@@ -1,0 +1,22 @@
+"""coast_tpu: a TPU-native software fault-tolerance framework.
+
+A ground-up re-design of BYU CCL's COAST (compiler-assisted software fault
+tolerance, /root/reference) for TPU hardware: protected dataflow regions are
+pure stepped JAX programs, replication is a vmap lane axis, voters are jnp
+reductions, CFCSS signatures are XOR tensor updates, and the QEMU+GDB fault
+injection campaign becomes one batched XLA program sharded across a slice.
+"""
+
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+from coast_tpu.passes.dataflow_protection import (ProtectedProgram,
+                                                  ProtectionConfig, protect)
+from coast_tpu.passes.strategies import DWC, EDDI, TMR, unprotected
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Region", "LeafSpec", "KIND_MEM", "KIND_REG", "KIND_CTRL", "KIND_RO",
+    "ProtectionConfig", "ProtectedProgram", "protect",
+    "TMR", "DWC", "EDDI", "unprotected",
+]

@@ -1,0 +1,150 @@
+"""Region IR: the protected-dataflow-region abstraction.
+
+COAST (the reference, /root/reference) protects a program by cloning LLVM IR
+instructions in place (projects/dataflowProtection/cloning.cpp).  The TPU-native
+re-expression does not mutate an instruction stream; instead a *region* is a
+pure, stepped JAX program over an explicit state pytree:
+
+    state = init()
+    for t in range(max_steps):        # lowered to lax.scan
+        if not done(state):
+            state = step(state, t)
+    errors = check(state)             # benchmark self-check (golden compare)
+
+The state pytree is the region's *memory image* -- the analogue of the ELF
+sections (.data/.bss/registers) that the reference fault-injector targets
+(simulation/platform/resources/mem.py:56-85).  Each leaf carries a
+:class:`LeafSpec` declaring:
+
+  * ``kind``   -- which sync-point class writes to it map to (``mem`` for
+    store-sync, ``ctrl`` for terminator-sync / loop-carried control,
+    ``reg`` for loop-carried data registers, ``ro`` for read-only input).
+  * ``xmr``    -- replication scope, the analogue of the ``__xMR`` /
+    ``__NO_xMR`` annotations in tests/COAST.h:11-64 and the per-global
+    scope lists of interface.cpp:244-362.
+
+The stepped shape is what makes *cycle-uniform* fault injection possible on
+TPU: the reference stops the guest at a uniformly random cycle
+(threadFunctions.py:451-520); we flip a bit at a uniformly random step index
+inside the traced scan, so an entire campaign batches as one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+State = Dict[str, jax.Array]
+
+# Leaf kinds -- the sync-point classes of synchronization.cpp:95-259 mapped
+# onto state-pytree leaves.
+KIND_MEM = "mem"    # written memory (store sync points)
+KIND_REG = "reg"    # loop-carried data registers
+KIND_CTRL = "ctrl"  # control state: loop counters, predicates (terminator sync)
+KIND_RO = "ro"      # read-only inputs (.rodata); never written by step()
+
+_VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO)
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafSpec:
+    """Replication/injection metadata for one state leaf.
+
+    ``xmr=None`` defers to the region default, mirroring how COAST treats
+    unannotated globals (scope rules in interface.cpp:364-532 and the
+    ``__DEFAULT_NO_xMR`` region-level default of tests/COAST.h).
+    """
+
+    kind: str = KIND_MEM
+    xmr: Optional[bool] = None
+    inject: bool = True   # is this leaf part of the injectable memory map?
+
+    def __post_init__(self):
+        if self.kind not in _VALID_KINDS:
+            raise ValueError(f"bad leaf kind {self.kind!r}; one of {_VALID_KINDS}")
+
+
+@dataclasses.dataclass
+class Region:
+    """A protected dataflow region (the unit `opt -TMR` operates on).
+
+    Semantics contract (all callables must be jit-traceable, static shapes):
+
+      * ``init()``                -> state pytree (dict name -> array)
+      * ``step(state, t)``        -> state; one micro-step of the program.
+        ``t`` is an int32 scalar tracer.  Must be pure.
+      * ``done(state)``           -> bool scalar; program has terminated.
+      * ``check(state)``          -> int32 scalar: the benchmark's own error
+        count (golden compare), the analogue of the guest's
+        ``C: E: F: T:`` UART line field ``E`` (resources/decoder.py:66).
+      * ``output(state)``         -> flat uint32 vector of the result, used
+        for SDC attribution in analysis.
+
+    ``nominal_steps`` is the fault-free runtime in steps (the injection
+    window upper bound, like ``maxSleepTime`` in resources/benchmarks.py:27-73);
+    ``max_steps`` is the watchdog bound (gdbHandlers.py:22-47): a run that has
+    not set ``done`` by then is classified a timeout (DUE).
+    """
+
+    name: str
+    init: Callable[[], State]
+    step: Callable[[State, jax.Array], State]
+    done: Callable[[State], jax.Array]
+    check: Callable[[State], jax.Array]
+    output: Callable[[State], jax.Array]
+    nominal_steps: int
+    max_steps: int
+    spec: Dict[str, LeafSpec]
+    default_xmr: bool = True
+    # Optional control-flow graph for CFCSS (coast_tpu.ir.graph.BlockGraph);
+    # regions without one can still be TMR/DWC protected.
+    graph: Any = None
+    # Extra metadata (benchmark golden values etc.)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def leaf_is_xmr(self, name: str) -> bool:
+        """Resolve the replication scope of a leaf (annotation > default)."""
+        s = self.spec[name]
+        return self.default_xmr if s.xmr is None else s.xmr
+
+    def validate(self) -> None:
+        """Shape/spec sanity check; the lightweight analogue of
+        verifyCloningSuccess (cloning.cpp:2305-2376)."""
+        state = jax.eval_shape(self.init)
+        missing = set(state) - set(self.spec)
+        extra = set(self.spec) - set(state)
+        if missing or extra:
+            raise ValueError(
+                f"region {self.name}: spec/state mismatch "
+                f"(missing specs {sorted(missing)}, dangling specs {sorted(extra)})")
+        stepped = jax.eval_shape(self.step, state, jnp.int32(0))
+        for k in state:
+            if (state[k].shape, state[k].dtype) != (stepped[k].shape, stepped[k].dtype):
+                raise ValueError(
+                    f"region {self.name}: step() changed leaf {k!r} from "
+                    f"{state[k].dtype}{state[k].shape} to "
+                    f"{stepped[k].dtype}{stepped[k].shape}")
+        if self.max_steps < self.nominal_steps:
+            raise ValueError("max_steps must be >= nominal_steps")
+
+    # ------------------------------------------------------------------
+    # Unprotected reference execution (the 'BOARD=x86, no OPT_PASSES' path,
+    # tests/makefiles/Makefile.compile.x86:80-124).
+    # ------------------------------------------------------------------
+    def run_unprotected(self) -> State:
+        state = self.init()
+
+        def body(carry, t):
+            state, halted = carry
+            new = self.step(state, t)
+            new = jax.tree.map(lambda o, n: jnp.where(halted, o, n), state, new)
+            halted = jnp.logical_or(halted, self.done(new))
+            return (new, halted), None
+
+        (state, _), _ = jax.lax.scan(
+            body, (state, jnp.bool_(False)),
+            jnp.arange(self.max_steps, dtype=jnp.int32))
+        return state

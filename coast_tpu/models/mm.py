@@ -1,0 +1,121 @@
+"""matrixMultiply: the reference's zero-to-aha benchmark as a TPU region.
+
+Semantics follow tests/matrixMultiply/matrixMultiply.c (9x9 matrix product,
+golden copy generated at startup, self-check counts mismatching words) and
+tests/mm_common/mm_common.c.  Values are seeded pseudo-randomly (seed 42 like
+the reference's ``seed_value``); we use our own LCG rather than glibc
+``rand()``, so the golden XOR constant differs from the reference's
+2802879457 but plays the same role (meta["golden_xor"]).
+
+Execution is stepped at two micro-steps per output row:
+
+    phase 0: acc  <- first[i,:] . second          (live in a register leaf)
+    phase 1: results[i,:] <- acc ; i += 1
+
+so a fault can land in the live accumulator between compute and store --
+the closest analogue of the reference's register-section injections
+(resources/registers.py A9Register) -- as well as in any memory word.
+
+Scope annotations mirror the C source: ``results_matrix`` is ``__xMR``,
+``golden`` is ``__NO_xMR`` (matrixMultiply.c globals), and the self-check
+runs unprotected on the voted view (checkGolden is ``__NO_xMR``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+SIDE = 9
+SEED = 42
+
+
+def _lcg_fill(seed: int, n: int) -> jnp.ndarray:
+    """Deterministic 15-bit pseudo-random values (stands in for rand())."""
+    out = []
+    x = seed & 0x7FFFFFFF
+    for _ in range(n):
+        x = (1103515245 * x + 12345) & 0x7FFFFFFF
+        out.append((x >> 16) & 0x7FFF)
+    return jnp.array(out, dtype=jnp.int32)
+
+
+def _matmul_u32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """9x9 product in mod-2^32 arithmetic (C unsigned semantics)."""
+    au = a.astype(jnp.uint32)
+    bu = b.astype(jnp.uint32)
+    return jnp.einsum("ik,kj->ij", au, bu)
+
+
+def make_region() -> Region:
+    first = _lcg_fill(SEED, SIDE * SIDE).reshape(SIDE, SIDE)
+    second = _lcg_fill(SEED + 1, SIDE * SIDE).reshape(SIDE, SIDE)
+    golden = _matmul_u32(first, second)
+    golden_xor = int(jnp.bitwise_xor.reduce(golden.reshape(-1)))
+
+    def init():
+        return {
+            "first": first,
+            "second": second,
+            "results": jnp.zeros((SIDE, SIDE), jnp.uint32),
+            "golden": golden,
+            "acc": jnp.zeros((SIDE,), jnp.uint32),
+            "i": jnp.int32(0),
+            "phase": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i, phase = state["i"], state["phase"]
+        # Gather of row i: OOB (corrupted i) clamps, i.e. reads the wrong
+        # row rather than trapping -- documented fidelity envelope vs the
+        # A9's data aborts (SURVEY.md §7 "Hard parts").
+        row_a = jax.lax.dynamic_index_in_dim(
+            state["first"], i, axis=0, keepdims=False).astype(jnp.uint32)
+        computed = jnp.sum(row_a[:, None] * state["second"].astype(jnp.uint32),
+                           axis=0)
+        compute_phase = phase == 0
+        acc = jnp.where(compute_phase, computed, state["acc"])
+        stored = jax.lax.dynamic_update_index_in_dim(
+            state["results"], state["acc"], i, axis=0)
+        results = jnp.where(compute_phase, state["results"], stored)
+        return {
+            **state,
+            "acc": acc,
+            "results": results,
+            "i": jnp.where(compute_phase, i, i + 1),
+            "phase": jnp.where(compute_phase, 1, 0),
+        }
+
+    def done(state):
+        return state["i"] >= SIDE
+
+    def check(state):
+        return jnp.sum(state["golden"] != state["results"]).astype(jnp.int32)
+
+    def output(state):
+        return state["results"].reshape(-1)
+
+    return Region(
+        name="matrixMultiply",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=2 * SIDE,
+        max_steps=6 * SIDE,
+        spec={
+            "first": LeafSpec(KIND_RO),
+            "second": LeafSpec(KIND_RO),
+            "results": LeafSpec(KIND_MEM, xmr=True),
+            "golden": LeafSpec(KIND_MEM, xmr=False),
+            "acc": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+            "phase": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        meta={"golden_xor": golden_xor, "oracle": "Number of errors: 0"},
+    )

@@ -1,0 +1,63 @@
+"""Voter lowering: COAST's `insertVoters` as jnp reductions over the lane axis.
+
+The reference materialises voters as IR instruction sequences at each sync
+point: for TMR a ``cmp eq(orig, clone1)`` + ``select(cmp, orig, clone2)``
+named "vote" (synchronization.cpp:439-448, 512-529); for DWC a compare plus a
+conditional branch to a per-function error block that aborts
+(synchronization.cpp:1117-1267).  On TPU the replicas are lanes of a leading
+axis, so a voter is an elementwise reduction over axis 0 -- no communication,
+fused by XLA into the surrounding computation.
+
+All voters return ``(value, miscompare)`` where ``miscompare`` is a bool
+scalar: "some lane disagreed somewhere in this tensor".  TMR uses it to bump
+the ``TMR_ERROR_CNT`` analogue (synchronization.cpp:1354-1465); DWC uses it
+to raise the abort flag.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tmr_vote(lanes: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Majority vote over 3 lanes (axis 0).
+
+    Exactly the reference's two-instruction voter: ``select(l0==l1, l0, l2)``
+    (synchronization.cpp:439-448).  With a single flipped lane the majority is
+    always correct; the returned value is broadcast back to every lane by the
+    caller, which is what repairs the corrupted replica (the reference stores
+    the voted value through the original *and* cloned store instructions,
+    syncStoreInst synchronization.cpp:476-561).
+    """
+    l0, l1, l2 = lanes[0], lanes[1], lanes[2]
+    agree01 = l0 == l1
+    voted = jnp.where(agree01, l0, l2)
+    miscompare = jnp.logical_not(
+        jnp.logical_and(jnp.all(agree01), jnp.all(l1 == l2)))
+    return voted, miscompare
+
+
+def dwc_check(lanes: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Duplication-with-compare over 2 lanes.
+
+    Detection only: the value is *not* repaired (there is no majority), the
+    caller must latch ``miscompare`` into the abort lattice -- the batched
+    analogue of branching to ``FAULT_DETECTED_DWC`` -> ``abort()``
+    (insertErrorFunction, synchronization.cpp:1198-1267).  The OR-reduction of
+    per-element compares mirrors processCallSync's OR of per-arg compares
+    (synchronization.cpp:709-726).
+    """
+    miscompare = jnp.logical_not(jnp.all(lanes[0] == lanes[1]))
+    return lanes[0], miscompare
+
+
+def vote(lanes: jax.Array, num_clones: int) -> Tuple[jax.Array, jax.Array]:
+    """Dispatch on replica count: 3 -> TMR majority, 2 -> DWC compare."""
+    if num_clones == 3:
+        return tmr_vote(lanes)
+    if num_clones == 2:
+        return dwc_check(lanes)
+    raise ValueError(f"unsupported replica count {num_clones} (COAST supports 2 or 3)")

@@ -1,0 +1,309 @@
+"""dataflowProtection: the core replication engine, TPU-native.
+
+The reference's engine (projects/dataflowProtection/, 7,899 LoC C++) clones
+LLVM instructions/globals N-1 times, rewires operands, and inserts voters at
+sync points (pipeline dataflowProtection.cpp:63-164).  The TPU-native engine
+does the equivalent transform on a :class:`~coast_tpu.ir.region.Region`:
+
+  * *cloning*  -> replicated state leaves get a leading lane axis of size N
+    (the replica set lives as one HBM tensor per leaf; cloned globals at
+    distinct addresses become lanes, cloning.cpp:2417-2462).
+  * *instruction replication* -> the region ``step`` runs once per lane:
+    ``vmap`` over the lane axis (interleaved scheduling) or an unrolled
+    per-lane loop (segmented scheduling) -- the -i / -s knob of
+    utils.cpp:370-550 becomes a lowering choice, not an instruction mover.
+  * *insertVoters* -> jnp reductions over the lane axis (coast_tpu.ops.voters)
+    at the same sync-point classes the reference uses
+    (populateSyncPoints, synchronization.cpp:95-259):
+       - store sync   : writes to ``mem`` leaves (syncStoreInst :476-561)
+       - terminator   : ``ctrl`` leaves (loop counters/predicates) are voted
+         every step *before* the done-predicate branch, so lanes cannot
+         structurally diverge (syncTerminator :741-1113)
+       - SoR crossing : writes to *shared* (non-xMR) leaves are voted before
+         the single store, which is also how -noMemReplication syncs
+         (the pervasive noMemReplicationFlag branches of 1b/1c)
+       - call/return  : the region boundary -- check()/output() read a voted
+         view of the final state (processCallSync :563-738).
+  * *error handling* -> DWC's ``FAULT_DETECTED_DWC -> abort()``
+    (synchronization.cpp:1198-1267) cannot abort a batched campaign; it
+    becomes a latched poison flag that freezes the run's state and classifies
+    it DUE.  TMR's ``TMR_ERROR_CNT`` correction counter
+    (insertTMRCorrectionCount :1354-1465) becomes an int32 accumulator; the
+    ``-countSyncs`` ``__SYNC_COUNT`` global (:103-121) likewise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO, Region, State
+from coast_tpu.ops import voters
+from coast_tpu.ops.bitflip import make_flipper
+
+_INT_DTYPES = (jnp.int32, jnp.uint32, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtectionConfig:
+    """Mirror of the reference CLI surface (dataflowProtection.cpp:14-47,
+    full flag table docs/source/passes.rst:30-140).
+
+    num_clones: 3 = TMR, 2 = DWC, 1 = unprotected passthrough.
+    """
+
+    num_clones: int = 3
+    # -noMemReplication: keep one copy of memory, replicate compute only;
+    # sync (vote) every value as it is stored (registers-only replication).
+    no_mem_replication: bool = False
+    # -noStoreDataSync: skip voting the data of stores to replicated memory.
+    no_store_data_sync: bool = False
+    # -noStoreAddrSync / -noLoadSync: skip voting the control/index state
+    # that forms addresses.  Folded into one knob because region control
+    # state is the only address-forming state.
+    no_ctrl_sync: bool = False
+    # -countErrors -> TMR_ERROR_CNT analogue.
+    count_errors: bool = True
+    # -countSyncs -> __SYNC_COUNT analogue.
+    count_syncs: bool = False
+    # -i (interleave, default) vs -s (segmented) replica scheduling.
+    segmented: bool = False
+    # Scope overrides, the -ignoreGlbls / -cloneGlbls CL lists
+    # (interface.cpp:82-164); highest priority, above region annotations.
+    ignore_globals: Tuple[str, ...] = ()
+    xmr_globals: Tuple[str, ...] = ()
+    # CFCSS stacking (projects/CFCSS); filled by passes.cfcss.
+    cfcss: bool = False
+
+    def resolve_xmr(self, region: Region, name: str) -> bool:
+        if self.num_clones == 1:
+            return False
+        if name in self.ignore_globals:
+            return False
+        if name in self.xmr_globals:
+            return True
+        if self.no_mem_replication and region.spec[name].kind in (KIND_MEM, KIND_RO):
+            return False
+        if region.spec[name].kind == KIND_RO:
+            # Read-only inputs are never cloned: same rule as constants /
+            # unwritten globals staying single-copy in the reference unless
+            # explicitly listed (populateValuesToClone, cloning.cpp:62-288).
+            return False
+        return region.leaf_is_xmr(name)
+
+
+def _flags_init(cfg: ProtectionConfig) -> Dict[str, jax.Array]:
+    return {
+        "dwc_fault": jnp.bool_(False),      # DWC miscompare latched -> DUE
+        "cfc_fault": jnp.bool_(False),      # CFCSS signature fault -> DUE
+        "tmr_cnt": jnp.int32(0),            # TMR_ERROR_CNT
+        "sync_cnt": jnp.int32(0),           # __SYNC_COUNT
+        "steps": jnp.int32(0),              # guest runtime T in steps
+        "done": jnp.bool_(False),
+    }
+
+
+class ProtectedProgram:
+    """A region after dataflowProtection: N-lane stepped program + flags.
+
+    The compiled artifact the strategies (TMR/DWC) and the fault-injection
+    campaign runner consume.  All methods are jit-traceable.
+    """
+
+    def __init__(self, region: Region, cfg: ProtectionConfig):
+        region.validate()
+        self.region = region
+        self.cfg = cfg
+        self.replicated: Dict[str, bool] = {
+            name: cfg.resolve_xmr(region, name) for name in region.spec
+        }
+        # Sync-point table: which replicated leaves get voted each step.
+        self.step_sync: Dict[str, bool] = {}
+        for name, spec in region.spec.items():
+            if not self.replicated[name]:
+                continue
+            if spec.kind == KIND_CTRL:
+                self.step_sync[name] = not cfg.no_ctrl_sync
+            elif spec.kind == KIND_MEM:
+                self.step_sync[name] = not cfg.no_store_data_sync
+            else:  # reg: registers are voted only where used by a sync point
+                self.step_sync[name] = False
+        # Injectable memory map order (stable): used by the flipper and by
+        # inject.mem.MemoryMap.
+        self.leaf_order = [n for n in region.spec if region.spec[n].inject]
+        self._flip = make_flipper(self.leaf_order)
+        # CFCSS runtime hook, installed by passes.cfcss.apply_cfcss.
+        self._cfcss_step = None
+
+    # -- state construction -------------------------------------------------
+    def init_pstate(self) -> Tuple[State, Dict[str, jax.Array]]:
+        state = self.region.init()
+        for name, arr in state.items():
+            if arr.dtype not in _INT_DTYPES:
+                raise TypeError(
+                    f"leaf {name!r} has dtype {arr.dtype}; injectable state "
+                    "must be 32-bit (word-addressed memory map)")
+        pstate = {
+            name: (jnp.broadcast_to(arr, (self.cfg.num_clones,) + arr.shape)
+                   if self.replicated[name] else arr)
+            for name, arr in state.items()
+        }
+        return pstate, _flags_init(self.cfg)
+
+    # -- lane execution -----------------------------------------------------
+    def _run_lanes(self, pstate: State, t: jax.Array) -> State:
+        """Execute step() once per lane; returns every leaf with a lane axis.
+
+        Interleaved (-i): one vmap -- XLA vectorises the N replicas through
+        each op, the closest analogue of interleaving replica instructions.
+        Segmented (-s): an unrolled per-lane loop -- each replica's whole
+        step is scheduled as a unit before the next (utils.cpp:370-550).
+        """
+        n = self.cfg.num_clones
+        if n == 1:
+            return {k: v[None] for k, v in self.region.step(pstate, t).items()}
+
+        if self.cfg.segmented:
+            lane_outs = []
+            for lane in range(n):
+                lane_state = {
+                    k: (v[lane] if self.replicated[k] else v)
+                    for k, v in pstate.items()
+                }
+                lane_outs.append(self.region.step(lane_state, t))
+            return {k: jnp.stack([o[k] for o in lane_outs]) for k in lane_outs[0]}
+
+        in_axes = ({k: (0 if self.replicated[k] else None) for k in pstate},
+                   None)
+        return jax.vmap(self.region.step, in_axes=in_axes, out_axes=0)(pstate, t)
+
+    # -- one protected step -------------------------------------------------
+    def step(self, pstate: State, flags: Dict[str, jax.Array],
+             t: jax.Array) -> Tuple[State, Dict[str, jax.Array]]:
+        cfg = self.cfg
+        halted = jnp.logical_or(flags["done"], flags["dwc_fault"])
+        halted = jnp.logical_or(halted, flags["cfc_fault"])
+
+        laned = self._run_lanes(pstate, t)
+
+        new_state: State = {}
+        miscompares = []
+        syncs = jnp.int32(0)
+        for name in pstate:
+            out = laned[name]
+            if self.replicated[name]:
+                if self.step_sync[name] and cfg.num_clones > 1:
+                    voted, mis = voters.vote(out, cfg.num_clones)
+                    miscompares.append(mis)
+                    syncs = syncs + 1
+                    if cfg.num_clones == 3:
+                        # Voted value repairs every replica (the reference
+                        # stores the select output through original + cloned
+                        # stores, syncStoreInst :476-561).
+                        out = jnp.broadcast_to(voted, out.shape)
+                new_state[name] = out
+            else:
+                if self.region.spec[name].kind == KIND_RO:
+                    new_state[name] = out[0]
+                elif cfg.num_clones > 1:
+                    # Store crossing the sphere of replication: vote before
+                    # the single store (verification.cpp forces these into
+                    # syncGlobalStores :587,676).
+                    voted, mis = voters.vote(out, cfg.num_clones)
+                    miscompares.append(mis)
+                    syncs = syncs + 1
+                    new_state[name] = voted
+                else:
+                    new_state[name] = out[0]
+
+        # Latch fault/correction accounting.
+        if miscompares and cfg.num_clones == 2:
+            mis_any = jnp.any(jnp.stack(miscompares))
+            flags = {**flags,
+                     "dwc_fault": jnp.logical_or(flags["dwc_fault"],
+                                                 jnp.logical_and(~halted, mis_any))}
+        elif miscompares and cfg.num_clones == 3 and cfg.count_errors:
+            mis_cnt = jnp.sum(jnp.stack(miscompares).astype(jnp.int32))
+            flags = {**flags,
+                     "tmr_cnt": flags["tmr_cnt"] + jnp.where(halted, 0, mis_cnt)}
+        if cfg.count_syncs:
+            flags = {**flags,
+                     "sync_cnt": flags["sync_cnt"] + jnp.where(halted, 0, syncs)}
+
+        # CFCSS signature update/check (stacked pass), if installed.
+        if self._cfcss_step is not None:
+            new_state, flags = self._cfcss_step(new_state, flags, t, halted)
+
+        # Terminator: evaluate done() on the voted view, *before* committing,
+        # so a single corrupted lane cannot steer control flow
+        # (syncTerminator votes branch predicates, :741-1113).
+        done_now = self.region.done(self._voted_view(new_state))
+        flags = {**flags,
+                 "done": jnp.logical_or(flags["done"],
+                                        jnp.logical_and(~halted, done_now)),
+                 "steps": flags["steps"] + jnp.where(halted, 0, 1)}
+
+        # Freeze state once halted (DWC abort semantics in a batch: the run's
+        # memory image stops evolving the step the fault latches).
+        new_state = jax.tree.map(
+            lambda old, new: jnp.where(halted, old, new), pstate, new_state)
+        return new_state, flags
+
+    # -- whole-program runners ---------------------------------------------
+    def _voted_view(self, pstate: State) -> State:
+        """Collapse lanes for the unprotected consumer of the result -- the
+        analogue of checkGolden() being __NO_xMR and reading voted stores
+        (tests/matrixMultiply/matrixMultiply.c checkGolden)."""
+        view: State = {}
+        for name, arr in pstate.items():
+            if not self.replicated[name]:
+                view[name] = arr
+            elif self.cfg.num_clones == 3:
+                view[name] = voters.tmr_vote(arr)[0]
+            else:
+                view[name] = arr[0]
+        return view
+
+    def run(self, fault: Optional[Dict[str, jax.Array]] = None
+            ) -> Dict[str, jax.Array]:
+        """Run to completion; optionally XOR one bit at step ``fault['t']``.
+
+        ``fault`` keys: leaf_id, lane, word, bit, t (int32 scalars).  Returns
+        the run record mirroring the guest UART line ``C: E: F: T:``
+        (resources/decoder.py:66) plus the DUE flags.
+        """
+        pstate, flags = self.init_pstate()
+
+        def body(carry, t):
+            pstate, flags = carry
+            if fault is not None:
+                pstate = jax.lax.cond(
+                    t == fault["t"],
+                    lambda s: self._flip(s, self.replicated, fault["leaf_id"],
+                                         fault["lane"], fault["word"], fault["bit"]),
+                    lambda s: s, pstate)
+            return self.step(pstate, flags, t), None
+
+        (pstate, flags), _ = jax.lax.scan(
+            body, (pstate, flags),
+            jnp.arange(self.region.max_steps, dtype=jnp.int32))
+
+        view = self._voted_view(pstate)
+        return {
+            "errors": self.region.check(view),          # E: SDC count
+            "corrected": flags["tmr_cnt"],              # F: TMR corrections
+            "steps": flags["steps"],                    # T: runtime
+            "sync_count": flags["sync_cnt"],
+            "done": flags["done"],
+            "dwc_fault": flags["dwc_fault"],
+            "cfc_fault": flags["cfc_fault"],
+            "output": self.region.output(view),
+        }
+
+
+def protect(region: Region, cfg: ProtectionConfig) -> ProtectedProgram:
+    """`opt -load DataflowProtection.so` equivalent: apply the engine."""
+    return ProtectedProgram(region, cfg)

@@ -1,0 +1,46 @@
+"""Strategy front-ends: TMR / DWC / EDDI over the replication engine.
+
+Mirrors the thin-wrapper passes of the reference: projects/TMR/TMR.cpp:26-36
+(``dataflowProtection::run(M, 3)``), projects/DWC/DWC.cpp:26-36 (``run(M, 2)``)
+and the deprecated projects/EDDI/EDDI.cpp:29-43 which refuses to run and tells
+the user to switch to DWC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from coast_tpu.ir.region import Region
+from coast_tpu.passes.dataflow_protection import (ProtectedProgram,
+                                                  ProtectionConfig, protect)
+
+
+def TMR(region: Region, **overrides) -> ProtectedProgram:
+    """Triple modular redundancy (SWIFT-R/Trikaya lineage,
+    docs/source/passes.rst:16): 3 lanes, majority voters, fault masking."""
+    cfg = dataclasses.replace(ProtectionConfig(num_clones=3), **overrides)
+    if cfg.num_clones != 3:
+        raise ValueError("TMR is fixed at 3 replicas (TMR.cpp:26-36)")
+    return protect(region, cfg)
+
+
+def DWC(region: Region, **overrides) -> ProtectedProgram:
+    """Duplication with compare: 2 lanes, compare + abort (detect-only)."""
+    cfg = dataclasses.replace(ProtectionConfig(num_clones=2), **overrides)
+    if cfg.num_clones != 2:
+        raise ValueError("DWC is fixed at 2 replicas (DWC.cpp:26-36)")
+    return protect(region, cfg)
+
+
+def EDDI(region: Region, **overrides) -> ProtectedProgram:
+    """Deprecated; kept for name recognition exactly like the reference
+    (EDDI.cpp:29-43 asserts with this instruction)."""
+    raise NotImplementedError(
+        "EDDI is deprecated. Switch to DWC (duplication with compare).")
+
+
+def unprotected(region: Region, **overrides) -> ProtectedProgram:
+    """Passthrough (the 'no OPT_PASSES' baseline build of the test harness,
+    unittest/cfg/full.yml first column)."""
+    cfg = dataclasses.replace(ProtectionConfig(num_clones=1), **overrides)
+    return protect(region, cfg)

@@ -1,0 +1,18 @@
+"""Test configuration: force the CPU backend with a virtual 8-device mesh.
+
+The CPU jax backend is our 'BOARD=x86' (the reference runs its functional
+regression on x86 before any real board, unittest/unittest.py:28-52); the
+8 virtual devices let sharding tests exercise real meshes without TPU chips.
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,103 @@
+"""End-to-end slice: matrixMultiply under TMR/DWC (SURVEY.md §7 step 3).
+
+Mirrors the reference's tier-1 functional tests (unittest/unittest.py:54-88):
+protection must not change semantics (golden check passes), and the
+zero-to-aha property: a single bit flip in one lane is corrected under TMR
+while the same flip changes the output of an unprotected run.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coast_tpu import DWC, TMR, ProtectionConfig, protect, unprotected
+from coast_tpu.models import mm
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+def test_unprotected_golden(region):
+    rec = jax.jit(unprotected(region).run)()
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+    assert int(rec["steps"]) == region.nominal_steps
+    assert int(jnp.bitwise_xor.reduce(rec["output"])) == region.meta["golden_xor"]
+
+
+@pytest.mark.parametrize("segmented", [False, True])
+def test_tmr_preserves_semantics(region, segmented):
+    rec = jax.jit(TMR(region, segmented=segmented).run)()
+    assert int(rec["errors"]) == 0
+    assert int(rec["corrected"]) == 0
+    assert bool(rec["done"])
+
+
+def test_dwc_preserves_semantics(region):
+    rec = jax.jit(DWC(region).run)()
+    assert int(rec["errors"]) == 0
+    assert not bool(rec["dwc_fault"])
+
+
+def _fault(prog, leaf, lane=1, word=0, bit=7, t=3):
+    return {
+        "leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+        "lane": jnp.int32(lane),
+        "word": jnp.int32(word),
+        "bit": jnp.int32(bit),
+        "t": jnp.int32(t),
+    }
+
+
+def test_zero_to_aha(region):
+    """The round-1 demo gate: same flip, three outcomes."""
+    # Flip a results-matrix word mid-run.
+    unprot = unprotected(region)
+    rec_u = jax.jit(unprot.run)(_fault(unprot, "results", lane=0, word=0, bit=20, t=5))
+    assert int(rec_u["errors"]) > 0, "unprotected run must show SDC"
+
+    tmr = TMR(region)
+    rec_t = jax.jit(tmr.run)(_fault(tmr, "results", lane=1, word=0, bit=20, t=5))
+    assert int(rec_t["errors"]) == 0, "TMR must mask the flip"
+    assert int(rec_t["corrected"]) > 0, "TMR_ERROR_CNT must record the correction"
+
+    dwc = DWC(region)
+    rec_d = jax.jit(dwc.run)(_fault(dwc, "results", lane=1, word=0, bit=20, t=5))
+    assert bool(rec_d["dwc_fault"]), "DWC must detect and abort (DUE)"
+
+
+def test_tmr_corrects_register_fault(region):
+    tmr = TMR(region)
+    # Flip the live accumulator between compute (phase 0) and store (phase 1):
+    # t=1 is the first store step.
+    rec = jax.jit(tmr.run)(_fault(tmr, "acc", lane=2, word=4, bit=15, t=1))
+    assert int(rec["errors"]) == 0
+    assert int(rec["corrected"]) > 0
+
+
+def test_tmr_corrects_control_fault(region):
+    tmr = TMR(region)
+    rec = jax.jit(tmr.run)(_fault(tmr, "i", lane=0, word=0, bit=31, t=4))
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+
+
+def test_unprotected_control_fault_times_out(region):
+    """Bit 31 of the loop counter makes i hugely negative: the watchdog
+    analogue (max_steps bound) must classify a hang, like the reference's
+    timeout watchdog (gdbHandlers.py:22-47)."""
+    unprot = unprotected(region)
+    rec = jax.jit(unprot.run)(_fault(unprot, "i", lane=0, word=0, bit=31, t=4))
+    assert not bool(rec["done"])
+    assert int(rec["steps"]) == region.max_steps
+
+
+def test_golden_corruption_reports_sdc(region):
+    """golden is __NO_xMR: flipping it makes the self-check miscount, which
+    the reference would classify as SDC from the UART line -- protection
+    does not extend outside the sphere of replication."""
+    tmr = TMR(region)
+    rec = jax.jit(tmr.run)(_fault(tmr, "golden", lane=0, word=10, bit=3, t=2))
+    assert int(rec["errors"]) > 0
