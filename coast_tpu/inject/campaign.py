@@ -1,0 +1,188 @@
+"""Batched fault-injection campaigns: the supervisor.py replacement.
+
+The reference campaign loop costs seconds per injection: spawn QEMU + GDB,
+sleep to a random point, interrupt, GDB round-trips to flip one bit, run to
+a breakpoint, parse UART, restart everything when a run wedges
+(threadFunctions.py:315-953; supervisor.py:400-509).  Here an entire batch
+of injections is ONE jitted XLA program:
+
+    vmap over campaigns ( scan over steps ( flip-at-t  +  N-lane step ) )
+
+so the per-injection cost is amortised to a few microseconds, and the only
+host<->device traffic is one classification tensor per batch (the north-star
+>=1000x injections/sec of BASELINE.json).  Campaign scale-out across chips
+-- the reference runs multiple supervisors side-by-side on disjoint port
+ranges (supervisor.py:335,386-391) -- is the batch axis sharded over a
+device mesh (coast_tpu.parallel.mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import FaultSchedule, generate
+from coast_tpu.passes.dataflow_protection import ProtectedProgram
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    """Aggregate + per-run results of one campaign (host-side)."""
+
+    benchmark: str
+    strategy: str
+    n: int
+    counts: Dict[str, int]            # class name -> count
+    seconds: float
+    codes: np.ndarray                 # int32 [n] class code per run
+    errors: np.ndarray                # int32 [n] E per run
+    corrected: np.ndarray             # int32 [n] F per run
+    steps: np.ndarray                 # int32 [n] T per run
+    schedule: FaultSchedule
+    seed: int
+
+    @property
+    def injections_per_sec(self) -> float:
+        return self.n / self.seconds if self.seconds > 0 else float("inf")
+
+    @property
+    def due(self) -> int:
+        """DUE bucket: aborts also count as timeouts in the reference's
+        summary (jsonParser.py:165-172)."""
+        return self.counts["due_abort"] + self.counts["due_timeout"]
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "benchmark": self.benchmark,
+            "strategy": self.strategy,
+            "injections": self.n,
+            **self.counts,
+            "due": self.due,
+            "seconds": round(self.seconds, 6),
+            "injections_per_sec": round(self.injections_per_sec, 2),
+            "seed": self.seed,
+        }
+
+
+class CampaignRunner:
+    """Runs seeded bit-flip campaigns against one protected program."""
+
+    def __init__(self, prog: ProtectedProgram,
+                 sections: Optional[Sequence[str]] = None,
+                 strategy_name: Optional[str] = None):
+        self.prog = prog
+        self.mmap = MemoryMap(prog, sections)
+        self.strategy_name = strategy_name or f"N={prog.cfg.num_clones}"
+        out_words = int(np.prod(jax.eval_shape(
+            prog.region.output, jax.eval_shape(prog.region.init)).shape))
+
+        def run_one(fault: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            rec = prog.run(fault)
+            return {
+                "code": cls.classify(rec, out_words),
+                "errors": rec["errors"],
+                "corrected": rec["corrected"],
+                "steps": rec["steps"],
+            }
+
+        self._run_batch = jax.jit(jax.vmap(run_one))
+
+    # -- execution ----------------------------------------------------------
+    def run_schedule(self, sched: FaultSchedule,
+                     batch_size: int = 4096) -> CampaignResult:
+        t0 = time.perf_counter()
+        outs: List[Dict[str, np.ndarray]] = []
+        for lo in range(0, len(sched), batch_size):
+            part = sched.slice(lo, min(lo + batch_size, len(sched)))
+            n_part = len(part)
+            # Pad ragged final batches to batch_size so every batch hits the
+            # same compiled program (a distinct remainder shape would force a
+            # fresh multi-second XLA compile); padded rows are dropped below.
+            pad = batch_size - n_part if n_part < batch_size else 0
+            fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
+                     for k, v in part.device_arrays().items()}
+            got = jax.device_get(self._run_batch(fault))
+            outs.append({k: v[:n_part] for k, v in got.items()})
+        if outs:
+            merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+        else:
+            merged = {k: np.zeros(0, np.int32)
+                      for k in ("code", "errors", "corrected", "steps")}
+        seconds = time.perf_counter() - t0
+        binc = np.bincount(merged["code"], minlength=cls.NUM_CLASSES)
+        counts = {name: int(binc[i]) for i, name in enumerate(cls.CLASS_NAMES)}
+        return CampaignResult(
+            benchmark=self.prog.region.name,
+            strategy=self.strategy_name,
+            n=len(sched),
+            counts=counts,
+            seconds=seconds,
+            codes=merged["code"],
+            errors=merged["errors"],
+            corrected=merged["corrected"],
+            steps=merged["steps"],
+            schedule=sched,
+            seed=sched.seed,
+        )
+
+    def run(self, n: int, seed: int = 0,
+            batch_size: int = 4096) -> CampaignResult:
+        sched = generate(self.mmap, n, seed, self.prog.region.nominal_steps)
+        return self.run_schedule(sched, batch_size)
+
+    def run_until_errors(self, min_errors: int, seed: int = 0,
+                         batch_size: int = 4096,
+                         round_to: int = 1000,
+                         max_n: int = 1_000_000) -> CampaignResult:
+        """The reference's campaign-sizing convention: inject until N SDC
+        errors are seen, then round the campaign up to the next ``round_to``
+        (supervisor.py:339; threadFunctions.py:534-558)."""
+        results: List[CampaignResult] = []
+        total = 0
+        errors_seen = 0
+        chunk_seed = seed
+        while total < max_n:
+            res = self.run(batch_size, seed=chunk_seed, batch_size=batch_size)
+            results.append(res)
+            total += res.n
+            errors_seen += res.counts["sdc"]
+            chunk_seed += 1
+            if errors_seen >= min_errors:
+                break
+        target = ((total + round_to - 1) // round_to) * round_to
+        while total < target and total < max_n:
+            res = self.run(min(batch_size, target - total), seed=chunk_seed,
+                           batch_size=batch_size)
+            results.append(res)
+            total += res.n
+            chunk_seed += 1
+        return _merge_results(results, seed)
+
+
+def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
+    first = parts[0]
+    counts = {k: sum(p.counts[k] for p in parts) for k in first.counts}
+    sched = FaultSchedule(
+        *(np.concatenate([getattr(p.schedule, f) for p in parts])
+          for f in ("leaf_id", "lane", "word", "bit", "t", "section_idx")),
+        seed=seed)
+    return CampaignResult(
+        benchmark=first.benchmark,
+        strategy=first.strategy,
+        n=sum(p.n for p in parts),
+        counts=counts,
+        seconds=sum(p.seconds for p in parts),
+        codes=np.concatenate([p.codes for p in parts]),
+        errors=np.concatenate([p.errors for p in parts]),
+        corrected=np.concatenate([p.corrected for p in parts]),
+        steps=np.concatenate([p.steps for p in parts]),
+        schedule=sched,
+        seed=seed,
+    )

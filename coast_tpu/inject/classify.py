@@ -1,0 +1,59 @@
+"""Run classification: the reference's SDC/DUE taxonomy as device-side codes.
+
+Mirrors the result-class lattice of supportClasses.py (RunResult /
+TimeoutResult / AbortResult / StackOverflowResult / InvalidResult) and the
+counting rules of jsonParser.summarizeRuns (jsonParser.py:148-201):
+
+  * abort and stack-overflow *also* count as timeouts (DUE) there; here
+    DUE_ABORT and DUE_TIMEOUT are distinct codes that both aggregate into
+    the DUE bucket.
+  * a RunResult with errors>0 is SDC regardless of faults; faults>0 with
+    errors==0 is a corrected run; otherwise success.
+
+Precedence (a DWC abort freezes an incomplete results matrix, so E>0 there
+must not be read as SDC): INVALID > DUE_ABORT > DUE_TIMEOUT > SDC >
+CORRECTED > SUCCESS.
+
+Timeout on TPU: "hang" is defined by the watchdog step bound
+(Region.max_steps; the reference arms a threading.Timer watchdog on every
+continue, gdbHandlers.py:22-47).  INVALID (unparseable UART in the
+reference, decoder.py:62-116) maps to a self-check result outside its
+representable domain -- reachable when a flip corrupts the check machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+SUCCESS = 0
+CORRECTED = 1   # "faults" column: TMR voted away a miscompare, output clean
+SDC = 2         # "errors" column: silent data corruption
+DUE_ABORT = 3   # DWC / CFCSS detected -> abort()
+DUE_TIMEOUT = 4  # watchdog bound hit (hang)
+INVALID = 5
+
+NUM_CLASSES = 6
+CLASS_NAMES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
+               "invalid")
+
+
+def classify(rec: Dict[str, jax.Array], output_words: int) -> jax.Array:
+    """record (from ProtectedProgram.run) -> int32 class code."""
+    errors = rec["errors"]
+    invalid = jnp.logical_or(errors < 0, errors > output_words)
+    code = jnp.where(rec["corrected"] > 0, CORRECTED, SUCCESS)
+    code = jnp.where(errors > 0, SDC, code)
+    code = jnp.where(jnp.logical_not(rec["done"]), DUE_TIMEOUT, code)
+    code = jnp.where(jnp.logical_or(rec["dwc_fault"], rec["cfc_fault"]),
+                     DUE_ABORT, code)
+    code = jnp.where(invalid, INVALID, code)
+    return code.astype(jnp.int32)
+
+
+def histogram(codes: jax.Array) -> jax.Array:
+    """Per-class counts (int32 [NUM_CLASSES]); psum-able across shards."""
+    return jnp.sum(
+        jax.nn.one_hot(codes, NUM_CLASSES, dtype=jnp.int32), axis=0)

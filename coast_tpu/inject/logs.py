@@ -1,0 +1,75 @@
+"""Campaign logs in the reference's InjectionLog JSON schema.
+
+Each injection serialises to the dict layout of
+supportClasses.InjectionLog.getDict (supportClasses.py:338-353) with a
+result sub-dict whose discriminating keys match the FromDict dispatch
+(supportClasses.py:355-389): "core" -> RunResult, "timeout" ->
+TimeoutResult, "message" -> AbortResult, "invalid" -> InvalidResult.
+jsonParser.py-style analysis therefore carries over directly
+(coast_tpu.analysis.json_parser consumes the same files).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import Dict, List
+
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignResult
+from coast_tpu.inject.mem import MemoryMap
+
+
+def _timestamp() -> str:
+    return datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")
+
+
+def _result_dict(code: int, errors: int, corrected: int, steps: int,
+                 ts: str) -> Dict[str, object]:
+    if code in (cls.SUCCESS, cls.CORRECTED, cls.SDC):
+        return {"timestamp": ts, "core": 0, "runtime": int(steps),
+                "errors": int(errors), "faults": int(corrected)}
+    if code == cls.DUE_ABORT:
+        return {"type": "DWC/CFCSS", "message": "FAULT_DETECTED abort",
+                "timestamp": ts, "errors": 1}
+    if code == cls.DUE_TIMEOUT:
+        return {"trap": False, "timeout": f"hit step bound at {int(steps)}",
+                "timestamp": ts}
+    return {"invalid": f"self-check out of domain (E={int(errors)})",
+            "timestamp": ts}
+
+
+def to_injection_logs(res: CampaignResult,
+                      mmap: MemoryMap) -> List[Dict[str, object]]:
+    ts = _timestamp()
+    secs = {s.leaf_id: s for s in mmap.sections}
+    logs = []
+    sched = res.schedule
+    for i in range(res.n):
+        sec = secs[int(sched.leaf_id[i])]
+        logs.append({
+            "timestamp": ts,
+            "number": i,
+            "section": sec.kind,
+            "address": int(sched.word[i]),
+            "oldValue": None,              # values live on-device; the flip
+            "newValue": None,              # is XOR(1<<bit), recorded below
+            "sleepTime": 0,
+            "cycles": int(sched.t[i]),     # step index = cycle analogue
+            "PC": int(sched.t[i]),
+            "name": f"{sec.name}[lane {int(sched.lane[i])}]^bit{int(sched.bit[i])}",
+            "result": _result_dict(int(res.codes[i]), int(res.errors[i]),
+                                   int(res.corrected[i]), int(res.steps[i]), ts),
+            "cacheInfo": None,
+        })
+    return logs
+
+
+def write_json(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
+    """Append-mode-equivalent structured log (threadFunctions.py:195-198
+    flushes per injection; we flush per campaign)."""
+    with open(path, "w") as f:
+        json.dump({
+            "summary": res.summary(),
+            "runs": to_injection_logs(res, mmap),
+        }, f, indent=1)

@@ -1,0 +1,90 @@
+"""Memory map: the injectable address space of a protected program.
+
+The reference targets ELF sections parsed from ``objdump -h``
+(resources/mem.py:56-85 ``MemoryMap``; resources/utils.py:18-57 ``readElf``)
+and samples a uniformly random address within a size-weighted section
+(``MemorySection.getRandomAddress`` mem.py:48-53).  The TPU analogue's
+"sections" are the state-pytree leaves of a protected program; replicated
+leaves contribute ``num_clones`` independently corruptible copies, exactly as
+the reference's cloned globals occupy distinct addresses.
+
+Sections are word-addressed (32-bit), matching the word-granular injections
+of injector.py:125-200.  Register-section injections map to ``reg``/``ctrl``
+leaves (loop-carried state), cache-section to the HBM-resident ``mem``
+leaves -- the fidelity envelope documented in SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from coast_tpu.passes.dataflow_protection import ProtectedProgram
+
+
+@dataclasses.dataclass(frozen=True)
+class MemorySection:
+    """One injectable leaf: ``bits = lanes * words * 32``."""
+
+    name: str
+    leaf_id: int
+    kind: str
+    lanes: int          # num_clones if replicated else 1
+    words: int          # flat 32-bit words per lane
+    @property
+    def bits(self) -> int:
+        return self.lanes * self.words * 32
+
+
+class MemoryMap:
+    """Section table + uniform sampling over all injectable bits."""
+
+    def __init__(self, prog: ProtectedProgram,
+                 sections: Optional[Sequence[str]] = None):
+        import jax
+        state = jax.eval_shape(prog.region.init)
+        self.sections: List[MemorySection] = []
+        for leaf_id, name in enumerate(prog.leaf_order):
+            if sections is not None and prog.region.spec[name].kind not in sections \
+                    and name not in sections:
+                continue
+            shape = state[name].shape
+            self.sections.append(MemorySection(
+                name=name,
+                leaf_id=leaf_id,
+                kind=prog.region.spec[name].kind,
+                lanes=prog.cfg.num_clones if prog.replicated[name] else 1,
+                words=int(math.prod(shape)) if shape else 1,
+            ))
+        if not self.sections:
+            raise ValueError("no injectable sections selected")
+        self.total_bits = sum(s.bits for s in self.sections)
+
+    def by_name(self, name: str) -> MemorySection:
+        for s in self.sections:
+            if s.name == name:
+                return s
+        raise KeyError(name)
+
+    def decode(self, flat_bits: np.ndarray):
+        """Map uniform draws over [0, total_bits) to (leaf_id, lane, word, bit).
+
+        Vectorised over a schedule; the size-weighted section choice mirrors
+        MemHierarchy's weighted pick (mem.py:120-161).
+        """
+        flat_bits = np.asarray(flat_bits, dtype=np.int64)
+        edges = np.cumsum([s.bits for s in self.sections])
+        sec_idx = np.searchsorted(edges, flat_bits, side="right")
+        leaf_ids = np.array([s.leaf_id for s in self.sections])[sec_idx]
+        offs = flat_bits - (edges[sec_idx] - np.array(
+            [s.bits for s in self.sections])[sec_idx])
+        words_per = np.array([s.words for s in self.sections])[sec_idx]
+        lane = offs // (words_per * 32)
+        rem = offs % (words_per * 32)
+        word = rem // 32
+        bit = rem % 32
+        return (leaf_ids.astype(np.int32), lane.astype(np.int32),
+                word.astype(np.int32), bit.astype(np.int32), sec_idx)

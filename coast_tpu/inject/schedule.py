@@ -1,0 +1,62 @@
+"""Seeded fault schedules: where/when each campaign run flips its bit.
+
+The reference draws a uniformly random sleep inside the benchmark's runtime
+window (threadFunctions.py:451-520) and a uniformly random address in a
+size-weighted memory section (injector.py:125-200); with the QEMU plugin the
+"when" is a uniformly random *cycle count* so injections are uniform in
+cycles rather than wall clock (SURVEY.md #9).  Here a schedule is a struct of
+arrays -- one row per injection: (leaf_id, lane, word, bit, t) -- generated
+up front from a seed, so a whole campaign is deterministic and replayable
+(the determinism-parity test of SURVEY.md §4 depends on this).
+
+Generation is delegated to the native C++ core (coast_tpu.native:
+counter-mode splitmix64 bulk generator) with a numpy fallback producing
+bit-identical streams.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.native import splitmix_fill
+
+
+@dataclasses.dataclass
+class FaultSchedule:
+    """One campaign's worth of injection targets (host-side numpy)."""
+
+    leaf_id: np.ndarray   # int32 [n]
+    lane: np.ndarray      # int32 [n]
+    word: np.ndarray      # int32 [n]
+    bit: np.ndarray       # int32 [n]
+    t: np.ndarray         # int32 [n] step index of the flip
+    section_idx: np.ndarray  # int32 [n] index into MemoryMap.sections
+    seed: int
+
+    def __len__(self) -> int:
+        return len(self.leaf_id)
+
+    def device_arrays(self) -> Dict[str, np.ndarray]:
+        return {"leaf_id": self.leaf_id, "lane": self.lane,
+                "word": self.word, "bit": self.bit, "t": self.t}
+
+    def slice(self, lo: int, hi: int) -> "FaultSchedule":
+        return FaultSchedule(
+            self.leaf_id[lo:hi], self.lane[lo:hi], self.word[lo:hi],
+            self.bit[lo:hi], self.t[lo:hi], self.section_idx[lo:hi], self.seed)
+
+
+def generate(mmap: MemoryMap, n: int, seed: int,
+             nominal_steps: int) -> FaultSchedule:
+    """n seeded draws: uniform over all injectable bits x uniform over the
+    nominal runtime window (the injection window of threadFunctions.py:451)."""
+    raw = splitmix_fill(seed, 2 * n)          # uint64 stream, native or numpy
+    flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
+    t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
+    leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
+    return FaultSchedule(leaf_id, lane, word, bit, t,
+                         sec_idx.astype(np.int32), seed)

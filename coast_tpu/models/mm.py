@@ -26,8 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
-                                 LeafSpec, Region)
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec, Region
 
 SIDE = 9
 SEED = 42
@@ -108,8 +107,11 @@ def make_region() -> Region:
         nominal_steps=2 * SIDE,
         max_steps=6 * SIDE,
         spec={
-            "first": LeafSpec(KIND_RO),
-            "second": LeafSpec(KIND_RO),
+            # first/second are filled by the protected initialize() in the
+            # reference (an __xMR function writing cloned globals), so they
+            # sit inside the sphere of replication: replicated + voted.
+            "first": LeafSpec(KIND_MEM),
+            "second": LeafSpec(KIND_MEM),
             "results": LeafSpec(KIND_MEM, xmr=True),
             "golden": LeafSpec(KIND_MEM, xmr=False),
             "acc": LeafSpec(KIND_REG),

@@ -1,0 +1,78 @@
+"""Native C++ core loader (ctypes) with bit-exact numpy fallbacks.
+
+The reference's native components are LLVM C++ passes (projects/); this
+framework's native core (coast_core.cpp) carries the host-side compute that
+is not XLA's job: bulk seeded RNG for fault schedules, CFCSS signature
+assignment over block graphs, and the replica scheduler.  Built via
+``make -C coast_tpu/native``; every entry point has a numpy fallback that
+produces *identical* results so the Python path never blocks on a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libcoast_core.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SPLITMIX_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _try_build() -> None:
+    src = os.path.join(_HERE, "coast_core.cpp")
+    if not os.path.exists(src):
+        return
+    try:
+        subprocess.run(["make", "-C", _HERE, "-s"], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        pass
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        _try_build()
+    if os.path.exists(_LIB_PATH):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.coast_rand64.argtypes = [
+                ctypes.c_uint64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")]
+            lib.coast_rand64.restype = None
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def splitmix_fill(seed: int, n: int) -> np.ndarray:
+    """n counter-mode splitmix64 draws (uint64).  Counter-based (value i =
+    finalizer(seed + (i+1)*golden)) so the C++ and numpy paths are trivially
+    bit-identical and the numpy path vectorises."""
+    seed = seed & 0xFFFFFFFFFFFFFFFF
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty(n, dtype=np.uint64)
+        lib.coast_rand64(np.uint64(seed), n, out)
+        return out
+    with np.errstate(over="ignore"):
+        idx = np.arange(1, n + 1, dtype=np.uint64)
+        z = np.uint64(seed) + idx * _SPLITMIX_GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))

@@ -280,8 +280,13 @@ class ProtectedProgram:
         def body(carry, t):
             pstate, flags = carry
             if fault is not None:
+                # No injection once halted: the reference's sleep window is
+                # bounded by the measured runtime, so flips always land in a
+                # live guest (threadFunctions.py:451-520); a flip into a
+                # finished/aborted run's frozen image would mis-classify it.
+                halted = flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
                 pstate = jax.lax.cond(
-                    t == fault["t"],
+                    jnp.logical_and(t == fault["t"], jnp.logical_not(halted)),
                     lambda s: self._flip(s, self.replicated, fault["leaf_id"],
                                          fault["lane"], fault["word"], fault["bit"]),
                     lambda s: s, pstate)

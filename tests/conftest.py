@@ -3,16 +3,23 @@
 The CPU jax backend is our 'BOARD=x86' (the reference runs its functional
 regression on x86 before any real board, unittest/unittest.py:28-52); the
 8 virtual devices let sharding tests exercise real meshes without TPU chips.
-Must run before jax is imported anywhere.
+
+Note: the TPU environment's site hook registers the axon PJRT plugin and
+*programmatically* sets jax's platform config, so JAX_PLATFORMS=cpu in the
+environment is not sufficient -- jax.config.update after import is.  Keeping
+tests on CPU also avoids holding a TPU claim during test runs.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

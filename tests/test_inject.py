@@ -1,0 +1,130 @@
+"""Fault-injection engine tests (SURVEY.md §7 step 4).
+
+Covers: seeded schedule determinism (the campaign-determinism test of
+SURVEY.md §4), memory-map bounds, batched campaign classification under
+unprotected/TMR/DWC, the round-to-1000 sizing convention, and the
+InjectionLog-compatible JSON schema.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.logs import to_injection_logs, write_json
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import generate
+from coast_tpu.models import mm
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+def test_schedule_deterministic(region):
+    prog = TMR(region)
+    mmap = MemoryMap(prog)
+    a = generate(mmap, 500, seed=7, nominal_steps=region.nominal_steps)
+    b = generate(mmap, 500, seed=7, nominal_steps=region.nominal_steps)
+    c = generate(mmap, 500, seed=8, nominal_steps=region.nominal_steps)
+    for f in ("leaf_id", "lane", "word", "bit", "t"):
+        assert np.array_equal(getattr(a, f), getattr(b, f))
+    assert not all(np.array_equal(getattr(a, f), getattr(c, f))
+                   for f in ("word", "bit", "t"))
+
+
+def test_memory_map_bounds(region):
+    prog = TMR(region)
+    mmap = MemoryMap(prog)
+    sched = generate(mmap, 2000, seed=3, nominal_steps=region.nominal_steps)
+    secs = {s.leaf_id: s for s in mmap.sections}
+    for i in range(len(sched)):
+        s = secs[int(sched.leaf_id[i])]
+        assert 0 <= sched.lane[i] < s.lanes
+        assert 0 <= sched.word[i] < s.words
+        assert 0 <= sched.bit[i] < 32
+        assert 0 <= sched.t[i] < region.nominal_steps
+    # replicated leaves expose num_clones lanes; shared leaves one
+    assert mmap.by_name("results").lanes == 3
+    assert mmap.by_name("golden").lanes == 1
+
+
+N = 400
+
+
+@pytest.fixture(scope="module")
+def campaigns(region):
+    res = {}
+    for name, prog in [("none", unprotected(region)), ("TMR", TMR(region)),
+                       ("DWC", DWC(region))]:
+        res[name] = CampaignRunner(prog, strategy_name=name).run(
+            N, seed=11, batch_size=200)
+    return res
+
+
+def test_campaign_counts_complete(campaigns):
+    for res in campaigns.values():
+        assert sum(res.counts.values()) == N
+        assert res.n == N
+
+
+def test_unprotected_shows_sdc(campaigns):
+    res = campaigns["none"]
+    assert res.counts["sdc"] > 0
+    assert res.counts["success"] > 0
+    assert res.counts["corrected"] == 0  # no voters -> nothing to correct
+
+
+def test_tmr_masks_faults(campaigns):
+    """The north-star property: TMR drives SDC well below unprotected and
+    converts hits into corrected runs (TMR_ERROR_CNT)."""
+    unprot, tmr = campaigns["none"], campaigns["TMR"]
+    assert tmr.counts["corrected"] > 0
+    assert tmr.counts["sdc"] < unprot.counts["sdc"] / 2
+    # TMR never aborts (no DWC error fn is inserted, TMR masks instead)
+    assert tmr.counts["due_abort"] == 0
+
+
+def test_dwc_detects_faults(campaigns):
+    unprot, dwc = campaigns["none"], campaigns["DWC"]
+    assert dwc.counts["due_abort"] > 0          # compare+abort path
+    assert dwc.counts["sdc"] < unprot.counts["sdc"]
+    assert dwc.counts["corrected"] == 0         # detect-only, no masking
+
+
+def test_campaign_deterministic(region):
+    r1 = CampaignRunner(TMR(region)).run(100, seed=5, batch_size=50)
+    r2 = CampaignRunner(TMR(region)).run(100, seed=5, batch_size=100)
+    assert np.array_equal(r1.codes, r2.codes)
+    assert r1.counts == r2.counts
+
+
+def test_run_until_errors_rounds(region):
+    res = CampaignRunner(unprotected(region)).run_until_errors(
+        min_errors=5, seed=1, batch_size=200, round_to=400)
+    assert res.counts["sdc"] >= 5
+    assert res.n % 400 == 0
+
+
+def test_injection_log_schema(region, tmp_path, campaigns):
+    res = campaigns["TMR"]
+    mmap = CampaignRunner(TMR(region)).mmap
+    logs = to_injection_logs(res, mmap)
+    assert len(logs) == N
+    for log in logs[:20]:
+        # keys of InjectionLog.getDict (supportClasses.py:338-353)
+        assert set(log) == {"timestamp", "number", "section", "oldValue",
+                            "newValue", "address", "sleepTime", "cycles",
+                            "PC", "name", "result", "cacheInfo"}
+        # result discriminating keys match FromDict dispatch (:355-389)
+        r = log["result"]
+        assert any(k in r for k in ("core", "timeout", "message", "invalid"))
+    path = tmp_path / "campaign.json"
+    write_json(res, mmap, str(path))
+    data = json.loads(path.read_text())
+    assert data["summary"]["injections"] == N
+    assert len(data["runs"]) == N
