@@ -1,0 +1,48 @@
+"""Headline benchmark: fault-injection throughput (injections/sec).
+
+The reference's campaign loop (supervisor.py + QEMU + GDB) costs on the
+order of seconds per injection: per-benchmark guest wall-clock alone is
+bounded at 0.25-2.0 s (resources/benchmarks.py:27-73 maxSleepTime), plus
+GDB round-trips and QEMU/GDB restarts (BASELINE.md "Injection throughput").
+We take 1.0 injection/sec as the reference baseline -- the generous end of
+that range -- and measure our batched XLA campaign on matrixMultiply under
+TMR (BASELINE.json config 1).  North star: >= 1000x.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_INJ_PER_SEC = 1.0  # QEMU+GDB loop, seconds-per-injection regime
+
+
+def main() -> None:
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm
+
+    region = mm.make_region()
+    runner = CampaignRunner(TMR(region), strategy_name="TMR")
+
+    batch = 8192
+    # Warm-up: compile + one full batch (excluded from timing).
+    runner.run(batch, seed=1, batch_size=batch)
+
+    n = 4 * batch
+    res = runner.run(n, seed=42, batch_size=batch)
+    value = res.injections_per_sec
+
+    print(json.dumps({
+        "metric": "mm_tmr_fault_injections_per_sec",
+        "value": round(value, 2),
+        "unit": "injections/sec",
+        "vs_baseline": round(value / BASELINE_INJ_PER_SEC, 2),
+    }))
+    # Side channel for humans (stderr keeps stdout to the one JSON line).
+    print(f"# {res.summary()}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

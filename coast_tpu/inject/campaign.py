@@ -92,11 +92,20 @@ class CampaignRunner:
                 "steps": rec["steps"],
             }
 
+        self._run_one = run_one
         self._run_batch = jax.jit(jax.vmap(run_one))
+
+    # -- overridable batching hooks (ShardedCampaignRunner replaces these) --
+    def _round_batch(self, batch_size: int) -> int:
+        return batch_size
+
+    def _batch_call(self, fault: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+        return jax.device_get(self._run_batch(fault))
 
     # -- execution ----------------------------------------------------------
     def run_schedule(self, sched: FaultSchedule,
                      batch_size: int = 4096) -> CampaignResult:
+        batch_size = self._round_batch(batch_size)
         t0 = time.perf_counter()
         outs: List[Dict[str, np.ndarray]] = []
         for lo in range(0, len(sched), batch_size):
@@ -108,7 +117,7 @@ class CampaignRunner:
             pad = batch_size - n_part if n_part < batch_size else 0
             fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
                      for k, v in part.device_arrays().items()}
-            got = jax.device_get(self._run_batch(fault))
+            got = self._batch_call(fault)
             outs.append({k: v[:n_part] for k, v in got.items()})
         if outs:
             merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

@@ -4,19 +4,25 @@ The reference flips one bit by reading a word over the GDB remote-serial
 protocol, XOR-ing a one-hot mask on the host, and writing it back
 (resources/injector.py:202-207 ``flipOneBit``), at a cost of several process
 round-trips per injection.  Here the flip is *part of the traced program*: a
-one-hot XOR into the state pytree, selected by (leaf, lane, word, bit) indices
-that arrive as device data.  Keeping the flip inside the jitted scan is also
-what stops XLA from CSE-ing the three identical lanes into one (SURVEY.md §7
-"Avoiding XLA de-duplication").
+one-hot XOR into the state pytree, selected by (leaf, lane, word, bit)
+indices that arrive as device data.  Keeping the flip inside the jitted scan
+is also what stops XLA from CSE-ing the three identical lanes into one
+(SURVEY.md §7 "Avoiding XLA de-duplication").
 
-All injectable leaves must be 32-bit typed (int32/uint32/float32); the memory
-map (coast_tpu.inject.mem) addresses them in 32-bit words, matching the
-reference's word-granular memory injections (injector.py:125-200).
+Leaf dispatch is maskwise, not branchwise: every leaf is XORed with a mask
+that is zero unless the leaf is the target (XOR 0 = identity).  That keeps
+one uniform program for any target -- no ``lax.switch`` whose branches XLA
+must type-match (which breaks under ``shard_map``, where only the touched
+leaf would become axis-varying) -- and vectorises cleanly under ``vmap``.
+
+All injectable leaves must be 32-bit typed (int32/uint32/float32); the
+memory map (coast_tpu.inject.mem) addresses them in 32-bit words, matching
+the reference's word-granular memory injections (injector.py:125-200).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -24,43 +30,45 @@ import jax.numpy as jnp
 from coast_tpu.ir.region import State
 
 
-def _flip_word(arr: jax.Array, word: jax.Array, bit: jax.Array) -> jax.Array:
-    """XOR bit ``bit`` of flat 32-bit word ``word`` of ``arr`` (any shape)."""
-    u32 = jax.lax.bitcast_convert_type(arr, jnp.uint32)
-    flat = u32.reshape(-1)
-    mask = jnp.left_shift(jnp.uint32(1), bit.astype(jnp.uint32))
-    flat = flat.at[word].set(flat[word] ^ mask, mode="promise_in_bounds")
-    return jax.lax.bitcast_convert_type(flat.reshape(u32.shape), arr.dtype)
-
-
 def make_flipper(leaf_order: List[str]):
-    """Build ``flip(state, leaf_id, lane, word, bit) -> state``.
+    """Build ``flip(state, replicated, leaf_id, lane, word, bit) -> state``.
 
-    ``leaf_id`` indexes ``leaf_order`` (the memory-map section order); the
-    dispatch is a ``lax.switch`` so the target leaf is data-dependent --
-    one compiled program serves every injection in a campaign.
-
-    For replicated leaves (leading lane axis) ``word`` addresses the flat
-    words of a single lane and ``lane`` picks the replica; for shared leaves
+    ``leaf_id`` indexes ``leaf_order`` (the memory-map section order).  For
+    replicated leaves (leading lane axis) ``word`` addresses the flat words
+    of a single lane and ``lane`` picks the replica; for shared leaves
     ``lane`` is ignored.  Replicated leaves being independently corruptible
     is the point of the lane axis: it is what the reference gets from cloned
     globals living at distinct addresses (cloning.cpp:2417-2462).
     """
 
     def flip(state: State, replicated: Dict[str, bool], leaf_id: jax.Array,
-             lane: jax.Array, word: jax.Array, bit: jax.Array) -> State:
-        def branch_for(name):
-            def br(st):
-                arr = st[name]
-                if replicated[name]:
-                    new_lane = _flip_word(arr[lane], word, bit)
-                    new = arr.at[lane].set(new_lane, mode="promise_in_bounds")
-                else:
-                    new = _flip_word(arr, word, bit)
-                return {**st, name: new}
-            return br
-
-        branches = [branch_for(n) for n in leaf_order]
-        return jax.lax.switch(leaf_id, branches, state)
+             lane: jax.Array, word: jax.Array, bit: jax.Array,
+             enable: jax.Array = True) -> State:
+        """``enable`` folds any fire condition (step match, not-halted) into
+        the mask, so callers never need lax.cond around the flip -- identity
+        is XOR 0, and the program stays uniform for shard_map/vmap."""
+        one = jnp.left_shift(jnp.uint32(1), bit.astype(jnp.uint32))
+        one = jnp.where(enable, one, jnp.uint32(0))
+        new: State = {}
+        for i, name in enumerate(leaf_order):
+            arr = state[name]
+            mask = jnp.where(leaf_id == i, one, jnp.uint32(0))
+            u32 = jax.lax.bitcast_convert_type(arr, jnp.uint32)
+            flat = u32.reshape(-1)
+            if replicated[name]:
+                words_per_lane = flat.shape[0] // arr.shape[0]
+                idx = lane * words_per_lane + word
+            else:
+                idx = word
+            # (lane, word) address the *target* leaf; for every other leaf it
+            # can be out of range.  Clamp: the mask is 0 for non-target
+            # leaves, so a clamped read-modify-write is value-preserving,
+            # and the promise below stays honest on TPU.
+            idx = jnp.minimum(idx, flat.shape[0] - 1)
+            flat = flat.at[idx].set(flat[idx] ^ mask,
+                                    mode="promise_in_bounds")
+            new[name] = jax.lax.bitcast_convert_type(
+                flat.reshape(u32.shape), arr.dtype)
+        return new
 
     return flip

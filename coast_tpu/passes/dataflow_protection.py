@@ -285,11 +285,11 @@ class ProtectedProgram:
                 # live guest (threadFunctions.py:451-520); a flip into a
                 # finished/aborted run's frozen image would mis-classify it.
                 halted = flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
-                pstate = jax.lax.cond(
-                    jnp.logical_and(t == fault["t"], jnp.logical_not(halted)),
-                    lambda s: self._flip(s, self.replicated, fault["leaf_id"],
-                                         fault["lane"], fault["word"], fault["bit"]),
-                    lambda s: s, pstate)
+                fire = jnp.logical_and(t == fault["t"],
+                                       jnp.logical_not(halted))
+                pstate = self._flip(pstate, self.replicated, fault["leaf_id"],
+                                    fault["lane"], fault["word"], fault["bit"],
+                                    enable=fire)
             return self.step(pstate, flags, t), None
 
         (pstate, flags), _ = jax.lax.scan(
