@@ -99,6 +99,17 @@ class CampaignRunner:
     def _round_batch(self, batch_size: int) -> int:
         return batch_size
 
+    @staticmethod
+    def _padded_fault(part: FaultSchedule, batch_size: int):
+        """Device fault arrays for one batch, edge-padded to batch_size so
+        every batch hits the same compiled program.  Returns (fault, n_valid);
+        callers drop or mask the padded tail."""
+        n_part = len(part)
+        pad = batch_size - n_part if n_part < batch_size else 0
+        fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
+                 for k, v in part.device_arrays().items()}
+        return fault, n_part
+
     def _batch_call(self, fault: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
         return jax.device_get(self._run_batch(fault))
 
@@ -110,13 +121,7 @@ class CampaignRunner:
         outs: List[Dict[str, np.ndarray]] = []
         for lo in range(0, len(sched), batch_size):
             part = sched.slice(lo, min(lo + batch_size, len(sched)))
-            n_part = len(part)
-            # Pad ragged final batches to batch_size so every batch hits the
-            # same compiled program (a distinct remainder shape would force a
-            # fresh multi-second XLA compile); padded rows are dropped below.
-            pad = batch_size - n_part if n_part < batch_size else 0
-            fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
-                     for k, v in part.device_arrays().items()}
+            fault, n_part = self._padded_fault(part, batch_size)
             got = self._batch_call(fault)
             outs.append({k: v[:n_part] for k, v in got.items()})
         if outs:

@@ -17,7 +17,6 @@ leaves -- the fidelity envelope documented in SURVEY.md §7.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -44,20 +43,18 @@ class MemoryMap:
 
     def __init__(self, prog: ProtectedProgram,
                  sections: Optional[Sequence[str]] = None):
-        import jax
-        state = jax.eval_shape(prog.region.init)
         self.sections: List[MemorySection] = []
-        for leaf_id, name in enumerate(prog.leaf_order):
-            if sections is not None and prog.region.spec[name].kind not in sections \
+        for leaf_id, (name, kind, lanes, words) in enumerate(
+                prog.injectable_sections()):
+            if sections is not None and kind not in sections \
                     and name not in sections:
                 continue
-            shape = state[name].shape
             self.sections.append(MemorySection(
                 name=name,
                 leaf_id=leaf_id,
-                kind=prog.region.spec[name].kind,
-                lanes=prog.cfg.num_clones if prog.replicated[name] else 1,
-                words=int(math.prod(shape)) if shape else 1,
+                kind=kind,
+                lanes=lanes,
+                words=max(words, 1),
             ))
         if not self.sections:
             raise ValueError("no injectable sections selected")

@@ -1,0 +1,47 @@
+"""Block graphs: the control-flow-graph view of a region, for CFCSS.
+
+The reference builds a BBNode graph over every function's basic blocks
+(populateGraph, projects/CFCSS/CFCSS.cpp:149-185; struct BBNode
+CFCSS.h:44-61).  A stepped region's analogue is coarser but the same shape:
+the region declares its logical blocks and legal transitions, plus a
+``block_of(state)`` classifier that says which block the next step executes
+given the current (control) state.  Node 0 is the entry pseudo-block (the
+state before step 0).
+
+Illegal control flow -- a corrupted loop counter teleporting execution to a
+block with no incoming edge from the current one -- is exactly what the
+runtime signature check detects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+
+from coast_tpu.ir.region import State
+
+
+@dataclasses.dataclass
+class BlockGraph:
+    """names[0] is the entry pseudo-block; edges are (u, v) node indices;
+    block_of maps (control) state -> int32 node index of the block the next
+    step will execute (or a terminal block once done)."""
+
+    names: List[str]
+    edges: List[Tuple[int, int]]
+    block_of: Callable[[State], jax.Array]
+
+    @property
+    def n(self) -> int:
+        return len(self.names)
+
+    def validate(self) -> None:
+        for u, v in self.edges:
+            if not (0 <= u < self.n and 0 <= v < self.n):
+                raise ValueError(f"edge ({u},{v}) out of range for {self.n} blocks")
+        targets = {v for _, v in self.edges}
+        for v in range(1, self.n):
+            if v not in targets:
+                raise ValueError(f"block {v} ({self.names[v]}) is unreachable")

@@ -26,6 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from coast_tpu.ir.graph import BlockGraph
 from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec, Region
 
 SIDE = 9
@@ -97,6 +98,22 @@ def make_region() -> Region:
     def output(state):
         return state["results"].reshape(-1)
 
+    def block_of(state):
+        """Post-step program label: the loop-exit test lives in the store/
+        latch block (the C for-loop tests after the increment), so 'exit' is
+        only reachable from a post-store state (phase back at 0)."""
+        compute_pending = state["phase"] == 0
+        return jnp.where(
+            compute_pending,
+            jnp.where(state["i"] >= SIDE, jnp.int32(3), jnp.int32(1)),
+            jnp.int32(2)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "compute", "store", "exit"],
+        edges=[(0, 1), (1, 2), (2, 1), (2, 3)],
+        block_of=block_of,
+    )
+
     return Region(
         name="matrixMultiply",
         init=init,
@@ -119,5 +136,6 @@ def make_region() -> Region:
             "phase": LeafSpec(KIND_CTRL),
         },
         default_xmr=True,
+        graph=graph,
         meta={"golden_xor": golden_xor, "oracle": "Number of errors: 0"},
     )

@@ -36,12 +36,18 @@ def _try_build() -> None:
         pass
 
 
+def _stale() -> bool:
+    src = os.path.join(_HERE, "coast_core.cpp")
+    return (os.path.exists(src) and os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH) or _stale():
         _try_build()
     if os.path.exists(_LIB_PATH):
         try:
@@ -50,8 +56,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64, ctypes.c_int64,
                 np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")]
             lib.coast_rand64.restype = None
+            lib.coast_cfcss_assign.argtypes = [
+                ctypes.c_int32, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_uint64, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")]
+            lib.coast_cfcss_assign.restype = ctypes.c_int32
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # Unloadable or built from an older source missing a symbol:
+            # fall back to numpy rather than crash every native-backed path.
             _lib = None
     return _lib
 
@@ -76,3 +93,90 @@ def splitmix_fill(seed: int, n: int) -> np.ndarray:
         z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         return z ^ (z >> np.uint64(31))
+
+
+def _splitmix_at(seed: int, i: int) -> int:
+    z = (seed + (i + 1) * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+def cfcss_assign(n: int, edges, seed: int = 0, sig_bits: int = 16):
+    """CFCSS signature assignment over a block graph (node 0 = entry).
+
+    Returns dict(sigs, diffs, fanin, dedge, attempts); see coast_core.cpp
+    for the algorithm (generateSignatures/calcSigDiff/verifySignatures
+    equivalents, CFCSS.cpp:187-201/:439-470/:380-426, with buffer blocks
+    folded into per-edge adjusters).  Native path and this fallback are
+    bit-identical by construction (same splitmix64 stream + same spin loop).
+    """
+    seed = seed & 0xFFFFFFFFFFFFFFFF
+    edges = np.ascontiguousarray(np.asarray(edges, np.int32).reshape(-1, 2))
+    n_edges = len(edges)
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "coast_cfcss_assign"):
+        sigs = np.empty(n, np.uint32)
+        diffs = np.empty(n, np.uint32)
+        fanin = np.empty(n, np.uint8)
+        dedge = np.empty(n * n, np.uint32)
+        rc = lib.coast_cfcss_assign(
+            np.int32(n), np.int32(n_edges), edges.reshape(-1),
+            np.uint64(seed), np.int32(sig_bits), sigs, diffs, fanin, dedge)
+        if rc < 0:
+            raise ValueError(f"cfcss_assign failed (rc={rc})")
+        return {"sigs": sigs, "diffs": diffs, "fanin": fanin.astype(bool),
+                "dedge": dedge.reshape(n, n), "attempts": int(rc)}
+
+    # ---- numpy/python fallback (bit-identical) ----
+    if n <= 0 or not (1 < sig_bits <= 32):
+        raise ValueError("cfcss_assign failed (rc=-2)")
+    if np.any(edges < 0) or np.any(edges >= n):
+        raise ValueError("cfcss_assign failed (rc=-2)")
+    mask = 0xFFFFFFFF if sig_bits == 32 else (1 << sig_bits) - 1
+    for attempt in range(64):
+        used = set()
+        sigs = np.zeros(n, np.uint32)
+        ctr = 0
+        ok = True
+        for v in range(n):
+            spins = 0
+            while True:
+                s = _splitmix_at(seed + attempt, ctr) & mask
+                ctr += 1
+                spins += 1
+                if s not in used:
+                    break
+                if spins > mask + 8:
+                    ok = False
+                    break
+            if not ok:
+                break
+            used.add(s)
+            sigs[v] = s
+        if not ok:
+            raise ValueError("cfcss_assign failed (rc=-1)")
+
+        is_edge = np.zeros((n, n), bool)
+        u0 = np.full(n, -1, np.int32)
+        pred_count = np.zeros(n, np.int32)
+        for u, v in edges:
+            if is_edge[u, v]:
+                continue
+            is_edge[u, v] = True
+            pred_count[v] += 1
+            if u0[v] < 0 or u < u0[v]:
+                u0[v] = u
+        fanin = pred_count > 1
+        diffs = np.where(u0 >= 0, sigs[np.maximum(u0, 0)] ^ sigs, sigs)
+        dedge = np.zeros((n, n), np.uint32)
+        for u, v in edges:
+            if fanin[v]:
+                dedge[u, v] = sigs[u0[v]] ^ sigs[u]
+
+        g = sigs[:, None] ^ diffs[None, :]          # illegal jump u -> v
+        aliased = np.logical_and(~is_edge, g == sigs[None, :])
+        if not aliased.any():
+            return {"sigs": sigs, "diffs": diffs.astype(np.uint32),
+                    "fanin": fanin, "dedge": dedge, "attempts": attempt + 1}
+    raise ValueError("cfcss_assign failed (rc=-1)")

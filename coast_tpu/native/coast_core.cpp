@@ -1,0 +1,146 @@
+// coast_core: native host-side core of the coast_tpu framework.
+//
+// The reference's native layer is a family of LLVM-7 C++ ModulePasses
+// (projects/); the TPU framework's native layer carries the host-side
+// algorithms that are neither XLA's job nor performance-trivial:
+//
+//   * coast_rand64        - bulk counter-mode splitmix64 for fault
+//                           schedules (replaces the per-injection host RNG
+//                           of resources/injector.py / threadFunctions.py).
+//   * coast_cfcss_assign  - control-flow-signature assignment over a block
+//                           graph: unique random signatures, designated-
+//                           predecessor XOR diffs, per-edge run-time
+//                           adjusters, and an iterate-until-sound check
+//                           that re-seeds on aliasing -- the equivalent of
+//                           generateSignatures / calcSigDiff /
+//                           insertBufferBlock / verifySignatures in
+//                           projects/CFCSS/CFCSS.cpp (:187-201, :439-470,
+//                           :342-426).  Per-edge adjusters subsume buffer
+//                           blocks: a buffer block exists only to give an
+//                           edge its own adjuster value.
+//
+// Exposed with C linkage for ctypes (no pybind11 in this image); the
+// Python side (coast_tpu/native/__init__.py) keeps bit-identical numpy
+// fallbacks.
+//
+// Build: make -C coast_tpu/native  ->  libcoast_core.so
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kGolden = 0x9E3779B97F4A7C15ULL;
+
+inline uint64_t splitmix_at(uint64_t seed, uint64_t i) {
+  // Counter mode: value i = finalizer(seed + (i+1)*golden).  Must stay
+  // bit-identical to the numpy fallback in native/__init__.py.
+  uint64_t z = seed + (i + 1) * kGolden;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+void coast_rand64(uint64_t seed, int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = splitmix_at(seed, (uint64_t)i);
+}
+
+// CFCSS signature assignment.
+//
+// Inputs:  n nodes (node 0 = entry), n_edges directed edges (u,v pairs),
+//          seed, sig_bits (reference default 16, CFCSS.h:33-35).
+// Outputs: sigs[n]        unique random signatures
+//          diffs[n]       d_v = s_{u0(v)} ^ s_v  (entry: d = s_entry,
+//                         matching a runtime G initialised to 0)
+//          fanin[n]       1 if the node has >1 predecessor
+//          dedge[n*n]     run-time adjuster for edge (u,v) into a fan-in
+//                         node: D = s_{u0(v)} ^ s_u (0 elsewhere)
+//
+// Soundness check mirrors verifySignatures' iterate-until-stable loop: for
+// every (u,v) pair that is NOT an edge, an illegal jump must not verify:
+//   s_u ^ d_v ^ (fanin_v ? dedge[u][v](=0) : 0) != s_v.
+// On aliasing we re-seed and retry (the reference regenerates conflicting
+// signatures); returns the number of attempts used, or -1 if it could not
+// find a sound assignment in 64 tries, -2 on malformed input.
+int32_t coast_cfcss_assign(int32_t n, int32_t n_edges, const int32_t* edges,
+                           uint64_t seed, int32_t sig_bits, uint32_t* sigs,
+                           uint32_t* diffs, uint8_t* fanin, uint32_t* dedge) {
+  if (n <= 0 || sig_bits <= 1 || sig_bits > 32) return -2;
+  for (int32_t e = 0; e < n_edges; ++e) {
+    if (edges[2 * e] < 0 || edges[2 * e] >= n || edges[2 * e + 1] < 0 ||
+        edges[2 * e + 1] >= n)
+      return -2;
+  }
+  const uint32_t mask =
+      sig_bits == 32 ? 0xFFFFFFFFu : ((1u << sig_bits) - 1u);
+
+  std::vector<int32_t> pred_count(n), u0(n);
+  std::vector<char> is_edge((size_t)n * n);
+  for (int attempt = 0; attempt < 64; ++attempt) {
+    // Unique random signatures (generateSignatures :187-201).  Hash set,
+    // not a bitmap: sig_bits=32 would need a 4 GiB bitmap.
+    std::unordered_set<uint32_t> used;
+    used.reserve((size_t)n * 2);
+    uint64_t ctr = 0;
+    bool ok = true;
+    // Spin bound: identical semantics to the Python fallback (mask + 8,
+    // saturated to avoid int32 overflow at sig_bits=32).
+    const int64_t max_spins = (int64_t)mask + 8;
+    for (int32_t v = 0; v < n; ++v) {
+      uint32_t s;
+      int64_t spins = 0;
+      do {
+        s = (uint32_t)splitmix_at(seed + attempt, ctr++) & mask;
+        if (++spins > max_spins) { ok = false; break; }
+      } while (used.count(s));
+      if (!ok) break;
+      used.insert(s);
+      sigs[v] = s;
+    }
+    if (!ok) return -1;  // more nodes than signature space
+
+    // Designated predecessor = lowest-numbered predecessor.
+    std::fill(pred_count.begin(), pred_count.end(), 0);
+    std::fill(u0.begin(), u0.end(), -1);
+    std::fill(is_edge.begin(), is_edge.end(), 0);
+    for (int32_t e = 0; e < n_edges; ++e) {
+      int32_t u = edges[2 * e], v = edges[2 * e + 1];
+      if (is_edge[(size_t)u * n + v]) continue;  // duplicate edge
+      is_edge[(size_t)u * n + v] = 1;
+      pred_count[v]++;
+      if (u0[v] < 0 || u < u0[v]) u0[v] = u;
+    }
+
+    // Diffs + per-edge adjusters (calcSigDiff :439-457; buffer-block
+    // fan-in fixes :342-378 folded into per-edge adjuster values).
+    std::memset(dedge, 0, sizeof(uint32_t) * (size_t)n * n);
+    for (int32_t v = 0; v < n; ++v) {
+      fanin[v] = pred_count[v] > 1 ? 1 : 0;
+      diffs[v] = (u0[v] >= 0) ? (sigs[u0[v]] ^ sigs[v]) : sigs[v];
+    }
+    for (int32_t e = 0; e < n_edges; ++e) {
+      int32_t u = edges[2 * e], v = edges[2 * e + 1];
+      if (fanin[v]) dedge[(size_t)u * n + v] = sigs[u0[v]] ^ sigs[u];
+    }
+
+    // Soundness: no illegal jump may verify (verifySignatures :380-426).
+    bool sound = true;
+    for (int32_t u = 0; u < n && sound; ++u) {
+      for (int32_t v = 0; v < n; ++v) {
+        if (is_edge[(size_t)u * n + v]) continue;
+        uint32_t g = sigs[u] ^ diffs[v];  // dedge[u][v] == 0 for non-edges
+        if (g == sigs[v]) { sound = false; break; }
+      }
+    }
+    if (sound) return attempt + 1;
+  }
+  return -1;
+}
+
+}  // extern "C"

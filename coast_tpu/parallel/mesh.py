@@ -125,10 +125,7 @@ class ShardedCampaignRunner(CampaignRunner):
         total = np.zeros(cls.NUM_CLASSES, np.int64)
         for lo in range(0, len(sched), batch_size):
             part = sched.slice(lo, min(lo + batch_size, len(sched)))
-            n_part = len(part)
-            pad = batch_size - n_part
-            fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
-                     for k, v in part.device_arrays().items()}
+            fault, n_part = self._padded_fault(part, batch_size)
             valid = jnp.asarray(np.arange(batch_size) < n_part)
             total += np.asarray(jax.device_get(
                 self._hist_sharded(fault, valid)), np.int64)

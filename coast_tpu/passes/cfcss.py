@@ -1,0 +1,97 @@
+"""CFCSS: control-flow checking by software signatures, stackable with TMR/DWC.
+
+The reference pass (projects/CFCSS/, 904 LoC) instruments every basic block
+with a signature store + XOR compare against runtime globals
+``BasicBlockSignatureTracker`` / ``RunTimeSignatureAdjuster``
+(CFCSS.cpp:726-731), branching to ``FAULT_DETECTED_CFC`` on mismatch
+(:87-122).  TPU-native re-expression:
+
+  * signature assignment (unique random sigs, designated-predecessor diffs,
+    fan-in adjusters, soundness iteration) runs in the native C++ core
+    (coast_tpu/native/coast_core.cpp `coast_cfcss_assign`); buffer blocks
+    (insertBufferBlock :342-378) are folded into per-edge adjusters.
+  * the runtime tracker G and the previous-block register are *injectable
+    replicated state leaves* -- per lane, exactly as stacking CFCSS after
+    TMR replicates its globals in the reference -- updated each step with
+    an XOR gather and compared against the expected signature.
+  * a mismatch in any lane latches ``cfc_fault``: the batched analogue of
+    branching to the CFC error handler and aborting (DUE classification).
+
+The signature transition, per step, with v = block_of(voted control state):
+
+    G'_lane = G_lane ^ diffs[v] ^ (fanin[v] ? dedge[prev_lane, v] : 0)
+    fault  |= any_lane(G' != sigs[v]);   prev' = v
+
+An illegal transition (u',v) not in the edge set fails the check by the
+assignment's soundness guarantee (coast_core.cpp verify loop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.native import cfcss_assign
+from coast_tpu.passes.dataflow_protection import ProtectedProgram
+
+# Synthetic leaf names for the CFCSS runtime state (the reference's
+# BasicBlockSignatureTracker / previous-block analogue).  They are part of
+# the injectable memory map, like the reference's globals.
+G_LEAF = "__cfcss_sig_tracker"
+PREV_LEAF = "__cfcss_prev_block"
+
+SIG_BITS = 16  # reference default signature width (CFCSS.h:33-35)
+
+
+def apply_cfcss(prog: ProtectedProgram, seed: int = 0) -> ProtectedProgram:
+    """Stack CFCSS onto a protected program (mutates and returns it).
+
+    Mirrors pass stacking in the reference build system: `opt -TMR -CFCSS`
+    runs both ModulePasses over the same module (BASELINE.json config 5).
+    """
+    region = prog.region
+    graph: BlockGraph = region.graph
+    if graph is None:
+        raise ValueError(
+            f"region {region.name} has no block graph; CFCSS needs one "
+            "(the reference requires basic blocks to instrument)")
+    graph.validate()
+
+    tables = cfcss_assign(graph.n, graph.edges, seed=seed, sig_bits=SIG_BITS)
+    sigs = jnp.asarray(tables["sigs"], jnp.uint32)
+    diffs = jnp.asarray(tables["diffs"], jnp.uint32)
+    fanin = jnp.asarray(tables["fanin"])
+    dedge = jnp.asarray(tables["dedge"], jnp.uint32)
+
+    n_lanes = prog.cfg.num_clones
+
+    def cfcss_init() -> Dict[str, jax.Array]:
+        return {
+            # G starts at the entry signature (runtime globals initialised
+            # before main in the reference, CFCSS.cpp:726-731).
+            G_LEAF: jnp.broadcast_to(sigs[0], (n_lanes,)).astype(jnp.uint32),
+            PREV_LEAF: jnp.zeros((n_lanes,), jnp.int32),
+        }
+
+    def cfcss_step(new_state, flags, t, halted):
+        v = graph.block_of(prog._voted_view(new_state))
+        g = new_state[G_LEAF]
+        prev = new_state[PREV_LEAF]
+        adj = jnp.where(fanin[v], dedge[prev, v], jnp.uint32(0))
+        g_new = g ^ diffs[v] ^ adj
+        mismatch = jnp.any(g_new != sigs[v])
+        flags = {**flags,
+                 "cfc_fault": jnp.logical_or(
+                     flags["cfc_fault"],
+                     jnp.logical_and(~halted, mismatch))}
+        new_state = {**new_state,
+                     G_LEAF: jnp.where(halted, g, g_new),
+                     PREV_LEAF: jnp.where(halted, prev,
+                                          jnp.full_like(prev, v))}
+        return new_state, flags
+
+    prog.install_cfcss(cfcss_init, cfcss_step, tables)
+    return prog

@@ -135,8 +135,47 @@ class ProtectedProgram:
         # inject.mem.MemoryMap.
         self.leaf_order = [n for n in region.spec if region.spec[n].inject]
         self._flip = make_flipper(self.leaf_order)
-        # CFCSS runtime hook, installed by passes.cfcss.apply_cfcss.
+        # CFCSS runtime hooks, installed by passes.cfcss.apply_cfcss.
+        self._cfcss_init = None
         self._cfcss_step = None
+        self.cfcss_tables = None
+
+    # -- CFCSS stacking (passes.cfcss) --------------------------------------
+    def install_cfcss(self, init_fn, step_fn, tables) -> None:
+        """Register the CFCSS runtime: extra injectable replicated leaves
+        (signature tracker + previous block, the reference's runtime globals
+        CFCSS.cpp:726-731) and the per-step signature update/check."""
+        self._cfcss_init = init_fn
+        self._cfcss_step = step_fn
+        self.cfcss_tables = tables
+        for name in jax.eval_shape(init_fn):
+            self.replicated[name] = True
+            if name not in self.leaf_order:
+                self.leaf_order.append(name)
+        self._flip = make_flipper(self.leaf_order)
+
+    def injectable_sections(self):
+        """(name, kind, lanes, words_per_lane) rows for the memory map.
+        Synthetic (CFCSS) leaves report kind 'cfcss'."""
+        state = jax.eval_shape(self.region.init)
+        if self._cfcss_init is not None:
+            cfcss_shapes = jax.eval_shape(self._cfcss_init)
+        rows = []
+        for name in self.leaf_order:
+            if name in self.region.spec:
+                shape = state[name].shape
+                kind = self.region.spec[name].kind
+                lanes = self.cfg.num_clones if self.replicated[name] else 1
+            else:
+                # CFCSS leaves are built already laned: (num_clones, ...).
+                shape = cfcss_shapes[name].shape[1:]
+                kind = "cfcss"
+                lanes = self.cfg.num_clones
+            words = 1
+            for d in shape:
+                words *= int(d)
+            rows.append((name, kind, lanes, words))
+        return rows
 
     # -- state construction -------------------------------------------------
     def init_pstate(self) -> Tuple[State, Dict[str, jax.Array]]:
@@ -151,6 +190,8 @@ class ProtectedProgram:
                    if self.replicated[name] else arr)
             for name, arr in state.items()
         }
+        if self._cfcss_init is not None:
+            pstate.update(self._cfcss_init())
         return pstate, _flags_init(self.cfg)
 
     # -- lane execution -----------------------------------------------------
@@ -187,12 +228,21 @@ class ProtectedProgram:
         halted = jnp.logical_or(flags["done"], flags["dwc_fault"])
         halted = jnp.logical_or(halted, flags["cfc_fault"])
 
-        laned = self._run_lanes(pstate, t)
+        # CFCSS check at block entry: v = the block this step executes,
+        # classified from the pre-step state.  A mismatch aborts before the
+        # block body commits (the reference branches to FAULT_DETECTED_CFC
+        # at the top of the block, CFCSS.cpp:504-550).
+        if self._cfcss_step is not None:
+            pstate, flags = self._cfcss_step(pstate, flags, t, halted)
+            halted = jnp.logical_or(halted, flags["cfc_fault"])
+
+        region_state = {k: pstate[k] for k in self.region.spec}
+        laned = self._run_lanes(region_state, t)
 
         new_state: State = {}
         miscompares = []
         syncs = jnp.int32(0)
-        for name in pstate:
+        for name in region_state:
             out = laned[name]
             if self.replicated[name]:
                 if self.step_sync[name] and cfg.num_clones > 1:
@@ -233,9 +283,10 @@ class ProtectedProgram:
             flags = {**flags,
                      "sync_cnt": flags["sync_cnt"] + jnp.where(halted, 0, syncs)}
 
-        # CFCSS signature update/check (stacked pass), if installed.
-        if self._cfcss_step is not None:
-            new_state, flags = self._cfcss_step(new_state, flags, t, halted)
+        # Carry CFCSS runtime leaves through (updated by the entry hook).
+        for name in pstate:
+            if name not in new_state:
+                new_state[name] = pstate[name]
 
         # Terminator: evaluate done() on the voted view, *before* committing,
         # so a single corrupted lane cannot steer control flow

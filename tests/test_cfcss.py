@@ -1,0 +1,135 @@
+"""CFCSS tests (SURVEY.md §7 step 6, BASELINE.json config 5).
+
+Covers the native/numpy signature-assignment contract, assignment soundness
+(every legal edge verifies, no illegal jump does -- the property
+verifySignatures iterates for, CFCSS.cpp:380-426), and the runtime: clean
+runs pass, signature-tracker corruption and control-flow corruption latch
+cfc_fault (DUE), stacked with TMR and standalone.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import coast_tpu.native as native
+from coast_tpu import ProtectionConfig, TMR, protect, unprotected
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.models import mm
+from coast_tpu.passes.cfcss import G_LEAF, PREV_LEAF, apply_cfcss
+
+
+@pytest.fixture()
+def region():
+    return mm.make_region()
+
+
+DIAMOND = [(0, 1), (0, 2), (1, 3), (2, 3), (3, 1)]  # fan-in at 3 and 1
+
+
+def test_assignment_sound():
+    t = native.cfcss_assign(4, DIAMOND, seed=3)
+    sigs, diffs, fanin, dedge = t["sigs"], t["diffs"], t["fanin"], t["dedge"]
+    assert len(set(sigs.tolist())) == 4          # unique signatures
+    assert fanin[3] and fanin[1] and not fanin[2]
+    edges = set(DIAMOND)
+    for u in range(4):
+        for v in range(4):
+            g = sigs[u] ^ diffs[v] ^ (dedge[u, v] if fanin[v] else 0)
+            if (u, v) in edges:
+                assert g == sigs[v], f"legal edge ({u},{v}) must verify"
+            else:
+                assert g != sigs[v], f"illegal jump ({u},{v}) must not verify"
+
+
+def test_native_fallback_identical():
+    if not native.native_available():
+        pytest.skip("native lib not built")
+    a = native.cfcss_assign(4, DIAMOND, seed=11)
+    lib, tried = native._lib, native._tried
+    try:
+        native._lib, native._tried = None, True
+        b = native.cfcss_assign(4, DIAMOND, seed=11)
+    finally:
+        native._lib, native._tried = lib, tried
+    for k in ("sigs", "diffs", "fanin", "dedge"):
+        assert np.array_equal(a[k], b[k])
+    assert a["attempts"] == b["attempts"]
+
+
+def test_assignment_rejects_bad_graph():
+    with pytest.raises(ValueError):
+        native.cfcss_assign(3, [(0, 5)], seed=0)   # edge out of range
+    with pytest.raises(ValueError):
+        native.cfcss_assign(0, [], seed=0)
+
+
+def _fault(prog, leaf, lane=0, word=0, bit=3, t=5):
+    return {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+            "lane": jnp.int32(lane), "word": jnp.int32(word),
+            "bit": jnp.int32(bit), "t": jnp.int32(t)}
+
+
+def test_tmr_cfcss_clean(region):
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    rec = jax.jit(prog.run)()
+    assert int(rec["errors"]) == 0
+    assert not bool(rec["cfc_fault"])
+    assert bool(rec["done"])
+
+
+def test_sig_tracker_corruption_detected(region):
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    rec = jax.jit(prog.run)(_fault(prog, G_LEAF, lane=1, word=0, bit=7, t=4))
+    assert bool(rec["cfc_fault"]), "flipped signature tracker must fault"
+
+
+def test_prev_block_corruption_detected(region):
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    rec = jax.jit(prog.run)(_fault(prog, PREV_LEAF, lane=0, word=0, bit=1, t=6))
+    # prev=store(2) ^ 2 -> entry(0): next fan-in adjuster lookup goes wrong.
+    assert bool(rec["cfc_fault"])
+
+
+def test_control_flow_corruption_detected_standalone(region):
+    """CFCSS without replication: a phase flip makes two consecutive
+    'store' labels -- an illegal (2,2) transition."""
+    prog = apply_cfcss(protect(region, ProtectionConfig(num_clones=1)))
+    rec = jax.jit(prog.run)(_fault(prog, "phase", word=0, bit=0, t=4))
+    assert bool(rec["cfc_fault"])
+
+
+def test_data_corruption_not_cfc(region):
+    """Pure data corruption (results word) is invisible to CFCSS alone --
+    control flow stays legal; the run is SDC, not DUE (the reference's CFCSS
+    protects control flow only, docs passes.rst)."""
+    prog = apply_cfcss(protect(region, ProtectionConfig(num_clones=1)))
+    rec = jax.jit(prog.run)(_fault(prog, "results", word=0, bit=12, t=3))
+    assert not bool(rec["cfc_fault"])
+    assert int(rec["errors"]) > 0
+
+
+def test_cfcss_leaves_in_memory_map(region):
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    runner = CampaignRunner(prog)
+    names = [s.name for s in runner.mmap.sections]
+    assert G_LEAF in names and PREV_LEAF in names
+    assert runner.mmap.by_name(G_LEAF).lanes == 3
+
+
+def test_campaign_cfcss_sections(region):
+    """Campaign restricted to the CFCSS runtime section: every effective hit
+    must be detected (DUE) or harmless, never SDC."""
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    res = CampaignRunner(prog, sections=["cfcss"]).run(200, seed=13,
+                                                       batch_size=100)
+    assert res.counts["due_abort"] > 0
+    assert res.counts["sdc"] == 0
+
+
+def test_region_without_graph_rejected():
+    r = mm.make_region()
+    r.graph = None
+    with pytest.raises(ValueError):
+        apply_cfcss(TMR(r, cfcss=True))
