@@ -1,0 +1,25 @@
+"""Benchmark regions: the reference's tests/ corpus re-expressed as stepped
+TPU regions (SURVEY.md §2.3 #31).  ``REGISTRY`` maps benchmark name ->
+make_region, the analogue of the unittest benchmark discovery by Makefile
+TARGET (unittest/unittest.py:28-52)."""
+
+from typing import Callable, Dict
+
+from coast_tpu.ir.region import Region
+
+
+def _lazy(modname: str) -> Callable[[], Region]:
+    def make() -> Region:
+        import importlib
+        mod = importlib.import_module(f"coast_tpu.models.{modname}")
+        return mod.make_region()
+    return make
+
+
+REGISTRY: Dict[str, Callable[[], Region]] = {
+    "matrixMultiply": _lazy("mm"),
+    "crc16": _lazy("crc16"),
+    "quicksort": _lazy("quicksort"),
+    "aes": _lazy("aes"),
+    "sha256": _lazy("sha256"),
+}

@@ -1,0 +1,268 @@
+"""aes: AES-128 ECB encrypt+decrypt benchmark as a TPU region (BASELINE
+config 2, -TMR).
+
+Semantics follow tests/aes/aes.c + TI_aes_128.c: encrypt a 16-byte block,
+check against the golden ciphertext, decrypt it back, check against the
+golden plaintext, accumulating ``local_errors``.  The reference iterates the
+four NIST ECB vector suites from flash; we run one deterministic
+(key, plaintext) vector with the golden ciphertext computed by an
+independent host-side AES model at build time -- same oracle role as the
+NIST ``gold_cypher``/``gold_plain`` arrays (aes.c:38-41).
+
+TPU-native re-expression: one region step per AES round (11 encrypt + 11
+decrypt = 22 steps); SubBytes is a 256-entry gather, ShiftRows a static
+permutation, MixColumns GF(2^8) bit math on int32 bytes -- all
+vmap-friendly, no data-dependent shapes.  The expanded key schedule is an
+injectable memory leaf, like the reference's in-RAM round keys.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+from coast_tpu.models.common import lcg_words
+
+# ---------------------------------------------------------------------------
+# Host-side AES-128 golden model (independent oracle).
+# ---------------------------------------------------------------------------
+
+
+def _gen_sbox():
+    exp = [0] * 256
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        # multiply by 3 = x ^ xtime(x)
+        x ^= ((x << 1) ^ (0x1B if x & 0x80 else 0)) & 0xFF
+    sbox = [0] * 256
+    for a in range(256):
+        inv = 0 if a == 0 else exp[(255 - log[a]) % 255]
+        s = inv
+        for _ in range(4):
+            inv = ((inv << 1) | (inv >> 7)) & 0xFF
+            s ^= inv
+        sbox[a] = s ^ 0x63
+    inv_sbox = [0] * 256
+    for a, v in enumerate(sbox):
+        inv_sbox[v] = a
+    return sbox, inv_sbox
+
+
+SBOX, INV_SBOX = _gen_sbox()
+assert SBOX[0x00] == 0x63 and SBOX[0x01] == 0x7C and SBOX[0x53] == 0xED
+
+# flat[r + 4c] = AES state s[r][c]; ShiftRows: s'[r][c] = s[r][(c+r)%4],
+# i.e. new flat index i = r + 4c reads old byte at r + 4((c+r)%4).
+_SHIFT_PERM = [(i % 4) + 4 * (((i // 4) + (i % 4)) % 4) for i in range(16)]
+_INV_SHIFT_PERM = [(i % 4) + 4 * (((i // 4) - (i % 4)) % 4) for i in range(16)]
+
+
+def _xt(b):
+    return ((b << 1) ^ (0x1B if b & 0x80 else 0)) & 0xFF
+
+
+def _gmul(b, k):
+    acc = 0
+    cur = b
+    while k:
+        if k & 1:
+            acc ^= cur
+        cur = _xt(cur)
+        k >>= 1
+    return acc
+
+
+def _mixcols_host(flat, inv=False):
+    coef = ([14, 11, 13, 9] if inv else [2, 3, 1, 1])
+    out = [0] * 16
+    for c in range(4):
+        col = flat[4 * c:4 * c + 4]
+        for r in range(4):
+            out[4 * c + r] = (_gmul(col[0], coef[(0 - r) % 4])
+                              ^ _gmul(col[1], coef[(1 - r) % 4])
+                              ^ _gmul(col[2], coef[(2 - r) % 4])
+                              ^ _gmul(col[3], coef[(3 - r) % 4]))
+    return out
+
+
+def _expand_key_host(key):
+    w = [list(key[4 * i:4 * i + 4]) for i in range(4)]
+    rcon = 1
+    for i in range(4, 44):
+        tmp = list(w[i - 1])
+        if i % 4 == 0:
+            tmp = tmp[1:] + tmp[:1]
+            tmp = [SBOX[b] for b in tmp]
+            tmp[0] ^= rcon
+            rcon = _xt(rcon)
+        w.append([w[i - 4][j] ^ tmp[j] for j in range(4)])
+    return [[b for word in w[4 * r:4 * r + 4] for b in word]
+            for r in range(11)]
+
+
+def _encrypt_host(block, rks):
+    b = [x ^ k for x, k in zip(block, rks[0])]
+    for r in range(1, 11):
+        b = [SBOX[x] for x in b]
+        b = [b[_SHIFT_PERM[i]] for i in range(16)]
+        if r < 10:
+            b = _mixcols_host(b)
+        b = [x ^ k for x, k in zip(b, rks[r])]
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Device-side round functions.
+# ---------------------------------------------------------------------------
+
+
+def _g2(x):
+    return ((x << 1) & 0xFF) ^ jnp.where((x & 0x80) != 0, 0x1B, 0)
+
+
+def _mix(flat, coef):
+    cols = flat.reshape(4, 4)                      # row c = AES column c
+    g = {1: lambda v: v, 2: _g2, 3: lambda v: _g2(v) ^ v}
+    g[4] = lambda v: _g2(_g2(v))
+    g[8] = lambda v: _g2(g[4](v))
+    g[9] = lambda v: g[8](v) ^ v
+    g[11] = lambda v: g[8](v) ^ _g2(v) ^ v
+    g[13] = lambda v: g[8](v) ^ g[4](v) ^ v
+    g[14] = lambda v: g[8](v) ^ g[4](v) ^ _g2(v)
+    out_rows = []
+    for r in range(4):
+        acc = jnp.zeros_like(cols[:, 0])
+        for j in range(4):
+            acc = acc ^ g[coef[(j - r) % 4]](cols[:, j])
+        out_rows.append(acc)
+    return jnp.stack(out_rows, axis=1).reshape(-1)
+
+
+def make_region() -> Region:
+    raw = lcg_words(31, 32, bits=8)
+    key = [int(v) for v in raw[:16]]
+    plain = [int(v) for v in raw[16:]]
+    rks_host = _expand_key_host(key)
+    gold_cipher = _encrypt_host(plain, rks_host)
+
+    sbox = jnp.asarray(SBOX, dtype=jnp.int32)
+    inv_sbox = jnp.asarray(INV_SBOX, dtype=jnp.int32)
+    shift = jnp.asarray(_SHIFT_PERM, dtype=jnp.int32)
+    inv_shift = jnp.asarray(_INV_SHIFT_PERM, dtype=jnp.int32)
+    rk0 = jnp.asarray(rks_host, dtype=jnp.int32)          # [11, 16]
+    plain_a = jnp.asarray(plain, dtype=jnp.int32)
+    gold_a = jnp.asarray(gold_cipher, dtype=jnp.int32)
+
+    def init():
+        return {
+            "block": plain_a,
+            "cipher": jnp.zeros(16, jnp.int32),
+            "rk": rk0,
+            "sbox": sbox,
+            "inv_sbox": inv_sbox,
+            "gold_cipher": gold_a,
+            "gold_plain": plain_a,
+            "round": jnp.int32(0),
+            "phase": jnp.int32(0),
+        }
+
+    def step(state, t):
+        blk = state["block"] & 0xFF            # uchar semantics on any flip
+        rnd = state["round"]
+        phase = state["phase"]
+        rk_r = jnp.take(state["rk"], rnd, axis=0, mode="clip") & 0xFF
+        sb = state["sbox"] & 0xFF
+        isb = state["inv_sbox"] & 0xFF
+
+        # --- encrypt round (phase 0): round 0 = initial ARK, 10 = final ---
+        sub = jnp.take(sb, blk, mode="clip")
+        shifted = sub[shift]
+        mixed = jnp.where(rnd < 10, _mix(shifted, [2, 3, 1, 1]), shifted)
+        enc_out = jnp.where(rnd == 0, blk ^ rk_r, mixed ^ rk_r)
+
+        # --- decrypt round (phase 1): round 10 = initial ARK, 0 = final ---
+        ishifted = blk[inv_shift]
+        isub = jnp.take(isb, ishifted, mode="clip")
+        ark = isub ^ rk_r
+        dec_out = jnp.where(rnd == 10, blk ^ rk_r,
+                            jnp.where(rnd > 0, _mix(ark, [14, 11, 13, 9]),
+                                      ark))
+
+        enc_phase = phase == 0
+        dec_phase = phase == 1
+        active = phase < 2
+        new_blk = jnp.where(enc_phase, enc_out,
+                            jnp.where(dec_phase, dec_out, blk))
+        enc_last = jnp.logical_and(enc_phase, rnd >= 10)
+        dec_last = jnp.logical_and(dec_phase, rnd <= 0)
+        cipher = jnp.where(enc_last, new_blk, state["cipher"])
+        new_round = jnp.where(enc_phase,
+                              jnp.where(enc_last, 10, rnd + 1),
+                              jnp.where(dec_phase, rnd - 1, rnd))
+        new_phase = jnp.where(enc_last, 1,
+                              jnp.where(dec_last, 2, phase))
+        return {
+            **state,
+            "block": jnp.where(active, new_blk, state["block"]),
+            "cipher": jnp.where(active, cipher, state["cipher"]),
+            "round": jnp.where(active, new_round, rnd),
+            "phase": jnp.where(active, new_phase, phase),
+        }
+
+    def done(state):
+        return state["phase"] >= 2
+
+    def check(state):
+        e = jnp.sum(state["cipher"] != state["gold_cipher"])
+        d = jnp.sum(state["block"] != state["gold_plain"])
+        return (e + d).astype(jnp.int32)
+
+    def output(state):
+        return jnp.concatenate([state["cipher"],
+                                state["block"]]).astype(jnp.uint32)
+
+    def block_of(state):
+        p = state["phase"]
+        return jnp.where(p >= 2, jnp.int32(3),
+                         jnp.where(p == 0, jnp.int32(1),
+                                   jnp.int32(2))).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "encrypt", "decrypt", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 2), (2, 3)],
+        block_of=block_of,
+    )
+
+    return Region(
+        name="aes",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=22,
+        max_steps=88,
+        spec={
+            "block": LeafSpec(KIND_MEM),
+            "cipher": LeafSpec(KIND_MEM),
+            "rk": LeafSpec(KIND_MEM),
+            "sbox": LeafSpec(KIND_RO),
+            "inv_sbox": LeafSpec(KIND_RO),
+            # Golden vectors live outside the protected compute, like the
+            # reference's flash-resident NIST arrays (__NO_xMR in spirit);
+            # never written -> read-only (still injectable).
+            "gold_cipher": LeafSpec(KIND_RO),
+            "gold_plain": LeafSpec(KIND_RO),
+            "round": LeafSpec(KIND_CTRL),
+            "phase": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "Number of errors: 0"},
+    )

@@ -1,0 +1,27 @@
+"""Shared helpers for the benchmark regions.
+
+The reference benchmarks seed C ``rand()`` and compare against golden
+values captured at startup (e.g. tests/quicksort/quicksort.c init_array,
+tests/mm_common/mm.c).  We use one deterministic LCG across all regions so
+inputs are reproducible without glibc.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def lcg_words(seed: int, n: int, bits: int = 15) -> np.ndarray:
+    """n deterministic pseudo-random values of `bits` width (numpy host-side,
+    stands in for the reference's srand/rand input generation)."""
+    out = np.empty(n, dtype=np.int64)
+    x = seed & 0x7FFFFFFF
+    for i in range(n):
+        x = (1103515245 * x + 12345) & 0x7FFFFFFF
+        out[i] = (x >> 16) & ((1 << bits) - 1)
+    return out
+
+
+def lcg_fill(seed: int, n: int, bits: int = 15) -> jnp.ndarray:
+    return jnp.asarray(lcg_words(seed, n, bits), dtype=jnp.int32)

@@ -1,0 +1,100 @@
+"""crc16: CRC-16/CCITT benchmark as a TPU region (BASELINE config 3, -DWC).
+
+Semantics follow tests/crc16/crc16.c: reflected CCITT polynomial 0x8408,
+init 0xFFFF, over the 13-byte message "Automated TMR"; one region step per
+message byte (the while loop body).  The reference program just prints the
+final CRC and the harness regex-checks it (unittest/unittest.py:74-88); here
+``check`` compares against the build-time golden CRC and ``output`` is the
+CRC word.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec, Region
+
+MESSAGE = b"Automated TMR"
+POLY = 0x8408
+
+
+def _crc16_host(data: bytes) -> int:
+    """Host-side golden model (independent oracle, mirrors crc16.c:21-31)."""
+    crc = 0xFFFF
+    for byte in data:
+        x = ((crc >> 8) ^ byte) & 0xFF
+        x ^= x >> 4
+        crc = ((crc << 8) ^ (x << 12) ^ (x << 5) ^ x) & 0xFFFF
+    return crc
+
+
+GOLDEN = _crc16_host(MESSAGE)
+
+
+def make_region() -> Region:
+    msg = jnp.asarray(np.frombuffer(MESSAGE, dtype=np.uint8).astype(np.int32))
+    n = len(MESSAGE)
+
+    def init():
+        return {
+            "msg": msg,
+            "crc": jnp.int32(0xFFFF),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        crc = state["crc"]
+        # Clamped gather on a corrupted index reads the wrong byte instead
+        # of trapping (fidelity envelope vs the A9 data abort, SURVEY.md §7).
+        byte = jnp.take(state["msg"], i, mode="clip") & 0xFF
+        x = ((crc >> 8) ^ byte) & 0xFF
+        x = x ^ (x >> 4)
+        new_crc = (((crc << 8) ^ (x << 12) ^ (x << 5) ^ x)) & 0xFFFF
+        active = i < n
+        return {
+            **state,
+            "crc": jnp.where(active, new_crc, crc),
+            "i": jnp.where(active, i + 1, i),
+        }
+
+    def done(state):
+        return state["i"] >= n
+
+    def check(state):
+        return (state["crc"] != GOLDEN).astype(jnp.int32)
+
+    def output(state):
+        return state["crc"].reshape(1)
+
+    def block_of(state):
+        return jnp.where(state["i"] >= n, jnp.int32(2), jnp.int32(1))
+
+    graph = BlockGraph(
+        names=["entry", "loop", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=block_of,
+    )
+
+    return Region(
+        name="crc16",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=n,
+        max_steps=4 * n,
+        spec={
+            # The message is a global string; COAST clones in-scope globals
+            # (cloning.cpp:2417-2462), so it sits inside the SoR by default.
+            "msg": LeafSpec(KIND_MEM),
+            "crc": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"golden": GOLDEN, "oracle": f"result: {GOLDEN:x}"},
+    )

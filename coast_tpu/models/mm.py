@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from coast_tpu.ir.graph import BlockGraph
-from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec, Region
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
 
 SIDE = 9
 SEED = 42
@@ -130,7 +131,8 @@ def make_region() -> Region:
             "first": LeafSpec(KIND_MEM),
             "second": LeafSpec(KIND_MEM),
             "results": LeafSpec(KIND_MEM, xmr=True),
-            "golden": LeafSpec(KIND_MEM, xmr=False),
+            # Never written after init -> read-only (still injectable).
+            "golden": LeafSpec(KIND_RO),
             "acc": LeafSpec(KIND_REG),
             "i": LeafSpec(KIND_CTRL),
             "phase": LeafSpec(KIND_CTRL),

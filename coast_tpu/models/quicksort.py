@@ -1,0 +1,130 @@
+"""quicksort: the LANL sort benchmark as a TPU region (BASELINE config 3, -DWC).
+
+Semantics follow tests/quicksort/quicksort.c: 580 pseudo-random ints, sorted
+forward twice then in reverse twice (init_array + the fwd/fwd/rev/rev main
+loop), each result compared against golden sorted arrays with a running
+``local_errors`` count.
+
+TPU-native re-expression: recursive quicksort is hostile to XLA (dynamic
+ranges, data-dependent recursion); the in-place sort becomes an
+**odd-even transposition sort** -- one region step per phase, each phase a
+580-wide vectorised compare-exchange, which maps onto the VPU and keeps the
+step shape static.  The sorting-network phase index and pass counter are the
+control state; a corrupted phase/pass mis-orders exchanges exactly as a
+corrupted loop variable mis-orders the reference's partitions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+from coast_tpu.models.common import lcg_words
+
+ELEMS = 580            # array_elements, quicksort.c:84
+PASSES = 4             # fwd, fwd, rev, rev
+SEED = 7
+
+
+def make_region() -> Region:
+    vals = lcg_words(SEED, ELEMS, bits=15)
+    golden_asc = jnp.asarray(np.sort(vals), dtype=jnp.int32)
+    golden_desc = jnp.asarray(np.sort(vals)[::-1].copy(), dtype=jnp.int32)
+    arr0 = jnp.asarray(vals, dtype=jnp.int32)
+
+    def exchange(a, offset, ascending):
+        """One transposition phase over pairs (offset+2k, offset+2k+1)."""
+        m = ((ELEMS - offset) // 2) * 2
+        body = jax.lax.slice_in_dim(a, offset, offset + m)
+        left = body[0::2]
+        right = body[1::2]
+        lo = jnp.minimum(left, right)
+        hi = jnp.maximum(left, right)
+        new_left = jnp.where(ascending, lo, hi)
+        new_right = jnp.where(ascending, hi, lo)
+        merged = jnp.stack([new_left, new_right], axis=1).reshape(-1)
+        return jnp.concatenate([a[:offset], merged, a[offset + m:]])
+
+    def init():
+        return {
+            "array": arr0,
+            "golden": golden_asc,
+            "golden_rev": golden_desc,
+            "pass_": jnp.int32(0),
+            "phase": jnp.int32(0),
+            "errs": jnp.int32(0),
+        }
+
+    def step(state, t):
+        a = state["array"]
+        p = state["pass_"]
+        phase = state["phase"]
+        active = p < PASSES
+        ascending = p < 2
+        even = exchange(a, 0, ascending)
+        odd = exchange(a, 1, ascending)
+        new_a = jnp.where((phase % 2) == 0, even, odd)
+        last_phase = phase >= ELEMS - 1
+        # End of the ascending passes: check against golden (the reference
+        # checks after every sort; the final state is checked in check()).
+        asc_done = jnp.logical_and(last_phase, p == 1)
+        asc_errs = jnp.sum(new_a != state["golden"]).astype(jnp.int32)
+        return {
+            **state,
+            "array": jnp.where(active, new_a, a),
+            "phase": jnp.where(active, jnp.where(last_phase, 0, phase + 1),
+                               phase),
+            "pass_": jnp.where(active & last_phase, p + 1, p),
+            "errs": state["errs"] + jnp.where(active & asc_done, asc_errs, 0),
+        }
+
+    def done(state):
+        return state["pass_"] >= PASSES
+
+    def check(state):
+        final_errs = jnp.sum(state["array"] != state["golden_rev"])
+        return (state["errs"] + final_errs).astype(jnp.int32)
+
+    def output(state):
+        return state["array"].astype(jnp.uint32)
+
+    def block_of(state):
+        p = state["pass_"]
+        return jnp.where(p >= PASSES, jnp.int32(3),
+                         jnp.where(p < 2, jnp.int32(1),
+                                   jnp.int32(2))).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "sort_fwd", "sort_rev", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 2), (2, 3)],
+        block_of=block_of,
+    )
+
+    return Region(
+        name="quicksort",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=PASSES * ELEMS,
+        max_steps=PASSES * ELEMS + ELEMS,
+        spec={
+            "array": LeafSpec(KIND_MEM),
+            # golden arrays: __NO_xMR in spirit -- the reference's golden
+            # globals live outside the protected compute (mm.c pattern) and
+            # are never written, so they are read-only (still injectable).
+            "golden": LeafSpec(KIND_RO),
+            "golden_rev": LeafSpec(KIND_RO),
+            "pass_": LeafSpec(KIND_CTRL),
+            "phase": LeafSpec(KIND_CTRL),
+            "errs": LeafSpec(KIND_REG),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "local_errors == 0"},
+    )

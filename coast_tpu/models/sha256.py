@@ -1,0 +1,180 @@
+"""sha256: the SHA-256 benchmark as a TPU region (BASELINE config 2, -TMR).
+
+Semantics follow tests/sha256_common/sha256_common.c: hash a fixed message,
+compare the digest against a golden digest (``hashGlbl`` vs ``golden``,
+sha256_common.c:208).  The golden digest here comes from Python's hashlib --
+an independent oracle, like the reference's precomputed ``sha_data.inc``.
+
+TPU-native re-expression, stepped at round granularity so faults land
+mid-compression (the analogue of register-section injections into the
+a..h working variables):
+
+    phase 0 (48 steps): message-schedule expansion  w[16+i] = ...
+    phase 1 (64 steps): one compression round per step on regs a..h
+    phase 2 (1 step):   state += regs; done
+
+All words are uint32 (mod-2^32 add semantics for free).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+MESSAGE = b"coast_tpu sha256 benchmark: Automated TMR"
+
+_K = [
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2]
+
+_H0 = [0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+       0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19]
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _pad_block(msg: bytes) -> np.ndarray:
+    assert len(msg) <= 55, "single-block region: message must fit 55 bytes"
+    buf = bytearray(64)
+    buf[:len(msg)] = msg
+    buf[len(msg)] = 0x80
+    bitlen = len(msg) * 8
+    buf[56:64] = bitlen.to_bytes(8, "big")
+    return np.frombuffer(bytes(buf), dtype=">u4").astype(np.uint32)
+
+
+def make_region() -> Region:
+    w16 = _pad_block(MESSAGE)
+    golden = np.frombuffer(hashlib.sha256(MESSAGE).digest(),
+                           dtype=">u4").astype(np.uint32)
+    golden_a = jnp.asarray(golden, dtype=jnp.uint32)
+    k_a = jnp.asarray(np.asarray(_K, dtype=np.uint32))
+
+    def init():
+        w0 = jnp.zeros(64, jnp.uint32).at[:16].set(jnp.asarray(w16))
+        return {
+            "w": w0,
+            "h": jnp.asarray(np.asarray(_H0, dtype=np.uint32)),
+            "regs": jnp.asarray(np.asarray(_H0, dtype=np.uint32)),
+            "k": k_a,
+            "golden": golden_a,
+            "round": jnp.int32(0),
+            "phase": jnp.int32(0),
+        }
+
+    def step(state, t):
+        w = state["w"]
+        regs = state["regs"]
+        rnd = state["round"]
+        phase = state["phase"]
+
+        # --- phase 0: schedule expansion: w[16+rnd] ---
+        j = jnp.clip(rnd, 0, 47) + 16
+        s1w = jnp.take(w, j - 2, mode="clip")
+        s0w = jnp.take(w, j - 15, mode="clip")
+        sig1 = _rotr(s1w, 17) ^ _rotr(s1w, 19) ^ (s1w >> 10)
+        sig0 = _rotr(s0w, 7) ^ _rotr(s0w, 18) ^ (s0w >> 3)
+        new_w_val = (sig1 + jnp.take(w, j - 7, mode="clip")
+                     + sig0 + jnp.take(w, j - 16, mode="clip"))
+        w_expanded = w.at[j].set(new_w_val, mode="drop")
+
+        # --- phase 1: compression round rnd ---
+        a, b, c, d, e, f, g, h = [regs[i] for i in range(8)]
+        i = jnp.clip(rnd, 0, 63)
+        ep1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + ep1 + ch + jnp.take(state["k"], i, mode="clip") \
+            + jnp.take(w, i, mode="clip")
+        ep0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = ep0 + maj
+        regs_next = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g])
+
+        # --- phase 2: finalize ---
+        h_final = state["h"] + regs
+
+        p0 = phase == 0
+        p1 = phase == 1
+        p2 = phase == 2
+        active = phase < 3
+        last0 = jnp.logical_and(p0, rnd >= 47)
+        last1 = jnp.logical_and(p1, rnd >= 63)
+        new_w = jnp.where(p0, w_expanded, w)
+        new_regs = jnp.where(p1, regs_next, regs)
+        new_h = jnp.where(p2, h_final, state["h"])
+        new_round = jnp.where(p0, jnp.where(last0, 0, rnd + 1),
+                              jnp.where(p1, jnp.where(last1, 0, rnd + 1),
+                                        rnd))
+        new_phase = jnp.where(last0, 1,
+                              jnp.where(last1, 2,
+                                        jnp.where(p2, 3, phase)))
+        return {
+            **state,
+            "w": jnp.where(active, new_w, w),
+            "regs": jnp.where(active, new_regs, regs),
+            "h": jnp.where(active, new_h, state["h"]),
+            "round": jnp.where(active, new_round, rnd),
+            "phase": jnp.where(active, new_phase, phase),
+        }
+
+    def done(state):
+        return state["phase"] >= 3
+
+    def check(state):
+        return jnp.sum(state["h"] != state["golden"]).astype(jnp.int32)
+
+    def output(state):
+        return state["h"]
+
+    def block_of(state):
+        p = state["phase"]
+        return jnp.clip(p + 1, 1, 4).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "schedule", "compress", "finalize", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 2), (2, 3), (3, 4)],
+        block_of=block_of,
+    )
+
+    return Region(
+        name="sha256",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=48 + 64 + 1,
+        max_steps=3 * (48 + 64 + 1),
+        spec={
+            "w": LeafSpec(KIND_MEM),
+            "h": LeafSpec(KIND_MEM),
+            "regs": LeafSpec(KIND_REG),
+            "k": LeafSpec(KIND_RO),
+            # hashGlbl-vs-golden compare runs outside the SoR (__NO_xMR);
+            # never written -> read-only (still injectable).
+            "golden": LeafSpec(KIND_RO),
+            "round": LeafSpec(KIND_CTRL),
+            "phase": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "Number of errors: 0",
+              "golden_hex": hashlib.sha256(MESSAGE).hexdigest()},
+    )

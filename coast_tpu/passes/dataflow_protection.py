@@ -347,6 +347,37 @@ class ProtectedProgram:
             body, (pstate, flags),
             jnp.arange(self.region.max_steps, dtype=jnp.int32))
 
+        # Region-boundary sync: when the result escapes the SoR (the
+        # external call at the end -- printf of the result / the golden
+        # check), every replicated leaf is compared/voted once, exactly the
+        # reference's call sync point (processCallSync,
+        # synchronization.cpp:563-738).  This is what catches divergence in
+        # register leaves that never pass through a store sync (e.g. a CRC
+        # accumulator flipped mid-loop).  Only a normally-completed run
+        # reaches the call; an aborted/hung guest never prints.
+        if self.cfg.num_clones > 1:
+            mis = jnp.bool_(False)
+            mis_cnt = jnp.int32(0)
+            for name, arr in pstate.items():
+                if not self.replicated[name]:
+                    continue
+                _, m = voters.vote(arr, self.cfg.num_clones)
+                mis = jnp.logical_or(mis, m)
+                mis_cnt = mis_cnt + m.astype(jnp.int32)
+            reached_call = jnp.logical_and(
+                flags["done"], jnp.logical_not(flags["dwc_fault"]))
+            reached_call = jnp.logical_and(
+                reached_call, jnp.logical_not(flags["cfc_fault"]))
+            if self.cfg.num_clones == 2:
+                flags = {**flags,
+                         "dwc_fault": jnp.logical_or(
+                             flags["dwc_fault"],
+                             jnp.logical_and(reached_call, mis))}
+            elif self.cfg.count_errors:
+                flags = {**flags,
+                         "tmr_cnt": flags["tmr_cnt"]
+                         + jnp.where(reached_call, mis_cnt, 0)}
+
         view = self._voted_view(pstate)
         return {
             "errors": self.region.check(view),          # E: SDC count

@@ -1,0 +1,96 @@
+"""Tier-1 functional tests over the benchmark corpus (SURVEY.md §4 tier 1).
+
+The reference builds each benchmark with every pass combo and regex-checks
+its self-check output (unittest/unittest.py:54-88, cfg/fast.yml: mm x
+{"", -DWC, -TMR}).  Here: every registered region must run golden-clean
+unprotected, under DWC, and under TMR; and a single mid-run bit flip into
+replicated state must be masked by TMR and detected by DWC.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.models import REGISTRY
+
+# (benchmark, leaf to corrupt, word, bit, step t) for the flip tests.
+FLIP_TARGETS = {
+    "matrixMultiply": ("results", 0, 20, 5),
+    "crc16": ("crc", 0, 9, 4),
+    "quicksort": ("array", 17, 12, 40),
+    "aes": ("block", 3, 6, 7),
+    "sha256": ("regs", 2, 13, 60),
+}
+
+
+@pytest.fixture(scope="module", params=sorted(REGISTRY))
+def named_region(request):
+    return request.param, REGISTRY[request.param]()
+
+
+def _fault(prog, leaf, lane, word, bit, t):
+    return {
+        "leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+        "lane": jnp.int32(lane),
+        "word": jnp.int32(word),
+        "bit": jnp.int32(bit),
+        "t": jnp.int32(t),
+    }
+
+
+def test_unprotected_golden(named_region):
+    name, region = named_region
+    rec = jax.jit(unprotected(region).run)()
+    assert int(rec["errors"]) == 0, f"{name}: self-check failed unprotected"
+    assert bool(rec["done"])
+    assert int(rec["steps"]) == region.nominal_steps
+
+
+def test_tmr_preserves_semantics(named_region):
+    name, region = named_region
+    rec = jax.jit(TMR(region).run)()
+    assert int(rec["errors"]) == 0, f"{name}: TMR changed semantics"
+    assert int(rec["corrected"]) == 0
+    assert bool(rec["done"])
+
+
+def test_dwc_preserves_semantics(named_region):
+    name, region = named_region
+    rec = jax.jit(DWC(region).run)()
+    assert int(rec["errors"]) == 0, f"{name}: DWC changed semantics"
+    assert not bool(rec["dwc_fault"])
+
+
+def test_flip_unprotected_changes_outcome(named_region):
+    """The same flip must produce SDC or a hang when unprotected..."""
+    name, region = named_region
+    leaf, word, bit, t = FLIP_TARGETS[name]
+    prog = unprotected(region)
+    rec = jax.jit(prog.run)(_fault(prog, leaf, 0, word, bit, t))
+    sdc = int(rec["errors"]) > 0
+    hang = not bool(rec["done"])
+    assert sdc or hang, f"{name}: flip was silently benign"
+
+
+def test_flip_tmr_masks(named_region):
+    """...be masked (and counted) under TMR..."""
+    name, region = named_region
+    leaf, word, bit, t = FLIP_TARGETS[name]
+    prog = TMR(region)
+    rec = jax.jit(prog.run)(_fault(prog, leaf, 1, word, bit, t))
+    assert int(rec["errors"]) == 0, f"{name}: TMR failed to mask"
+    assert bool(rec["done"])
+    assert int(rec["corrected"]) > 0, f"{name}: correction not counted"
+
+
+def test_flip_dwc_detects(named_region):
+    """...and be detected (DUE) under DWC."""
+    name, region = named_region
+    leaf, word, bit, t = FLIP_TARGETS[name]
+    prog = DWC(region)
+    rec = jax.jit(prog.run)(_fault(prog, leaf, 1, word, bit, t))
+    assert bool(rec["dwc_fault"]), f"{name}: DWC failed to detect"
+    # The frozen mid-run state may fail the self-check; like the reference's
+    # aborted guest (no UART line), classification ranks the abort first
+    # (inject.classify), so the E field of an aborted run is not asserted.
