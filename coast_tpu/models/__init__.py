@@ -22,4 +22,9 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     "quicksort": _lazy("quicksort"),
     "aes": _lazy("aes"),
     "sha256": _lazy("sha256"),
+    "chstone_mips": _lazy("chstone_mips"),
+    "towersOfHanoi": _lazy("hanoi"),
 }
+
+# The CHStone sub-suite (BASELINE config 4: full TMR campaign).
+CHSTONE = ("chstone_mips",)

@@ -1,0 +1,270 @@
+"""CHStone mips: a MIPS ISA interpreter as a TPU region (BASELINE config 4).
+
+Semantics follow tests/chstone/mips/mips.c + imem.h: interpret a 44-word
+MIPS text segment (a bubble sort over A[8]) one instruction per region step
+until pc==0, then check ``main_result`` = (n_inst==611) + 8 matches of
+dmem against outData; RESULT: PASS iff main_result==9 (mips.c:297-305).
+
+This is the richest injection target in the corpus: a 32-entry register
+file, 64-word data memory, pc / Hi / Lo -- the direct analogue of the
+reference's register-section injections (resources/registers.py).
+
+TPU-native notes: the do-while dispatch loop becomes one step per
+instruction; the switch over opcodes becomes masked selects (every op class
+computed, one committed) -- branchless, static-shape, vmap-friendly.  C
+quirks kept: ``reg`` is int, so SRL/SRLV compile to *arithmetic* shifts
+(mips.c:199-207); shift amounts are masked to 5 bits (MIPS semantics);
+IADDR/DADDR clamp-gather instead of trapping on wild addresses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+# The 44-word text segment of imem.h (SPIM assembly of main + compare_swap
+# bubble sort; see imem.h:40-123 for the disassembly).
+IMEM = [
+    0x8fa40000, 0x27a50004, 0x24a60004, 0x00041080, 0x00c23021, 0x0c100016,
+    0x00000000, 0x3402000a, 0x0000000c, 0x3c011001, 0x34280000, 0x00044880,
+    0x01094821, 0x8d2a0000, 0x00055880, 0x010b5821, 0x8d6c0000, 0x018a682a,
+    0x11a00003, 0xad2c0000, 0xad6a0000, 0x03e00008, 0x27bdfff4, 0xafbf0008,
+    0xafb10004, 0xafb00000, 0x24100000, 0x2a080008, 0x1100000b, 0x26110001,
+    0x2a280008, 0x11000006, 0x26040000, 0x26250000, 0x0c100009, 0x26310001,
+    0x0810001e, 0x26100001, 0x0810001b, 0x8fbf0008, 0x8fb10004, 0x8fb00000,
+    0x27bd000c, 0x03e00008,
+]
+
+A_IN = [22, 5, -9, 3, -17, 38, 0, 11]
+OUT_DATA = [-17, -9, 0, 3, 5, 11, 22, 38]
+N_INST_GOLDEN = 611      # mips.c:297
+
+
+def _sra(x, n):
+    """C `int >> n` (arithmetic); n already masked to [0,31]."""
+    return jnp.right_shift(x, n)
+
+
+def _srl_u(x, n):
+    return jnp.right_shift(x.astype(jnp.uint32), n.astype(jnp.uint32)
+                           ).astype(jnp.int32)
+
+
+def _umulhi(a, b):
+    """High 32 bits of the unsigned 64-bit product, in 32-bit ops."""
+    au, bu = a.astype(jnp.uint32), b.astype(jnp.uint32)
+    al, ah = au & 0xFFFF, au >> 16
+    bl, bh = bu & 0xFFFF, bu >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = lh + (ll >> 16)
+    mid_lo = mid & 0xFFFF
+    carry = mid >> 16
+    mid2 = hl + mid_lo
+    return (hh + carry + (mid2 >> 16)).astype(jnp.int32)
+
+
+def make_region() -> Region:
+    imem0 = jnp.asarray(np.asarray(IMEM, dtype=np.uint32).view(np.int32))
+    a_in = jnp.asarray(A_IN, dtype=jnp.int32)
+    out_data = jnp.asarray(OUT_DATA, dtype=jnp.int32)
+
+    def init():
+        regs = jnp.zeros(32, jnp.int32).at[29].set(0x7FFFEFFC)
+        dmem = jnp.zeros(64, jnp.int32).at[:8].set(a_in)
+        return {
+            "imem": imem0,
+            "regs": regs,
+            "dmem": dmem,
+            "a_in": a_in,
+            "out_data": out_data,
+            "pc": jnp.int32(0x00400000),
+            "hi": jnp.int32(0),
+            "lo": jnp.int32(0),
+            "n_inst": jnp.int32(0),
+        }
+
+    def step(state, t):
+        pc = state["pc"]
+        regs = state["regs"]
+        dmem = state["dmem"]
+        running = pc != 0
+
+        iaddr = _srl_u(pc & 0xFF, jnp.int32(2))
+        ins = jnp.take(state["imem"], iaddr, mode="clip")
+        insu = ins.astype(jnp.uint32)
+        op = (insu >> 26).astype(jnp.int32)
+        funct = ins & 0x3F
+        shamt = (ins >> 6) & 0x1F
+        rd = (ins >> 11) & 0x1F
+        rt = (ins >> 16) & 0x1F
+        rs = (ins >> 21) & 0x1F
+        addr_u = ins & 0xFFFF                       # zero-extended
+        addr_s = (addr_u ^ 0x8000) - 0x8000         # sign-extended short
+        vrs = jnp.take(regs, rs, mode="clip")
+        vrt = jnp.take(regs, rt, mode="clip")
+        pc1 = pc + 4
+
+        # ---- R-type (op == 0) ----
+        sh_s = shamt & 31
+        sh_r = vrs & 31
+        r_vals = [
+            (33, vrs + vrt),                        # ADDU
+            (35, vrs - vrt),                        # SUBU
+            (16, state["hi"]),                      # MFHI
+            (18, state["lo"]),                      # MFLO
+            (36, vrs & vrt),                        # AND
+            (37, vrs | vrt),                        # OR
+            (38, vrs ^ vrt),                        # XOR
+            (0, vrt << sh_s),                       # SLL
+            (2, _sra(vrt, sh_s)),                   # SRL (C int >>)
+            (4, vrt << sh_r),                       # SLLV
+            (6, _sra(vrt, sh_r)),                   # SRLV (C int >>)
+            (42, (vrs < vrt).astype(jnp.int32)),    # SLT
+            (43, (vrs.astype(jnp.uint32)
+                  < vrt.astype(jnp.uint32)).astype(jnp.int32)),  # SLTU
+        ]
+        r_writes = jnp.stack([funct == f for f, _ in r_vals])
+        r_val = jnp.select([funct == f for f, _ in r_vals],
+                           [v for _, v in r_vals], jnp.int32(0))
+        r_reg_write = jnp.any(r_writes)
+        is_mult = jnp.logical_or(funct == 24, funct == 25)
+        lo_new = (vrs.astype(jnp.uint32) * vrt.astype(jnp.uint32)
+                  ).astype(jnp.int32)
+        hi_signed = (_umulhi(vrs, vrt)
+                     - jnp.where(vrs < 0, vrt, 0)
+                     - jnp.where(vrt < 0, vrs, 0))
+        hi_new = jnp.where(funct == 24, hi_signed, _umulhi(vrs, vrt))
+        is_jr = funct == 8
+        r_known = jnp.logical_or(jnp.logical_or(r_reg_write, is_mult), is_jr)
+        r_pc = jnp.where(is_jr, vrs, jnp.where(r_known, pc1, 0))
+
+        # ---- J / JAL (op 2, 3) ----
+        tgt = (ins & 0x3FFFFFF) << 2
+        # ---- I-type ----
+        daddr = _srl_u((vrs + addr_s) & 0xFF, jnp.int32(2))
+        lw_val = jnp.take(dmem, daddr, mode="clip")
+        i_vals = [
+            (9, vrs + addr_s),                       # ADDIU
+            (12, vrs & addr_u),                      # ANDI
+            (13, vrs | addr_u),                      # ORI
+            (14, vrs ^ addr_u),                      # XORI
+            (35, lw_val),                            # LW
+            (15, addr_u << 16),                      # LUI
+            (10, (vrs < addr_s).astype(jnp.int32)),  # SLTI
+            (11, (vrs.astype(jnp.uint32)
+                  < addr_u.astype(jnp.uint32)).astype(jnp.int32)),  # SLTIU
+        ]
+        i_reg_write = jnp.any(jnp.stack([op == o for o, _ in i_vals]))
+        i_val = jnp.select([op == o for o, _ in i_vals],
+                           [v for _, v in i_vals], jnp.int32(0))
+        is_sw = op == 43
+        btaken = jnp.select(
+            [op == 4, op == 5, op == 1],
+            [vrs == vrt, vrs != vrt, vrs >= 0], jnp.bool_(False))
+        is_branch = jnp.logical_or(jnp.logical_or(op == 4, op == 5), op == 1)
+        i_known = jnp.logical_or(jnp.logical_or(i_reg_write, is_sw), is_branch)
+        i_pc = jnp.where(jnp.logical_and(is_branch, btaken),
+                         pc1 - 4 + (addr_s << 2),
+                         jnp.where(i_known, pc1, 0))
+
+        is_r = op == 0
+        is_j = op == 2
+        is_jal = op == 3
+
+        # register write: rd for R-type, rt for I-type, $31 for JAL
+        wr_en = jnp.where(is_r, r_reg_write,
+                          jnp.where(is_jal, True,
+                                    jnp.logical_and(~is_j, i_reg_write)))
+        wr_idx = jnp.where(is_r, rd, jnp.where(is_jal, 31, rt))
+        wr_val = jnp.where(is_r, r_val, jnp.where(is_jal, pc1, i_val))
+        regs_w = regs.at[wr_idx].set(wr_val, mode="drop")
+        new_regs = jnp.where(jnp.logical_and(running, wr_en), regs_w, regs)
+        new_regs = new_regs.at[0].set(0)             # reg[0]=0, mips.c:292
+
+        dmem_w = dmem.at[daddr].set(vrt, mode="drop")
+        new_dmem = jnp.where(
+            jnp.logical_and(running, jnp.logical_and(~is_r, is_sw)),
+            dmem_w, dmem)
+
+        new_hi = jnp.where(jnp.logical_and(is_r, is_mult),
+                           hi_new, state["hi"])
+        new_lo = jnp.where(jnp.logical_and(is_r, is_mult),
+                           lo_new, state["lo"])
+        new_pc = jnp.where(is_r, r_pc,
+                           jnp.where(jnp.logical_or(is_j, is_jal), tgt, i_pc))
+
+        return {
+            **state,
+            "regs": new_regs,
+            "dmem": new_dmem,
+            "hi": jnp.where(running, new_hi, state["hi"]),
+            "lo": jnp.where(running, new_lo, state["lo"]),
+            "pc": jnp.where(running, new_pc, pc),
+            "n_inst": state["n_inst"] + jnp.where(running, 1, 0),
+        }
+
+    def done(state):
+        return state["pc"] == 0
+
+    def check(state):
+        main_result = ((state["n_inst"] == N_INST_GOLDEN).astype(jnp.int32)
+                       + jnp.sum(state["dmem"][:8] == state["out_data"]
+                                 ).astype(jnp.int32))
+        return jnp.int32(9) - main_result
+
+    def output(state):
+        return jnp.concatenate(
+            [state["dmem"][:8], state["n_inst"].reshape(1)]).astype(jnp.uint32)
+
+    def block_of(state):
+        """Coarse blocks by text address: startup [0x00..0x20], compare_swap
+        [0x24..0x54], main [0x58..0xac], exit (pc==0)."""
+        pc = state["pc"]
+        off = pc & 0xFF
+        return jnp.where(pc == 0, jnp.int32(4),
+                         jnp.where(off < 0x24, jnp.int32(1),
+                                   jnp.where(off < 0x58, jnp.int32(2),
+                                             jnp.int32(3)))).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "startup", "compare_swap", "main", "exit"],
+        edges=[(0, 1), (1, 1), (1, 3), (3, 3), (3, 2), (2, 2), (2, 3),
+               (3, 1), (1, 4)],  # (3,1): main's jr $ra back to startup
+        block_of=block_of,
+    )
+
+    return Region(
+        name="chstone_mips",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_INST_GOLDEN,
+        max_steps=1536,
+        spec={
+            "imem": LeafSpec(KIND_RO),
+            "a_in": LeafSpec(KIND_RO),
+            "out_data": LeafSpec(KIND_RO),
+            # regs/dmem are in-SoR local arrays: stores to them are store
+            # sync points in the reference (populateSyncPoints).
+            "regs": LeafSpec(KIND_MEM),
+            "dmem": LeafSpec(KIND_MEM),
+            "pc": LeafSpec(KIND_CTRL),
+            "n_inst": LeafSpec(KIND_CTRL),
+            "hi": LeafSpec(KIND_REG),
+            "lo": LeafSpec(KIND_REG),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "RESULT: PASS"},
+    )

@@ -1,0 +1,158 @@
+"""towersOfHanoi: the recursion/control-flow stress benchmark as a TPU
+region (tests/towersOfHanoi/towers.c).
+
+The reference is pure recursion with no data output (its value is deep call
+stacks and branching -- the stackProtection scenario,
+synchronization.cpp:1579-1812).  The TPU-native re-expression runs the
+recursion as an explicit stack machine, one frame visit per step, which
+gives the fault injector a real in-memory call stack to corrupt: frames
+(num, from, to, aux, stage) live in injectable memory leaves, and a flipped
+frame word mis-routes the recursion exactly as a smashed stack does.
+
+The reference uses num=32 (2^31 calls -- a pure burn); we run NUM_DISKS=8
+and add a semantic oracle the reference lacks: every move is applied to a
+disk-position array, and the check requires exactly 2^n - 1 moves with all
+disks on the target peg.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec,
+                                 Region)
+
+NUM_DISKS = 8
+DEPTH = NUM_DISKS + 1
+PEG_FROM, PEG_TO, PEG_AUX = 0, 1, 2       # towers(num, 'A', 'C', 'B')
+TOTAL_MOVES = (1 << NUM_DISKS) - 1
+# Frame visits: non-leaf frames 3 (stages 0,1,2), leaves 1.
+NOMINAL = 3 * ((1 << (NUM_DISKS - 1)) - 1) + (1 << (NUM_DISKS - 1))
+
+
+def make_region() -> Region:
+
+    def init():
+        z = jnp.zeros(DEPTH, jnp.int32)
+        return {
+            "st_num": z.at[0].set(NUM_DISKS),
+            "st_f": z.at[0].set(PEG_FROM),
+            "st_t": z.at[0].set(PEG_TO),
+            "st_a": z.at[0].set(PEG_AUX),
+            "st_stage": z,
+            "sp": jnp.int32(1),
+            "disk_pos": jnp.full(NUM_DISKS, PEG_FROM, jnp.int32),
+            "moves": jnp.int32(0),
+        }
+
+    def step(state, t):
+        sp = state["sp"]
+        running = sp > 0
+        top = jnp.clip(sp - 1, 0, DEPTH - 1)
+        num = jnp.take(state["st_num"], top, mode="clip")
+        f = jnp.take(state["st_f"], top, mode="clip")
+        to = jnp.take(state["st_t"], top, mode="clip")
+        aux = jnp.take(state["st_a"], top, mode="clip")
+        stage = jnp.take(state["st_stage"], top, mode="clip")
+
+        leaf = num <= 1
+        s0 = stage == 0
+        s1 = stage == 1
+
+        # stage 0, leaf: move disk 1 (index 0), pop.
+        # stage 0, non-leaf: stage<-1, push (num-1, f, aux, to).
+        # stage 1: move disk num (index num-1), stage<-2, push (num-1, aux, to, f).
+        # stage >=2: pop.
+        do_move = jnp.logical_and(running,
+                                  jnp.logical_or(jnp.logical_and(s0, leaf), s1))
+        moved_disk = jnp.where(jnp.logical_and(s0, leaf), 0,
+                               jnp.clip(num - 1, 0, NUM_DISKS - 1))
+        disk_pos = jnp.where(
+            do_move,
+            state["disk_pos"].at[moved_disk].set(to, mode="drop"),
+            state["disk_pos"])
+
+        push = jnp.logical_and(running,
+                               jnp.logical_or(jnp.logical_and(s0, ~leaf), s1))
+        pop = jnp.logical_and(running, ~push)
+
+        # stage bump on the current frame before pushing the child.
+        new_stage_top = jnp.where(s0, 1, 2)
+        st_stage = jnp.where(
+            push, state["st_stage"].at[top].set(new_stage_top, mode="drop"),
+            state["st_stage"])
+
+        child = jnp.clip(sp, 0, DEPTH - 1)
+        cf = jnp.where(s0, f, aux)
+        ct = jnp.where(s0, aux, to)
+        ca = jnp.where(s0, to, f)
+        st_num = jnp.where(push, state["st_num"].at[child].set(num - 1,
+                                                               mode="drop"),
+                           state["st_num"])
+        st_f = jnp.where(push, state["st_f"].at[child].set(cf, mode="drop"),
+                         state["st_f"])
+        st_t = jnp.where(push, state["st_t"].at[child].set(ct, mode="drop"),
+                         state["st_t"])
+        st_a = jnp.where(push, state["st_a"].at[child].set(ca, mode="drop"),
+                         state["st_a"])
+        st_stage = jnp.where(push, st_stage.at[child].set(0, mode="drop"),
+                             st_stage)
+
+        new_sp = jnp.where(push, sp + 1, jnp.where(pop, sp - 1, sp))
+        return {
+            "st_num": st_num,
+            "st_f": st_f,
+            "st_t": st_t,
+            "st_a": st_a,
+            "st_stage": st_stage,
+            "sp": new_sp,
+            "disk_pos": disk_pos,
+            "moves": state["moves"] + jnp.where(do_move, 1, 0),
+        }
+
+    def done(state):
+        return state["sp"] <= 0
+
+    def check(state):
+        wrong_moves = (state["moves"] != TOTAL_MOVES).astype(jnp.int32)
+        off_peg = jnp.sum(state["disk_pos"] != PEG_TO).astype(jnp.int32)
+        return wrong_moves + off_peg
+
+    def output(state):
+        return jnp.concatenate(
+            [state["disk_pos"], state["moves"].reshape(1)]).astype(jnp.uint32)
+
+    def block_of(state):
+        return jnp.where(state["sp"] <= 0, jnp.int32(2),
+                         jnp.int32(1)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "towers", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=block_of,
+    )
+
+    return Region(
+        name="towersOfHanoi",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=NOMINAL,
+        max_steps=3 * NOMINAL,
+        spec={
+            "st_num": LeafSpec(KIND_MEM),
+            "st_f": LeafSpec(KIND_MEM),
+            "st_t": LeafSpec(KIND_MEM),
+            "st_a": LeafSpec(KIND_MEM),
+            "st_stage": LeafSpec(KIND_MEM),
+            "sp": LeafSpec(KIND_CTRL),
+            "disk_pos": LeafSpec(KIND_MEM),
+            "moves": LeafSpec(KIND_REG),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "all disks on peg C in 2^n-1 moves"},
+    )

@@ -21,6 +21,10 @@ FLIP_TARGETS = {
     "quicksort": ("array", 17, 12, 40),
     "aes": ("block", 3, 6, 7),
     "sha256": ("regs", 2, 13, 60),
+    # pc bit 3 lands inside IADDR's 0xff window (high pc bits are masked
+    # off by the fetch, mips.c IADDR) and derails the instruction stream.
+    "chstone_mips": ("pc", 0, 3, 100),
+    "towersOfHanoi": ("sp", 0, 2, 100),
 }
 
 
@@ -82,6 +86,19 @@ def test_flip_tmr_masks(named_region):
     assert int(rec["errors"]) == 0, f"{name}: TMR failed to mask"
     assert bool(rec["done"])
     assert int(rec["corrected"]) > 0, f"{name}: correction not counted"
+
+
+def test_tmr_cfcss_clean(named_region):
+    """CFCSS stacked on TMR must not fire on a fault-free run: every legal
+    block transition of every benchmark graph must be in the edge set
+    (config 5 of BASELINE.json, stacking per CFCSS.cpp)."""
+    from coast_tpu.passes.cfcss import apply_cfcss
+    name, region = named_region
+    prog = apply_cfcss(TMR(region, cfcss=True))
+    rec = jax.jit(prog.run)()
+    assert not bool(rec["cfc_fault"]), f"{name}: spurious CFCSS fault"
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
 
 
 def test_flip_dwc_detects(named_region):
