@@ -61,6 +61,9 @@ class LeafSpec:
     kind: str = KIND_MEM
     xmr: Optional[bool] = None
     inject: bool = True   # is this leaf part of the injectable memory map?
+    # Opt this leaf out of SoR verification, mirroring the parameterized
+    # ``no-verify-<glbl>`` annotation (interface.cpp:364-532).
+    no_verify: bool = False
 
     def __post_init__(self):
         if self.kind not in _VALID_KINDS:

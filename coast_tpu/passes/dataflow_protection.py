@@ -115,6 +115,10 @@ class ProtectedProgram:
 
     def __init__(self, region: Region, cfg: ProtectionConfig):
         region.validate()
+        # verifyOptions runs before any cloning, and refuses to build on a
+        # rule violation (pipeline order, dataflowProtection.cpp:63-164).
+        from coast_tpu.passes.verification import verify_options
+        self.forced_sync = verify_options(region, cfg)
         self.region = region
         self.cfg = cfg
         self.replicated: Dict[str, bool] = {

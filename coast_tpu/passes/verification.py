@@ -1,0 +1,224 @@
+"""Sphere-of-Replication verification: the verifyOptions equivalent.
+
+The reference statically checks that the user's replication-scope choices
+are self-consistent before cloning anything, and refuses to compile
+otherwise (projects/dataflowProtection/verification.cpp:719-1077, rules
+table in the comment at :686-718; ``std::exit(-1)`` at :1055-1065).  Its
+rules, translated to the region model:
+
+  Protected -> NotProtected (a replicated value stored to an unreplicated
+  leaf): OK, but *vote first* -- the engine already forces a boundary vote
+  on every such store (the ``syncGlobalStores`` set, verification.cpp
+  :587,676); the verifier reports these as forced sync points.
+
+  NotProtected -> Protected (an unreplicated, *mutable* leaf feeding a
+  replicated leaf): NOT OK -- corrupted unprotected state would be imported
+  into every replica identically, silently defeating replication.  Reading
+  never-written (read-only) unprotected data is OK.
+
+TPU-native analysis: where the reference walks LLVM use-def chains, we
+trace the region's ``step`` to a **jaxpr** and propagate leaf provenance
+through its equations -- the use-def chain of the XLA program itself.  A
+leaf is *written* if its output is not the identity passthrough of its
+input var; leaf-level dependencies are the transitive closure over eqn
+operands.
+
+Like the reference, violations raise (the exit(-1) analogue) with an error
+listing every offending leaf, and the expected-rejection unit tests
+(globalPointers.c / linkedList.c / verifyOptions.c, unitTestDriver.py
+``cf=True``) assert that bad configs *fail to compile*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Set
+
+import jax
+import jax.numpy as jnp
+from jax.extend.core import Literal
+
+from coast_tpu.ir.region import KIND_CTRL, KIND_RO, Region
+
+# Mirror of the reference's colored error prefix (dataflowProtection.h:84-96).
+_ERR = "ERROR (SoR verification): "
+
+
+class SoRViolation(Exception):
+    """Raised instead of the reference's std::exit(-1); carries all
+    violations found (the reference also reports all before exiting)."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("\n".join(_ERR + e for e in errors))
+
+
+@dataclasses.dataclass(frozen=True)
+class RegionDataflow:
+    """Static dataflow facts about a region's step function."""
+
+    written: FrozenSet[str]                 # leaves not passed through identity
+    deps: Dict[str, FrozenSet[str]]         # out leaf -> source leaves
+
+
+def analyze(region: Region) -> RegionDataflow:
+    """Trace step() and propagate leaf provenance through the jaxpr."""
+    state = jax.eval_shape(region.init)
+    closed = jax.make_jaxpr(region.step)(state, jnp.int32(0))
+    jaxpr = closed.jaxpr
+
+    names = sorted(state)
+    flat_in, in_tree = jax.tree.flatten({k: state[k] for k in names})
+    # jax.make_jaxpr flattens (state, t): state leaves in dict-key order
+    # (dicts flatten sorted), then t.
+    assert len(jaxpr.invars) == len(flat_in) + 1, (
+        len(jaxpr.invars), len(flat_in))
+    src: Dict[object, Set[str]] = {}
+    in_var_of: Dict[str, object] = {}
+    for name, var in zip(names, jaxpr.invars[:-1]):
+        src[var] = {name}
+        in_var_of[name] = var
+
+    def var_deps(v) -> Set[str]:
+        if isinstance(v, Literal):
+            return set()
+        return src.get(v, set())
+
+    for eqn in jaxpr.eqns:
+        acc: Set[str] = set()
+        for v in eqn.invars:
+            acc |= var_deps(v)
+        # Sub-jaxprs (scan/cond/while/pjit): conservative -- every output
+        # depends on every input, which over-approximates but never misses
+        # a crossing (the reference is likewise conservative at calls,
+        # verification.cpp "TODO: track pointers across function calls").
+        for v in eqn.outvars:
+            src[v] = acc
+
+    assert len(jaxpr.outvars) == len(names), (
+        f"step() must return exactly the state leaves; got "
+        f"{len(jaxpr.outvars)} outputs for {len(names)} leaves")
+    out_deps: Dict[str, FrozenSet[str]] = {}
+    written: Set[str] = set()
+    for name, var in zip(names, jaxpr.outvars):
+        if isinstance(var, Literal):
+            out_deps[name] = frozenset()
+            written.add(name)
+        elif var is in_var_of.get(name):
+            out_deps[name] = frozenset({name})      # identity passthrough
+        else:
+            out_deps[name] = frozenset(var_deps(var))
+            written.add(name)
+    return RegionDataflow(written=frozenset(written), deps=out_deps)
+
+
+def _scope_excluded(region: Region, cfg, name: str) -> bool:
+    """Excluded from the SoR by an explicit user choice (CL list,
+    annotation, or region default), as opposed to by kind or mode."""
+    if name in cfg.ignore_globals:
+        return True
+    if name in cfg.xmr_globals:
+        return False
+    spec = region.spec[name]
+    if spec.xmr is False:
+        return True
+    return region.default_xmr is False and spec.xmr is not True
+
+
+def verify_options(region: Region, cfg) -> FrozenSet[str]:
+    """The verifyOptions pipeline step.  Raises SoRViolation on any rule
+    break; returns the forced-boundary-sync leaf set otherwise.
+
+    Per-leaf opt-out: LeafSpec.no_verify mirrors the parameterized
+    ``no-verify-<glbl>`` annotation (interface.cpp:364-532).
+    """
+    flow = analyze(region)
+    errors: List[str] = []
+    forced_sync: Set[str] = set()
+
+    # -- unknown names in scope lists (processCommandLine :244-362 reports
+    #    missing names and exits) --
+    for opt, val in (("ignore_globals", cfg.ignore_globals),
+                     ("xmr_globals", cfg.xmr_globals)):
+        for name in val:
+            if name not in region.spec:
+                errors.append(
+                    f"-{opt}: no leaf named '{name}' in region "
+                    f"'{region.name}' (have: {', '.join(sorted(region.spec))})")
+    both = set(cfg.ignore_globals) & set(cfg.xmr_globals)
+    for name in sorted(both):
+        errors.append(f"leaf '{name}' listed in both -ignore_globals and "
+                      "-xmr_globals")
+    if errors:
+        raise SoRViolation(errors)
+
+    replicated = {name: cfg.resolve_xmr(region, name) for name in region.spec}
+    any_replicated = any(replicated.values())
+
+    for name, spec in region.spec.items():
+        no_verify = getattr(spec, "no_verify", False)
+
+        # -- read-only leaves must not be written (const-ness; the closest
+        #    LLVM analogue is storing through a pointer to const) --
+        if spec.kind == KIND_RO and name in flow.written and not no_verify:
+            errors.append(
+                f"read-only leaf '{name}' is written by step(); "
+                "declare it KIND_MEM or stop writing it")
+
+        # -- conflicting annotations: explicitly replicating a leaf the
+        #    engine will never clone (the verifyOptions.c expected-fail
+        #    class: scope options that contradict each other) --
+        if spec.kind == KIND_RO and (spec.xmr is True
+                                     or name in cfg.xmr_globals):
+            errors.append(
+                f"leaf '{name}' is KIND_RO (never cloned, "
+                "cloning.cpp:62-288 rule) but annotated __xMR; "
+                "conflicting replication scope")
+
+        if not any_replicated or no_verify:
+            continue
+
+        # -- unvoted control: a ctrl leaf excluded from the SoR by scope
+        #    choice steers every replica from one corruptible copy --
+        if (spec.kind == KIND_CTRL and not replicated[name]
+                and cfg.num_clones > 1 and _scope_excluded(region, cfg, name)):
+            errors.append(
+                f"control leaf '{name}' excluded from replication: "
+                "branch predicates must be voted before the branch "
+                "(syncTerminator, synchronization.cpp:741-1113); "
+                "an unprotected loop variable defeats every replica")
+
+    # -- NotProtected -> Protected writes (rules table :686-718) --
+    if cfg.num_clones > 1:
+        # A hole needs *scope choice* exclusion: kind-based exclusion by
+        # -noMemReplication is the load-sync design, not a hole (the
+        # pervasive noMemReplicationFlag branches sync reads instead).
+        mutable_unprot = {
+            n for n in region.spec
+            if not replicated[n] and n in flow.written
+            and region.spec[n].kind != KIND_RO
+            and _scope_excluded(region, cfg, n)}
+        for name in sorted(region.spec):
+            if not replicated[name] or getattr(region.spec[name],
+                                               "no_verify", False):
+                continue
+            bad = (flow.deps.get(name, frozenset()) & mutable_unprot) - {name}
+            for srcname in sorted(bad):
+                errors.append(
+                    f"replicated leaf '{name}' reads mutable unprotected "
+                    f"leaf '{srcname}': NotProtected->Protected writes are "
+                    "not OK (verification.cpp rules table :686-718); "
+                    "replicate the source or mark it no_verify")
+
+        # -- Protected -> NotProtected: forced boundary votes (OK) --
+        for name in sorted(region.spec):
+            if replicated[name] or region.spec[name].kind == KIND_RO:
+                continue
+            if name in flow.written and any(
+                    replicated.get(s, False)
+                    for s in flow.deps.get(name, frozenset())):
+                forced_sync.add(name)
+
+    if errors:
+        raise SoRViolation(errors)
+    return frozenset(forced_sync)

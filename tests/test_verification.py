@@ -1,0 +1,115 @@
+"""SoR verification tests, incl. the expected-rejection tier (SURVEY.md §4
+tier 2: globalPointers.c / linkedList.c / verifyOptions.c compile with
+cf=True -- the verifier must *reject* invalid configurations)."""
+
+import jax.numpy as jnp
+import pytest
+
+from coast_tpu import (DWC, TMR, KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                       ProtectionConfig, Region, protect, unprotected)
+from coast_tpu.models import REGISTRY
+from coast_tpu.passes.verification import SoRViolation, analyze, verify_options
+
+
+def _toy(spec_overrides=None, default_xmr=True):
+    """counter region: acc accumulates src; ctrl loop var; ro constant."""
+    spec = {
+        "acc": LeafSpec(KIND_MEM),
+        "src": LeafSpec(KIND_MEM),
+        "ro_in": LeafSpec(KIND_RO),
+        "i": LeafSpec(KIND_CTRL),
+    }
+    spec.update(spec_overrides or {})
+
+    def init():
+        return {
+            "acc": jnp.zeros(4, jnp.int32),
+            "src": jnp.ones(4, jnp.int32),
+            "ro_in": jnp.arange(4, dtype=jnp.int32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        return {
+            **state,
+            "acc": state["acc"] + state["src"] + state["ro_in"],
+            "src": state["src"] * 2,
+            "i": state["i"] + 1,
+        }
+
+    return Region(
+        name="toy", init=init, step=step,
+        done=lambda s: s["i"] >= 4,
+        check=lambda s: jnp.int32(0),
+        output=lambda s: s["acc"].astype(jnp.uint32),
+        nominal_steps=4, max_steps=8, spec=spec, default_xmr=default_xmr,
+    )
+
+
+def test_analyze_writes_and_deps():
+    flow = analyze(_toy())
+    assert "acc" in flow.written and "src" in flow.written
+    assert "ro_in" not in flow.written
+    assert {"acc", "src", "ro_in"} <= flow.deps["acc"]
+    assert flow.deps["ro_in"] == frozenset({"ro_in"})
+
+
+def test_corpus_passes_verification():
+    """Every registered benchmark must verify clean under TMR and DWC
+    (the reference's whole test corpus compiles under both passes)."""
+    for name, make in REGISTRY.items():
+        region = make()
+        TMR(region)
+        DWC(region)
+
+
+def test_unknown_scope_name_rejected():
+    with pytest.raises(SoRViolation, match="no leaf named 'bogus'"):
+        TMR(_toy(), ignore_globals=("bogus",))
+
+
+def test_conflicting_scope_lists_rejected():
+    with pytest.raises(SoRViolation, match="both"):
+        TMR(_toy(), ignore_globals=("src",), xmr_globals=("src",))
+
+
+def test_ro_leaf_written_rejected():
+    region = _toy({"src": LeafSpec(KIND_RO)})
+    with pytest.raises(SoRViolation, match="read-only leaf 'src' is written"):
+        TMR(region)
+
+
+def test_ro_xmr_annotation_conflict_rejected():
+    region = _toy({"ro_in": LeafSpec(KIND_RO, xmr=True)})
+    with pytest.raises(SoRViolation, match="conflicting replication scope"):
+        TMR(region)
+
+
+def test_unprotected_ctrl_rejected():
+    """The verifyOptions.c class: scope options that defeat protection."""
+    with pytest.raises(SoRViolation, match="control leaf 'i'"):
+        TMR(_toy(), ignore_globals=("i",))
+
+
+def test_mutable_unprotected_source_rejected():
+    """NotProtected->Protected write: 'acc' (replicated) reads 'src' which
+    is written every step but excluded from the SoR -- the linkedList.c
+    SoR-violation demo class."""
+    with pytest.raises(SoRViolation, match="reads mutable unprotected"):
+        TMR(_toy({"src": LeafSpec(KIND_MEM, xmr=False)}))
+
+
+def test_no_verify_annotation_suppresses():
+    region = _toy({"src": LeafSpec(KIND_MEM, xmr=False, no_verify=True),
+                   "acc": LeafSpec(KIND_MEM, no_verify=True)})
+    TMR(region)   # must build
+
+
+def test_no_mem_replication_is_not_a_hole():
+    """-noMemReplication excludes memory by kind (load-sync design), which
+    must not be reported as a scope hole."""
+    TMR(_toy(), no_mem_replication=True)
+
+
+def test_unprotected_passes_everything():
+    unprotected(_toy({"src": LeafSpec(KIND_MEM, xmr=False)}))
