@@ -1,0 +1,22 @@
+"""User-facing interface layer: replication-scope configuration.
+
+The reference ingests scope through three layers with defined precedence --
+config file, overridden by command line, refined by in-code annotations
+(interface.cpp:82-362; SURVEY.md §5 "Config / flag system").  Here:
+
+  * config file  -> :mod:`coast_tpu.interface.config` (same key=value
+    format as projects/dataflowProtection/functions.config)
+  * command line -> :mod:`coast_tpu.opt` (same flag names as
+    dataflowProtection.cpp:14-47)
+  * annotations  -> :class:`~coast_tpu.ir.region.LeafSpec` fields on the
+    region itself (the COAST.h macro analogue)
+  * signature-rewrite features (protected lib, replicated returns,
+    clone-after-call, per-arg exclusion) -> :mod:`coast_tpu.interface.wrappers`
+"""
+
+from coast_tpu.interface.config import ScopeConfig, parse_config_file
+from coast_tpu.interface.wrappers import (clone_after_call, protected_lib,
+                                          replicated_return)
+
+__all__ = ["ScopeConfig", "parse_config_file",
+           "protected_lib", "replicated_return", "clone_after_call"]

@@ -1,0 +1,104 @@
+"""Signature-rewrite features as JAX function transforms.
+
+The reference implements these by rewriting function signatures in IR --
+adding cloned arguments (cloneFunctionArguments, cloning.cpp:493-1113),
+out-pointer returns (.RR functions, :1128-1225), COAST_WRAPPER renames
+(utils.cpp:716-830).  On TPU the same contracts become function
+*transforms* over jittable callables: the lane axis is explicit, and the
+caller picks the boundary semantics.
+
+  protected_lib      -- "replicate body, keep signature"
+                        (__xMR_PROT_LIB, cloning.cpp:562-564): single-copy
+                        in/out; internally N lanes + vote; miscompare info
+                        is returned so the caller can latch DWC faults.
+  replicated_return  -- ".RR" (cloneFunctionReturnVals :1128-1225): the
+                        caller passes per-lane arguments and receives
+                        per-lane returns, no boundary sync.
+  clone_after_call   -- (cloning.cpp:1700-1768, e.g. scanf): call ONCE on
+                        the single-copy arguments, then fan the result out
+                        to N lanes -- for functions that must not or cannot
+                        be replicated.
+  no_xmr_arg         -- __NO_xMR_ARG(n) (interface.cpp noXmrArgList):
+                        listed argument positions stay single-copy
+                        (shared across lanes) in replicated_return.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ops import voters
+
+
+def protected_lib(fn: Callable, num_clones: int = 3) -> Callable:
+    """Wrap ``fn(*args) -> pytree``: unreplicated signature, replicated
+    body, boundary vote.  Returns ``(voted_out, miscompare)`` where
+    miscompare is a scalar bool (any lane disagreed) -- the caller's DWC
+    error-block hook / TMR correction count source."""
+    if num_clones < 2:
+        raise ValueError("protected_lib needs num_clones >= 2")
+
+    def wrapper(*args):
+        lanes = jax.vmap(lambda _: fn(*args))(jnp.arange(num_clones))
+        flat, tree = jax.tree.flatten(lanes)
+        mis = jnp.bool_(False)
+        voted = []
+        for leaf in flat:
+            v, m = voters.vote(leaf, num_clones)
+            voted.append(v)
+            mis = jnp.logical_or(mis, m)
+        return jax.tree.unflatten(tree, voted), mis
+
+    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_COAST_WRAPPER"
+    return wrapper
+
+
+def replicated_return(fn: Callable, num_clones: int = 3,
+                      no_xmr_args: Sequence[int] = ()) -> Callable:
+    """Wrap ``fn`` as its .RR form: arguments carry a leading lane axis
+    (except positions in ``no_xmr_args``, shared single-copy), and the
+    return is per-lane with no sync -- divergence is the caller's to
+    resolve at its own sync points."""
+
+    def wrapper(*args):
+        in_axes = tuple(None if i in no_xmr_args else 0
+                        for i in range(len(args)))
+        for i, a in enumerate(args):
+            if i in no_xmr_args:
+                continue
+            lanes = jax.tree.leaves(jax.tree.map(lambda x: jnp.shape(x)[0], a))
+            if any(l != num_clones for l in lanes):
+                raise ValueError(
+                    f"{wrapper.__name__}: argument {i} has lane axis "
+                    f"{lanes}, expected {num_clones} replicas")
+        return jax.vmap(fn, in_axes=in_axes)(*args)
+
+    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}.RR"
+    return wrapper
+
+
+def no_xmr_arg(*argnums: int):
+    """Annotation helper: ``replicated_return(fn, n, no_xmr_args=...)``
+    sugar matching the __NO_xMR_ARG(n) macro shape."""
+    def apply(fn: Callable, num_clones: int = 3) -> Callable:
+        return replicated_return(fn, num_clones, no_xmr_args=argnums)
+    return apply
+
+
+def clone_after_call(fn: Callable, num_clones: int = 3) -> Callable:
+    """Wrap ``fn``: call once on single-copy args, broadcast the result to
+    a leading lane axis so each replica owns an (initially identical,
+    independently corruptible) copy."""
+
+    def wrapper(*args):
+        out = fn(*args)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x, (num_clones,) + jnp.shape(x)), out)
+
+    wrapper.__name__ = (
+        f"{getattr(fn, '__name__', 'fn')}_CLONE_AFTER_CALL_1_2")
+    return wrapper

@@ -1,0 +1,207 @@
+"""``python -m coast_tpu.opt``: the ``opt -load DataflowProtection.so``
+command-line surface, TPU-native.
+
+Flag names are the reference's verbatim (dataflowProtection.cpp:14-47;
+docs/source/passes.rst:30-140): single-dash long flags, ``-flag=v1,v2``
+comma lists.  Instead of an LLVM module, the positional argument names a
+benchmark region from the registry (the analogue of the .bc input), and the
+protected program is *run*; stdout ends with the guest UART line
+
+    C: 0 E: <errors> F: <corrected> T: <steps>
+
+exactly as resources/decoder.py:66 parses it, so the reference's campaign
+tooling conventions carry over.  Exit status = error count (the benchmark
+main()'s return convention).
+
+    python -m coast_tpu.opt -TMR -countErrors matrixMultiply
+    python -m coast_tpu.opt -DWC -s -ignoreGlbls=golden matrixMultiply
+    python -m coast_tpu.opt -TMR -CFCSS -dumpModule sha256
+    python -m coast_tpu.opt -TMR -inject=results:1:0:20:5 matrixMultiply
+
+``-dumpModule`` prints the jaxpr of the protected step -- the analogue of
+dumping the transformed LLVM module (utils.cpp:909-929).  ``-inject`` is
+the forced-injection debug hook (--forceBreak, injector.py:59-68).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_BOOL_FLAGS = {
+    "TMR", "DWC", "EDDI", "CFCSS",
+    "noMemReplication", "noLoadSync", "noStoreDataSync", "noStoreAddrSync",
+    "storeDataSync", "countErrors", "reportErrors", "countSyncs",
+    "i", "s", "verbose", "dumpModule", "noMain", "noCloneOpsCheck",
+    "protectStack",
+}
+_LIST_FLAGS = {
+    "ignoreFns", "ignoreGlbls", "skipLibCalls", "replicateFnCalls",
+    "isrFunctions", "cloneFns", "cloneGlbls", "cloneReturn",
+    "cloneAfterCall", "protectedLibFn", "runtimeInitGlobals",
+}
+_STR_FLAGS = {"configFile", "inject"}
+
+
+class UsageError(Exception):
+    pass
+
+
+def parse_argv(argv: List[str]) -> Tuple[Dict[str, object], List[str]]:
+    flags: Dict[str, object] = {}
+    positional: List[str] = []
+    for arg in argv:
+        if not arg.startswith("-"):
+            positional.append(arg)
+            continue
+        name, sep, value = arg[1:].partition("=")
+        if name in _BOOL_FLAGS:
+            if sep:
+                raise UsageError(f"flag -{name} takes no value")
+            flags[name] = True
+        elif name in _LIST_FLAGS:
+            if not sep:
+                raise UsageError(f"flag -{name} needs =name,name,...")
+            flags.setdefault(name, [])
+            flags[name].extend(v for v in value.split(",") if v)  # type: ignore
+        elif name in _STR_FLAGS:
+            if not sep:
+                raise UsageError(f"flag -{name} needs =value")
+            flags[name] = value
+        else:
+            raise UsageError(f"unknown flag -{name}")
+    return flags, positional
+
+
+def _parse_inject(spec: str, prog) -> Dict[str, object]:
+    import jax.numpy as jnp
+    parts = spec.split(":")
+    if len(parts) != 5:
+        raise UsageError("-inject=leaf:lane:word:bit:t")
+    leaf, lane, word, bit, t = parts
+    if leaf not in prog.leaf_order:
+        raise UsageError(f"-inject: no injectable leaf '{leaf}' "
+                         f"(have: {', '.join(prog.leaf_order)})")
+    return {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+            "lane": jnp.int32(int(lane)), "word": jnp.int32(int(word)),
+            "bit": jnp.int32(int(bit)), "t": jnp.int32(int(t))}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        flags, positional = parse_argv(argv)
+    except UsageError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+
+    from coast_tpu.models import REGISTRY
+    if len(positional) != 1 or positional[0] not in REGISTRY:
+        print("usage: python -m coast_tpu.opt [-TMR|-DWC|-EDDI] [flags] "
+              f"<benchmark>\nbenchmarks: {', '.join(sorted(REGISTRY))}",
+              file=sys.stderr)
+        return 2
+    bench = positional[0]
+
+    strategies = [s for s in ("TMR", "DWC", "EDDI") if flags.get(s)]
+    if len(strategies) > 1:
+        print(f"ERROR: choose one of -TMR/-DWC/-EDDI, got {strategies}",
+              file=sys.stderr)
+        return 2
+    if flags.get("i") and flags.get("s"):
+        # The reference errors when both scheduling flags are given
+        # (processCommandLine, interface.cpp:244-362).
+        print("ERROR: -i and -s are mutually exclusive", file=sys.stderr)
+        return 2
+
+    from coast_tpu.interface.config import (ConfigError, parse_config_file)
+    try:
+        scope = parse_config_file(flags.get("configFile"),
+                                  required="configFile" in flags)
+        scope.merge_cl({k: v for k, v in flags.items() if k in _LIST_FLAGS})
+    except ConfigError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+    import jax
+
+    from coast_tpu import DWC, EDDI, TMR, unprotected
+    from coast_tpu.passes.cfcss import apply_cfcss
+    from coast_tpu.passes.verification import SoRViolation
+
+    region = REGISTRY[bench]()
+    overrides = dict(scope.protection_overrides())
+    overrides["no_mem_replication"] = bool(flags.get("noMemReplication"))
+    overrides["no_store_data_sync"] = bool(flags.get("noStoreDataSync"))
+    overrides["no_ctrl_sync"] = bool(flags.get("noStoreAddrSync")
+                                     or flags.get("noLoadSync"))
+    overrides["count_errors"] = bool(flags.get("countErrors"))
+    overrides["count_syncs"] = bool(flags.get("countSyncs"))
+    overrides["segmented"] = bool(flags.get("s"))
+    overrides["cfcss"] = bool(flags.get("CFCSS"))
+
+    strategy = strategies[0] if strategies else None
+    try:
+        if strategy == "TMR":
+            prog = TMR(region, **overrides)
+        elif strategy == "DWC":
+            prog = DWC(region, **overrides)
+        elif strategy == "EDDI":
+            EDDI(region)           # raises: deprecated, switch to DWC
+            return 1
+        else:
+            prog = unprotected(region, **{
+                k: v for k, v in overrides.items()
+                if k not in ("ignore_globals", "xmr_globals")})
+    except SoRViolation as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except NotImplementedError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+    if flags.get("CFCSS"):
+        prog = apply_cfcss(prog)
+
+    if flags.get("verbose"):
+        for name in sorted(region.spec):
+            print(f"# leaf {name}: kind={region.spec[name].kind} "
+                  f"replicated={prog.replicated[name]}", file=sys.stderr)
+
+    if flags.get("dumpModule"):
+        import jax.numpy as jnp
+        pstate, fl = jax.eval_shape(prog.init_pstate)
+        print(jax.make_jaxpr(prog.step)(pstate, fl, jnp.int32(0)))
+
+    fault = None
+    if "inject" in flags:
+        try:
+            fault = _parse_inject(flags["inject"], prog)   # type: ignore
+        except (UsageError, ValueError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+
+    rec = jax.jit(prog.run)(fault) if fault is not None \
+        else jax.jit(prog.run)()
+
+    errors = int(rec["errors"])
+    if bool(rec["dwc_fault"]):
+        # FAULT_DETECTED_DWC -> abort(): no UART success line is printed
+        # (decoder.py classifies the absence as abort/DUE).
+        print("FAULT_DETECTED_DWC: abort()", file=sys.stderr)
+        return 134                       # SIGABRT convention
+    if bool(rec["cfc_fault"]):
+        print("FAULT_DETECTED_CFC: abort()", file=sys.stderr)
+        return 134
+    if not bool(rec["done"]):
+        print("TIMEOUT: watchdog expired", file=sys.stderr)
+        return 124                       # timeout(1) convention
+    if flags.get("countSyncs"):
+        print(f"__SYNC_COUNT: {int(rec['sync_count'])}")
+    print(f"C: 0 E: {errors} F: {int(rec['corrected'])} "
+          f"T: {int(rec['steps'])}")
+    return errors
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Interface-layer tests: config file, CL merge rules, wrappers, CLI."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coast_tpu.interface.config import (ConfigError, ScopeConfig,
+                                        parse_config_file)
+from coast_tpu.interface.wrappers import (clone_after_call, protected_lib,
+                                          replicated_return)
+from coast_tpu.opt import main as opt_main
+
+
+# ---------------------------------------------------------------------------
+# Config file (interface.cpp:172-241 format)
+# ---------------------------------------------------------------------------
+
+def test_parse_config_file(tmp_path):
+    p = tmp_path / "functions.config"
+    p.write_text(
+        "# comment line\n"
+        "\n"
+        "skipLibCalls = rand, srand, printf\n"
+        "ignoreGlbls=golden , seed\n"
+        "ignoreFns =\n")
+    cfg = parse_config_file(str(p))
+    assert cfg.skip_lib_calls == ["rand", "srand", "printf"]
+    assert cfg.ignore_glbls == ["golden", "seed"]
+    assert cfg.ignore_fns == []
+
+
+def test_parse_config_unknown_key(tmp_path):
+    p = tmp_path / "functions.config"
+    p.write_text("cloneGlbls = x\n")     # CL-only option: not a file key
+    with pytest.raises(ConfigError, match="unrecognized option 'cloneGlbls'"):
+        parse_config_file(str(p))
+
+
+def test_parse_config_missing_required():
+    with pytest.raises(ConfigError, match="No configuration file"):
+        parse_config_file("/nonexistent/functions.config", required=True)
+
+
+def test_merge_cl_override_rules():
+    """cloneGlbls removes from ignoreGlbls; cloneAfterCall implies
+    skipLibCalls + ignoreFns (interface.cpp:88-164)."""
+    cfg = ScopeConfig(ignore_glbls=["a", "b"], skip_lib_calls=["scanf"])
+    cfg.merge_cl({"cloneGlbls": ["b"], "cloneAfterCall": ["scanf"]})
+    assert cfg.ignore_glbls == ["a"]
+    assert cfg.clone_glbls == ["b"]
+    assert "scanf" in cfg.ignore_fns
+    assert cfg.protection_overrides() == {
+        "ignore_globals": ("a",), "xmr_globals": ("b",)}
+
+
+# ---------------------------------------------------------------------------
+# Signature-rewrite wrappers (cloning.cpp:493-1225, 1700-1768)
+# ---------------------------------------------------------------------------
+
+def test_protected_lib_votes_and_reports():
+    def body(x):
+        return x * 2 + 1
+
+    lib = protected_lib(body, num_clones=3)
+    out, mis = jax.jit(lib)(jnp.arange(4))
+    assert out.shape == (4,)
+    assert (out == jnp.arange(4) * 2 + 1).all()
+    assert not bool(mis)
+    assert lib.__name__ == "body_COAST_WRAPPER"
+
+
+def test_replicated_return_per_lane():
+    def body(x, shared):
+        return x + shared
+
+    rr = replicated_return(body, num_clones=3, no_xmr_args=(1,))
+    lanes = jnp.stack([jnp.zeros(2), jnp.ones(2), 2 * jnp.ones(2)])
+    out = jax.jit(rr)(lanes, jnp.float32(10.0))
+    assert out.shape == (3, 2)
+    assert (out[2] == 12.0).all()
+
+
+def test_clone_after_call_broadcasts():
+    def once(x):
+        return {"v": x + 1}
+
+    cac = clone_after_call(once, num_clones=3)
+    out = jax.jit(cac)(jnp.arange(4))
+    assert out["v"].shape == (3, 4)
+    assert (out["v"][1] == jnp.arange(4) + 1).all()
+
+
+# ---------------------------------------------------------------------------
+# CLI (the opt flag surface)
+# ---------------------------------------------------------------------------
+
+def test_cli_tmr_uart_line(capsys):
+    rc = opt_main(["-TMR", "-countErrors", "matrixMultiply"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    assert out.startswith("C: 0 E: 0 F: 0 T: ")
+
+
+def test_cli_forced_injection_dwc_aborts(capsys):
+    rc = opt_main(["-DWC", "-inject=results:1:0:20:5", "matrixMultiply"])
+    assert rc == 134
+    assert "FAULT_DETECTED_DWC" in capsys.readouterr().err
+
+
+def test_cli_forced_injection_tmr_corrects(capsys):
+    rc = opt_main(["-TMR", "-countErrors", "-inject=results:1:0:20:5",
+                   "matrixMultiply"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    assert " E: 0 " in out and " F: 0 " not in out
+
+
+def test_cli_scope_rejection(capsys):
+    rc = opt_main(["-TMR", "-ignoreGlbls=i", "matrixMultiply"])
+    assert rc == 1
+    assert "SoR verification" in capsys.readouterr().err
+
+
+def test_cli_eddi_deprecated(capsys):
+    rc = opt_main(["-EDDI", "matrixMultiply"])
+    assert rc == 1
+    assert "Switch to DWC" in capsys.readouterr().err
+
+
+def test_cli_bad_flags(capsys):
+    assert opt_main(["-TMR", "-s", "-i", "crc16"]) == 2
+    assert opt_main(["-bogusFlag", "crc16"]) == 2
+    assert opt_main(["-TMR"]) == 2
+    assert opt_main(["-TMR", "-DWC", "crc16"]) == 2
+
+
+def test_cli_count_syncs(capsys):
+    rc = opt_main(["-TMR", "-countSyncs", "crc16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "__SYNC_COUNT:" in out
+
+
+def test_cli_dump_module(capsys):
+    rc = opt_main(["-TMR", "-dumpModule", "crc16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lambda" in out or "let" in out   # jaxpr text
