@@ -1,0 +1,5 @@
+"""Results analysis (the reference's simulation/platform/jsonParser.py)."""
+
+from coast_tpu.analysis.json_parser import (  # noqa: F401
+    Summary, classify_run, compare_runs, cycle_histogram, read_json_file,
+    section_stats, summarize_path, summarize_runs)

@@ -1,0 +1,5 @@
+import sys
+
+from coast_tpu.analysis.json_parser import main
+
+sys.exit(main())

@@ -1,0 +1,350 @@
+"""Campaign-log analysis: the jsonParser.py equivalent.
+
+Consumes the structured JSON logs written by :mod:`coast_tpu.inject.logs`
+(whose per-run dicts follow the reference's ``InjectionLog.getDict`` schema,
+supportClasses.py:338-353) and reproduces the reference's analyses
+(simulation/platform/jsonParser.py):
+
+  * per-file / per-dir run summaries -- success / SDC "errors" / corrected
+    "faults" / DUE (timeout + abort) / invalid counts and percentages
+    (``summarizeRuns``, jsonParser.py:148-201);
+  * timing -- seconds per injection (``summarizeTiming`` :204-213);
+  * A-vs-B comparison -- runtime x, error-rate x, and
+    **MWTF = (delta error rate) / (delta runtime)** (``compareRuns``
+    :458-506, mwtf :473);
+  * per-section error attribution -- which injected section/symbol produced
+    which outcome (per-register counts :259-287 + ``examineSymbolInjections``
+    :340-455 / elfUtils.py:105-176 rolled into one table, since TPU
+    "sections" already are named leaves);
+  * injection-time histogram (``pcStats`` :216-230, cycle-count histogram --
+    text, no matplotlib dependency).
+
+CLI (mirroring ``jsonParser.py logs/ -p | -k fileB``)::
+
+    python -m coast_tpu.analysis run.json            # summarize one file
+    python -m coast_tpu.analysis logs/               # summarize a directory
+    python -m coast_tpu.analysis a.json -k b.json    # compare A vs B (MWTF)
+    python -m coast_tpu.analysis run.json -p         # + per-section table
+    python -m coast_tpu.analysis run.json -c         # + cycle histogram
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Outcome classes, matching coast_tpu.inject.classify codes / CLASS_NAMES.
+_CLASSES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
+            "invalid")
+
+
+def classify_run(run: Dict[str, object]) -> str:
+    """Reconstruct the outcome class of one logged run.
+
+    Dispatch on the result sub-dict's discriminating keys, exactly the
+    ``InjectionLog.FromDict`` scheme (supportClasses.py:355-389): ``core`` ->
+    RunResult, ``timeout`` -> TimeoutResult, ``message`` -> Abort-like,
+    ``invalid`` -> InvalidResult.
+    """
+    res = run.get("result") or {}
+    if "invalid" in res:
+        return "invalid"
+    if "timeout" in res:
+        return "due_timeout"
+    if "message" in res:
+        return "due_abort"
+    if "core" in res:
+        errors = int(res.get("errors", 0))
+        faults = int(res.get("faults", 0))
+        if errors > 0:
+            return "sdc"
+        if faults > 0:
+            return "corrected"
+        return "success"
+    return "invalid"
+
+
+@dataclasses.dataclass
+class Summary:
+    """One file/dir's aggregate, the ``summarizeRuns`` output row."""
+
+    name: str
+    n: int
+    counts: Dict[str, int]
+    seconds: float
+    mean_steps: float            # mean guest runtime T over completed runs
+
+    @property
+    def due(self) -> int:
+        # Aborts also count into the DUE/timeout bucket in the reference's
+        # summary (jsonParser.py:165-172).
+        return self.counts["due_abort"] + self.counts["due_timeout"]
+
+    @property
+    def error_rate(self) -> float:
+        return self.counts["sdc"] / self.n if self.n else 0.0
+
+    def pct(self, cls: str) -> float:
+        return 100.0 * self.counts[cls] / self.n if self.n else 0.0
+
+    def seconds_per_injection(self) -> float:
+        # summarizeTiming (jsonParser.py:204-213).
+        return self.seconds / self.n if self.n else 0.0
+
+    def format(self) -> str:
+        lines = [f"=== {self.name}: {self.n} injections ==="]
+        for cls in _CLASSES:
+            lines.append(f"  {cls:<12} {self.counts[cls]:>8}  "
+                         f"({self.pct(cls):6.2f}%)")
+        lines.append(f"  {'due (total)':<12} {self.due:>8}  "
+                     f"({100.0 * self.due / self.n if self.n else 0.0:6.2f}%)")
+        lines.append(f"  error rate   {self.error_rate:.6f}")
+        lines.append(f"  mean runtime {self.mean_steps:.1f} steps")
+        if self.seconds:
+            lines.append(
+                f"  {self.seconds_per_injection() * 1e6:.2f} usec per "
+                f"injection ({self.n / self.seconds:.1f} injections/sec)")
+        return "\n".join(lines)
+
+
+def read_json_file(path: str) -> Dict[str, object]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "runs" not in doc:
+        raise ValueError(f"{path}: not a coast_tpu campaign log")
+    return doc
+
+
+def _iter_docs(path: str) -> Iterable[Tuple[str, Dict[str, object]]]:
+    """Yield (name, doc) campaign logs under ``path``.
+
+    A directory is scanned leniently: stray .json files that are not
+    campaign logs are skipped with a warning (a log dir often accumulates
+    other tooling's files).  An explicitly named file is strict.
+    """
+    if os.path.isdir(path):
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith(".json"):
+                continue
+            try:
+                yield fname, read_json_file(os.path.join(path, fname))
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"warning: skipping {fname}: {e}", file=sys.stderr)
+    else:
+        yield os.path.basename(path), read_json_file(path)
+
+
+def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
+    counts = {cls: 0 for cls in _CLASSES}
+    n = 0
+    seconds = 0.0
+    step_sum = 0
+    step_n = 0
+    for doc in docs:
+        runs: List[Dict[str, object]] = doc["runs"]  # type: ignore
+        for run in runs:
+            cls = classify_run(run)
+            counts[cls] += 1
+            n += 1
+            res = run.get("result") or {}
+            if "core" in res:
+                step_sum += int(res.get("runtime", 0))
+                step_n += 1
+        summary = doc.get("summary") or {}
+        seconds += float(summary.get("seconds", 0.0))
+    return Summary(name=name, n=n, counts=counts, seconds=seconds,
+                   mean_steps=step_sum / step_n if step_n else 0.0)
+
+
+def summarize_path(path: str) -> Summary:
+    return summarize_runs(os.path.basename(path.rstrip("/")) or path,
+                          (doc for _, doc in _iter_docs(path)))
+
+
+# -- A-vs-B comparison (compareRuns, jsonParser.py:458-506) ------------------
+
+def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
+    """Protection-cost metrics of ``new`` relative to ``base``.
+
+    ``mwtf`` is the Mean-Work-To-Failure *ratio* of jsonParser.py:473:
+    (error-rate improvement) / (runtime slowdown).  >1 means the protection
+    buys more reliability than it costs in time.
+
+    The runtime-slowdown denominator: the reference measures guest runtime
+    of the protected binary.  Here both programs scan the same step count
+    by construction (``steps_x`` is ~1); the replication cost (N lanes +
+    voters) lands in wall-clock per injection, so ``runtime_x`` prefers the
+    seconds-per-injection ratio and falls back to the step ratio when a
+    summary carries no timing.
+    """
+    def _ratio(a: float, b: float) -> float:
+        if b == 0.0:
+            return float("inf") if a > 0 else 1.0
+        return a / b
+
+    steps_x = _ratio(new.mean_steps, base.mean_steps)
+    if base.seconds and new.seconds:
+        runtime_x = _ratio(new.seconds_per_injection(),
+                           base.seconds_per_injection())
+    else:
+        runtime_x = steps_x
+    error_rate_x = _ratio(new.error_rate, base.error_rate)
+    improvement = _ratio(base.error_rate, new.error_rate)
+    mwtf = improvement / runtime_x if runtime_x > 0 else float("inf")
+    return {
+        "runtime_x": runtime_x,
+        "steps_x": steps_x,
+        "error_rate_x": error_rate_x,
+        "error_improvement_x": improvement,
+        "mwtf": mwtf,
+    }
+
+
+def format_comparison(base: Summary, new: Summary) -> str:
+    cmp = compare_runs(base, new)
+    lines = [f"=== {base.name} (base)  vs  {new.name} ===",
+             base.format(), new.format(), "--- comparison ---"]
+    lines.append(f"  runtime x          {cmp['runtime_x']:.3f} "
+                 f"(steps x {cmp['steps_x']:.3f})")
+    lines.append(f"  error rate x       {cmp['error_rate_x']:.4f}")
+    lines.append(f"  error improvement  {cmp['error_improvement_x']:.2f}x")
+    lines.append(f"  MWTF               {cmp['mwtf']:.2f}")
+    return "\n".join(lines)
+
+
+# -- per-section attribution (per-register counts :259-287 + per-symbol
+#    examineSymbolInjections :340-455) ---------------------------------------
+
+def section_stats(docs: Iterable[Dict[str, object]]
+                  ) -> Dict[str, Dict[str, int]]:
+    """symbol -> {class -> count, 'injections' -> n}.
+
+    On TPU the injected "section"/"symbol" is the state leaf recorded in each
+    run's ``symbol`` key (fallback: parse the ``name`` field's ``sym[lane``
+    shape), so register-style and symbol-style attribution coincide.
+    """
+    table: Dict[str, Dict[str, int]] = {}
+    for doc in docs:
+        for run in doc["runs"]:  # type: ignore
+            sym = run.get("symbol")
+            if not sym:
+                sym = str(run.get("name", "?")).split("[", 1)[0]
+            row = table.setdefault(
+                sym, {**{cls: 0 for cls in _CLASSES}, "injections": 0})
+            row["injections"] += 1
+            row[classify_run(run)] += 1
+    return table
+
+
+def format_section_stats(table: Dict[str, Dict[str, int]]) -> str:
+    lines = ["--- per-section attribution ---",
+             f"  {'symbol':<20} {'inj':>7} {'sdc':>6} {'corr':>6} "
+             f"{'due':>6} {'inv':>5}  sdc%"]
+    for sym in sorted(table, key=lambda s: -table[s]["sdc"]):
+        row = table[sym]
+        due = row["due_abort"] + row["due_timeout"]
+        pct = 100.0 * row["sdc"] / row["injections"] if row["injections"] else 0
+        lines.append(f"  {sym:<20} {row['injections']:>7} {row['sdc']:>6} "
+                     f"{row['corrected']:>6} {due:>6} {row['invalid']:>5}  "
+                     f"{pct:5.1f}%")
+    return "\n".join(lines)
+
+
+# -- injection-time histogram (pcStats :216-230) -----------------------------
+
+def cycle_histogram(docs: Iterable[Dict[str, object]],
+                    bins: int = 20) -> List[Tuple[int, int, int]]:
+    """[(lo, hi, count)] over the injection step index ('cycles' key)."""
+    cycles = [int(run.get("cycles", 0))
+              for doc in docs for run in doc["runs"]]  # type: ignore
+    if not cycles:
+        return []
+    lo, hi = min(cycles), max(cycles)
+    width = max(1, (hi - lo + bins) // bins)
+    counts = [0] * bins
+    for c in cycles:
+        counts[min((c - lo) // width, bins - 1)] += 1
+    return [(lo + i * width, lo + (i + 1) * width - 1, counts[i])
+            for i in range(bins)]
+
+
+def format_cycle_histogram(hist: List[Tuple[int, int, int]]) -> str:
+    if not hist:
+        return "--- cycle histogram: no runs ---"
+    peak = max(c for _, _, c in hist) or 1
+    lines = ["--- injection-step histogram ---"]
+    for lo, hi, c in hist:
+        bar = "#" * int(40 * c / peak)
+        lines.append(f"  [{lo:>6}-{hi:>6}] {c:>7} {bar}")
+    return "\n".join(lines)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    paths: List[str] = []
+    compare_path: Optional[str] = None
+    per_section = False
+    histogram = False
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "-k":
+            i += 1
+            if i >= len(argv):
+                print("ERROR: -k needs a file", file=sys.stderr)
+                return 2
+            compare_path = argv[i]
+        elif arg == "-p":
+            per_section = True
+        elif arg == "-c":
+            histogram = True
+        elif arg.startswith("-"):
+            print(f"ERROR: unknown flag {arg}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(arg)
+        i += 1
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
+
+    def _load(path: str):
+        try:
+            return [doc for _, doc in _iter_docs(path)]
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"ERROR: {path}: {e}", file=sys.stderr)
+            return None
+
+    compare_summary: Optional[Summary] = None
+    if compare_path is not None:
+        cmp_docs = _load(compare_path)
+        if cmp_docs is None:
+            return 1
+        compare_summary = summarize_runs(
+            os.path.basename(compare_path.rstrip("/")) or compare_path,
+            cmp_docs)
+
+    for path in paths:
+        docs = _load(path)
+        if docs is None:
+            return 1
+        base = summarize_runs(
+            os.path.basename(path.rstrip("/")) or path, docs)
+        if compare_summary is not None:
+            print(format_comparison(base, compare_summary))
+        else:
+            print(base.format())
+        if per_section:
+            print(format_section_stats(section_stats(docs)))
+        if histogram:
+            print(format_cycle_histogram(cycle_histogram(docs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,8 @@ def to_injection_logs(res: CampaignResult,
             "cycles": int(sched.t[i]),     # step index = cycle analogue
             "PC": int(sched.t[i]),
             "name": f"{sec.name}[lane {int(sched.lane[i])}]^bit{int(sched.bit[i])}",
+            "symbol": sec.name,            # clean key for per-symbol
+                                           # attribution (elfUtils.py:105-176)
             "result": _result_dict(int(res.codes[i]), int(res.errors[i]),
                                    int(res.corrected[i]), int(res.steps[i]), ts),
             "cacheInfo": None,

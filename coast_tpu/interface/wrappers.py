@@ -37,12 +37,25 @@ def protected_lib(fn: Callable, num_clones: int = 3) -> Callable:
     """Wrap ``fn(*args) -> pytree``: unreplicated signature, replicated
     body, boundary vote.  Returns ``(voted_out, miscompare)`` where
     miscompare is a scalar bool (any lane disagreed) -- the caller's DWC
-    error-block hook / TMR correction count source."""
+    error-block hook / TMR correction count source.
+
+    The redundancy is over *replicated argument copies*: each array
+    argument is broadcast to N lane copies and the body is vmapped over the
+    lane axis, so every lane computes from its own independently
+    corruptible data (exactly how cloned globals occupy distinct addresses
+    in the reference).  A fault model must flip bits in a lane's argument
+    copy (or in per-lane intermediate state) for lanes to diverge --
+    vmapping a closure over ignored lane indices would let XLA compute the
+    body once and broadcast, yielding zero redundancy (the de-duplication
+    hazard of SURVEY.md §7)."""
     if num_clones < 2:
         raise ValueError("protected_lib needs num_clones >= 2")
 
     def wrapper(*args):
-        lanes = jax.vmap(lambda _: fn(*args))(jnp.arange(num_clones))
+        laned = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x), (num_clones,) + jnp.shape(x)), args)
+        lanes = jax.vmap(lambda lane_args: fn(*lane_args))(laned)
         flat, tree = jax.tree.flatten(lanes)
         mis = jnp.bool_(False)
         voted = []
@@ -69,11 +82,13 @@ def replicated_return(fn: Callable, num_clones: int = 3,
         for i, a in enumerate(args):
             if i in no_xmr_args:
                 continue
-            lanes = jax.tree.leaves(jax.tree.map(lambda x: jnp.shape(x)[0], a))
-            if any(l != num_clones for l in lanes):
+            shapes = [jnp.shape(x) for x in jax.tree.leaves(a)]
+            bad = [s for s in shapes if len(s) == 0 or s[0] != num_clones]
+            if bad:
                 raise ValueError(
-                    f"{wrapper.__name__}: argument {i} has lane axis "
-                    f"{lanes}, expected {num_clones} replicas")
+                    f"{wrapper.__name__}: argument {i} has leaf shape(s) "
+                    f"{bad} without a leading lane axis of "
+                    f"{num_clones} replicas")
         return jax.vmap(fn, in_axes=in_axes)(*args)
 
     wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}.RR"

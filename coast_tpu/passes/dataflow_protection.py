@@ -143,6 +143,12 @@ class ProtectedProgram:
         self._cfcss_init = None
         self._cfcss_step = None
         self.cfcss_tables = None
+        if cfg.cfcss:
+            # -CFCSS stacking requested in the config itself (opt -TMR
+            # -CFCSS runs both passes over one module); lazy import breaks
+            # the passes.cfcss -> dataflow_protection import cycle.
+            from coast_tpu.passes.cfcss import apply_cfcss
+            apply_cfcss(self)
 
     # -- CFCSS stacking (passes.cfcss) --------------------------------------
     def install_cfcss(self, init_fn, step_fn, tables) -> None:

@@ -1,0 +1,140 @@
+"""Analysis-layer tests: the jsonParser.py equivalent (SURVEY.md §2.2 #22).
+
+Covers: run re-classification from logged JSON (FromDict dispatch parity),
+summaries, the MWTF comparison (jsonParser.py:458-506), per-section
+attribution, the cycle histogram, and the CLI.
+"""
+
+import json
+
+import pytest
+
+from coast_tpu import TMR, unprotected
+from coast_tpu.analysis import json_parser as jp
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.logs import write_json
+from coast_tpu.models import mm
+
+N = 300
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def log_files(region, tmp_path_factory):
+    d = tmp_path_factory.mktemp("logs")
+    paths = {}
+    for name, prog in [("none", unprotected(region)), ("TMR", TMR(region))]:
+        runner = CampaignRunner(prog, strategy_name=name)
+        res = runner.run(N, seed=11, batch_size=150)
+        path = d / f"{name}.json"
+        write_json(res, runner.mmap, str(path))
+        paths[name] = (str(path), res)
+    return paths
+
+
+def test_classify_run_parity(log_files):
+    """Re-classifying each logged run from its result dict must reproduce
+    the device-side class code (the FromDict scheme round-trips)."""
+    for name, (path, res) in log_files.items():
+        doc = jp.read_json_file(path)
+        for i, run in enumerate(doc["runs"]):
+            assert jp.classify_run(run) == cls.CLASS_NAMES[int(res.codes[i])]
+
+
+def test_summarize_matches_counts(log_files):
+    for name, (path, res) in log_files.items():
+        s = jp.summarize_path(path)
+        assert s.n == N
+        for c in jp._CLASSES:
+            assert s.counts[c] == res.counts[c]
+        assert s.due == res.counts["due_abort"] + res.counts["due_timeout"]
+        assert s.seconds_per_injection() > 0
+
+
+def test_summarize_directory(log_files):
+    import os
+    d = os.path.dirname(log_files["TMR"][0])
+    s = jp.summarize_path(d)
+    assert s.n == 2 * N
+
+
+def test_compare_runs_mwtf(log_files):
+    base = jp.summarize_path(log_files["none"][0])
+    new = jp.summarize_path(log_files["TMR"][0])
+    cmp = jp.compare_runs(base, new)
+    # Both programs scan the same step count by construction; the lane
+    # cost lands in wall-clock (runtime_x), which timing noise can wiggle.
+    assert cmp["steps_x"] == pytest.approx(1.0, abs=0.05)
+    assert cmp["runtime_x"] > 0
+    # TMR buys a much lower error rate; MWTF must show a net win.
+    assert cmp["error_rate_x"] < 1.0
+    assert cmp["error_improvement_x"] > 1.0
+    assert cmp["mwtf"] > 1.0
+
+
+def test_compare_zero_error_base():
+    a = jp.Summary("a", 10, {c: 0 for c in jp._CLASSES}, 1.0, 100.0)
+    b = jp.Summary("b", 10, {c: 0 for c in jp._CLASSES}, 1.0, 100.0)
+    cmp = jp.compare_runs(a, b)
+    assert cmp["mwtf"] == 1.0                      # 0/0 -> neutral
+
+
+def test_section_stats(log_files):
+    path, res = log_files["none"]
+    doc = jp.read_json_file(path)
+    table = jp.section_stats([doc])
+    assert sum(r["injections"] for r in table.values()) == N
+    # every injected symbol is a real region leaf
+    leaf_names = set(mm.make_region().spec)
+    assert set(table) <= leaf_names
+    text = jp.format_section_stats(table)
+    assert "per-section attribution" in text
+
+
+def test_cycle_histogram(log_files):
+    doc = jp.read_json_file(log_files["TMR"][0])
+    hist = jp.cycle_histogram([doc], bins=10)
+    assert sum(c for _, _, c in hist) == N
+    assert jp.format_cycle_histogram(hist).count("\n") == 10
+
+
+def test_cli_summary_and_compare(log_files, capsys):
+    assert jp.main([log_files["none"][0]]) == 0
+    out = capsys.readouterr().out
+    assert "injections" in out and "error rate" in out
+
+    assert jp.main([log_files["none"][0], "-k", log_files["TMR"][0],
+                    "-p", "-c"]) == 0
+    out = capsys.readouterr().out
+    assert "MWTF" in out
+    assert "per-section attribution" in out
+    assert "histogram" in out
+
+
+def test_cli_bad_args(capsys):
+    assert jp.main([]) == 2
+    assert jp.main(["-x"]) == 2
+    assert jp.main(["a.json", "-k"]) == 2
+
+
+def test_cli_missing_file_clean_error(capsys):
+    assert jp.main(["/nonexistent/typo.json"]) == 1
+    assert "ERROR" in capsys.readouterr().err
+
+
+def test_cli_skips_stray_json_in_dir(log_files, capsys, tmp_path):
+    import shutil
+    d = tmp_path / "logs"
+    d.mkdir()
+    shutil.copy(log_files["TMR"][0], d / "tmr.json")
+    (d / "config.json").write_text('{"not": "a campaign log"}')
+    (d / "broken.json").write_text("{nope")
+    assert jp.main([str(d)]) == 0
+    cap = capsys.readouterr()
+    assert f"{N} injections" in cap.out
+    assert cap.err.count("skipping") == 2

@@ -116,10 +116,11 @@ def test_injection_log_schema(region, tmp_path, campaigns):
     logs = to_injection_logs(res, mmap)
     assert len(logs) == N
     for log in logs[:20]:
-        # keys of InjectionLog.getDict (supportClasses.py:338-353)
+        # keys of InjectionLog.getDict (supportClasses.py:338-353), plus
+        # the extra "symbol" attribution key
         assert set(log) == {"timestamp", "number", "section", "oldValue",
                             "newValue", "address", "sleepTime", "cycles",
-                            "PC", "name", "result", "cacheInfo"}
+                            "PC", "name", "result", "cacheInfo", "symbol"}
         # result discriminating keys match FromDict dispatch (:355-389)
         r = log["result"]
         assert any(k in r for k in ("core", "timeout", "message", "invalid"))

@@ -69,6 +69,25 @@ def test_protected_lib_votes_and_reports():
     assert lib.__name__ == "body_COAST_WRAPPER"
 
 
+def test_protected_lib_body_runs_per_lane():
+    """The body must be batched over real per-lane argument copies, not
+    computed once and broadcast (the XLA de-duplication hazard): body ops
+    must appear at lane-batched shapes in the jaxpr."""
+    def body(x):
+        return x * 2 + 1
+
+    lib = protected_lib(body, num_clones=3)
+    s = str(jax.make_jaxpr(lib)(jnp.arange(4)))
+    mul_lines = [ln for ln in s.splitlines() if " mul " in ln]
+    assert mul_lines and all("i32[3,4]" in ln for ln in mul_lines)
+
+
+def test_replicated_return_scalar_arg_error():
+    rr = replicated_return(lambda x: x, num_clones=3)
+    with pytest.raises(ValueError, match="lane axis"):
+        rr(jnp.float32(1.0))
+
+
 def test_replicated_return_per_lane():
     def body(x, shared):
         return x + shared
@@ -113,6 +132,20 @@ def test_cli_forced_injection_tmr_corrects(capsys):
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert rc == 0
     assert " E: 0 " in out and " F: 0 " not in out
+
+
+def test_cli_inject_range_validation(capsys):
+    # DWC has lanes 0-1; lane 2 must be rejected, not clamped elsewhere.
+    assert opt_main(["-DWC", "-inject=results:2:0:20:5",
+                     "matrixMultiply"]) == 2
+    assert "lane 2 out of range" in capsys.readouterr().err
+    # bit 40 would be a silent shift-to-zero no-op.
+    assert opt_main(["-TMR", "-inject=results:0:0:40:5",
+                     "matrixMultiply"]) == 2
+    assert "bit 40 out of range" in capsys.readouterr().err
+    assert opt_main(["-TMR", "-inject=results:0:9999:3:5",
+                     "matrixMultiply"]) == 2
+    assert "word 9999 out of range" in capsys.readouterr().err
 
 
 def test_cli_scope_rejection(capsys):
