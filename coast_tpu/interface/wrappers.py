@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from coast_tpu.ops import voters
 
 
-def protected_lib(fn: Callable, num_clones: int = 3) -> Callable:
+def protected_lib(fn: Callable, num_clones: int = 3,
+                  static_argnums: Sequence[int] = ()) -> Callable:
     """Wrap ``fn(*args) -> pytree``: unreplicated signature, replicated
     body, boundary vote.  Returns ``(voted_out, miscompare)`` where
     miscompare is a scalar bool (any lane disagreed) -- the caller's DWC
@@ -47,15 +48,29 @@ def protected_lib(fn: Callable, num_clones: int = 3) -> Callable:
     copy (or in per-lane intermediate state) for lanes to diverge --
     vmapping a closure over ignored lane indices would let XLA compute the
     body once and broadcast, yielding zero redundancy (the de-duplication
-    hazard of SURVEY.md §7)."""
+    hazard of SURVEY.md §7).
+
+    ``static_argnums`` names positions that stay concrete Python values
+    (axis numbers, shape parameters): they are passed through unreplicated
+    and untraced, like non-pointer immediate arguments the reference leaves
+    unchanged when it rewrites the signature."""
     if num_clones < 2:
         raise ValueError("protected_lib needs num_clones >= 2")
+    static_set = frozenset(static_argnums)
 
     def wrapper(*args):
+        dyn = [a for i, a in enumerate(args) if i not in static_set]
         laned = jax.tree.map(
             lambda x: jnp.broadcast_to(
-                jnp.asarray(x), (num_clones,) + jnp.shape(x)), args)
-        lanes = jax.vmap(lambda lane_args: fn(*lane_args))(laned)
+                jnp.asarray(x), (num_clones,) + jnp.shape(x)), tuple(dyn))
+
+        def one_lane(lane_args):
+            it = iter(lane_args)
+            full = [args[i] if i in static_set else next(it)
+                    for i in range(len(args))]
+            return fn(*full)
+
+        lanes = jax.vmap(one_lane)(laned)
         flat, tree = jax.tree.flatten(lanes)
         mis = jnp.bool_(False)
         voted = []

@@ -64,6 +64,12 @@ class LeafSpec:
     # Opt this leaf out of SoR verification, mirroring the parameterized
     # ``no-verify-<glbl>`` annotation (interface.cpp:364-532).
     no_verify: bool = False
+    # Marks call-stack / return-address state: the target of the
+    # experimental ``-protectStack`` voting on llvm.returnaddress copies
+    # (insertStackProtection, synchronization.cpp:1579-1812).  When
+    # ProtectionConfig.protect_stack is set these leaves are voted every
+    # step regardless of the per-kind sync flags.
+    stack: bool = False
 
     def __post_init__(self):
         if self.kind not in _VALID_KINDS:

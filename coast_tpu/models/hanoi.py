@@ -143,12 +143,14 @@ def make_region() -> Region:
         nominal_steps=NOMINAL,
         max_steps=3 * NOMINAL,
         spec={
-            "st_num": LeafSpec(KIND_MEM),
-            "st_f": LeafSpec(KIND_MEM),
-            "st_t": LeafSpec(KIND_MEM),
-            "st_a": LeafSpec(KIND_MEM),
-            "st_stage": LeafSpec(KIND_MEM),
-            "sp": LeafSpec(KIND_CTRL),
+            # The frame stack is the region's call stack: the target of
+            # -protectStack (stackProtect.c / stackAttack.c scenarios).
+            "st_num": LeafSpec(KIND_MEM, stack=True),
+            "st_f": LeafSpec(KIND_MEM, stack=True),
+            "st_t": LeafSpec(KIND_MEM, stack=True),
+            "st_a": LeafSpec(KIND_MEM, stack=True),
+            "st_stage": LeafSpec(KIND_MEM, stack=True),
+            "sp": LeafSpec(KIND_CTRL, stack=True),
             "disk_pos": LeafSpec(KIND_MEM),
             "moves": LeafSpec(KIND_REG),
         },

@@ -34,13 +34,20 @@ _BOOL_FLAGS = {
     "storeDataSync", "countErrors", "reportErrors", "countSyncs",
     "i", "s", "verbose", "dumpModule", "noMain", "noCloneOpsCheck",
     "protectStack",
+    # Utility passes (SURVEY.md §2.1 #6-#8), stackable with any strategy:
+    # -DebugStatements (block trace), -SmallProfile (+ -noPrint), -ExitMarker.
+    "DebugStatements", "SmallProfile", "noPrint", "ExitMarker",
 }
 _LIST_FLAGS = {
     "ignoreFns", "ignoreGlbls", "skipLibCalls", "replicateFnCalls",
     "isrFunctions", "cloneFns", "cloneGlbls", "cloneReturn",
     "cloneAfterCall", "protectedLibFn", "runtimeInitGlobals",
+    "fnPrintList",  # -DebugStatements block-name filter
 }
-_STR_FLAGS = {"configFile", "inject"}
+# List flags that feed the scope config (ScopeConfig.merge_cl); fnPrintList
+# is instrumentation-only.
+_SCOPE_LIST_FLAGS = _LIST_FLAGS - {"fnPrintList"}
+_STR_FLAGS = {"configFile", "inject", "printFnName"}
 
 
 class UsageError(Exception):
@@ -135,7 +142,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         scope = parse_config_file(flags.get("configFile"),
                                   required="configFile" in flags)
-        scope.merge_cl({k: v for k, v in flags.items() if k in _LIST_FLAGS})
+        scope.merge_cl({k: v for k, v in flags.items()
+                        if k in _SCOPE_LIST_FLAGS})
     except ConfigError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
@@ -155,6 +163,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     overrides["count_syncs"] = bool(flags.get("countSyncs"))
     overrides["segmented"] = bool(flags.get("s"))
     overrides["cfcss"] = bool(flags.get("CFCSS"))
+    overrides["protect_stack"] = bool(flags.get("protectStack"))
 
     strategy = strategies[0] if strategies else None
     try:
@@ -194,8 +203,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"ERROR: {e}", file=sys.stderr)
             return 2
 
-    rec = jax.jit(prog.run)(fault) if fault is not None \
-        else jax.jit(prog.run)()
+    if "printFnName" in flags:
+        # Accepted for CLI compatibility (smallProfile.cpp:26); the TPU
+        # target always prints host-side, there is no guest print symbol to
+        # redirect.
+        print("WARNING: -printFnName has no effect on the TPU target "
+              "(profile stats print host-side)", file=sys.stderr)
+    want_trace = bool(flags.get("DebugStatements")
+                      or (flags.get("SmallProfile")
+                          and not flags.get("noPrint")))
+    want_state = bool(flags.get("ExitMarker"))
+    runner = lambda f: prog.run(f, trace=want_trace, return_state=want_state)
+    rec = jax.jit(runner)(fault) if fault is not None \
+        else jax.jit(lambda: runner(None))()
+
+    if want_trace or want_state:
+        from coast_tpu.passes import instrument
+        if flags.get("DebugStatements"):
+            for line in instrument.format_trace(
+                    prog, rec, tuple(flags.get("fnPrintList", ()))):
+                print(line)
+        if flags.get("SmallProfile") and not flags.get("noPrint"):
+            # PRINT_PROFILE_STATS before main returns
+            # (insertProfilePrintFunction, smallProfile.cpp:184-253).
+            for line in instrument.format_profile_stats(
+                    instrument.profile_counts(prog, rec)):
+                print(line)
+        if want_state:
+            digest = instrument.state_digest(rec["final_state"])
+            print("EXIT_MARKER: " + " ".join(
+                f"{k}={v:#010x}" for k, v in digest.items()))
 
     errors = int(rec["errors"])
     if bool(rec["dwc_fault"]):

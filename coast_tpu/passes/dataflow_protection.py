@@ -71,6 +71,11 @@ class ProtectionConfig:
     count_syncs: bool = False
     # -i (interleave, default) vs -s (segmented) replica scheduling.
     segmented: bool = False
+    # -protectStack: vote the region's call-stack leaves (LeafSpec.stack)
+    # every step, the analogue of saving llvm.returnaddress copies at entry
+    # and voting them before returns (insertStackProtection,
+    # synchronization.cpp:1579-1812).
+    protect_stack: bool = False
     # Scope overrides, the -ignoreGlbls / -cloneGlbls CL lists
     # (interface.cpp:82-164); highest priority, above region annotations.
     ignore_globals: Tuple[str, ...] = ()
@@ -135,6 +140,11 @@ class ProtectedProgram:
                 self.step_sync[name] = not cfg.no_store_data_sync
             else:  # reg: registers are voted only where used by a sync point
                 self.step_sync[name] = False
+            if cfg.protect_stack and spec.stack:
+                # Stack protection is an independent mechanism stacked on
+                # top of the normal sync taxonomy: the saved return-address
+                # copies are voted even when store/ctrl syncs are disabled.
+                self.step_sync[name] = True
         # Injectable memory map order (stable): used by the flipper and by
         # inject.mem.MemoryMap.
         self.leaf_order = [n for n in region.spec if region.spec[n].inject]
@@ -328,32 +338,48 @@ class ProtectedProgram:
                 view[name] = arr[0]
         return view
 
-    def run(self, fault: Optional[Dict[str, jax.Array]] = None
-            ) -> Dict[str, jax.Array]:
+    def run(self, fault: Optional[Dict[str, jax.Array]] = None,
+            trace: bool = False,
+            return_state: bool = False) -> Dict[str, jax.Array]:
         """Run to completion; optionally XOR one bit at step ``fault['t']``.
 
         ``fault`` keys: leaf_id, lane, word, bit, t (int32 scalars).  Returns
         the run record mirroring the guest UART line ``C: E: F: T:``
         (resources/decoder.py:66) plus the DUE flags.
+
+        ``trace=True`` additionally records, per scan step, the block about
+        to execute and whether the run was still live -- the raw material of
+        the debugStatements/smallProfile instrumentation passes
+        (coast_tpu.passes.instrument).  The trace rides out of the scan as
+        two stacked tensors (one host transfer), not per-step host prints.
         """
         pstate, flags = self.init_pstate()
 
         def body(carry, t):
             pstate, flags = carry
+            halted = flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
             if fault is not None:
                 # No injection once halted: the reference's sleep window is
                 # bounded by the measured runtime, so flips always land in a
                 # live guest (threadFunctions.py:451-520); a flip into a
                 # finished/aborted run's frozen image would mis-classify it.
-                halted = flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
                 fire = jnp.logical_and(t == fault["t"],
                                        jnp.logical_not(halted))
                 pstate = self._flip(pstate, self.replicated, fault["leaf_id"],
                                     fault["lane"], fault["word"], fault["bit"],
                                     enable=fire)
-            return self.step(pstate, flags, t), None
+            ys = None
+            if trace:
+                if self.region.graph is not None:
+                    blk = self.region.graph.block_of(self._voted_view(
+                        {k: pstate[k] for k in self.region.spec}))
+                else:
+                    blk = jnp.int32(0)
+                ys = (jnp.asarray(blk, jnp.int32),
+                      jnp.logical_not(halted))
+            return self.step(pstate, flags, t), ys
 
-        (pstate, flags), _ = jax.lax.scan(
+        (pstate, flags), ys = jax.lax.scan(
             body, (pstate, flags),
             jnp.arange(self.region.max_steps, dtype=jnp.int32))
 
@@ -389,7 +415,7 @@ class ProtectedProgram:
                          + jnp.where(reached_call, mis_cnt, 0)}
 
         view = self._voted_view(pstate)
-        return {
+        rec = {
             "errors": self.region.check(view),          # E: SDC count
             "corrected": flags["tmr_cnt"],              # F: TMR corrections
             "steps": flags["steps"],                    # T: runtime
@@ -399,6 +425,14 @@ class ProtectedProgram:
             "cfc_fault": flags["cfc_fault"],
             "output": self.region.output(view),
         }
+        if trace:
+            rec["trace_block"], rec["trace_live"] = ys
+        if return_state:
+            # The voted final memory image -- what a debugger reads at the
+            # EXIT_MARKER breakpoint before main returns (exitMarker.cpp
+            # :120-140); consumed by passes.instrument.run_to_exit_marker.
+            rec["final_state"] = view
+        return rec
 
 
 def protect(region: Region, cfg: ProtectionConfig) -> ProtectedProgram:

@@ -1,0 +1,140 @@
+"""Instrumentation passes: tracing, profiling, and the exit-marker hook.
+
+The reference ships three utility ModulePasses next to the protection engine
+(SURVEY.md §2.1 #6-#8); each gets a TPU-native equivalent here:
+
+  * ``debugStatements`` (projects/debugStatements/debugStatements.cpp) prints
+    ``fn-->bb`` at every basic-block entry via an inserted printf.  Printing
+    from inside a jitted scan would serialise the program on host callbacks,
+    so the TPU form records the per-step (block, live) trace as scan outputs
+    -- one device->host transfer -- and formats the same ``fn-->bb`` lines
+    host-side (:func:`trace_run` / :func:`format_trace`).
+  * ``smallProfile`` (projects/smallProfile/smallProfile.cpp) keeps a global
+    call counter per function and prints ``<name>: <count>`` from a generated
+    ``PRINT_PROFILE_STATS`` before main returns (:103-253).  The region
+    analogue counts executed steps per block -- a histogram of the same
+    trace -- plus a whole-region counter (:func:`profile_run` /
+    :func:`format_profile_stats`).
+  * ``exitMarker`` (projects/exitMarker/exitMarker.cpp:96-140) calls a dummy
+    ``EXIT_MARKER(ret)`` before every return in main so the fault-injection
+    platform can breakpoint the final state.  The campaign analogue is a
+    stable final-memory-image hook: :func:`run_to_exit_marker` returns the
+    voted final state pytree (what GDB would read at that breakpoint)
+    alongside the run record.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from coast_tpu.passes.dataflow_protection import ProtectedProgram
+
+
+def _block_names(prog: ProtectedProgram) -> List[str]:
+    graph = prog.region.graph
+    if graph is None:
+        # Regions without a declared CFG are a single logical block, like a
+        # straight-line function body.
+        return [prog.region.name]
+    return list(graph.names)
+
+
+def trace_run(prog: ProtectedProgram,
+              fault: Optional[Dict[str, jax.Array]] = None
+              ) -> Tuple[Dict[str, np.ndarray], List[str]]:
+    """Run with tracing and return (record, ``fn-->bb`` lines).
+
+    The lines are exactly the debugStatements output shape: one
+    ``<region>--><block>`` per executed step, in execution order
+    (debugStatements.cpp prints name + "-->" + block at each entry).
+    """
+    rec = jax.device_get(jax.jit(
+        lambda f: prog.run(f, trace=True))(fault)
+        if fault is not None else
+        jax.jit(lambda: prog.run(trace=True))())
+    return rec, format_trace(prog, rec)
+
+
+def format_trace(prog: ProtectedProgram, rec: Dict[str, np.ndarray],
+                 fn_print_list: Sequence[str] = ()) -> List[str]:
+    """Trace tensors -> printf lines; ``fn_print_list`` filters by block
+    name, the -fnPrintList CL list (debugStatements.cpp:22)."""
+    names = _block_names(prog)
+    blocks = np.asarray(rec["trace_block"])
+    live = np.asarray(rec["trace_live"])
+    lines = []
+    for blk, ok in zip(blocks, live):
+        if not ok:
+            continue
+        name = names[int(blk)] if 0 <= int(blk) < len(names) else f"bb{blk}"
+        if fn_print_list and name not in fn_print_list:
+            continue
+        lines.append(f"{prog.region.name}-->{name}")
+    return lines
+
+
+def profile_run(prog: ProtectedProgram,
+                fault: Optional[Dict[str, jax.Array]] = None
+                ) -> Tuple[Dict[str, np.ndarray], Dict[str, int]]:
+    """Run with profiling and return (record, counters).
+
+    Counters mirror smallProfile's ``__<fn>_profCnt`` globals
+    (createGlobalCounter, smallProfile.cpp:278-304): one per block (steps
+    executed in that block) plus the whole region under its own name (the
+    'calls to main' counter -- a region is entered once per run, so the
+    value is total live steps, its dynamic instruction count analogue).
+    """
+    rec = jax.device_get(jax.jit(
+        lambda f: prog.run(f, trace=True))(fault)
+        if fault is not None else
+        jax.jit(lambda: prog.run(trace=True))())
+    return rec, profile_counts(prog, rec)
+
+
+def profile_counts(prog: ProtectedProgram,
+                   rec: Dict[str, np.ndarray]) -> Dict[str, int]:
+    names = _block_names(prog)
+    blocks = np.asarray(rec["trace_block"])
+    live = np.asarray(rec["trace_live"])
+    hist = np.bincount(blocks[live], minlength=len(names))
+    counts = {name: int(hist[i]) for i, name in enumerate(names)}
+    counts[prog.region.name] = int(live.sum())
+    return counts
+
+
+def format_profile_stats(counts: Dict[str, int]) -> List[str]:
+    """``PRINT_PROFILE_STATS`` output: ``<name>: <count>`` per counter
+    (insertProfilePrintFunction, smallProfile.cpp:184-253)."""
+    return [f"{name}: {cnt}" for name, cnt in counts.items()]
+
+
+def run_to_exit_marker(prog: ProtectedProgram,
+                       fault: Optional[Dict[str, jax.Array]] = None
+                       ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Run to the EXIT_MARKER breakpoint and return (final_state, record).
+
+    ``final_state`` is the voted view of the final memory image -- per leaf,
+    what the reference's GDB client reads when it hits the EXIT_MARKER
+    breakpoint before main returns (exitMarker.cpp:120-140;
+    resources/benchmarks.py breakpoint table).  One jitted run.
+    """
+    rec = jax.device_get(
+        jax.jit(lambda f: prog.run(f, return_state=True))(fault)
+        if fault is not None else
+        jax.jit(lambda: prog.run(return_state=True))())
+    final_state = rec.pop("final_state")
+    return final_state, rec
+
+
+def state_digest(final_state: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Per-leaf XOR digest of the final image -- the compact form the opt
+    CLI prints under -ExitMarker (stable across runs for a given program,
+    like the mm benchmark's golden XOR convention, tests/mm_common/mm.c:31)."""
+    out = {}
+    for name in sorted(final_state):
+        arr = np.asarray(final_state[name]).astype(np.uint32, copy=False)
+        out[name] = int(np.bitwise_xor.reduce(arr.reshape(-1) & 0xFFFFFFFF))
+    return out

@@ -92,9 +92,8 @@ def test_tmr_cfcss_clean(named_region):
     """CFCSS stacked on TMR must not fire on a fault-free run: every legal
     block transition of every benchmark graph must be in the edge set
     (config 5 of BASELINE.json, stacking per CFCSS.cpp)."""
-    from coast_tpu.passes.cfcss import apply_cfcss
     name, region = named_region
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     rec = jax.jit(prog.run)()
     assert not bool(rec["cfc_fault"]), f"{name}: spurious CFCSS fault"
     assert int(rec["errors"]) == 0

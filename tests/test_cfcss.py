@@ -72,7 +72,7 @@ def _fault(prog, leaf, lane=0, word=0, bit=3, t=5):
 
 
 def test_tmr_cfcss_clean(region):
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     rec = jax.jit(prog.run)()
     assert int(rec["errors"]) == 0
     assert not bool(rec["cfc_fault"])
@@ -80,13 +80,13 @@ def test_tmr_cfcss_clean(region):
 
 
 def test_sig_tracker_corruption_detected(region):
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     rec = jax.jit(prog.run)(_fault(prog, G_LEAF, lane=1, word=0, bit=7, t=4))
     assert bool(rec["cfc_fault"]), "flipped signature tracker must fault"
 
 
 def test_prev_block_corruption_detected(region):
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     rec = jax.jit(prog.run)(_fault(prog, PREV_LEAF, lane=0, word=0, bit=1, t=6))
     # prev=store(2) ^ 2 -> entry(0): next fan-in adjuster lookup goes wrong.
     assert bool(rec["cfc_fault"])
@@ -111,7 +111,7 @@ def test_data_corruption_not_cfc(region):
 
 
 def test_cfcss_leaves_in_memory_map(region):
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     runner = CampaignRunner(prog)
     names = [s.name for s in runner.mmap.sections]
     assert G_LEAF in names and PREV_LEAF in names
@@ -121,7 +121,7 @@ def test_cfcss_leaves_in_memory_map(region):
 def test_campaign_cfcss_sections(region):
     """Campaign restricted to the CFCSS runtime section: every effective hit
     must be detected (DUE) or harmless, never SDC."""
-    prog = apply_cfcss(TMR(region, cfcss=True))
+    prog = TMR(region, cfcss=True)
     res = CampaignRunner(prog, sections=["cfcss"]).run(200, seed=13,
                                                        batch_size=100)
     assert res.counts["due_abort"] > 0
@@ -132,4 +132,4 @@ def test_region_without_graph_rejected():
     r = mm.make_region()
     r.graph = None
     with pytest.raises(ValueError):
-        apply_cfcss(TMR(r, cfcss=True))
+        TMR(r, cfcss=True)

@@ -1,0 +1,153 @@
+"""Instrumentation passes: tracing / profiling / exit marker / stack
+protection (SURVEY.md §2.1 #6-#8 and the -protectStack mechanism of
+synchronization.cpp:1579-1812)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.models import hanoi, mm
+from coast_tpu.passes import instrument
+
+
+@pytest.fixture(scope="module")
+def hanoi_region():
+    return hanoi.make_region()
+
+
+@pytest.fixture(scope="module")
+def mm_region():
+    return mm.make_region()
+
+
+# -- debugStatements (trace) ------------------------------------------------
+
+def test_trace_lines_cover_every_live_step(hanoi_region):
+    prog = TMR(hanoi_region)
+    rec, lines = instrument.trace_run(prog)
+    assert len(lines) == int(rec["steps"])
+    # debugStatements output shape: fn-->bb (debugStatements.cpp:56-58).
+    assert lines[0] == "towersOfHanoi-->towers"
+    assert all(line.startswith("towersOfHanoi-->") for line in lines)
+
+
+def test_trace_filter_mirrors_fnPrintList(hanoi_region):
+    prog = TMR(hanoi_region)
+    rec, _ = instrument.trace_run(prog)
+    only_towers = instrument.format_trace(prog, rec, ("towers",))
+    everything = instrument.format_trace(prog, rec)
+    assert 0 < len(only_towers) <= len(everything)
+    assert set(only_towers) == {"towersOfHanoi-->towers"}
+
+
+def test_trace_region_without_graph():
+    from coast_tpu.ir.region import KIND_REG, LeafSpec, Region
+    region = Region(
+        name="straightline",
+        init=lambda: {"x": jnp.int32(0)},
+        step=lambda s, t: {"x": s["x"] + 1},
+        done=lambda s: s["x"] >= 4,
+        check=lambda s: (s["x"] != 4).astype(jnp.int32),
+        output=lambda s: s["x"].reshape(1).astype(jnp.uint32),
+        nominal_steps=4, max_steps=8,
+        spec={"x": LeafSpec(KIND_REG)})
+    prog = unprotected(region)
+    rec, lines = instrument.trace_run(prog)
+    # A region without a CFG is one logical block named after itself.
+    assert lines == ["straightline-->straightline"] * 4
+
+
+# -- smallProfile (block counters) ------------------------------------------
+
+def test_profile_counts_sum_to_steps(hanoi_region):
+    prog = TMR(hanoi_region)
+    rec, counts = instrument.profile_run(prog)
+    steps = int(rec["steps"])
+    assert counts["towersOfHanoi"] == steps
+    # every live step ran the 'towers' block (done latches on sp==0).
+    assert counts["towers"] == steps
+    assert counts["entry"] == 0
+    stats = instrument.format_profile_stats(counts)
+    assert f"towers: {steps}" in stats
+
+
+def test_profile_counts_frozen_after_abort(hanoi_region):
+    """An aborted (DWC fault) run stops accumulating counters, like a guest
+    that called abort() mid-run."""
+    prog = DWC(hanoi_region)
+    fault = {"leaf_id": jnp.int32(prog.leaf_order.index("disk_pos")),
+             "lane": jnp.int32(1), "word": jnp.int32(0),
+             "bit": jnp.int32(1), "t": jnp.int32(10)}
+    rec, counts = instrument.profile_run(prog, fault)
+    assert bool(rec["dwc_fault"])
+    assert counts["towersOfHanoi"] == int(rec["steps"])
+    assert int(rec["steps"]) < hanoi_region.nominal_steps
+
+
+# -- exitMarker --------------------------------------------------------------
+
+def test_exit_marker_final_state(mm_region):
+    prog = TMR(mm_region)
+    final_state, rec = instrument.run_to_exit_marker(prog)
+    assert int(rec["errors"]) == 0
+    # The final image contains every region leaf, lane-collapsed.
+    assert set(final_state) == set(mm_region.spec)
+    for name, arr in final_state.items():
+        assert arr.shape == jax.eval_shape(mm_region.init)[name].shape
+    digest = instrument.state_digest(final_state)
+    # The results matrix digest is the benchmark's own golden XOR fold of
+    # the output (mm.c:31 checkGolden convention).
+    out_xor = int(np.bitwise_xor.reduce(np.asarray(rec["output"])))
+    assert digest["results"] == out_xor
+
+
+def test_exit_marker_deterministic(mm_region):
+    prog = TMR(mm_region)
+    d1 = instrument.state_digest(instrument.run_to_exit_marker(prog)[0])
+    d2 = instrument.state_digest(instrument.run_to_exit_marker(prog)[0])
+    assert d1 == d2
+
+
+# -- protectStack ------------------------------------------------------------
+
+def _stack_fault(prog, t):
+    return {"leaf_id": jnp.int32(prog.leaf_order.index("st_t")),
+            "lane": jnp.int32(1), "word": jnp.int32(2),
+            "bit": jnp.int32(0), "t": jnp.int32(t)}
+
+
+def test_protect_stack_forces_step_sync(hanoi_region):
+    base = TMR(hanoi_region, no_store_data_sync=True)
+    prot = TMR(hanoi_region, no_store_data_sync=True, protect_stack=True)
+    assert not base.step_sync["st_t"]
+    assert prot.step_sync["st_t"]
+    # Non-stack leaves keep the relaxed sync.
+    assert not prot.step_sync["disk_pos"]
+
+
+def test_protect_stack_detects_early_under_dwc(hanoi_region):
+    """A corrupted frame is caught at the next stack vote (early DUE) rather
+    than surviving until a later sync point -- the reference's motivation:
+    vote the saved return address before using it (stackProtect.c)."""
+    t = 40
+    unprot_cfg = dict(no_store_data_sync=True, no_ctrl_sync=True)
+    plain = DWC(hanoi_region, **unprot_cfg)
+    protd = DWC(hanoi_region, **unprot_cfg, protect_stack=True)
+    rec_plain = jax.jit(plain.run)(_stack_fault(plain, t))
+    rec_prot = jax.jit(protd.run)(_stack_fault(protd, t))
+    assert bool(rec_prot["dwc_fault"])
+    # Early detection freezes the run at the corrupting step.
+    assert int(rec_prot["steps"]) <= t + 1
+    # Without stack protection the divergence runs on (detected later or
+    # never, depending on whether the frame is still live).
+    assert int(rec_plain["steps"]) > int(rec_prot["steps"])
+
+
+def test_protect_stack_corrects_under_tmr(hanoi_region):
+    prog = TMR(hanoi_region, no_store_data_sync=True, protect_stack=True)
+    rec = jax.jit(prog.run)(_stack_fault(prog, 40))
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+    assert int(rec["corrected"]) >= 1

@@ -82,6 +82,19 @@ def test_protected_lib_body_runs_per_lane():
     assert mul_lines and all("i32[3,4]" in ln for ln in mul_lines)
 
 
+def test_protected_lib_static_argnums():
+    """Static Python args (axis numbers, shape params) pass through
+    unreplicated and untraced."""
+    def body(x, axis):
+        return x.sum(axis)
+
+    lib = protected_lib(body, num_clones=3, static_argnums=(1,))
+    out, mis = jax.jit(lib, static_argnums=(1,))(
+        jnp.arange(6).reshape(2, 3), 1)
+    assert (out == jnp.array([3, 12])).all()
+    assert not bool(mis)
+
+
 def test_replicated_return_scalar_arg_error():
     rr = replicated_return(lambda x: x, num_clones=3)
     with pytest.raises(ValueError, match="lane axis"):
