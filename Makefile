@@ -1,0 +1,32 @@
+# Top-level targets mirroring the reference repo Makefile:4-21 and its
+# Travis stages (build / test_fast / test_full / regression_test).
+
+PYTHON ?= python3
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: build test test_fast test_full test_tmr regression_test bench clean
+
+build:
+	$(MAKE) -C coast_tpu/native
+
+test:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/ -x -q
+
+test_fast: build
+	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/fast.yml
+
+test_full: build
+	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/full.yml
+
+test_tmr: build
+	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/full_tmr.yml
+
+regression_test: build
+	$(CPU_ENV) $(PYTHON) unittest/pyDriver.py unittest/cfg/regression.yml
+
+bench: build
+	$(PYTHON) bench.py
+
+clean:
+	$(MAKE) -C coast_tpu/native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -1,0 +1,218 @@
+"""Region fuzzing: the llvm-stress tier (unittest/llvm-stress.py:27-77).
+
+The reference generates random IR modules with ``llvm-stress-7`` and checks
+the protection passes survive compiling them to assembly (no run, no main).
+The TPU analogue generates random *stepped regions* -- random uint32
+dataflow over randomly-kinded state leaves with a loop-carried program
+counter -- and holds a stronger oracle than "it compiled":
+
+  1. every strategy (unprotected / TMR / DWC / TMR+CFCSS / segmented TMR)
+     builds, jit-compiles and runs to completion (the compile-survival bar);
+  2. protection does not change semantics: every strategy's output equals
+     the unprotected output (the tier-1 golden rule applied to random
+     programs);
+  3. a single bit flip in one replica lane under TMR is voted away (the
+     zero-to-aha property holds on arbitrary dataflow, not just the
+     curated benchmarks).
+
+Deterministic per seed, so any failure is replayable:
+``python -m coast_tpu.testing.fuzz -seed 12345 -n 1``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+W = 8           # words per vector leaf (static shapes throughout)
+MAX_OPS = 12    # random ops per step
+
+
+def random_region(seed: int):
+    """Build a random region from a seed.  Mirrors llvm-stress's role:
+    random op mix over random operands, but shaped as a stepped region."""
+    import jax.numpy as jnp
+
+    from coast_tpu.ir.graph import BlockGraph
+    from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                     LeafSpec, Region)
+
+    rng = np.random.RandomState(seed)
+    n_mem = rng.randint(1, 4)
+    n_reg = rng.randint(1, 3)
+    steps = int(rng.randint(8, 33))
+
+    leaves: Dict[str, LeafSpec] = {"pc": LeafSpec(KIND_CTRL)}
+    init_vals: Dict[str, np.ndarray] = {"pc": np.int32(0)}
+    for i in range(n_mem):
+        leaves[f"m{i}"] = LeafSpec(KIND_MEM)
+        init_vals[f"m{i}"] = rng.randint(0, 2**32, W, np.uint32)
+    for i in range(n_reg):
+        leaves[f"r{i}"] = LeafSpec(KIND_REG)
+        init_vals[f"r{i}"] = rng.randint(0, 2**32, W, np.uint32)
+    leaves["ro"] = LeafSpec(KIND_RO)
+    init_vals["ro"] = rng.randint(0, 2**32, W, np.uint32)
+
+    data_leaves = [n for n in leaves if n != "pc"]
+    writable = [n for n in data_leaves if n != "ro"]
+
+    # A random straight-line op list, chosen once at build time (the
+    # program is fixed; the *data* flows through it every step).
+    ops: List[tuple] = []
+    for _ in range(rng.randint(3, MAX_OPS + 1)):
+        kind = rng.choice(["add", "sub", "mul", "xor", "and", "or",
+                           "shl", "shr", "rot", "sel", "gather", "scatter"])
+        dst = rng.choice(writable)
+        srcs = [rng.choice(data_leaves) for _ in range(3)]
+        k = int(rng.randint(0, 32))
+        ops.append((kind, dst, srcs, k))
+
+    def init():
+        return {k: jnp.asarray(v) for k, v in init_vals.items()}
+
+    def step(state, t):
+        s = dict(state)
+        for kind, dst, (a, b, c), k in ops:
+            va, vb, vc = s[a], s[b], s[c]
+            if kind == "add":
+                out = va + vb
+            elif kind == "sub":
+                out = va - vb
+            elif kind == "mul":
+                out = va * vb
+            elif kind == "xor":
+                out = va ^ vb
+            elif kind == "and":
+                out = va & vb
+            elif kind == "or":
+                out = va | vb
+            elif kind == "shl":
+                out = va << np.uint32(k % 31 + 1)
+            elif kind == "shr":
+                out = va >> np.uint32(k % 31 + 1)
+            elif kind == "rot":
+                r = k % 31 + 1
+                out = (va << np.uint32(r)) | (va >> np.uint32(32 - r))
+            elif kind == "sel":
+                out = jnp.where((va & 1) == 1, vb, vc)
+            elif kind == "gather":
+                idx = (jnp.arange(W) + s["pc"] + k) % W
+                out = vb[idx]
+            else:  # scatter
+                slot = (s["pc"] + k) % W
+                out = s[dst].at[slot].set(vb[k % W])
+            s[dst] = out.astype(jnp.uint32)
+        s["pc"] = state["pc"] + 1
+        return s
+
+    def done(state):
+        return state["pc"] >= steps
+
+    def check(state):
+        # The fuzz oracle is cross-strategy output equality (held by the
+        # driver), not an in-region golden value.
+        return jnp.int32(0)
+
+    def output(state):
+        return jnp.concatenate(
+            [state[n].reshape(-1) for n in sorted(data_leaves)]
+            + [state["pc"].reshape(1).astype(jnp.uint32)])
+
+    graph = BlockGraph(
+        names=["entry", "body", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["pc"] >= steps,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name=f"fuzz{seed}",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=steps,
+        max_steps=2 * steps,
+        spec=leaves,
+        default_xmr=True,
+        graph=graph,
+    )
+
+
+def fuzz_one(seed: int) -> None:
+    """Run the full oracle for one seed; raises AssertionError on any
+    divergence."""
+    import jax
+    import jax.numpy as jnp
+
+    from coast_tpu import DWC, TMR, unprotected
+
+    region = random_region(seed)
+    region.validate()
+
+    golden = np.asarray(jax.device_get(
+        jax.jit(unprotected(region).run)()["output"]))
+
+    progs = {
+        "TMR": TMR(region),
+        "DWC": DWC(region),
+        "TMR-s": TMR(region, segmented=True),
+        "TMR+CFCSS": TMR(region, cfcss=True),
+        "TMR-noMem": TMR(region, no_mem_replication=True),
+    }
+    for name, prog in progs.items():
+        rec = jax.device_get(jax.jit(prog.run)())
+        assert bool(rec["done"]), f"seed {seed}: {name} did not terminate"
+        assert not bool(rec["dwc_fault"]), f"seed {seed}: {name} false DWC"
+        assert not bool(rec["cfc_fault"]), f"seed {seed}: {name} false CFC"
+        got = np.asarray(rec["output"])
+        assert (got == golden).all(), (
+            f"seed {seed}: {name} changed semantics "
+            f"(first diff at {int(np.argmax(got != golden))})")
+
+    # Single-lane flip under TMR must be voted away.
+    prog = progs["TMR"]
+    rng = np.random.RandomState(seed ^ 0x5EED)
+    repl = [n for n in prog.leaf_order
+            if n in prog.replicated and prog.replicated[n]]
+    leaf = repl[rng.randint(len(repl))]
+    fault = {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+             "lane": jnp.int32(rng.randint(1, 3)),
+             "word": jnp.int32(rng.randint(W)),
+             "bit": jnp.int32(rng.randint(32)),
+             "t": jnp.int32(rng.randint(region.nominal_steps))}
+    rec = jax.device_get(jax.jit(prog.run)(fault))
+    got = np.asarray(rec["output"])
+    assert (got == golden).all(), (
+        f"seed {seed}: TMR failed to mask a single-lane flip in {leaf}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="random-region fuzzing")
+    parser.add_argument("-n", type=int, default=10, help="number of seeds")
+    parser.add_argument("-seed", type=int, default=0, help="first seed")
+    args = parser.parse_args(argv)
+
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The TPU site hook sets the platform programmatically; env var
+        # alone is not enough (see tests/conftest.py).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    for seed in range(args.seed, args.seed + args.n):
+        try:
+            fuzz_one(seed)
+        except AssertionError as e:
+            print(f"FAILED: {e}")
+            return 1
+        print(f"seed {seed}: ok")
+    print("Success!")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

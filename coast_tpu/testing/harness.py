@@ -1,0 +1,181 @@
+"""Flag-matrix regression harness: the unittest/unittest.py equivalent.
+
+The reference's tier-1 functional tests build every benchmark with every
+``OPT_PASSES`` combo for BOARD=x86 and regex-check its self-check output
+(unittest/unittest.py:28-88; configs unittest/cfg/{fast,full,full_tmr}.yml).
+Here the "build + run" of one combo is one in-process invocation of the opt
+CLI (coast_tpu.opt) -- the jit compile is the build, the CPU backend is the
+x86 board -- so a 17-combo matrix over the registry runs in one python
+process instead of one subprocess per (combo, benchmark).
+
+Config format is the reference's, unchanged:
+
+    benchmarks:
+      - path: matrixMultiply         # registry name, or a suite name
+        re: "Number of errors: 0"    # optional stdout regex oracle
+    OPT_PASSES:
+      - ""
+      - "-DWC"
+      - "-TMR -noMemReplication"
+
+A combo string is split on whitespace and handed to coast_tpu.opt.main
+verbatim.  Exit status must be 0 and the regex (if any) must match stdout,
+else the harness stops with a nonzero exit, exactly like the reference's
+error() (unittest.py:24-26).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+
+class _Colors:
+    HEADER = "\033[95m"
+    OKBLUE = "\033[94m"
+    FAIL = "\033[91m"
+    ENDC = "\033[0m"
+
+
+class HarnessError(Exception):
+    pass
+
+
+def expand_benchmarks(cfg: dict) -> List[Tuple[str, Optional[str]]]:
+    """Resolve cfg benchmark entries to (registry_name, regex) rows.
+
+    ``path`` may name one region or a suite ('chstone' expands to the
+    CHSTONE tuple; 'all' to the whole registry), the analogue of the
+    directory-walk discovery of unittest.py:91-102.
+    """
+    from coast_tpu.models import CHSTONE, REGISTRY
+    rows: List[Tuple[str, Optional[str]]] = []
+    for entry in cfg["benchmarks"]:
+        path = entry["path"]
+        regex = entry.get("re")
+        if path == "all":
+            names = sorted(REGISTRY)
+        elif path == "chstone":
+            names = list(CHSTONE)
+        elif path in REGISTRY:
+            names = [path]
+        else:
+            raise HarnessError(f"No benchmarks found at {path!r}")
+        rows.extend((n, regex) for n in names)
+    return rows
+
+
+def run_combo(bench: str, opt_passes: str) -> Tuple[int, str]:
+    """One (benchmark, OPT_PASSES) cell: returns (exit_status, stdout)."""
+    from coast_tpu.opt import main as opt_main
+    argv = opt_passes.split() + [bench]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = opt_main(argv)
+    return rc, buf.getvalue()
+
+
+def run_config(cfg: dict, quiet: bool = False) -> int:
+    """The unittest.py main loop: every combo x every benchmark.  Returns
+    the number of cells run; raises HarnessError on the first failure."""
+    benches = expand_benchmarks(cfg)
+    cells = 0
+    for opt_pass in cfg["OPT_PASSES"]:
+        if not quiet:
+            print(f"{_Colors.HEADER}OPT_PASSES: {opt_pass}{_Colors.ENDC}")
+        for bench, regex in benches:
+            if not quiet:
+                print(f"  {_Colors.OKBLUE}{bench}{_Colors.ENDC}")
+            rc, out = run_combo(bench, opt_pass)
+            if rc != 0:
+                print(out)
+                raise HarnessError(
+                    f"Could not run {bench} with OPT_PASSES='{opt_pass}' "
+                    f"(exit {rc})")
+            if regex is not None and not re.search(regex, out):
+                print(out)
+                raise HarnessError(
+                    f"Could not match stdout of {bench} using re "
+                    f"expression: {regex}")
+            cells += 1
+    return cells
+
+
+def run_drivers(cfg: dict, quiet: bool = False) -> int:
+    """The pyDriver.py layer (unittest/pyDriver.py:1-88): run specialized
+    drivers over pass combos; each must print 'Success!'.
+
+        drivers:
+          - module: fuzz          # coast_tpu.testing.<module>.main(argv)
+            args: ["-n", "5"]
+    """
+    import importlib
+    ran = 0
+    for drv in cfg.get("drivers", ()):
+        mod = importlib.import_module(f"coast_tpu.testing.{drv['module']}")
+        argv = [str(a) for a in drv.get("args", ())]
+        if not quiet:
+            print(f"{_Colors.HEADER}driver: {drv['module']} "
+                  f"{' '.join(argv)}{_Colors.ENDC}")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = mod.main(argv)
+        out = buf.getvalue()
+        if rc != 0 or not re.search(r"Success!", out):
+            print(out)
+            raise HarnessError(f"driver {drv['module']} failed (exit {rc})")
+        ran += 1
+    return ran
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="yml-driven flag-matrix regression harness")
+    parser.add_argument("config_yml")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The TPU environment's site hook sets the platform
+        # programmatically, so the env var alone is not enough (see
+        # tests/conftest.py); pin before the first backend init.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        with open(args.config_yml) as fh:
+            cfg = yaml.safe_load(fh)
+    except OSError:
+        print(f"!!!! ERROR: Config file {args.config_yml} does not exist.")
+        return 1
+    except yaml.YAMLError as exc:
+        print(f"!!!! ERROR: invalid YAML in {args.config_yml}: {exc}")
+        return 1
+    if not isinstance(cfg, dict):
+        print(f"!!!! ERROR: Config file {args.config_yml} is empty or not "
+              "a mapping.")
+        return 1
+    if "OPT_PASSES" in cfg and "benchmarks" not in cfg:
+        print(f"!!!! ERROR: Config file {args.config_yml} has OPT_PASSES "
+              "but no benchmarks section.")
+        return 1
+
+    try:
+        cells = run_config(cfg, quiet=args.quiet) if "OPT_PASSES" in cfg else 0
+        cells += run_drivers(cfg, quiet=args.quiet)
+    except HarnessError as e:
+        print(f"{_Colors.FAIL}!!!! ERROR: {e}{_Colors.ENDC}")
+        return 1
+    print(f"{cells} cells passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

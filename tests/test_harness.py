@@ -1,0 +1,70 @@
+"""Flag-matrix harness + fuzz tiers (SURVEY.md §4 tiers 1 and 3;
+unittest/unittest.py + llvm-stress.py equivalents)."""
+
+import numpy as np
+import pytest
+
+from coast_tpu.testing import fuzz
+from coast_tpu.testing.harness import (HarnessError, expand_benchmarks,
+                                       run_combo, run_config, run_drivers)
+
+
+def test_fast_matrix():
+    """The fast.yml tier: mm under '', -DWC, -TMR with the stdout oracle."""
+    cfg = {
+        "benchmarks": [{"path": "matrixMultiply", "re": "E: 0"}],
+        "OPT_PASSES": ["", "-DWC", "-TMR"],
+    }
+    assert run_config(cfg, quiet=True) == 3
+
+
+def test_expand_suites():
+    from coast_tpu.models import CHSTONE, REGISTRY
+    rows = expand_benchmarks({"benchmarks": [{"path": "chstone"}]})
+    assert [r[0] for r in rows] == list(CHSTONE)
+    rows = expand_benchmarks({"benchmarks": [{"path": "all"}]})
+    assert len(rows) == len(REGISTRY)
+    with pytest.raises(HarnessError):
+        expand_benchmarks({"benchmarks": [{"path": "noSuchBench"}]})
+
+
+def test_regex_mismatch_fails():
+    cfg = {
+        "benchmarks": [{"path": "crc16", "re": "THIS WILL NOT MATCH"}],
+        "OPT_PASSES": ["-TMR"],
+    }
+    with pytest.raises(HarnessError, match="Could not match"):
+        run_config(cfg, quiet=True)
+
+
+def test_combo_cell_runs_clean():
+    rc, out = run_combo("crc16", "-TMR -noMemReplication")
+    assert rc == 0
+    assert "E: 0" in out
+
+
+def test_driver_tier_runs_fuzz():
+    cfg = {"drivers": [{"module": "fuzz", "args": ["-n", "2", "-seed", "7"]}]}
+    assert run_drivers(cfg, quiet=True) == 1
+
+
+# -- fuzz tier ---------------------------------------------------------------
+
+def test_fuzz_seeds_pass():
+    for seed in range(3):
+        fuzz.fuzz_one(seed)
+
+
+def test_fuzz_deterministic():
+    import jax
+
+    r1 = fuzz.random_region(42)
+    r2 = fuzz.random_region(42)
+    o1 = np.asarray(jax.jit(lambda: r1.output(r1.run_unprotected()))())
+    o2 = np.asarray(jax.jit(lambda: r2.output(r2.run_unprotected()))())
+    assert (o1 == o2).all()
+
+
+def test_fuzz_cli_reports_success(capsys):
+    assert fuzz.main(["-n", "1", "-seed", "3"]) == 0
+    assert "Success!" in capsys.readouterr().out

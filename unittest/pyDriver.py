@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""Driver-of-drivers shim (reference: unittest/pyDriver.py runs specialized
+drivers like llvm-stress over pass combos, regex 'Success!').  The yml
+``drivers:`` section of coast_tpu.testing.harness is the implementation."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from coast_tpu.testing.harness import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or ["unittest/cfg/regression.yml"]))
