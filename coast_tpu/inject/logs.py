@@ -47,18 +47,29 @@ def to_injection_logs(res: CampaignResult,
     sched = res.schedule
     for i in range(res.n):
         sec = secs[int(sched.leaf_id[i])]
+        discarded = int(sched.t[i]) < 0
+        if discarded:
+            # Cache draw outside the program footprint: never fired (the
+            # plugin's invalid-line discard); must not be attributed to a
+            # real section.
+            section, symbol = "cache-invalid", "<invalid-line>"
+            name = f"<invalid-line>^bit{int(sched.bit[i])}"
+        else:
+            section, symbol = sec.kind, sec.name
+            name = (f"{sec.name}[lane {int(sched.lane[i])}]"
+                    f"^bit{int(sched.bit[i])}")
         logs.append({
             "timestamp": ts,
             "number": i,
-            "section": sec.kind,
+            "section": section,
             "address": int(sched.word[i]),
             "oldValue": None,              # values live on-device; the flip
             "newValue": None,              # is XOR(1<<bit), recorded below
             "sleepTime": 0,
             "cycles": int(sched.t[i]),     # step index = cycle analogue
             "PC": int(sched.t[i]),
-            "name": f"{sec.name}[lane {int(sched.lane[i])}]^bit{int(sched.bit[i])}",
-            "symbol": sec.name,            # clean key for per-symbol
+            "name": name,
+            "symbol": symbol,              # clean key for per-symbol
                                            # attribution (elfUtils.py:105-176)
             "result": _result_dict(int(res.codes[i]), int(res.errors[i]),
                                    int(res.corrected[i]), int(res.steps[i]), ts),

@@ -1,0 +1,204 @@
+"""Campaign supervisor CLI: the simulation/platform/supervisor.py surface.
+
+The reference supervisor spawns QEMU + GDB per campaign and drives the
+state machine over sockets (supervisor.py:400-509); here the whole campaign
+is the batched XLA program of :mod:`coast_tpu.inject.campaign`, and this
+module keeps the *interface*: the same section vocabulary, campaign sizing,
+forced-injection debug hook, and JSON logs.
+
+    python -m coast_tpu.inject.supervisor -f matrixMultiply -s memory -t 1000
+    python -m coast_tpu.inject.supervisor -f crc16 -O "-DWC" -s registers -t 500
+    python -m coast_tpu.inject.supervisor -f aes -s dcache -e 10 -l logs/
+
+Section choices (supervisor.py:340) map onto leaf kinds:
+``data/bss/heap/init`` -> written memory leaves, ``rodata`` -> read-only
+leaves, ``memory`` -> both, ``registers`` -> loop-carried reg/ctrl leaves,
+``stack`` -> LeafSpec.stack leaves, ``text``/``icache`` -> control +
+CFCSS-signature state (instruction-fetch corruption manifests as control
+flow), ``dcache``/``l2cache``/``cache`` -> the geometry overlay of
+:mod:`coast_tpu.inject.hierarchy`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+SECTION_CHOICES = ["stack", "text", "rodata", "data", "bss", "heap", "init",
+                   "registers", "memory", "cache", "icache", "dcache",
+                   "l2cache"]
+
+_KIND_SECTIONS = {
+    "memory": ("mem", "ro"),
+    "data": ("mem",),
+    "bss": ("mem",),
+    "heap": ("mem",),
+    "init": ("mem",),
+    "rodata": ("ro",),
+    "registers": ("reg", "ctrl"),
+    "text": ("ctrl", "cfcss"),
+}
+
+
+def parse_command_line(argv: Optional[List[str]] = None):
+    parser = argparse.ArgumentParser(
+        description="Supervisor for batched TPU fault injection")
+    parser.add_argument("--filename", "-f", type=str, required=True,
+                        help="benchmark region to run (registry name)")
+    parser.add_argument("--port-range", "-p", type=int, default=None,
+                        help="accepted for compatibility; the batched "
+                        "campaign needs no ports (scale-out is the mesh "
+                        "batch axis)")
+    parser.add_argument("-t", metavar="N", type=int, default=1,
+                        help="number of injections")
+    parser.add_argument("-e", "--errorCount", metavar="N", type=int,
+                        help="run until N errors seen, then complete the "
+                        "next 1000 injections")
+    parser.add_argument("--section", "-s", type=str, default="memory",
+                        choices=SECTION_CHOICES,
+                        help="memory section to inject faults into")
+    parser.add_argument("--board", "-d", type=str, default="tpu",
+                        choices=["tpu", "cpu", "pynq", "hifive1"],
+                        help="execution backend (cpu = the x86 regression "
+                        "board)")
+    parser.add_argument("--opt-passes", "-O", type=str, default="-TMR",
+                        help="protection to apply (opt CLI flag string); "
+                        "the reference bakes this into the ELF instead")
+    parser.add_argument("--log-dir", "-l", type=str, default=None,
+                        help="directory in which to create the log files")
+    parser.add_argument("--no-logging", "-q", action="store_true",
+                        help="do not produce log files")
+    parser.add_argument("--verbosity", "-v", default="n",
+                        choices=["n", "c", "e", "s", "i", "a"])
+    parser.add_argument("--forceBreak", "-b", metavar="EXPRESSION", type=str,
+                        help="forced injection leaf:lane:word:bit:t "
+                        "(injector.py setBreaking analogue)")
+    parser.add_argument("--breakCount", "-c", metavar="ITERATION", type=int,
+                        default=1, help="how many forced injections to run")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="campaign schedule seed (replayable)")
+    parser.add_argument("--batch-size", type=int, default=4096)
+    args = parser.parse_args(argv)
+
+    if args.board in ("pynq", "hifive1"):
+        print("This board not yet supported in this version", file=sys.stderr)
+        sys.exit(-1)
+    if args.log_dir and not os.path.isdir(args.log_dir):
+        print(f"Error, directory {args.log_dir} does not exist!",
+              file=sys.stderr)
+        sys.exit(-1)
+    return args
+
+
+def build_program(bench: str, opt_passes: str):
+    """Build the protected program from an opt-CLI flag string, using the
+    opt parser itself so flag semantics (and error behavior on typos)
+    cannot drift from `python -m coast_tpu.opt`."""
+    from coast_tpu import DWC, TMR, unprotected
+    from coast_tpu.interface.config import ConfigError
+    from coast_tpu.models import REGISTRY
+    from coast_tpu.opt import UsageError, build_overrides, parse_argv
+    if bench not in REGISTRY:
+        print(f"Error, file {bench} does not exist!", file=sys.stderr)
+        sys.exit(-1)
+    region = REGISTRY[bench]()
+    try:
+        flags, positional = parse_argv(opt_passes.split())
+        if positional:
+            raise UsageError(
+                f"unexpected positional argument(s) in -O: {positional}")
+        if flags.get("i") and flags.get("s"):
+            raise UsageError("-i and -s are mutually exclusive")
+        overrides = build_overrides(flags)
+    except (UsageError, ConfigError) as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        sys.exit(-1)
+    # The supervisor always wants the correction counter (it feeds the
+    # 'faults' column of the logs).
+    overrides["count_errors"] = True
+    if flags.get("TMR"):
+        return TMR(region, **overrides), "TMR"
+    if flags.get("DWC"):
+        return DWC(region, **overrides), "DWC"
+    return unprotected(region, **overrides), "unprotected"
+
+
+def section_filter(prog, section: str):
+    """CLI section choice -> MemoryMap ``sections`` argument (kind names or
+    leaf names), or None for the full map (cache overlays)."""
+    if section in _KIND_SECTIONS:
+        return _KIND_SECTIONS[section]
+    if section == "stack":
+        names = [n for n, s in prog.region.spec.items() if s.stack]
+        if not names:
+            print(f"Error, {prog.region.name} has no stack-class leaves!",
+                  file=sys.stderr)
+            sys.exit(-1)
+        return names
+    # cache sections overlay the full map.
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_command_line(argv)
+
+    if args.board == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu.inject import logs
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.hierarchy import (MemHierarchy,
+                                            generate_cache_schedule)
+
+    prog, strategy = build_program(args.filename, args.opt_passes)
+    runner = CampaignRunner(prog, sections=section_filter(prog, args.section),
+                            strategy_name=strategy)
+    mmap = runner.mmap
+
+    if args.forceBreak:
+        # Forced injection replay (--forceBreak, supervisor.py:357-359;
+        # injector.setBreaking injector.py:59-68): run the named flip
+        # breakCount times.
+        import jax
+        from coast_tpu.opt import UsageError, _parse_inject
+        try:
+            fault = _parse_inject(args.forceBreak, prog)
+        except (UsageError, ValueError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+        for i in range(args.breakCount):
+            rec = jax.jit(prog.run)(fault)
+            print(f"forced injection {i}: E: {int(rec['errors'])} "
+                  f"F: {int(rec['corrected'])} T: {int(rec['steps'])} "
+                  f"dwc={bool(rec['dwc_fault'])} cfc={bool(rec['cfc_fault'])}")
+        return 0
+
+    if args.section in ("cache", "icache", "dcache", "l2cache"):
+        hierarchy = MemHierarchy("tpu")
+        cache_name = None if args.section == "cache" else args.section
+        sched = generate_cache_schedule(
+            mmap, hierarchy, args.t, args.seed,
+            prog.region.nominal_steps, cache_name)
+        res = runner.run_schedule(sched, batch_size=args.batch_size)
+    elif args.errorCount:
+        res = runner.run_until_errors(args.errorCount, seed=args.seed,
+                                      batch_size=args.batch_size)
+    else:
+        res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size)
+
+    print(res.summary())
+    if not args.no_logging:
+        log_dir = args.log_dir or "."
+        path = os.path.join(
+            log_dir,
+            f"{prog.region.name}_{strategy}_{args.section}.json")
+        logs.write_json(res, mmap, path)
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

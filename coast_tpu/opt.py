@@ -111,6 +111,28 @@ def _parse_inject(spec: str, prog) -> Dict[str, object]:
             "bit": jnp.int32(bit), "t": jnp.int32(t)}
 
 
+def build_overrides(flags: Dict[str, object]) -> Dict[str, object]:
+    """Parsed flags -> ProtectionConfig overrides (incl. the scope lists
+    from config file + CL merging).  Shared by the opt CLI and the
+    campaign supervisor so the flag semantics cannot drift."""
+    from coast_tpu.interface.config import parse_config_file
+    scope = parse_config_file(flags.get("configFile"),
+                              required="configFile" in flags)
+    scope.merge_cl({k: v for k, v in flags.items()
+                    if k in _SCOPE_LIST_FLAGS})
+    overrides = dict(scope.protection_overrides())
+    overrides["no_mem_replication"] = bool(flags.get("noMemReplication"))
+    overrides["no_store_data_sync"] = bool(flags.get("noStoreDataSync"))
+    overrides["no_ctrl_sync"] = bool(flags.get("noStoreAddrSync")
+                                     or flags.get("noLoadSync"))
+    overrides["count_errors"] = bool(flags.get("countErrors"))
+    overrides["count_syncs"] = bool(flags.get("countSyncs"))
+    overrides["segmented"] = bool(flags.get("s"))
+    overrides["cfcss"] = bool(flags.get("CFCSS"))
+    overrides["protect_stack"] = bool(flags.get("protectStack"))
+    return overrides
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
@@ -138,12 +160,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("ERROR: -i and -s are mutually exclusive", file=sys.stderr)
         return 2
 
-    from coast_tpu.interface.config import (ConfigError, parse_config_file)
+    from coast_tpu.interface.config import ConfigError
     try:
-        scope = parse_config_file(flags.get("configFile"),
-                                  required="configFile" in flags)
-        scope.merge_cl({k: v for k, v in flags.items()
-                        if k in _SCOPE_LIST_FLAGS})
+        overrides = build_overrides(flags)
     except ConfigError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
@@ -154,16 +173,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     from coast_tpu.passes.verification import SoRViolation
 
     region = REGISTRY[bench]()
-    overrides = dict(scope.protection_overrides())
-    overrides["no_mem_replication"] = bool(flags.get("noMemReplication"))
-    overrides["no_store_data_sync"] = bool(flags.get("noStoreDataSync"))
-    overrides["no_ctrl_sync"] = bool(flags.get("noStoreAddrSync")
-                                     or flags.get("noLoadSync"))
-    overrides["count_errors"] = bool(flags.get("countErrors"))
-    overrides["count_syncs"] = bool(flags.get("countSyncs"))
-    overrides["segmented"] = bool(flags.get("s"))
-    overrides["cfcss"] = bool(flags.get("CFCSS"))
-    overrides["protect_stack"] = bool(flags.get("protectStack"))
 
     strategy = strategies[0] if strategies else None
     try:

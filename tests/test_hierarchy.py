@@ -1,0 +1,195 @@
+"""Cache/register injection models + supervisor CLI (SURVEY.md §2.2
+#11/#13/#17/#18: supervisor.py, injector.py targets, mem.py caches,
+registers.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.hierarchy import (CACHE_INFO, CacheData, MemHierarchy,
+                                        RegisterFile, cache_addr_to_fault,
+                                        generate_cache_schedule)
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.supervisor import main as supervisor_main
+from coast_tpu.models import crc16, mm
+
+
+@pytest.fixture(scope="module")
+def prog():
+    return TMR(mm.make_region())
+
+
+# -- cache geometry ----------------------------------------------------------
+
+def test_cache_geometry_matches_reference():
+    """Row math = size / (blockSize * assoc) (resources/mem.py:110-111)."""
+    h = MemHierarchy("tpu")
+    assert h.caches["icache"].rows == 32768 // (32 * 4) == 256
+    assert h.caches["dcache"].rows == 256
+    assert h.caches["l2cache"].rows == 524288 // (32 * 8) == 2048
+    assert h.caches["dcache"].words_per_block == 8
+
+
+def test_cache_random_addr_in_range():
+    c = CacheData("dcache", **{k: v for k, v in zip(
+        ("size", "assoc", "block_size", "policy"),
+        (32768, 4, 32, 0))})
+    rng = np.random.RandomState(0)
+    for _ in range(100):
+        row, block, word = c.random_word_cache_addr(rng)
+        assert 0 <= row < c.rows
+        assert 0 <= block < c.assoc
+        assert 0 <= word < c.words_per_block
+
+
+def test_hierarchy_weighted_choice_prefers_l2():
+    """l2 is 8x the size of either L1, so the size-weighted pick
+    (mem.py:134-140) must dominate."""
+    h = MemHierarchy("tpu")
+    rng = np.random.RandomState(1)
+    picks = [h.random_word_cache_addr(rng)[0] for _ in range(500)]
+    assert picks.count("l2cache") > 300
+
+
+def test_invalid_board_rejected():
+    with pytest.raises(ValueError, match="Invalid board"):
+        MemHierarchy("msp430")
+
+
+# -- cache -> fault mapping --------------------------------------------------
+
+def test_dcache_maps_to_mem_sections(prog):
+    mmap = MemoryMap(prog)
+    c = MemHierarchy("tpu").caches["dcache"]
+    hit = cache_addr_to_fault(mmap, c, 0, 0, 3)
+    assert hit is not None
+    leaf_id, lane, word, sec_idx = hit
+    assert mmap.sections[sec_idx].kind in ("mem", "ro")
+    assert mmap.sections[sec_idx].leaf_id == leaf_id
+
+
+def test_cache_beyond_footprint_discarded(prog):
+    mmap = MemoryMap(prog)
+    c = MemHierarchy("tpu").caches["l2cache"]
+    # mm's whole image is far smaller than the last L2 line.
+    assert cache_addr_to_fault(mmap, c, c.rows - 1, c.assoc - 1, 7) is None
+
+
+def test_icache_maps_to_control_state(prog):
+    mmap = MemoryMap(prog)
+    c = MemHierarchy("tpu").caches["icache"]
+    hit = cache_addr_to_fault(mmap, c, 0, 0, 0)
+    assert hit is not None
+    assert mmap.sections[hit[3]].kind in ("ctrl", "cfcss")
+
+
+def test_cache_campaign_classifies_everything(prog):
+    runner = CampaignRunner(prog, strategy_name="TMR")
+    sched = generate_cache_schedule(
+        runner.mmap, MemHierarchy("tpu"), 64, seed=3,
+        nominal_steps=prog.region.nominal_steps)
+    res = runner.run_schedule(sched, batch_size=64)
+    assert res.n == 64
+    assert sum(res.counts.values()) == 64
+    # Discarded (invalid-line) injections never fire and classify success.
+    n_discarded = int((sched.t == -1).sum())
+    assert res.counts["success"] + res.counts["corrected"] >= n_discarded
+
+
+# -- register file -----------------------------------------------------------
+
+def test_register_file_names_and_lookup(prog):
+    rf = RegisterFile(prog)
+    assert len(rf.names) >= 2
+    name = rf.names[0]
+    leaf_id, lane, word = rf.name_lookup(name)
+    sec = [s for s in MemoryMap(prog).sections if s.leaf_id == leaf_id][0]
+    assert sec.kind in ("reg", "ctrl")
+    assert rf.name_lookup("no_such_register") is None
+
+
+def test_register_file_covers_all_lanes(prog):
+    """Replicated reg/ctrl leaves contribute one register file per lane
+    (N independently corruptible copies)."""
+    rf = RegisterFile(prog)
+    lanes_seen = {r[2] for r in rf._rows}
+    assert lanes_seen == {0, 1, 2}          # TMR: 3 lanes addressable
+    assert any(n.endswith("@2") for n in rf.names)
+
+
+def test_register_random_deterministic(prog):
+    rf = RegisterFile(prog)
+    a = rf.random(np.random.RandomState(9))
+    b = rf.random(np.random.RandomState(9))
+    assert a == b
+
+
+# -- supervisor CLI ----------------------------------------------------------
+
+def test_supervisor_memory_campaign(tmp_path, capsys):
+    rc = supervisor_main(["-f", "crc16", "-s", "registers", "-t", "32",
+                          "--seed", "5", "--batch-size", "32",
+                          "-l", str(tmp_path), "-d", "cpu"])
+    assert rc == 0
+    path = tmp_path / "crc16_TMR_registers.json"
+    assert path.exists()
+    data = json.loads(path.read_text())
+    assert data["summary"]["injections"] == 32
+    assert len(data["runs"]) == 32
+    # Every injected section must be register-class.
+    for run in data["runs"]:
+        assert run["section"] in ("reg", "ctrl")
+
+
+def test_supervisor_cache_campaign(tmp_path):
+    rc = supervisor_main(["-f", "matrixMultiply", "-s", "dcache", "-t", "16",
+                          "--batch-size", "16", "-l", str(tmp_path),
+                          "-d", "cpu"])
+    assert rc == 0
+    assert (tmp_path / "matrixMultiply_TMR_dcache.json").exists()
+
+
+def test_discarded_cache_draws_marked_in_logs(prog):
+    """Invalid-line injections must not pollute per-symbol attribution
+    (the reference logs them distinctly, supportClasses InvalidResult)."""
+    from coast_tpu.inject import logs as logs_mod
+    runner = CampaignRunner(prog, strategy_name="TMR")
+    sched = generate_cache_schedule(
+        runner.mmap, MemHierarchy("tpu"), 64, seed=11,
+        nominal_steps=prog.region.nominal_steps, cache_name="l2cache")
+    n_discarded = int((sched.t == -1).sum())
+    assert n_discarded > 0                  # l2 is far bigger than mm
+    res = runner.run_schedule(sched, batch_size=64)
+    rows = logs_mod.to_injection_logs(res, runner.mmap)
+    marked = [r for r in rows if r["symbol"] == "<invalid-line>"]
+    assert len(marked) == n_discarded
+    assert all(r["section"] == "cache-invalid" for r in marked)
+
+
+def test_supervisor_rejects_bad_opt_flags(capsys):
+    with pytest.raises(SystemExit):
+        supervisor_main(["-f", "crc16", "-O", "-TMR -protectstack",
+                         "-t", "1", "-q", "-d", "cpu"])
+
+
+def test_supervisor_force_break(capsys):
+    rc = supervisor_main(["-f", "matrixMultiply", "-b", "results:1:0:20:5",
+                          "-c", "2", "-q", "-d", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("forced injection") == 2
+    assert "F: 1" in out or "E: 0" in out
+
+
+def test_supervisor_rejects_unsupported_board():
+    with pytest.raises(SystemExit):
+        supervisor_main(["-f", "crc16", "-d", "hifive1"])
+
+
+def test_supervisor_rejects_unknown_benchmark():
+    with pytest.raises(SystemExit):
+        supervisor_main(["-f", "noSuchBench", "-d", "cpu"])
