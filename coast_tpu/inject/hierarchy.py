@@ -155,6 +155,22 @@ class RegisterFile:
         return self._rows[int(rng.randint(len(self._rows)))]
 
 
+# What each cache overlays: instruction fetch corruption manifests in
+# control/CFCSS state; data caches back the memory image.  Shared by the
+# scalar mapping, the vectorised scheduler, and the supervisor's 'text'
+# section alias.
+ICACHE_KINDS = ("ctrl", "cfcss")
+DCACHE_KINDS = ("mem", "ro")
+
+
+def _overlay_rows(mmap: MemoryMap, cache_name: str):
+    """The (section_idx, section) rows a cache overlays, in map order --
+    the single source of truth for the footprint mapping."""
+    kinds = ICACHE_KINDS if cache_name == "icache" else DCACHE_KINDS
+    return [(idx, s) for idx, s in enumerate(mmap.sections)
+            if s.kind in kinds]
+
+
 def cache_addr_to_fault(mmap: MemoryMap, cache: CacheData, row: int,
                         block: int, word: int
                         ) -> Optional[Tuple[int, int, int, int]]:
@@ -169,10 +185,7 @@ def cache_addr_to_fault(mmap: MemoryMap, cache: CacheData, row: int,
         order (physically-indexed cache over the address space);
       * the icache overlays control state (``ctrl`` and CFCSS leaves).
     """
-    kinds = (("ctrl", "cfcss") if cache.name == "icache"
-             else ("mem", "ro"))
-    rows = [(idx, s) for idx, s in enumerate(mmap.sections)
-            if s.kind in kinds]
+    rows = _overlay_rows(mmap, cache.name)
     if not rows:
         return None
     linear = ((row * cache.assoc) + block) * cache.words_per_block + word
@@ -225,9 +238,7 @@ def generate_cache_schedule(mmap: MemoryMap, hierarchy: MemHierarchy,
         blk = rng.randint(0, c.assoc, k)
         w = rng.randint(0, c.words_per_block, k)
         linear = ((row * c.assoc) + blk) * c.words_per_block + w
-        kinds = (("ctrl", "cfcss") if cname == "icache" else ("mem", "ro"))
-        rows = [(idx, s) for idx, s in enumerate(mmap.sections)
-                if s.kind in kinds]
+        rows = _overlay_rows(mmap, cname)
         if not rows:
             t[mask] = -1
             continue

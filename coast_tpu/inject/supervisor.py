@@ -30,15 +30,17 @@ SECTION_CHOICES = ["stack", "text", "rodata", "data", "bss", "heap", "init",
                    "registers", "memory", "cache", "icache", "dcache",
                    "l2cache"]
 
+from coast_tpu.inject.hierarchy import DCACHE_KINDS, ICACHE_KINDS
+
 _KIND_SECTIONS = {
-    "memory": ("mem", "ro"),
+    "memory": DCACHE_KINDS,
     "data": ("mem",),
     "bss": ("mem",),
     "heap": ("mem",),
     "init": ("mem",),
     "rodata": ("ro",),
     "registers": ("reg", "ctrl"),
-    "text": ("ctrl", "cfcss"),
+    "text": ICACHE_KINDS,
 }
 
 
@@ -154,8 +156,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                                             generate_cache_schedule)
 
     prog, strategy = build_program(args.filename, args.opt_passes)
-    runner = CampaignRunner(prog, sections=section_filter(prog, args.section),
-                            strategy_name=strategy)
+    try:
+        runner = CampaignRunner(prog,
+                                sections=section_filter(prog, args.section),
+                                strategy_name=strategy)
+    except ValueError:
+        print(f"Error, {prog.region.name} has no injectable leaves in "
+              f"section '{args.section}'!", file=sys.stderr)
+        return 1
     mmap = runner.mmap
 
     if args.forceBreak:

@@ -8,11 +8,11 @@ from typing import Callable, Dict
 from coast_tpu.ir.region import Region
 
 
-def _lazy(modname: str) -> Callable[[], Region]:
+def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
     def make() -> Region:
         import importlib
         mod = importlib.import_module(f"coast_tpu.models.{modname}")
-        return mod.make_region()
+        return getattr(mod, fn)()
     return make
 
 
@@ -24,7 +24,19 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     "sha256": _lazy("sha256"),
     "chstone_mips": _lazy("chstone_mips"),
     "towersOfHanoi": _lazy("hanoi"),
+    # CHStone kernels (tests/chstone/*), SURVEY.md §2.3 #31.
+    "chstone_sha": _lazy("chstone.sha"),
+    "chstone_adpcm": _lazy("chstone.adpcm"),
+    "chstone_blowfish": _lazy("chstone.blowfish"),
+    "chstone_dfadd": _lazy("chstone.dfkernels", "make_dfadd"),
+    "chstone_dfmul": _lazy("chstone.dfkernels", "make_dfmul"),
+    "chstone_dfdiv": _lazy("chstone.dfkernels", "make_dfdiv"),
+    "chstone_dfsin": _lazy("chstone.dfkernels", "make_dfsin"),
 }
 
-# The CHStone sub-suite (BASELINE config 4: full TMR campaign).
-CHSTONE = ("chstone_mips",)
+# The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The
+# reference builds 12 kernels with OPT_PASSES=-TMR
+# (tests/chstone/Makefile.common:1-3); aes is the shared aes region.
+CHSTONE = ("chstone_mips", "chstone_sha", "chstone_adpcm",
+           "chstone_blowfish", "chstone_dfadd", "chstone_dfmul",
+           "chstone_dfdiv", "chstone_dfsin", "aes")

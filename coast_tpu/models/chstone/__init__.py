@@ -1,0 +1,14 @@
+"""CHStone kernels as stepped TPU regions (reference: tests/chstone/*).
+
+The CHStone suite (Hara et al., Nagoya University) is the reference's
+large-benchmark tier: 12 self-checking C kernels built with
+``OPT_PASSES=-TMR`` (tests/chstone/Makefile.common:1-3) and the target of
+the full TMR fault-injection campaign (BASELINE.json config 4).  Each
+module here re-expresses one kernel as a :class:`~coast_tpu.ir.region.Region`
+-- same computation class, same self-check discipline (a run is correct iff
+its result equals an independently-computed golden), stepped so a whole
+injection campaign batches as one XLA program.
+
+The mips kernel lives in coast_tpu/models/chstone_mips.py (it predates this
+subpackage); the aes kernel is coast_tpu/models/aes.py.
+"""

@@ -1,0 +1,585 @@
+"""CHStone adpcm: CCITT G.722 split-band ADPCM encode + decode
+(reference: tests/chstone/adpcm/adpcm.c).
+
+The reference encodes 100 16 kHz samples in pairs through transmit QMF +
+two-band ADPCM (encode, adpcm.c:229-375), decodes them back (decode,
+:377-511), and self-checks both the compressed codes and the reconstructed
+samples against embedded vectors (main, :761-788).
+
+The TPU region runs the same DSP as a 100-step machine: steps 0..49 encode
+one sample pair each, steps 50..99 decode one code word each.  Predictor
+state (QMF delay lines, zero/pole-section coefficients, log scale factors)
+lives in injectable leaves, so a campaign corrupts the adaptive predictors
+mid-stream -- the interesting failure mode of ADPCM.  The golden vectors
+are produced at build time by an independent pure-python-int oracle
+(:func:`golden_reference`) that follows the C semantics exactly (arbitrary
+precision, C ``long`` accumulators); the int32 region must match it
+word-for-word, which also proves the int32 lowering never overflows on the
+fault-free path.
+
+The G.722 constants below are from the CCITT recommendation (quantizer
+decision levels, inverse-quantizer outputs, log-scale lookup); the
+``upzero`` delay-line quirk (slot 2 not shifted) is reproduced faithfully.
+One deliberate deviation: the reference's decoder output path indexes the
+66-level inverse quantizer with the *encoder's* stale global ``il``
+(adpcm.c:401 ``qq6_code6_table[il]``, constant during the decode phase) --
+an artifact of its globals; oracle and region both use the received code
+``ilr``, the correct G.722 behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+SIZE = 100
+N_STEPS = SIZE                      # 50 encode + 50 decode
+
+# QMF coefficients, scaled x4 vs the CCITT table (adpcm.c:92-95).
+H = [12, -44, -44, 212, 48, -624, 128, 1448, -840, -3220, 3804, 15504,
+     15504, 3804, -3220, -840, 1448, 128, -624, 48, 212, -44, -44, 12]
+
+QQ4 = [0, -20456, -12896, -8968, -6288, -4240, -2584, -1200,
+       20456, 12896, 8968, 6288, 4240, 2584, 1200, 0]
+QQ6 = [-136, -136, -136, -136, -24808, -21904, -19008, -16704, -14984,
+       -13512, -12280, -11192, -10232, -9360, -8576, -7856, -7192, -6576,
+       -6000, -5456, -4944, -4464, -4008, -3576, -3168, -2776, -2400,
+       -2032, -1688, -1360, -1040, -728, 24808, 21904, 19008, 16704,
+       14984, 13512, 12280, 11192, 10232, 9360, 8576, 7856, 7192, 6576,
+       6000, 5456, 4944, 4464, 4008, 3576, 3168, 2776, 2400, 2032, 1688,
+       1360, 1040, 728, 432, 136, -432, -136]
+WL = [-60, 3042, 1198, 538, 334, 172, 58, -30,
+      3042, 1198, 538, 334, 172, 58, -30, -60]
+ILB = [2048, 2093, 2139, 2186, 2233, 2282, 2332, 2383, 2435, 2489, 2543,
+       2599, 2656, 2714, 2774, 2834, 2896, 2960, 3025, 3091, 3158, 3228,
+       3298, 3371, 3444, 3520, 3597, 3676, 3756, 3838, 3922, 4008]
+DECIS_LEVL = [280, 576, 880, 1200, 1520, 1864, 2208, 2584, 2960, 3376,
+              3784, 4240, 4696, 5200, 5712, 6288, 6864, 7520, 8184, 8968,
+              9752, 10712, 11664, 12896, 14120, 15840, 17560, 20456,
+              23352, 32767]
+Q26_POS = [61, 60, 59, 58, 57, 56, 55, 54, 53, 52, 51, 50, 49, 48, 47, 46,
+           45, 44, 43, 42, 41, 40, 39, 38, 37, 36, 35, 34, 33, 32, 32]
+Q26_NEG = [63, 62, 31, 30, 29, 28, 27, 26, 25, 24, 23, 22, 21, 20, 19, 18,
+           17, 16, 15, 14, 13, 12, 11, 10, 9, 8, 7, 6, 5, 4, 4]
+QQ2 = [-7408, -1616, 7408, 1616]
+WH = [798, -214, 798, -214]
+
+
+def make_input() -> np.ndarray:
+    """Deterministic 100-sample 16 kHz-ish waveform (two mixed tones,
+    |x| <= ~1800 keeping every int32 intermediate in range -- proven by the
+    oracle-equality test)."""
+    i = np.arange(SIZE)
+    x = (1200 * np.sin(2 * np.pi * i / 23)
+         + 600 * np.sin(2 * np.pi * i / 7 + 1.0))
+    return x.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Pure-python-int oracle (C `long` semantics: arbitrary precision + >> is
+# arithmetic shift).  This is the build-time golden generator.
+# ---------------------------------------------------------------------------
+
+class _G722:
+    """Shared encoder/decoder half-state (one sub-band pair)."""
+
+    def __init__(self):
+        self.detl, self.deth = 32, 8
+        self.nbl = self.al1 = self.al2 = self.plt1 = self.plt2 = 0
+        self.rlt1 = self.rlt2 = 0
+        self.nbh = self.ah1 = self.ah2 = self.ph1 = self.ph2 = 0
+        self.rh1 = self.rh2 = 0
+        self.bpl = [0] * 6
+        self.dltx = [0] * 6
+        self.bph = [0] * 6
+        self.dhx = [0] * 6
+
+
+def _filtez(bpl: List[int], dlt: List[int]) -> int:
+    return sum(b * d for b, d in zip(bpl, dlt)) >> 14
+
+
+def _filtep(r1: int, a1: int, r2: int, a2: int) -> int:
+    return (a1 * 2 * r1 + a2 * 2 * r2) >> 15
+
+
+def _quantl(el: int, detl: int) -> int:
+    wd = abs(el)
+    for mil in range(30):
+        if wd <= (DECIS_LEVL[mil] * detl) >> 15:
+            break
+    else:
+        mil = 30
+    return Q26_POS[mil] if el >= 0 else Q26_NEG[mil]
+
+
+def _logscl(il: int, nbl: int) -> int:
+    nbl = ((nbl * 127) >> 7) + WL[il >> 2]
+    return min(max(nbl, 0), 18432)
+
+
+def _logsch(ih: int, nbh: int) -> int:
+    nbh = ((nbh * 127) >> 7) + WH[ih]
+    return min(max(nbh, 0), 22528)
+
+
+def _scalel(nbl: int, shift: int) -> int:
+    wd1 = (nbl >> 6) & 31
+    wd2 = nbl >> 11
+    return (ILB[wd1] >> (shift + 1 - wd2)) << 3
+
+
+def _upzero(dlt: int, dlti: List[int], bli: List[int]) -> None:
+    if dlt == 0:
+        for i in range(6):
+            bli[i] = (255 * bli[i]) >> 8
+    else:
+        for i in range(6):
+            wd2 = 128 if dlt * dlti[i] >= 0 else -128
+            bli[i] = wd2 + ((255 * bli[i]) >> 8)
+    # Delay-line quirk: slot 2 is not shifted (adpcm.c:640-645).
+    dlti[5] = dlti[4]
+    dlti[4] = dlti[3]
+    dlti[3] = dlti[2]
+    dlti[1] = dlti[0]
+    dlti[0] = dlt
+
+
+def _uppol2(al1: int, al2: int, plt: int, plt1: int, plt2: int) -> int:
+    wd2 = 4 * al1
+    if plt * plt1 >= 0:
+        wd2 = -wd2
+    wd2 >>= 7
+    wd4 = wd2 + 128 if plt * plt2 >= 0 else wd2 - 128
+    apl2 = wd4 + ((127 * al2) >> 7)
+    return min(max(apl2, -12288), 12288)
+
+
+def _uppol1(al1: int, apl2: int, plt: int, plt1: int) -> int:
+    wd2 = (al1 * 255) >> 8
+    apl1 = wd2 + 192 if plt * plt1 >= 0 else wd2 - 192
+    wd3 = 15360 - apl2
+    return min(max(apl1, -wd3), wd3)
+
+
+def golden_reference(data: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Run encode+decode host-side; returns (compressed[50], result[100])."""
+    enc = _G722()
+    dec = _G722()
+    tqmf = [0] * 24
+    accumc = [0] * 11
+    accumd = [0] * 11
+    compressed = []
+    result = []
+
+    for i in range(0, SIZE, 2):
+        xin1, xin2 = int(data[i]), int(data[i + 1])
+        # Transmit QMF (adpcm.c:236-260).
+        xa = sum(tqmf[2 * j] * H[2 * j] for j in range(12))
+        xb = sum(tqmf[2 * j + 1] * H[2 * j + 1] for j in range(12))
+        tqmf[2:] = tqmf[:-2]
+        tqmf[0], tqmf[1] = xin2, xin1
+        xl = (xa + xb) >> 15
+        xh = (xa - xb) >> 15
+
+        # Lower sub-band encoder.
+        szl = _filtez(enc.bpl, enc.dltx)
+        spl = _filtep(enc.rlt1, enc.al1, enc.rlt2, enc.al2)
+        sl = szl + spl
+        el = xl - sl
+        il = _quantl(el, enc.detl)
+        dlt = (enc.detl * QQ4[il >> 2]) >> 15
+        enc.nbl = _logscl(il, enc.nbl)
+        enc.detl = _scalel(enc.nbl, 8)
+        plt = dlt + szl
+        _upzero(dlt, enc.dltx, enc.bpl)
+        enc.al2 = _uppol2(enc.al1, enc.al2, plt, enc.plt1, enc.plt2)
+        enc.al1 = _uppol1(enc.al1, enc.al2, plt, enc.plt1)
+        rlt = sl + dlt
+        enc.rlt2, enc.rlt1 = enc.rlt1, rlt
+        enc.plt2, enc.plt1 = enc.plt1, plt
+
+        # Higher sub-band encoder.
+        szh = _filtez(enc.bph, enc.dhx)
+        sph = _filtep(enc.rh1, enc.ah1, enc.rh2, enc.ah2)
+        sh = sph + szh
+        eh = xh - sh
+        ih = 3 if eh >= 0 else 1
+        decis = (564 * enc.deth) >> 12
+        if abs(eh) > decis:
+            ih -= 1
+        dh = (enc.deth * QQ2[ih]) >> 15
+        enc.nbh = _logsch(ih, enc.nbh)
+        enc.deth = _scalel(enc.nbh, 10)
+        ph = dh + szh
+        _upzero(dh, enc.dhx, enc.bph)
+        enc.ah2 = _uppol2(enc.ah1, enc.ah2, ph, enc.ph1, enc.ph2)
+        enc.ah1 = _uppol1(enc.ah1, enc.ah2, ph, enc.ph1)
+        yh = sh + dh
+        enc.rh2, enc.rh1 = enc.rh1, yh
+        enc.ph2, enc.ph1 = enc.ph1, ph
+
+        compressed.append(il | (ih << 6))
+
+    for i in range(0, SIZE, 2):
+        inp = compressed[i // 2]
+        ilr = inp & 0x3F
+        ih = inp >> 6
+        # Lower sub-band decoder.
+        szl = _filtez(dec.bpl, dec.dltx)
+        spl = _filtep(dec.rlt1, dec.al1, dec.rlt2, dec.al2)
+        sl = spl + szl
+        dlt = (dec.detl * QQ4[ilr >> 2]) >> 15
+        dl = (dec.detl * QQ6[ilr]) >> 15
+        rl = dl + sl
+        dec.nbl = _logscl(ilr, dec.nbl)
+        dec.detl = _scalel(dec.nbl, 8)
+        plt = dlt + szl
+        _upzero(dlt, dec.dltx, dec.bpl)
+        dec.al2 = _uppol2(dec.al1, dec.al2, plt, dec.plt1, dec.plt2)
+        dec.al1 = _uppol1(dec.al1, dec.al2, plt, dec.plt1)
+        rlt = sl + dlt
+        dec.rlt2, dec.rlt1 = dec.rlt1, rlt
+        dec.plt2, dec.plt1 = dec.plt1, plt
+
+        # Higher sub-band decoder.
+        szh = _filtez(dec.bph, dec.dhx)
+        sph = _filtep(dec.rh1, dec.ah1, dec.rh2, dec.ah2)
+        sh = sph + szh
+        dh = (dec.deth * QQ2[ih]) >> 15
+        dec.nbh = _logsch(ih, dec.nbh)
+        dec.deth = _scalel(dec.nbh, 10)
+        ph = dh + szh
+        _upzero(dh, dec.dhx, dec.bph)
+        dec.ah2 = _uppol2(dec.ah1, dec.ah2, ph, dec.ph1, dec.ph2)
+        dec.ah1 = _uppol1(dec.ah1, dec.ah2, ph, dec.ph1)
+        rh = sh + dh
+        dec.rh2, dec.rh1 = dec.rh1, rh
+        dec.ph2, dec.ph1 = dec.ph1, ph
+
+        # Receive QMF (adpcm.c:481-511).
+        xd = rl - rh
+        xs = rl + rh
+        xa1 = xd * H[0] + sum(accumc[j] * H[2 * j + 2] for j in range(11))
+        xa2 = xs * H[1] + sum(accumd[j] * H[2 * j + 3] for j in range(11))
+        result.append(xa1 >> 14)
+        result.append(xa2 >> 14)
+        accumc[1:] = accumc[:-1]
+        accumd[1:] = accumd[:-1]
+        accumc[0], accumd[0] = xd, xs
+
+    return (np.array(compressed, np.int64), np.array(result, np.int64))
+
+
+# ---------------------------------------------------------------------------
+# The jnp step (int32): same math, vectorised tables.
+# ---------------------------------------------------------------------------
+
+_J = {k: jnp.asarray(v, jnp.int32) for k, v in
+      dict(H=H, QQ4=QQ4, QQ6=QQ6, WL=WL, ILB=ILB, DECIS=DECIS_LEVL,
+           POS=Q26_POS, NEG=Q26_NEG, QQ2=QQ2, WH=WH).items()}
+
+# Scalar predictor state packed into one register-file leaf per codec half:
+_SCALARS = ("detl", "deth", "nbl", "nbh", "al1", "al2", "plt1", "plt2",
+            "rlt1", "rlt2", "ah1", "ah2", "ph1", "ph2", "rh1", "rh2")
+_SIDX = {n: i for i, n in enumerate(_SCALARS)}
+
+
+def _jz(s, name):
+    return s[_SIDX[name]]
+
+
+def _jfiltez(bpl, dltx):
+    return jnp.sum(bpl * dltx) >> 14
+
+
+def _jfiltep(r1, a1, r2, a2):
+    return (a1 * (2 * r1) + a2 * (2 * r2)) >> 15
+
+
+def _jquantl(el, detl):
+    wd = jnp.abs(el)
+    decis = (_J["DECIS"] * detl) >> 15
+    hit = wd <= decis
+    mil = jnp.where(jnp.any(hit), jnp.argmax(hit).astype(jnp.int32),
+                    jnp.int32(30))
+    return jnp.where(el >= 0, _J["POS"][mil], _J["NEG"][mil])
+
+
+def _jlogscl(il, nbl):
+    nbl = ((nbl * 127) >> 7) + _J["WL"][il >> 2]
+    return jnp.clip(nbl, 0, 18432)
+
+
+def _jlogsch(ih, nbh):
+    nbh = ((nbh * 127) >> 7) + _J["WH"][ih]
+    return jnp.clip(nbh, 0, 22528)
+
+
+def _jscalel(nbl, shift):
+    wd1 = (nbl >> 6) & 31
+    wd2 = nbl >> 11
+    return (_J["ILB"][wd1] >> (shift + 1 - wd2)) << 3
+
+
+def _jupzero(dlt, dlti, bli):
+    leak = (255 * bli) >> 8
+    wd2 = jnp.where(dlt * dlti >= 0, 128, -128).astype(jnp.int32)
+    bli_new = jnp.where(dlt == 0, leak, wd2 + leak)
+    dlti_new = jnp.stack([dlt, dlti[0], dlti[2], dlti[2], dlti[3], dlti[4]])
+    return dlti_new, bli_new
+
+
+def _juppol2(al1, al2, plt, plt1, plt2):
+    wd2 = jnp.where(plt * plt1 >= 0, -(4 * al1), 4 * al1) >> 7
+    wd4 = jnp.where(plt * plt2 >= 0, wd2 + 128, wd2 - 128)
+    return jnp.clip(wd4 + ((127 * al2) >> 7), -12288, 12288)
+
+
+def _juppol1(al1, apl2, plt, plt1):
+    wd2 = (al1 * 255) >> 8
+    apl1 = jnp.where(plt * plt1 >= 0, wd2 + 192, wd2 - 192)
+    wd3 = 15360 - apl2
+    return jnp.clip(apl1, -wd3, wd3)
+
+
+def _band_update(s, prefix, plt_or_ph):
+    """Common post-quantizer predictor update for one sub-band.
+    prefix 'l': al1/al2/plt1/plt2; prefix 'h': ah1/ah2/ph1/ph2."""
+    if prefix == "l":
+        a1, a2, p1, p2 = (_jz(s, "al1"), _jz(s, "al2"),
+                          _jz(s, "plt1"), _jz(s, "plt2"))
+    else:
+        a1, a2, p1, p2 = (_jz(s, "ah1"), _jz(s, "ah2"),
+                          _jz(s, "ph1"), _jz(s, "ph2"))
+    new_a2 = _juppol2(a1, a2, plt_or_ph, p1, p2)
+    new_a1 = _juppol1(a1, new_a2, plt_or_ph, p1)
+    return new_a1, new_a2
+
+
+def make_region() -> Region:
+    data = make_input()
+    g_comp, g_res = golden_reference(data)
+
+    def init():
+        s0 = np.zeros(len(_SCALARS), np.int32)
+        s0[_SIDX["detl"]] = 32
+        s0[_SIDX["deth"]] = 8
+        return {
+            "input": jnp.asarray(data, jnp.int32),
+            "compressed": jnp.zeros(SIZE // 2, jnp.int32),
+            "result": jnp.zeros(SIZE, jnp.int32),
+            "tqmf": jnp.zeros(24, jnp.int32),
+            "accumc": jnp.zeros(11, jnp.int32),
+            "accumd": jnp.zeros(11, jnp.int32),
+            "enc_s": jnp.asarray(s0),
+            "dec_s": jnp.asarray(s0),
+            "enc_bpl": jnp.zeros(6, jnp.int32),
+            "enc_dltx": jnp.zeros(6, jnp.int32),
+            "enc_bph": jnp.zeros(6, jnp.int32),
+            "enc_dhx": jnp.zeros(6, jnp.int32),
+            "dec_bpl": jnp.zeros(6, jnp.int32),
+            "dec_dltx": jnp.zeros(6, jnp.int32),
+            "dec_bph": jnp.zeros(6, jnp.int32),
+            "dec_dhx": jnp.zeros(6, jnp.int32),
+            "i": jnp.int32(0),
+        }
+
+    def _encode_step(st, k):
+        """k in [0,50): encode pair (input[2k], input[2k+1])."""
+        s = st["enc_s"]
+        xin1 = jnp.take(st["input"], 2 * k, mode="clip")
+        xin2 = jnp.take(st["input"], 2 * k + 1, mode="clip")
+        tq = st["tqmf"]
+        xa = jnp.sum(tq[0::2] * _J["H"][0::2])
+        xb = jnp.sum(tq[1::2] * _J["H"][1::2])
+        tq = jnp.concatenate([jnp.stack([xin2, xin1]), tq[:-2]])
+        xl = (xa + xb) >> 15
+        xh = (xa - xb) >> 15
+
+        szl = _jfiltez(st["enc_bpl"], st["enc_dltx"])
+        spl = _jfiltep(_jz(s, "rlt1"), _jz(s, "al1"),
+                       _jz(s, "rlt2"), _jz(s, "al2"))
+        sl = szl + spl
+        el = xl - sl
+        il = _jquantl(el, _jz(s, "detl"))
+        dlt = (_jz(s, "detl") * _J["QQ4"][il >> 2]) >> 15
+        nbl = _jlogscl(il, _jz(s, "nbl"))
+        detl = _jscalel(nbl, 8)
+        plt = dlt + szl
+        dltx, bpl = _jupzero(dlt, st["enc_dltx"], st["enc_bpl"])
+        al1, al2 = _band_update(s, "l", plt)
+        rlt = sl + dlt
+
+        szh = _jfiltez(st["enc_bph"], st["enc_dhx"])
+        sph = _jfiltep(_jz(s, "rh1"), _jz(s, "ah1"),
+                       _jz(s, "rh2"), _jz(s, "ah2"))
+        sh = sph + szh
+        eh = xh - sh
+        ih = jnp.where(eh >= 0, 3, 1).astype(jnp.int32)
+        decis = (564 * _jz(s, "deth")) >> 12
+        ih = jnp.where(jnp.abs(eh) > decis, ih - 1, ih)
+        dh = (_jz(s, "deth") * _J["QQ2"][ih]) >> 15
+        nbh = _jlogsch(ih, _jz(s, "nbh"))
+        deth = _jscalel(nbh, 10)
+        ph = dh + szh
+        dhx, bph = _jupzero(dh, st["enc_dhx"], st["enc_bph"])
+        ah1, ah2 = _band_update(s, "h", ph)
+        yh = sh + dh
+
+        new_s = s
+        for name, val in (("detl", detl), ("deth", deth), ("nbl", nbl),
+                          ("nbh", nbh), ("al1", al1), ("al2", al2),
+                          ("plt1", plt), ("plt2", _jz(s, "plt1")),
+                          ("rlt1", rlt), ("rlt2", _jz(s, "rlt1")),
+                          ("ah1", ah1), ("ah2", ah2),
+                          ("ph1", ph), ("ph2", _jz(s, "ph1")),
+                          ("rh1", yh), ("rh2", _jz(s, "rh1"))):
+            new_s = new_s.at[_SIDX[name]].set(val)
+
+        code = il | (ih << 6)
+        return {
+            **st,
+            "tqmf": tq,
+            "enc_s": new_s,
+            "enc_bpl": bpl, "enc_dltx": dltx,
+            "enc_bph": bph, "enc_dhx": dhx,
+            "compressed": st["compressed"].at[k].set(code, mode="drop"),
+        }
+
+    def _decode_step(st, k):
+        """k in [0,50): decode compressed[k] -> result[2k], result[2k+1]."""
+        s = st["dec_s"]
+        inp = jnp.take(st["compressed"], k, mode="clip")
+        ilr = inp & 0x3F
+        ih = inp >> 6
+
+        szl = _jfiltez(st["dec_bpl"], st["dec_dltx"])
+        spl = _jfiltep(_jz(s, "rlt1"), _jz(s, "al1"),
+                       _jz(s, "rlt2"), _jz(s, "al2"))
+        sl = spl + szl
+        dlt = (_jz(s, "detl") * _J["QQ4"][ilr >> 2]) >> 15
+        dl = (_jz(s, "detl") * _J["QQ6"][ilr]) >> 15
+        rl = dl + sl
+        nbl = _jlogscl(ilr, _jz(s, "nbl"))
+        detl = _jscalel(nbl, 8)
+        plt = dlt + szl
+        dltx, bpl = _jupzero(dlt, st["dec_dltx"], st["dec_bpl"])
+        al1, al2 = _band_update(s, "l", plt)
+        rlt = sl + dlt
+
+        szh = _jfiltez(st["dec_bph"], st["dec_dhx"])
+        sph = _jfiltep(_jz(s, "rh1"), _jz(s, "ah1"),
+                       _jz(s, "rh2"), _jz(s, "ah2"))
+        sh = sph + szh
+        dh = (_jz(s, "deth") * _J["QQ2"][ih]) >> 15
+        nbh = _jlogsch(ih, _jz(s, "nbh"))
+        deth = _jscalel(nbh, 10)
+        ph = dh + szh
+        dhx, bph = _jupzero(dh, st["dec_dhx"], st["dec_bph"])
+        ah1, ah2 = _band_update(s, "h", ph)
+        rh = sh + dh
+
+        xd = rl - rh
+        xs = rl + rh
+        xa1 = xd * _J["H"][0] + jnp.sum(st["accumc"] * _J["H"][2::2])
+        xa2 = xs * _J["H"][1] + jnp.sum(st["accumd"] * _J["H"][3::2])
+        out1 = xa1 >> 14
+        out2 = xa2 >> 14
+        accumc = jnp.concatenate([xd.reshape(1), st["accumc"][:-1]])
+        accumd = jnp.concatenate([xs.reshape(1), st["accumd"][:-1]])
+
+        new_s = s
+        for name, val in (("detl", detl), ("deth", deth), ("nbl", nbl),
+                          ("nbh", nbh), ("al1", al1), ("al2", al2),
+                          ("plt1", plt), ("plt2", _jz(s, "plt1")),
+                          ("rlt1", rlt), ("rlt2", _jz(s, "rlt1")),
+                          ("ah1", ah1), ("ah2", ah2),
+                          ("ph1", ph), ("ph2", _jz(s, "ph1")),
+                          ("rh1", rh), ("rh2", _jz(s, "rh1"))):
+            new_s = new_s.at[_SIDX[name]].set(val)
+
+        result = st["result"].at[2 * k].set(out1, mode="drop")
+        result = result.at[2 * k + 1].set(out2, mode="drop")
+        return {
+            **st,
+            "dec_s": new_s,
+            "dec_bpl": bpl, "dec_dltx": dltx,
+            "dec_bph": bph, "dec_dhx": dhx,
+            "accumc": accumc, "accumd": accumd,
+            "result": result,
+        }
+
+    def step(state, t):
+        i = state["i"]
+        enc = _encode_step(state, jnp.clip(i, 0, SIZE // 2 - 1))
+        dec = _decode_step(state, jnp.clip(i - SIZE // 2, 0, SIZE // 2 - 1))
+        is_enc = i < SIZE // 2
+        merged = {k: jnp.where(is_enc, enc[k], dec[k]) for k in state
+                  if k not in ("input", "i")}
+        merged["input"] = state["input"]
+        merged["i"] = i + 1
+        return merged
+
+    def done(state):
+        return state["i"] >= N_STEPS
+
+    def check(state):
+        bad = jnp.sum(state["compressed"]
+                      != jnp.asarray(g_comp, jnp.int32))
+        bad += jnp.sum(state["result"] != jnp.asarray(g_res, jnp.int32))
+        return bad.astype(jnp.int32)
+
+    def output(state):
+        return jnp.concatenate(
+            [state["compressed"], state["result"]]).astype(jnp.uint32)
+
+    graph = BlockGraph(
+        names=["entry", "encode", "decode", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 2), (2, 3)],
+        block_of=lambda s: jnp.where(
+            s["i"] >= N_STEPS, jnp.int32(3),
+            jnp.where(s["i"] >= SIZE // 2, jnp.int32(2), jnp.int32(1))))
+
+    spec = {
+        "input": LeafSpec(KIND_RO),
+        "compressed": LeafSpec(KIND_MEM),
+        "result": LeafSpec(KIND_MEM),
+        "tqmf": LeafSpec(KIND_MEM),
+        "accumc": LeafSpec(KIND_MEM),
+        "accumd": LeafSpec(KIND_MEM),
+        "enc_s": LeafSpec(KIND_REG),
+        "dec_s": LeafSpec(KIND_REG),
+        "enc_bpl": LeafSpec(KIND_MEM),
+        "enc_dltx": LeafSpec(KIND_MEM),
+        "enc_bph": LeafSpec(KIND_MEM),
+        "enc_dhx": LeafSpec(KIND_MEM),
+        "dec_bpl": LeafSpec(KIND_MEM),
+        "dec_dltx": LeafSpec(KIND_MEM),
+        "dec_bph": LeafSpec(KIND_MEM),
+        "dec_dhx": LeafSpec(KIND_MEM),
+        "i": LeafSpec(KIND_CTRL),
+    }
+
+    return Region(
+        name="chstone_adpcm",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_STEPS,
+        max_steps=N_STEPS + 8,
+        spec=spec,
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "pure-python C-long G.722 reference",
+              "golden_compressed_head": g_comp[:4].tolist()},
+    )

@@ -1,0 +1,278 @@
+"""CHStone blowfish: Blowfish CFB64 encryption of a 5200-byte corpus
+(reference: tests/chstone/blowfish/{bf.c,bf_enc.c,bf_cfb64.c,bf_skey.c}).
+
+The reference key-schedules Blowfish from an embedded key, CFB64-encrypts
+5200 bytes, and self-checks every output byte (main, bf.c:831-847,
+``main_result == 5200``).  The region runs the whole cipher on-device as a
+1171-step machine:
+
+  * steps 0..520: key schedule -- each step is one zero-block encryption
+    whose result fills the next P pair (9 steps) or S-box pair (4x128
+    steps), exactly BF_set_key's loop structure (bf_skey.c);
+  * steps 521..1170: one CFB64 block each (encrypt ivec -> xor plaintext
+    -> ciphertext becomes the next ivec, bf_cfb64.c:100-130).
+
+The P-array and S-boxes are *injectable memory leaves* -- the classic SDC
+study target for table-driven ciphers (one flipped S-box word corrupts
+every later block).  The pi-derived initial tables are computed at build
+time from a fixed-point Machin formula (16*atan(1/5) - 4*atan(1/239))
+rather than embedded, and the implementation is anchored by the published
+zero-key test vector (0x4EF99745 0x6198DD78) in tests.  Goldens come from
+the pure-python oracle below.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+
+DATA_BYTES = 5200
+N_BLOCKS = DATA_BYTES // 8                 # 650 CFB64 blocks
+KS_STEPS = 9 + 4 * 128                     # 521 key-schedule encryptions
+N_STEPS = KS_STEPS + N_BLOCKS
+
+_M32 = 0xFFFFFFFF
+
+KEY = b"TPUcoastBlowfish66"                # 18-byte key (1..56 bytes legal)
+
+_TEXT = (b"The quick brown fox jumps over the lazy dog. "
+         b"Pack my box with five dozen liquor jugs. ")
+
+
+def _corpus() -> bytes:
+    reps = DATA_BYTES // len(_TEXT) + 2
+    return (_TEXT * reps)[:DATA_BYTES]
+
+
+@lru_cache(maxsize=1)
+def pi_hex_words(n_words: int = 1042) -> List[int]:
+    """First ``n_words`` 32-bit words of pi's fractional hex expansion
+    (the Blowfish initial P/S constants), via fixed-point Machin:
+    pi = 16*atan(1/5) - 4*atan(1/239)."""
+    hex_digits = n_words * 8 + 16                      # guard digits
+    scale = 1 << (4 * hex_digits)
+
+    # Alternating series: atan(1/x) = sum (-1)^k / ((2k+1) x^(2k+1)).
+    def atan_inv_exact(x: int) -> int:
+        total = 0
+        term = scale // x
+        x2 = x * x
+        k = 0
+        while term:
+            total += term // (2 * k + 1) if k % 2 == 0 else -(
+                term // (2 * k + 1))
+            term //= x2
+            k += 1
+        return total
+
+    pi = 16 * atan_inv_exact(5) - 4 * atan_inv_exact(239)
+    frac = pi - 3 * scale                              # fractional part
+    words = []
+    for i in range(n_words):
+        frac *= 1 << 32
+        w, frac = divmod(frac, scale)
+        words.append(int(w) & _M32)
+    return words
+
+
+def _initial_tables() -> Tuple[List[int], List[int]]:
+    words = pi_hex_words()
+    return words[:18], words[18:18 + 1024]
+
+
+# ---------------------------------------------------------------------------
+# Pure-python oracle (build-time golden generator + correctness anchor).
+# ---------------------------------------------------------------------------
+
+def _f(s: List[int], x: int) -> int:
+    a, b, c, d = (x >> 24) & 255, (x >> 16) & 255, (x >> 8) & 255, x & 255
+    return ((((s[a] + s[256 + b]) & _M32) ^ s[512 + c]) + s[768 + d]) & _M32
+
+
+def _encrypt_block(p: List[int], s: List[int], xl: int, xr: int
+                   ) -> Tuple[int, int]:
+    for i in range(16):
+        xl ^= p[i]
+        xr ^= _f(s, xl)
+        xl, xr = xr, xl
+    xl, xr = xr, xl
+    xr ^= p[16]
+    xl ^= p[17]
+    return xl, xr
+
+
+def key_schedule(key: bytes) -> Tuple[List[int], List[int]]:
+    p0, s0 = _initial_tables()
+    p = list(p0)
+    s = list(s0)
+    for i in range(18):
+        kw = 0
+        for j in range(4):
+            kw = (kw << 8) | key[(4 * i + j) % len(key)]
+        p[i] ^= kw
+    dl = dr = 0
+    for i in range(0, 18, 2):
+        dl, dr = _encrypt_block(p, s, dl, dr)
+        p[i], p[i + 1] = dl, dr
+    for i in range(0, 1024, 2):
+        dl, dr = _encrypt_block(p, s, dl, dr)
+        s[i], s[i + 1] = dl, dr
+    return p, s
+
+
+def golden_reference(key: bytes, data: bytes) -> np.ndarray:
+    """CFB64-encrypt; returns ciphertext as uint32 [N_BLOCKS, 2]."""
+    p, s = key_schedule(key)
+    ivl = ivr = 0
+    out = []
+    for b in range(0, len(data), 8):
+        kl, kr = _encrypt_block(p, s, ivl, ivr)
+        pl = int.from_bytes(data[b:b + 4], "big")
+        pr = int.from_bytes(data[b + 4:b + 8], "big")
+        cl, cr = pl ^ kl, pr ^ kr
+        out.append((cl, cr))
+        ivl, ivr = cl, cr
+    return np.array(out, np.int64).astype(np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# The jnp region.
+# ---------------------------------------------------------------------------
+
+def _jf(s, x):
+    a = (x >> np.uint32(24)) & np.uint32(255)
+    b = (x >> np.uint32(16)) & np.uint32(255)
+    c = (x >> np.uint32(8)) & np.uint32(255)
+    d = x & np.uint32(255)
+    return (((s[a] + s[np.uint32(256) + b]) ^ s[np.uint32(512) + c])
+            + s[np.uint32(768) + d]).astype(jnp.uint32)
+
+
+def _jencrypt(p, s, xl, xr):
+    for i in range(16):
+        xl = xl ^ p[i]
+        xr = xr ^ _jf(s, xl)
+        xl, xr = xr, xl
+    xl, xr = xr, xl
+    xr = xr ^ p[16]
+    xl = xl ^ p[17]
+    return xl, xr
+
+
+def make_region() -> Region:
+    data = _corpus()
+    golden = golden_reference(KEY, data)
+
+    p0, s0 = _initial_tables()
+    p_keyed = list(p0)
+    for i in range(18):
+        kw = 0
+        for j in range(4):
+            kw = (kw << 8) | KEY[(4 * i + j) % len(KEY)]
+        p_keyed[i] ^= kw
+
+    plain = np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 2)
+
+    def init():
+        return {
+            "plain": jnp.asarray(plain),
+            "P": jnp.asarray(p_keyed, jnp.uint32),
+            "S": jnp.asarray(s0, jnp.uint32),
+            "out": jnp.zeros((N_BLOCKS, 2), jnp.uint32),
+            "chain": jnp.zeros(2, jnp.uint32),   # ks data / CFB ivec
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        p, s = state["P"], state["S"]
+        in_ks = i < KS_STEPS
+
+        # Both phases encrypt the chaining block with the current tables.
+        xl, xr = _jencrypt(p, s, state["chain"][0], state["chain"][1])
+
+        # -- key-schedule phase: write the pair into P or S --------------
+        ks_i = jnp.clip(i, 0, KS_STEPS - 1)
+        is_p = ks_i < 9
+        p_idx = 2 * ks_i
+        s_idx = 2 * (ks_i - 9)
+        new_p = jnp.where(
+            jnp.logical_and(in_ks, is_p),
+            p.at[p_idx].set(xl, mode="drop")
+             .at[p_idx + 1].set(xr, mode="drop"),
+            p)
+        new_s = jnp.where(
+            jnp.logical_and(in_ks, ~is_p),
+            s.at[s_idx].set(xl, mode="drop")
+             .at[s_idx + 1].set(xr, mode="drop"),
+            s)
+
+        # -- CFB phase: keystream xor plaintext --------------------------
+        blk = jnp.clip(i - KS_STEPS, 0, N_BLOCKS - 1)
+        pl = jnp.take(state["plain"], blk, axis=0, mode="clip")
+        cl = pl[0] ^ xl
+        cr = pl[1] ^ xr
+        new_out = jnp.where(
+            in_ks, state["out"],
+            state["out"].at[blk].set(jnp.stack([cl, cr]), mode="drop"))
+
+        # Chain: key schedule feeds the encryption output back; CFB chains
+        # the ciphertext block.
+        chain = jnp.where(in_ks, jnp.stack([xl, xr]), jnp.stack([cl, cr]))
+        # Crossing from key schedule into CFB resets the chain to ivec=0.
+        chain = jnp.where(i == KS_STEPS - 1, jnp.zeros(2, jnp.uint32), chain)
+
+        return {
+            "plain": state["plain"],
+            "P": new_p,
+            "S": new_s,
+            "out": new_out,
+            "chain": chain,
+            "i": i + 1,
+        }
+
+    def done(state):
+        return state["i"] >= N_STEPS
+
+    def check(state):
+        return jnp.sum(state["out"] != jnp.asarray(golden)).astype(jnp.int32)
+
+    def output(state):
+        return state["out"].reshape(-1)
+
+    graph = BlockGraph(
+        names=["entry", "BF_set_key", "BF_cfb64_encrypt", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 2), (2, 3)],
+        block_of=lambda st: jnp.where(
+            st["i"] >= N_STEPS, jnp.int32(3),
+            jnp.where(st["i"] >= KS_STEPS, jnp.int32(2), jnp.int32(1))))
+
+    return Region(
+        name="chstone_blowfish",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_STEPS,
+        max_steps=N_STEPS + 8,
+        spec={
+            "plain": LeafSpec(KIND_RO),
+            "P": LeafSpec(KIND_MEM),
+            "S": LeafSpec(KIND_MEM),
+            "out": LeafSpec(KIND_MEM),
+            "chain": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "pure-python Blowfish (pi tables via Machin)",
+              "golden_head": golden[0].tolist()},
+    )

@@ -1,0 +1,522 @@
+"""IEEE-754 binary64 soft-float on uint32 limb pairs (the engine behind
+the CHStone dfadd/dfmul/dfdiv/dfsin kernels; reference:
+tests/chstone/df*/softfloat.c -- SoftFloat-2 by J. Hauser).
+
+The reference kernels exercise a C softfloat library (64-bit ``long long``
+arithmetic).  The TPU framework's memory map is 32-bit words (uint32
+leaves), so doubles live as (hi, lo) uint32 pairs and every 64-bit
+operation is built from 32-bit limb ops -- which also means a campaign can
+flip any single word of a double independently, like the reference's
+word-granular injections into its 64-bit globals.
+
+Semantics: round-to-nearest-even, subnormals supported, all NaN results
+canonicalised to 0x7FF8000000000000 (the reference propagates SoftFloat's
+default NaN; we canonicalise both the implementation and the numpy oracle
+so the self-check is payload-independent).
+
+All functions take/return jnp uint32 scalars and are jit-traceable with
+static control flow (where-chains, unrolled division).  Correctness is
+anchored against numpy's IEEE float64 in tests (random patterns + the
+special/denormal/rounding-edge matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+_NAN_HI = 0x7FF80000
+
+Pair = Tuple[jax.Array, jax.Array]
+
+
+def _u(x) -> jax.Array:
+    return jnp.asarray(x, U32)
+
+
+# -- 64-bit primitives on (hi, lo) pairs ------------------------------------
+
+def add64(ah, al, bh, bl) -> Pair:
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    return ah + bh + carry, lo
+
+
+def sub64(ah, al, bh, bl) -> Pair:
+    lo = al - bl
+    borrow = (al < bl).astype(U32)
+    return ah - bh - borrow, lo
+
+
+def lt64(ah, al, bh, bl) -> jax.Array:
+    return jnp.logical_or(ah < bh, jnp.logical_and(ah == bh, al < bl))
+
+
+def eq64(ah, al, bh, bl) -> jax.Array:
+    return jnp.logical_and(ah == bh, al == bl)
+
+
+def _safe_shl32(x, k):
+    """x << k for traced k in [0, 63]; k >= 32 yields 0."""
+    return jnp.where(k < 32, x << jnp.clip(k, 0, 31), _u(0))
+
+
+def _safe_shr32(x, k):
+    return jnp.where(k < 32, x >> jnp.clip(k, 0, 31), _u(0))
+
+
+def shl64(h, l, k) -> Pair:
+    """(h,l) << k, k traced in [0, 63]."""
+    k = jnp.asarray(k, U32)
+    hi_small = (_safe_shl32(h, k)
+                | jnp.where(k == 0, _u(0), _safe_shr32(l, _u(32) - k)))
+    hi_big = _safe_shl32(l, k - 32)
+    new_h = jnp.where(k < 32, hi_small, hi_big)
+    new_l = _safe_shl32(l, k)
+    return new_h, new_l
+
+
+def shr64(h, l, k) -> Pair:
+    k = jnp.asarray(k, U32)
+    lo_small = (_safe_shr32(l, k)
+                | jnp.where(k == 0, _u(0), _safe_shl32(h, _u(32) - k)))
+    lo_big = _safe_shr32(h, k - 32)
+    new_l = jnp.where(k < 32, lo_small, lo_big)
+    new_h = _safe_shr32(h, k)
+    return new_h, new_l
+
+
+def shr64_jam(h, l, k) -> Pair:
+    """Right shift with sticky: any bit shifted out ORs into the LSB
+    (softfloat shift64RightJamming)."""
+    k = jnp.asarray(jnp.clip(k, 0, 127), U32)
+    big = k >= 64
+    kk = jnp.where(big, _u(0), k)
+    sh, sl = shr64(h, l, kk)
+    # Lost bits: (h,l) << (64-k) != 0, for 0 < k < 64.
+    lh, ll = shl64(h, l, jnp.where(kk == 0, _u(0), _u(64) - kk))
+    lost_small = jnp.where(kk == 0, False, (lh | ll) != 0)
+    any_bits = (h | l) != 0
+    sticky = jnp.where(big, any_bits, lost_small)
+    new_h = jnp.where(big, _u(0), sh)
+    new_l = jnp.where(big, _u(0), sl) | sticky.astype(U32)
+    return new_h, new_l
+
+
+def clz32(x) -> jax.Array:
+    y = x
+    y = y | (y >> 1)
+    y = y | (y >> 2)
+    y = y | (y >> 4)
+    y = y | (y >> 8)
+    y = y | (y >> 16)
+    return _u(32) - jax.lax.population_count(y)
+
+
+def clz64(h, l) -> jax.Array:
+    return jnp.where(h != 0, clz32(h), _u(32) + clz32(l))
+
+
+def umul32(a, b) -> Pair:
+    """Full 32x32 -> 64 multiply in uint32 limbs."""
+    a0 = a & _u(0xFFFF)
+    a1 = a >> 16
+    b0 = b & _u(0xFFFF)
+    b1 = b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & _u(0xFFFF)) + (p10 & _u(0xFFFF))
+    lo = (mid << 16) | (p00 & _u(0xFFFF))
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+# -- unpack / pack -----------------------------------------------------------
+
+def _unpack(hi, lo):
+    sign = hi >> 31
+    exp = (hi >> 20) & _u(0x7FF)
+    fh = hi & _u(0xFFFFF)
+    return sign, exp, fh, lo
+
+
+def _is_nan(exp, fh, fl):
+    return jnp.logical_and(exp == 0x7FF, (fh | fl) != 0)
+
+
+def _canonical_nan() -> Pair:
+    return _u(_NAN_HI), _u(0)
+
+
+def _pack_inf(sign) -> Pair:
+    return (sign << 31) | _u(0x7FF00000), _u(0)
+
+
+def _pack_zero(sign) -> Pair:
+    return sign << 31, _u(0)
+
+
+def _round_pack(sign, exp, sigh, sigl, g: int = 3) -> Pair:
+    """Round-to-nearest-even and pack.
+
+    Input: zSig = (sigh, sigl) in [2^(52+g), 2^(53+g)) for normal results
+    (implicit bit at position 52+g; low ``g`` bits are guard/round/sticky),
+    zExp = biased exponent (int32, may be <= 0 for subnormal territory).
+    ``g`` is 3 for mul/div and 10 for add/sub (softfloat aligns add/sub at
+    10 extra bits so the post-cancellation normalise-then-round is exact).
+    """
+    exp = jnp.asarray(exp, jnp.int32)
+
+    # Subnormal territory: jam-shift right so the result rounds at the
+    # subnormal precision.
+    is_sub = exp < 1
+    shift = jnp.clip(1 - exp, 0, 127).astype(U32)
+    jh, jl = shr64_jam(sigh, sigl, shift)
+    sigh = jnp.where(is_sub, jh, sigh)
+    sigl = jnp.where(is_sub, jl, sigl)
+    exp = jnp.where(is_sub, 1, exp)
+
+    half = _u(1 << (g - 1))
+    rb = sigl & _u((1 << g) - 1)
+    sigh, sigl = shr64(sigh, sigl, _u(g))        # truncated mantissa
+    lsb = sigl & _u(1)
+    round_up = jnp.logical_or(
+        rb > half, jnp.logical_and(rb == half, lsb == 1))
+    sigh, sigl = add64(sigh, sigl, _u(0), round_up.astype(U32))
+
+    # Mantissa overflow from rounding: [2^52, 2^53] -> 2^53 means exp+1.
+    overflow = jnp.logical_and(sigh == _u(0x200000), sigl == 0)  # 2^53
+    exp = jnp.where(overflow, exp + 1, exp)
+    sigh = jnp.where(overflow, _u(0x100000), sigh)               # 2^52
+    sigl = jnp.where(overflow, _u(0), sigl)
+
+    # Normal iff the implicit bit survived (>= 2^52).
+    is_norm = sigh >= _u(0x100000)
+    packed_exp = jnp.where(is_norm, exp.astype(U32), _u(0))
+    frac_h = jnp.where(is_norm, sigh - _u(0x100000), sigh)
+
+    to_inf = exp >= 0x7FF
+    hi = (sign << 31) | (packed_exp << 20) | frac_h
+    ih, il = _pack_inf(sign)
+    hi = jnp.where(to_inf, ih, hi)
+    lo = jnp.where(to_inf, il, sigl)
+    return hi, lo
+
+
+def _norm_sig(exp, fh, fl):
+    """Effective (exp, 53-bit significand in [2^52, 2^53)) for a finite
+    nonzero input; subnormals are normalised."""
+    is_sub = exp == 0
+    # Normal: implicit bit.
+    nh = fh | _u(0x100000)
+    # Subnormal: shift left until bit 52 set.
+    lz = clz64(fh, fl)                       # >= 11 for subnormals
+    shift = (lz - _u(11)).astype(U32)
+    sh, sl = shl64(fh, fl, shift)
+    eff_exp = jnp.where(is_sub,
+                        jnp.int32(1) - shift.astype(jnp.int32),
+                        exp.astype(jnp.int32))
+    sig_h = jnp.where(is_sub, sh, nh)
+    sig_l = jnp.where(is_sub, sl, fl)
+    return eff_exp, sig_h, sig_l
+
+
+# -- float64 add -------------------------------------------------------------
+
+def f64_add(ah, al, bh, bl) -> Pair:
+    """a + b on packed (hi, lo) uint32 pairs (float64_add,
+    softfloat.c)."""
+    ah, al, bh, bl = _u(ah), _u(al), _u(bh), _u(bl)
+    sa, ea, fah, fal = _unpack(ah, al)
+    sb, eb, fbh, fbl = _unpack(bh, bl)
+
+    a_nan = _is_nan(ea, fah, fal)
+    b_nan = _is_nan(eb, fbh, fbl)
+    a_inf = jnp.logical_and(ea == 0x7FF, (fah | fal) == 0)
+    b_inf = jnp.logical_and(eb == 0x7FF, (fbh | fbl) == 0)
+    a_zero = jnp.logical_and(ea == 0, (fah | fal) == 0)
+    b_zero = jnp.logical_and(eb == 0, (fbh | fbl) == 0)
+
+    # Magnitude ordering (exp, frac): ensure A >= B.
+    swap = jnp.logical_or(
+        ea < eb, jnp.logical_and(ea == eb, lt64(fah, fal, fbh, fbl)))
+    sa_, ea_, fah_, fal_ = (jnp.where(swap, sb, sa), jnp.where(swap, eb, ea),
+                            jnp.where(swap, fbh, fah),
+                            jnp.where(swap, fbl, fal))
+    sb_, eb_, fbh_, fbl_ = (jnp.where(swap, sa, sb), jnp.where(swap, ea, eb),
+                            jnp.where(swap, fah, fbh),
+                            jnp.where(swap, fal, fbl))
+
+    # Effective exponents/significands << 10 (softfloat's add alignment):
+    # [2^62, 2^63).
+    ea_eff, sah, sal = _norm_sig(ea_, fah_, fal_)
+    eb_eff, sbh, sbl = _norm_sig(eb_, fbh_, fbl_)
+    sah, sal = shl64(sah, sal, _u(10))
+    sbh, sbl = shl64(sbh, sbl, _u(10))
+    # Zero operands have garbage normalisation; zero them.
+    a_z = jnp.logical_and(ea_ == 0, (fah_ | fal_) == 0)
+    b_z = jnp.logical_and(eb_ == 0, (fbh_ | fbl_) == 0)
+    sah = jnp.where(a_z, _u(0), sah)
+    sal = jnp.where(a_z, _u(0), sal)
+    sbh = jnp.where(b_z, _u(0), sbh)
+    sbl = jnp.where(b_z, _u(0), sbl)
+    ea_eff = jnp.where(a_z, jnp.int32(1), ea_eff)
+    eb_eff = jnp.where(b_z, jnp.int32(1), eb_eff)
+
+    d = jnp.clip(ea_eff - eb_eff, 0, 127).astype(U32)
+    sbh, sbl = shr64_jam(sbh, sbl, d)
+
+    same_sign = sa_ == sb_
+    # Same sign: add; may carry to 2^63.
+    sumh, suml = add64(sah, sal, sbh, sbl)
+    carried = sumh >= _u(0x80000000)         # 2^63 reached
+    ch, cl = shr64_jam(sumh, suml, _u(1))
+    add_h = jnp.where(carried, ch, sumh)
+    add_l = jnp.where(carried, cl, suml)
+    add_exp = jnp.where(carried, ea_eff + 1, ea_eff)
+
+    # Opposite sign: subtract (A >= B in magnitude).
+    dfh, dfl = sub64(sah, sal, sbh, sbl)
+    cancel = (dfh | dfl) == 0
+    lz = clz64(dfh, dfl)                     # result bit at 62 -> lz == 1
+    norm_shift = jnp.clip(
+        jnp.minimum((lz - _u(1)).astype(jnp.int32), ea_eff - 1),
+        0, 63).astype(U32)
+    nfh, nfl = shl64(dfh, dfl, norm_shift)
+    sub_exp = ea_eff - norm_shift.astype(jnp.int32)
+
+    res_sign = sa_                           # A's sign (A is larger)
+    zh = jnp.where(same_sign, add_h, nfh)
+    zl = jnp.where(same_sign, add_l, nfl)
+    zexp = jnp.where(same_sign, add_exp, sub_exp)
+
+    hi, lo = _round_pack(res_sign, zexp, zh, zl, g=10)
+
+    # Exact cancellation -> +0 (round-to-nearest rule).
+    czh, czl = _pack_zero(_u(0))
+    hi = jnp.where(jnp.logical_and(~same_sign, cancel), czh, hi)
+    lo = jnp.where(jnp.logical_and(~same_sign, cancel), czl, lo)
+
+    # Both zero: (+0)+(+0)=+0, (-0)+(-0)=-0, mixed -> +0.
+    both_zero = jnp.logical_and(a_zero, b_zero)
+    zs = jnp.where(same_sign, sa, _u(0))
+    bzh, bzl = _pack_zero(zs)
+    hi = jnp.where(both_zero, bzh, hi)
+    lo = jnp.where(both_zero, bzl, lo)
+
+    # Infinities.
+    opp_inf = jnp.logical_and(jnp.logical_and(a_inf, b_inf), sa != sb)
+    any_inf = jnp.logical_or(a_inf, b_inf)
+    inf_sign = jnp.where(a_inf, sa, sb)
+    iih, iil = _pack_inf(inf_sign)
+    hi = jnp.where(any_inf, iih, hi)
+    lo = jnp.where(any_inf, iil, lo)
+
+    # NaNs (highest priority).
+    is_nan = jnp.logical_or(jnp.logical_or(a_nan, b_nan), opp_inf)
+    nh, nl = _canonical_nan()
+    hi = jnp.where(is_nan, nh, hi)
+    lo = jnp.where(is_nan, nl, lo)
+    return hi, lo
+
+
+def f64_sub(ah, al, bh, bl) -> Pair:
+    """a - b = a + (-b) (float64_sub)."""
+    return f64_add(ah, al, _u(bh) ^ _u(0x80000000), bl)
+
+
+# -- float64 mul -------------------------------------------------------------
+
+def f64_mul(ah, al, bh, bl) -> Pair:
+    ah, al, bh, bl = _u(ah), _u(al), _u(bh), _u(bl)
+    sa, ea, fah, fal = _unpack(ah, al)
+    sb, eb, fbh, fbl = _unpack(bh, bl)
+    zsign = sa ^ sb
+
+    a_nan = _is_nan(ea, fah, fal)
+    b_nan = _is_nan(eb, fbh, fbl)
+    a_inf = jnp.logical_and(ea == 0x7FF, (fah | fal) == 0)
+    b_inf = jnp.logical_and(eb == 0x7FF, (fbh | fbl) == 0)
+    a_zero = jnp.logical_and(ea == 0, (fah | fal) == 0)
+    b_zero = jnp.logical_and(eb == 0, (fbh | fbl) == 0)
+
+    ea_eff, sah, sal = _norm_sig(ea, fah, fal)
+    eb_eff, sbh, sbl = _norm_sig(eb, fbh, fbl)
+
+    # 53x53 -> 106-bit product in 4 limbs (sah <= 2^21).
+    h00, l00 = umul32(sal, sbl)
+    h01, l01 = umul32(sal, sbh)
+    h10, l10 = umul32(sah, sbl)
+    h11, l11 = umul32(sah, sbh)
+    p0 = l00
+    p1 = h00 + l01
+    c1 = (p1 < h00).astype(U32)
+    p1n = p1 + l10
+    c1 = c1 + (p1n < p1).astype(U32)
+    p1 = p1n
+    p2 = h01 + h10
+    c2 = (p2 < h01).astype(U32)
+    p2n = p2 + l11
+    c2 = c2 + (p2n < p2).astype(U32)
+    p2 = p2n + c1
+    c2 = c2 + (p2 < c1).astype(U32)
+    p3 = h11 + c2
+
+    zexp = ea_eff + eb_eff - 0x3FF
+
+    # Normalise the product to [2^105, 2^106): if below, shift left 1.
+    top_bit = (p3 >> 9) & _u(1)              # bit 105 of the product
+    lo_norm = top_bit == 0
+    # 128-bit shl by 1:
+    q3 = (p3 << 1) | (p2 >> 31)
+    q2 = (p2 << 1) | (p1 >> 31)
+    q1 = (p1 << 1) | (p0 >> 31)
+    q0 = p0 << 1
+    p3 = jnp.where(lo_norm, q3, p3)
+    p2 = jnp.where(lo_norm, q2, p2)
+    p1 = jnp.where(lo_norm, q1, p1)
+    p0 = jnp.where(lo_norm, q0, p0)
+    zexp = jnp.where(lo_norm, zexp, zexp + 1)
+
+    # zSig = bits [105:50] (56 bits), sticky from bits [49:0].
+    sig_l = (p1 >> 18) | (p2 << 14)
+    sig_h = (p2 >> 18) | (p3 << 14)
+    sig_h = sig_h & _u(0xFFFFFF)             # keep 56 bits total
+    sticky = jnp.logical_or(p0 != 0, (p1 & _u(0x3FFFF)) != 0)
+    sig_l = sig_l | sticky.astype(U32)
+
+    hi, lo = _round_pack(zsign, zexp, sig_h, sig_l)
+
+    # Zeros (0 * finite).
+    any_zero = jnp.logical_or(a_zero, b_zero)
+    zh, zl = _pack_zero(zsign)
+    hi = jnp.where(any_zero, zh, hi)
+    lo = jnp.where(any_zero, zl, lo)
+
+    # Infinities.
+    any_inf = jnp.logical_or(a_inf, b_inf)
+    ih, il = _pack_inf(zsign)
+    hi = jnp.where(any_inf, ih, hi)
+    lo = jnp.where(any_inf, il, lo)
+
+    # NaN: nan operand, or inf * 0.
+    inf_times_zero = jnp.logical_or(jnp.logical_and(a_inf, b_zero),
+                                    jnp.logical_and(b_inf, a_zero))
+    is_nan = jnp.logical_or(jnp.logical_or(a_nan, b_nan), inf_times_zero)
+    nh, nl = _canonical_nan()
+    hi = jnp.where(is_nan, nh, hi)
+    lo = jnp.where(is_nan, nl, lo)
+    return hi, lo
+
+
+# -- float64 div -------------------------------------------------------------
+
+def f64_div(ah, al, bh, bl) -> Pair:
+    ah, al, bh, bl = _u(ah), _u(al), _u(bh), _u(bl)
+    sa, ea, fah, fal = _unpack(ah, al)
+    sb, eb, fbh, fbl = _unpack(bh, bl)
+    zsign = sa ^ sb
+
+    a_nan = _is_nan(ea, fah, fal)
+    b_nan = _is_nan(eb, fbh, fbl)
+    a_inf = jnp.logical_and(ea == 0x7FF, (fah | fal) == 0)
+    b_inf = jnp.logical_and(eb == 0x7FF, (fbh | fbl) == 0)
+    a_zero = jnp.logical_and(ea == 0, (fah | fal) == 0)
+    b_zero = jnp.logical_and(eb == 0, (fbh | fbl) == 0)
+
+    ea_eff, sah, sal = _norm_sig(ea, fah, fal)
+    eb_eff, sbh, sbl = _norm_sig(eb, fbh, fbl)
+
+    zexp = ea_eff - eb_eff + 0x3FF
+
+    # Ensure dividend significand >= divisor significand.
+    a_lt = lt64(sah, sal, sbh, sbl)
+    dh, dl = shl64(sah, sal, _u(1))
+    sah = jnp.where(a_lt, dh, sah)
+    sal = jnp.where(a_lt, dl, sal)
+    zexp = jnp.where(a_lt, zexp - 1, zexp)
+
+    # Restoring division: 56 quotient bits (leading bit 1).
+    remh, reml = sah, sal
+    qh = _u(0)
+    ql = _u(0)
+    for _ in range(56):
+        ge = jnp.logical_not(lt64(remh, reml, sbh, sbl))
+        nrh, nrl = sub64(remh, reml, sbh, sbl)
+        remh = jnp.where(ge, nrh, remh)
+        reml = jnp.where(ge, nrl, reml)
+        remh, reml = shl64(remh, reml, _u(1))
+        qh, ql = shl64(qh, ql, _u(1))
+        ql = ql | ge.astype(U32)
+    sticky = (remh | reml) != 0
+    ql = ql | sticky.astype(U32)
+
+    hi, lo = _round_pack(zsign, zexp, qh, ql)
+
+    # x / inf -> 0;  0 / y -> 0.
+    to_zero = jnp.logical_or(b_inf, a_zero)
+    zh, zl = _pack_zero(zsign)
+    hi = jnp.where(to_zero, zh, hi)
+    lo = jnp.where(to_zero, zl, lo)
+
+    # inf / y -> inf;  x / 0 -> inf.
+    to_inf = jnp.logical_or(a_inf, b_zero)
+    ih, il = _pack_inf(zsign)
+    hi = jnp.where(to_inf, ih, hi)
+    lo = jnp.where(to_inf, il, lo)
+
+    # NaN: nan operand, inf/inf, 0/0.
+    is_nan = jnp.logical_or(
+        jnp.logical_or(a_nan, b_nan),
+        jnp.logical_or(jnp.logical_and(a_inf, b_inf),
+                       jnp.logical_and(a_zero, b_zero)))
+    nh, nl = _canonical_nan()
+    hi = jnp.where(is_nan, nh, hi)
+    lo = jnp.where(is_nan, nl, lo)
+    return hi, lo
+
+
+# -- numpy oracle ------------------------------------------------------------
+
+def canonicalize_nan64(bits: np.ndarray) -> np.ndarray:
+    """uint64 bit patterns: any NaN -> 0x7FF8000000000000."""
+    bits = np.asarray(bits, np.uint64)
+    exp = (bits >> np.uint64(52)) & np.uint64(0x7FF)
+    frac = bits & np.uint64((1 << 52) - 1)
+    is_nan = (exp == 0x7FF) & (frac != 0)
+    return np.where(is_nan, np.uint64(0x7FF8000000000000), bits)
+
+
+def oracle_op(op: str, a_bits: np.ndarray, b_bits: np.ndarray) -> np.ndarray:
+    """IEEE-correct reference via numpy float64 (round-nearest-even)."""
+    a = np.asarray(a_bits, np.uint64).view(np.float64)
+    b = np.asarray(b_bits, np.uint64).view(np.float64)
+    with np.errstate(all="ignore"):
+        if op == "add":
+            z = a + b
+        elif op == "sub":
+            z = a - b
+        elif op == "mul":
+            z = a * b
+        elif op == "div":
+            z = a / b
+        else:
+            raise ValueError(op)
+    return canonicalize_nan64(z.view(np.uint64))
+
+
+def split_bits(bits: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    bits = np.asarray(bits, np.uint64)
+    return ((bits >> np.uint64(32)).astype(np.uint32),
+            (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def join_bits(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return ((np.asarray(hi, np.uint64) << np.uint64(32))
+            | np.asarray(lo, np.uint64))

@@ -1,0 +1,261 @@
+"""CHStone dfadd / dfmul / dfdiv / dfsin: IEEE double soft-float kernels
+(reference: tests/chstone/{dfadd,dfmul,dfdiv,dfsin}/).
+
+The reference kernels drive a C softfloat library over embedded test
+vectors -- dfadd: 46 float64_add cases (dfadd.c:57-232), dfmul/dfdiv the
+same shape for mul/div, dfsin: a sine computed from add/mul/div + the
+int conversions (dfsin.c).  The TPU regions run the
+:mod:`~coast_tpu.models.chstone.df64` limb soft-float on-device:
+
+  * df{add,mul,div}: one step = one test vector through the op; the
+    vector set covers every special-value pair (0/±1/±1.5/±inf/NaN,
+    denormals, max/min normals) plus seeded random patterns, and goldens
+    come from numpy's IEEE float64 (NaNs canonicalised) -- a stronger
+    oracle than embedded constants.
+  * dfsin: one step = one Taylor term of one input
+    (term_j = -term_{j-1}·x²/((2j)(2j+1)), 10 terms x 36 inputs); the
+    golden runs the identical recurrence in numpy float64, so the device
+    result must match bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+from coast_tpu.models.chstone import df64
+
+_SPECIALS = np.array([
+    0x0000000000000000, 0x8000000000000000,        # +-0
+    0x3FF0000000000000, 0xBFF0000000000000,        # +-1
+    0x3FF8000000000000, 0xBFF8000000000000,        # +-1.5
+    0x4000000000000000, 0xC000000000000000,        # +-2
+    0x7FF0000000000000, 0xFFF0000000000000,        # +-inf
+    0x7FF8000000000000,                            # nan
+    0x0000000000000001, 0x000FFFFFFFFFFFFF,        # denormals
+    0x0010000000000000, 0x7FEFFFFFFFFFFFFF,        # min/max normal
+    0x3FF0000000000001, 0x3CA0000000000000,        # 1+ulp, 2^-53
+], dtype=np.uint64)
+
+N_VECTORS = 64
+
+
+def _vectors(op: str) -> tuple:
+    """Special-pair coverage + seeded randoms, like the reference's matrix
+    of 0/1/1.5/inf/nan combinations (dfadd.c:58-155)."""
+    rng = np.random.RandomState({"add": 11, "mul": 22, "div": 33}[op])
+    k = len(_SPECIALS)
+    idx = np.arange(N_VECTORS)
+    a = _SPECIALS[idx % k].copy()
+    b = _SPECIALS[(idx * 7 + 3) % k].copy()
+    n_rand = N_VECTORS - 40
+    a[40:] = rng.randint(0, 2**64, n_rand, dtype=np.uint64)
+    b[40:] = rng.randint(0, 2**64, n_rand, dtype=np.uint64)
+    return a, b
+
+
+def _split2(bits: np.ndarray) -> np.ndarray:
+    hi, lo = df64.split_bits(bits)
+    return np.stack([hi, lo], axis=-1)
+
+
+def _make_df_op_region(kname: str, op: str,
+                       op_fn: Callable) -> Region:
+    a_bits, b_bits = _vectors(op)
+    golden = _split2(df64.oracle_op(op, a_bits, b_bits))
+
+    a_in = _split2(a_bits)
+    b_in = _split2(b_bits)
+
+    def init():
+        return {
+            "a_in": jnp.asarray(a_in),
+            "b_in": jnp.asarray(b_in),
+            "z": jnp.zeros((N_VECTORS, 2), jnp.uint32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = jnp.clip(state["i"], 0, N_VECTORS - 1)
+        a = jnp.take(state["a_in"], i, axis=0, mode="clip")
+        b = jnp.take(state["b_in"], i, axis=0, mode="clip")
+        zh, zl = op_fn(a[0], a[1], b[0], b[1])
+        z = state["z"].at[i].set(jnp.stack([zh, zl]), mode="drop")
+        return {"a_in": state["a_in"], "b_in": state["b_in"],
+                "z": z, "i": state["i"] + 1}
+
+    def done(state):
+        return state["i"] >= N_VECTORS
+
+    def check(state):
+        # main_result counts exact matches (dfadd.c:218); errors = misses.
+        row_bad = jnp.any(state["z"] != jnp.asarray(golden), axis=1)
+        return jnp.sum(row_bad).astype(jnp.int32)
+
+    def output(state):
+        return state["z"].reshape(-1)
+
+    graph = BlockGraph(
+        names=["entry", f"float64_{op}", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N_VECTORS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name=kname,
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_VECTORS,
+        max_steps=N_VECTORS + 8,
+        spec={
+            "a_in": LeafSpec(KIND_RO),
+            "b_in": LeafSpec(KIND_RO),
+            "z": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": f"numpy float64 {op} (NaN-canonicalised)"},
+    )
+
+
+def make_dfadd() -> Region:
+    return _make_df_op_region("chstone_dfadd", "add", df64.f64_add)
+
+
+def make_dfmul() -> Region:
+    return _make_df_op_region("chstone_dfmul", "mul", df64.f64_mul)
+
+
+def make_dfdiv() -> Region:
+    return _make_df_op_region("chstone_dfdiv", "div", df64.f64_div)
+
+
+# -- dfsin -------------------------------------------------------------------
+
+N_INPUTS = 36
+N_TERMS = 10
+SIN_STEPS = N_INPUTS * N_TERMS
+
+
+def _dbl(x: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", x))[0]
+
+
+# Term divisors (2j)(2j+1), j=1..9: small exact integers.
+_DIVS = [float((2 * j) * (2 * j + 1)) for j in range(1, N_TERMS)]
+
+
+def _sin_inputs() -> np.ndarray:
+    xs = [-np.pi + k * (2 * np.pi / (N_INPUTS - 1)) for k in range(N_INPUTS)]
+    return np.array([_dbl(float(v)) for v in xs], dtype=np.uint64)
+
+
+def _sin_golden(x_bits: np.ndarray) -> np.ndarray:
+    """The identical recurrence in numpy float64 (one rounding per op,
+    matching the device sequence exactly)."""
+    out = []
+    for xb in x_bits:
+        x = np.uint64(xb).view(np.float64)
+        with np.errstate(all="ignore"):
+            x2 = x * x
+            term = x
+            acc = x
+            for j in range(1, N_TERMS):
+                term = np.float64(term * x2)
+                term = np.float64(term / np.float64(_DIVS[j - 1]))
+                term = -term
+                acc = np.float64(acc + term)
+        out.append(np.float64(acc).view(np.uint64))
+    return df64.canonicalize_nan64(np.array(out, dtype=np.uint64))
+
+
+def make_dfsin() -> Region:
+    x_bits = _sin_inputs()
+    golden = _split2(_sin_golden(x_bits))
+    x_in = _split2(x_bits)
+    divs = _split2(np.array([_dbl(d) for d in _DIVS], dtype=np.uint64))
+
+    def init():
+        return {
+            "x_in": jnp.asarray(x_in),
+            "divs": jnp.asarray(divs),
+            "acc": jnp.zeros((N_INPUTS, 2), jnp.uint32),
+            "term": jnp.zeros(2, jnp.uint32),
+            "x2": jnp.zeros(2, jnp.uint32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        inp = jnp.clip(i // N_TERMS, 0, N_INPUTS - 1)
+        j = i % N_TERMS
+        first = j == 0
+
+        x = jnp.take(state["x_in"], inp, axis=0, mode="clip")
+        x2h, x2l = df64.f64_mul(x[0], x[1], x[0], x[1])
+        x2 = jnp.where(first, jnp.stack([x2h, x2l]), state["x2"])
+
+        # term_j = -(term_{j-1} * x2) / divs[j-1]
+        th, tl = df64.f64_mul(state["term"][0], state["term"][1],
+                              x2[0], x2[1])
+        d = jnp.take(state["divs"], jnp.clip(j - 1, 0, N_TERMS - 2),
+                     axis=0, mode="clip")
+        th, tl = df64.f64_div(th, tl, d[0], d[1])
+        th = th ^ jnp.uint32(0x80000000)          # negate (exact)
+        term = jnp.where(first, x, jnp.stack([th, tl]))
+
+        acc_prev = jnp.take(state["acc"], inp, axis=0, mode="clip")
+        sh, sl = df64.f64_add(acc_prev[0], acc_prev[1], term[0], term[1])
+        acc_new = jnp.where(first, x, jnp.stack([sh, sl]))
+        acc = state["acc"].at[inp].set(acc_new, mode="drop")
+
+        return {"x_in": state["x_in"], "divs": state["divs"],
+                "acc": acc, "term": term, "x2": x2, "i": i + 1}
+
+    def done(state):
+        return state["i"] >= SIN_STEPS
+
+    def check(state):
+        row_bad = jnp.any(state["acc"] != jnp.asarray(golden), axis=1)
+        return jnp.sum(row_bad).astype(jnp.int32)
+
+    def output(state):
+        return state["acc"].reshape(-1)
+
+    graph = BlockGraph(
+        names=["entry", "sin_term", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= SIN_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="chstone_dfsin",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=SIN_STEPS,
+        max_steps=SIN_STEPS + 8,
+        spec={
+            "x_in": LeafSpec(KIND_RO),
+            "divs": LeafSpec(KIND_RO),
+            "acc": LeafSpec(KIND_MEM),
+            "term": LeafSpec(KIND_MEM),
+            "x2": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "numpy float64 identical-recurrence Taylor sine"},
+    )

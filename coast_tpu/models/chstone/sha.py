@@ -1,0 +1,151 @@
+"""CHStone sha: SHA-1 over two 8 KiB streams (reference:
+tests/chstone/sha/{sha.c,sha_driver.c,sha_data.c}).
+
+The reference hashes VSIZE=2 input vectors of 8192 bytes each
+(sha_data.c:1090 ``in_i``) and self-checks the final digest words against
+an embedded expected vector (sha_driver.c outData).  Here the two streams
+are deterministic generated text, padding is precomputed host-side into the
+read-only block array, and the golden digests come from ``hashlib`` -- an
+independent reference implementation, a stronger oracle than an embedded
+constant.  One region step = one SHA-1 block compression (the 80-round
+schedule is unrolled inside the step; the scan over blocks is the stepped
+dimension, so a campaign flips bits in digests/schedules mid-stream).
+
+State layout:
+  * ``msg``    (ro)   [2, 129, 16] uint32: padded big-endian message blocks
+  * ``digest`` (mem)  [2, 5] uint32: running h0..h4 per stream
+  * ``i``      (ctrl) step counter (which (stream, block) is next)
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+
+N_STREAMS = 2
+STREAM_BYTES = 8192
+BLOCKS_PER_STREAM = STREAM_BYTES // 64 + 1        # +1 padding block
+TOTAL_STEPS = N_STREAMS * BLOCKS_PER_STREAM
+
+_H0 = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+
+_TEXT = (b"Wear sunscreen. If I could offer you only one tip for the "
+         b"future, sunscreen would be it. The long term benefits of "
+         b"sunscreen have been proved by scientists. ")
+
+
+def _stream_bytes(k: int) -> bytes:
+    """Deterministic 8 KiB corpus per stream (stream index varies the
+    phase so the two hashes differ)."""
+    reps = (STREAM_BYTES // len(_TEXT) + 2)
+    return (_TEXT * reps)[k * 37: k * 37 + STREAM_BYTES]
+
+
+def _padded_blocks(data: bytes) -> np.ndarray:
+    """SHA-1 padding -> [BLOCKS_PER_STREAM, 16] big-endian uint32.
+    len(data) is a multiple of 64, so exactly one extra block is needed."""
+    bitlen = 8 * len(data)
+    padded = data + b"\x80" + b"\x00" * 55 + bitlen.to_bytes(8, "big")
+    arr = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+    return arr.reshape(-1, 16)
+
+
+def _rotl(x, n):
+    return ((x << np.uint32(n)) | (x >> np.uint32(32 - n))).astype(jnp.uint32)
+
+
+def make_region() -> Region:
+    msg_np = np.stack([_padded_blocks(_stream_bytes(k))
+                       for k in range(N_STREAMS)])
+    golden = np.stack([
+        np.frombuffer(hashlib.sha1(_stream_bytes(k)).digest(),
+                      dtype=">u4").astype(np.uint32)
+        for k in range(N_STREAMS)])
+
+    def init():
+        return {
+            "msg": jnp.asarray(msg_np),
+            "digest": jnp.tile(jnp.asarray(_H0, jnp.uint32), (N_STREAMS, 1)),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        stream = jnp.clip(i // BLOCKS_PER_STREAM, 0, N_STREAMS - 1)
+        blk = i % BLOCKS_PER_STREAM
+        first = blk == 0
+
+        w16 = jnp.take(jnp.take(state["msg"], stream, axis=0), blk, axis=0,
+                       mode="clip")
+        # Message schedule W[0..79] (sha_transform, sha.c:92-102).
+        w = [w16[j] for j in range(16)]
+        for j in range(16, 80):
+            w.append(_rotl(w[j - 3] ^ w[j - 8] ^ w[j - 14] ^ w[j - 16], 1))
+
+        # A fresh block of a new stream starts from H0; otherwise continue
+        # the running digest.
+        h = jnp.where(first, jnp.asarray(_H0, jnp.uint32),
+                      jnp.take(state["digest"], stream, axis=0))
+        a, b, c, d, e = (h[0], h[1], h[2], h[3], h[4])
+        for j in range(80):
+            if j < 20:
+                f = (b & c) | (~b & d)
+                k = np.uint32(0x5A827999)
+            elif j < 40:
+                f = b ^ c ^ d
+                k = np.uint32(0x6ED9EBA1)
+            elif j < 60:
+                f = (b & c) | (b & d) | (c & d)
+                k = np.uint32(0x8F1BBCDC)
+            else:
+                f = b ^ c ^ d
+                k = np.uint32(0xCA62C1D6)
+            tmp = (_rotl(a, 5) + f + e + w[j] + k).astype(jnp.uint32)
+            a, b, c, d, e = tmp, a, _rotl(b, 30), c, d
+
+        new_h = (h + jnp.stack([a, b, c, d, e])).astype(jnp.uint32)
+        digest = state["digest"].at[stream].set(new_h)
+        return {"msg": state["msg"], "digest": digest, "i": i + 1}
+
+    def done(state):
+        return state["i"] >= TOTAL_STEPS
+
+    def check(state):
+        # main_result counts matching digest words (sha_driver.c:53-57);
+        # our error count is the complement: mismatched words.
+        return jnp.sum(state["digest"] != jnp.asarray(golden)).astype(jnp.int32)
+
+    def output(state):
+        return state["digest"].reshape(-1)
+
+    graph = BlockGraph(
+        names=["entry", "sha_transform", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= TOTAL_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="chstone_sha",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=TOTAL_STEPS,
+        max_steps=TOTAL_STEPS + 8,
+        spec={
+            "msg": LeafSpec(KIND_RO),
+            "digest": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"golden": golden.tolist(),
+              "oracle": "hashlib.sha1 digests of both streams"},
+    )

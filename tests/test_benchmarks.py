@@ -25,6 +25,15 @@ FLIP_TARGETS = {
     # off by the fetch, mips.c IADDR) and derails the instruction stream.
     "chstone_mips": ("pc", 0, 3, 100),
     "towersOfHanoi": ("sp", 0, 2, 100),
+    "chstone_sha": ("digest", 0, 7, 100),
+    # flip an already-written code word before the decode phase reads it
+    "chstone_adpcm": ("compressed", 3, 2, 30),
+    # S-box word flip mid-CFB-stream: the table-driven-cipher SDC classic
+    "chstone_blowfish": ("S", 100, 5, 600),
+    "chstone_dfadd": ("z", 2, 19, 32),
+    "chstone_dfmul": ("z", 2, 19, 32),
+    "chstone_dfdiv": ("z", 2, 19, 32),
+    "chstone_dfsin": ("acc", 0, 19, 200),
 }
 
 

@@ -1,0 +1,153 @@
+"""CHStone kernel regions (SURVEY.md §2.3 #31; BASELINE config 4).
+
+Tier-1 discipline per kernel: unprotected golden passes, TMR/DWC preserve
+semantics, and a single-lane flip is masked (TMR) / detected-or-benign
+(DWC).  Plus kernel-specific anchors: the published Blowfish zero-key test
+vector and bit-exactness of the limb soft-float against numpy's IEEE
+doubles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.models import CHSTONE, REGISTRY
+
+KERNELS = ("chstone_sha", "chstone_adpcm", "chstone_blowfish",
+           "chstone_dfadd", "chstone_dfmul", "chstone_dfdiv",
+           "chstone_dfsin")
+
+
+@pytest.fixture(scope="module")
+def regions():
+    return {k: REGISTRY[k]() for k in KERNELS}
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_unprotected_golden(regions, kernel):
+    region = regions[kernel]
+    region.validate()
+    state = region.run_unprotected()
+    assert int(region.check(state)) == 0
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_tmr_preserves_semantics(regions, kernel):
+    rec = jax.device_get(jax.jit(TMR(regions[kernel]).run)())
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+    assert int(rec["steps"]) == regions[kernel].nominal_steps
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_dwc_preserves_semantics(regions, kernel):
+    rec = jax.device_get(jax.jit(DWC(regions[kernel]).run)())
+    assert int(rec["errors"]) == 0
+    assert not bool(rec["dwc_fault"])
+
+
+def _mem_fault(prog, t):
+    """A flip into the first replicated mem leaf at step t, lane 1."""
+    leaf = next(n for n in prog.leaf_order
+                if prog.replicated[n] and prog.region.spec[n].kind == "mem")
+    return leaf, {
+        "leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+        "lane": jnp.int32(1), "word": jnp.int32(0),
+        "bit": jnp.int32(13), "t": jnp.int32(t)}
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_tmr_masks_single_lane_flip(regions, kernel):
+    prog = TMR(regions[kernel])
+    _, fault = _mem_fault(prog, regions[kernel].nominal_steps // 2)
+    rec = jax.device_get(jax.jit(prog.run)(fault))
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+
+
+def test_chstone_suite_registered():
+    assert set(KERNELS) < set(CHSTONE)
+    assert "chstone_mips" in CHSTONE
+    assert len(CHSTONE) >= 9
+
+
+# -- kernel-specific anchors -------------------------------------------------
+
+def test_sha_matches_hashlib(regions):
+    import hashlib
+    from coast_tpu.models.chstone import sha as sha_mod
+    state = regions["chstone_sha"].run_unprotected()
+    digest0 = np.asarray(state["digest"])[0]
+    want = np.frombuffer(
+        hashlib.sha1(sha_mod._stream_bytes(0)).digest(), dtype=">u4")
+    assert (digest0 == want.astype(np.uint32)).all()
+
+
+def test_blowfish_published_vector():
+    from coast_tpu.models.chstone import blowfish as bf
+    p, s = bf.key_schedule(bytes(8))
+    assert bf._encrypt_block(p, s, 0, 0) == (0x4EF99745, 0x6198DD78)
+    assert bf.pi_hex_words()[0] == 0x243F6A88     # Blowfish P[0]
+
+
+def test_adpcm_region_matches_oracle(regions):
+    from coast_tpu.models.chstone import adpcm
+    state = regions["chstone_adpcm"].run_unprotected()
+    g_comp, g_res = adpcm.golden_reference(adpcm.make_input())
+    assert np.array_equal(np.asarray(state["compressed"]),
+                          g_comp.astype(np.int32))
+    assert np.array_equal(np.asarray(state["result"]),
+                          g_res.astype(np.int32))
+
+
+def test_df64_bit_exact_vs_numpy():
+    from coast_tpu.models.chstone import df64
+    rng = np.random.RandomState(7)
+    a = rng.randint(0, 2**64, 512, dtype=np.uint64)
+    b = rng.randint(0, 2**64, 512, dtype=np.uint64)
+    ah, al = df64.split_bits(a)
+    bh, bl = df64.split_bits(b)
+    for op, fn in (("add", df64.f64_add), ("mul", df64.f64_mul),
+                   ("div", df64.f64_div)):
+        zh, zl = jax.jit(jax.vmap(fn))(
+            jnp.asarray(ah), jnp.asarray(al), jnp.asarray(bh),
+            jnp.asarray(bl))
+        got = df64.join_bits(np.asarray(zh), np.asarray(zl))
+        want = df64.oracle_op(op, a, b)
+        assert (got == want).all(), f"{op} diverged from IEEE"
+
+
+def test_df64_specials_and_denormals():
+    from coast_tpu.models.chstone import df64
+    from coast_tpu.models.chstone.dfkernels import _SPECIALS
+    a = np.repeat(_SPECIALS, len(_SPECIALS))
+    b = np.tile(_SPECIALS, len(_SPECIALS))
+    ah, al = df64.split_bits(a)
+    bh, bl = df64.split_bits(b)
+    for op, fn in (("add", df64.f64_add), ("sub", df64.f64_sub),
+                   ("mul", df64.f64_mul), ("div", df64.f64_div)):
+        zh, zl = jax.jit(jax.vmap(fn))(
+            jnp.asarray(ah), jnp.asarray(al), jnp.asarray(bh),
+            jnp.asarray(bl))
+        got = df64.join_bits(np.asarray(zh), np.asarray(zl))
+        want = df64.oracle_op(op, a, b)
+        assert (got == want).all(), f"{op} special-matrix divergence"
+
+
+def test_blowfish_sbox_flip_is_classic_sdc(regions):
+    """A single unprotected S-box flip corrupts the ciphertext stream --
+    the table-driven-cipher SDC scenario TMR exists for."""
+    region = regions["chstone_blowfish"]
+    unprot = unprotected(region)
+    fault = {"leaf_id": jnp.int32(unprot.leaf_order.index("S")),
+             "lane": jnp.int32(0), "word": jnp.int32(100),
+             "bit": jnp.int32(5),
+             "t": jnp.int32(600)}      # after key schedule, mid-stream
+    rec = jax.device_get(jax.jit(unprot.run)(fault))
+    assert int(rec["errors"]) > 0
+    prog = TMR(region)
+    fault["lane"] = jnp.int32(1)
+    rec2 = jax.device_get(jax.jit(prog.run)(fault))
+    assert int(rec2["errors"]) == 0
