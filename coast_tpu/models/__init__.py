@@ -32,6 +32,9 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     "chstone_dfmul": _lazy("chstone.dfkernels", "make_dfmul"),
     "chstone_dfdiv": _lazy("chstone.dfkernels", "make_dfdiv"),
     "chstone_dfsin": _lazy("chstone.dfkernels", "make_dfsin"),
+    "chstone_gsm": _lazy("chstone.gsm"),
+    "chstone_motion": _lazy("chstone.motion"),
+    "chstone_jpeg": _lazy("chstone.jpeg"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The
@@ -39,4 +42,5 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
 # (tests/chstone/Makefile.common:1-3); aes is the shared aes region.
 CHSTONE = ("chstone_mips", "chstone_sha", "chstone_adpcm",
            "chstone_blowfish", "chstone_dfadd", "chstone_dfmul",
-           "chstone_dfdiv", "chstone_dfsin", "aes")
+           "chstone_dfdiv", "chstone_dfsin", "chstone_gsm",
+           "chstone_motion", "chstone_jpeg", "aes")

@@ -1,0 +1,77 @@
+"""Shared bitstream helpers for the VLC-driven CHStone kernels
+(motion: Table B-10 decode; jpeg: Huffman entropy decode).
+
+Host side: MSB-first bit writer/reader over 32-bit words (the shape of
+the reference's ``ld->Rdbfr`` buffer, getbits.c).  Device side: a traced
+``show_bits`` window extractor over a uint32 word array.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BitWriter:
+    """MSB-first accumulator; ``words()`` pads with ``pad_bit`` plus two
+    guard words so device reads past the end stay in bounds."""
+
+    def __init__(self, pad_bit: int = 0):
+        self.bits: List[int] = []
+        self.pad_bit = pad_bit
+
+    def put(self, value: int, n: int) -> None:
+        for k in range(n - 1, -1, -1):
+            self.bits.append((value >> k) & 1)
+
+    def words(self) -> np.ndarray:
+        bits = self.bits + [self.pad_bit] * ((-len(self.bits)) % 32 + 64)
+        out = []
+        for w in range(0, len(bits), 32):
+            v = 0
+            for b in bits[w:w + 32]:
+                v = (v << 1) | b
+            out.append(v)
+        return np.array(out, np.uint32)
+
+
+class BitReader:
+    """MSB-first reader over a bit list or a uint32 word array."""
+
+    def __init__(self, source):
+        if isinstance(source, np.ndarray):
+            self.bits = []
+            for w in source:
+                for k in range(31, -1, -1):
+                    self.bits.append((int(w) >> k) & 1)
+        else:
+            self.bits = list(source)
+        self.pos = 0
+
+    def get(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.bits[self.pos]
+            self.pos += 1
+        return v
+
+    def show(self, n: int) -> int:
+        v = 0
+        for k in range(n):
+            b = self.bits[self.pos + k] if self.pos + k < len(self.bits) else 0
+            v = (v << 1) | b
+        return v
+
+
+def jshow(words, pos, n: int):
+    """Traced: the n-bit window (n <= 25) at bit cursor ``pos`` of a
+    uint32 word array (Show_Bits, getbits.c:102)."""
+    w = pos >> 5
+    off = (pos & 31).astype(jnp.uint32)
+    w1 = jnp.take(words, w, mode="clip")
+    w2 = jnp.take(words, w + 1, mode="clip")
+    hi = w1 << off
+    lo = jnp.where(off == 0, jnp.uint32(0), w2 >> (jnp.uint32(32) - off))
+    return ((hi | lo) >> np.uint32(32 - n)).astype(jnp.int32)

@@ -1,0 +1,480 @@
+"""CHStone jpeg: baseline JPEG decode core -- Huffman entropy decode,
+dequantisation, integer IDCT (reference: tests/chstone/jpeg/{decode.c,
+huffman.c,chenidct.c}).
+
+The reference decodes an embedded JFIF image: marker parse, Huffman decode
+of DCT coefficient blocks, dequantise, Chen IDCT, self-check against an
+expected pixel array.  The TPU region keeps the computational core with
+the marker/header layer resolved at build time (the reference's init.c
+tables play that role there):
+
+  * build time: a deterministic 16-block 8x8 image is forward-DCT'd,
+    quantised (standard luminance table), zigzag'd and Huffman-encoded
+    with the JPEG Annex K.3 luminance tables -- producing a valid
+    entropy-coded stream;
+  * device: a stepped state machine over that stream.  One step = one
+    Huffman symbol (canonical min/max-code ladder over 16 lengths, like
+    huffman.c's DecodeHuffman) + its magnitude bits (receive/extend), or
+    one block's dequant + fixed-point 2D IDCT once its EOB arrives.
+
+Golden: the pure-python oracle below decodes the same stream with the
+same integer IDCT (bit-identical arithmetic), and the decoded pixels are
+additionally checked to reconstruct the original image within quantisation
+error -- proving the pipeline is a real JPEG decode, not a tautology.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+from coast_tpu.models.chstone._bits import BitReader, BitWriter, jshow
+
+NB = 16                       # 8x8 blocks
+CONST_BITS = 13
+PASS1_BITS = 2
+
+# Standard luminance quantisation table (Annex K.1), zigzag source order.
+QTAB = np.array([
+    16, 11, 10, 16, 24, 40, 51, 61,
+    12, 12, 14, 19, 26, 58, 60, 55,
+    14, 13, 16, 24, 40, 57, 69, 56,
+    14, 17, 22, 29, 51, 87, 80, 62,
+    18, 22, 37, 56, 68, 109, 103, 77,
+    24, 35, 55, 64, 81, 104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101,
+    72, 92, 95, 98, 112, 100, 103, 99], np.int64).reshape(8, 8)
+
+ZIGZAG = np.array([
+    0, 1, 8, 16, 9, 2, 3, 10, 17, 24, 32, 25, 18, 11, 4, 5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6, 7, 14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63],
+    np.int64)
+
+# Annex K.3.1: luminance DC (BITS, HUFFVAL).
+DC_BITS = [0, 1, 5, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+DC_VALS = list(range(12))
+# Annex K.3.2: luminance AC.
+AC_BITS = [0, 2, 1, 3, 3, 2, 4, 3, 5, 5, 4, 4, 0, 0, 1, 0x7D]
+AC_VALS = [
+    0x01, 0x02, 0x03, 0x00, 0x04, 0x11, 0x05, 0x12, 0x21, 0x31, 0x41,
+    0x06, 0x13, 0x51, 0x61, 0x07, 0x22, 0x71, 0x14, 0x32, 0x81, 0x91,
+    0xA1, 0x08, 0x23, 0x42, 0xB1, 0xC1, 0x15, 0x52, 0xD1, 0xF0, 0x24,
+    0x33, 0x62, 0x72, 0x82, 0x09, 0x0A, 0x16, 0x17, 0x18, 0x19, 0x1A,
+    0x25, 0x26, 0x27, 0x28, 0x29, 0x2A, 0x34, 0x35, 0x36, 0x37, 0x38,
+    0x39, 0x3A, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48, 0x49, 0x4A, 0x53,
+    0x54, 0x55, 0x56, 0x57, 0x58, 0x59, 0x5A, 0x63, 0x64, 0x65, 0x66,
+    0x67, 0x68, 0x69, 0x6A, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78, 0x79,
+    0x7A, 0x83, 0x84, 0x85, 0x86, 0x87, 0x88, 0x89, 0x8A, 0x92, 0x93,
+    0x94, 0x95, 0x96, 0x97, 0x98, 0x99, 0x9A, 0xA2, 0xA3, 0xA4, 0xA5,
+    0xA6, 0xA7, 0xA8, 0xA9, 0xAA, 0xB2, 0xB3, 0xB4, 0xB5, 0xB6, 0xB7,
+    0xB8, 0xB9, 0xBA, 0xC2, 0xC3, 0xC4, 0xC5, 0xC6, 0xC7, 0xC8, 0xC9,
+    0xCA, 0xD2, 0xD3, 0xD4, 0xD5, 0xD6, 0xD7, 0xD8, 0xD9, 0xDA, 0xE1,
+    0xE2, 0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8, 0xE9, 0xEA, 0xF1, 0xF2,
+    0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8, 0xF9, 0xFA]
+
+
+def _canonical(bits: List[int], vals: List[int]):
+    """(code, length) per symbol + the decoder ladder
+    (mincode/maxcode/valptr per length), JPEG Annex C."""
+    codes = {}
+    mincode = [0] * 17
+    maxcode = [-1] * 17
+    valptr = [0] * 17
+    code = 0
+    k = 0
+    for length in range(1, 17):
+        valptr[length] = k
+        mincode[length] = code
+        for _ in range(bits[length - 1]):
+            codes[vals[k]] = (code, length)
+            code += 1
+            k += 1
+        maxcode[length] = code - 1
+        code <<= 1
+    return codes, mincode, maxcode, valptr
+
+
+DC_CODES, DC_MIN, DC_MAX, DC_PTR = _canonical(DC_BITS, DC_VALS)
+AC_CODES, AC_MIN, AC_MAX, AC_PTR = _canonical(AC_BITS, AC_VALS)
+
+
+def make_image() -> np.ndarray:
+    """Deterministic [NB, 8, 8] image (smooth gradients + texture)."""
+    y, x = np.mgrid[0:8, 0:8]
+    blocks = []
+    for b in range(NB):
+        img = (128 + 60 * np.sin(2 * np.pi * (x + 3 * b) / 13)
+               + 40 * np.cos(2 * np.pi * (y + b) / 9)
+               + 10 * np.sin(2 * np.pi * (x * y) / 31 + b))
+        blocks.append(np.clip(img, 0, 255))
+    return np.array(blocks)
+
+
+def _fdct(block: np.ndarray) -> np.ndarray:
+    """Reference float forward DCT-II (8x8), level-shifted."""
+    f = block.astype(np.float64) - 128.0
+    n = 8
+    c = np.array([[np.cos((2 * i + 1) * u * np.pi / 16) for i in range(n)]
+                  for u in range(n)])
+    a = np.array([np.sqrt(1 / 8) if u == 0 else np.sqrt(2 / 8)
+                  for u in range(n)])
+    return a[:, None] * a[None, :] * (c @ f @ c.T)
+
+
+def _quantise(coef: np.ndarray) -> np.ndarray:
+    return np.round(coef / QTAB).astype(np.int64)
+
+
+def _size_cat(v: int) -> int:
+    return 0 if v == 0 else int(abs(v)).bit_length()
+
+
+class _Writer(BitWriter):
+    """BitWriter + JPEG magnitude coding; pads with 1s (Annex B)."""
+
+    def __init__(self):
+        super().__init__(pad_bit=1)
+
+    def put_code(self, code: int, length: int):
+        self.put(code, length)
+
+    def put_mag(self, v: int, size: int):
+        if size == 0:
+            return
+        if v < 0:
+            v = v + (1 << size) - 1
+        self.put(v, size)
+
+
+def encode(blocks_q: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Huffman-encode zigzag'd quantised blocks; returns (stream words,
+    total huffman-symbol count) -- the symbol count sizes the step budget."""
+    wr = _Writer()
+    pred = 0
+    n_sym = 0
+    for b in range(NB):
+        zz = blocks_q[b].reshape(64)[ZIGZAG]
+        diff = int(zz[0]) - pred
+        pred = int(zz[0])
+        size = _size_cat(diff)
+        code, length = DC_CODES[size]
+        wr.put_code(code, length)
+        wr.put_mag(diff, size)
+        n_sym += 1
+        run = 0
+        last_nz = 0
+        for k in range(1, 64):
+            if zz[k] != 0:
+                last_nz = k
+        for k in range(1, last_nz + 1):
+            v = int(zz[k])
+            if v == 0:
+                run += 1
+                continue
+            while run >= 16:
+                code, length = AC_CODES[0xF0]       # ZRL
+                wr.put_code(code, length)
+                n_sym += 1
+                run -= 16
+            size = _size_cat(v)
+            code, length = AC_CODES[(run << 4) | size]
+            wr.put_code(code, length)
+            wr.put_mag(v, size)
+            n_sym += 1
+            run = 0
+        if last_nz != 63:
+            code, length = AC_CODES[0x00]           # EOB
+            wr.put_code(code, length)
+            n_sym += 1
+    return wr.words(), n_sym
+
+
+# -- shared integer IDCT (host + device definitions kept in lockstep) --------
+
+_C = {  # round(cos(k*pi/16) * 2^13) constants, jpeg_idct_islow style
+    "0_298631336": 2446, "0_390180644": 3196, "0_541196100": 4433,
+    "0_765366865": 6270, "0_899976223": 7373, "1_175875602": 9633,
+    "1_501321110": 12299, "1_847759065": 15137, "1_961570560": 16069,
+    "2_053119869": 16819, "2_562915447": 20995, "3_072711026": 25172,
+}
+
+
+def _idct_1d(s0, s1, s2, s3, s4, s5, s6, s7, shift):
+    """One islow-style fixed-point IDCT pass over 8 values."""
+    z2, z3 = s2, s6
+    z1 = (z2 + z3) * _C["0_541196100"]
+    tmp2 = z1 + z3 * (-_C["1_847759065"])
+    tmp3 = z1 + z2 * _C["0_765366865"]
+    z2, z3 = s0, s4
+    tmp0 = (z2 + z3) * (1 << CONST_BITS)
+    tmp1 = (z2 - z3) * (1 << CONST_BITS)
+    t10, t13 = tmp0 + tmp3, tmp0 - tmp3
+    t11, t12 = tmp1 + tmp2, tmp1 - tmp2
+
+    t0, t1, t2, t3 = s7, s5, s3, s1
+    z1 = t0 + t3
+    z2 = t1 + t2
+    z3 = t0 + t2
+    z4 = t1 + t3
+    z5 = (z3 + z4) * _C["1_175875602"]
+    t0 = t0 * _C["0_298631336"]
+    t1 = t1 * _C["2_053119869"]
+    t2 = t2 * _C["3_072711026"]
+    t3 = t3 * _C["1_501321110"]
+    z1 = z1 * (-_C["0_899976223"])
+    z2 = z2 * (-_C["2_562915447"])
+    z3 = z3 * (-_C["1_961570560"]) + z5
+    z4 = z4 * (-_C["0_390180644"]) + z5
+    t0 = t0 + z1 + z3
+    t1 = t1 + z2 + z4
+    t2 = t2 + z2 + z3
+    t3 = t3 + z1 + z4
+
+    rnd = 1 << (shift - 1)
+    return ((t10 + t3 + rnd) >> shift, (t11 + t2 + rnd) >> shift,
+            (t12 + t1 + rnd) >> shift, (t13 + t0 + rnd) >> shift,
+            (t13 - t0 + rnd) >> shift, (t12 - t1 + rnd) >> shift,
+            (t11 - t2 + rnd) >> shift, (t10 - t3 + rnd) >> shift)
+
+
+def idct_2d_int(coef_rows):
+    """8x8 integer IDCT; input natural-order dequantised coefficients
+    (int64 numpy or int32 jnp [8,8]); output pixel block [8,8]."""
+    xp = jnp if isinstance(coef_rows, jax.Array) else np
+    c = coef_rows
+    # Pass 1: columns, descale CONST_BITS - PASS1_BITS.
+    cols = _idct_1d(c[0], c[1], c[2], c[3], c[4], c[5], c[6], c[7],
+                    CONST_BITS - PASS1_BITS)
+    w = xp.stack(cols)          # [8 rows of intermediate][8 cols]
+    # Pass 2: rows, descale CONST_BITS + PASS1_BITS + 3.
+    rows = _idct_1d(w[:, 0], w[:, 1], w[:, 2], w[:, 3],
+                    w[:, 4], w[:, 5], w[:, 6], w[:, 7],
+                    CONST_BITS + PASS1_BITS + 3)
+    out = xp.stack(rows, axis=1) + 128
+    return xp.clip(out, 0, 255)
+
+
+# -- host oracle -------------------------------------------------------------
+
+def _decode_symbol(rd: BitReader, mincode, maxcode, valptr, vals) -> int:
+    code = 0
+    for length in range(1, 17):
+        code = (code << 1) | rd.get(1)
+        if maxcode[length] >= code >= mincode[length]:
+            return vals[valptr[length] + code - mincode[length]]
+    raise ValueError("bad huffman code")
+
+
+def _extend(v: int, size: int) -> int:
+    if size == 0:
+        return 0
+    return v - ((1 << size) - 1) if v < (1 << (size - 1)) else v
+
+
+def golden_reference(words: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(pixels [NB,8,8], coefficients [NB,8,8], huffman symbol count)."""
+    rd = BitReader(words)
+    pred = 0
+    coefs = np.zeros((NB, 64), np.int64)
+    n_sym = 0
+    for b in range(NB):
+        size = _decode_symbol(rd, DC_MIN, DC_MAX, DC_PTR, DC_VALS)
+        diff = _extend(rd.get(size), size) if size else 0
+        pred += diff
+        coefs[b, 0] = pred
+        n_sym += 1
+        k = 1
+        while k < 64:
+            rs = _decode_symbol(rd, AC_MIN, AC_MAX, AC_PTR, AC_VALS)
+            n_sym += 1
+            run, size = rs >> 4, rs & 15
+            if rs == 0x00:
+                break
+            if rs == 0xF0:
+                k += 16
+                continue
+            k += run
+            coefs[b, k] = _extend(rd.get(size), size)
+            k += 1
+    # de-zigzag + dequantise + IDCT.
+    pixels = np.zeros((NB, 8, 8), np.int64)
+    nat = np.zeros((NB, 8, 8), np.int64)
+    for b in range(NB):
+        block = np.zeros(64, np.int64)
+        block[ZIGZAG] = coefs[b]
+        deq = block.reshape(8, 8) * QTAB
+        nat[b] = deq
+        pixels[b] = idct_2d_int(deq)
+    return pixels, nat, n_sym
+
+
+# -- region ------------------------------------------------------------------
+
+def make_region() -> Region:
+    image = make_image()
+    blocks_q = np.stack([_quantise(_fdct(image[b])) for b in range(NB)])
+    words, n_sym = encode(blocks_q)
+    g_pixels, _, n_sym2 = golden_reference(words)
+    assert n_sym == n_sym2
+    n_steps = n_sym + NB                 # symbols + one IDCT step per block
+
+    dc_min = jnp.asarray(DC_MIN, jnp.int32)
+    dc_max = jnp.asarray(DC_MAX, jnp.int32)
+    dc_ptr = jnp.asarray(DC_PTR, jnp.int32)
+    dc_vals = jnp.asarray(DC_VALS + [0] * 4, jnp.int32)
+    ac_min = jnp.asarray(AC_MIN, jnp.int32)
+    ac_max = jnp.asarray(AC_MAX, jnp.int32)
+    ac_ptr = jnp.asarray(AC_PTR, jnp.int32)
+    ac_vals = jnp.asarray(AC_VALS, jnp.int32)
+    qtab = jnp.asarray(QTAB.reshape(64), jnp.int32)
+    unzig = np.zeros(64, np.int64)
+    unzig[ZIGZAG] = np.arange(64)        # natural pos -> zigzag index
+    zig_of_nat = jnp.asarray(unzig, jnp.int32)
+
+    def _jdecode(words_arr, pos, mn, mx, ptr, vals):
+        """Canonical ladder: try lengths 1..16 (DecodeHuffman,
+        huffman.c)."""
+        peek16 = jshow(words_arr, pos, 16)
+        sym = jnp.int32(0)
+        length_found = jnp.int32(17)
+        for length in range(1, 17):
+            code = peek16 >> (16 - length)
+            hit = jnp.logical_and(code <= mx[length],
+                                  code >= mn[length])
+            first = jnp.logical_and(hit, length_found == 17)
+            idx = jnp.clip(ptr[length] + code - mn[length], 0,
+                           vals.shape[0] - 1)
+            sym = jnp.where(first, vals[idx], sym)
+            length_found = jnp.where(first, length, length_found)
+        return sym, jnp.clip(length_found, 1, 16)
+
+    def _jextend(v, size):
+        half = jnp.where(size == 0, 0, 1 << jnp.clip(size - 1, 0, 15))
+        full = jnp.where(size == 0, 1, (1 << jnp.clip(size, 0, 16)) - 1)
+        return jnp.where(size == 0, 0,
+                         jnp.where(v < half, v - full, v))
+
+    def init():
+        return {
+            "stream": jnp.asarray(words),
+            "coef": jnp.zeros((NB, 64), jnp.int32),   # zigzag order
+            "pixels": jnp.zeros((NB, 64), jnp.int32),
+            "pos": jnp.int32(0),
+            "blk": jnp.int32(0),
+            "k": jnp.int32(0),       # next zigzag position (0 = DC next)
+            "pred": jnp.int32(0),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        blk = jnp.clip(state["blk"], 0, NB - 1)
+        pos = state["pos"]
+        k = state["k"]
+
+        # --- entropy phase (k in [0, 64)) --------------------------------
+        is_dc = k == 0
+        dsym, dlen = _jdecode(state["stream"], pos, dc_min, dc_max,
+                              dc_ptr, dc_vals)
+        asym, alen = _jdecode(state["stream"], pos, ac_min, ac_max,
+                              ac_ptr, ac_vals)
+        sym = jnp.where(is_dc, dsym, asym)
+        slen = jnp.where(is_dc, dlen, alen)
+        size = jnp.where(is_dc, sym, sym & 15)
+        run = jnp.where(is_dc, 0, sym >> 4)
+        mag_raw = (jshow(state["stream"], pos + slen, 16)
+                   >> (16 - jnp.clip(size, 1, 16)))
+        mag = _jextend(jnp.where(size == 0, 0, mag_raw), size)
+        consumed = slen + size
+
+        eob = jnp.logical_and(~is_dc, sym == 0x00)
+        zrl = jnp.logical_and(~is_dc, sym == 0xF0)
+        pred_new = jnp.where(is_dc, state["pred"] + mag, state["pred"])
+        value = jnp.where(is_dc, pred_new, mag)
+        write_k = jnp.clip(jnp.where(is_dc, 0, k + run), 0, 63)
+        do_write = jnp.logical_and(~eob, ~zrl)
+        coef = jnp.where(
+            do_write,
+            state["coef"].at[blk, write_k].set(value, mode="drop"),
+            state["coef"])
+        k_next = jnp.where(eob, 64,
+                           jnp.where(zrl, k + 16, write_k + 1))
+        block_done = k_next >= 64
+
+        # --- IDCT phase (k == 64): dequant + 2D IDCT, advance block ------
+        in_idct = k >= 64
+        zz = jnp.take(state["coef"], blk, axis=0)
+        deq_zz = zz * jnp.take(qtab, ZIGZAG, axis=0)  # value at nat pos
+        nat = jnp.take(deq_zz, zig_of_nat, axis=0)    # natural order, via
+        # zig_of_nat[nat_pos] = zigzag index holding that coefficient
+        pix = idct_2d_int(nat.reshape(8, 8)).reshape(64).astype(jnp.int32)
+        pixels = jnp.where(
+            in_idct,
+            state["pixels"].at[blk].set(pix, mode="drop"),
+            state["pixels"])
+
+        new_blk = jnp.where(in_idct, state["blk"] + 1, state["blk"])
+        new_k = jnp.where(in_idct, 0, jnp.where(block_done, 64, k_next))
+        new_pos = jnp.where(in_idct, pos, pos + consumed)
+        finished = state["blk"] >= NB
+
+        return {
+            "stream": state["stream"],
+            "coef": jnp.where(in_idct | finished, state["coef"], coef),
+            "pixels": pixels,
+            "pos": jnp.where(finished, pos, new_pos),
+            "blk": jnp.where(finished, state["blk"], new_blk),
+            "k": jnp.where(finished, k, new_k),
+            "pred": jnp.where(in_idct | finished, state["pred"], pred_new),
+            "i": state["i"] + 1,
+        }
+
+    def done(state):
+        return state["blk"] >= NB
+
+    def check(state):
+        want = jnp.asarray(g_pixels.reshape(NB, 64), jnp.int32)
+        bad_pix = jnp.sum(jnp.any(state["pixels"] != want, axis=1))
+        return bad_pix.astype(jnp.int32)
+
+    def output(state):
+        return state["pixels"].reshape(-1).astype(jnp.uint32)
+
+    graph = BlockGraph(
+        names=["entry", "DecodeHuffMCU", "ChenIDct", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2), (2, 1), (2, 3)],
+        block_of=lambda s: jnp.where(
+            s["blk"] >= NB, jnp.int32(3),
+            jnp.where(s["k"] >= 64, jnp.int32(2), jnp.int32(1))))
+
+    return Region(
+        name="chstone_jpeg",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=n_steps,
+        max_steps=n_steps + 16,
+        spec={
+            "stream": LeafSpec(KIND_RO),
+            "coef": LeafSpec(KIND_MEM),
+            "pixels": LeafSpec(KIND_MEM),
+            "pos": LeafSpec(KIND_CTRL),
+            "blk": LeafSpec(KIND_CTRL),
+            "k": LeafSpec(KIND_CTRL),
+            "pred": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "pure-python baseline JPEG decode, shared int IDCT"},
+    )

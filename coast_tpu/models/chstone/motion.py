@@ -1,0 +1,268 @@
+"""CHStone motion: MPEG-2 motion-vector decoding (reference:
+tests/chstone/motion/{motion.c,mpeg2.c,getbits.c,getvlc.c}).
+
+The reference decodes one motion_vectors() call -- two VLC-coded
+components (ISO/IEC 13818-2 Table B-10) pulled from a bit buffer, with
+residuals, predictor update and the mvscale halving -- and self-checks the
+PMV array (mpeg2.c main, ``main_result == 12``).  The TPU region scales
+the same machinery to a 32-call decode chain: one step = one component
+(horizontal or vertical), 64 steps total, so the injectable surface is the
+bit buffer, the bit cursor, and the evolving predictors -- a flipped
+cursor bit desynchronises the VLC exactly like a corrupted ``ld->Bfr``.
+
+The bitstream is *encoded* at build time by inverting the decoder (a
+search over Table B-10 prefixes), so it is valid by construction; the
+golden comes from the pure-python decoder below, which mirrors
+Get_motion_code/decode_motion_vector literally.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+from coast_tpu.models.chstone._bits import BitReader, BitWriter, jshow
+
+NV = 32                     # motion_vector() calls
+N_STEPS = 2 * NV            # one component per step
+R_SIZE = 8                  # h_r_size = v_r_size = 200 % 32 (motion.c:151)
+
+# Table B-10 (getvlc.h:62-81).
+MVTAB0 = [(99, 0), (3, 3), (2, 2), (2, 2), (1, 1), (1, 1), (1, 1), (1, 1)]
+MVTAB1 = [(99, 0), (99, 0), (99, 0), (7, 6), (6, 6), (5, 6), (4, 5), (4, 5)]
+MVTAB2 = [(16, 9), (15, 9), (14, 9), (13, 9), (12, 9), (11, 9),
+          (10, 8), (10, 8), (9, 8), (9, 8), (8, 8), (8, 8)]
+
+
+# Host-side bit I/O shared with jpeg: coast_tpu/models/chstone/_bits.py
+
+
+def _decode_motion_code(rd: BitReader) -> int:
+    """Literal Get_motion_code (getvlc.c:78-103)."""
+    if rd.get(1):
+        return 0
+    code = rd.show(9)
+    if code >= 64:
+        code >>= 6
+        rd.pos += MVTAB0[code][1]
+        return -MVTAB0[code][0] if rd.get(1) else MVTAB0[code][0]
+    if code >= 24:
+        code >>= 3
+        rd.pos += MVTAB1[code][1]
+        return -MVTAB1[code][0] if rd.get(1) else MVTAB1[code][0]
+    code -= 12
+    if code < 0:
+        return 0
+    rd.pos += MVTAB2[code][1]
+    return -MVTAB2[code][0] if rd.get(1) else MVTAB2[code][0]
+
+
+def _vlc_for(mc: int) -> Tuple[int, int]:
+    """Invert the decoder: (bits, length) whose Get_motion_code == mc > 0
+    (prefix only, excluding the leading 0 and the sign bit)."""
+    for length in range(1, 10):
+        for value in range(1 << length):
+            probe = []
+            for k in range(length - 1, -1, -1):
+                probe.append((value >> k) & 1)
+            # decode: leading 0 consumed already; append sign 0 + padding
+            rd = BitReader(probe + [0] * 12)
+            code = rd.show(9)
+            if code >= 64:
+                idx = code >> 6
+                tab, base = MVTAB0[idx], MVTAB0[idx][1]
+            elif code >= 24:
+                idx = code >> 3
+                tab, base = MVTAB1[idx], MVTAB1[idx][1]
+            elif code - 12 >= 0:
+                idx = code - 12
+                tab, base = MVTAB2[idx], MVTAB2[idx][1]
+            else:
+                continue
+            if tab[0] == mc and base == length:
+                return value, length
+    raise AssertionError(f"no VLC for motion code {mc}")
+
+
+def make_stream(seed: int = 5) -> Tuple[np.ndarray, List[Tuple[int, int]]]:
+    """Encode 2*NV components; returns (stream words, [(code, residual)])."""
+    rng = np.random.RandomState(seed)
+    wr = BitWriter(pad_bit=0)
+    plan = []
+    for _ in range(2 * NV):
+        mc = int(rng.randint(-16, 17))
+        residual = int(rng.randint(0, 1 << R_SIZE)) if mc != 0 else 0
+        plan.append((mc, residual))
+        if mc == 0:
+            wr.put(1, 1)
+        else:
+            wr.put(0, 1)
+            bits, length = _vlc_for(abs(mc))
+            wr.put(bits, length)
+            wr.put(1 if mc < 0 else 0, 1)
+            wr.put(residual, R_SIZE)
+    return wr.words(), plan
+
+
+def _decode_mv(pred: int, r_size: int, mc: int, residual: int) -> int:
+    """decode_motion_vector (mpeg2.c:146-166), full_pel_vector = 0."""
+    lim = 16 << r_size
+    vec = pred
+    if mc > 0:
+        vec += ((mc - 1) << r_size) + residual + 1
+        if vec >= lim:
+            vec -= lim + lim
+    elif mc < 0:
+        vec -= ((-mc - 1) << r_size) + residual + 1
+        if vec < -lim:
+            vec += lim + lim
+    return vec
+
+
+def golden_reference(words: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode the stream host-side: returns (history [NV,2], final PMV[2])."""
+    rd = BitReader(words)
+    pmv = [0, 0]
+    hist = []
+    for call in range(NV):
+        mvscale = call % 2                  # alternate frame/field calls
+        mc = _decode_motion_code(rd)
+        residual = rd.get(R_SIZE) if mc != 0 else 0
+        pmv[0] = _decode_mv(pmv[0], R_SIZE, mc, residual)
+        mc = _decode_motion_code(rd)
+        residual = rd.get(R_SIZE) if mc != 0 else 0
+        if mvscale:
+            pmv[1] >>= 1
+        pmv[1] = _decode_mv(pmv[1], R_SIZE, mc, residual)
+        if mvscale:
+            pmv[1] <<= 1
+        hist.append((pmv[0], pmv[1]))
+    return np.array(hist, np.int64), np.array(pmv, np.int64)
+
+
+# -- device decoder ----------------------------------------------------------
+
+def make_region() -> Region:
+    words, _plan = make_stream()
+    g_hist, g_pmv = golden_reference(words)
+
+    tab0 = jnp.asarray(MVTAB0, jnp.int32)
+    tab1 = jnp.asarray(MVTAB1, jnp.int32)
+    tab2 = jnp.asarray(MVTAB2, jnp.int32)
+
+    def init():
+        return {
+            "stream": jnp.asarray(words),
+            "pmv": jnp.zeros(2, jnp.int32),
+            "hist": jnp.zeros((NV, 2), jnp.int32),
+            "pos": jnp.int32(0),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        pos = state["pos"]
+        call = i >> 1
+        vertical = (i & 1) == 1
+        mvscale = (call % 2) == 1
+
+        b0 = jshow(state["stream"], pos, 1)
+        code9 = jshow(state["stream"], pos + 1, 9)
+
+        # Table dispatch (Get_motion_code, getvlc.c:78-103).
+        idx0 = code9 >> 6
+        idx1 = code9 >> 3
+        idx2 = jnp.clip(code9 - 12, 0, 11)
+        in0 = code9 >= 64
+        in1 = jnp.logical_and(~in0, code9 >= 24)
+        in2 = jnp.logical_and(code9 < 24, code9 - 12 >= 0)
+        mag = jnp.where(in0, tab0[idx0, 0],
+                        jnp.where(in1, tab1[idx1, 0],
+                                  jnp.where(in2, tab2[idx2, 0], 0)))
+        vlen = jnp.where(in0, tab0[idx0, 1],
+                         jnp.where(in1, tab1[idx1, 1],
+                                   jnp.where(in2, tab2[idx2, 1], 0)))
+        sign = jshow(state["stream"], pos + 1 + vlen, 1)
+        mc_nz = jnp.where(sign == 1, -mag, mag)
+        consumed_nz = 1 + vlen + 1
+        zero_short = b0 == 1                 # leading 1 -> code 0
+        zero_tab = jnp.logical_and(b0 == 0, jnp.logical_and(
+            ~in0, jnp.logical_and(~in1, ~in2)))
+        mc = jnp.where(jnp.logical_or(zero_short, zero_tab), 0, mc_nz)
+        consumed = jnp.where(zero_short, 1,
+                             jnp.where(zero_tab, 1, consumed_nz))
+        residual = jnp.where(
+            mc != 0,
+            jshow(state["stream"], pos + consumed, R_SIZE), 0)
+        consumed = consumed + jnp.where(mc != 0, R_SIZE, 0)
+
+        # decode_motion_vector (mpeg2.c:146-166).
+        comp = vertical.astype(jnp.int32)
+        pred = jnp.take(state["pmv"], comp, mode="clip")
+        pred = jnp.where(jnp.logical_and(vertical, mvscale),
+                         pred >> 1, pred)
+        lim = 16 << R_SIZE
+        mag_m1 = jnp.where(mc > 0, mc - 1, -mc - 1)
+        delta = (mag_m1 << R_SIZE) + residual + 1
+        vec_pos = pred + delta
+        vec_pos = jnp.where(vec_pos >= lim, vec_pos - 2 * lim, vec_pos)
+        vec_neg = pred - delta
+        vec_neg = jnp.where(vec_neg < -lim, vec_neg + 2 * lim, vec_neg)
+        vec = jnp.where(mc > 0, vec_pos, jnp.where(mc < 0, vec_neg, pred))
+        vec = jnp.where(jnp.logical_and(vertical, mvscale),
+                        vec << 1, vec)
+
+        pmv = state["pmv"].at[comp].set(vec, mode="drop")
+        hist = jnp.where(
+            vertical,
+            state["hist"].at[jnp.clip(call, 0, NV - 1)].set(
+                jnp.stack([pmv[0], vec]), mode="drop"),
+            state["hist"])
+
+        return {"stream": state["stream"], "pmv": pmv, "hist": hist,
+                "pos": pos + consumed, "i": i + 1}
+
+    def done(state):
+        return state["i"] >= N_STEPS
+
+    def check(state):
+        bad = jnp.sum(jnp.any(
+            state["hist"] != jnp.asarray(g_hist, jnp.int32), axis=1))
+        bad += jnp.sum(state["pmv"] != jnp.asarray(g_pmv, jnp.int32))
+        return bad.astype(jnp.int32)
+
+    def output(state):
+        return jnp.concatenate(
+            [state["hist"].reshape(-1), state["pmv"]]).astype(jnp.uint32)
+
+    graph = BlockGraph(
+        names=["entry", "motion_vector", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="chstone_motion",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_STEPS,
+        max_steps=N_STEPS + 8,
+        spec={
+            "stream": LeafSpec(KIND_RO),
+            "pmv": LeafSpec(KIND_MEM),
+            "hist": LeafSpec(KIND_MEM),
+            "pos": LeafSpec(KIND_CTRL),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "pure-python Table B-10 VLC decoder"},
+    )

@@ -34,6 +34,12 @@ FLIP_TARGETS = {
     "chstone_dfmul": ("z", 2, 19, 32),
     "chstone_dfdiv": ("z", 2, 19, 32),
     "chstone_dfsin": ("acc", 0, 19, 200),
+    # flip L_ACF[0] (the normalisation driver) before the Schur phase
+    "chstone_gsm": ("l_acf", 0, 20, 470),
+    # bit-cursor flip desynchronises the VLC stream
+    "chstone_motion": ("pos", 0, 2, 20),
+    # decoded-coefficient flip before the block's IDCT consumes it
+    "chstone_jpeg": ("coef", 3, 9, 10),
 }
 
 
