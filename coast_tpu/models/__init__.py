@@ -35,6 +35,18 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     "chstone_gsm": _lazy("chstone.gsm"),
     "chstone_motion": _lazy("chstone.motion"),
     "chstone_jpeg": _lazy("chstone.jpeg"),
+    # Corner-case corpus (SURVEY.md §2.3 #31: crazyCF, cache_test,
+    # schedule2, helloWorld, trivial, simpleTMR, scalarize; §2.3 #32 simd,
+    # whetstone).
+    "crazyCF": _lazy("crazycf"),
+    "whetstone": _lazy("whetstone"),
+    "simd": _lazy("vector", "make_simd_region"),
+    "scalarize": _lazy("vector", "make_scalarize_region"),
+    "cache_test": _lazy("cache_test"),
+    "schedule2": _lazy("schedule2"),
+    "trivial": _lazy("smoke", "make_trivial_region"),
+    "helloWorld": _lazy("smoke", "make_hello_region"),
+    "simpleTMR": _lazy("smoke", "make_simple_tmr_region"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

@@ -1,0 +1,93 @@
+"""cache_test: strided memory-walk benchmark (reference: tests/cache_test/
+-- access patterns sized to the A9 cache hierarchy, the natural target of
+the plugin's cache-section injections).
+
+The TPU region walks a 1024-word table (4 KiB, one L1 way's worth in the
+reference geometry) with three co-prime strides, read-modify-writing each
+visited word.  Under ``-s dcache`` campaigns the hierarchy overlay
+(coast_tpu.inject.hierarchy) maps cache lines onto exactly this leaf, so
+flipped "cache lines" surface as corrupted table words mid-walk.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, LeafSpec, Region
+
+WORDS = 1024
+PASSES = 3
+STRIDES = (1, 17, 257)                # co-prime with 1024? 17,257 are; 1 too
+N_STEPS = PASSES * WORDS
+
+
+def golden_reference() -> np.ndarray:
+    mem = (np.arange(WORDS, dtype=np.uint64) * 2246822519) % (1 << 32)
+    for p in range(PASSES):
+        stride = STRIDES[p]
+        idx = 0
+        for k in range(WORDS):
+            mem[idx] = (mem[idx] * 5 + k + p) % (1 << 32)
+            idx = (idx + stride) % WORDS
+    return mem.astype(np.uint32)
+
+
+def make_region() -> Region:
+    golden = golden_reference()
+    init_mem = ((np.arange(WORDS, dtype=np.uint64) * 2246822519)
+                % (1 << 32)).astype(np.uint32)
+    strides = jnp.asarray(STRIDES, jnp.int32)
+
+    def init():
+        return {
+            "table": jnp.asarray(init_mem),
+            "idx": jnp.int32(0),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        p = jnp.clip(i // WORDS, 0, PASSES - 1)
+        k = i % WORDS
+        idx = state["idx"]
+        v = jnp.take(state["table"], idx, mode="clip")
+        v = v * np.uint32(5) + k.astype(jnp.uint32) + p.astype(jnp.uint32)
+        table = state["table"].at[idx].set(v, mode="drop")
+        # Pass boundary resets the cursor to 0 for the next stride.
+        next_idx = (idx + jnp.take(strides, p, mode="clip")) % WORDS
+        next_idx = jnp.where(k == WORDS - 1, 0, next_idx)
+        return {"table": table, "idx": next_idx, "i": i + 1}
+
+    def done(state):
+        return state["i"] >= N_STEPS
+
+    def check(state):
+        return jnp.sum(state["table"]
+                       != jnp.asarray(golden)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "walk", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="cache_test",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=lambda s: s["table"],
+        nominal_steps=N_STEPS,
+        max_steps=N_STEPS + 8,
+        spec={
+            "table": LeafSpec(KIND_MEM),
+            "idx": LeafSpec(KIND_CTRL),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={},
+    )

@@ -1,0 +1,135 @@
+"""crazyCF: the irregular-control-flow benchmark (reference:
+tests/crazyCF/ -- deeply nested switches/branches whose point is stressing
+the CFCSS signature graph, not arithmetic).
+
+The TPU region is a dispatch machine over a data array: each step
+classifies the current value into one of seven switch cases, each with its
+own update rule (some themselves branchy), then merges.  The BlockGraph
+exposes the real dispatch->case_k->merge structure (10 nodes), so stacking
+CFCSS instruments a genuinely multi-way graph -- a corrupted ctrl word
+steers execution to a case with no legal edge from the current block,
+which is exactly the illegal jump CFCSS detects.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+
+N = 96
+
+
+def make_input() -> np.ndarray:
+    rng = np.random.RandomState(17)
+    return rng.randint(0, 2**31, N).astype(np.int64)
+
+
+def _case_update(v: int, acc: int) -> int:
+    """The host oracle's switch body (python ints, wrap to uint32)."""
+    m = 0xFFFFFFFF
+    c = v % 7
+    if c == 0:
+        acc = (acc + v) & m
+    elif c == 1:
+        acc = (acc ^ (v << 3)) & m
+    elif c == 2:
+        acc = (acc * 2654435761) & m if v & 1 else (acc + 0x9E3779B9) & m
+    elif c == 3:
+        acc = ((acc >> 5) | (acc << 27)) & m
+    elif c == 4:
+        acc = (acc - v) & m if acc > v else (v - acc) & m
+    elif c == 5:
+        acc = (acc | (v >> 7)) & m
+    else:
+        acc = (acc & (v | 0xFF)) & m
+    return acc
+
+
+def golden_reference(data: np.ndarray) -> int:
+    acc = 0x12345678
+    for v in data:
+        acc = _case_update(int(v) & 0xFFFFFFFF, acc)
+    return acc
+
+
+def make_region() -> Region:
+    data = make_input()
+    golden = golden_reference(data)
+
+    def init():
+        return {
+            "data": jnp.asarray(data, jnp.uint32),
+            "acc": jnp.uint32(0x12345678),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = jnp.clip(state["i"], 0, N - 1)
+        v = jnp.take(state["data"], i, mode="clip")
+        acc = state["acc"]
+        c = v % 7
+        r0 = acc + v
+        r1 = acc ^ (v << 3)
+        r2 = jnp.where((v & 1) == 1,
+                       acc * np.uint32(2654435761),
+                       acc + np.uint32(0x9E3779B9))
+        r3 = (acc >> 5) | (acc << 27)
+        r4 = jnp.where(acc > v, acc - v, v - acc)
+        r5 = acc | (v >> 7)
+        r6 = acc & (v | np.uint32(0xFF))
+        new_acc = jnp.where(c == 0, r0,
+                   jnp.where(c == 1, r1,
+                    jnp.where(c == 2, r2,
+                     jnp.where(c == 3, r3,
+                      jnp.where(c == 4, r4,
+                       jnp.where(c == 5, r5, r6))))))
+        return {"data": state["data"], "acc": new_acc,
+                "i": state["i"] + 1}
+
+    def done(state):
+        return state["i"] >= N
+
+    def check(state):
+        return (state["acc"] != np.uint32(golden)).astype(jnp.int32)
+
+    def output(state):
+        return state["acc"].reshape(1)
+
+    def block_of(state):
+        i = state["i"]
+        at_exit = i >= N
+        v = jnp.take(state["data"], jnp.clip(i, 0, N - 1), mode="clip")
+        case = (v % 7).astype(jnp.int32)
+        return jnp.where(at_exit, jnp.int32(9), case + 2)
+
+    # entry(0) -> dispatch... block_of reports the case block (2..8) the
+    # step will execute; every case can follow every case (via the merge).
+    names = ["entry", "dispatch"] + [f"case{k}" for k in range(7)] + ["exit"]
+    edges = [(0, c) for c in range(2, 9)]
+    edges += [(a, b) for a in range(2, 9) for b in range(2, 9)]
+    edges += [(c, 9) for c in range(2, 9)]
+    edges += [(0, 1), (1, 2)]          # keep dispatch reachable
+    graph = BlockGraph(names=names, edges=edges, block_of=block_of)
+
+    return Region(
+        name="crazyCF",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N,
+        max_steps=N + 8,
+        spec={
+            "data": LeafSpec(KIND_RO),
+            "acc": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"golden": golden},
+    )

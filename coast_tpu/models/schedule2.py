@@ -1,0 +1,250 @@
+"""schedule2: the priority-scheduler benchmark (reference:
+tests/schedule2/ -- the Siemens 'schedule2' process scheduler: three
+priority queues, new-job/upgrade/block/quantum-expire/finish commands,
+self-checked by the completion order).
+
+The TPU region runs the same machine: three fixed-capacity FIFO queues
+(arrays + counts), a command tape, and one command per step.  The
+completion log is the oracle surface; a flipped queue slot or count
+reorders scheduling exactly like the reference's corrupted ready lists.
+
+Commands: 0 NEW_JOB(prio) - enqueue next job id at prio
+          1 UPGRADE_PRIO(prio) - move head of prio up one level
+          2 BLOCK - move running job to blocked queue
+          3 QUANTUM_EXPIRE - running job to back of its queue
+          4 UNBLOCK - oldest blocked job back to its priority queue
+          5 FINISH - running job completes (logged)
+The "running job" is the head of the highest non-empty priority queue.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+QCAP = 16          # per-queue capacity
+NQ = 3             # priority levels (2 = highest)
+N_CMDS = 128
+
+
+def make_tape(seed: int = 23) -> np.ndarray:
+    """Command tape: (op, arg) pairs, biased towards NEW_JOB early."""
+    rng = np.random.RandomState(seed)
+    ops = []
+    for k in range(N_CMDS):
+        if k < 24:
+            op = 0 if rng.rand() < 0.7 else int(rng.randint(0, 6))
+        else:
+            op = int(rng.randint(0, 6))
+        arg = int(rng.randint(0, NQ))
+        ops.append((op, arg))
+    return np.array(ops, np.int64)
+
+
+class _Sched:
+    """Host oracle."""
+
+    def __init__(self):
+        self.queues: List[List[int]] = [[], [], []]
+        self.blocked: List[int] = []
+        self.next_id = 1
+        self.log: List[int] = []
+
+    def running(self) -> Tuple[int, int]:
+        for prio in range(NQ - 1, -1, -1):
+            if self.queues[prio]:
+                return prio, self.queues[prio][0]
+        return -1, 0
+
+    def do(self, op: int, arg: int) -> None:
+        if op == 0:                       # NEW_JOB
+            if len(self.queues[arg]) < QCAP:
+                self.queues[arg].append(self.next_id)
+                self.next_id += 1
+        elif op == 1:                     # UPGRADE_PRIO
+            if arg < NQ - 1 and self.queues[arg] \
+                    and len(self.queues[arg + 1]) < QCAP:
+                self.queues[arg + 1].append(self.queues[arg].pop(0))
+        elif op == 2:                     # BLOCK
+            prio, _ = self.running()
+            if prio >= 0 and len(self.blocked) < QCAP:
+                self.blocked.append(self.queues[prio].pop(0))
+        elif op == 3:                     # QUANTUM_EXPIRE
+            prio, _ = self.running()
+            if prio >= 0:
+                self.queues[prio].append(self.queues[prio].pop(0))
+        elif op == 4:                     # UNBLOCK
+            if self.blocked and len(self.queues[arg]) < QCAP:
+                self.queues[arg].append(self.blocked.pop(0))
+        else:                             # FINISH
+            prio, job = self.running()
+            if prio >= 0:
+                self.queues[prio].pop(0)
+                self.log.append(job)
+
+
+def golden_reference(tape: np.ndarray) -> np.ndarray:
+    s = _Sched()
+    for op, arg in tape:
+        s.do(int(op), int(arg))
+    log = s.log[:N_CMDS] + [0] * (N_CMDS - len(s.log))
+    return np.array(log, np.int64)
+
+
+def make_region() -> Region:
+    tape = make_tape()
+    golden = golden_reference(tape)
+
+    def init():
+        return {
+            "tape": jnp.asarray(tape.reshape(-1), jnp.int32),
+            # queues[prio, slot]; row 3 = blocked queue.
+            "queues": jnp.zeros((NQ + 1, QCAP), jnp.int32),
+            "counts": jnp.zeros(NQ + 1, jnp.int32),
+            "log": jnp.zeros(N_CMDS, jnp.int32),
+            "log_n": jnp.int32(0),
+            "next_id": jnp.int32(1),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = state["i"]
+        op = jnp.take(state["tape"], 2 * i, mode="clip")
+        arg = jnp.take(state["tape"], 2 * i + 1, mode="clip")
+        q = state["queues"]
+        cnt = state["counts"]
+
+        # Running job = head of highest non-empty priority queue.
+        prio = jnp.where(cnt[2] > 0, 2,
+                         jnp.where(cnt[1] > 0, 1,
+                                   jnp.where(cnt[0] > 0, 0, -1)))
+
+        def enq(q, cnt, row, job):
+            slot = jnp.clip(cnt[row], 0, QCAP - 1)
+            return (q.at[row, slot].set(job, mode="drop"),
+                    cnt.at[row].set(cnt[row] + 1))
+
+        def deq(q, cnt, row):
+            head = q[row, 0]
+            shifted = jnp.concatenate(
+                [jnp.take(q, row, axis=0)[1:], jnp.zeros(1, jnp.int32)])
+            return head, q.at[row].set(shifted), cnt.at[row].set(cnt[row] - 1)
+
+        # Compute every op's effect, select at the end.
+        # op 0: NEW_JOB at arg.
+        can0 = cnt[arg] < QCAP
+        q0, c0 = enq(q, cnt, arg, state["next_id"])
+        q0 = jnp.where(can0, q0, q)
+        c0 = jnp.where(can0, c0, cnt)
+        nid0 = jnp.where(can0, state["next_id"] + 1, state["next_id"])
+
+        # op 1: UPGRADE head of arg -> arg+1.
+        can1 = jnp.logical_and(arg < NQ - 1,
+                               jnp.logical_and(cnt[arg] > 0,
+                                               cnt[jnp.clip(arg + 1, 0, NQ - 1)]
+                                               < QCAP))
+        h1, qd, cd = deq(q, cnt, arg)
+        q1, c1 = enq(qd, cd, jnp.clip(arg + 1, 0, NQ - 1), h1)
+        q1 = jnp.where(can1, q1, q)
+        c1 = jnp.where(can1, c1, cnt)
+
+        # op 2: BLOCK the running job (-> row NQ).
+        can2 = jnp.logical_and(prio >= 0, cnt[NQ] < QCAP)
+        h2, qd2, cd2 = deq(q, cnt, jnp.clip(prio, 0, 2))
+        q2, c2 = enq(qd2, cd2, NQ, h2)
+        q2 = jnp.where(can2, q2, q)
+        c2 = jnp.where(can2, c2, cnt)
+
+        # op 3: QUANTUM_EXPIRE - rotate the running queue.
+        can3 = prio >= 0
+        h3, qd3, cd3 = deq(q, cnt, jnp.clip(prio, 0, 2))
+        q3, c3 = enq(qd3, cd3, jnp.clip(prio, 0, 2), h3)
+        q3 = jnp.where(can3, q3, q)
+        c3 = jnp.where(can3, c3, cnt)
+
+        # op 4: UNBLOCK oldest -> queue arg.
+        can4 = jnp.logical_and(cnt[NQ] > 0, cnt[arg] < QCAP)
+        h4, qd4, cd4 = deq(q, cnt, NQ)
+        q4, c4 = enq(qd4, cd4, arg, h4)
+        q4 = jnp.where(can4, q4, q)
+        c4 = jnp.where(can4, c4, cnt)
+
+        # op 5: FINISH the running job.
+        can5 = prio >= 0
+        h5, qd5, cd5 = deq(q, cnt, jnp.clip(prio, 0, 2))
+        q5 = jnp.where(can5, qd5, q)
+        c5 = jnp.where(can5, cd5, cnt)
+        log5 = jnp.where(
+            can5,
+            state["log"].at[jnp.clip(state["log_n"], 0, N_CMDS - 1)].set(
+                h5, mode="drop"),
+            state["log"])
+        logn5 = jnp.where(can5, state["log_n"] + 1, state["log_n"])
+
+        new_q = jnp.where(op == 0, q0,
+                 jnp.where(op == 1, q1,
+                  jnp.where(op == 2, q2,
+                   jnp.where(op == 3, q3,
+                    jnp.where(op == 4, q4, q5)))))
+        new_c = jnp.where(op == 0, c0,
+                 jnp.where(op == 1, c1,
+                  jnp.where(op == 2, c2,
+                   jnp.where(op == 3, c3,
+                    jnp.where(op == 4, c4, c5)))))
+        return {
+            "tape": state["tape"],
+            "queues": new_q,
+            "counts": new_c,
+            "log": jnp.where(op == 5, log5, state["log"]),
+            "log_n": jnp.where(op == 5, logn5, state["log_n"]),
+            "next_id": jnp.where(op == 0, nid0, state["next_id"]),
+            "i": i + 1,
+        }
+
+    def done(state):
+        return state["i"] >= N_CMDS
+
+    def check(state):
+        return jnp.sum(state["log"]
+                       != jnp.asarray(golden, jnp.int32)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "new_job", "upgrade_prio", "block",
+               "quantum_expire", "unblock", "finish", "exit"],
+        edges=([(0, b) for b in range(1, 7)]
+               + [(a, b) for a in range(1, 7) for b in range(1, 7)]
+               + [(a, 7) for a in range(1, 7)]),
+        block_of=lambda s: jnp.where(
+            s["i"] >= N_CMDS, jnp.int32(7),
+            jnp.clip(jnp.take(s["tape"],
+                              2 * jnp.clip(s["i"], 0, N_CMDS - 1),
+                              mode="clip"), 0, 5) + 1))
+
+    return Region(
+        name="schedule2",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=lambda s: s["log"].astype(jnp.uint32),
+        nominal_steps=N_CMDS,
+        max_steps=N_CMDS + 8,
+        spec={
+            "tape": LeafSpec(KIND_RO),
+            "queues": LeafSpec(KIND_MEM),
+            "counts": LeafSpec(KIND_CTRL),
+            "log": LeafSpec(KIND_MEM),
+            "log_n": LeafSpec(KIND_CTRL),
+            "next_id": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={},
+    )

@@ -1,0 +1,122 @@
+"""Smoke-tier benchmarks: trivial, helloWorld, simpleTMR (reference:
+tests/trivial/, tests/helloWorld/, tests/simpleTMR/).
+
+The reference keeps a few near-empty programs in the matrix so the build
+pipeline itself is tested on degenerate inputs (no loops, tiny loops,
+string output).  Same role here: minimal regions that still satisfy the
+full Region contract, so every strategy and the campaign machinery can be
+exercised at near-zero cost.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+
+def _linear_graph(name: str, n_steps: int):
+    return BlockGraph(
+        names=["entry", name, "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= n_steps,
+                                     jnp.int32(2), jnp.int32(1)))
+
+
+def make_trivial_region() -> Region:
+    """tests/trivial: main returns 0."""
+    def init():
+        return {"ret": jnp.int32(0), "i": jnp.int32(0)}
+
+    def step(state, t):
+        return {"ret": state["ret"], "i": state["i"] + 1}
+
+    return Region(
+        name="trivial",
+        init=init,
+        step=step,
+        done=lambda s: s["i"] >= 1,
+        check=lambda s: (s["ret"] != 0).astype(jnp.int32),
+        output=lambda s: s["ret"].reshape(1).astype(jnp.uint32),
+        nominal_steps=1,
+        max_steps=4,
+        spec={"ret": LeafSpec(KIND_REG), "i": LeafSpec(KIND_CTRL)},
+        default_xmr=True,
+        graph=_linear_graph("main", 1),
+        meta={},
+    )
+
+
+_HELLO = b"Hello world!"
+
+
+def make_hello_region() -> Region:
+    """tests/helloWorld: emit the string, one character per step (the
+    closest analogue of a putchar loop over UART)."""
+    msg = np.frombuffer(_HELLO + b"\x00" * (-len(_HELLO) % 4),
+                        dtype=np.uint8).astype(np.uint32)
+    n = len(msg)
+
+    def init():
+        return {
+            "text": jnp.asarray(msg),
+            "out": jnp.zeros(n, jnp.uint32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i = jnp.clip(state["i"], 0, n - 1)
+        ch = jnp.take(state["text"], i, mode="clip")
+        return {"text": state["text"],
+                "out": state["out"].at[i].set(ch, mode="drop"),
+                "i": state["i"] + 1}
+
+    return Region(
+        name="helloWorld",
+        init=init,
+        step=step,
+        done=lambda s: s["i"] >= n,
+        check=lambda s: jnp.sum(s["out"] != jnp.asarray(msg)).astype(
+            jnp.int32),
+        output=lambda s: s["out"],
+        nominal_steps=n,
+        max_steps=n + 4,
+        spec={"text": LeafSpec(KIND_RO), "out": LeafSpec(KIND_MEM),
+              "i": LeafSpec(KIND_CTRL)},
+        default_xmr=True,
+        graph=_linear_graph("puts", n),
+        meta={"message": _HELLO.decode()},
+    )
+
+
+N_ACC = 32
+
+
+def make_simple_tmr_region() -> Region:
+    """tests/simpleTMR: the minimal accumulate loop used as the TMR demo."""
+    golden = sum(range(N_ACC)) * 3 + 7
+
+    def init():
+        return {"acc": jnp.int32(7), "i": jnp.int32(0)}
+
+    def step(state, t):
+        return {"acc": state["acc"] + 3 * jnp.clip(state["i"], 0, N_ACC - 1),
+                "i": state["i"] + 1}
+
+    return Region(
+        name="simpleTMR",
+        init=init,
+        step=step,
+        done=lambda s: s["i"] >= N_ACC,
+        check=lambda s: (s["acc"] != golden).astype(jnp.int32),
+        output=lambda s: s["acc"].reshape(1).astype(jnp.uint32),
+        nominal_steps=N_ACC,
+        max_steps=N_ACC + 8,
+        spec={"acc": LeafSpec(KIND_REG), "i": LeafSpec(KIND_CTRL)},
+        default_xmr=True,
+        graph=_linear_graph("accumulate", N_ACC),
+        meta={"golden": golden},
+    )

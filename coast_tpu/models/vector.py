@@ -1,0 +1,143 @@
+"""simd + scalarize: vector-dataflow benchmarks (reference:
+tests/TMRregression/unitTests/simd.c and tests/scalarize/).
+
+The reference's simd.c exercises the SIMD path of the voters (vector
+compares + CreateAddReduce in synchronization.cpp:1136-1177, 1469-1530);
+tests/scalarize checks vector code that must be scalarised before
+replication.  The TPU analogue: regions whose leaves are whole vectors
+updated per step, so every voter is an elementwise vector compare with a
+reduction -- the natural TPU form of the reference's SIMD voter.
+
+* ``simd``      : uint32x16 integer lanes (add/rot/xor mix)
+* ``scalarize`` : float32x8 axpy-style chain
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, LeafSpec,
+                                 Region)
+
+W = 16
+N_STEPS = 64
+FW = 8
+F_STEPS = 48
+
+
+def _simd_golden() -> np.ndarray:
+    v = np.arange(W, dtype=np.uint64) * 2654435761 % (1 << 32)
+    k = np.uint64(0x9E3779B9)
+    for t in range(N_STEPS):
+        v = (v + np.roll(v, 1) + k) % (1 << 32)
+        v = ((v << np.uint64(7)) | (v >> np.uint64(25))) % (1 << 32)
+        v = v ^ np.uint64(t)
+    return v.astype(np.uint32)
+
+
+def make_simd_region() -> Region:
+    golden = _simd_golden()
+    init_v = (np.arange(W, dtype=np.uint64) * 2654435761
+              % (1 << 32)).astype(np.uint32)
+
+    def init():
+        return {"v": jnp.asarray(init_v), "i": jnp.int32(0)}
+
+    def step(state, t):
+        v = state["v"]
+        v = v + jnp.roll(v, 1) + np.uint32(0x9E3779B9)
+        v = (v << np.uint32(7)) | (v >> np.uint32(25))
+        v = v ^ t.astype(jnp.uint32)
+        return {"v": v, "i": state["i"] + 1}
+
+    def done(state):
+        return state["i"] >= N_STEPS
+
+    def check(state):
+        return jnp.sum(state["v"] != jnp.asarray(golden)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "vloop", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="simd",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=lambda s: s["v"],
+        nominal_steps=N_STEPS,
+        max_steps=N_STEPS + 8,
+        spec={"v": LeafSpec(KIND_MEM), "i": LeafSpec(KIND_CTRL)},
+        default_xmr=True,
+        graph=graph,
+        meta={},
+    )
+
+
+def _scalarize_golden() -> np.ndarray:
+    x = np.linspace(0.1, 1.0, FW).astype(np.float32)
+    y = np.ones(FW, np.float32)
+    a = np.float32(1.0009765625)           # exactly representable
+    for _ in range(F_STEPS):
+        y = np.float32(a) * x + y
+        x = np.float32(0.75) * x
+    return np.concatenate([x, y])
+
+
+def make_scalarize_region() -> Region:
+    golden = _scalarize_golden()
+
+    def init():
+        return {
+            "x": jnp.linspace(0.1, 1.0, FW, dtype=jnp.float32),
+            "y": jnp.ones(FW, jnp.float32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        y = jnp.float32(1.0009765625) * state["x"] + state["y"]
+        x = jnp.float32(0.75) * state["x"]
+        return {"x": x, "y": y, "i": state["i"] + 1}
+
+    def done(state):
+        return state["i"] >= F_STEPS
+
+    def check(state):
+        # Tolerance, not bit-equality: XLA may contract a*x+y into an FMA,
+        # and whether it does differs between the plain and the vmapped
+        # (replicated) lowering of the same step -- bit-exactness across
+        # compilations is not an IEEE guarantee once contraction is legal.
+        # A real fault perturbs exponent/sign bits and blows far past this.
+        got = jnp.concatenate([state["x"], state["y"]])
+        want = jnp.asarray(golden)
+        return jnp.sum(jnp.abs(got - want) > 1e-4).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "axpy", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= F_STEPS,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="scalarize",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=lambda s: jax.lax.bitcast_convert_type(
+            jnp.concatenate([s["x"], s["y"]]), jnp.uint32),
+        nominal_steps=F_STEPS,
+        max_steps=F_STEPS + 8,
+        spec={"x": LeafSpec(KIND_MEM), "y": LeafSpec(KIND_MEM),
+              "i": LeafSpec(KIND_CTRL)},
+        default_xmr=True,
+        graph=graph,
+        meta={},
+    )

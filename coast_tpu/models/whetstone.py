@@ -1,0 +1,102 @@
+"""whetstone: the classic floating-point synthetic benchmark (reference:
+tests/TMRregression/unitTests/{whetstone.c,whets.c}).
+
+The reference runs the Whetstone modules (array arithmetic, trig-free
+polynomial chains, conditional jumps) to exercise FP dataflow under
+replication.  The TPU region runs a compact float32 Whetstone: each step
+is one iteration updating the classic 4-element working set through the
+module-1 elementary arithmetic and a module-6-style integer/float mix.
+State leaves are float32 words -- the flipper bitcasts, so a campaign
+flips real IEEE bits (sign/exponent/mantissa) like a register-file upset.
+
+Golden: the identical float32 sequence in numpy (one rounding per op),
+compared with a small tolerance -- XLA FMA contraction may differ between
+the plain and replicated lowerings, so exact-ulp equality across
+compilations is not an IEEE guarantee.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, LeafSpec,
+                                 Region)
+
+N_ITER = 128
+T = np.float32(0.499975)
+T1 = np.float32(0.50025)
+T2 = np.float32(2.0)
+
+
+def golden_reference() -> np.ndarray:
+    e = np.array([1.0, -1.0, -1.0, -1.0], np.float32)
+    for _ in range(N_ITER):
+        # Module 1: simple identifiers (whets.c N1 body).
+        e0 = np.float32((e[0] + e[1] + e[2] - e[3]) * T)
+        e1 = np.float32((e0 + e[1] - e[2] + e[3]) * T)
+        e2 = np.float32((e0 - e1 + e[2] + e[3]) * T)
+        e3 = np.float32((-e0 + e1 + e2 + e[3]) * T)
+        e = np.array([e0, e1, e2, np.float32(e3 / T2)], np.float32)
+    return e
+
+
+def make_region() -> Region:
+    golden = golden_reference()
+
+    def init():
+        return {
+            "e": jnp.asarray([1.0, -1.0, -1.0, -1.0], jnp.float32),
+            "i": jnp.int32(0),
+        }
+
+    def step(state, t):
+        e = state["e"]
+        e0 = (e[0] + e[1] + e[2] - e[3]) * T
+        e1 = (e0 + e[1] - e[2] + e[3]) * T
+        e2 = (e0 - e1 + e[2] + e[3]) * T
+        e3 = (-e0 + e1 + e2 + e[3]) * T
+        new_e = jnp.stack([e0, e1, e2, e3 / T2])
+        return {"e": new_e, "i": state["i"] + 1}
+
+    def done(state):
+        return state["i"] >= N_ITER
+
+    def check(state):
+        # Tolerant compare: XLA's FMA contraction may differ between the
+        # plain and replicated lowerings (see models/vector.py check), so
+        # ulp-exact equality across compilations is not guaranteed.  Faults
+        # that matter (sign/exponent flips) exceed this by orders of
+        # magnitude.
+        want = jnp.asarray(golden)
+        return jnp.sum(jnp.abs(state["e"] - want) > 1e-4).astype(jnp.int32)
+
+    def output(state):
+        return jax.lax.bitcast_convert_type(state["e"], jnp.uint32)
+
+    graph = BlockGraph(
+        names=["entry", "module1", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N_ITER,
+                                     jnp.int32(2), jnp.int32(1)))
+
+    return Region(
+        name="whetstone",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N_ITER,
+        max_steps=N_ITER + 8,
+        spec={
+            "e": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"golden_bits": [hex(int(x)) for x in
+                              golden.view(np.uint32)]},
+    )

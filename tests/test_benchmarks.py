@@ -40,6 +40,17 @@ FLIP_TARGETS = {
     "chstone_motion": ("pos", 0, 2, 20),
     # decoded-coefficient flip before the block's IDCT consumes it
     "chstone_jpeg": ("coef", 3, 9, 10),
+    "crazyCF": ("acc", 0, 13, 95),   # late flip: earlier ones are absorbed by the AND/OR cases
+    # exponent-bit flip in the float working set
+    "whetstone": ("e", 1, 30, 40),
+    "simd": ("v", 3, 22, 20),
+    "scalarize": ("y", 2, 30, 10),
+    "cache_test": ("table", 100, 9, 500),
+    # corrupt the job-id source: every later NEW_JOB misnumbers
+    "schedule2": ("next_id", 0, 2, 30),
+    "trivial": ("ret", 0, 0, 0),
+    "helloWorld": ("out", 2, 5, 8),
+    "simpleTMR": ("acc", 0, 7, 10),
 }
 
 
