@@ -8,41 +8,255 @@ We take 1.0 injection/sec as the reference baseline -- the generous end of
 that range -- and measure our batched XLA campaign on matrixMultiply under
 TMR (BASELINE.json config 1).  North star: >= 1000x.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Robustness (VERDICT round 1 #1: BENCH_r01 was rc=1 with a bare traceback):
+the measurement runs in a supervised *worker subprocess* with stage-level
+progress records, because on this hardware the axon TPU backend can wedge
+inside backend init (jax.devices() blocking on the device claim) or fail
+at the first dispatch.  The parent watches the worker with bounded
+timeouts, retries a fast failure once, falls back to the CPU backend when
+the TPU is unreachable, and ALWAYS emits a machine-readable JSON line --
+including an "error" field describing what the TPU did -- with rc=0
+whenever any measurement exists.
 """
 
+from __future__ import annotations
+
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_INJ_PER_SEC = 1.0  # QEMU+GDB loop, seconds-per-injection regime
 
+# Stage timeouts (seconds), env-tunable for the driver.
+INIT_TIMEOUT = int(os.environ.get("COAST_BENCH_INIT_TIMEOUT", "420"))
+RETRY_TIMEOUT = int(os.environ.get("COAST_BENCH_RETRY_TIMEOUT", "180"))
+RUN_TIMEOUT = int(os.environ.get("COAST_BENCH_RUN_TIMEOUT", "900"))
+BATCHES = (2048, 8192, 16384)
 
-def main() -> None:
-    from coast_tpu import TMR
+
+# ---------------------------------------------------------------------------
+# Worker: one backend attempt.  Emits one JSON record per line on stdout:
+#   {"stage": "init", ...}   backend is up (devices visible)
+#   {"stage": "dispatch"}    first op executed
+#   {"stage": "result", ...} a finished measurement (possibly several)
+#   {"stage": "done"}        all measurements finished
+# The parent treats the last "result" as authoritative, so a wedge mid-way
+# still yields partial numbers.
+# ---------------------------------------------------------------------------
+
+def _emit(rec):
+    sys.stdout.write(json.dumps(rec) + "\n")
+    sys.stdout.flush()
+
+
+def worker(backend: str) -> None:
+    if backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if backend == "cpu":
+        # The axon site hook registers its PJRT plugin programmatically, so
+        # the env var alone is not sufficient (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    _emit({"stage": "init", "backend": jax.default_backend(),
+           "devices": [str(d) for d in devs]})
+
+    jnp.add(jnp.int32(1), jnp.int32(1)).block_until_ready()
+    _emit({"stage": "dispatch"})
+
+    from coast_tpu import DWC, TMR, unprotected
     from coast_tpu.inject.campaign import CampaignRunner
-    from coast_tpu.models import mm
+    from coast_tpu.models import REGISTRY
 
-    region = mm.make_region()
+    region = REGISTRY["matrixMultiply"]()
+
+    # -- protected-vs-unprotected runtime overhead (the MWTF denominator,
+    #    jsonParser.py:458-506) -------------------------------------------
+    overhead = {}
+    for name, make in (("unprotected", unprotected), ("DWC", DWC),
+                       ("TMR", TMR)):
+        prog = make(region)
+        run = jax.jit(lambda p=prog: p.run(None))
+        jax.block_until_ready(run())            # compile
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run()
+        jax.block_until_ready(out)
+        overhead[name] = (time.perf_counter() - t0) / reps
+    _emit({"stage": "result", "kind": "overhead",
+           "seconds_per_run": {k: round(v, 6) for k, v in overhead.items()},
+           "tmr_runtime_x": round(overhead["TMR"] / overhead["unprotected"], 3),
+           "dwc_runtime_x": round(overhead["DWC"] / overhead["unprotected"], 3)})
+
+    # -- injections/sec on mm-TMR at several batch sizes -------------------
     runner = CampaignRunner(TMR(region), strategy_name="TMR")
+    best = None
+    for batch in BATCHES:
+        runner.run(batch, seed=1, batch_size=batch)          # compile+warm
+        res = runner.run(4 * batch, seed=42, batch_size=batch)
+        rec = {"stage": "result", "kind": "throughput",
+               "benchmark": "matrixMultiply", "strategy": "TMR",
+               "batch_size": batch, "injections": res.n,
+               "seconds": round(res.seconds, 4),
+               "injections_per_sec": round(res.injections_per_sec, 2),
+               "counts": res.counts}
+        _emit(rec)
+        if best is None or res.injections_per_sec > best:
+            best = res.injections_per_sec
 
-    batch = 8192
-    # Warm-up: compile + one full batch (excluded from timing).
-    runner.run(batch, seed=1, batch_size=batch)
+    _emit({"stage": "done", "best_injections_per_sec": round(best, 2)})
 
-    n = 4 * batch
-    res = runner.run(n, seed=42, batch_size=batch)
-    value = res.injections_per_sec
 
-    print(json.dumps({
-        "metric": "mm_tmr_fault_injections_per_sec",
-        "value": round(value, 2),
-        "unit": "injections/sec",
-        "vs_baseline": round(value / BASELINE_INJ_PER_SEC, 2),
-    }))
-    # Side channel for humans (stderr keeps stdout to the one JSON line).
-    print(f"# {res.summary()}", file=sys.stderr)
+# ---------------------------------------------------------------------------
+# Parent: supervise attempts, always emit the one JSON line.
+# ---------------------------------------------------------------------------
+
+def _attempt(backend: str, timeout_s: int):
+    """Run one worker; returns (records, error_note)."""
+    env = dict(os.environ)
+    import tempfile
+    # Worker stderr goes to a temp file, not a pipe: JAX/XLA on the TPU
+    # path can emit more log output than a pipe buffer holds, and an
+    # undrained pipe would block the worker mid-measurement.
+    err_f = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", backend],
+        stdout=subprocess.PIPE, stderr=err_f, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    records, error = [], None
+    deadline = time.monotonic() + timeout_s
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    buf = ""
+    stage = "spawn"
+    try:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                error = (f"worker wedged in stage '{stage}' "
+                         f"(no progress for {timeout_s}s budget)")
+                proc.kill()
+                break
+            if not sel.select(timeout=min(remaining, 5.0)):
+                if proc.poll() is not None:
+                    break
+                continue
+            line = proc.stdout.readline()
+            if not line:
+                break
+            buf += line
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            records.append(rec)
+            stage = rec.get("stage", stage)
+            if stage == "init":
+                # Backend is up: grant the full run budget from here.
+                deadline = time.monotonic() + RUN_TIMEOUT
+            if stage == "done":
+                break
+        proc.wait(timeout=10)
+    except Exception as e:  # noqa: BLE001 - supervision must not raise
+        error = error or f"supervisor error: {type(e).__name__}: {e}"
+        proc.kill()
+    finally:
+        try:
+            err_f.seek(0)
+            stderr_tail = err_f.read()[-2000:]
+            err_f.close()
+        except Exception:  # noqa: BLE001
+            stderr_tail = ""
+        sel.close()
+    if proc.returncode not in (0, None) and error is None:
+        error = (f"worker exited rc={proc.returncode} in stage '{stage}': "
+                 + stderr_tail.strip().splitlines()[-1] if stderr_tail.strip()
+                 else f"worker exited rc={proc.returncode}")
+    if error and stderr_tail.strip():
+        error += " | stderr: " + " / ".join(
+            stderr_tail.strip().splitlines()[-3:])
+    return records, error
+
+
+def _summarize(records):
+    thr = [r for r in records if r.get("kind") == "throughput"]
+    ovh = [r for r in records if r.get("kind") == "overhead"]
+    init = next((r for r in records if r.get("stage") == "init"), None)
+    out = {}
+    if init:
+        out["backend"] = init.get("backend")
+        out["devices"] = init.get("devices")
+    if ovh:
+        out["overhead"] = {k: v for k, v in ovh[-1].items()
+                           if k not in ("stage", "kind")}
+    if thr:
+        best = max(thr, key=lambda r: r["injections_per_sec"])
+        out["throughput"] = [
+            {k: r[k] for k in ("batch_size", "injections",
+                               "seconds", "injections_per_sec")}
+            for r in thr]
+        out["best"] = best
+    return out
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        worker(sys.argv[2] if len(sys.argv) > 2 else "default")
+        return 0
+
+    errors = []
+    force = os.environ.get("COAST_BENCH_BACKEND")  # e.g. "cpu" for dev boxes
+    plan = ([(force, INIT_TIMEOUT)] if force else
+            [("default", INIT_TIMEOUT), ("default", RETRY_TIMEOUT),
+             ("cpu", RETRY_TIMEOUT)])
+    summary, used = {}, None
+    for backend, budget in plan:
+        t0 = time.time()
+        records, error = _attempt(backend, budget)
+        if error:
+            errors.append(f"[{backend} attempt, {time.time()-t0:.0f}s] {error}")
+        summary = _summarize(records)
+        if "best" in summary:
+            used = backend
+            break
+
+    line = {"metric": "mm_tmr_fault_injections_per_sec"}
+    if "best" in summary:
+        value = summary["best"]["injections_per_sec"]
+        line.update({
+            "value": value,
+            "unit": "injections/sec",
+            "vs_baseline": round(value / BASELINE_INJ_PER_SEC, 2),
+            "backend": summary.get("backend"),
+            "throughput": summary.get("throughput"),
+            "overhead": summary.get("overhead"),
+        })
+        if errors:
+            line["error"] = "; ".join(errors)
+        if used == "cpu" and not force:
+            line["note"] = ("TPU backend unreachable; value measured on the "
+                            "CPU fallback backend")
+        print(json.dumps(line))
+        for e in errors:
+            print(f"# {e}", file=sys.stderr)
+        return 0
+    # No measurement anywhere: still one parseable JSON line, nonzero rc.
+    line.update({"value": None, "unit": "injections/sec", "vs_baseline": None,
+                 "error": "; ".join(errors) or "no measurement produced",
+                 "partial": summary or None})
+    print(json.dumps(line))
+    for e in errors:
+        print(f"# {e}", file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

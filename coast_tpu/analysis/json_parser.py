@@ -112,8 +112,23 @@ class Summary:
 
 def read_json_file(path: str) -> Dict[str, object]:
     with open(path) as f:
+        first = f.readline()
+        try:
+            head = json.loads(first)
+        except ValueError:
+            head = None
+        if (isinstance(head, dict) and "summary" in head
+                and head["summary"].get("format") == "ndjson"):
+            # write_ndjson bulk log: summary line + one run per line.
+            return {"summary": head["summary"],
+                    "runs": [json.loads(line) for line in f if line.strip()]}
+        if isinstance(head, dict) and ("runs" in head or "columns" in head):
+            # Single-line doc (write_columnar emits one line): the first
+            # readline consumed and parsed the whole file already.
+            return head
+        f.seek(0)
         doc = json.load(f)
-    if not isinstance(doc, dict) or "runs" not in doc:
+    if not isinstance(doc, dict) or not ("runs" in doc or "columns" in doc):
         raise ValueError(f"{path}: not a coast_tpu campaign log")
     return doc
 
@@ -144,15 +159,28 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     step_sum = 0
     step_n = 0
     for doc in docs:
-        runs: List[Dict[str, object]] = doc["runs"]  # type: ignore
-        for run in runs:
-            cls = classify_run(run)
-            counts[cls] += 1
-            n += 1
-            res = run.get("result") or {}
-            if "core" in res:
-                step_sum += int(res.get("runtime", 0))
-                step_n += 1
+        if "columns" in doc:                      # vectorised columnar path
+            import numpy as np
+            col = doc["columns"]  # type: ignore
+            codes = np.asarray(col["code"])
+            steps = np.asarray(col["steps"])
+            binc = np.bincount(codes, minlength=len(_CLASSES))
+            for i, cls in enumerate(_CLASSES):
+                counts[cls] += int(binc[i])
+            n += len(codes)
+            completed = codes <= 2                # success/corrected/sdc
+            step_sum += int(steps[completed].sum())
+            step_n += int(completed.sum())
+        else:
+            runs: List[Dict[str, object]] = doc["runs"]  # type: ignore
+            for run in runs:
+                cls = classify_run(run)
+                counts[cls] += 1
+                n += 1
+                res = run.get("result") or {}
+                if "core" in res:
+                    step_sum += int(res.get("runtime", 0))
+                    step_n += 1
         summary = doc.get("summary") or {}
         seconds += float(summary.get("seconds", 0.0))
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
@@ -228,6 +256,29 @@ def section_stats(docs: Iterable[Dict[str, object]]
     """
     table: Dict[str, Dict[str, int]] = {}
     for doc in docs:
+        if "columns" in doc:                      # vectorised columnar path
+            import numpy as np
+            col = doc["columns"]  # type: ignore
+            codes = np.asarray(col["code"])
+            leaf_ids = np.asarray(col["leaf_id"]).copy()
+            # Cache draws outside the program footprint (t < 0, never
+            # fired) go to the '<invalid-line>' bucket, matching
+            # to_injection_logs' symbol override.
+            invalid_line = np.asarray(col["t"]) < 0
+            leaf_ids[invalid_line] = -1
+            sec_name = {s["leaf_id"]: s["name"]
+                        for s in doc.get("sections", [])}  # type: ignore
+            sec_name[-1] = "<invalid-line>"
+            for lid in np.unique(leaf_ids):
+                sym = sec_name.get(int(lid), "?")
+                row = table.setdefault(
+                    sym, {**{cls: 0 for cls in _CLASSES}, "injections": 0})
+                sel = codes[leaf_ids == lid]
+                binc = np.bincount(sel, minlength=len(_CLASSES))
+                row["injections"] += len(sel)
+                for i, cls in enumerate(_CLASSES):
+                    row[cls] += int(binc[i])
+            continue
         for run in doc["runs"]:  # type: ignore
             sym = run.get("symbol")
             if not sym:
@@ -258,8 +309,13 @@ def format_section_stats(table: Dict[str, Dict[str, int]]) -> str:
 def cycle_histogram(docs: Iterable[Dict[str, object]],
                     bins: int = 20) -> List[Tuple[int, int, int]]:
     """[(lo, hi, count)] over the injection step index ('cycles' key)."""
-    cycles = [int(run.get("cycles", 0))
-              for doc in docs for run in doc["runs"]]  # type: ignore
+    cycles = []
+    for doc in docs:
+        if "columns" in doc:
+            cycles.extend(doc["columns"]["t"])  # type: ignore
+        else:
+            cycles.extend(int(run.get("cycles", 0))
+                          for run in doc["runs"])  # type: ignore
     if not cycles:
         return []
     lo, hi = min(cycles), max(cycles)

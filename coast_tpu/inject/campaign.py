@@ -147,9 +147,16 @@ class CampaignRunner:
         )
 
     def run(self, n: int, seed: int = 0,
-            batch_size: int = 4096) -> CampaignResult:
-        sched = generate(self.mmap, n, seed, self.prog.region.nominal_steps)
-        return self.run_schedule(sched, batch_size)
+            batch_size: int = 4096, start_num: int = 0) -> CampaignResult:
+        """``start_num`` resumes a seeded campaign at injection #start_num:
+        the schedule stream for (seed, start_num+n) is generated and the
+        first start_num rows skipped, so a resumed campaign injects exactly
+        the faults the interrupted one would have (the --start-num counter
+        of gdbClient.py:401)."""
+        sched = generate(self.mmap, start_num + n, seed,
+                         self.prog.region.nominal_steps)
+        return self.run_schedule(sched.slice(start_num, start_num + n),
+                                 batch_size)
 
     def run_until_errors(self, min_errors: int, seed: int = 0,
                          batch_size: int = 4096,

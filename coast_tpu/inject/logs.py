@@ -7,6 +7,15 @@ result sub-dict whose discriminating keys match the FromDict dispatch
 TimeoutResult, "message" -> AbortResult, "invalid" -> InvalidResult.
 jsonParser.py-style analysis therefore carries over directly
 (coast_tpu.analysis.json_parser consumes the same files).
+
+Throughput note: the reference logs one injection per several seconds, so
+per-run Python dicts are free.  A batched campaign produces 10^6 runs in a
+few seconds, so serialisation must not be the bottleneck: all per-run
+columns are converted with a single C-speed ``ndarray.tolist()`` each, and
+two bulk writers exist alongside the schema-compatible one --
+``write_ndjson`` (one template-formatted JSON line per run) and
+``write_columnar`` (one JSON doc of parallel arrays; O(1) Python objects),
+both consumed by coast_tpu.analysis.json_parser.
 """
 
 from __future__ import annotations
@@ -39,50 +48,141 @@ def _result_dict(code: int, errors: int, corrected: int, steps: int,
             "timestamp": ts}
 
 
+def _columns(res: CampaignResult, mmap: MemoryMap):
+    """Per-run columns as plain Python lists (one C-speed conversion each)."""
+    secs = {s.leaf_id: s for s in mmap.sections}
+    sched = res.schedule
+    return {
+        "leaf_id": sched.leaf_id.tolist(),
+        "lane": sched.lane.tolist(),
+        "word": sched.word.tolist(),
+        "bit": sched.bit.tolist(),
+        "t": sched.t.tolist(),
+        "code": res.codes.tolist(),
+        "errors": res.errors.tolist(),
+        "corrected": res.corrected.tolist(),
+        "steps": res.steps.tolist(),
+    }, secs
+
+
 def to_injection_logs(res: CampaignResult,
                       mmap: MemoryMap) -> List[Dict[str, object]]:
     ts = _timestamp()
-    secs = {s.leaf_id: s for s in mmap.sections}
+    col, secs = _columns(res, mmap)
+    sec_kind = {lid: s.kind for lid, s in secs.items()}
+    sec_name = {lid: s.name for lid, s in secs.items()}
     logs = []
-    sched = res.schedule
     for i in range(res.n):
-        sec = secs[int(sched.leaf_id[i])]
-        discarded = int(sched.t[i]) < 0
-        if discarded:
+        lid = col["leaf_id"][i]
+        t_i = col["t"][i]
+        if t_i < 0:
             # Cache draw outside the program footprint: never fired (the
             # plugin's invalid-line discard); must not be attributed to a
             # real section.
             section, symbol = "cache-invalid", "<invalid-line>"
-            name = f"<invalid-line>^bit{int(sched.bit[i])}"
+            name = f"<invalid-line>^bit{col['bit'][i]}"
         else:
-            section, symbol = sec.kind, sec.name
-            name = (f"{sec.name}[lane {int(sched.lane[i])}]"
-                    f"^bit{int(sched.bit[i])}")
+            section, symbol = sec_kind[lid], sec_name[lid]
+            name = f"{sec_name[lid]}[lane {col['lane'][i]}]^bit{col['bit'][i]}"
         logs.append({
             "timestamp": ts,
             "number": i,
             "section": section,
-            "address": int(sched.word[i]),
+            "address": col["word"][i],
             "oldValue": None,              # values live on-device; the flip
             "newValue": None,              # is XOR(1<<bit), recorded below
             "sleepTime": 0,
-            "cycles": int(sched.t[i]),     # step index = cycle analogue
-            "PC": int(sched.t[i]),
+            "cycles": t_i,                 # step index = cycle analogue
+            "PC": t_i,
             "name": name,
             "symbol": symbol,              # clean key for per-symbol
                                            # attribution (elfUtils.py:105-176)
-            "result": _result_dict(int(res.codes[i]), int(res.errors[i]),
-                                   int(res.corrected[i]), int(res.steps[i]), ts),
+            "result": _result_dict(col["code"][i], col["errors"][i],
+                                   col["corrected"][i], col["steps"][i], ts),
             "cacheInfo": None,
         })
     return logs
 
 
 def write_json(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
-    """Append-mode-equivalent structured log (threadFunctions.py:195-198
-    flushes per injection; we flush per campaign)."""
+    """Reference-schema structured log (threadFunctions.py:195-198 flushes
+    per injection; we flush per campaign)."""
     with open(path, "w") as f:
         json.dump({
             "summary": res.summary(),
             "runs": to_injection_logs(res, mmap),
         }, f, indent=1)
+
+
+def write_ndjson(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
+    """Newline-delimited bulk log: line 1 is the campaign summary (with a
+    ``"format": "ndjson"`` marker), each following line one run in the
+    InjectionLog schema.  Lines are template-formatted from pre-converted
+    columns -- no per-run dict/json.dumps work -- so a 10^6-run campaign
+    serialises in seconds."""
+    ts = _timestamp()
+    col, secs = _columns(res, mmap)
+    # One result template per class, mirroring _result_dict (timestamps
+    # identical across the campaign, as with write_json).
+    run_tpl = ('{"timestamp": "%s", "core": 0, "runtime": %%(steps)d, '
+               '"errors": %%(errors)d, "faults": %%(faults)d}' % ts)
+    res_tpl = {
+        cls.SUCCESS: run_tpl,
+        cls.CORRECTED: run_tpl,
+        cls.SDC: run_tpl,
+        cls.DUE_ABORT: ('{"type": "DWC/CFCSS", "message": "FAULT_DETECTED '
+                        'abort", "timestamp": "%s", "errors": 1}' % ts),
+        cls.DUE_TIMEOUT: ('{"trap": false, "timeout": "hit step bound at '
+                          '%%(steps)d", "timestamp": "%s"}' % ts),
+        cls.INVALID: ('{"invalid": "self-check out of domain '
+                      '(E=%%(errors)d)", "timestamp": "%s"}' % ts),
+    }
+    line_tpl = (
+        '{"timestamp": "%s", "number": %%(i)d, "section": "%%(section)s", '
+        '"address": %%(word)d, "oldValue": null, "newValue": null, '
+        '"sleepTime": 0, "cycles": %%(t)d, "PC": %%(t)d, '
+        '"name": "%%(name)s", "symbol": "%%(symbol)s", '
+        '"result": %%(result)s, "cacheInfo": null}' % ts)
+    sec_kind = {lid: s.kind for lid, s in secs.items()}
+    sec_name = {lid: s.name for lid, s in secs.items()}
+    with open(path, "w") as f:
+        f.write(json.dumps({"summary": {**res.summary(),
+                                        "format": "ndjson"}}) + "\n")
+        write = f.write
+        for i in range(res.n):
+            lid = col["leaf_id"][i]
+            t_i = col["t"][i]
+            if t_i < 0:
+                section, symbol = "cache-invalid", "<invalid-line>"
+                name = f"<invalid-line>^bit{col['bit'][i]}"
+            else:
+                section, symbol = sec_kind[lid], sec_name[lid]
+                name = (f"{sec_name[lid]}[lane {col['lane'][i]}]"
+                        f"^bit{col['bit'][i]}")
+            result = res_tpl[col["code"][i]] % {
+                "errors": col["errors"][i], "faults": col["corrected"][i],
+                "steps": col["steps"][i]}
+            # json.dumps on the string fields: leaf names are arbitrary
+            # author-chosen strings and must be JSON-escaped.
+            write(line_tpl % {
+                "i": i, "section": json.dumps(section)[1:-1],
+                "word": col["word"][i], "t": t_i,
+                "name": json.dumps(name)[1:-1],
+                "symbol": json.dumps(symbol)[1:-1],
+                "result": result} + "\n")
+
+
+def write_columnar(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
+    """Columnar bulk log: the whole campaign as parallel arrays plus the
+    section table -- O(1) Python objects regardless of campaign size, and
+    the natural format for numpy-side analysis.  json_parser summarises it
+    directly without materialising per-run dicts."""
+    col, secs = _columns(res, mmap)
+    with open(path, "w") as f:
+        json.dump({
+            "summary": {**res.summary(), "format": "columnar"},
+            "sections": [{"leaf_id": s.leaf_id, "name": s.name,
+                          "kind": s.kind, "lanes": s.lanes, "words": s.words}
+                         for s in secs.values()],
+            "columns": col,
+        }, f)

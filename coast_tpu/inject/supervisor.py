@@ -81,11 +81,26 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         default=1, help="how many forced injections to run")
     parser.add_argument("--seed", type=int, default=0,
                         help="campaign schedule seed (replayable)")
+    parser.add_argument("--start-num", type=int, default=0,
+                        help="resume the seeded campaign at injection "
+                        "#N (gdbClient.py:401 --start-num analogue)")
     parser.add_argument("--batch-size", type=int, default=4096)
+    parser.add_argument("--log-format", type=str, default="json",
+                        choices=["json", "ndjson", "columnar"],
+                        help="log writer: json = reference InjectionLog "
+                        "schema, ndjson/columnar = bulk formats for "
+                        "10^6-run campaigns")
     args = parser.parse_args(argv)
 
     if args.board in ("pynq", "hifive1"):
         print("This board not yet supported in this version", file=sys.stderr)
+        sys.exit(-1)
+    if args.errorCount and args.start_num:
+        # Hard error beats a silently ignored resume point: the
+        # error-bounded sizing loop draws fresh per-chunk seeds, so there
+        # is no single schedule stream a --start-num could index into.
+        print("Error, --start-num cannot be combined with -e/--errorCount",
+              file=sys.stderr)
         sys.exit(-1)
     if args.log_dir and not os.path.isdir(args.log_dir):
         print(f"Error, directory {args.log_dir} does not exist!",
@@ -195,7 +210,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         res = runner.run_until_errors(args.errorCount, seed=args.seed,
                                       batch_size=args.batch_size)
     else:
-        res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size)
+        res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size,
+                         start_num=args.start_num)
 
     print(res.summary())
     if not args.no_logging:
@@ -203,7 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         path = os.path.join(
             log_dir,
             f"{prog.region.name}_{strategy}_{args.section}.json")
-        logs.write_json(res, mmap, path)
+        writer = {"json": logs.write_json, "ndjson": logs.write_ndjson,
+                  "columnar": logs.write_columnar}[args.log_format]
+        writer(res, mmap, path)
         print(f"wrote {path}")
     return 0
 

@@ -25,6 +25,7 @@ the forced-injection debug hook (--forceBreak, injector.py:59-68).
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -123,8 +124,8 @@ def build_overrides(flags: Dict[str, object]) -> Dict[str, object]:
     overrides = dict(scope.protection_overrides())
     overrides["no_mem_replication"] = bool(flags.get("noMemReplication"))
     overrides["no_store_data_sync"] = bool(flags.get("noStoreDataSync"))
-    overrides["no_ctrl_sync"] = bool(flags.get("noStoreAddrSync")
-                                     or flags.get("noLoadSync"))
+    overrides["no_load_sync"] = bool(flags.get("noLoadSync"))
+    overrides["no_store_addr_sync"] = bool(flags.get("noStoreAddrSync"))
     overrides["count_errors"] = bool(flags.get("countErrors"))
     overrides["count_syncs"] = bool(flags.get("countSyncs"))
     overrides["segmented"] = bool(flags.get("s"))
@@ -168,6 +169,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The axon site hook registers its PJRT plugin and *programmatically*
+        # selects jax_platforms="axon,cpu" at interpreter start, overriding
+        # the env var; honor the user's CPU request explicitly (the 'x86
+        # board' path of the test harness; see testing/harness.py:145-150).
+        jax.config.update("jax_platforms", "cpu")
 
     from coast_tpu import DWC, EDDI, TMR, unprotected
     from coast_tpu.passes.verification import SoRViolation

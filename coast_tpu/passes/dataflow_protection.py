@@ -61,10 +61,15 @@ class ProtectionConfig:
     no_mem_replication: bool = False
     # -noStoreDataSync: skip voting the data of stores to replicated memory.
     no_store_data_sync: bool = False
-    # -noStoreAddrSync / -noLoadSync: skip voting the control/index state
-    # that forms addresses.  Folded into one knob because region control
-    # state is the only address-forming state.
-    no_ctrl_sync: bool = False
+    # -noLoadSync: skip voting address-forming control state *before* the
+    # lanes consume it (the reference votes GEP operands feeding loads,
+    # syncGEP synchronization.cpp:413-474).  Pre-step vote: repairs a flip
+    # before any load in the step dereferences it.
+    no_load_sync: bool = False
+    # -noStoreAddrSync: skip voting address-forming control state at the
+    # commit boundary (GEP operands feeding stores, :413-474).  Post-step
+    # vote: repairs control state before the next step's stores use it.
+    no_store_addr_sync: bool = False
     # -countErrors -> TMR_ERROR_CNT analogue.
     count_errors: bool = True
     # -countSyncs -> __SYNC_COUNT analogue.
@@ -129,13 +134,29 @@ class ProtectedProgram:
         self.replicated: Dict[str, bool] = {
             name: cfg.resolve_xmr(region, name) for name in region.spec
         }
-        # Sync-point table: which replicated leaves get voted each step.
+        # Address-forming roles from the provenance pass: which ctrl leaves
+        # feed load indices vs store indices (the GEP-operand classification
+        # of syncGEP, synchronization.cpp:413-474).
+        from coast_tpu.passes.verification import analyze
+        flow = analyze(region)
+        # Sync-point table: which replicated leaves get voted at the commit
+        # boundary each step (post-step), and which get a pre-step vote.
         self.step_sync: Dict[str, bool] = {}
+        self.pre_sync: Dict[str, bool] = {}
         for name, spec in region.spec.items():
             if not self.replicated[name]:
                 continue
+            self.pre_sync[name] = False
             if spec.kind == KIND_CTRL:
-                self.step_sync[name] = not cfg.no_ctrl_sync
+                in_load = name in flow.load_addr
+                in_store = name in flow.store_addr
+                # Pure predicates (neither address role) are terminator-sync
+                # state: syncTerminator voting is not flag-gated in the
+                # reference (synchronization.cpp:741-1113), so they are
+                # always voted at the commit boundary.
+                self.step_sync[name] = ((in_store and not cfg.no_store_addr_sync)
+                                        or not (in_load or in_store))
+                self.pre_sync[name] = in_load and not cfg.no_load_sync
             elif spec.kind == KIND_MEM:
                 self.step_sync[name] = not cfg.no_store_data_sync
             else:  # reg: registers are voted only where used by a sync point
@@ -257,11 +278,27 @@ class ProtectedProgram:
             halted = jnp.logical_or(halted, flags["cfc_fault"])
 
         region_state = {k: pstate[k] for k in self.region.spec}
+        miscompares = []
+        syncs = jnp.int32(0)
+
+        # Pre-step load sync: vote address-forming ctrl state before any
+        # load in this step dereferences it -- the syncGEP-before-the-load
+        # insertion point (synchronization.cpp:413-474).  TMR repairs the
+        # lanes in place; DWC latches the miscompare below and the step
+        # does not commit (check before use).
+        if cfg.num_clones > 1:
+            for name in region_state:
+                if self.pre_sync.get(name, False):
+                    voted, mis = voters.vote(region_state[name], cfg.num_clones)
+                    miscompares.append(mis)
+                    syncs = syncs + 1
+                    if cfg.num_clones == 3:
+                        region_state[name] = jnp.broadcast_to(
+                            voted, region_state[name].shape)
+
         laned = self._run_lanes(region_state, t)
 
         new_state: State = {}
-        miscompares = []
-        syncs = jnp.int32(0)
         for name in region_state:
             out = laned[name]
             if self.replicated[name]:
@@ -289,12 +326,16 @@ class ProtectedProgram:
                 else:
                     new_state[name] = out[0]
 
-        # Latch fault/correction accounting.
+        # Latch fault/correction accounting.  DWC checks *before* the store
+        # commits: a miscompare this step freezes the state at its pre-step
+        # image, the analogue of branching to the error block before the
+        # store instruction (syncStoreInst, synchronization.cpp:476-561).
+        fault_now = jnp.bool_(False)
         if miscompares and cfg.num_clones == 2:
             mis_any = jnp.any(jnp.stack(miscompares))
+            fault_now = jnp.logical_and(~halted, mis_any)
             flags = {**flags,
-                     "dwc_fault": jnp.logical_or(flags["dwc_fault"],
-                                                 jnp.logical_and(~halted, mis_any))}
+                     "dwc_fault": jnp.logical_or(flags["dwc_fault"], fault_now)}
         elif miscompares and cfg.num_clones == 3 and cfg.count_errors:
             mis_cnt = jnp.sum(jnp.stack(miscompares).astype(jnp.int32))
             flags = {**flags,
@@ -311,16 +352,18 @@ class ProtectedProgram:
         # Terminator: evaluate done() on the voted view, *before* committing,
         # so a single corrupted lane cannot steer control flow
         # (syncTerminator votes branch predicates, :741-1113).
+        commit_halt = jnp.logical_or(halted, fault_now)
         done_now = self.region.done(self._voted_view(new_state))
         flags = {**flags,
                  "done": jnp.logical_or(flags["done"],
-                                        jnp.logical_and(~halted, done_now)),
-                 "steps": flags["steps"] + jnp.where(halted, 0, 1)}
+                                        jnp.logical_and(~commit_halt, done_now)),
+                 "steps": flags["steps"] + jnp.where(commit_halt, 0, 1)}
 
         # Freeze state once halted (DWC abort semantics in a batch: the run's
-        # memory image stops evolving the step the fault latches).
+        # memory image stops evolving the step the fault latches -- and the
+        # fault step itself never commits, check-before-store).
         new_state = jax.tree.map(
-            lambda old, new: jnp.where(halted, old, new), pstate, new_state)
+            lambda old, new: jnp.where(commit_halt, old, new), pstate, new_state)
         return new_state, flags
 
     # -- whole-program runners ---------------------------------------------

@@ -59,10 +59,29 @@ class RegionDataflow:
 
     written: FrozenSet[str]                 # leaves not passed through identity
     deps: Dict[str, FrozenSet[str]]         # out leaf -> source leaves
+    # Address-forming roles: leaves whose values flow into the index
+    # operands of load-like (gather/dynamic_slice) or store-like
+    # (scatter/dynamic_update_slice) primitives.  These are the TPU
+    # analogues of the GEP operands the reference's syncGEP votes
+    # (synchronization.cpp:413-474): a "load address" is a gather index, a
+    # "store address" is a scatter/dynamic-update index.
+    load_addr: FrozenSet[str] = frozenset()
+    store_addr: FrozenSet[str] = frozenset()
+
+
+# Primitives that read memory at a data-dependent address (their trailing
+# operands are indices) vs write at one.  jnp indexing lowers to these.
+_LOAD_PRIMS = ("gather", "dynamic_slice")
+_STORE_UPDATE_PRIM = "dynamic_update_slice"
 
 
 def analyze(region: Region) -> RegionDataflow:
-    """Trace step() and propagate leaf provenance through the jaxpr."""
+    """Trace step() and propagate leaf provenance through the jaxpr.
+
+    Provenance recurses into sub-jaxprs (pjit/scan/cond/while) so address
+    roles inside control-flow bodies are found; loop carries run to a
+    fixpoint.  The reference is likewise transitive at calls
+    (verification.cpp getCallArgIndex :383-441)."""
     state = jax.eval_shape(region.init)
     closed = jax.make_jaxpr(region.step)(state, jnp.int32(0))
     jaxpr = closed.jaxpr
@@ -79,21 +98,107 @@ def analyze(region: Region) -> RegionDataflow:
         src[var] = {name}
         in_var_of[name] = var
 
+    load_addr: Set[str] = set()
+    store_addr: Set[str] = set()
+
     def var_deps(v) -> Set[str]:
         if isinstance(v, Literal):
             return set()
         return src.get(v, set())
 
-    for eqn in jaxpr.eqns:
-        acc: Set[str] = set()
-        for v in eqn.invars:
-            acc |= var_deps(v)
-        # Sub-jaxprs (scan/cond/while/pjit): conservative -- every output
-        # depends on every input, which over-approximates but never misses
-        # a crossing (the reference is likewise conservative at calls,
-        # verification.cpp "TODO: track pointers across function calls").
-        for v in eqn.outvars:
-            src[v] = acc
+    def seed(inner_vars, dep_sets) -> None:
+        for iv, d in zip(inner_vars, dep_sets):
+            src[iv] = src.get(iv, set()) | d
+
+    def walk(jpr) -> List[Set[str]]:
+        """Propagate through one (sub-)jaxpr; returns outvar dep sets.
+        Monotone over ``src``, so fixpoint iteration is safe."""
+        for eqn in jpr.eqns:
+            prim = eqn.primitive.name
+            ins = [var_deps(v) for v in eqn.invars]
+            if prim in _LOAD_PRIMS:
+                for d in ins[1:]:
+                    load_addr.update(d)
+            elif prim == _STORE_UPDATE_PRIM:
+                for d in ins[2:]:
+                    store_addr.update(d)
+            elif prim.startswith("scatter"):
+                if len(ins) > 1:
+                    store_addr.update(ins[1])
+
+            out_sets: List[Set[str]] = []
+            params = eqn.params
+            if prim == "cond" and "branches" in params:
+                per_branch = []
+                for br in params["branches"]:
+                    seed(br.jaxpr.invars, ins[1:])
+                    per_branch.append(walk(br.jaxpr))
+                # Control dependence: which branch ran (the predicate)
+                # influences every output -- exactly why the reference
+                # votes branch predicates (syncTerminator).
+                pred = ins[0]
+                out_sets = [set().union(pred, *(b[i] for b in per_branch))
+                            for i in range(len(eqn.outvars))]
+            elif prim == "while":
+                cn = params["cond_nconsts"]
+                bn = params["body_nconsts"]
+                cj = params["cond_jaxpr"].jaxpr
+                bj = params["body_jaxpr"].jaxpr
+                carry = [set(d) for d in ins[cn + bn:]]
+                # Fixpoint bound: a dependency can advance one carry slot
+                # per pass, so |carry| passes suffice (+2 slack).
+                cond_deps: Set[str] = set()
+                for _ in range(len(carry) + 2):
+                    seed(cj.invars, ins[:cn] + carry)
+                    cond_out = walk(cj)
+                    cond_deps |= set().union(*cond_out) if cond_out else set()
+                    seed(bj.invars, ins[cn:cn + bn] + carry)
+                    new_carry = walk(bj)
+                    grew = any(not n <= c for n, c in zip(new_carry, carry))
+                    carry = [c | n for c, n in zip(carry, new_carry)]
+                    if not grew:
+                        break
+                # Control dependence: the loop predicate decides how many
+                # iterations ran, so it taints every carried output.
+                out_sets = [c | cond_deps for c in carry]
+            elif prim == "scan":
+                sub = params["jaxpr"].jaxpr
+                cur = list(ins)
+                n_carry = params["num_carry"]
+                n_consts = params["num_consts"]
+                for _ in range(max(n_carry, 1) + 2):   # loop-carry fixpoint
+                    seed(sub.invars, cur)
+                    outs = walk(sub)
+                    carry_out = outs[:n_carry]
+                    old = cur[n_consts:n_consts + n_carry]
+                    grew = any(not n <= c for n, c in zip(carry_out, old))
+                    cur = (cur[:n_consts]
+                           + [c | n for c, n in zip(old, carry_out)]
+                           + cur[n_consts + n_carry:])
+                    if not grew:
+                        break
+                out_sets = outs
+            elif "jaxpr" in params:               # pjit / closed_call / remat
+                sub = params["jaxpr"]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                seed(sub.invars, ins)
+                out_sets = walk(sub)
+            elif "call_jaxpr" in params:
+                sub = params["call_jaxpr"]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                seed(sub.invars, ins)
+                out_sets = walk(sub)
+
+            if len(out_sets) != len(eqn.outvars):
+                acc: Set[str] = set()
+                for d in ins:
+                    acc |= d
+                out_sets = [acc] * len(eqn.outvars)
+            for v, s in zip(eqn.outvars, out_sets):
+                src[v] = src.get(v, set()) | s
+        return [var_deps(v) for v in jpr.outvars]
+
+    walk(jaxpr)
 
     assert len(jaxpr.outvars) == len(names), (
         f"step() must return exactly the state leaves; got "
@@ -109,7 +214,9 @@ def analyze(region: Region) -> RegionDataflow:
         else:
             out_deps[name] = frozenset(var_deps(var))
             written.add(name)
-    return RegionDataflow(written=frozenset(written), deps=out_deps)
+    return RegionDataflow(written=frozenset(written), deps=out_deps,
+                          load_addr=frozenset(load_addr),
+                          store_addr=frozenset(store_addr))
 
 
 def _scope_excluded(region: Region, cfg, name: str) -> bool:

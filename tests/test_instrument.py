@@ -82,7 +82,10 @@ def test_profile_counts_frozen_after_abort(hanoi_region):
              "bit": jnp.int32(1), "t": jnp.int32(10)}
     rec, counts = instrument.profile_run(prog, fault)
     assert bool(rec["dwc_fault"])
-    assert counts["towersOfHanoi"] == int(rec["steps"])
+    # Check-before-store: the fault step is *entered* (profiled, like a
+    # block that runs up to the compare before branching to the error
+    # block) but never commits, so it is not counted in the runtime T.
+    assert counts["towersOfHanoi"] == int(rec["steps"]) + 1
     assert int(rec["steps"]) < hanoi_region.nominal_steps
 
 
@@ -132,7 +135,8 @@ def test_protect_stack_detects_early_under_dwc(hanoi_region):
     than surviving until a later sync point -- the reference's motivation:
     vote the saved return address before using it (stackProtect.c)."""
     t = 40
-    unprot_cfg = dict(no_store_data_sync=True, no_ctrl_sync=True)
+    unprot_cfg = dict(no_store_data_sync=True, no_load_sync=True,
+                      no_store_addr_sync=True)
     plain = DWC(hanoi_region, **unprot_cfg)
     protd = DWC(hanoi_region, **unprot_cfg, protect_stack=True)
     rec_plain = jax.jit(plain.run)(_stack_fault(plain, t))
