@@ -1,0 +1,15 @@
+"""Frontend: automatic region lifting.
+
+The reference's engine protects *arbitrary programs*: ``opt`` discovers
+what to clone from the module itself (populateValuesToClone,
+projects/dataflowProtection/cloning.cpp:62-288) -- the user only annotates
+scope.  This package is the TPU-native analogue: it takes a user's plain
+jittable function (or a stepped function over a state dict) and *derives*
+the protected Region -- state discovery, LeafSpec kind classification from
+jaxpr provenance, termination analysis, golden self-check, and a control
+block graph -- so no hand-written spec is needed.
+"""
+
+from coast_tpu.frontend.lifter import LiftError, lift_fn, lift_step
+
+__all__ = ["lift_step", "lift_fn", "LiftError"]

@@ -67,6 +67,17 @@ class RegionDataflow:
     # "store address" is a scatter/dynamic-update index.
     load_addr: FrozenSet[str] = frozenset()
     store_addr: FrozenSet[str] = frozenset()
+    # Leaves used as the *target* of a store-like partial update (operand 0
+    # of dynamic_update_slice / scatter): the memory the program stores
+    # into, i.e. the leaves the reference's syncStoreInst guards
+    # (synchronization.cpp:476-561).  Used by the region lifter to classify
+    # KIND_MEM automatically.
+    stored_into: FrozenSet[str] = frozenset()
+    # Leaves whose values feed branch predicates (cond/while predicates,
+    # select_n selectors): the terminator-sync state the reference votes
+    # before every branch (syncTerminator :741-1113).  Used by the lifter
+    # to classify KIND_CTRL.
+    branch_pred: FrozenSet[str] = frozenset()
 
 
 # Primitives that read memory at a data-dependent address (their trailing
@@ -75,31 +86,25 @@ _LOAD_PRIMS = ("gather", "dynamic_slice")
 _STORE_UPDATE_PRIM = "dynamic_update_slice"
 
 
-def analyze(region: Region) -> RegionDataflow:
-    """Trace step() and propagate leaf provenance through the jaxpr.
+def _trace_provenance(jaxpr, names):
+    """Propagate leaf provenance through a jaxpr whose first ``len(names)``
+    invars are the named state leaves (any remaining invars -- e.g. the step
+    counter ``t`` -- carry no provenance).
 
-    Provenance recurses into sub-jaxprs (pjit/scan/cond/while) so address
-    roles inside control-flow bodies are found; loop carries run to a
-    fixpoint.  The reference is likewise transitive at calls
-    (verification.cpp getCallArgIndex :383-441)."""
-    state = jax.eval_shape(region.init)
-    closed = jax.make_jaxpr(region.step)(state, jnp.int32(0))
-    jaxpr = closed.jaxpr
-
-    names = sorted(state)
-    flat_in, in_tree = jax.tree.flatten({k: state[k] for k in names})
-    # jax.make_jaxpr flattens (state, t): state leaves in dict-key order
-    # (dicts flatten sorted), then t.
-    assert len(jaxpr.invars) == len(flat_in) + 1, (
-        len(jaxpr.invars), len(flat_in))
+    Returns ``(out_sets, in_var_of, facts)`` where ``out_sets`` is the leaf
+    dep set of every jaxpr outvar, ``in_var_of`` maps leaf name -> invar,
+    and ``facts`` holds the role sets (load/store address, store target,
+    branch predicate)."""
     src: Dict[object, Set[str]] = {}
     in_var_of: Dict[str, object] = {}
-    for name, var in zip(names, jaxpr.invars[:-1]):
+    for name, var in zip(names, jaxpr.invars):
         src[var] = {name}
         in_var_of[name] = var
 
     load_addr: Set[str] = set()
     store_addr: Set[str] = set()
+    stored_into: Set[str] = set()
+    branch_pred: Set[str] = set()
 
     def var_deps(v) -> Set[str]:
         if isinstance(v, Literal):
@@ -122,9 +127,13 @@ def analyze(region: Region) -> RegionDataflow:
             elif prim == _STORE_UPDATE_PRIM:
                 for d in ins[2:]:
                     store_addr.update(d)
+                stored_into.update(ins[0])
             elif prim.startswith("scatter"):
                 if len(ins) > 1:
                     store_addr.update(ins[1])
+                stored_into.update(ins[0])
+            elif prim == "select_n":
+                branch_pred.update(ins[0])
 
             out_sets: List[Set[str]] = []
             params = eqn.params
@@ -137,6 +146,7 @@ def analyze(region: Region) -> RegionDataflow:
                 # influences every output -- exactly why the reference
                 # votes branch predicates (syncTerminator).
                 pred = ins[0]
+                branch_pred.update(pred)
                 out_sets = [set().union(pred, *(b[i] for b in per_branch))
                             for i in range(len(eqn.outvars))]
             elif prim == "while":
@@ -160,6 +170,7 @@ def analyze(region: Region) -> RegionDataflow:
                         break
                 # Control dependence: the loop predicate decides how many
                 # iterations ran, so it taints every carried output.
+                branch_pred.update(cond_deps)
                 out_sets = [c | cond_deps for c in carry]
             elif prim == "scan":
                 sub = params["jaxpr"].jaxpr
@@ -198,25 +209,67 @@ def analyze(region: Region) -> RegionDataflow:
                 src[v] = src.get(v, set()) | s
         return [var_deps(v) for v in jpr.outvars]
 
-    walk(jaxpr)
+    out_sets = walk(jaxpr)
+    facts = {"load_addr": frozenset(load_addr),
+             "store_addr": frozenset(store_addr),
+             "stored_into": frozenset(stored_into),
+             "branch_pred": frozenset(branch_pred)}
+    return out_sets, in_var_of, facts
+
+
+def analyze_step(step, state) -> RegionDataflow:
+    """Trace a step function over ``state`` shapes and propagate leaf
+    provenance through the jaxpr.
+
+    Provenance recurses into sub-jaxprs (pjit/scan/cond/while) so address
+    roles inside control-flow bodies are found; loop carries run to a
+    fixpoint.  The reference is likewise transitive at calls
+    (verification.cpp getCallArgIndex :383-441)."""
+    state = jax.eval_shape(lambda: state)  # accept arrays or ShapeDtypeStructs
+    closed = jax.make_jaxpr(step)(state, jnp.int32(0))
+    jaxpr = closed.jaxpr
+
+    names = sorted(state)
+    # jax.make_jaxpr flattens (state, t): state leaves in dict-key order
+    # (dicts flatten sorted), then t.
+    assert len(jaxpr.invars) == len(names) + 1, (
+        len(jaxpr.invars), len(names))
+    out_sets, in_var_of, facts = _trace_provenance(jaxpr, names)
 
     assert len(jaxpr.outvars) == len(names), (
         f"step() must return exactly the state leaves; got "
         f"{len(jaxpr.outvars)} outputs for {len(names)} leaves")
     out_deps: Dict[str, FrozenSet[str]] = {}
     written: Set[str] = set()
-    for name, var in zip(names, jaxpr.outvars):
+    for name, var, deps in zip(names, jaxpr.outvars, out_sets):
         if isinstance(var, Literal):
             out_deps[name] = frozenset()
             written.add(name)
         elif var is in_var_of.get(name):
             out_deps[name] = frozenset({name})      # identity passthrough
         else:
-            out_deps[name] = frozenset(var_deps(var))
+            out_deps[name] = frozenset(deps)
             written.add(name)
-    return RegionDataflow(written=frozenset(written), deps=out_deps,
-                          load_addr=frozenset(load_addr),
-                          store_addr=frozenset(store_addr))
+    return RegionDataflow(written=frozenset(written), deps=out_deps, **facts)
+
+
+def analyze(region: Region) -> RegionDataflow:
+    """Provenance analysis of a region's step (see analyze_step)."""
+    return analyze_step(region.step, jax.eval_shape(region.init))
+
+
+def reads_of(fn, state, *extra_args) -> FrozenSet[str]:
+    """The set of state leaves the output of ``fn(state, *extra)`` depends
+    on -- e.g. which leaves a region's done() predicate reads.  Used by the
+    lifter to classify termination-steering leaves as KIND_CTRL."""
+    state = jax.eval_shape(lambda: state)
+    closed = jax.make_jaxpr(fn)(state, *extra_args)
+    names = sorted(state)
+    out_sets, _, _ = _trace_provenance(closed.jaxpr, names)
+    acc: Set[str] = set()
+    for s in out_sets:
+        acc |= s
+    return frozenset(acc)
 
 
 def _scope_excluded(region: Region, cfg, name: str) -> bool:
