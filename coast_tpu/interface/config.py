@@ -82,10 +82,24 @@ class ScopeConfig:
             self.ignore_fns.append(x)
 
     def protection_overrides(self) -> Dict[str, Tuple[str, ...]]:
-        """The engine-facing knobs: leaf-scope lists for ProtectionConfig."""
+        """The engine-facing knobs: every scope list, forwarded to
+        ProtectionConfig.  Function-scope lists rewrap the region's named
+        sub-functions per class (dataflow_protection.fn_scope_of); names
+        that don't exist and flags with no tpu semantics are hard errors
+        in verify_options, never silently inert."""
+        u = lambda xs: tuple(dict.fromkeys(xs))  # noqa: E731 - dedupe, keep order
         return {
-            "ignore_globals": tuple(dict.fromkeys(self.ignore_glbls)),
-            "xmr_globals": tuple(dict.fromkeys(self.clone_glbls)),
+            "ignore_globals": u(self.ignore_glbls),
+            "xmr_globals": u(self.clone_glbls),
+            "ignore_fns": u(self.ignore_fns),
+            "skip_lib_calls": u(self.skip_lib_calls),
+            "replicate_fn_calls": u(self.replicate_fn_calls),
+            "clone_fns": u(self.clone_fns),
+            "clone_return_fns": u(self.clone_return),
+            "clone_after_call_fns": u(self.clone_after_call),
+            "protected_lib_fns": u(self.protected_lib_fns),
+            "isr_functions": u(self.isr_functions),
+            "runtime_init_globals": u(self.runtime_init_globals),
         }
 
 

@@ -132,3 +132,102 @@ def clone_after_call(fn: Callable, num_clones: int = 3) -> Callable:
     wrapper.__name__ = (
         f"{getattr(fn, '__name__', 'fn')}_CLONE_AFTER_CALL_1_2")
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# In-lane scope wrappers: the same boundary contracts, applied *inside* the
+# engine's vmapped lane trace.
+#
+# The transforms above take explicit lane axes and are used at the region
+# boundary.  When a region's step calls named sub-functions
+# (Region.functions), the engine runs the step under ``vmap(...,
+# axis_name=LANE_AXIS)`` and rewraps each function per its scope class
+# using cross-lane collectives over the named lane axis: ``all_gather``
+# reconstructs the replica set inside a single lane's trace, so the
+# call-boundary vote (processCallSync, synchronization.cpp:563-738) can
+# run exactly at the call site.  Miscompare results are appended to the
+# FnNamespace log and latched by the engine (DWC abort / TMR_ERROR_CNT).
+# ---------------------------------------------------------------------------
+
+LANE_AXIS = "lane"
+
+
+def _gather_args(args):
+    return jax.tree.map(
+        lambda x: jax.lax.all_gather(jnp.asarray(x), LANE_AXIS), tuple(args))
+
+
+def _vote_tree(tree, num_clones, log):
+    flat, treedef = jax.tree.flatten(tree)
+    voted = []
+    for leaf in flat:
+        v, m = voters.vote(leaf, num_clones)
+        log.append(m)
+        voted.append(v)
+    return jax.tree.unflatten(treedef, voted)
+
+
+def lane_ignored(fn: Callable, num_clones: int, log) -> Callable:
+    """-ignoreFns: the function is *outside* the sphere of replication --
+    one logical call with synchronized arguments.  Every crossing argument
+    is voted across lanes (the forced call-boundary sync of
+    verification.cpp:587,676), the body runs once on the voted copies, and
+    the single result re-enters every lane identically."""
+
+    def wrapper(*args):
+        voted = _vote_tree(_gather_args(args), num_clones, log)
+        return fn(*voted)
+
+    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_IGNORED"
+    return wrapper
+
+
+def _call_on_lane0(fn: Callable) -> Callable:
+    """Single unsynced call on lane 0's arguments (shared by -skipLibCalls
+    and -cloneAfterCall, whose mechanics coincide under the lane axis)."""
+
+    def wrapper(*args):
+        gathered = _gather_args(args)
+        lane0 = jax.tree.map(lambda g: g[0], gathered)
+        return fn(*lane0)
+
+    return wrapper
+
+
+def lane_skip_lib(fn: Callable, num_clones: int) -> Callable:
+    """-skipLibCalls: single call, *no* argument sync -- lane 0's arguments
+    are used verbatim (the reference simply does not clone or sync the
+    call, interface.cpp:82-100).  A fault in lane 0's arguments therefore
+    corrupts every replica: the single point of failure the flag
+    deliberately accepts for cheap library calls."""
+    wrapper = _call_on_lane0(fn)
+    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_SKIPLIB"
+    return wrapper
+
+
+def lane_protected_lib(fn: Callable, num_clones: int, log) -> Callable:
+    """-protectedLibFn (__xMR_PROT_LIB): replicated body behind a
+    single-copy signature (cloning.cpp:562-564).  Arguments are voted in,
+    the body runs per lane, and the return is voted out -- both boundary
+    syncs are logged."""
+
+    def wrapper(*args):
+        voted_in = _vote_tree(_gather_args(args), num_clones, log)
+        out = fn(*voted_in)
+        (gathered_out,) = _gather_args((out,))
+        return _vote_tree(gathered_out, num_clones, log)
+
+    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_COAST_WRAPPER"
+    return wrapper
+
+
+def lane_clone_after_call(fn: Callable, num_clones: int) -> Callable:
+    """-cloneAfterCall: call once on lane 0's (single-copy) arguments and
+    fan the result out -- each lane receives an identical copy that then
+    lives and corrupts independently (cloning.cpp:1700-1768, the scanf
+    pattern).  Under the lane axis the returned value is already per-lane;
+    the fan-out is the identity."""
+    wrapper = _call_on_lane0(fn)
+    wrapper.__name__ = (
+        f"{getattr(fn, '__name__', 'fn')}_CLONE_AFTER_CALL_1_2")
+    return wrapper

@@ -32,6 +32,7 @@ inside the traced scan, so an entire campaign batches as one XLA program.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -76,6 +77,28 @@ class LeafSpec:
             raise ValueError(f"bad leaf kind {self.kind!r}; one of {_VALID_KINDS}")
 
 
+class FnNamespace:
+    """Attribute/byname access to a region's sub-functions, plus a log of
+    call-boundary miscompares the engine's wrappers append to during
+    tracing (the per-call compare results of processCallSync,
+    synchronization.cpp:563-738)."""
+
+    def __init__(self, fns: Dict[str, Callable]):
+        self._fns = fns
+        self.miscompares = []   # bool tracers appended by scope wrappers
+
+    def __getattr__(self, name: str) -> Callable:
+        try:
+            return self.__dict__["_fns"][name]
+        except KeyError:
+            raise AttributeError(
+                f"region has no function {name!r} "
+                f"(have: {', '.join(sorted(self.__dict__['_fns']))})") from None
+
+    def __getitem__(self, name: str) -> Callable:
+        return getattr(self, name)
+
+
 @dataclasses.dataclass
 class Region:
     """A protected dataflow region (the unit `opt -TMR` operates on).
@@ -111,6 +134,14 @@ class Region:
     # Optional control-flow graph for CFCSS (coast_tpu.ir.graph.BlockGraph);
     # regions without one can still be TMR/DWC protected.
     graph: Any = None
+    # Named sub-functions (jittable callables) the step may invoke through
+    # the ``fns`` namespace of a 3-argument ``step(state, t, fns)``.  These
+    # are the region's "module functions": the unit the function-scope
+    # lists (-ignoreFns/-cloneFns/-skipLibCalls/-replicateFnCalls/
+    # -protectedLibFn/-cloneAfterCall/-cloneReturn, interface.cpp:82-164)
+    # name and the engine re-wraps per scope class
+    # (passes.dataflow_protection._fn_scope_of).
+    functions: Dict[str, Callable] = dataclasses.field(default_factory=dict)
     # Extra metadata (benchmark golden values etc.)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -118,6 +149,26 @@ class Region:
         """Resolve the replication scope of a leaf (annotation > default)."""
         s = self.spec[name]
         return self.default_xmr if s.xmr is None else s.xmr
+
+    def wants_fns(self) -> bool:
+        """True when step has the 3-argument form ``step(state, t, fns)``."""
+        try:
+            return len(inspect.signature(self.step).parameters) >= 3
+        except (TypeError, ValueError):
+            return False
+
+    def bound_step(self, fns: Any = None) -> Callable:
+        """The 2-argument step with the function namespace bound.
+
+        With ``fns=None`` the raw sub-functions are bound unwrapped -- the
+        view analysis passes and unprotected execution see (the original
+        module before cloning).  The protection engine passes its own
+        namespace with each function wrapped per its scope class."""
+        if not self.wants_fns():
+            return self.step
+        if fns is None:
+            fns = FnNamespace(dict(self.functions))
+        return lambda state, t: self.step(state, t, fns)
 
     def validate(self) -> None:
         """Shape/spec sanity check; the lightweight analogue of
@@ -129,7 +180,7 @@ class Region:
             raise ValueError(
                 f"region {self.name}: spec/state mismatch "
                 f"(missing specs {sorted(missing)}, dangling specs {sorted(extra)})")
-        stepped = jax.eval_shape(self.step, state, jnp.int32(0))
+        stepped = jax.eval_shape(self.bound_step(), state, jnp.int32(0))
         for k in state:
             if (state[k].shape, state[k].dtype) != (stepped[k].shape, stepped[k].dtype):
                 raise ValueError(
@@ -145,10 +196,11 @@ class Region:
     # ------------------------------------------------------------------
     def run_unprotected(self) -> State:
         state = self.init()
+        step = self.bound_step()
 
         def body(carry, t):
             state, halted = carry
-            new = self.step(state, t)
+            new = step(state, t)
             new = jax.tree.map(lambda o, n: jnp.where(halted, o, n), state, new)
             halted = jnp.logical_or(halted, self.done(new))
             return (new, halted), None

@@ -47,6 +47,9 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     "trivial": _lazy("smoke", "make_trivial_region"),
     "helloWorld": _lazy("smoke", "make_hello_region"),
     "simpleTMR": _lazy("smoke", "make_simple_tmr_region"),
+    # Multi-function region for the function-scope lists (the nestedCalls/
+    # protectedLib/cloneAfterCall/replReturn unit-test class, §2.3 #32).
+    "nestedCalls": _lazy("nested_calls"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

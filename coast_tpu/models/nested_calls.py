@@ -1,0 +1,109 @@
+"""nestedCalls: a multi-function region exercising the function-scope lists.
+
+The reference's unit-test corpus isolates function-boundary mechanics in
+dedicated files -- nestedCalls.c, protectedLib.c, cloneAfterCall.c,
+replReturn.c (tests/TMRregression/unitTests/) -- driven with per-test scope
+flags (unitTestDriver.py:81-150).  This region is their TPU analogue: a
+hash pipeline whose step calls two named sub-functions through the ``fns``
+namespace, so every scope class (-ignoreFns / -skipLibCalls /
+-replicateFnCalls / -cloneFns / -cloneReturn / -cloneAfterCall /
+-protectedLibFn, interface.cpp:82-164) can be applied to them and its
+boundary behavior observed.
+
+Program: out[i] = fold(mix(acc ^ data[i])); acc chains through mix, so a
+flipped lane keeps diverging until a call-boundary or store sync repairs
+or detects it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+
+N = 24
+
+
+def mix(x: jax.Array) -> jax.Array:
+    """Inner hash round (a protected callee by default)."""
+    x = (x ^ (x >> 3)) * jnp.uint32(0x9E3779B1)
+    return x ^ (x >> 7)
+
+
+def fold(x: jax.Array) -> jax.Array:
+    """Word fold (the function the scope tests move between classes)."""
+    return ((x >> 16) ^ (x & jnp.uint32(0xFFFF))) * jnp.uint32(0x85EBCA6B)
+
+
+def make_region() -> Region:
+    data = (jnp.arange(N, dtype=jnp.uint32) * jnp.uint32(2654435761)) >> 13
+
+    def init():
+        return {
+            "data": data,
+            "out": jnp.zeros(N, jnp.uint32),
+            "i": jnp.int32(0),
+            "acc": jnp.uint32(1),
+        }
+
+    def step(state, t, fns):
+        x = jax.lax.dynamic_index_in_dim(state["data"], state["i"],
+                                         keepdims=False)
+        y = fns.mix(state["acc"] ^ x)
+        z = fns.fold(y)
+        out = jax.lax.dynamic_update_index_in_dim(state["out"], z,
+                                                  state["i"], axis=0)
+        return {"data": state["data"], "out": out,
+                "i": state["i"] + 1, "acc": y}
+
+    def done(state):
+        return state["i"] >= N
+
+    # Golden final image computed with the raw (unwrapped) functions.
+    golden = {"i": jnp.int32(0), "acc": jnp.uint32(1),
+              "out": jnp.zeros(N, jnp.uint32)}
+    import numpy as np
+    acc = np.uint32(1)
+    outs = []
+    for i in range(N):
+        y = int(mix(jnp.uint32(int(acc) ^ int(data[i]))))
+        outs.append(int(fold(jnp.uint32(y))))
+        acc = np.uint32(y)
+    golden_out = jnp.asarray(np.array(outs, dtype=np.uint32))
+
+    def check(state):
+        return jnp.sum(state["out"] != golden_out).astype(jnp.int32)
+
+    def output(state):
+        return state["out"]
+
+    graph = BlockGraph(
+        names=["entry", "loop", "exit"],
+        edges=[(0, 1), (1, 1), (1, 2)],
+        block_of=lambda s: jnp.where(s["i"] >= N, jnp.int32(2),
+                                     jnp.int32(1)).astype(jnp.int32),
+    )
+
+    return Region(
+        name="nestedCalls",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=N,
+        max_steps=3 * N,
+        spec={
+            "data": LeafSpec(KIND_RO),
+            "out": LeafSpec(KIND_MEM),
+            "i": LeafSpec(KIND_CTRL),
+            "acc": LeafSpec(KIND_REG),
+        },
+        default_xmr=True,
+        graph=graph,
+        functions={"mix": mix, "fold": fold},
+        meta={"oracle": "Number of errors: 0"},
+    )

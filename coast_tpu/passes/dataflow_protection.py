@@ -85,8 +85,62 @@ class ProtectionConfig:
     # (interface.cpp:82-164); highest priority, above region annotations.
     ignore_globals: Tuple[str, ...] = ()
     xmr_globals: Tuple[str, ...] = ()
+    # Function-scope lists (interface.cpp:82-164), applied to the region's
+    # named sub-functions (Region.functions) by rewrapping each call per
+    # its scope class (interface/wrappers.py lane_* combinators).
+    # Precedence mirrors the reference's merge rules (clone lists override
+    # ignore lists): cloneAfterCall > protectedLibFn > cloneReturn >
+    # cloneFns > ignoreFns > replicateFnCalls > skipLibCalls > default
+    # (replicated).
+    ignore_fns: Tuple[str, ...] = ()
+    skip_lib_calls: Tuple[str, ...] = ()
+    replicate_fn_calls: Tuple[str, ...] = ()
+    clone_fns: Tuple[str, ...] = ()
+    clone_return_fns: Tuple[str, ...] = ()        # -cloneReturn (.RR)
+    clone_after_call_fns: Tuple[str, ...] = ()    # -cloneAfterCall
+    protected_lib_fns: Tuple[str, ...] = ()       # -protectedLibFn
+    # -isrFunctions: interrupt handlers excluded from cloning.  There is no
+    # interrupt concept in a stepped TPU region; a non-empty list is a hard
+    # configuration error (refused, not silently inert).
+    isr_functions: Tuple[str, ...] = ()
+    # -runtimeInitGlobals: cloned globals re-initialised by a runtime
+    # memcpy at program start (addGlobalRuntimeInit, cloning.cpp:2543-2588).
+    # The engine broadcast-initialises *every* replicated leaf from the
+    # single init() image (init_pstate), so the semantics hold for all
+    # leaves by construction; listed names are validated to exist.
+    runtime_init_globals: Tuple[str, ...] = ()
     # CFCSS stacking (projects/CFCSS); filled by passes.cfcss.
     cfcss: bool = False
+
+    def fn_scope_of(self, name: str) -> str:
+        """Resolve a sub-function's scope class.  Precedence encodes the
+        reference's CL merge rules (getFunctionsFromCL, interface.cpp
+        :88-164: cloneAfterCall implies skipLibCalls+ignoreFns, clone
+        lists override ignore lists)."""
+        if name in self.clone_after_call_fns:
+            return "clone_after_call"
+        if name in self.protected_lib_fns:
+            return "protected_lib"
+        if name in self.clone_return_fns:
+            return "replicated_return"
+        if name in self.clone_fns:
+            return "replicated"
+        if name in self.ignore_fns:
+            return "ignored"
+        if name in self.replicate_fn_calls:
+            return "replicated"
+        if name in self.skip_lib_calls:
+            return "skip_lib"
+        return "replicated"
+
+    def fn_lists(self) -> Dict[str, Tuple[str, ...]]:
+        return {"ignoreFns": self.ignore_fns,
+                "skipLibCalls": self.skip_lib_calls,
+                "replicateFnCalls": self.replicate_fn_calls,
+                "cloneFns": self.clone_fns,
+                "cloneReturn": self.clone_return_fns,
+                "cloneAfterCall": self.clone_after_call_fns,
+                "protectedLibFn": self.protected_lib_fns}
 
     def resolve_xmr(self, region: Region, name: str) -> bool:
         if self.num_clones == 1:
@@ -166,6 +220,19 @@ class ProtectedProgram:
                 # top of the normal sync taxonomy: the saved return-address
                 # copies are voted even when store/ctrl syncs are disabled.
                 self.step_sync[name] = True
+        # Function-scope resolution (the populateFnWorklist closure,
+        # cloning.cpp:294-431): each named sub-function gets a scope class
+        # and is rewrapped accordingly inside the lane trace.
+        self.fn_scope: Dict[str, str] = {
+            name: cfg.fn_scope_of(name) for name in region.functions}
+        cross_lane = [n for n, c in self.fn_scope.items()
+                      if c in ("ignored", "skip_lib", "protected_lib",
+                               "clone_after_call")]
+        if cfg.segmented and cross_lane and cfg.num_clones > 1:
+            raise ValueError(
+                "segmented (-s) replica scheduling cannot express the "
+                "cross-lane call-boundary sync of function scope classes "
+                f"for {sorted(cross_lane)}; use interleaved (-i) scheduling")
         # Injectable memory map order (stable): used by the flipper and by
         # inject.mem.MemoryMap.
         self.leaf_order = [n for n in region.spec if region.spec[n].inject]
@@ -236,8 +303,39 @@ class ProtectedProgram:
         return pstate, _flags_init(self.cfg)
 
     # -- lane execution -----------------------------------------------------
-    def _run_lanes(self, pstate: State, t: jax.Array) -> State:
-        """Execute step() once per lane; returns every leaf with a lane axis.
+    def _fn_env(self):
+        """Build the per-trace function namespace: each named sub-function
+        rewrapped per its scope class (the call-boundary contracts of
+        interface/wrappers.py); boundary miscompares accumulate in the
+        namespace log and are latched by step()."""
+        from coast_tpu.interface import wrappers as W
+        from coast_tpu.ir.region import FnNamespace
+        env = FnNamespace({})
+        n = self.cfg.num_clones
+        wrapped = {}
+        for name, fn in self.region.functions.items():
+            cls = self.fn_scope[name]
+            if n == 1 or cls in ("replicated", "replicated_return"):
+                # Replicated bodies/calls are the natural per-lane call
+                # under vmap; .RR additionally skips boundary sync, which
+                # is also the per-lane default here.
+                wrapped[name] = fn
+            elif cls == "ignored":
+                wrapped[name] = W.lane_ignored(fn, n, env.miscompares)
+            elif cls == "skip_lib":
+                wrapped[name] = W.lane_skip_lib(fn, n)
+            elif cls == "protected_lib":
+                wrapped[name] = W.lane_protected_lib(fn, n, env.miscompares)
+            else:  # clone_after_call
+                wrapped[name] = W.lane_clone_after_call(fn, n)
+        env._fns = wrapped
+        return env
+
+    def _run_lanes(self, pstate: State, t: jax.Array):
+        """Execute step() once per lane; returns ``(laned, call_mis)`` where
+        every leaf of ``laned`` carries a lane axis and ``call_mis`` is the
+        vector of call-boundary miscompares from function-scope wrappers
+        (empty when the region has no such calls).
 
         Interleaved (-i): one vmap -- XLA vectorises the N replicas through
         each op, the closest analogue of interleaving replica instructions.
@@ -245,22 +343,45 @@ class ProtectedProgram:
         step is scheduled as a unit before the next (utils.cpp:370-550).
         """
         n = self.cfg.num_clones
+        no_mis = jnp.zeros((0,), jnp.bool_)
         if n == 1:
-            return {k: v[None] for k, v in self.region.step(pstate, t).items()}
+            out = self.region.bound_step()(pstate, t)
+            return {k: v[None] for k, v in out.items()}, no_mis
 
         if self.cfg.segmented:
+            step = self.region.bound_step()
             lane_outs = []
             for lane in range(n):
                 lane_state = {
                     k: (v[lane] if self.replicated[k] else v)
                     for k, v in pstate.items()
                 }
-                lane_outs.append(self.region.step(lane_state, t))
-            return {k: jnp.stack([o[k] for o in lane_outs]) for k in lane_outs[0]}
+                lane_outs.append(step(lane_state, t))
+            return ({k: jnp.stack([o[k] for o in lane_outs])
+                     for k in lane_outs[0]}, no_mis)
 
         in_axes = ({k: (0 if self.replicated[k] else None) for k in pstate},
                    None)
-        return jax.vmap(self.region.step, in_axes=in_axes, out_axes=0)(pstate, t)
+
+        if not self.region.wants_fns():
+            laned = jax.vmap(self.region.step, in_axes=in_axes,
+                             out_axes=0)(pstate, t)
+            return laned, no_mis
+
+        from coast_tpu.interface.wrappers import LANE_AXIS
+
+        def step_plus(state, t):
+            env = self._fn_env()
+            out = self.region.step(state, t, env)
+            mis = (jnp.stack(env.miscompares) if env.miscompares
+                   else jnp.zeros((0,), jnp.bool_))
+            return out, mis
+
+        laned, mis = jax.vmap(step_plus, in_axes=in_axes, out_axes=0,
+                              axis_name=LANE_AXIS)(pstate, t)
+        # The wrappers compute each miscompare from an all_gather, so every
+        # lane carries the identical value; one lane's copy is the record.
+        return laned, mis[0]
 
     # -- one protected step -------------------------------------------------
     def step(self, pstate: State, flags: Dict[str, jax.Array],
@@ -296,7 +417,15 @@ class ProtectedProgram:
                         region_state[name] = jnp.broadcast_to(
                             voted, region_state[name].shape)
 
-        laned = self._run_lanes(region_state, t)
+        laned, call_mis = self._run_lanes(region_state, t)
+        # Call-boundary syncs executed by function-scope wrappers inside the
+        # lane trace (processCallSync, synchronization.cpp:563-738): each
+        # entry is one vote/compare at a sub-function call site.
+        n_call_sync = int(call_mis.shape[0])
+        if n_call_sync and cfg.num_clones > 1:
+            for j in range(n_call_sync):
+                miscompares.append(call_mis[j])
+            syncs = syncs + n_call_sync
 
         new_state: State = {}
         for name in region_state:

@@ -254,8 +254,11 @@ def analyze_step(step, state) -> RegionDataflow:
 
 
 def analyze(region: Region) -> RegionDataflow:
-    """Provenance analysis of a region's step (see analyze_step)."""
-    return analyze_step(region.step, jax.eval_shape(region.init))
+    """Provenance analysis of a region's step (see analyze_step).  Sub-
+    functions are bound unwrapped: the analysis sees the module as written,
+    before any scope-class rewrapping (the reference likewise verifies
+    before cloning, dataflowProtection.cpp:63-164)."""
+    return analyze_step(region.bound_step(), jax.eval_shape(region.init))
 
 
 def reads_of(fn, state, *extra_args) -> FrozenSet[str]:
@@ -309,6 +312,32 @@ def verify_options(region: Region, cfg) -> FrozenSet[str]:
     for name in sorted(both):
         errors.append(f"leaf '{name}' listed in both -ignore_globals and "
                       "-xmr_globals")
+
+    # -- function-scope lists: every named function must exist (the
+    #    missing-name error of processCommandLine, interface.cpp:244-362);
+    #    flags with no tpu semantics are refused, never silently inert --
+    fns = getattr(region, "functions", {}) or {}
+    for flag, names in getattr(cfg, "fn_lists", dict)().items():
+        for name in names:
+            if name not in fns:
+                have = ", ".join(sorted(fns)) or "<none>"
+                errors.append(
+                    f"-{flag}: no function named '{name}' in region "
+                    f"'{region.name}' (have: {have})")
+    for name in getattr(cfg, "isr_functions", ()):
+        errors.append(
+            f"-isrFunctions: '{name}': interrupt service routines do not "
+            "exist in a stepped TPU region; remove the flag (the reference "
+            "excludes ISRs from cloning, inspection.cpp:183-186 -- here "
+            "there is nothing to exclude)")
+    for name in getattr(cfg, "runtime_init_globals", ()):
+        if name not in region.spec:
+            errors.append(
+                f"-runtimeInitGlobals: no leaf named '{name}' in region "
+                f"'{region.name}' (every replicated leaf is already "
+                "runtime-initialised from the init() image by "
+                "init_pstate, the addGlobalRuntimeInit analogue)")
+
     if errors:
         raise SoRViolation(errors)
 

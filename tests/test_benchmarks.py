@@ -51,6 +51,8 @@ FLIP_TARGETS = {
     "trivial": ("ret", 0, 0, 0),
     "helloWorld": ("out", 2, 5, 8),
     "simpleTMR": ("acc", 0, 7, 10),
+    # corrupt the chained hash accumulator mid-pipeline
+    "nestedCalls": ("acc", 0, 4, 2),
 }
 
 

@@ -49,8 +49,14 @@ def test_merge_cl_override_rules():
     assert cfg.ignore_glbls == ["a"]
     assert cfg.clone_glbls == ["b"]
     assert "scanf" in cfg.ignore_fns
-    assert cfg.protection_overrides() == {
-        "ignore_globals": ("a",), "xmr_globals": ("b",)}
+    ov = cfg.protection_overrides()
+    assert ov["ignore_globals"] == ("a",)
+    assert ov["xmr_globals"] == ("b",)
+    # All function-scope lists forward to the engine now (VERDICT r1 #3):
+    # cloneAfterCall implied skipLibCalls+ignoreFns membership, but the
+    # engine resolves the scope class by precedence.
+    assert ov["clone_after_call_fns"] == ("scanf",)
+    assert "scanf" in ov["ignore_fns"]
 
 
 # ---------------------------------------------------------------------------
