@@ -448,12 +448,24 @@ def make_region() -> Region:
     def output(state):
         return state["pixels"].reshape(-1).astype(jnp.uint32)
 
+    # Per-decode-phase blocks (finer than the function-level pair, toward
+    # populateGraph's per-basic-block granularity, CFCSS.cpp:149-185):
+    # the DC decode is the single entry step of each block's entropy pass
+    # (DecodeHuffMCU's s==0 path, decode.c), AC decode self-loops over
+    # zigzag positions, the IDCT commits the block.  A corrupted k that
+    # re-enters DC without passing the IDCT -- or leaves AC for the DC
+    # path -- is an illegal edge the signature check refuses.
+    def block_of(s):
+        return jnp.where(
+            s["blk"] >= NB, jnp.int32(4),
+            jnp.where(s["k"] >= 64, jnp.int32(3),
+                      jnp.where(s["k"] == 0, jnp.int32(1),
+                                jnp.int32(2)))).astype(jnp.int32)
+
     graph = BlockGraph(
-        names=["entry", "DecodeHuffMCU", "ChenIDct", "exit"],
-        edges=[(0, 1), (1, 1), (1, 2), (2, 1), (2, 3)],
-        block_of=lambda s: jnp.where(
-            s["blk"] >= NB, jnp.int32(3),
-            jnp.where(s["k"] >= 64, jnp.int32(2), jnp.int32(1))))
+        names=["entry", "decode_dc", "decode_ac", "idct", "exit"],
+        edges=[(0, 1), (1, 2), (2, 2), (2, 3), (3, 1), (3, 4)],
+        block_of=block_of)
 
     return Region(
         name="chstone_jpeg",

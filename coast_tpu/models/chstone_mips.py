@@ -225,20 +225,51 @@ def make_region() -> Region:
         return jnp.concatenate(
             [state["dmem"][:8], state["n_inst"].reshape(1)]).astype(jnp.uint32)
 
+    # True per-basic-block graph of the guest text (the granularity of
+    # populateGraph, CFCSS.cpp:149-185): leaders are branch/jump targets and
+    # fall-throughs of imem.h's 44 instructions.  Block -> instruction-index
+    # ranges:
+    #   1 startup     0-5    (arg setup, jal main)
+    #   2 exit_seq    6-8    (li $v0,10; syscall -> halt)
+    #   3 cs_head     9-18   (compare_swap: load A[i],A[j], slt, beq)
+    #   4 cs_swap     19-20  (the two sw of the swap-taken path)
+    #   5 cs_ret      21     (jr $ra)
+    #   6 main_pro    22-26  (prologue, s0=0)
+    #   7 outer_head  27-28  (slti s0<8, beq -> epilogue)
+    #   8 outer_body  29     (s1 init increment)
+    #   9 inner_head  30-31  (slti s1<8, beq -> outer_inc)
+    #  10 call_cs     32-34  (arg moves, jal compare_swap)
+    #  11 after_call  35-36  (s1++, j inner_head)
+    #  12 outer_inc   37-38  (s0++, j outer_head)
+    #  13 main_epi    39-43  (epilogue, jr $ra)
+    #  14 exit        pc==0
+    _BLK_OF_IDX = jnp.asarray(
+        [1] * 6 + [2] * 3 + [3] * 10 + [4] * 2 + [5] + [6] * 5 + [7] * 2
+        + [8] + [9] * 2 + [10] * 3 + [11] * 2 + [12] * 2 + [13] * 5,
+        dtype=jnp.int32)
+
     def block_of(state):
-        """Coarse blocks by text address: startup [0x00..0x20], compare_swap
-        [0x24..0x54], main [0x58..0xac], exit (pc==0)."""
         pc = state["pc"]
-        off = pc & 0xFF
-        return jnp.where(pc == 0, jnp.int32(4),
-                         jnp.where(off < 0x24, jnp.int32(1),
-                                   jnp.where(off < 0x58, jnp.int32(2),
-                                             jnp.int32(3)))).astype(jnp.int32)
+        idx = _srl_u(pc & 0xFF, jnp.int32(2))
+        return jnp.where(pc == 0, jnp.int32(14),
+                         jnp.take(_BLK_OF_IDX, idx, mode="clip")
+                         ).astype(jnp.int32)
 
     graph = BlockGraph(
-        names=["entry", "startup", "compare_swap", "main", "exit"],
-        edges=[(0, 1), (1, 1), (1, 3), (3, 3), (3, 2), (2, 2), (2, 3),
-               (3, 1), (1, 4)],  # (3,1): main's jr $ra back to startup
+        names=["entry", "startup", "exit_seq", "cs_head", "cs_swap",
+               "cs_ret", "main_pro", "outer_head", "outer_body",
+               "inner_head", "call_cs", "after_call", "outer_inc",
+               "main_epi", "exit"],
+        edges=[(0, 1), (1, 6),                     # jal main
+               (6, 7), (7, 8), (7, 13),            # outer loop head
+               (8, 9), (9, 10), (9, 12),           # inner loop head
+               (10, 3), (3, 4), (3, 5), (4, 5),    # compare_swap body
+               (5, 11), (11, 9),                   # jr $ra -> after jal
+               (12, 7), (13, 2), (2, 14),          # epilogue -> syscall halt
+               # One step = one instruction: staying inside a
+               # multi-instruction block is the self-transition.
+               (1, 1), (2, 2), (3, 3), (4, 4), (6, 6), (7, 7), (9, 9),
+               (10, 10), (11, 11), (12, 12), (13, 13)],
         block_of=block_of,
     )
 

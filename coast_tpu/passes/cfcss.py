@@ -17,13 +17,21 @@ with a signature store + XOR compare against runtime globals
   * a mismatch in any lane latches ``cfc_fault``: the batched analogue of
     branching to the CFC error handler and aborting (DUE classification).
 
-The signature transition, per step, with v = block_of(voted control state):
+The signature transition, per step, with v_lane = block_of(that lane's own
+control state) -- classified **per lane**, exactly as each replica's
+instruction stream carries its own signature tracker in the reference
+(stacking CFCSS after TMR clones the runtime globals):
 
-    G'_lane = G_lane ^ diffs[v] ^ (fanin[v] ? dedge[prev_lane, v] : 0)
-    fault  |= any_lane(G' != sigs[v]);   prev' = v
+    G'_lane = G_lane ^ diffs[v_lane] ^ (fanin[v_lane] ? dedge[prev_lane, v_lane] : 0)
+    fault  |= any_lane(G'_lane != sigs[v_lane]);   prev'_lane = v_lane
 
-An illegal transition (u',v) not in the edge set fails the check by the
-assignment's soundness guarantee (coast_core.cpp verify loop).
+A lane whose corrupted control state steers it onto an illegal edge
+(u_prev, v) mismatches by the assignment's soundness guarantee
+(coast_core.cpp verify loop) even when every other lane is clean -- so
+CFCSS catches lane-local control corruption that disabled ctrl voting
+(-noStoreAddrSync/-noLoadSync) would otherwise let slip to the output.
+Classifying from the voted view instead would absorb exactly those
+corruptions before CFCSS could see them (VERDICT round 1 weakness #5).
 """
 
 from __future__ import annotations
@@ -76,8 +84,21 @@ def apply_cfcss(prog: ProtectedProgram, seed: int = 0) -> ProtectedProgram:
             PREV_LEAF: jnp.zeros((n_lanes,), jnp.int32),
         }
 
+    def lane_blocks(state) -> jax.Array:
+        """block_of evaluated on each lane's OWN control state -> (n_lanes,)
+        int32.  The voted view is deliberately not used here: voting would
+        repair the very control corruption CFCSS exists to detect."""
+        region_state = {k: state[k] for k in region.spec}
+        if n_lanes == 1 or not any(prog.replicated[k] for k in region.spec):
+            v = graph.block_of(region_state)
+            return jnp.broadcast_to(jnp.asarray(v, jnp.int32), (n_lanes,))
+        in_axes = ({k: (0 if prog.replicated[k] else None)
+                    for k in region_state},)
+        return jax.vmap(graph.block_of, in_axes=in_axes)(
+            region_state).astype(jnp.int32)
+
     def cfcss_step(new_state, flags, t, halted):
-        v = graph.block_of(prog._voted_view(new_state))
+        v = lane_blocks(new_state)                       # (n_lanes,)
         g = new_state[G_LEAF]
         prev = new_state[PREV_LEAF]
         adj = jnp.where(fanin[v], dedge[prev, v], jnp.uint32(0))
@@ -89,8 +110,7 @@ def apply_cfcss(prog: ProtectedProgram, seed: int = 0) -> ProtectedProgram:
                      jnp.logical_and(~halted, mismatch))}
         new_state = {**new_state,
                      G_LEAF: jnp.where(halted, g, g_new),
-                     PREV_LEAF: jnp.where(halted, prev,
-                                          jnp.full_like(prev, v))}
+                     PREV_LEAF: jnp.where(halted, prev, v)}
         return new_state, flags
 
     prog.install_cfcss(cfcss_init, cfcss_step, tables)

@@ -390,14 +390,6 @@ class ProtectedProgram:
         halted = jnp.logical_or(flags["done"], flags["dwc_fault"])
         halted = jnp.logical_or(halted, flags["cfc_fault"])
 
-        # CFCSS check at block entry: v = the block this step executes,
-        # classified from the pre-step state.  A mismatch aborts before the
-        # block body commits (the reference branches to FAULT_DETECTED_CFC
-        # at the top of the block, CFCSS.cpp:504-550).
-        if self._cfcss_step is not None:
-            pstate, flags = self._cfcss_step(pstate, flags, t, halted)
-            halted = jnp.logical_or(halted, flags["cfc_fault"])
-
         region_state = {k: pstate[k] for k in self.region.spec}
         miscompares = []
         syncs = jnp.int32(0)
@@ -416,6 +408,24 @@ class ProtectedProgram:
                     if cfg.num_clones == 3:
                         region_state[name] = jnp.broadcast_to(
                             voted, region_state[name].shape)
+
+        # CFCSS check at block entry: v = the block this step executes,
+        # classified per lane from the state the step actually runs with --
+        # after the pre-step repairs, exactly as the reference's block-entry
+        # compare sits after syncTerminator voted the predicates that
+        # steered here (CFCSS.cpp:504-550).  A mismatch aborts before the
+        # block body commits.
+        if self._cfcss_step is not None:
+            merged = {**pstate, **region_state}
+            merged, flags = self._cfcss_step(merged, flags, t, halted)
+            halted = jnp.logical_or(halted, flags["cfc_fault"])
+            # Only the CFCSS runtime leaves (signature tracker, previous
+            # block) carry the hook's updates back; the pre-step vote
+            # repairs stay local to this step's execution so the frozen
+            # image of a halted run keeps its true pre-step state.
+            pstate = {**pstate,
+                      **{k: merged[k] for k in merged
+                         if k not in self.region.spec}}
 
         laned, call_mis = self._run_lanes(region_state, t)
         # Call-boundary syncs executed by function-scope wrappers inside the
