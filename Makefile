@@ -4,13 +4,19 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_fast test_full test_tmr regression_test bench clean
+.PHONY: build test test_all test_fast test_full test_tmr regression_test bench clean
 
 build:
 	$(MAKE) -C coast_tpu/native
 
+# Fast pytest tier (<5 min): everything except the slow corpus matrices
+# (pytest.ini markers), the fast.yml/full.yml split of the reference CI.
 test:
-	$(CPU_ENV) $(PYTHON) -m pytest tests/ -x -q
+	$(CPU_ENV) $(PYTHON) -m pytest tests/ -x -q -m "not slow"
+
+# Full pytest suite including the benchmark/CHStone matrices (~15 min).
+test_all:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/ -q
 
 test_fast: build
 	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/fast.yml

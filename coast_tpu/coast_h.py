@@ -1,0 +1,79 @@
+"""The COAST.h annotation surface: one module, every user-facing macro.
+
+The reference's entire user-facing API is 69 lines of C macros
+(tests/COAST.h:11-64) whose strings the pass layer matches
+(dataflowProtection.h:69-79).  This module is the TPU framework's
+single equivalent surface: each macro maps to a LeafSpec / Region /
+ProtectionConfig idiom, importable as ``from coast_tpu.coast_h import
+xMR, NO_xMR, ...``.
+
+Macro -> TPU mapping table:
+
+  =====================  ====================================================
+  COAST.h macro          coast_tpu equivalent
+  =====================  ====================================================
+  __xMR                  ``xMR(spec)``: LeafSpec with xmr=True -- the leaf is
+                         replicated whatever the region default
+                         (interface.cpp:364-532 global annotations).
+  __NO_xMR               ``NO_xMR(spec)``: LeafSpec with xmr=False -- kept
+                         out of the sphere of replication.
+  __DEFAULT_NO_xMR       ``Region(default_xmr=False)``: per-region opt-in
+                         scope (the TMR_default_off mode).
+  __NO_xMR_ARG(n)        ``no_xmr_arg(n)(fn)`` / ``replicated_return(fn,
+                         no_xmr_args=(n,))`` (interface/wrappers.py):
+                         argument position n stays single-copy.
+  __xMR_RET_VAL          ``replicated_return(fn)``: the .RR form -- per-lane
+                         returns, no boundary sync
+                         (cloneFunctionReturnVals, cloning.cpp:1128-1225);
+                         per-function via -cloneReturn on Region.functions.
+  __xMR_PROT_LIB         ``protected_lib(fn)`` at a region boundary, or
+                         -protectedLibFn naming a Region.functions entry:
+                         replicated body behind a single-copy signature
+                         (cloning.cpp:562-564).
+  __xMR_ALL_AFTER_CALL   -cloneAfterCall naming a Region.functions entry:
+                         call once, fan the result out per lane
+                         (cloning.cpp:1700-1768).
+  __ISR_FUNC             refused: no interrupt concept in a stepped region
+                         (verify_options hard error; the reference excludes
+                         ISRs, inspection.cpp:183-186).
+  __COAST_VOLATILE       ``LeafSpec(no_verify=True)``: keep the leaf out of
+                         SoR verification (the llvm.used / no-verify-<glbl>
+                         path, interface.cpp:510-531).
+  __COAST_IGNORE_GLOBAL  -ignoreGlbls / ProtectionConfig(ignore_globals=...)
+  fname_COAST_WRAPPER    ``protected_lib(fn).__name__`` carries the same
+                         suffix (utils.cpp:716-830 renames).
+  =====================  ====================================================
+
+Precedence matches the reference (config file < command line < in-code
+annotation < per-leaf LeafSpec): ProtectionConfig scope lists override
+region annotations, which override ``default_xmr``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from coast_tpu.interface.wrappers import (clone_after_call, no_xmr_arg,
+                                          protected_lib, replicated_return)
+from coast_tpu.ir.region import LeafSpec
+
+__all__ = ["xMR", "NO_xMR", "VOLATILE", "no_xmr_arg", "protected_lib",
+           "replicated_return", "clone_after_call", "LeafSpec"]
+
+
+def xMR(spec: LeafSpec = None, **kw) -> LeafSpec:
+    """__xMR: force the leaf into the sphere of replication."""
+    base = spec if spec is not None else LeafSpec(**kw)
+    return dataclasses.replace(base, xmr=True)
+
+
+def NO_xMR(spec: LeafSpec = None, **kw) -> LeafSpec:
+    """__NO_xMR: keep the leaf out of the sphere of replication."""
+    base = spec if spec is not None else LeafSpec(**kw)
+    return dataclasses.replace(base, xmr=False)
+
+
+def VOLATILE(spec: LeafSpec = None, **kw) -> LeafSpec:
+    """__COAST_VOLATILE: exempt the leaf from SoR verification."""
+    base = spec if spec is not None else LeafSpec(**kw)
+    return dataclasses.replace(base, no_verify=True)

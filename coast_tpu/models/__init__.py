@@ -18,6 +18,8 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
 
 REGISTRY: Dict[str, Callable[[], Region]] = {
     "matrixMultiply": _lazy("mm"),
+    # TPU-shaped flagship: 1 MiB state, MXU-blocked (VERDICT r1 #7).
+    "matrixMultiply256": _lazy("mm256"),
     "crc16": _lazy("crc16"),
     "quicksort": _lazy("quicksort"),
     "aes": _lazy("aes"),
@@ -50,6 +52,9 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     # Multi-function region for the function-scope lists (the nestedCalls/
     # protectedLib/cloneAfterCall/replReturn unit-test class, §2.3 #32).
     "nestedCalls": _lazy("nested_calls"),
+    # RTOS-scale scope-config demonstrator (rtos/pynq rtos_mm analogue,
+    # §2.3 #33); canonical config in rtos/.
+    "rtos_app": _lazy("rtos_app"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

@@ -1,0 +1,140 @@
+"""matrixMultiply256: the TPU-shaped flagship benchmark (>= 1 MiB state).
+
+The reference's flagship is a 9x9 integer matrixMultiply
+(tests/matrixMultiply/matrixMultiply.c) -- ~160 words of state, sized for a
+Cortex-A9 guest under QEMU.  A TPU's fault-injection value proposition is
+the opposite regime: large replica tensors resident in HBM, compute on the
+MXU, thousands of campaigns batched per dispatch.  This region is the same
+*program* as matrixMultiply -- golden generated at startup, triple loop,
+self-check counts mismatching words -- scaled to that regime:
+
+  * 256x256 operands/results/golden: 4 x 256 KiB = 1.0 MiB of region
+    state; under TMR the replicated leaves alone hold 3.75 MiB in HBM
+    (first/second/results x 3 lanes + shared golden).
+  * one step = one 32-row output block: a (32x256)@(256x256) matmul the
+    XLA compiler tiles onto the MXU -- per protected step that is
+    3 lanes x 4.2 MFLOP of systolic work, vs the scalar adds of the 9x9.
+  * entries are integer-valued floats in [0, 256): every product and
+    256-term row sum stays below 2^24, so float32 matmul is *exact* and
+    the golden compare is bitwise-stable under any op order or fusion
+    XLA picks (the mm.c golden-XOR oracle, tests/mm_common/mm.c:31,
+    without depending on float rounding).
+
+Two micro-steps per block (compute into the live ``acc`` register leaf,
+then commit), so register-class injections land between compute and store
+exactly as in the small mm (resources/registers.py analogue).
+
+meta carries the FLOP/byte footprint so the bench can report achieved
+utilization alongside injections/sec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+from coast_tpu.models.common import lcg_words
+
+SIDE = 256
+BLOCK = 32
+N_BLOCKS = SIDE // BLOCK
+SEED = 42
+
+
+def _fill(seed: int, n: int) -> np.ndarray:
+    """Deterministic entries in [0, 256): integer-valued, f32-exact."""
+    return lcg_words(seed, n, bits=8).astype(np.float32)
+
+
+def make_region() -> Region:
+    first = jnp.asarray(_fill(SEED, SIDE * SIDE).reshape(SIDE, SIDE))
+    second = jnp.asarray(_fill(SEED + 1, SIDE * SIDE).reshape(SIDE, SIDE))
+    # Exact in f32 (sums < 2^24), so host float64 rounds to the same values.
+    golden = jnp.asarray(
+        (np.asarray(first, np.float64) @ np.asarray(second, np.float64)
+         ).astype(np.float32))
+
+    def init():
+        return {
+            "first": first,
+            "second": second,
+            "results": jnp.zeros((SIDE, SIDE), jnp.float32),
+            "golden": golden,
+            "acc": jnp.zeros((BLOCK, SIDE), jnp.float32),
+            "i": jnp.int32(0),
+            "phase": jnp.int32(0),
+        }
+
+    def step(state, t):
+        i, phase = state["i"], state["phase"]
+        row0 = jnp.clip(i, 0, N_BLOCKS - 1) * BLOCK
+        block_a = jax.lax.dynamic_slice(state["first"], (row0, 0),
+                                        (BLOCK, SIDE))
+        computed = block_a @ state["second"]        # MXU: (32,256)@(256,256)
+        compute_phase = phase == 0
+        acc = jnp.where(compute_phase, computed, state["acc"])
+        stored = jax.lax.dynamic_update_slice(state["results"], state["acc"],
+                                              (row0, 0))
+        results = jnp.where(compute_phase, state["results"], stored)
+        return {
+            **state,
+            "acc": acc,
+            "results": results,
+            "i": jnp.where(compute_phase, i, i + 1),
+            "phase": jnp.where(compute_phase, 1, 0),
+        }
+
+    def done(state):
+        return state["i"] >= N_BLOCKS
+
+    def check(state):
+        return jnp.sum(state["golden"] != state["results"]).astype(jnp.int32)
+
+    def output(state):
+        return jax.lax.bitcast_convert_type(state["results"],
+                                            jnp.uint32).reshape(-1)
+
+    def block_of(state):
+        compute_pending = state["phase"] == 0
+        return jnp.where(
+            compute_pending,
+            jnp.where(state["i"] >= N_BLOCKS, jnp.int32(3), jnp.int32(1)),
+            jnp.int32(2)).astype(jnp.int32)
+
+    graph = BlockGraph(
+        names=["entry", "compute", "store", "exit"],
+        edges=[(0, 1), (1, 2), (2, 1), (2, 3)],
+        block_of=block_of,
+    )
+
+    flops_per_run = 2 * SIDE * SIDE * SIDE          # one full matmul
+    state_bytes = 4 * (4 * SIDE * SIDE + BLOCK * SIDE + 2)
+
+    return Region(
+        name="matrixMultiply256",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=2 * N_BLOCKS,
+        max_steps=6 * N_BLOCKS,
+        spec={
+            "first": LeafSpec(KIND_MEM),
+            "second": LeafSpec(KIND_MEM),
+            "results": LeafSpec(KIND_MEM, xmr=True),
+            "golden": LeafSpec(KIND_RO),
+            "acc": LeafSpec(KIND_REG),
+            "i": LeafSpec(KIND_CTRL),
+            "phase": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        graph=graph,
+        meta={"oracle": "Number of errors: 0",
+              "flops_per_run": flops_per_run,
+              "state_bytes": state_bytes},
+    )

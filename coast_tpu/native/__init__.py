@@ -2,8 +2,8 @@
 
 The reference's native components are LLVM C++ passes (projects/); this
 framework's native core (coast_core.cpp) carries the host-side compute that
-is not XLA's job: bulk seeded RNG for fault schedules, CFCSS signature
-assignment over block graphs, and the replica scheduler.  Built via
+is not XLA's job: bulk seeded RNG for fault schedules and CFCSS signature
+assignment over block graphs.  Built via
 ``make -C coast_tpu/native``; every entry point has a numpy fallback that
 produces *identical* results so the Python path never blocks on a compiler.
 """

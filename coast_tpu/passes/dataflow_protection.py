@@ -535,6 +535,9 @@ class ProtectedProgram:
         (coast_tpu.passes.instrument).  The trace rides out of the scan as
         two stacked tensors (one host transfer), not per-step host prints.
         """
+        if fault is not None:
+            # Accept plain Python ints (the CLI / README ergonomics).
+            fault = {k: jnp.asarray(v, jnp.int32) for k, v in fault.items()}
         pstate, flags = self.init_pstate()
 
         def body(carry, t):

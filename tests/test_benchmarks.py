@@ -14,6 +14,11 @@ import pytest
 from coast_tpu import DWC, TMR, unprotected
 from coast_tpu.models import REGISTRY
 
+# Corpus matrix tier: slow (the full.yml analogue); the fast tier
+# (`make test`, -m "not slow") mirrors fast.yml (.travis.yml:20-44).
+pytestmark = pytest.mark.slow
+
+
 # (benchmark, leaf to corrupt, word, bit, step t) for the flip tests.
 FLIP_TARGETS = {
     "matrixMultiply": ("results", 0, 20, 5),
@@ -53,6 +58,11 @@ FLIP_TARGETS = {
     "simpleTMR": ("acc", 0, 7, 10),
     # corrupt the chained hash accumulator mid-pipeline
     "nestedCalls": ("acc", 0, 4, 2),
+    # flagship: flip a mantissa bit in the live accumulator block between
+    # compute and commit
+    "matrixMultiply256": ("acc", 777, 22, 3),
+    # corrupt the CRC task's accumulator before its next dispatch
+    "rtos_app": ("acc_crc", 0, 9, 4),
 }
 
 

@@ -15,6 +15,11 @@ import pytest
 from coast_tpu import DWC, TMR, unprotected
 from coast_tpu.models import CHSTONE, REGISTRY
 
+# Corpus matrix tier: slow (the full.yml analogue); the fast tier
+# (`make test`, -m "not slow") mirrors fast.yml (.travis.yml:20-44).
+pytestmark = pytest.mark.slow
+
+
 KERNELS = ("chstone_sha", "chstone_adpcm", "chstone_blowfish",
            "chstone_dfadd", "chstone_dfmul", "chstone_dfdiv",
            "chstone_dfsin", "chstone_gsm", "chstone_motion",

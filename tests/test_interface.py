@@ -198,3 +198,23 @@ def test_cli_dump_module(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "lambda" in out or "let" in out   # jaxpr text
+
+
+# ---------------------------------------------------------------------------
+# COAST.h annotation surface (tests/COAST.h:11-64 -> coast_tpu/coast_h.py)
+# ---------------------------------------------------------------------------
+
+def test_coast_h_macros():
+    from coast_tpu import coast_h
+    from coast_tpu.ir.region import KIND_MEM, LeafSpec
+
+    s = coast_h.xMR(LeafSpec(KIND_MEM))
+    assert s.xmr is True and s.kind == KIND_MEM
+    s = coast_h.NO_xMR(kind=KIND_MEM)
+    assert s.xmr is False
+    s = coast_h.VOLATILE(LeafSpec(KIND_MEM))
+    assert s.no_verify is True
+    # wrapper re-exports carry the reference's name-mangling contracts
+    assert coast_h.protected_lib(lambda x: x).__name__.endswith(
+        "_COAST_WRAPPER")
+    assert coast_h.replicated_return(lambda x: x).__name__.endswith(".RR")

@@ -1,0 +1,156 @@
+"""RTOS-scale scope-configuration tests (the rtos/pynq tier analogue).
+
+The reference's FreeRTOS build is the canonical production config:
+dozens-long scope lists composed across functions.config and Makefile
+variables, applied with -TMR -countErrors (rtos/pynq/Makefile:8-33).
+These tests drive the same split end to end on the rtos_app region:
+config file (rtos/functions.config) + CL lists (rtos/Makefile OPT_FLAGS)
+-> merged ScopeConfig -> ProtectionConfig -> engine, asserting the
+resolved scope of every one of the twelve sub-functions, golden-clean
+protected semantics, and the fault behaviors the scope choices buy.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coast_tpu import DWC, TMR, ProtectionConfig, protect
+from coast_tpu.interface.config import parse_config_file
+from coast_tpu.models import REGISTRY
+from coast_tpu.opt import main as opt_main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(ROOT, "rtos", "functions.config")
+
+# The CL half of the canonical config (rtos/Makefile OPT_FLAGS).
+CL_LISTS = {
+    "cloneFns": ["run_mm", "run_crc", "heartbeat"],
+    "protectedLibFn": ["ring_push"],
+    "cloneAfterCall": ["rng_next"],
+    "cloneGlbls": ["ring"],
+}
+
+
+def _canonical_cfg(num_clones=3, **extra):
+    scope = parse_config_file(CONFIG, required=True)
+    scope.merge_cl({k: list(v) for k, v in CL_LISTS.items()})
+    return ProtectionConfig(num_clones=num_clones, count_syncs=True,
+                            **scope.protection_overrides(), **extra)
+
+
+def test_config_file_parses_all_six_keys():
+    scope = parse_config_file(CONFIG, required=True)
+    assert scope.ignore_fns == ["pick_task", "clampi", "uart_fmt",
+                                "stack_note"]
+    assert scope.skip_lib_calls == ["rng_next"]
+    assert scope.replicate_fn_calls == ["mix", "fold", "saturate"]
+    assert scope.ignore_glbls == ["uart"]
+    assert scope.runtime_init_globals == ["ring", "acc_mm", "acc_crc"]
+    assert scope.isr_functions == []
+
+
+def test_every_function_resolves_per_canonical_config():
+    """All twelve sub-functions are named by some list; the engine's
+    resolution must reflect the file/CL merge and precedence rules."""
+    region = REGISTRY["rtos_app"]()
+    prog = protect(region, _canonical_cfg())
+    assert prog.fn_scope == {
+        "pick_task": "ignored",
+        "clampi": "ignored",
+        "uart_fmt": "ignored",
+        "stack_note": "ignored",
+        # cloneAfterCall beats the skipLibCalls membership it implies.
+        "rng_next": "clone_after_call",
+        "mix": "replicated",
+        "fold": "replicated",
+        "saturate": "replicated",
+        "run_mm": "replicated",
+        "run_crc": "replicated",
+        "heartbeat": "replicated",
+        "ring_push": "protected_lib",
+    }
+    assert not prog.replicated["uart"]       # -ignoreGlbls
+    assert prog.replicated["ring"]           # -cloneGlbls
+
+
+def test_canonical_build_golden_clean():
+    region = REGISTRY["rtos_app"]()
+    for make_cfg in (lambda: _canonical_cfg(3), lambda: _canonical_cfg(2)):
+        prog = protect(region, make_cfg())
+        rec = jax.jit(prog.run)(None)
+        assert int(rec["errors"]) == 0
+        assert bool(rec["done"])
+        assert int(rec["steps"]) == region.nominal_steps
+
+
+def test_uart_outside_sor_single_copy():
+    """The -ignoreGlbls'd UART buffer is stored through a boundary vote: a
+    lane flip in a replicated source is repaired before the single store,
+    so the unprotected mirror stays clean (syncGlobalStores class)."""
+    region = REGISTRY["rtos_app"]()
+    prog = protect(region, _canonical_cfg())
+    rec = jax.jit(prog.run)(
+        {"leaf_id": jnp.int32(prog.leaf_order.index("acc_crc")),
+         "lane": jnp.int32(1), "word": jnp.int32(0),
+         "bit": jnp.int32(9), "t": jnp.int32(7)})
+    assert int(rec["errors"]) == 0
+    assert int(rec["corrected"]) > 0
+
+
+def test_rng_single_stream_is_accepted_spof():
+    """-cloneAfterCall=rng_next: one entropy stream feeds every lane.  A
+    lane-0 seed flip corrupts all replicas identically -- the accepted
+    single point of failure of the class (cloning.cpp:1700-1768) -- which
+    TMR therefore cannot mask."""
+    region = REGISTRY["rtos_app"]()
+    prog = protect(region, _canonical_cfg())
+    rec = jax.jit(prog.run)(
+        {"leaf_id": jnp.int32(prog.leaf_order.index("seed")),
+         "lane": jnp.int32(0), "word": jnp.int32(0),
+         "bit": jnp.int32(5), "t": jnp.int32(5)})
+    assert int(rec["errors"]) > 0
+    # Under the default (no scope lists) the same flip is masked.
+    prog = protect(region, ProtectionConfig(num_clones=3))
+    rec = jax.jit(prog.run)(
+        {"leaf_id": jnp.int32(prog.leaf_order.index("seed")),
+         "lane": jnp.int32(0), "word": jnp.int32(0),
+         "bit": jnp.int32(5), "t": jnp.int32(5)})
+    assert int(rec["errors"]) == 0
+
+
+def test_dwc_detects_ring_boundary():
+    region = REGISTRY["rtos_app"]()
+    prog = protect(region, _canonical_cfg(num_clones=2))
+    rec = jax.jit(prog.run)(
+        {"leaf_id": jnp.int32(prog.leaf_order.index("ring")),
+         "lane": jnp.int32(1), "word": jnp.int32(3),
+         "bit": jnp.int32(11), "t": jnp.int32(20)})
+    assert bool(rec["dwc_fault"])
+
+
+def test_opt_cli_canonical_invocation(capsys):
+    """The rtos/Makefile command line end to end through the opt CLI."""
+    rc = opt_main(["-TMR", "-countErrors", "-countSyncs",
+                   "-cloneFns=run_mm,run_crc,heartbeat",
+                   "-protectedLibFn=ring_push",
+                   "-cloneAfterCall=rng_next",
+                   "-cloneGlbls=ring",
+                   f"-configFile={CONFIG}",
+                   "rtos_app"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "E: 0" in out
+
+
+def test_isr_key_in_config_file_refused(tmp_path):
+    """The reference's rtos config carries -isrFunctions exclusions; here
+    the key parses but a non-empty list is refused by the engine."""
+    p = tmp_path / "functions.config"
+    p.write_text("isrFunctions = FreeRTOS_IRQ_Handler\n")
+    scope = parse_config_file(str(p), required=True)
+    cfg = ProtectionConfig(num_clones=3, **scope.protection_overrides())
+    from coast_tpu.passes.verification import SoRViolation
+    with pytest.raises(SoRViolation, match="isrFunctions"):
+        protect(REGISTRY["rtos_app"](), cfg)
