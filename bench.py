@@ -35,7 +35,7 @@ BASELINE_INJ_PER_SEC = 1.0  # QEMU+GDB loop, seconds-per-injection regime
 INIT_TIMEOUT = int(os.environ.get("COAST_BENCH_INIT_TIMEOUT", "420"))
 RETRY_TIMEOUT = int(os.environ.get("COAST_BENCH_RETRY_TIMEOUT", "180"))
 RUN_TIMEOUT = int(os.environ.get("COAST_BENCH_RUN_TIMEOUT", "900"))
-BATCHES = (2048, 8192, 16384)
+BATCHES = (1024, 2048, 4096)
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -110,8 +110,14 @@ class CampaignRunner:
                  for k, v in part.device_arrays().items()}
         return fault, n_part
 
-    def _batch_call(self, fault: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
-        return jax.device_get(self._run_batch(fault))
+    def _dispatch(self, fault: Dict[str, jax.Array]):
+        """Launch one batch; returns the (async) device result."""
+        return self._run_batch(fault)
+
+    @staticmethod
+    def _collect(pending) -> Dict[str, np.ndarray]:
+        """Block on a dispatched batch and fetch it to the host."""
+        return jax.device_get(pending)
 
     # -- execution ----------------------------------------------------------
     def run_schedule(self, sched: FaultSchedule,
@@ -119,11 +125,22 @@ class CampaignRunner:
         batch_size = self._round_batch(batch_size)
         t0 = time.perf_counter()
         outs: List[Dict[str, np.ndarray]] = []
+        # Double-buffered: dispatch batch i+1 before collecting batch i, so
+        # the host-side fetch (one tunnel round-trip per batch) overlaps the
+        # device work -- jax dispatch is async, the device_get is the only
+        # blocking point.
+        in_flight: List[Tuple[object, int]] = []
         for lo in range(0, len(sched), batch_size):
             part = sched.slice(lo, min(lo + batch_size, len(sched)))
             fault, n_part = self._padded_fault(part, batch_size)
-            got = self._batch_call(fault)
-            outs.append({k: v[:n_part] for k, v in got.items()})
+            in_flight.append((self._dispatch(fault), n_part))
+            if len(in_flight) > 1:
+                pending, n_prev = in_flight.pop(0)
+                got = self._collect(pending)
+                outs.append({k: v[:n_prev] for k, v in got.items()})
+        for pending, n_prev in in_flight:
+            got = self._collect(pending)
+            outs.append({k: v[:n_prev] for k, v in got.items()})
         if outs:
             merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
         else:

@@ -112,8 +112,8 @@ class ShardedCampaignRunner(CampaignRunner):
         nd = self.n_devices
         return max(nd, (batch_size // nd) * nd)
 
-    def _batch_call(self, fault: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
-        return jax.device_get(self._records_sharded(fault))
+    def _dispatch(self, fault: Dict[str, jax.Array]):
+        return self._records_sharded(fault)
 
     # -- counts-only campaign mode ------------------------------------------
     def run_histogram(self, n: int, seed: int = 0,

@@ -564,9 +564,34 @@ class ProtectedProgram:
                       jnp.logical_not(halted))
             return self.step(pstate, flags, t), ys
 
-        (pstate, flags), ys = jax.lax.scan(
-            body, (pstate, flags),
-            jnp.arange(self.region.max_steps, dtype=jnp.int32))
+        if trace:
+            # The per-step trace needs fixed-length stacked outputs.
+            (pstate, flags), ys = jax.lax.scan(
+                body, (pstate, flags),
+                jnp.arange(self.region.max_steps, dtype=jnp.int32))
+        else:
+            # Early exit: stop as soon as the run halts instead of always
+            # paying the full max_steps watchdog window (3x the nominal
+            # runtime).  Under a vmapped campaign the batching rule keeps
+            # iterating while ANY run is live and masks the finished ones
+            # -- which our freeze-once-halted step already guarantees is
+            # value-preserving -- so a batch costs roughly its slowest
+            # member, not the watchdog bound (the reference likewise waits
+            # on the breakpoint, not the watchdog, threadFunctions.py
+            # :754-842).
+            def cond(carry):
+                (pstate, flags), t = carry
+                live = ~(flags["done"] | flags["dwc_fault"]
+                         | flags["cfc_fault"])
+                return jnp.logical_and(t < self.region.max_steps, live)
+
+            def wbody(carry):
+                (pstate, flags), t = carry
+                out, _ = body((pstate, flags), t)
+                return out, t + 1
+
+            (pstate, flags), _ = jax.lax.while_loop(
+                cond, wbody, ((pstate, flags), jnp.int32(0)))
 
         # Region-boundary sync: when the result escapes the SoR (the
         # external call at the end -- printf of the result / the golden
