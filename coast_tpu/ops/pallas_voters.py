@@ -1,0 +1,116 @@
+"""Pallas TPU kernels for the hot voter path.
+
+The jnp voters (coast_tpu/ops/voters.py) are what XLA fuses for small
+leaves; for the flagship-scale leaves (mm256's 256 KiB tensors) the vote
+is a pure HBM-bandwidth op, and a hand-tiled Pallas kernel fuses the
+majority select, the miscompare reduction, and the per-lane repair
+broadcast into ONE pass over the replica set -- the role the reference
+assigns to its native components (SURVEY.md §7: the bit-flip/vote kernels
+are the XLA custom-call/Pallas obligations of the design).
+
+Contract: bit-identical to ``voters.tmr_vote`` / ``voters.dwc_check``.
+Eligibility is checked by the caller-facing wrappers, which fall back to
+the jnp voters off-TPU, for unsupported shapes/dtypes, or when the leaf
+is too small to be worth a kernel launch (``eligible``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ops import voters
+
+try:  # pallas is TPU-only at runtime but importable everywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover - minimal builds
+    _HAVE_PALLAS = False
+
+_32BIT = (jnp.float32, jnp.int32, jnp.uint32)
+# Below this many words a kernel launch costs more than it saves.
+MIN_WORDS = 16384
+
+
+def eligible(lanes: jax.Array) -> bool:
+    """True when the Pallas path applies: TPU backend, 32-bit dtype, a
+    (lanes, M, N) shape with VPU-aligned tiles, and a big enough leaf."""
+    if not _HAVE_PALLAS or jax.default_backend() != "tpu":
+        return False
+    if lanes.ndim != 3 or lanes.dtype not in _32BIT:
+        return False
+    n, m, k = lanes.shape
+    if n not in (2, 3):
+        return False
+    if m % 8 or k % 128:          # f32/i32 min tile (8, 128)
+        return False
+    return m * k >= MIN_WORDS
+
+
+def _tm(m: int, k: int) -> int:
+    """Row-tile height: whole rows per step, bounded to ~2 MiB of VMEM for
+    the 3-lane input block.  Must DIVIDE m -- a partial last block would
+    feed Pallas's undefined padding rows into the miscompare reduction."""
+    budget_rows = max(8, (2 * 1024 * 1024) // (3 * 4 * k) // 8 * 8)
+    tm = min(m, budget_rows)
+    while m % tm:
+        tm -= 8            # m % 8 == 0 (eligible), so this terminates at 8
+    return tm
+
+
+def _vote_kernel(n_lanes, in_ref, voted_ref, mis_ref):
+    l0 = in_ref[0]
+    l1 = in_ref[1]
+    if n_lanes == 3:
+        l2 = in_ref[2]
+        agree01 = l0 == l1
+        voted_ref[:] = jnp.where(agree01, l0, l2)
+        mismatch = jnp.logical_or(jnp.logical_not(jnp.all(agree01)),
+                                  jnp.logical_not(jnp.all(l1 == l2)))
+    else:
+        voted_ref[:] = l0
+        mismatch = jnp.logical_not(jnp.all(l0 == l1))
+    # Every grid step writes its own tile-aligned flag block -- no cross-
+    # step accumulation, no pl.program_id, no revisited output.  Those
+    # patterns all break when pallas_call is vmapped (the campaign path):
+    # the batch axis is prepended to the grid, so "first tile" tests fire
+    # on the wrong steps and revisited VMEM windows start uninitialised.
+    # The host ORs the (grid, 8, 128) flags afterwards; the extra output
+    # traffic is 4 KiB per tile, noise next to the lane data.
+    mis_ref[:] = jnp.broadcast_to(mismatch.astype(jnp.int32), (1, 8, 128))
+
+
+@jax.jit
+def _vote_pallas(lanes: jax.Array):
+    n, m, k = lanes.shape
+    tm = _tm(m, k)
+    grid = m // tm
+    voted, mis = pl.pallas_call(
+        functools.partial(_vote_kernel, n),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((n, tm, k), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, k), lanes.dtype),
+            jax.ShapeDtypeStruct((grid, 8, 128), jnp.int32),
+        ],
+    )(lanes)
+    return voted, jnp.any(mis != 0)
+
+
+def vote(lanes: jax.Array, num_clones: int):
+    """Drop-in for voters.vote with the Pallas fast path when eligible."""
+    if num_clones > 1 and eligible(lanes):
+        return _vote_pallas(lanes)
+    return voters.vote(lanes, num_clones)

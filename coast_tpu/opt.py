@@ -34,7 +34,7 @@ _BOOL_FLAGS = {
     "noMemReplication", "noLoadSync", "noStoreDataSync", "noStoreAddrSync",
     "storeDataSync", "countErrors", "reportErrors", "countSyncs",
     "i", "s", "verbose", "dumpModule", "noMain", "noCloneOpsCheck",
-    "protectStack",
+    "protectStack", "pallasVoters",
     # Utility passes (SURVEY.md §2.1 #6-#8), stackable with any strategy:
     # -DebugStatements (block trace), -SmallProfile (+ -noPrint), -ExitMarker.
     "DebugStatements", "SmallProfile", "noPrint", "ExitMarker",
@@ -131,6 +131,7 @@ def build_overrides(flags: Dict[str, object]) -> Dict[str, object]:
     overrides["segmented"] = bool(flags.get("s"))
     overrides["cfcss"] = bool(flags.get("CFCSS"))
     overrides["protect_stack"] = bool(flags.get("protectStack"))
+    overrides["pallas_voters"] = bool(flags.get("pallasVoters"))
     return overrides
 
 

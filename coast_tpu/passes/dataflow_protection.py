@@ -99,6 +99,11 @@ class ProtectionConfig:
     clone_return_fns: Tuple[str, ...] = ()        # -cloneReturn (.RR)
     clone_after_call_fns: Tuple[str, ...] = ()    # -cloneAfterCall
     protected_lib_fns: Tuple[str, ...] = ()       # -protectedLibFn
+    # -pallasVoters: lower eligible large-leaf votes through the fused
+    # Pallas TPU kernel (ops/pallas_voters.py) instead of the jnp voter
+    # XLA fuses; bit-identical, ~1.4x the bandwidth on flagship-sized
+    # leaves, falls back automatically off-TPU / for small leaves.
+    pallas_voters: bool = False
     # -isrFunctions: interrupt handlers excluded from cloning.  There is no
     # interrupt concept in a stepped TPU region; a non-empty list is a hard
     # configuration error (refused, not silently inert).
@@ -220,6 +225,14 @@ class ProtectedProgram:
                 # top of the normal sync taxonomy: the saved return-address
                 # copies are voted even when store/ctrl syncs are disabled.
                 self.step_sync[name] = True
+        # Voter lowering: jnp reductions by default; -pallasVoters routes
+        # eligible large leaves through the fused Pallas kernel (which
+        # itself falls back to the jnp voter when not applicable).
+        if cfg.pallas_voters:
+            from coast_tpu.ops import pallas_voters
+            self._vote = pallas_voters.vote
+        else:
+            self._vote = voters.vote
         # Function-scope resolution (the populateFnWorklist closure,
         # cloning.cpp:294-431): each named sub-function gets a scope class
         # and is rewrapped accordingly inside the lane trace.
@@ -402,7 +415,7 @@ class ProtectedProgram:
         if cfg.num_clones > 1:
             for name in region_state:
                 if self.pre_sync.get(name, False):
-                    voted, mis = voters.vote(region_state[name], cfg.num_clones)
+                    voted, mis = self._vote(region_state[name], cfg.num_clones)
                     miscompares.append(mis)
                     syncs = syncs + 1
                     if cfg.num_clones == 3:
@@ -442,7 +455,7 @@ class ProtectedProgram:
             out = laned[name]
             if self.replicated[name]:
                 if self.step_sync[name] and cfg.num_clones > 1:
-                    voted, mis = voters.vote(out, cfg.num_clones)
+                    voted, mis = self._vote(out, cfg.num_clones)
                     miscompares.append(mis)
                     syncs = syncs + 1
                     if cfg.num_clones == 3:
@@ -458,7 +471,7 @@ class ProtectedProgram:
                     # Store crossing the sphere of replication: vote before
                     # the single store (verification.cpp forces these into
                     # syncGlobalStores :587,676).
-                    voted, mis = voters.vote(out, cfg.num_clones)
+                    voted, mis = self._vote(out, cfg.num_clones)
                     miscompares.append(mis)
                     syncs = syncs + 1
                     new_state[name] = voted
@@ -607,7 +620,7 @@ class ProtectedProgram:
             for name, arr in pstate.items():
                 if not self.replicated[name]:
                     continue
-                _, m = voters.vote(arr, self.cfg.num_clones)
+                _, m = self._vote(arr, self.cfg.num_clones)
                 mis = jnp.logical_or(mis, m)
                 mis_cnt = mis_cnt + m.astype(jnp.int32)
             reached_call = jnp.logical_and(

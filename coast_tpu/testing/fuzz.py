@@ -174,14 +174,22 @@ def fuzz_one(seed: int) -> None:
             f"(first diff at {int(np.argmax(got != golden))})")
 
     # Single-lane flip under TMR must be voted away.
-    prog = progs["TMR"]
-    rng = np.random.RandomState(seed ^ 0x5EED)
-    repl = [n for n in prog.leaf_order
-            if n in prog.replicated and prog.replicated[n]]
+    _assert_flip_masked(progs["TMR"], region, golden,
+                        np.random.RandomState(seed ^ 0x5EED), seed)
+
+
+def _assert_flip_masked(prog, region, golden, rng, seed) -> None:
+    """Random single-lane flip into a replicated leaf under TMR: the
+    output must still equal the fault-free golden image."""
+    import jax
+    import jax.numpy as jnp
+
+    repl = [n for n in prog.leaf_order if prog.replicated.get(n)]
     leaf = repl[rng.randint(len(repl))]
+    words = int(np.prod(jax.eval_shape(region.init)[leaf].shape)) or 1
     fault = {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
              "lane": jnp.int32(rng.randint(1, 3)),
-             "word": jnp.int32(rng.randint(W)),
+             "word": jnp.int32(rng.randint(words)),
              "bit": jnp.int32(rng.randint(32)),
              "t": jnp.int32(rng.randint(region.nominal_steps))}
     rec = jax.device_get(jax.jit(prog.run)(fault))
@@ -190,10 +198,126 @@ def fuzz_one(seed: int) -> None:
         f"seed {seed}: TMR failed to mask a single-lane flip in {leaf}")
 
 
+# ---------------------------------------------------------------------------
+# Lifter fuzzing: random whole functions through lift_fn, and the random
+# regions above re-derived by lift_step with NO hand-written spec.  The
+# soundness bar: the lifted region's unprotected output equals jit(fn)'s,
+# every strategy preserves it, and TMR still masks a single-lane flip --
+# whatever leaf kinds the lifter inferred.
+# ---------------------------------------------------------------------------
+
+_FN_OPS = ("add", "xor", "mul", "or", "and", "shl", "shr", "sub")
+
+
+def random_fn(seed: int):
+    """A random jittable function with a lax.scan main loop: random uint32
+    dataflow over loop carries (+ optional scanned inputs), random stacked
+    outputs, and a post-loop epilogue.  Returns (fn, example_args)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed ^ 0x11F7E4)
+    n_carry = int(rng.randint(1, 4))
+    n_xs = int(rng.randint(0, 3))
+    length = int(rng.randint(4, 25))
+    n_ops = int(rng.randint(3, MAX_OPS))
+    # Concrete op program, fixed at build time (deterministic per seed).
+    prog = []
+    n_vals = n_carry + n_xs
+    for _ in range(n_ops):
+        op = _FN_OPS[rng.randint(len(_FN_OPS))]
+        a = int(rng.randint(n_vals))
+        b = int(rng.randint(n_vals))
+        sh = int(rng.randint(1, 31))
+        prog.append((op, a, b, sh))
+        n_vals += 1
+    carry_picks = [int(rng.randint(n_vals)) for _ in range(n_carry)]
+    y_pick = int(rng.randint(n_vals))
+
+    def fn(*args):
+        c0 = args[:n_carry]
+        xs = args[n_carry:]
+
+        def body(carry, x):
+            vals = list(carry) + ([] if x is None else list(x))
+            for op, a, b, sh in prog:
+                va, vb = vals[a], vals[b]
+                if op == "add":
+                    vals.append(va + vb)
+                elif op == "sub":
+                    vals.append(va - vb)
+                elif op == "xor":
+                    vals.append(va ^ vb)
+                elif op == "mul":
+                    vals.append(va * vb)
+                elif op == "or":
+                    vals.append(va | vb)
+                elif op == "and":
+                    vals.append(va & vb)
+                elif op == "shl":
+                    vals.append(va << jnp.uint32(sh))
+                else:
+                    vals.append(va >> jnp.uint32(sh))
+            new_carry = tuple(vals[i] for i in carry_picks)
+            return new_carry, vals[y_pick]
+
+        final, ys = jax.lax.scan(
+            body, c0, tuple(xs) if xs else None,
+            length=length if not xs else None)
+        # Epilogue: fold the stacked outputs into the result.
+        return tuple(f ^ jnp.uint32(0xA5A5A5A5) for f in final) + (ys[-1],)
+
+    args = tuple(jnp.uint32(v)
+                 for v in rng.randint(0, 2**32, n_carry, np.uint32))
+    args += tuple(
+        jnp.asarray(rng.randint(0, 2**32, length, np.uint32))
+        for _ in range(n_xs))
+    return fn, args
+
+
+def fuzz_lifter_one(seed: int) -> None:
+    """lift_fn + lift_step soundness for one seed."""
+    import jax
+    import jax.numpy as jnp
+
+    from coast_tpu import DWC, TMR, unprotected
+    from coast_tpu.frontend import lift_fn, lift_step
+
+    # -- whole-function lifting --------------------------------------------
+    fn, args = random_fn(seed)
+    want = jax.device_get(jax.jit(fn)(*args))
+    flat_want = np.concatenate([
+        np.asarray(w).reshape(-1).view(np.uint32) for w in want])
+    region = lift_fn(f"fuzzfn{seed}", fn, *args)
+    got = np.asarray(jax.device_get(region.output(region.run_unprotected())))
+    assert (got == flat_want).all(), (
+        f"seed {seed}: lift_fn changed the function's result")
+
+    for name, prog in (("TMR", TMR(region)), ("DWC", DWC(region))):
+        rec = jax.device_get(jax.jit(prog.run)())
+        assert int(rec["errors"]) == 0, f"seed {seed}: lift_fn {name} broke"
+        assert bool(rec["done"])
+
+    # -- step lifting with no hand-written spec ----------------------------
+    hand = random_region(seed)
+    lifted = lift_step(f"fuzzstep{seed}", hand.step, hand.init,
+                       done=hand.done)
+    golden = np.asarray(jax.device_get(
+        jax.jit(unprotected(lifted).run)()["output"]))
+    prog = TMR(lifted)
+    rec = jax.device_get(jax.jit(prog.run)())
+    assert int(rec["errors"]) == 0, f"seed {seed}: lift_step TMR broke"
+
+    _assert_flip_masked(prog, lifted, golden,
+                        np.random.RandomState(seed ^ 0x11F7), seed)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="random-region fuzzing")
     parser.add_argument("-n", type=int, default=10, help="number of seeds")
     parser.add_argument("-seed", type=int, default=0, help="first seed")
+    parser.add_argument("-mode", choices=("region", "lifter", "all"),
+                        default="all")
     args = parser.parse_args(argv)
 
     import os
@@ -205,7 +329,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     for seed in range(args.seed, args.seed + args.n):
         try:
-            fuzz_one(seed)
+            if args.mode in ("region", "all"):
+                fuzz_one(seed)
+            if args.mode in ("lifter", "all"):
+                fuzz_lifter_one(seed)
         except AssertionError as e:
             print(f"FAILED: {e}")
             return 1

@@ -1,0 +1,110 @@
+"""MWTF report: the reference's headline protection metric, measured.
+
+jsonParser.py's A-vs-B comparison is how COAST results are actually
+judged: error-rate improvement divided by runtime cost (MWTF ratio,
+jsonParser.py:458-506, mwtf :473).  This script produces that table from
+real campaigns on this chip: for each requested benchmark it runs an
+unprotected baseline campaign and a protected campaign (TMR and DWC),
+measures the protected/unprotected runtime ratio on-device, and emits
+one comparison artifact (committed at artifacts/mwtf_report.json).
+
+Usage: python scripts/mwtf_report.py [-n 20000] [--benchmarks mm,crc16]
+       [--out artifacts/mwtf_report.json] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BENCH_ALIASES = {"mm": "matrixMultiply", "mm256": "matrixMultiply256"}
+
+
+def _runtime_s(prog, reps=20) -> float:
+    import jax
+    run = jax.jit(lambda: prog.run(None))
+    jax.block_until_ready(run())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=20_000,
+                    help="injections per campaign")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--benchmarks", default="mm,crc16,quicksort")
+    ap.add_argument("--out", default="artifacts/mwtf_report.json")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import DWC, TMR, unprotected
+    from coast_tpu.analysis.json_parser import Summary, compare_runs
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY
+
+    report = {"backend": jax.default_backend(), "n_per_campaign": args.n,
+              "benchmarks": {}}
+    for name in args.benchmarks.split(","):
+        name = BENCH_ALIASES.get(name.strip(), name.strip())
+        region = REGISTRY[name]()
+        progs = {"unprotected": unprotected(region),
+                 "DWC": DWC(region), "TMR": TMR(region)}
+        summaries, runtimes = {}, {}
+        for strat, prog in progs.items():
+            runtimes[strat] = _runtime_s(prog)
+            runner = CampaignRunner(prog, strategy_name=strat)
+            batch = min(args.batch, args.n)
+            runner.run(batch, seed=1, batch_size=batch)       # warm
+            res = runner.run(args.n, seed=2026, batch_size=batch)
+            summaries[strat] = Summary(
+                name=f"{name}-{strat}", n=res.n, counts=res.counts,
+                # MWTF's runtime ratio must be the *guest* runtime, not
+                # campaign wall-clock (jsonParser uses the measured run
+                # time, threadFunctions.py:387-449): use the on-device
+                # seconds per fault-free run.
+                seconds=runtimes[strat] * res.n,
+                mean_steps=float(res.steps.mean()))
+        row = {"campaigns": {s: summaries[s].counts for s in summaries},
+               "seconds_per_run": {s: round(runtimes[s], 6)
+                                   for s in runtimes},
+               "injections_per_sec": {}}
+        def _j(v):
+            # Strict-JSON-safe: infinities (zero protected SDCs) as "inf".
+            import math
+            if isinstance(v, float):
+                return round(v, 4) if math.isfinite(v) else "inf"
+            return v
+
+        for strat in ("DWC", "TMR"):
+            cmp_ = compare_runs(summaries["unprotected"], summaries[strat])
+            row[f"vs_unprotected_{strat}"] = {k: _j(v)
+                                              for k, v in cmp_.items()}
+        report["benchmarks"][name] = row
+        print(f"# {name}: TMR mwtf={row['vs_unprotected_TMR']['mwtf']} "
+              f"DWC mwtf={row['vs_unprotected_DWC']['mwtf']}",
+              file=sys.stderr, flush=True)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+    print(json.dumps({k: {s: v for s, v in row.items()
+                          if s.startswith("vs_")}
+                      for k, row in report["benchmarks"].items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

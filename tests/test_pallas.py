@@ -1,0 +1,60 @@
+"""Pallas voter kernel tests (the CPU-side contract).
+
+The kernel itself only runs on TPU hardware (bench.py and the verify
+drives measure it there: bit-identical to the jnp voter, ~1.4x vote
+bandwidth, 2x flagship single-run rate).  On the CPU backend these tests
+pin the *dispatch* contract: eligibility gating, transparent fallback,
+and that a -pallasVoters build is classification-identical to the
+default build.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu import TMR, ProtectionConfig, protect
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.models import REGISTRY
+from coast_tpu.ops import pallas_voters, voters
+
+
+def test_not_eligible_on_cpu():
+    x = jnp.zeros((3, 256, 256), jnp.uint32)
+    assert not pallas_voters.eligible(x)          # cpu backend
+
+
+def test_eligibility_shape_rules():
+    # Even on TPU these shapes would be refused; the predicate must say
+    # no regardless of backend.
+    assert not pallas_voters.eligible(jnp.zeros((3, 9), jnp.uint32))
+    assert not pallas_voters.eligible(jnp.zeros((3, 250, 130), jnp.uint32))
+    assert not pallas_voters.eligible(jnp.zeros((4, 256, 256), jnp.uint32))
+    assert not pallas_voters.eligible(jnp.zeros((3, 8, 128), jnp.uint32))
+
+
+def test_fallback_matches_jnp_voter():
+    key = jax.random.PRNGKey(7)
+    x = jax.random.randint(key, (3, 64, 256), 0, 1 << 30, jnp.int32)
+    x = x.at[2, 5, 7].add(9)
+    v_ref, m_ref = voters.vote(x, 3)
+    v_pl, m_pl = pallas_voters.vote(x, 3)
+    np.testing.assert_array_equal(np.asarray(v_ref), np.asarray(v_pl))
+    assert bool(m_ref) == bool(m_pl)
+
+
+def test_engine_flag_classification_identical():
+    region = REGISTRY["matrixMultiply256"]()
+    base = CampaignRunner(TMR(region), strategy_name="TMR")
+    fast = CampaignRunner(
+        protect(region, ProtectionConfig(num_clones=3, pallas_voters=True)),
+        strategy_name="TMR")
+    rb = base.run(64, seed=5, batch_size=64)
+    rf = fast.run(64, seed=5, batch_size=64)
+    np.testing.assert_array_equal(rb.codes, rf.codes)
+    assert rb.counts == rf.counts
+
+
+def test_cli_flag_parses():
+    from coast_tpu.opt import build_overrides, parse_argv
+    flags, pos = parse_argv(["-TMR", "-pallasVoters", "matrixMultiply"])
+    assert build_overrides(flags)["pallas_voters"] is True
