@@ -1,0 +1,119 @@
+"""Cross-backend classification parity: the BASELINE fidelity gate.
+
+BASELINE.md's second gate is classification fidelity: the same seeded
+fault schedule must classify identically wherever it runs.  The
+reference validates its QEMU loop against hardware; this framework's
+analogue is CPU-vs-TPU: the CPU backend is the "BOARD=x86" functional
+reference every test runs against, and the TPU backend is the deployment
+target, so bit-identical per-run classification codes across the two
+backends is the evidence that campaign numbers measured on TPU mean what
+the CPU-validated semantics say.
+
+The CPU leg runs in a subprocess (the site hook claims the TPU at
+interpreter start; a fresh process with the platform pinned is the only
+clean way to get a pure CPU run next to a TPU run).
+
+Usage: python scripts/classification_parity.py [-n 4096]
+       [--out artifacts/classification_parity.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BENCHMARKS = ("matrixMultiply", "crc16", "matrixMultiply256")
+SEED = 77
+
+
+def run_leg(backend: str, n: int, batch: int, out_path: str) -> None:
+    """One backend's campaigns -> npz of per-run codes."""
+    import numpy as np
+
+    import jax
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY
+
+    arrays = {"backend": np.array(jax.default_backend())}
+    for name in BENCHMARKS:
+        nn = n if name != "matrixMultiply256" else min(n, 512)
+        runner = CampaignRunner(TMR(REGISTRY[name]()), strategy_name="TMR")
+        res = runner.run(nn, seed=SEED, batch_size=min(batch, nn))
+        arrays[f"{name}_codes"] = res.codes
+        arrays[f"{name}_errors"] = res.errors
+        arrays[f"{name}_steps"] = res.steps
+    np.savez(out_path, **arrays)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--out", default="artifacts/classification_parity.json")
+    ap.add_argument("--leg", choices=("cpu", "tpu"), default=None,
+                    help="internal: run one backend leg")
+    ap.add_argument("--npz", default=None)
+    args = ap.parse_args(argv)
+
+    if args.leg:
+        run_leg(args.leg, args.n, args.batch, args.npz)
+        return 0
+
+    import numpy as np
+    legs = {}
+    for backend in ("cpu", "tpu"):
+        npz = f"/tmp/parity_{backend}.npz"
+        env = dict(os.environ)
+        if backend == "cpu":
+            # Pin before interpreter start as well (the site hook
+            # registers the TPU plugin programmatically; run_leg's
+            # jax.config.update is the in-process half).
+            env["JAX_PLATFORMS"] = "cpu"
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--leg", backend,
+             "-n", str(args.n), "--batch", str(args.batch), "--npz", npz],
+            check=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        legs[backend] = np.load(npz)
+
+    report = {"n": args.n, "seed": SEED,
+              "cpu_backend": str(legs["cpu"]["backend"]),
+              "tpu_backend": str(legs["tpu"]["backend"]),
+              "benchmarks": {}}
+    ok = True
+    if report["tpu_backend"] != "tpu":
+        # Without real hardware the comparison is CPU-vs-CPU: vacuous.
+        report["error"] = ("TPU leg ran on backend "
+                           f"'{report['tpu_backend']}'; parity not tested")
+        ok = False
+    for name in BENCHMARKS:
+        rows = {}
+        for field in ("codes", "errors", "steps"):
+            a = legs["cpu"][f"{name}_{field}"]
+            b = legs["tpu"][f"{name}_{field}"]
+            same = bool(np.array_equal(a, b))
+            rows[field] = {"identical": same, "n": int(a.size)}
+            if not same:
+                ok = False
+                rows[field]["first_diff"] = int(np.argmax(a != b))
+        report["benchmarks"][name] = rows
+    report["parity"] = ok
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
