@@ -111,45 +111,49 @@ def worker(backend: str) -> None:
         if best is None or res.injections_per_sec > best:
             best = res.injections_per_sec
 
-    # -- TPU-shaped flagship: matrixMultiply256 (>=1 MiB state, MXU) -------
-    # Reports achieved FLOP/s and HBM-resident replica bytes alongside
-    # injections/sec: the utilization evidence behind the "TPU-native"
-    # claim (a 9x9 guest kernel cannot exercise the hardware).
-    flag = REGISTRY["matrixMultiply256"]()
-    # Flagship ships with the fused Pallas voter kernel (bit-identical to
-    # the jnp voter; ~2x the single-run rate, ~1.5x campaign throughput).
-    fl_prog = TMR(flag, pallas_voters=True)
-    fl_run = jax.jit(lambda: fl_prog.run(None))
-    jax.block_until_ready(fl_run())
-    reps = 10
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fl_run()
-    jax.block_until_ready(out)
-    sec_per_run = (time.perf_counter() - t0) / reps
-    lanes_flops = 3 * flag.meta["flops_per_run"]
-    fl_rec = {"stage": "result", "kind": "flagship",
-              "benchmark": "matrixMultiply256", "strategy": "TMR",
-              "state_bytes": flag.meta["state_bytes"],
-              "seconds_per_run": round(sec_per_run, 6),
-              "gflops_per_sec": round(lanes_flops / sec_per_run / 1e9, 2)}
-    fl_runner = CampaignRunner(fl_prog, strategy_name="TMR")
-    fl_batches = []
-    # Batch is capped well below the toy benchmark's: each campaign holds
-    # ~3.3 MiB of replica state, and oversized batches fall off an HBM
-    # cliff (measured: 1024 -> 18 inj/s vs 256 -> 280 inj/s on v5e-lite).
-    for batch in (256, 512):
-        fl_runner.run(batch, seed=1, batch_size=batch)       # compile+warm
-        res = fl_runner.run(2 * batch, seed=42, batch_size=batch)
-        fl_batches.append({
-            "batch_size": batch, "injections": res.n,
-            "seconds": round(res.seconds, 4),
-            "injections_per_sec": round(res.injections_per_sec, 2),
-            "gflops_per_sec": round(
-                lanes_flops * res.n / res.seconds / 1e9, 2),
-            "counts": res.counts})
-    fl_rec["campaign"] = fl_batches
-    _emit(fl_rec)
+    # -- TPU-shaped flagships: mm256 (1 MiB f32) and mm1024 (4 MiB bf16
+    # MXU).  Reports achieved FLOP/s and HBM-resident replica bytes
+    # alongside injections/sec: the utilization evidence behind the
+    # "TPU-native" claim (a 9x9 guest kernel cannot exercise the
+    # hardware).  Batches are capped well below the toy benchmark's: each
+    # campaign holds MiBs of replica state, and oversized batches fall
+    # off an HBM cliff (measured: mm256 batch 1024 -> 18 inj/s vs 256 ->
+    # 280 inj/s on v5e-lite).
+    for flag_name, batches in (("matrixMultiply256", (256, 512)),
+                               ("matrixMultiply1024", (32, 64))):
+        flag = REGISTRY[flag_name]()
+        # Flagships ship with the fused Pallas voter kernel
+        # (bit-identical to the jnp voter; ~2x mm256's single-run rate).
+        fl_prog = TMR(flag, pallas_voters=True)
+        fl_run = jax.jit(lambda p=fl_prog: p.run(None))
+        jax.block_until_ready(fl_run())
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fl_run()
+        jax.block_until_ready(out)
+        sec_per_run = (time.perf_counter() - t0) / reps
+        lanes_flops = 3 * flag.meta["flops_per_run"]
+        fl_rec = {"stage": "result", "kind": "flagship",
+                  "benchmark": flag_name, "strategy": "TMR",
+                  "state_bytes": flag.meta["state_bytes"],
+                  "seconds_per_run": round(sec_per_run, 6),
+                  "gflops_per_sec": round(
+                      lanes_flops / sec_per_run / 1e9, 2)}
+        fl_runner = CampaignRunner(fl_prog, strategy_name="TMR")
+        fl_batches = []
+        for batch in batches:
+            fl_runner.run(batch, seed=1, batch_size=batch)   # compile+warm
+            res = fl_runner.run(2 * batch, seed=42, batch_size=batch)
+            fl_batches.append({
+                "batch_size": batch, "injections": res.n,
+                "seconds": round(res.seconds, 4),
+                "injections_per_sec": round(res.injections_per_sec, 2),
+                "gflops_per_sec": round(
+                    lanes_flops * res.n / res.seconds / 1e9, 2),
+                "counts": res.counts})
+        fl_rec["campaign"] = fl_batches
+        _emit(fl_rec)
 
     _emit({"stage": "done", "best_injections_per_sec": round(best, 2)})
 
@@ -239,8 +243,8 @@ def _summarize(records):
         out["overhead"] = {k: v for k, v in ovh[-1].items()
                            if k not in ("stage", "kind")}
     if flag:
-        out["flagship"] = {k: v for k, v in flag[-1].items()
-                           if k not in ("stage", "kind")}
+        out["flagship"] = [{k: v for k, v in r.items()
+                            if k not in ("stage", "kind")} for r in flag]
     if thr:
         best = max(thr, key=lambda r: r["injections_per_sec"])
         out["throughput"] = [

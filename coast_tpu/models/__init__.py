@@ -18,8 +18,9 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
 
 REGISTRY: Dict[str, Callable[[], Region]] = {
     "matrixMultiply": _lazy("mm"),
-    # TPU-shaped flagship: 1 MiB state, MXU-blocked (VERDICT r1 #7).
+    # TPU-shaped flagships: 1 MiB f32 / 4 MiB bf16-MXU (VERDICT r1 #7).
     "matrixMultiply256": _lazy("mm256"),
+    "matrixMultiply1024": _lazy("mm256", "make_region_1024"),
     "crc16": _lazy("crc16"),
     "quicksort": _lazy("quicksort"),
     "aes": _lazy("aes"),

@@ -8,19 +8,48 @@ inputs are reproducible without glibc.
 
 from __future__ import annotations
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
+
+
+_LCG_A, _LCG_C, _LCG_MASK = 1103515245, 12345, 0x7FFFFFFF
+
+
+@functools.lru_cache(maxsize=64)
+def _lcg_state_stream(seed: int, n: int) -> np.ndarray:
+    """The raw LCG state sequence, cached and read-only.
+
+    The flagship regions draw 2x1M words per build; a pure-Python
+    recurrence costs tens of seconds.  Affine maps compose, so after
+    generating one stride sequentially the rest is vectorised numpy:
+    x[i+s] = (A^s x[i] + C_s) mod 2^31, with A^s and C_s built by
+    composing (a, c) -> (A a, A c + C) s times.  int64 holds the
+    products exactly (a_s, x < 2^31 so a_s * x < 2^62)."""
+    out = np.empty(n, dtype=np.int64)
+    stride = min(n, 4096)
+    x = seed & _LCG_MASK
+    for i in range(stride):
+        x = (_LCG_A * x + _LCG_C) & _LCG_MASK
+        out[i] = x
+    a_s, c_s = 1, 0
+    for _ in range(stride):
+        a_s, c_s = (_LCG_A * a_s) & _LCG_MASK, (_LCG_A * c_s + _LCG_C) & _LCG_MASK
+    filled = stride
+    while filled < n:
+        m = min(stride, n - filled)
+        out[filled:filled + m] = (
+            a_s * out[filled - stride:filled - stride + m] + c_s) & _LCG_MASK
+        filled += m
+    out.setflags(write=False)
+    return out
 
 
 def lcg_words(seed: int, n: int, bits: int = 15) -> np.ndarray:
     """n deterministic pseudo-random values of `bits` width (numpy host-side,
     stands in for the reference's srand/rand input generation)."""
-    out = np.empty(n, dtype=np.int64)
-    x = seed & 0x7FFFFFFF
-    for i in range(n):
-        x = (1103515245 * x + 12345) & 0x7FFFFFFF
-        out[i] = (x >> 16) & ((1 << bits) - 1)
-    return out
+    return (_lcg_state_stream(seed, n) >> 16) & ((1 << bits) - 1)
 
 
 def lcg_fill(seed: int, n: int, bits: int = 15) -> jnp.ndarray:

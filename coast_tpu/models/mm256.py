@@ -14,8 +14,8 @@ self-check counts mismatching words -- scaled to that regime:
   * one step = one 32-row output block: a (32x256)@(256x256) matmul the
     XLA compiler tiles onto the MXU -- per protected step that is
     3 lanes x 4.2 MFLOP of systolic work, vs the scalar adds of the 9x9.
-  * entries are integer-valued floats in [0, 256): every product and
-    256-term row sum stays below 2^24, so float32 matmul is *exact* and
+  * entries are integer-valued floats sized per side (_entry_bits) so
+    every product and row sum stays below 2^24: float32 matmul is *exact* and
     the golden compare is bitwise-stable under any op order or fusion
     XLA picks (the mm.c golden-XOR oracle, tests/mm_common/mm.c:31,
     without depending on float rounding).
@@ -41,18 +41,44 @@ from coast_tpu.models.common import lcg_words
 
 SIDE = 256
 BLOCK = 32
-N_BLOCKS = SIDE // BLOCK
 SEED = 42
 
 
-def _fill(seed: int, n: int) -> np.ndarray:
-    """Deterministic entries in [0, 256): integer-valued, f32-exact."""
-    return lcg_words(seed, n, bits=8).astype(np.float32)
+def _fill(seed: int, n: int, bits: int) -> np.ndarray:
+    """Deterministic integer-valued entries in [0, 2^bits)."""
+    return lcg_words(seed, n, bits=bits).astype(np.float32)
 
 
-def make_region() -> Region:
-    first = jnp.asarray(_fill(SEED, SIDE * SIDE).reshape(SIDE, SIDE))
-    second = jnp.asarray(_fill(SEED + 1, SIDE * SIDE).reshape(SIDE, SIDE))
+def _entry_bits(side: int, bf16_matmul: bool) -> int:
+    """Largest entry width keeping every row sum exactly representable:
+    side * (2^bits - 1)^2 < 2^24, so f32 accumulation never rounds and
+    the golden compare is bitwise regardless of op order (256 -> 8 bits;
+    1024 -> 7 bits, a 16.52M vs 16.78M margin).  bf16 operands
+    additionally cap entries below 2^8 so the bfloat16 cast is exact."""
+    bits = 1
+    while side * (2 ** (bits + 1) - 1) ** 2 < 2 ** 24:
+        bits += 1
+    return min(bits, 8) if bf16_matmul else bits
+
+
+def make_region(side: int = SIDE, block: int = BLOCK,
+                bf16_matmul: bool = False) -> Region:
+    """The flagship family: ``side``x``side`` blocked matmul.
+
+    ``bf16_matmul=True`` feeds the MXU at bf16 rate: operands are cast to
+    bfloat16 inside the step (state stays 32-bit for the word-addressed
+    injection map).  Entries are integer-valued below 2^8 (exactly
+    representable in bf16; see _entry_bits) and accumulation happens in
+    f32 (preferred_element_type), so the result -- and therefore the
+    golden compare -- stays exact.
+    Injected mantissa flips in the f32 operands can land below bf16
+    precision; SDC statistics of this variant reflect the reduced-
+    precision datapath, exactly as a bf16 deployment would."""
+    n_blocks = side // block
+    bits = _entry_bits(side, bf16_matmul)
+    first = jnp.asarray(_fill(SEED, side * side, bits).reshape(side, side))
+    second = jnp.asarray(
+        _fill(SEED + 1, side * side, bits).reshape(side, side))
     # Exact in f32 (sums < 2^24), so host float64 rounds to the same values.
     golden = jnp.asarray(
         (np.asarray(first, np.float64) @ np.asarray(second, np.float64)
@@ -62,19 +88,24 @@ def make_region() -> Region:
         return {
             "first": first,
             "second": second,
-            "results": jnp.zeros((SIDE, SIDE), jnp.float32),
+            "results": jnp.zeros((side, side), jnp.float32),
             "golden": golden,
-            "acc": jnp.zeros((BLOCK, SIDE), jnp.float32),
+            "acc": jnp.zeros((block, side), jnp.float32),
             "i": jnp.int32(0),
             "phase": jnp.int32(0),
         }
 
     def step(state, t):
         i, phase = state["i"], state["phase"]
-        row0 = jnp.clip(i, 0, N_BLOCKS - 1) * BLOCK
+        row0 = jnp.clip(i, 0, n_blocks - 1) * block
         block_a = jax.lax.dynamic_slice(state["first"], (row0, 0),
-                                        (BLOCK, SIDE))
-        computed = block_a @ state["second"]        # MXU: (32,256)@(256,256)
+                                        (block, side))
+        if bf16_matmul:
+            computed = jnp.dot(block_a.astype(jnp.bfloat16),
+                               state["second"].astype(jnp.bfloat16),
+                               preferred_element_type=jnp.float32)
+        else:
+            computed = block_a @ state["second"]    # MXU, f32
         compute_phase = phase == 0
         acc = jnp.where(compute_phase, computed, state["acc"])
         stored = jax.lax.dynamic_update_slice(state["results"], state["acc"],
@@ -89,7 +120,7 @@ def make_region() -> Region:
         }
 
     def done(state):
-        return state["i"] >= N_BLOCKS
+        return state["i"] >= n_blocks
 
     def check(state):
         return jnp.sum(state["golden"] != state["results"]).astype(jnp.int32)
@@ -102,7 +133,7 @@ def make_region() -> Region:
         compute_pending = state["phase"] == 0
         return jnp.where(
             compute_pending,
-            jnp.where(state["i"] >= N_BLOCKS, jnp.int32(3), jnp.int32(1)),
+            jnp.where(state["i"] >= n_blocks, jnp.int32(3), jnp.int32(1)),
             jnp.int32(2)).astype(jnp.int32)
 
     graph = BlockGraph(
@@ -111,18 +142,18 @@ def make_region() -> Region:
         block_of=block_of,
     )
 
-    flops_per_run = 2 * SIDE * SIDE * SIDE          # one full matmul
-    state_bytes = 4 * (4 * SIDE * SIDE + BLOCK * SIDE + 2)
+    flops_per_run = 2 * side * side * side          # one full matmul
+    state_bytes = 4 * (4 * side * side + block * side + 2)
 
     return Region(
-        name="matrixMultiply256",
+        name=f"matrixMultiply{side}",
         init=init,
         step=step,
         done=done,
         check=check,
         output=output,
-        nominal_steps=2 * N_BLOCKS,
-        max_steps=6 * N_BLOCKS,
+        nominal_steps=2 * n_blocks,
+        max_steps=6 * n_blocks,
         spec={
             "first": LeafSpec(KIND_MEM),
             "second": LeafSpec(KIND_MEM),
@@ -136,5 +167,12 @@ def make_region() -> Region:
         graph=graph,
         meta={"oracle": "Number of errors: 0",
               "flops_per_run": flops_per_run,
-              "state_bytes": state_bytes},
+              "state_bytes": state_bytes,
+              "bf16_matmul": bf16_matmul},
     )
+
+
+def make_region_1024() -> Region:
+    """The MXU-rate flagship: 1024x1024 with bf16 operands (4 MiB result
+    state; ~2.1 GFLOP per run per lane)."""
+    return make_region(side=1024, block=128, bf16_matmul=True)

@@ -58,9 +58,10 @@ FLIP_TARGETS = {
     "simpleTMR": ("acc", 0, 7, 10),
     # corrupt the chained hash accumulator mid-pipeline
     "nestedCalls": ("acc", 0, 4, 2),
-    # flagship: flip a mantissa bit in the live accumulator block between
+    # flagships: flip a mantissa bit in the live accumulator block between
     # compute and commit
     "matrixMultiply256": ("acc", 777, 22, 3),
+    "matrixMultiply1024": ("acc", 7777, 20, 3),
     # corrupt the CRC task's accumulator before its next dispatch
     "rtos_app": ("acc_crc", 0, 9, 4),
 }
