@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr regression_test bench clean
+.PHONY: build test test_all test_fast test_full test_tmr regression_test test_rtos bench clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -29,6 +29,9 @@ test_tmr: build
 
 regression_test: build
 	$(CPU_ENV) $(PYTHON) unittest/pyDriver.py unittest/cfg/regression.yml
+
+test_rtos:
+	sh unittest/rtos_test.sh
 
 bench: build
 	$(PYTHON) bench.py

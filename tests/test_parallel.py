@@ -66,3 +66,23 @@ def test_run_histogram_matches_records(region):
 def test_sharded_empty_schedule(region):
     res = ShardedCampaignRunner(TMR(region), make_mesh(8)).run(0, seed=1)
     assert res.n == 0 and sum(res.counts.values()) == 0
+
+
+def test_sharded_campaign_with_fn_scope_region():
+    """Function-scope wrappers use cross-lane collectives over the vmap
+    lane axis; they must compose with shard_map over the mesh axes (the
+    lane axis name is distinct from every mesh axis name)."""
+    from coast_tpu import ProtectionConfig, protect
+    from coast_tpu.models import REGISTRY
+
+    mesh = make_mesh(4, axis_names=("data",))
+    region = REGISTRY["nestedCalls"]()
+    prog = protect(region, ProtectionConfig(
+        num_clones=3, ignore_fns=("fold",), protected_lib_fns=("mix",)))
+    runner = ShardedCampaignRunner(prog, mesh, strategy_name="TMR")
+    res = runner.run(32, seed=3, batch_size=32)
+    assert sum(res.counts.values()) == 32
+    # Classification must be identical to the unsharded runner's.
+    base = CampaignRunner(prog, strategy_name="TMR").run(
+        32, seed=3, batch_size=32)
+    assert res.counts == base.counts

@@ -1,0 +1,18 @@
+#!/bin/sh
+# RTOS smoke tier: the unittest/rtos_test.sh analogue (:26-44).
+#
+# The reference builds every FreeRTOS target and boots each in QEMU for a
+# few seconds (kill-and-hope, no output oracle).  Our rtos_app targets
+# run to completion with a real oracle, so this tier is strictly
+# stronger: build + run each protected target under the canonical
+# production scope config and require the golden-clean UART line.
+set -e
+cd "$(dirname "$0")/.."
+
+for tgt in rtos_app rtos_app_dwc; do
+    echo "== rtos smoke: $tgt"
+    out=$(timeout 600 make -s -C rtos "$tgt")
+    echo "$out" | tail -1
+    echo "$out" | grep -q "C: 0 E: 0" || { echo "FAIL: $tgt"; exit 1; }
+done
+echo "Success!"
