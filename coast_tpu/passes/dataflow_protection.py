@@ -533,9 +533,20 @@ class ProtectedProgram:
                 view[name] = arr[0]
         return view
 
+    def _default_unroll(self) -> int:
+        """Steps executed per early-exit loop iteration.  Measured on-chip:
+        with the flip masks hoisted out of the loop the per-step kernels are
+        cheap selects/XORs and the batched-while step cost is compute-, not
+        iteration-, bound, so unrolling only adds masked no-op sub-steps.
+        Callers can override per run; exactness is preserved at any value
+        (sub-steps past ``max_steps`` are masked to no-ops, so the record
+        is identical to the unroll=1 program)."""
+        return 1
+
     def run(self, fault: Optional[Dict[str, jax.Array]] = None,
             trace: bool = False,
-            return_state: bool = False) -> Dict[str, jax.Array]:
+            return_state: bool = False,
+            unroll: Optional[int] = None) -> Dict[str, jax.Array]:
         """Run to completion; optionally XOR one bit at step ``fault['t']``.
 
         ``fault`` keys: leaf_id, lane, word, bit, t (int32 scalars).  Returns
@@ -547,11 +558,25 @@ class ProtectedProgram:
         the debugStatements/smallProfile instrumentation passes
         (coast_tpu.passes.instrument).  The trace rides out of the scan as
         two stacked tensors (one host transfer), not per-step host prints.
+
+        ``unroll`` sets how many steps the early-exit loop executes per
+        iteration (default 1); any value yields the identical run record
+        (overshooting sub-steps are masked to no-ops).  The traced path is
+        a fixed-length scan, so ``unroll`` does not apply there.
         """
         if fault is not None:
             # Accept plain Python ints (the CLI / README ergonomics).
             fault = {k: jnp.asarray(v, jnp.int32) for k, v in fault.items()}
         pstate, flags = self.init_pstate()
+
+        # The flip's one-hot masks are step-invariant: build them ONCE
+        # outside the loop (the in-loop iota-compare rebuild measured ~2/3
+        # of small-benchmark campaign runtime), leaving one select+XOR per
+        # leaf per step.
+        masks = (None if fault is None else
+                 self._flip.build_masks(pstate, self.replicated,
+                                        fault["leaf_id"], fault["lane"],
+                                        fault["word"], fault["bit"]))
 
         def body(carry, t):
             pstate, flags = carry
@@ -563,9 +588,7 @@ class ProtectedProgram:
                 # finished/aborted run's frozen image would mis-classify it.
                 fire = jnp.logical_and(t == fault["t"],
                                        jnp.logical_not(halted))
-                pstate = self._flip(pstate, self.replicated, fault["leaf_id"],
-                                    fault["lane"], fault["word"], fault["bit"],
-                                    enable=fire)
+                pstate = self._flip.apply_masks(pstate, masks, fire)
             ys = None
             if trace:
                 if self.region.graph is not None:
@@ -591,17 +614,42 @@ class ProtectedProgram:
             # value-preserving -- so a batch costs roughly its slowest
             # member, not the watchdog bound (the reference likewise waits
             # on the breakpoint, not the watchdog, threadFunctions.py
-            # :754-842).
+            # :754-842).  A single loop keeps the batched-while iteration
+            # count at max(total steps) across the batch -- a
+            # flip-then-continue two-phase split would serialise to
+            # max(fault.t) + max(remaining), nearly doubling it.
+            def wstep(pstate, flags, t):
+                out, _ = body((pstate, flags), t)
+                return out
+
+            unroll_n = (self._default_unroll() if unroll is None
+                        else max(1, int(unroll)))
+            limit = jnp.int32(self.region.max_steps)
+
             def cond(carry):
                 (pstate, flags), t = carry
                 live = ~(flags["done"] | flags["dwc_fault"]
                          | flags["cfc_fault"])
-                return jnp.logical_and(t < self.region.max_steps, live)
+                return jnp.logical_and(t < limit, live)
+
+            def guarded(carry, t):
+                """One sub-step, masked to a no-op past the watchdog bound
+                so an unrolled iteration that overshoots cannot let a hung
+                run keep executing -- the record matches the unroll=1
+                program exactly."""
+                new_state, new_flags = wstep(*carry, t)
+                ok = t < limit
+                return jax.tree.map(
+                    lambda o, n: jnp.where(ok, n, o),
+                    carry, (new_state, new_flags))
 
             def wbody(carry):
-                (pstate, flags), t = carry
-                out, _ = body((pstate, flags), t)
-                return out, t + 1
+                st, t = carry
+                if unroll_n == 1:
+                    return wstep(*st, t), t + 1
+                for k in range(unroll_n):
+                    st = guarded(st, t + k)
+                return st, t + unroll_n
 
             (pstate, flags), _ = jax.lax.while_loop(
                 cond, wbody, ((pstate, flags), jnp.int32(0)))

@@ -101,3 +101,34 @@ def test_golden_corruption_reports_sdc(region):
     tmr = TMR(region)
     rec = jax.jit(tmr.run)(_fault(tmr, "golden", lane=0, word=10, bit=3, t=2))
     assert int(rec["errors"]) > 0
+
+
+@pytest.mark.parametrize("strat", [TMR, DWC])
+def test_unroll_equivalence(region, strat):
+    """The early-exit loop's unroll knob must not change the run record:
+    sub-steps past the watchdog bound are masked to no-ops, so any unroll
+    value produces the unroll=1 program's exact record (classification
+    parity is what makes unrolling a pure lowering choice)."""
+    prog = strat(region)
+    fault = _fault(prog, "results", lane=1, word=4, bit=19, t=6)
+    base = jax.device_get(jax.jit(lambda f: prog.run(f, unroll=1))(fault))
+    rolled = jax.device_get(jax.jit(lambda f: prog.run(f, unroll=4))(fault))
+    for k in ("errors", "corrected", "steps", "done", "dwc_fault",
+              "cfc_fault", "output"):
+        assert (base[k] == rolled[k]).all(), k
+
+
+def test_unroll_equivalence_hung_run(region):
+    """A flip that wedges the guest (sign-bit of the loop counter in an
+    unprotected run: the index goes negative and the loop can never reach
+    its bound) must classify DUE_TIMEOUT at exactly max_steps under every
+    unroll -- an unrolled iteration may not let the hung run keep
+    executing past the watchdog."""
+    prog = unprotected(region)
+    fault = _fault(prog, "i", lane=0, word=0, bit=31, t=3)
+    base = jax.device_get(jax.jit(lambda f: prog.run(f, unroll=1))(fault))
+    rolled = jax.device_get(jax.jit(lambda f: prog.run(f, unroll=5))(fault))
+    assert not bool(base["done"])
+    assert int(base["steps"]) == region.max_steps
+    for k in ("errors", "steps", "done", "output"):
+        assert (base[k] == rolled[k]).all(), k
