@@ -1,11 +1,12 @@
 """Native C++ core loader (ctypes) with bit-exact numpy fallbacks.
 
 The reference's native components are LLVM C++ passes (projects/); this
-framework's native core (coast_core.cpp) carries the host-side compute that
-is not XLA's job: bulk seeded RNG for fault schedules and CFCSS signature
-assignment over block graphs.  Built via
-``make -C coast_tpu/native``; every entry point has a numpy fallback that
-produces *identical* results so the Python path never blocks on a compiler.
+framework's native core (coast_core.cpp) carries the host-side work that is
+not XLA's job: bulk seeded RNG for fault schedules, CFCSS signature
+assignment over block graphs, and the bulk campaign-log ndjson encoder (the
+IO path of 10^6-run campaigns).  Built via ``make -C coast_tpu/native``;
+every entry point has a Python/numpy fallback that produces *identical*
+results so the Python path never blocks on a compiler.
 """
 
 from __future__ import annotations
@@ -65,6 +66,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")]
             lib.coast_cfcss_assign.restype = ctypes.c_int32
+            try:
+                # Newer symbol in its own guard: an older .so (rebuild
+                # failed on a compiler-less host) must degrade only the
+                # ndjson path, not the whole native core -- callers check
+                # hasattr before using it.
+                i32arr = np.ctypeslib.ndpointer(np.int32,
+                                                flags="C_CONTIGUOUS")
+                lib.coast_ndjson_encode.argtypes = [
+                    ctypes.c_int64, ctypes.c_int64,
+                    i32arr, i32arr, i32arr, i32arr, i32arr,
+                    i32arr, i32arr, i32arr, i32arr,
+                    ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+                lib.coast_ndjson_encode.restype = ctypes.c_int64
+            except AttributeError:
+                pass
             _lib = lib
         except (OSError, AttributeError):
             # Unloadable or built from an older source missing a symbol:
@@ -93,6 +112,63 @@ def splitmix_fill(seed: int, n: int) -> np.ndarray:
         z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         return z ^ (z >> np.uint64(31))
+
+
+def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
+                       sec_name_by_leaf, ts: str, write,
+                       chunk_bytes: int = 32 << 20) -> bool:
+    """Native bulk serialisation of campaign rows [lo, hi) to
+    InjectionLog-schema ndjson lines (byte-identical to the Python
+    formatter in inject/logs.write_ndjson), streamed chunk-by-chunk to
+    ``write`` so peak memory stays at one bounded buffer regardless of
+    campaign size.  ``col`` is a dict of int32 numpy columns;
+    ``sec_kind_by_leaf``/``sec_name_by_leaf`` are lists of
+    pre-JSON-escaped strings indexed by leaf_id.  Returns False (before
+    writing anything) when the native core is unavailable, so the caller
+    can fall back to the Python loop; raises on malformed input, which
+    indicates a bug rather than a missing compiler."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "coast_ndjson_encode"):
+        return False
+    n_leaves = len(sec_kind_by_leaf)
+    kind_arr = (ctypes.c_char_p * n_leaves)(
+        *(s.encode() for s in sec_kind_by_leaf))
+    name_arr = (ctypes.c_char_p * n_leaves)(
+        *(s.encode() for s in sec_name_by_leaf))
+    cols = {k: np.ascontiguousarray(col[k], np.int32)
+            for k in ("leaf_id", "lane", "word", "bit", "t",
+                      "code", "errors", "corrected", "steps")}
+    buf = ctypes.create_string_buffer(chunk_bytes)
+    ts_b = ts.encode()
+
+    def encode(i, j):
+        return lib.coast_ndjson_encode(
+            i, j, cols["leaf_id"], cols["lane"], cols["word"], cols["bit"],
+            cols["t"], cols["code"], cols["errors"], cols["corrected"],
+            cols["steps"], np.int32(n_leaves), kind_arr, name_arr,
+            ts_b, buf, chunk_bytes)
+
+    # Rows per chunk from the same conservative per-line bound the C side
+    # enforces, so long leaf names shrink the chunk instead of overflowing
+    # it (and no formatting pass is ever discarded).
+    max_str = max([len(ts_b)] + [len(s) for s in kind_arr]
+                  + [len(s) for s in name_arr])
+    line_bound = 320 + 2 * len(ts_b) + 3 * max_str + 9 * 20
+    rows_per_chunk = max(1, chunk_bytes // line_bound)
+    i = lo
+    while i < hi:
+        j = min(hi, i + rows_per_chunk)
+        wrote = encode(i, j)
+        while wrote == -1 and j - i > 1:   # belt-and-braces: halve until fit
+            j = i + max(1, (j - i) // 2)
+            wrote = encode(i, j)
+        if wrote < 0:
+            raise RuntimeError(
+                f"coast_ndjson_encode failed (rc={wrote}) on rows "
+                f"[{i}, {j})")
+        write(ctypes.string_at(buf, wrote))
+        i = j
+    return True
 
 
 def _splitmix_at(seed: int, i: int) -> int:

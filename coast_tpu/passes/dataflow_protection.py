@@ -533,20 +533,10 @@ class ProtectedProgram:
                 view[name] = arr[0]
         return view
 
-    def _default_unroll(self) -> int:
-        """Steps executed per early-exit loop iteration.  Measured on-chip:
-        with the flip masks hoisted out of the loop the per-step kernels are
-        cheap selects/XORs and the batched-while step cost is compute-, not
-        iteration-, bound, so unrolling only adds masked no-op sub-steps.
-        Callers can override per run; exactness is preserved at any value
-        (sub-steps past ``max_steps`` are masked to no-ops, so the record
-        is identical to the unroll=1 program)."""
-        return 1
-
     def run(self, fault: Optional[Dict[str, jax.Array]] = None,
             trace: bool = False,
             return_state: bool = False,
-            unroll: Optional[int] = None) -> Dict[str, jax.Array]:
+            unroll: int = 1) -> Dict[str, jax.Array]:
         """Run to completion; optionally XOR one bit at step ``fault['t']``.
 
         ``fault`` keys: leaf_id, lane, word, bit, t (int32 scalars).  Returns
@@ -560,9 +550,11 @@ class ProtectedProgram:
         two stacked tensors (one host transfer), not per-step host prints.
 
         ``unroll`` sets how many steps the early-exit loop executes per
-        iteration (default 1); any value yields the identical run record
-        (overshooting sub-steps are masked to no-ops).  The traced path is
-        a fixed-length scan, so ``unroll`` does not apply there.
+        iteration; any value yields the identical run record (overshooting
+        sub-steps are masked to no-ops).  The default stays 1: measured
+        on-chip, with the flip masks hoisted the step cost is compute-
+        bound, so unrolling only adds masked no-op sub-steps.  The traced
+        path is a fixed-length scan, so ``unroll`` does not apply there.
         """
         if fault is not None:
             # Accept plain Python ints (the CLI / README ergonomics).
@@ -622,8 +614,7 @@ class ProtectedProgram:
                 out, _ = body((pstate, flags), t)
                 return out
 
-            unroll_n = (self._default_unroll() if unroll is None
-                        else max(1, int(unroll)))
+            unroll_n = max(1, int(unroll))
             limit = jnp.int32(self.region.max_steps)
 
             def cond(carry):

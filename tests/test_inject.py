@@ -174,3 +174,51 @@ def test_bulk_log_formats_match_classic(region, tmp_path, campaigns):
     hists = {name: jp.cycle_histogram(d) for name, d in docs.items()}
     for name, h in hists.items():
         assert h == hists["classic.json"], name
+
+
+def test_native_ndjson_encoder_byte_parity(region, tmp_path, monkeypatch):
+    """The native C++ bulk encoder must be byte-identical to the Python
+    template formatter across every class code and the cache-invalid
+    (t < 0) attribution path."""
+    from coast_tpu import native
+    from coast_tpu.inject import logs
+    from coast_tpu.inject.campaign import CampaignResult
+    from coast_tpu.inject.schedule import FaultSchedule
+
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+
+    runner = CampaignRunner(TMR(region))
+    n = 12
+    sched = FaultSchedule(
+        leaf_id=np.arange(n, dtype=np.int32) % 3,
+        lane=np.arange(n, dtype=np.int32) % 3,
+        word=np.arange(n, dtype=np.int32) * 7,
+        bit=np.arange(n, dtype=np.int32) % 32,
+        # two cache-invalid rows exercise the pseudo-section path
+        t=np.where(np.arange(n) % 5 == 4, -1,
+                   np.arange(n)).astype(np.int32),
+        section_idx=np.zeros(n, np.int32), seed=3)
+    res = CampaignResult(
+        benchmark="synthetic", strategy="TMR", n=n,
+        counts={name: 2 for name in cls.CLASS_NAMES},
+        seconds=1.0,
+        codes=(np.arange(n, dtype=np.int32) % cls.NUM_CLASSES),
+        errors=np.arange(n, dtype=np.int32),
+        corrected=np.arange(n, dtype=np.int32) * 3,
+        steps=np.arange(n, dtype=np.int32) + 10,
+        schedule=sched, seed=3)
+
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    logs.write_ndjson(res, runner.mmap, str(tmp_path / "native.json"))
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    logs.write_ndjson(res, runner.mmap, str(tmp_path / "python.json"))
+    a = (tmp_path / "native.json").read_bytes()
+    b = (tmp_path / "python.json").read_bytes()
+    assert a == b
+    # every class code and both attribution paths actually appeared
+    assert b.count(b"cache-invalid") == 2
+    assert b"FAULT_DETECTED abort" in b
+    assert b"hit step bound" in b
+    assert b"self-check out of domain" in b
