@@ -19,13 +19,18 @@ supportClasses.py:338-353) and reproduces the reference's analyses
   * injection-time histogram (``pcStats`` :216-230, cycle-count histogram --
     text, no matplotlib dependency).
 
-CLI (mirroring ``jsonParser.py logs/ -p | -k fileB``)::
+CLI (mirroring ``jsonParser.py logs/ -p | -k fileB | -d dirB``)::
 
     python -m coast_tpu.analysis run.json            # summarize one file
     python -m coast_tpu.analysis logs/               # summarize a directory
     python -m coast_tpu.analysis a.json -k b.json    # compare A vs B (MWTF)
+    python -m coast_tpu.analysis dirA -d dirB        # compare directories
     python -m coast_tpu.analysis run.json -p         # + per-section table
+    python -m coast_tpu.analysis run.json -r         # + register-kind table
+    python -m coast_tpu.analysis run.json -t         # + trap/timeout counts
     python -m coast_tpu.analysis run.json -c         # + cycle histogram
+    python -m coast_tpu.analysis run.json -n -p      # tables only (-n: no
+                                                     #   summary block)
 """
 
 from __future__ import annotations
@@ -246,13 +251,16 @@ def format_comparison(base: Summary, new: Summary) -> str:
 # -- per-section attribution (per-register counts :259-287 + per-symbol
 #    examineSymbolInjections :340-455) ---------------------------------------
 
-def section_stats(docs: Iterable[Dict[str, object]]
-                  ) -> Dict[str, Dict[str, int]]:
+def section_stats(docs: Iterable[Dict[str, object]],
+                  kinds: Optional[set] = None) -> Dict[str, Dict[str, int]]:
     """symbol -> {class -> count, 'injections' -> n}.
 
     On TPU the injected "section"/"symbol" is the state leaf recorded in each
     run's ``symbol`` key (fallback: parse the ``name`` field's ``sym[lane``
     shape), so register-style and symbol-style attribution coincide.
+    ``kinds`` restricts the table to sections of those kinds (e.g.
+    ``{"reg", "ctrl"}`` for the reference's per-register error counts,
+    jsonParser.py:259-287).
     """
     table: Dict[str, Dict[str, int]] = {}
     for doc in docs:
@@ -269,7 +277,11 @@ def section_stats(docs: Iterable[Dict[str, object]]
             sec_name = {s["leaf_id"]: s["name"]
                         for s in doc.get("sections", [])}  # type: ignore
             sec_name[-1] = "<invalid-line>"
+            sec_kind = {s["leaf_id"]: s.get("kind")
+                        for s in doc.get("sections", [])}  # type: ignore
             for lid in np.unique(leaf_ids):
+                if kinds is not None and sec_kind.get(int(lid)) not in kinds:
+                    continue
                 sym = sec_name.get(int(lid), "?")
                 row = table.setdefault(
                     sym, {**{cls: 0 for cls in _CLASSES}, "injections": 0})
@@ -280,6 +292,8 @@ def section_stats(docs: Iterable[Dict[str, object]]
                     row[cls] += int(binc[i])
             continue
         for run in doc["runs"]:  # type: ignore
+            if kinds is not None and run.get("section") not in kinds:
+                continue
             sym = run.get("symbol")
             if not sym:
                 sym = str(run.get("name", "?")).split("[", 1)[0]
@@ -288,6 +302,27 @@ def section_stats(docs: Iterable[Dict[str, object]]
             row["injections"] += 1
             row[classify_run(run)] += 1
     return table
+
+
+def trap_counts(docs: Iterable[Dict[str, object]]) -> Tuple[int, int]:
+    """(traps, timeouts): how many DUE timeouts were traps (``-t``,
+    jsonParser.py countTrap).  TPU runs cannot trap -- there is no
+    exception vector, the watchdog bound is the only hang detector -- so
+    traps is 0 unless logs came from another platform; the flag exists
+    for CLI parity and honest reporting of that difference."""
+    traps = timeouts = 0
+    for doc in docs:
+        if "columns" in doc:
+            import numpy as np
+            codes = np.asarray(doc["columns"]["code"])  # type: ignore
+            timeouts += int((codes == _CLASSES.index("due_timeout")).sum())
+        else:
+            for run in doc["runs"]:  # type: ignore
+                res = run.get("result") or {}
+                if "timeout" in res:
+                    timeouts += 1
+                    traps += 1 if res.get("trap") else 0
+    return traps, timeouts
 
 
 def format_section_stats(table: Dict[str, Dict[str, int]]) -> str:
@@ -346,19 +381,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     compare_path: Optional[str] = None
     per_section = False
     histogram = False
+    registers = False
+    count_trap = False
+    no_summary = False
     i = 0
     while i < len(argv):
         arg = argv[i]
-        if arg == "-k":
+        if arg in ("-k", "-d"):
+            # -k compares files, -d directories; _iter_docs walks either,
+            # so both resolve to the same comparison path (jsonParser.py
+            # compare-files :88 / compare-dirs :89).
             i += 1
             if i >= len(argv):
-                print("ERROR: -k needs a file", file=sys.stderr)
+                print(f"ERROR: {arg} needs a path", file=sys.stderr)
                 return 2
             compare_path = argv[i]
         elif arg == "-p":
             per_section = True
         elif arg == "-c":
             histogram = True
+        elif arg == "-r":
+            registers = True
+        elif arg == "-t":
+            count_trap = True
+        elif arg == "-n":
+            no_summary = True
         elif arg.startswith("-"):
             print(f"ERROR: unknown flag {arg}", file=sys.stderr)
             return 2
@@ -393,10 +440,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             os.path.basename(path.rstrip("/")) or path, docs)
         if compare_summary is not None:
             print(format_comparison(base, compare_summary))
-        else:
+        elif not no_summary:
             print(base.format())
         if per_section:
             print(format_section_stats(section_stats(docs)))
+        if registers:
+            print(format_section_stats(
+                section_stats(docs, kinds={"reg", "ctrl", "cfcss"})))
+        if count_trap:
+            traps, timeouts = trap_counts(docs)
+            print(f"traps: {traps} of {timeouts} timeouts")
         if histogram:
             print(format_cycle_histogram(cycle_histogram(docs)))
     return 0

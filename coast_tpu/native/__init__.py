@@ -148,9 +148,11 @@ def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
             cols["steps"], np.int32(n_leaves), kind_arr, name_arr,
             ts_b, buf, chunk_bytes)
 
-    # Rows per chunk from the same conservative per-line bound the C side
-    # enforces, so long leaf names shrink the chunk instead of overflowing
-    # it (and no formatting pass is ever discarded).
+    # Advisory chunk sizing: estimate rows per chunk from a conservative
+    # per-line bound so long leaf names shrink the chunk up front.  Safety
+    # does not depend on the estimate -- the C writer bounds-checks every
+    # write and returns -1 on overflow, which the halving loop below
+    # retries with fewer rows (discarding that one failed pass).
     max_str = max([len(ts_b)] + [len(s) for s in kind_arr]
                   + [len(s) for s in name_arr])
     line_bound = 320 + 2 * len(ts_b) + 3 * max_str + 9 * 20

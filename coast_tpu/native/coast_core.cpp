@@ -58,21 +58,32 @@ inline uint64_t splitmix_at(uint64_t seed, uint64_t i) {
 
 // Append helpers for the ndjson encoder: memcpy/itoa composition is ~5x
 // faster than snprintf chains at the 10^6-row scale the encoder exists for.
-inline char* put_str(char* p, const char* s, size_t len) {
-  std::memcpy(p, s, len);
-  return p + len;
+// Every write is bounds-checked against the buffer end: on overflow the
+// writer latches and the encoder returns -1 (the caller retries with a
+// smaller row range), so buffer safety never depends on the advisory
+// per-line size estimate staying in sync with the templates.
+struct Writer {
+  char* p;
+  char* end;
+  bool overflow = false;
+};
+inline void put_str(Writer& w, const char* s, size_t len) {
+  if (w.overflow || (size_t)(w.end - w.p) < len) {
+    w.overflow = true;
+    return;
+  }
+  std::memcpy(w.p, s, len);
+  w.p += len;
 }
-inline char* put_lit(char* p, const char* s) {
-  return put_str(p, s, std::strlen(s));
-}
-inline char* put_i64(char* p, int64_t v) {
+inline void put_lit(Writer& w, const char* s) { put_str(w, s, std::strlen(s)); }
+inline void put_i64(Writer& w, int64_t v) {
   char tmp[24];
   char* q = tmp + sizeof tmp;
   bool neg = v < 0;
   uint64_t u = neg ? (uint64_t)(-(v + 1)) + 1 : (uint64_t)v;
   do { *--q = (char)('0' + u % 10); u /= 10; } while (u);
   if (neg) *--q = '-';
-  return put_str(p, q, (size_t)(tmp + sizeof tmp - q));
+  put_str(w, q, (size_t)(tmp + sizeof tmp - q));
 }
 
 }  // namespace
@@ -186,8 +197,9 @@ int32_t coast_cfcss_assign(int32_t n, int32_t n_edges, const int32_t* edges,
 // Rows with t < 0 are cache draws outside the program footprint (never
 // fired) and attribute to the "cache-invalid" pseudo-section.
 //
-// Returns bytes written into out, or -1 if out_cap could be exceeded
-// (caller retries with a larger buffer), -2 on malformed input.
+// Returns bytes written into out, or -1 when the rows do not fit out_cap
+// (every write is bounds-checked; the caller retries a smaller row range),
+// -2 on malformed input.
 int64_t coast_ndjson_encode(
     int64_t lo, int64_t hi, const int32_t* leaf_id, const int32_t* lane,
     const int32_t* word, const int32_t* bit, const int32_t* t,
@@ -196,97 +208,93 @@ int64_t coast_ndjson_encode(
     const char* const* sec_name, const char* ts, char* out,
     int64_t out_cap) {
   if (lo < 0 || hi < lo || n_leaves < 0) return -2;
-  size_t max_str = std::strlen(ts);
-  for (int32_t s = 0; s < n_leaves; ++s) {
-    max_str = std::max(max_str, std::strlen(sec_kind[s]));
-    max_str = std::max(max_str, std::strlen(sec_name[s]));
-  }
-  // Conservative per-line bound: fixed template text + 2 timestamps +
-  // 3 strings + ~9 int fields at <= 20 chars each.
-  const int64_t line_bound =
-      320 + 2 * (int64_t)std::strlen(ts) + 3 * (int64_t)max_str + 9 * 20;
   const size_t ts_len = std::strlen(ts);
   std::vector<size_t> kind_len(n_leaves), name_len(n_leaves);
   for (int32_t s = 0; s < n_leaves; ++s) {
     kind_len[s] = std::strlen(sec_kind[s]);
     name_len[s] = std::strlen(sec_name[s]);
   }
-  char* p = out;
-  char* const end = out + out_cap;
+  Writer w{out, out + out_cap};
   for (int64_t i = lo; i < hi; ++i) {
-    if (end - p < line_bound) return -1;
-    p = put_lit(p, "{\"timestamp\": \"");
-    p = put_str(p, ts, ts_len);
-    p = put_lit(p, "\", \"number\": ");
-    p = put_i64(p, i);
-    p = put_lit(p, ", \"section\": \"");
+    put_lit(w, "{\"timestamp\": \"");
+    put_str(w, ts, ts_len);
+    put_lit(w, "\", \"number\": ");
+    put_i64(w, i);
+    put_lit(w, ", \"section\": \"");
     const int32_t lid = leaf_id[i];
     const bool invalid_line = t[i] < 0;
     if (!invalid_line && (lid < 0 || lid >= n_leaves)) return -2;
-    p = invalid_line ? put_lit(p, "cache-invalid")
-                     : put_str(p, sec_kind[lid], kind_len[lid]);
-    p = put_lit(p, "\", \"address\": ");
-    p = put_i64(p, word[i]);
-    p = put_lit(p, ", \"oldValue\": null, \"newValue\": null, "
-                   "\"sleepTime\": 0, \"cycles\": ");
-    p = put_i64(p, t[i]);
-    p = put_lit(p, ", \"PC\": ");
-    p = put_i64(p, t[i]);
-    p = put_lit(p, ", \"name\": \"");
     if (invalid_line) {
-      p = put_lit(p, "<invalid-line>^bit");
-      p = put_i64(p, bit[i]);
+      put_lit(w, "cache-invalid");
     } else {
-      p = put_str(p, sec_name[lid], name_len[lid]);
-      p = put_lit(p, "[lane ");
-      p = put_i64(p, lane[i]);
-      p = put_lit(p, "]^bit");
-      p = put_i64(p, bit[i]);
+      put_str(w, sec_kind[lid], kind_len[lid]);
     }
-    p = put_lit(p, "\", \"symbol\": \"");
-    p = invalid_line ? put_lit(p, "<invalid-line>")
-                     : put_str(p, sec_name[lid], name_len[lid]);
-    p = put_lit(p, "\", \"result\": ");
+    put_lit(w, "\", \"address\": ");
+    put_i64(w, word[i]);
+    put_lit(w, ", \"oldValue\": null, \"newValue\": null, "
+               "\"sleepTime\": 0, \"cycles\": ");
+    put_i64(w, t[i]);
+    put_lit(w, ", \"PC\": ");
+    put_i64(w, t[i]);
+    put_lit(w, ", \"name\": \"");
+    if (invalid_line) {
+      put_lit(w, "<invalid-line>^bit");
+      put_i64(w, bit[i]);
+    } else {
+      put_str(w, sec_name[lid], name_len[lid]);
+      put_lit(w, "[lane ");
+      put_i64(w, lane[i]);
+      put_lit(w, "]^bit");
+      put_i64(w, bit[i]);
+    }
+    put_lit(w, "\", \"symbol\": \"");
+    if (invalid_line) {
+      put_lit(w, "<invalid-line>");
+    } else {
+      put_str(w, sec_name[lid], name_len[lid]);
+    }
+    put_lit(w, "\", \"result\": ");
     switch (code[i]) {
       case 0:  // SUCCESS
       case 1:  // CORRECTED
       case 2:  // SDC
-        p = put_lit(p, "{\"timestamp\": \"");
-        p = put_str(p, ts, ts_len);
-        p = put_lit(p, "\", \"core\": 0, \"runtime\": ");
-        p = put_i64(p, steps[i]);
-        p = put_lit(p, ", \"errors\": ");
-        p = put_i64(p, errors[i]);
-        p = put_lit(p, ", \"faults\": ");
-        p = put_i64(p, corrected[i]);
-        p = put_lit(p, "}");
+        put_lit(w, "{\"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"core\": 0, \"runtime\": ");
+        put_i64(w, steps[i]);
+        put_lit(w, ", \"errors\": ");
+        put_i64(w, errors[i]);
+        put_lit(w, ", \"faults\": ");
+        put_i64(w, corrected[i]);
+        put_lit(w, "}");
         break;
       case 3:  // DUE_ABORT
-        p = put_lit(p, "{\"type\": \"DWC/CFCSS\", \"message\": "
-                       "\"FAULT_DETECTED abort\", \"timestamp\": \"");
-        p = put_str(p, ts, ts_len);
-        p = put_lit(p, "\", \"errors\": 1}");
+        put_lit(w, "{\"type\": \"DWC/CFCSS\", \"message\": "
+                   "\"FAULT_DETECTED abort\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"errors\": 1}");
         break;
       case 4:  // DUE_TIMEOUT
-        p = put_lit(p, "{\"trap\": false, \"timeout\": \"hit step bound at ");
-        p = put_i64(p, steps[i]);
-        p = put_lit(p, "\", \"timestamp\": \"");
-        p = put_str(p, ts, ts_len);
-        p = put_lit(p, "\"}");
+        put_lit(w, "{\"trap\": false, \"timeout\": \"hit step bound at ");
+        put_i64(w, steps[i]);
+        put_lit(w, "\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\"}");
         break;
       case 5:  // INVALID
-        p = put_lit(p, "{\"invalid\": \"self-check out of domain (E=");
-        p = put_i64(p, errors[i]);
-        p = put_lit(p, ")\", \"timestamp\": \"");
-        p = put_str(p, ts, ts_len);
-        p = put_lit(p, "\"}");
+        put_lit(w, "{\"invalid\": \"self-check out of domain (E=");
+        put_i64(w, errors[i]);
+        put_lit(w, ")\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\"}");
         break;
       default:
         return -2;
     }
-    p = put_lit(p, ", \"cacheInfo\": null}\n");
+    put_lit(w, ", \"cacheInfo\": null}\n");
+    if (w.overflow) return -1;
   }
-  return p - out;
+  return w.p - out;
 }
 
 }  // extern "C"

@@ -138,3 +138,31 @@ def test_cli_skips_stray_json_in_dir(log_files, capsys, tmp_path):
     cap = capsys.readouterr()
     assert f"{N} injections" in cap.out
     assert cap.err.count("skipping") == 2
+
+
+def test_cli_register_trap_dir_flags(log_files, capsys, tmp_path):
+    """-r (register-kind attribution), -t (trap counts), -n (no summary),
+    -d (directory compare) -- the rest of the jsonParser.py flag surface
+    (jsonParser.py:84-94)."""
+    path = log_files["TMR"][0]
+    assert jp.main([path, "-n", "-r", "-t"]) == 0
+    out = capsys.readouterr().out
+    assert "per-section attribution" in out
+    assert "injections" not in out.splitlines()[0]  # -n suppressed summary
+    assert "timeouts" in out
+    # register table only contains reg/ctrl/cfcss-kind leaves
+    doc = jp.read_json_file(path)
+    reg_table = jp.section_stats([doc], kinds={"reg", "ctrl", "cfcss"})
+    full_table = jp.section_stats([doc])
+    assert set(reg_table) < set(full_table)
+    assert sum(r["injections"] for r in reg_table.values()) < \
+        sum(r["injections"] for r in full_table.values())
+
+    # -d: directory comparison
+    import shutil
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(); db.mkdir()
+    shutil.copy(log_files["none"][0], da / "none.json")
+    shutil.copy(log_files["TMR"][0], db / "tmr.json")
+    assert jp.main([str(da), "-d", str(db)]) == 0
+    assert "MWTF" in capsys.readouterr().out
