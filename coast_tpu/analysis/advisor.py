@@ -1,0 +1,262 @@
+"""Selective-hardening advisor: data-driven xMR scope recommendations.
+
+The reference leaves protection scope to the user: docs tell you to hand-
+compose ``-ignoreGlbls/-cloneGlbls`` lists per target and iterate against
+fault-injection campaigns by hand (the canonical dozens-name scope list of
+rtos/pynq/Makefile:8-30 was produced that way).  A batched campaign engine
+makes that loop automatic: inject into the *unprotected* program, attribute
+SDC/DUE outcomes to the state leaf that was hit (the per-symbol attribution
+of jsonParser.py:340-455), and greedily protect the highest-harm leaves --
+closed over the SoR rules so the verifier accepts the result -- until a
+target residual SDC rate is met.  The output is both region annotations and
+a functions.config-compatible snippet (``cloneGlbls=``/``ignoreGlbls=``),
+so the recommendation plugs straight into the reference-style interface
+layer.
+
+This is a beyond-parity capability: nothing in the reference automates
+scope selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignResult, CampaignRunner
+from coast_tpu.ir.region import KIND_CTRL, KIND_RO, LeafSpec, Region
+from coast_tpu.passes.strategies import TMR, unprotected
+from coast_tpu.passes.verification import RegionDataflow, analyze
+
+
+@dataclasses.dataclass
+class LeafHarm:
+    """Campaign attribution for one injectable leaf of the unprotected run."""
+
+    name: str
+    injections: int
+    sdc: int
+    due: int
+    words: int
+
+    @property
+    def harm_rate(self) -> float:
+        """P(SDC or DUE | flip lands in this leaf)."""
+        return (self.sdc + self.due) / self.injections if self.injections \
+            else 0.0
+
+
+@dataclasses.dataclass
+class Advice:
+    region_name: str
+    target_sdc: float
+    ranked: List[LeafHarm]              # harm-descending attribution table
+    protect: List[str]                  # leaves to replicate (SoR-closed)
+    annotations: Dict[str, LeafSpec]    # selective spec (xmr islands)
+    baseline: Dict[str, object]         # unprotected campaign summary
+    achieved: Optional[Dict[str, object]] = None   # selective TMR summary
+    full: Optional[Dict[str, object]] = None       # full TMR summary
+    protected_words: int = 0
+    total_words: int = 0
+
+    @property
+    def config_text(self) -> str:
+        """functions.config-style snippet (interface/config.py FILE_KEYS):
+        the protect list as cloneGlbls, the rest as ignoreGlbls."""
+        ignore = [h.name for h in self.ranked if h.name not in self.protect]
+        return ("# selective xMR scope recommended by coast_tpu advisor\n"
+                f"cloneGlbls={','.join(self.protect)}\n"
+                f"ignoreGlbls={','.join(ignore)}\n")
+
+    def format(self) -> str:
+        lines = [f"--- selective-hardening advice: {self.region_name} ---",
+                 f"  {'leaf':<18} {'inj':>6} {'sdc':>6} {'due':>5} "
+                 f"{'words':>6}  harm%  protect"]
+        for h in self.ranked:
+            mark = "xMR" if h.name in self.protect else "-"
+            lines.append(
+                f"  {h.name:<18} {h.injections:>6} {h.sdc:>6} {h.due:>5} "
+                f"{h.words:>6}  {100 * h.harm_rate:5.1f}  {mark}")
+        lines.append(f"  replicated words: {self.protected_words}"
+                     f"/{self.total_words}")
+
+        def rate(s):
+            n = s["injections"]
+            return (s["sdc"] + s["due_abort"] + s["due_timeout"]) / n if n \
+                else 0.0
+
+        lines.append(f"  unprotected harm rate: {100 * rate(self.baseline):.2f}%")
+        if self.achieved is not None:
+            lines.append(f"  selective TMR harm rate: "
+                         f"{100 * rate(self.achieved):.2f}%")
+        if self.full is not None:
+            lines.append(f"  full TMR harm rate: {100 * rate(self.full):.2f}%")
+        return "\n".join(lines)
+
+
+def _leaf_harms(res: CampaignResult, runner: CampaignRunner) -> List[LeafHarm]:
+    codes = res.codes
+    lids = res.schedule.leaf_id
+    harms = []
+    for sec in runner.mmap.sections:
+        sel = codes[lids == sec.leaf_id]
+        binc = np.bincount(sel, minlength=cls.NUM_CLASSES)
+        harms.append(LeafHarm(
+            name=sec.name,
+            injections=int(len(sel)),
+            sdc=int(binc[cls.SDC]),
+            due=int(binc[cls.DUE_ABORT] + binc[cls.DUE_TIMEOUT]),
+            words=int(sec.words * sec.lanes)))
+    harms.sort(key=lambda h: (-h.harm_rate, h.name))
+    return harms
+
+
+def _sor_closure(region: Region, flow: RegionDataflow,
+                 chosen: FrozenSet[str]) -> FrozenSet[str]:
+    """Close the protect-set under the verifier's rules (verification.py;
+    reference rules table verification.cpp:686-718) so the recommended
+    config always builds:
+
+    * NotProtected->Protected: a replicated leaf may not read a *mutable*
+      unprotected leaf, so every mutable transitive source joins the set;
+    * unvoted control: once anything is replicated, every KIND_CTRL leaf
+      must be too (branch predicates are voted before the branch,
+      synchronization.cpp:741-1113), so all ctrl leaves join the set.
+    """
+    closed = set(chosen)
+    if closed:
+        closed |= {n for n, s in region.spec.items() if s.kind == KIND_CTRL}
+    frontier = list(closed)
+    while frontier:
+        name = frontier.pop()
+        for src in flow.deps.get(name, frozenset()):
+            if src != name and src in flow.written and src not in closed:
+                closed.add(src)
+                frontier.append(src)
+    return frozenset(closed)
+
+
+def _selective_region(region: Region, protect_set: FrozenSet[str]) -> Region:
+    spec = {}
+    for name, s in region.spec.items():
+        spec[name] = dataclasses.replace(s, xmr=(name in protect_set))
+    return dataclasses.replace(region, spec=spec, default_xmr=False)
+
+
+def advise(region: Region,
+           budget: int = 8192,
+           target_sdc: float = 0.0,
+           seed: int = 0,
+           batch_size: int = 2048,
+           validate: bool = True) -> Advice:
+    """Recommend a selective xMR scope for ``region``.
+
+    ``budget`` faults are injected into the unprotected program; leaves are
+    protected greedily by harm contribution (SoR-closed at every step)
+    until the *predicted* residual harm rate is <= ``target_sdc``.
+    ``validate=True`` re-runs the campaign against the recommended
+    selective TMR and full TMR for the achieved rates.
+    """
+    runner = CampaignRunner(unprotected(region), strategy_name="none")
+    base = runner.run(budget, seed=seed, batch_size=batch_size)
+    harms = _leaf_harms(base, runner)
+    total_inj = sum(h.injections for h in harms)
+    flow = analyze(region)
+
+    protect_set: FrozenSet[str] = frozenset()
+    residual = sum(h.sdc + h.due for h in harms)
+    by_name = {h.name: h for h in harms}
+    # Greedy by absolute harm *contribution* (sdc+due counts), not the
+    # conditional rate: a leaf hit twice with 100% harm contributes less
+    # campaign harm than a large leaf at 30%, and protecting it first
+    # would inflate the scope for no residual benefit.
+    for h in sorted(harms, key=lambda x: (-(x.sdc + x.due), x.name)):
+        if total_inj and residual / total_inj <= target_sdc:
+            break
+        if h.sdc + h.due == 0:
+            break
+        if h.name in protect_set or h.name not in region.spec:
+            continue
+        if region.spec[h.name].kind == KIND_RO:
+            # Never-cloned rule (cloning.cpp:62-288): read-only leaves are
+            # unprotectable; flips into them corrupt the oracle itself.
+            # Their harm stays in the residual -- a tight target may be
+            # unreachable, exactly as on the reference.
+            continue
+        protect_set = _sor_closure(region, flow, protect_set | {h.name})
+        residual = sum(x.sdc + x.due for x in harms
+                       if x.name not in protect_set)
+
+    annotations = {name: dataclasses.replace(region.spec[name],
+                                             xmr=(name in protect_set))
+                   for name in region.spec}
+    advice = Advice(
+        region_name=region.name,
+        target_sdc=target_sdc,
+        ranked=harms,
+        # protect lists the full closed set (harm-table order first, then
+        # any closure members outside it, e.g. non-injectable leaves), so
+        # config_text round-trips to exactly the validated scope.
+        protect=([h.name for h in harms if h.name in protect_set]
+                 + sorted(protect_set - set(by_name))),
+        annotations=annotations,
+        baseline=base.summary(),
+        protected_words=sum(by_name[n].words for n in protect_set
+                            if n in by_name),
+        total_words=sum(h.words for h in harms),
+    )
+
+    if validate and protect_set:
+        sel_prog = TMR(_selective_region(region, protect_set))
+        sel = CampaignRunner(sel_prog, strategy_name="TMR-selective").run(
+            budget, seed=seed, batch_size=batch_size)
+        advice.achieved = sel.summary()
+        full = CampaignRunner(TMR(region), strategy_name="TMR").run(
+            budget, seed=seed, batch_size=batch_size)
+        advice.full = full.summary()
+    return advice
+
+
+def main(argv=None) -> int:
+    """``python -m coast_tpu.analysis.advisor <benchmark> [-e N] [-t RATE]
+    [--seed S] [-o functions.config]`` -- recommend a selective scope for
+    a registered benchmark and optionally write the config snippet."""
+    import argparse
+    import sys
+
+    from coast_tpu.models import REGISTRY
+
+    ap = argparse.ArgumentParser(
+        prog="coast_tpu.analysis.advisor",
+        description="data-driven selective-xMR scope recommendation")
+    ap.add_argument("benchmark", choices=sorted(REGISTRY))
+    ap.add_argument("-e", type=int, default=8192, metavar="N",
+                    help="injection budget (default 8192)")
+    ap.add_argument("-t", type=float, default=0.0, metavar="RATE",
+                    help="target residual harm rate (default 0: minimal)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip the selective/full TMR validation campaigns")
+    ap.add_argument("-o", metavar="PATH",
+                    help="write the functions.config snippet here")
+    args = ap.parse_args(argv)
+
+    import jax
+    if __import__("os").environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    adv = advise(REGISTRY[args.benchmark](), budget=args.e,
+                 target_sdc=args.t, seed=args.seed,
+                 validate=not args.no_validate)
+    print(adv.format())
+    if args.o:
+        with open(args.o, "w") as f:
+            f.write(adv.config_text)
+        print(f"wrote {args.o}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

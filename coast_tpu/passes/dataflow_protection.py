@@ -536,7 +536,7 @@ class ProtectedProgram:
     def run(self, fault: Optional[Dict[str, jax.Array]] = None,
             trace: bool = False,
             return_state: bool = False,
-            unroll: Optional[int] = None) -> Dict[str, jax.Array]:
+            unroll: int = 1) -> Dict[str, jax.Array]:
         """Run to completion; optionally XOR one bit at step ``fault['t']``.
 
         ``fault`` keys: leaf_id, lane, word, bit, t (int32 scalars).  Returns
@@ -614,7 +614,7 @@ class ProtectedProgram:
                 out, _ = body((pstate, flags), t)
                 return out
 
-            unroll_n = max(1, int(unroll)) if unroll is not None else 1
+            unroll_n = max(1, int(unroll))
             limit = jnp.int32(self.region.max_steps)
 
             def cond(carry):
