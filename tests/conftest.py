@@ -22,4 +22,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the benchmark/CHStone matrices compile
+# the same protected programs on every run (module-scope jit per strategy
+# per region dominated the full tier's ~17 min); cached executables cut
+# repeat runs to the execution time.  Repo-local and gitignored.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
