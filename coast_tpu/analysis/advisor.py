@@ -8,7 +8,7 @@ makes that loop automatic: inject into the *unprotected* program, attribute
 SDC/DUE outcomes to the state leaf that was hit (the per-symbol attribution
 of jsonParser.py:340-455), and greedily protect the highest-harm leaves --
 closed over the SoR rules so the verifier accepts the result -- until a
-target residual SDC rate is met.  The output is both region annotations and
+target residual harm rate (SDC + DUE + INVALID) is met.  The output is both region annotations and
 a functions.config-compatible snippet (``cloneGlbls=``/``ignoreGlbls=``),
 so the recommendation plugs straight into the reference-style interface
 layer.
@@ -39,19 +39,26 @@ class LeafHarm:
     injections: int
     sdc: int
     due: int
+    invalid: int
     words: int
 
     @property
+    def harm(self) -> int:
+        """Bad outcomes attributed to this leaf.  INVALID counts: a flip
+        that corrupts the check machinery itself (classify.py) is still a
+        corruption that protection would have masked."""
+        return self.sdc + self.due + self.invalid
+
+    @property
     def harm_rate(self) -> float:
-        """P(SDC or DUE | flip lands in this leaf)."""
-        return (self.sdc + self.due) / self.injections if self.injections \
-            else 0.0
+        """P(SDC, DUE or INVALID | flip lands in this leaf)."""
+        return self.harm / self.injections if self.injections else 0.0
 
 
 @dataclasses.dataclass
 class Advice:
     region_name: str
-    target_sdc: float
+    target_harm: float
     ranked: List[LeafHarm]              # harm-descending attribution table
     protect: List[str]                  # leaves to replicate (SoR-closed)
     annotations: Dict[str, LeafSpec]    # selective spec (xmr islands)
@@ -73,19 +80,21 @@ class Advice:
     def format(self) -> str:
         lines = [f"--- selective-hardening advice: {self.region_name} ---",
                  f"  {'leaf':<18} {'inj':>6} {'sdc':>6} {'due':>5} "
-                 f"{'words':>6}  harm%  protect"]
+                 f"{'inv':>5} {'words':>6}  harm%  protect"]
         for h in self.ranked:
             mark = "xMR" if h.name in self.protect else "-"
             lines.append(
                 f"  {h.name:<18} {h.injections:>6} {h.sdc:>6} {h.due:>5} "
-                f"{h.words:>6}  {100 * h.harm_rate:5.1f}  {mark}")
+                f"{h.invalid:>5} {h.words:>6}  {100 * h.harm_rate:5.1f}  "
+                f"{mark}")
         lines.append(f"  replicated words: {self.protected_words}"
                      f"/{self.total_words}")
 
         def rate(s):
             n = s["injections"]
-            return (s["sdc"] + s["due_abort"] + s["due_timeout"]) / n if n \
-                else 0.0
+            bad = (s["sdc"] + s["due_abort"] + s["due_timeout"]
+                   + s["invalid"])
+            return bad / n if n else 0.0
 
         lines.append(f"  unprotected harm rate: {100 * rate(self.baseline):.2f}%")
         if self.achieved is not None:
@@ -108,6 +117,7 @@ def _leaf_harms(res: CampaignResult, runner: CampaignRunner) -> List[LeafHarm]:
             injections=int(len(sel)),
             sdc=int(binc[cls.SDC]),
             due=int(binc[cls.DUE_ABORT] + binc[cls.DUE_TIMEOUT]),
+            invalid=int(binc[cls.INVALID]),
             words=int(sec.words * sec.lanes)))
     harms.sort(key=lambda h: (-h.harm_rate, h.name))
     return harms
@@ -147,7 +157,7 @@ def _selective_region(region: Region, protect_set: FrozenSet[str]) -> Region:
 
 def advise(region: Region,
            budget: int = 8192,
-           target_sdc: float = 0.0,
+           target_harm: float = 0.0,
            seed: int = 0,
            batch_size: int = 2048,
            validate: bool = True) -> Advice:
@@ -155,7 +165,7 @@ def advise(region: Region,
 
     ``budget`` faults are injected into the unprotected program; leaves are
     protected greedily by harm contribution (SoR-closed at every step)
-    until the *predicted* residual harm rate is <= ``target_sdc``.
+    until the *predicted* residual harm rate is <= ``target_harm``.
     ``validate=True`` re-runs the campaign against the recommended
     selective TMR and full TMR for the achieved rates.
     """
@@ -166,16 +176,16 @@ def advise(region: Region,
     flow = analyze(region)
 
     protect_set: FrozenSet[str] = frozenset()
-    residual = sum(h.sdc + h.due for h in harms)
+    residual = sum(h.harm for h in harms)
     by_name = {h.name: h for h in harms}
-    # Greedy by absolute harm *contribution* (sdc+due counts), not the
+    # Greedy by absolute harm *contribution* (bad-outcome counts), not the
     # conditional rate: a leaf hit twice with 100% harm contributes less
     # campaign harm than a large leaf at 30%, and protecting it first
     # would inflate the scope for no residual benefit.
-    for h in sorted(harms, key=lambda x: (-(x.sdc + x.due), x.name)):
-        if total_inj and residual / total_inj <= target_sdc:
+    for h in sorted(harms, key=lambda x: (-x.harm, x.name)):
+        if total_inj and residual / total_inj <= target_harm:
             break
-        if h.sdc + h.due == 0:
+        if h.harm == 0:
             break
         if h.name in protect_set or h.name not in region.spec:
             continue
@@ -186,15 +196,13 @@ def advise(region: Region,
             # unreachable, exactly as on the reference.
             continue
         protect_set = _sor_closure(region, flow, protect_set | {h.name})
-        residual = sum(x.sdc + x.due for x in harms
+        residual = sum(x.harm for x in harms
                        if x.name not in protect_set)
 
-    annotations = {name: dataclasses.replace(region.spec[name],
-                                             xmr=(name in protect_set))
-                   for name in region.spec}
+    annotations = _selective_region(region, protect_set).spec
     advice = Advice(
         region_name=region.name,
-        target_sdc=target_sdc,
+        target_harm=target_harm,
         ranked=harms,
         # protect lists the full closed set (harm-table order first, then
         # any closure members outside it, e.g. non-injectable leaves), so
@@ -235,7 +243,7 @@ def main(argv=None) -> int:
     ap.add_argument("-e", type=int, default=8192, metavar="N",
                     help="injection budget (default 8192)")
     ap.add_argument("-t", type=float, default=0.0, metavar="RATE",
-                    help="target residual harm rate (default 0: minimal)")
+                    help="target residual harm rate, SDC+DUE+INVALID (default 0: minimal)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-validate", action="store_true",
                     help="skip the selective/full TMR validation campaigns")
@@ -248,7 +256,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     adv = advise(REGISTRY[args.benchmark](), budget=args.e,
-                 target_sdc=args.t, seed=args.seed,
+                 target_harm=args.t, seed=args.seed,
                  validate=not args.no_validate)
     print(adv.format())
     if args.o:

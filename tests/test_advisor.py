@@ -21,7 +21,7 @@ def region():
 
 @pytest.fixture(scope="module")
 def advice(region):
-    return advise(region, budget=2048, target_sdc=0.02, batch_size=1024)
+    return advise(region, budget=2048, target_harm=0.02, batch_size=1024)
 
 
 def test_ro_leaves_never_protected(advice, region):
@@ -48,8 +48,9 @@ def test_closure_pulls_mutable_sources_and_ctrl(region):
 
 def test_validation_improves_harm_rate(advice):
     def rate(s):
-        return (s["sdc"] + s["due_abort"] + s["due_timeout"]) \
-            / s["injections"]
+        # Same harm metric the advisor optimizes: SDC + DUE + INVALID.
+        return (s["sdc"] + s["due_abort"] + s["due_timeout"]
+                + s["invalid"]) / s["injections"]
     assert advice.achieved is not None and advice.full is not None
     assert rate(advice.achieved) < rate(advice.baseline)
     # The selective config can never beat full TMR by more than noise, and
@@ -59,9 +60,9 @@ def test_validation_improves_harm_rate(advice):
 
 
 def test_generous_target_protects_less(region):
-    adv = advise(region, budget=2048, target_sdc=0.5, batch_size=1024,
+    adv = advise(region, budget=2048, target_harm=0.5, batch_size=1024,
                  validate=False)
-    full = advise(region, budget=2048, target_sdc=0.0, batch_size=1024,
+    full = advise(region, budget=2048, target_harm=0.0, batch_size=1024,
                   validate=False)
     assert set(adv.protect) <= set(full.protect)
     assert len(adv.protect) < len(full.protect)
