@@ -24,8 +24,11 @@ from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import numpy as np
 
+import math
+
 from coast_tpu.inject import classify as cls
 from coast_tpu.inject.campaign import CampaignResult, CampaignRunner
+from coast_tpu.inject.schedule import generate_stratified
 from coast_tpu.ir.region import KIND_CTRL, KIND_RO, LeafSpec, Region
 from coast_tpu.passes.strategies import TMR, unprotected
 from coast_tpu.passes.verification import RegionDataflow, analyze
@@ -54,6 +57,21 @@ class LeafHarm:
         """P(SDC, DUE or INVALID | flip lands in this leaf)."""
         return self.harm / self.injections if self.injections else 0.0
 
+    @property
+    def harm_ci95(self) -> Tuple[float, float]:
+        """Wilson 95% interval on harm_rate -- honest uncertainty for the
+        leaves a size-weighted campaign would have starved."""
+        n = self.injections
+        if not n:
+            return (0.0, 1.0)
+        z = 1.959963984540054
+        phat = self.harm / n
+        denom = 1 + z * z / n
+        centre = phat + z * z / (2 * n)
+        half = z * math.sqrt(phat * (1 - phat) / n + z * z / (4 * n * n))
+        return (max(0.0, (centre - half) / denom),
+                min(1.0, (centre + half) / denom))
+
 
 @dataclasses.dataclass
 class Advice:
@@ -67,6 +85,7 @@ class Advice:
     full: Optional[Dict[str, object]] = None       # full TMR summary
     protected_words: int = 0
     total_words: int = 0
+    baseline_rate: float = 0.0          # post-stratified population estimate
 
     @property
     def config_text(self) -> str:
@@ -80,13 +99,14 @@ class Advice:
     def format(self) -> str:
         lines = [f"--- selective-hardening advice: {self.region_name} ---",
                  f"  {'leaf':<18} {'inj':>6} {'sdc':>6} {'due':>5} "
-                 f"{'inv':>5} {'words':>6}  harm%  protect"]
+                 f"{'inv':>5} {'words':>6}  harm% (95% CI)      protect"]
         for h in self.ranked:
             mark = "xMR" if h.name in self.protect else "-"
+            lo, hi = h.harm_ci95
             lines.append(
                 f"  {h.name:<18} {h.injections:>6} {h.sdc:>6} {h.due:>5} "
-                f"{h.invalid:>5} {h.words:>6}  {100 * h.harm_rate:5.1f}  "
-                f"{mark}")
+                f"{h.invalid:>5} {h.words:>6}  {100 * h.harm_rate:5.1f} "
+                f"[{100 * lo:4.1f},{100 * hi:5.1f}]  {mark}")
         lines.append(f"  replicated words: {self.protected_words}"
                      f"/{self.total_words}")
 
@@ -96,7 +116,9 @@ class Advice:
                    + s["invalid"])
             return bad / n if n else 0.0
 
-        lines.append(f"  unprotected harm rate: {100 * rate(self.baseline):.2f}%")
+        lines.append(f"  unprotected harm rate: "
+                     f"{100 * self.baseline_rate:.2f}% "
+                     f"(post-stratified estimate)")
         if self.achieved is not None:
             lines.append(f"  selective TMR harm rate: "
                          f"{100 * rate(self.achieved):.2f}%")
@@ -160,30 +182,53 @@ def advise(region: Region,
            target_harm: float = 0.0,
            seed: int = 0,
            batch_size: int = 2048,
-           validate: bool = True) -> Advice:
+           validate: bool = True,
+           stratified: bool = True) -> Advice:
     """Recommend a selective xMR scope for ``region``.
 
-    ``budget`` faults are injected into the unprotected program; leaves are
-    protected greedily by harm contribution (SoR-closed at every step)
-    until the *predicted* residual harm rate is <= ``target_harm``.
-    ``validate=True`` re-runs the campaign against the recommended
-    selective TMR and full TMR for the achieved rates.
+    ``budget`` faults are injected into the unprotected program
+    (equal-allocation stratified across leaves by default, so small
+    control words are measured as well as large buffers); leaves are
+    protected greedily by population harm contribution (SoR-closed at
+    every step) until the post-stratified residual harm rate is <=
+    ``target_harm``.  ``validate=True`` re-runs the campaign against the
+    recommended selective TMR and full TMR for the achieved rates.
     """
     runner = CampaignRunner(unprotected(region), strategy_name="none")
-    base = runner.run(budget, seed=seed, batch_size=batch_size)
+    if stratified:
+        # Equal-allocation stratified attribution: every leaf measured at
+        # the same resolution (size-weighted sampling starves 1-word ctrl
+        # leaves next to KiB buffers); population rates recovered below by
+        # size-reweighting (post-stratification).
+        n_per = max(1, budget // max(1, len(runner.mmap.sections)))
+        sched = generate_stratified(runner.mmap, n_per, seed,
+                                    region.nominal_steps)
+        base = runner.run_schedule(sched, batch_size)
+    else:
+        base = runner.run(budget, seed=seed, batch_size=batch_size)
     harms = _leaf_harms(base, runner)
-    total_inj = sum(h.injections for h in harms)
     flow = analyze(region)
 
+    # Post-stratified population estimate: weight each leaf's conditional
+    # harm rate by its share of the injectable bit space.  Exact for
+    # stratified campaigns and consistent with the count ratio for
+    # size-weighted ones.
+    weight = {s.name: s.bits / runner.mmap.total_bits
+              for s in runner.mmap.sections}
+
+    def pop_rate(excluded: FrozenSet[str]) -> float:
+        return sum(weight[h.name] * h.harm_rate for h in harms
+                   if h.name not in excluded)
+
     protect_set: FrozenSet[str] = frozenset()
-    residual = sum(h.harm for h in harms)
     by_name = {h.name: h for h in harms}
-    # Greedy by absolute harm *contribution* (bad-outcome counts), not the
-    # conditional rate: a leaf hit twice with 100% harm contributes less
-    # campaign harm than a large leaf at 30%, and protecting it first
+    # Greedy by population harm *contribution* (weight x rate), not the
+    # conditional rate: a 1-word leaf at 100% harm contributes less
+    # campaign harm than a KiB buffer at 30%, and protecting it first
     # would inflate the scope for no residual benefit.
-    for h in sorted(harms, key=lambda x: (-x.harm, x.name)):
-        if total_inj and residual / total_inj <= target_harm:
+    for h in sorted(harms,
+                    key=lambda x: (-weight[x.name] * x.harm_rate, x.name)):
+        if pop_rate(protect_set) <= target_harm:
             break
         if h.harm == 0:
             break
@@ -196,8 +241,6 @@ def advise(region: Region,
             # unreachable, exactly as on the reference.
             continue
         protect_set = _sor_closure(region, flow, protect_set | {h.name})
-        residual = sum(x.harm for x in harms
-                       if x.name not in protect_set)
 
     annotations = _selective_region(region, protect_set).spec
     advice = Advice(
@@ -214,6 +257,7 @@ def advise(region: Region,
         protected_words=sum(by_name[n].words for n in protect_set
                             if n in by_name),
         total_words=sum(h.words for h in harms),
+        baseline_rate=pop_rate(frozenset()),
     )
 
     if validate and protect_set:

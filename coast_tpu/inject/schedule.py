@@ -60,3 +60,39 @@ def generate(mmap: MemoryMap, n: int, seed: int,
     leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
     return FaultSchedule(leaf_id, lane, word, bit, t,
                          sec_idx.astype(np.int32), seed)
+
+
+def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
+                        nominal_steps: int) -> FaultSchedule:
+    """n_per_section seeded draws into EACH section (equal-allocation
+    stratified sampling).
+
+    Size-weighted sampling (``generate``) starves small sections: a 1-word
+    loop counter next to a KiB-scale buffer draws a handful of injections
+    per campaign, so its estimated harm rate is noise -- yet control words
+    are exactly the high-leverage targets.  Equal allocation measures every
+    section at the same resolution; population-level rates are recovered by
+    size-reweighting (post-stratification), which is how the advisor uses
+    it.  Rows are ordered section-major and deterministic per seed; each
+    section's sub-stream is keyed by a splitmix draw from the master seed
+    (not seed+idx, which would make adjacent master seeds share stream
+    bits shifted one section over), so campaigns replay per stratum and
+    different master seeds are decorrelated."""
+    keys = splitmix_fill(seed, len(mmap.sections))
+    parts = []
+    for idx, sec in enumerate(mmap.sections):
+        raw = splitmix_fill(int(keys[idx]), 2 * n_per_section)
+        offs = (raw[:n_per_section] % np.uint64(sec.bits)).astype(np.int64)
+        t = (raw[n_per_section:]
+             % np.uint64(max(nominal_steps, 1))).astype(np.int32)
+        words_bits = sec.words * 32
+        parts.append((
+            np.full(n_per_section, sec.leaf_id, np.int32),
+            (offs // words_bits).astype(np.int32),           # lane
+            ((offs % words_bits) // 32).astype(np.int32),    # word
+            (offs % 32).astype(np.int32),                    # bit
+            t,
+            np.full(n_per_section, idx, np.int32),
+        ))
+    return FaultSchedule(*[np.concatenate(cols) for cols in zip(*parts)],
+                         seed=seed)

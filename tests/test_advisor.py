@@ -80,3 +80,41 @@ def test_report_format(advice):
     assert "selective-hardening advice" in out
     assert "unprotected harm rate" in out
     assert "selective TMR harm rate" in out
+
+
+def test_stratified_schedule_equal_allocation(region):
+    from coast_tpu import unprotected
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.schedule import generate_stratified
+    import numpy as np
+
+    runner = CampaignRunner(unprotected(region))
+    sched = generate_stratified(runner.mmap, 64, seed=5,
+                                nominal_steps=region.nominal_steps)
+    counts = np.bincount(sched.section_idx)
+    assert (counts == 64).all()
+    # rows stay within each section's address space
+    for sec in runner.mmap.sections:
+        rows = sched.leaf_id == sec.leaf_id
+        assert (sched.lane[rows] < sec.lanes).all()
+        assert (sched.word[rows] < sec.words).all()
+        assert (sched.bit[rows] < 32).all()
+    # deterministic per seed
+    again = generate_stratified(runner.mmap, 64, seed=5,
+                                nominal_steps=region.nominal_steps)
+    assert (again.word == sched.word).all() and (again.t == sched.t).all()
+    other = generate_stratified(runner.mmap, 64, seed=6,
+                                nominal_steps=region.nominal_steps)
+    assert not (other.word == sched.word).all()
+
+
+def test_stratified_measures_small_leaves(region):
+    """The point of stratification: 1-word control leaves get the same
+    sample count as the 81-word matrices (size-weighted sampling gave
+    them a handful of draws per campaign)."""
+    adv = advise(region, budget=1024, validate=False)
+    by_name = {h.name: h for h in adv.ranked}
+    assert by_name["i"].injections == by_name["first"].injections
+    assert by_name["i"].injections >= 16
+    lo, hi = by_name["i"].harm_ci95
+    assert 0.0 <= lo <= hi <= 1.0 and hi - lo < 0.5
