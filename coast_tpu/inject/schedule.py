@@ -79,20 +79,18 @@ def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
     bits shifted one section over), so campaigns replay per stratum and
     different master seeds are decorrelated."""
     keys = splitmix_fill(seed, len(mmap.sections))
-    parts = []
+    section_start = np.cumsum([0] + [s.bits for s in mmap.sections])
+    flat_parts = []
+    t_parts = []
     for idx, sec in enumerate(mmap.sections):
         raw = splitmix_fill(int(keys[idx]), 2 * n_per_section)
         offs = (raw[:n_per_section] % np.uint64(sec.bits)).astype(np.int64)
-        t = (raw[n_per_section:]
-             % np.uint64(max(nominal_steps, 1))).astype(np.int32)
-        words_bits = sec.words * 32
-        parts.append((
-            np.full(n_per_section, sec.leaf_id, np.int32),
-            (offs // words_bits).astype(np.int32),           # lane
-            ((offs % words_bits) // 32).astype(np.int32),    # word
-            (offs % 32).astype(np.int32),                    # bit
-            t,
-            np.full(n_per_section, idx, np.int32),
-        ))
-    return FaultSchedule(*[np.concatenate(cols) for cols in zip(*parts)],
-                         seed=seed)
+        t_parts.append((raw[n_per_section:]
+                        % np.uint64(max(nominal_steps, 1))).astype(np.int32))
+        flat_parts.append(section_start[idx] + offs)
+    # One source of truth for the bit layout: per-section offsets become
+    # global flat indices and go through the same decode as generate().
+    leaf_id, lane, word, bit, sec_idx = mmap.decode(
+        np.concatenate(flat_parts))
+    return FaultSchedule(leaf_id, lane, word, bit, np.concatenate(t_parts),
+                         sec_idx.astype(np.int32), seed)

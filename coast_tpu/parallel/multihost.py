@@ -1,0 +1,114 @@
+"""Multi-host (DCN) campaign scale-out.
+
+The reference scales campaigns across machines by running supervisors
+side-by-side on disjoint port ranges (supervisor.py:335,386-391) -- its
+"distributed backend" is POSIX processes + localhost TCP (SURVEY.md §5).
+The TPU-native equivalent is a multi-process JAX program: every host
+calls :func:`init_multihost`, contributes its local chips to one global
+``Mesh``, and the sharded campaign histogram (parallel/mesh.py) reduces
+with ``psum`` -- XLA routes the collective over ICI within a slice and
+DCN across hosts.  Each process sees the identical, fully-replicated
+classification counts; per-run records never cross hosts.
+
+On a real TPU pod slice ``jax.distributed.initialize()`` auto-detects
+the topology; the explicit coordinator arguments exist for CPU rehearsal
+(two localhost processes over Gloo stand in for the DCN boundary -- the
+same rehearsal role QEMU plays for the reference's boards) and for
+non-auto-provisioned clusters.
+
+Worker CLI (one invocation per host/process)::
+
+    python -m coast_tpu.parallel.multihost matrixMultiply \
+        --coordinator HOST:PORT --num-processes 2 --process-id 0 \
+        -e 4096 --seed 21
+
+Every process prints the same global counts; exit code 0 on success.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join (or auto-detect) the multi-process JAX runtime.
+
+    With no arguments this defers entirely to
+    ``jax.distributed.initialize()`` auto-detection (TPU pods).  Passing
+    the coordinator triple runs the explicit bootstrap used by the CPU
+    rehearsal and by clusters without an auto-provisioner.
+    """
+    import jax
+
+    if coordinator_address is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from coast_tpu.models import REGISTRY
+
+    ap = argparse.ArgumentParser(
+        prog="coast_tpu.parallel.multihost",
+        description="one worker of a multi-host sharded fault-injection "
+                    "campaign; run once per host/process")
+    ap.add_argument("benchmark", choices=sorted(REGISTRY))
+    ap.add_argument("--coordinator", metavar="HOST:PORT",
+                    help="coordinator address (omit on TPU pods: "
+                         "auto-detected)")
+    ap.add_argument("--num-processes", type=int)
+    ap.add_argument("--process-id", type=int)
+    ap.add_argument("--local-devices", type=int, default=0,
+                    help="force N virtual CPU devices per process "
+                         "(rehearsal mode; 0 = real devices)")
+    ap.add_argument("-e", type=int, default=4096, metavar="N",
+                    help="total injections across all hosts")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--strategy", default="TMR", choices=("TMR", "DWC"))
+    args = ap.parse_args(argv)
+
+    if args.local_devices:
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.local_devices}").strip()
+
+    import jax
+
+    if args.local_devices:
+        # Rehearsal runs on the CPU backend regardless of the site hook's
+        # platform selection (see opt.py:174-179).
+        jax.config.update("jax_platforms", "cpu")
+    init_multihost(args.coordinator, args.num_processes, args.process_id)
+
+    from coast_tpu.parallel.mesh import ShardedCampaignRunner, make_mesh
+    from coast_tpu.passes.strategies import DWC, TMR
+
+    region = REGISTRY[args.benchmark]()
+    prog = (TMR if args.strategy == "TMR" else DWC)(region)
+    mesh = make_mesh(len(jax.devices()))
+    runner = ShardedCampaignRunner(prog, mesh,
+                                   strategy_name=args.strategy)
+    counts = runner.run_histogram(args.e, seed=args.seed,
+                                  batch_size=args.batch_size)
+    # Every process holds the identical psum'd histogram; print with the
+    # process id so a launcher can assert cross-host agreement.
+    print(f"[proc {jax.process_index()}/{jax.process_count()}] "
+          f"devices={len(jax.devices())} counts={counts}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
