@@ -28,7 +28,7 @@ import math
 
 from coast_tpu.inject import classify as cls
 from coast_tpu.inject.campaign import CampaignResult, CampaignRunner
-from coast_tpu.inject.schedule import generate_stratified
+from coast_tpu.inject.schedule import generate_stratified_total
 from coast_tpu.ir.region import KIND_CTRL, KIND_RO, LeafSpec, Region
 from coast_tpu.passes.strategies import TMR, unprotected
 from coast_tpu.passes.verification import RegionDataflow, analyze
@@ -200,9 +200,8 @@ def advise(region: Region,
         # the same resolution (size-weighted sampling starves 1-word ctrl
         # leaves next to KiB buffers); population rates recovered below by
         # size-reweighting (post-stratification).
-        n_per = max(1, budget // max(1, len(runner.mmap.sections)))
-        sched = generate_stratified(runner.mmap, n_per, seed,
-                                    region.nominal_steps)
+        sched = generate_stratified_total(runner.mmap, budget, seed,
+                                          region.nominal_steps)
         base = runner.run_schedule(sched, batch_size)
     else:
         base = runner.run(budget, seed=seed, batch_size=batch_size)

@@ -94,3 +94,14 @@ def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
         np.concatenate(flat_parts))
     return FaultSchedule(leaf_id, lane, word, bit, np.concatenate(t_parts),
                          sec_idx.astype(np.int32), seed)
+
+
+def generate_stratified_total(mmap: MemoryMap, total: int, seed: int,
+                              nominal_steps: int) -> FaultSchedule:
+    """Stratified schedule sized by a total budget: ``total`` is divided
+    equally across sections, floored at one draw per section, so the
+    actual campaign size is ``max(1, total // n_sections) * n_sections``
+    (callers report len(schedule), which may round away from ``total``).
+    Single allocation policy shared by the advisor and the supervisor."""
+    n_per = max(1, total // len(mmap.sections))
+    return generate_stratified(mmap, n_per, seed, nominal_steps)

@@ -85,6 +85,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         help="resume the seeded campaign at injection "
                         "#N (gdbClient.py:401 --start-num analogue)")
     parser.add_argument("--batch-size", type=int, default=4096)
+    parser.add_argument("--stratified", action="store_true",
+                        help="equal-allocation sampling per section: -t "
+                        "is divided across sections (floored at 1 each, "
+                        "so the actual count is reported in the summary); "
+                        "small sections are measured at the same "
+                        "resolution as large ones")
     parser.add_argument("--log-format", type=str, default="json",
                         choices=["json", "ndjson", "columnar"],
                         help="log writer: json = reference InjectionLog "
@@ -94,6 +100,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
 
     if args.board in ("pynq", "hifive1"):
         print("This board not yet supported in this version", file=sys.stderr)
+        sys.exit(-1)
+    if args.stratified and (args.errorCount or args.section in (
+            "cache", "icache", "dcache", "l2cache")):
+        print("Error, --stratified cannot be combined with -e/--errorCount "
+              "or cache sections (those draw their own schedules)",
+              file=sys.stderr)
         sys.exit(-1)
     if args.errorCount and args.start_num:
         # Hard error beats a silently ignored resume point: the
@@ -209,6 +221,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.errorCount:
         res = runner.run_until_errors(args.errorCount, seed=args.seed,
                                       batch_size=args.batch_size)
+    elif args.stratified:
+        from coast_tpu.inject.schedule import generate_stratified_total
+        if args.start_num:
+            print("Error, --start-num cannot be combined with --stratified "
+                  "(strata are separately seeded streams)", file=sys.stderr)
+            return 2
+        sched = generate_stratified_total(mmap, args.t, args.seed,
+                                          prog.region.nominal_steps)
+        res = runner.run_schedule(sched, batch_size=args.batch_size)
     else:
         res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size,
                          start_num=args.start_num)

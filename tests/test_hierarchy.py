@@ -193,3 +193,18 @@ def test_supervisor_rejects_unsupported_board():
 def test_supervisor_rejects_unknown_benchmark():
     with pytest.raises(SystemExit):
         supervisor_main(["-f", "noSuchBench", "-d", "cpu"])
+
+
+def test_supervisor_stratified_campaign(capsys):
+    rc = supervisor_main(["-f", "crc16", "-t", "64", "--stratified",
+                          "--no-logging", "-O", "-TMR -countErrors"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "'injections':" in out
+
+
+def test_supervisor_stratified_rejects_start_num(capsys):
+    rc = supervisor_main(["-f", "crc16", "-t", "64", "--stratified",
+                          "--start-num", "10", "--no-logging",
+                          "-O", "-TMR -countErrors"])
+    assert rc == 2
