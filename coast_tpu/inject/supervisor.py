@@ -101,10 +101,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
     if args.board in ("pynq", "hifive1"):
         print("This board not yet supported in this version", file=sys.stderr)
         sys.exit(-1)
-    if args.stratified and (args.errorCount or args.section in (
-            "cache", "icache", "dcache", "l2cache")):
-        print("Error, --stratified cannot be combined with -e/--errorCount "
-              "or cache sections (those draw their own schedules)",
+    if args.stratified and (args.errorCount or args.start_num
+                            or args.section in ("cache", "icache", "dcache",
+                                                "l2cache")):
+        print("Error, --stratified cannot be combined with -e/--errorCount, "
+              "--start-num, or cache sections (those draw their own "
+              "schedules; strata are separately seeded streams)",
               file=sys.stderr)
         sys.exit(-1)
     if args.errorCount and args.start_num:
@@ -223,13 +225,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                                       batch_size=args.batch_size)
     elif args.stratified:
         from coast_tpu.inject.schedule import generate_stratified_total
-        if args.start_num:
-            print("Error, --start-num cannot be combined with --stratified "
-                  "(strata are separately seeded streams)", file=sys.stderr)
-            return 2
         sched = generate_stratified_total(mmap, args.t, args.seed,
                                           prog.region.nominal_steps)
-        res = runner.run_schedule(sched, batch_size=args.batch_size)
+        res = runner.run_schedule(
+            sched, batch_size=min(args.batch_size, len(sched)))
     else:
         res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size,
                          start_num=args.start_num)

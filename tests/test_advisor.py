@@ -118,3 +118,23 @@ def test_stratified_measures_small_leaves(region):
     assert by_name["i"].injections >= 16
     lo, hi = by_name["i"].harm_ci95
     assert 0.0 <= lo <= hi <= 1.0 and hi - lo < 0.5
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bench", [
+    "aes", "cache_test", "crc16", "quicksort", "sha256", "towersOfHanoi",
+    "schedule2", "simd", "scalarize", "crazyCF", "whetstone", "trivial",
+    "simpleTMR", "helloWorld", "nestedCalls", "rtos_app",
+])
+def test_advisor_sweep_builds_everywhere(bench):
+    """The SoR closure must hold for every region shape in the corpus:
+    whatever the greedy picks, the selective program must construct
+    (verifier-accepted).  CHStone soft-float kernels are exercised by
+    their own tier; their multi-minute CPU campaigns stay out of here."""
+    from coast_tpu.models import REGISTRY
+    region = REGISTRY[bench]()
+    adv = advise(region, budget=256, validate=False, batch_size=256)
+    TMR(_selective_region(region, frozenset(adv.protect)))  # no raise
+    assert adv.ranked
+    for h in adv.ranked:
+        assert 0 <= h.harm <= h.injections

@@ -204,7 +204,7 @@ def test_supervisor_stratified_campaign(capsys):
 
 
 def test_supervisor_stratified_rejects_start_num(capsys):
-    rc = supervisor_main(["-f", "crc16", "-t", "64", "--stratified",
-                          "--start-num", "10", "--no-logging",
-                          "-O", "-TMR -countErrors"])
-    assert rc == 2
+    with pytest.raises(SystemExit):
+        supervisor_main(["-f", "crc16", "-t", "64", "--stratified",
+                         "--start-num", "10", "--no-logging",
+                         "-O", "-TMR -countErrors"])
