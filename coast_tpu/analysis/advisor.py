@@ -183,7 +183,8 @@ def advise(region: Region,
            seed: int = 0,
            batch_size: int = 2048,
            validate: bool = True,
-           stratified: bool = True) -> Advice:
+           stratified: bool = True,
+           cost_aware: bool = False) -> Advice:
     """Recommend a selective xMR scope for ``region``.
 
     ``budget`` faults are injected into the unprotected program
@@ -191,8 +192,11 @@ def advise(region: Region,
     control words are measured as well as large buffers); leaves are
     protected greedily by population harm contribution (SoR-closed at
     every step) until the post-stratified residual harm rate is <=
-    ``target_harm``.  ``validate=True`` re-runs the campaign against the
-    recommended selective TMR and full TMR for the achieved rates.
+    ``target_harm``.  ``cost_aware=True`` switches the greedy to marginal
+    harm removed per replicated word added (the MWTF-shaped ordering),
+    which can reach the same target with a smaller replication footprint.
+    ``validate=True`` re-runs the campaign against the recommended
+    selective TMR and full TMR for the achieved rates.
     """
     runner = CampaignRunner(unprotected(region), strategy_name="none")
     if stratified:
@@ -224,25 +228,54 @@ def advise(region: Region,
 
     protect_set: FrozenSet[str] = frozenset()
     by_name = {h.name: h for h in harms}
-    # Greedy by population harm *contribution* (weight x rate), not the
-    # conditional rate: a 1-word leaf at 100% harm contributes less
-    # campaign harm than a KiB buffer at 30%, and protecting it first
-    # would inflate the scope for no residual benefit.
-    for h in sorted(harms,
-                    key=lambda x: (-weight[x.name] * x.harm_rate, x.name)):
-        if pop_rate(protect_set) <= target_harm:
-            break
-        if h.harm == 0:
-            break
-        if h.name in protect_set or h.name not in region.spec:
-            continue
-        if region.spec[h.name].kind == KIND_RO:
-            # Never-cloned rule (cloning.cpp:62-288): read-only leaves are
-            # unprotectable; flips into them corrupt the oracle itself.
-            # Their harm stays in the residual -- a tight target may be
-            # unreachable, exactly as on the reference.
-            continue
-        protect_set = _sor_closure(region, flow, protect_set | {h.name})
+
+    def protectable(h: LeafHarm) -> bool:
+        # Never-cloned rule (cloning.cpp:62-288): read-only leaves are
+        # unprotectable; flips into them corrupt the oracle itself.
+        # Their harm stays in the residual -- a tight target may be
+        # unreachable, exactly as on the reference.
+        return (h.harm > 0 and h.name in region.spec
+                and region.spec[h.name].kind != KIND_RO)
+
+    if cost_aware:
+        # MWTF-shaped greedy: each step protects the candidate whose
+        # SoR-closed addition removes the most population harm per
+        # replicated word added -- the benefit/cost ratio MWTF's
+        # (error-rate change)/(runtime change) measures after the fact
+        # (jsonParser.py:458-506).  O(n^2) closures; fine at leaf counts.
+        while pop_rate(protect_set) > target_harm:
+            cur = pop_rate(protect_set)
+            best = None
+            for h in harms:
+                if h.name in protect_set or not protectable(h):
+                    continue
+                cand = _sor_closure(region, flow, protect_set | {h.name})
+                benefit = cur - pop_rate(cand)
+                if benefit <= 0:
+                    continue
+                cost = sum(by_name[n].words for n in cand - protect_set
+                           if n in by_name)
+                score = benefit / max(cost, 1)
+                if best is None or score > best[0]:
+                    best = (score, cand)
+            if best is None:
+                break
+            protect_set = best[1]
+    else:
+        # Greedy by population harm *contribution* (weight x rate), not
+        # the conditional rate: a 1-word leaf at 100% harm contributes
+        # less campaign harm than a KiB buffer at 30%, and protecting it
+        # first would inflate the scope for no residual benefit.
+        for h in sorted(harms,
+                        key=lambda x: (-weight[x.name] * x.harm_rate,
+                                       x.name)):
+            if pop_rate(protect_set) <= target_harm:
+                break
+            if h.harm == 0:
+                break
+            if h.name in protect_set or not protectable(h):
+                continue
+            protect_set = _sor_closure(region, flow, protect_set | {h.name})
 
     annotations = _selective_region(region, protect_set).spec
     advice = Advice(
@@ -293,6 +326,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-validate", action="store_true",
                     help="skip the selective/full TMR validation campaigns")
+    ap.add_argument("--cost-aware", action="store_true",
+                    help="greedy by harm removed per replicated word "
+                         "(smaller footprint for the same target)")
     ap.add_argument("-o", metavar="PATH",
                     help="write the functions.config snippet here")
     args = ap.parse_args(argv)
@@ -303,7 +339,8 @@ def main(argv=None) -> int:
 
     adv = advise(REGISTRY[args.benchmark](), budget=args.e,
                  target_harm=args.t, seed=args.seed,
-                 validate=not args.no_validate)
+                 validate=not args.no_validate,
+                 cost_aware=args.cost_aware)
     print(adv.format())
     if args.o:
         with open(args.o, "w") as f:

@@ -138,3 +138,28 @@ def test_advisor_sweep_builds_everywhere(bench):
     assert adv.ranked
     for h in adv.ranked:
         assert 0 <= h.harm <= h.injections
+
+
+@pytest.mark.parametrize("bench", ["matrixMultiply", "quicksort"])
+def test_cost_aware_never_larger_footprint(bench):
+    """For any reachable nonzero target, the MWTF-shaped greedy meets the
+    same target with at most the default ordering's replication
+    footprint, and the recommendation still builds."""
+    from coast_tpu.models import REGISTRY
+    region = REGISTRY[bench]()
+    kw = dict(budget=512, target_harm=0.25, batch_size=512, validate=False)
+    default = advise(region, **kw)
+    cheap = advise(region, cost_aware=True, **kw)
+    assert cheap.protected_words <= default.protected_words
+    # ... and it got as close to the target as protection can: the
+    # residual is bounded by target_harm plus the unprotectable floor
+    # (read-only leaves are never-cloned; their harm cannot be removed).
+    assert cheap.protect
+    protected = set(cheap.protect)
+    total_words = sum(h.words for h in cheap.ranked)
+    resid_rate = sum((h.words / total_words) * h.harm_rate
+                     for h in cheap.ranked if h.name not in protected)
+    floor = sum((h.words / total_words) * h.harm_rate
+                for h in cheap.ranked
+                if region.spec[h.name].kind == KIND_RO)
+    assert resid_rate <= max(kw["target_harm"], floor) + 1e-9
