@@ -118,9 +118,15 @@ def worker(backend: str) -> None:
     # hardware).  Batches are capped well below the toy benchmark's: each
     # campaign holds MiBs of replica state, and oversized batches fall
     # off an HBM cliff (measured: mm256 batch 1024 -> 18 inj/s vs 256 ->
-    # 280 inj/s on v5e-lite).
-    for flag_name, batches in (("matrixMultiply256", (256, 512)),
-                               ("matrixMultiply1024", (32, 64))):
+    # 280 inj/s on v5e-lite).  Skipped whenever the RESOLVED backend is
+    # CPU (the explicit fallback attempt, or a "default" attempt that
+    # silently landed on the host): the flagships exist to measure the
+    # hardware, and their MiB-scale campaigns would eat the whole run
+    # window on a host core.
+    flagships = (() if jax.default_backend() == "cpu" else
+                 (("matrixMultiply256", (256, 512)),
+                  ("matrixMultiply1024", (32, 64))))
+    for flag_name, batches in flagships:
         flag = REGISTRY[flag_name]()
         # Flagships ship with the fused Pallas voter kernel
         # (bit-identical to the jnp voter; ~2x mm256's single-run rate).
