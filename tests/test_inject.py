@@ -222,3 +222,82 @@ def test_native_ndjson_encoder_byte_parity(region, tmp_path, monkeypatch):
     assert b"FAULT_DETECTED abort" in b
     assert b"hit step bound" in b
     assert b"self-check out of domain" in b
+
+
+def test_native_ndjson_classifier_matches_python(region, tmp_path, monkeypatch):
+    """The native log READER must agree with classify_run exactly -- every
+    class code, core-result step accounting, and the cache-invalid rows
+    whose name/symbol contain the literal string 'invalid' (the classifier
+    must only look inside the result object)."""
+    from coast_tpu import native
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject import logs
+    from coast_tpu.inject.campaign import CampaignResult
+    from coast_tpu.inject.schedule import FaultSchedule
+
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+
+    runner = CampaignRunner(TMR(region))
+    n = 18
+    sched = FaultSchedule(
+        leaf_id=np.arange(n, dtype=np.int32) % 3,
+        lane=np.arange(n, dtype=np.int32) % 3,
+        word=np.arange(n, dtype=np.int32),
+        bit=np.arange(n, dtype=np.int32) % 32,
+        t=np.where(np.arange(n) % 5 == 4, -1,
+                   np.arange(n)).astype(np.int32),
+        section_idx=np.zeros(n, np.int32), seed=9)
+    res = CampaignResult(
+        benchmark="synthetic", strategy="TMR", n=n,
+        counts={name: 3 for name in cls.CLASS_NAMES}, seconds=1.25,
+        codes=(np.arange(n, dtype=np.int32) % cls.NUM_CLASSES),
+        errors=np.arange(n, dtype=np.int32),
+        corrected=np.arange(n, dtype=np.int32) * 2,
+        steps=np.arange(n, dtype=np.int32) + 7,
+        schedule=sched, seed=9)
+    path = str(tmp_path / "clsf.json")
+    logs.write_ndjson(res, runner.mmap, path)
+
+    fast = jp._summarize_ndjson_native(path)
+    assert fast is not None
+    slow = jp.summarize_runs("clsf.json", [jp.read_json_file(path)])
+    assert fast.n == slow.n == n
+    assert fast.counts == slow.counts
+    assert fast.mean_steps == slow.mean_steps
+    assert fast.seconds == slow.seconds
+    # summarize_path routes through the fast path and agrees too
+    assert jp.summarize_path(path).counts == slow.counts
+    # a non-InjectionLog ndjson file cleanly refuses the fast path
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"summary": {"format": "ndjson"}}) +
+                   "\n{\"not\": \"a run\"}\n")
+    assert jp._summarize_ndjson_native(str(bad)) is None
+
+
+def test_native_classifier_adversarial_leaf_name(tmp_path):
+    """A JSON-escaped leaf name containing the literal bytes of the
+    result-field marker (and a discriminating key) must not shift the
+    classifier's anchor: the real result object is the last field before
+    the cacheInfo tail."""
+    from coast_tpu import native
+    from coast_tpu.analysis import json_parser as jp
+
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+    line = ('{"timestamp": "t", "number": 0, "section": "mem", '
+            '"address": 0, "oldValue": null, "newValue": null, '
+            '"sleepTime": 0, "cycles": 1, "PC": 1, '
+            '"name": "x \\"result\\": {\\"invalid\\": 0} y", '
+            '"symbol": "x", "result": {"timestamp": "t", "core": 0, '
+            '"runtime": 9, "errors": 0, "faults": 2}, "cacheInfo": null}')
+    path = tmp_path / "adv.json"
+    path.write_text(json.dumps({"summary": {"format": "ndjson",
+                                            "seconds": 0.5}}) + "\n"
+                    + line + "\n")
+    fast = jp._summarize_ndjson_native(str(path))
+    slow = jp.summarize_runs("adv", [jp.read_json_file(str(path))])
+    assert fast is not None
+    assert fast.counts == slow.counts
+    assert fast.counts["corrected"] == 1 and fast.counts["invalid"] == 0
+    assert fast.mean_steps == slow.mean_steps == 9.0
