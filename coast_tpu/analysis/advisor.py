@@ -206,9 +206,9 @@ def advise(region: Region,
         # size-reweighting (post-stratification).
         sched = generate_stratified_total(runner.mmap, budget, seed,
                                           region.nominal_steps)
-        # Clamp the batch to the schedule: run_schedule edge-pads every
-        # batch to batch_size, so a small stratified budget would other-
-        # wise pay for padding rows (4x waste at the defaults).
+        # One-shot campaign: clamp the batch to the schedule (run_schedule
+        # edge-pads every batch, and a small stratified budget would
+        # otherwise pay for padding rows -- 4x waste at the defaults).
         base = runner.run_schedule(sched, min(batch_size, len(sched)))
     else:
         base = runner.run(budget, seed=seed, batch_size=batch_size)

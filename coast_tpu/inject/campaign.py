@@ -122,6 +122,12 @@ class CampaignRunner:
     # -- execution ----------------------------------------------------------
     def run_schedule(self, sched: FaultSchedule,
                      batch_size: int = 4096) -> CampaignResult:
+        # Deliberately no clamp to len(sched) here: every batch is
+        # edge-padded to batch_size so all chunks (including a caller's
+        # externally-sliced tail, e.g. scripts/campaign_1m.py) share one
+        # compiled program.  One-shot small campaigns clamp at the call
+        # site (advisor, supervisor) where a single smaller compile beats
+        # padding waste.
         batch_size = self._round_batch(batch_size)
         t0 = time.perf_counter()
         outs: List[Dict[str, np.ndarray]] = []

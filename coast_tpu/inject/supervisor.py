@@ -219,7 +219,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         sched = generate_cache_schedule(
             mmap, hierarchy, args.t, args.seed,
             prog.region.nominal_steps, cache_name)
-        res = runner.run_schedule(sched, batch_size=args.batch_size)
+        res = runner.run_schedule(
+            sched, batch_size=min(args.batch_size, len(sched)))
     elif args.errorCount:
         res = runner.run_until_errors(args.errorCount, seed=args.seed,
                                       batch_size=args.batch_size)

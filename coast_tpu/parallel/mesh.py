@@ -121,7 +121,10 @@ class ShardedCampaignRunner(CampaignRunner):
         """Classification counts for n seeded injections; per-run records
         never leave the devices (padding masked out of the histogram)."""
         sched = generate(self.mmap, n, seed, self.prog.region.nominal_steps)
-        batch_size = self._round_batch(batch_size)
+        # One-shot campaign drawn here: clamp the batch to the schedule so
+        # a small n does not pay for padding rows (the clamp happens
+        # before device rounding, which floors at one row per device).
+        batch_size = self._round_batch(min(batch_size, len(sched)))
         total = np.zeros(cls.NUM_CLASSES, np.int64)
         for lo in range(0, len(sched), batch_size):
             part = sched.slice(lo, min(lo + batch_size, len(sched)))
