@@ -97,7 +97,10 @@ class CampaignRunner:
 
     # -- overridable batching hooks (ShardedCampaignRunner replaces these) --
     def _round_batch(self, batch_size: int) -> int:
-        return batch_size
+        # Floor at one row: call sites clamp to len(schedule) to avoid
+        # padding waste, and an empty schedule (cache draws all invalid,
+        # zero budget) must step range() by 1, not 0.
+        return max(1, batch_size)
 
     @staticmethod
     def _padded_fault(part: FaultSchedule, batch_size: int):

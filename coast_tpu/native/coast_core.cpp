@@ -220,6 +220,93 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
     }
     return nullptr;
   };
+  // classify_run dispatches on the result DICT's top-level key membership
+  // (analysis/json_parser.py:49-72).  Mirror that exactly: scan the result
+  // object once, tracking string state and brace depth, and consider only
+  // keys at depth 1.  A discriminating word appearing as a string VALUE
+  // ({"status": "invalid"}) or inside a NESTED object ({"detail":
+  // {"timeout": 5}}) must not reroute classification -- a plain substring
+  // search silently diverges from the Python parser on such foreign lines.
+  struct ResultKeys {
+    bool object = false;   // result is a JSON object; anything else (list,
+                           // string, null) gets Python's quirky membership
+                           // semantics, so the caller must fall back.
+    bool invalid = false, timeout = false, message = false, core = false;
+    int64_t errors = 0, faults = 0, runtime = 0;
+  };
+  auto scan_result = [](const char* q, const char* end) -> ResultKeys {
+    ResultKeys r;
+    while (q < end && (*q == ' ' || *q == '\t')) ++q;
+    if (q >= end || *q != '{') return r;   // r.object stays false
+    r.object = true;
+    int depth = 0;
+    bool in_str = false, esc = false, have_key = false;
+    const char* str_start = nullptr;    // open depth-1 string, if any
+    const char* kb = nullptr;           // last completed depth-1 string
+    size_t klen = 0;
+    for (; q < end; ++q) {
+      const char c = *q;
+      if (in_str) {
+        if (esc) esc = false;
+        else if (c == '\\') esc = true;
+        else if (c == '"') {
+          in_str = false;
+          if (depth == 1 && str_start) {
+            kb = str_start;
+            klen = (size_t)(q - str_start);
+            have_key = true;
+            str_start = nullptr;
+          }
+        }
+        continue;
+      }
+      switch (c) {
+        case '"':
+          in_str = true;
+          if (depth == 1) str_start = q + 1;
+          break;
+        case '{': case '[': ++depth; break;
+        case '}': case ']':
+          if (--depth == 0) return r;
+          break;
+        case ',': have_key = false; break;
+        case ':':
+          if (depth == 1 && have_key) {
+            auto is = [&](const char* w, size_t n) {
+              return klen == n && std::memcmp(kb, w, n) == 0;
+            };
+            if (is("invalid", 7)) r.invalid = true;
+            else if (is("timeout", 7)) r.timeout = true;
+            else if (is("message", 7)) r.message = true;
+            else if (is("core", 4)) r.core = true;
+            else if (is("errors", 6) || is("faults", 6)
+                     || is("runtime", 7)) {
+              const char* v = q + 1;
+              while (v < end && (*v == ' ' || *v == '\t')) ++v;
+              const bool neg = (v < end && *v == '-');
+              if (neg) ++v;
+              int64_t x = 0;
+              bool any = false;
+              while (v < end && *v >= '0' && *v <= '9') {
+                x = x * 10 + (*v - '0');
+                ++v;
+                any = true;
+              }
+              if (any) {
+                x = neg ? -x : x;
+                if (kb[0] == 'e') r.errors = x;
+                else if (kb[0] == 'f') r.faults = x;
+                else r.runtime = x;
+              }
+            }
+            have_key = false;
+          }
+          break;
+        default: break;
+      }
+    }
+    return r;
+  };
   auto rfind = [](const char* p, const char* end, const char* needle,
                   size_t nlen) -> const char* {
     if ((size_t)(end - p) < nlen) return nullptr;
@@ -228,25 +315,6 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
     }
     return nullptr;
   };
-  auto parse_int_after = [&](const char* p, const char* end, const char* key,
-                             size_t klen, int64_t* out) -> bool {
-    const char* k = find(p, end, key, klen);
-    if (!k) return false;
-    k += klen;
-    bool neg = (k < end && *k == '-');
-    if (neg) ++k;
-    int64_t v = 0;
-    bool any = false;
-    while (k < end && *k >= '0' && *k <= '9') {
-      v = v * 10 + (*k - '0');
-      ++k;
-      any = true;
-    }
-    if (!any) return false;
-    *out = neg ? -v : v;
-    return true;
-  };
-
   int64_t lines = 0;
   const char* p = buf;
   const char* const bend = buf + len;
@@ -272,21 +340,23 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
     }
     if (!res) return -1;
     res += sizeof kResult - 1;
-    if (find(res, rend, "\"invalid\"", 9)) {
+    const ResultKeys rk = scan_result(res, rend);
+    // Non-object results (a list, a bare string, null): classify_run's
+    // `"timeout" in res` membership does substring/element search there,
+    // which this scanner deliberately does not model -- punt the whole
+    // file to the Python parser rather than silently diverge.
+    if (!rk.object) return -1;
+    if (rk.invalid) {
       counts[5]++;
-    } else if (find(res, rend, "\"timeout\"", 9)) {
+    } else if (rk.timeout) {
       counts[4]++;
-    } else if (find(res, rend, "\"message\"", 9)) {
+    } else if (rk.message) {
       counts[3]++;
-    } else if (find(res, rend, "\"core\"", 6)) {
-      int64_t errors = 0, faults = 0, runtime = 0;
-      parse_int_after(res, rend, "\"errors\": ", 10, &errors);
-      parse_int_after(res, rend, "\"faults\": ", 10, &faults);
-      parse_int_after(res, rend, "\"runtime\": ", 11, &runtime);
-      if (errors > 0) counts[2]++;
-      else if (faults > 0) counts[1]++;
+    } else if (rk.core) {
+      if (rk.errors > 0) counts[2]++;
+      else if (rk.faults > 0) counts[1]++;
       else counts[0]++;
-      *step_sum += runtime;
+      *step_sum += rk.runtime;
       (*step_n)++;
     } else {
       counts[5]++;  // classify_run's final fallback: invalid

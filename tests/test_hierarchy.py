@@ -153,6 +153,18 @@ def test_supervisor_cache_campaign(tmp_path):
     assert (tmp_path / "matrixMultiply_TMR_dcache.json").exists()
 
 
+def test_supervisor_empty_cache_campaign(tmp_path):
+    """-t 0 on a cache section yields an empty schedule; the supervisor
+    must summarise an empty campaign cleanly, not crash batching."""
+    rc = supervisor_main(["-f", "matrixMultiply", "-s", "dcache", "-t", "0",
+                          "--batch-size", "16", "-l", str(tmp_path),
+                          "-d", "cpu"])
+    assert rc == 0
+    data = json.loads(
+        (tmp_path / "matrixMultiply_TMR_dcache.json").read_text())
+    assert data["summary"]["injections"] == 0
+
+
 def test_discarded_cache_draws_marked_in_logs(prog):
     """Invalid-line injections must not pollute per-symbol attribution
     (the reference logs them distinctly, supportClasses InvalidResult)."""

@@ -301,3 +301,131 @@ def test_native_classifier_adversarial_leaf_name(tmp_path):
     assert fast.counts == slow.counts
     assert fast.counts["corrected"] == 1 and fast.counts["invalid"] == 0
     assert fast.mean_steps == slow.mean_steps == 9.0
+
+
+def test_native_classifier_word_as_value_not_key(tmp_path):
+    """A discriminating word appearing as a string VALUE inside a foreign
+    result object must not reroute classification: only key position
+    (closing quote followed by ':') counts, exactly like classify_run's
+    dict-key membership."""
+    from coast_tpu import native
+    from coast_tpu.analysis import json_parser as jp
+
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+    # A core result whose free-text note is exactly "timeout": the old
+    # substring search classified this as due_timeout; classify_run says
+    # corrected (no "timeout" KEY, "core" key present, faults>0).
+    core_val = ('{"timestamp": "t", "core": 0, "runtime": 5, "errors": 0, '
+                '"faults": 1, "note": "timeout"}')
+    # A foreign result with discriminating words only in value position:
+    # classify_run's final fallback says invalid -- but via the fallback
+    # branch, not via a bogus "invalid"/"timeout" key match.
+    foreign_val = '{"status": "invalid", "kind": "timeout"}'
+    # Discriminating keys buried one object deep: classify_run sees no
+    # TOP-LEVEL key and falls back to invalid; so must the native scan.
+    nested_val = '{"detail": {"timeout": 5, "core": 1, "errors": 9}}'
+    tpl = ('{"timestamp": "t", "number": %d, "section": "mem", '
+           '"address": 0, "oldValue": null, "newValue": null, '
+           '"sleepTime": 0, "cycles": 1, "PC": 1, "name": "x", '
+           '"symbol": "x", "result": %s, "cacheInfo": null}')
+    path = tmp_path / "val.json"
+    path.write_text(json.dumps({"summary": {"format": "ndjson",
+                                            "seconds": 0.5}}) + "\n"
+                    + tpl % (0, core_val) + "\n"
+                    + tpl % (1, foreign_val) + "\n"
+                    + tpl % (2, nested_val) + "\n")
+    fast = jp._summarize_ndjson_native(str(path))
+    slow = jp.summarize_runs("val", [jp.read_json_file(str(path))])
+    assert fast is not None
+    assert fast.counts == slow.counts
+    assert fast.counts["corrected"] == 1
+    assert fast.counts["invalid"] == 2
+    assert fast.counts["due_timeout"] == 0
+    assert fast.counts["sdc"] == 0
+
+
+def test_native_ndjson_stream_chunking(region, tmp_path, monkeypatch):
+    """ndjson_stream_rows with a tiny chunk budget must (a) split the
+    campaign across many encode() calls with absolute row numbering intact
+    and (b) survive a -1 overflow return by halving the row window --
+    byte-identical to the Python formatter either way."""
+    from coast_tpu import native
+    from coast_tpu.inject import logs
+    from coast_tpu.inject.campaign import CampaignResult
+    from coast_tpu.inject.schedule import FaultSchedule
+
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+
+    runner = CampaignRunner(TMR(region))
+    n = 64
+    sched = FaultSchedule(
+        leaf_id=np.arange(n, dtype=np.int32) % 3,
+        lane=np.arange(n, dtype=np.int32) % 3,
+        word=np.arange(n, dtype=np.int32) * 11,
+        bit=np.arange(n, dtype=np.int32) % 32,
+        t=np.where(np.arange(n) % 7 == 6, -1,
+                   np.arange(n)).astype(np.int32),
+        section_idx=np.zeros(n, np.int32), seed=21)
+    res = CampaignResult(
+        benchmark="synthetic", strategy="TMR", n=n,
+        counts={name: 2 for name in cls.CLASS_NAMES}, seconds=2.0,
+        codes=(np.arange(n, dtype=np.int32) % cls.NUM_CLASSES),
+        errors=np.arange(n, dtype=np.int32),
+        corrected=np.arange(n, dtype=np.int32) * 3,
+        steps=np.arange(n, dtype=np.int32) + 10,
+        schedule=sched, seed=21)
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+
+    # Python reference bytes (native disabled).
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    logs.write_ndjson(res, runner.mmap, str(tmp_path / "python.json"))
+    monkeypatch.undo()
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    py_rows = (tmp_path / "python.json").read_bytes().split(b"\n", 1)[1]
+
+    # Native streaming bytes, assembled the way _ndjson_try_native does but
+    # with a chunk budget sized for ~2 rows so dozens of chunks are needed.
+    secs = {s.leaf_id: s for s in runner.mmap.sections}
+    n_leaves = max(secs) + 1
+    kind_by_leaf = [""] * n_leaves
+    name_by_leaf = [""] * n_leaves
+    for lid, s in secs.items():
+        kind_by_leaf[lid] = json.dumps(s.kind)[1:-1]
+        name_by_leaf[lid] = json.dumps(s.name)[1:-1]
+    col = {"leaf_id": sched.leaf_id, "lane": sched.lane,
+           "word": sched.word, "bit": sched.bit, "t": sched.t,
+           "code": res.codes, "errors": res.errors,
+           "corrected": res.corrected, "steps": res.steps}
+
+    chunks = []
+    real_lib = native.get_lib()
+    fail_first = {"left": 1}
+
+    class FlakyLib:
+        """Delegate to the real library, but report buffer overflow (-1)
+        on the first few encode calls to force the halving retry."""
+
+        def __getattr__(self, attr):
+            fn = getattr(real_lib, attr)
+            if attr != "coast_ndjson_encode":
+                return fn
+
+            def encode(*args):
+                if fail_first["left"] > 0:
+                    fail_first["left"] -= 1
+                    return -1
+                return fn(*args)
+            return encode
+
+    monkeypatch.setattr(native, "get_lib", lambda: FlakyLib())
+    ts = "2026-01-01 00:00:00.000000"
+    ok = native.ndjson_stream_rows(0, n, col, kind_by_leaf, name_by_leaf,
+                                   ts, chunks.append, chunk_bytes=2048)
+    assert ok
+    assert fail_first["left"] == 0          # the retry path actually ran
+    assert len(chunks) > 5                  # genuinely chunked
+    assert b"".join(chunks) == py_rows
