@@ -31,6 +31,18 @@ import time
 
 BASELINE_INJ_PER_SEC = 1.0  # QEMU+GDB loop, seconds-per-injection regime
 
+# Published single-chip bf16 matmul peak for the chip this tunnel exposes
+# (TPU v5e: 197 TFLOP/s bf16).  Flagship records report achieved FLOP/s as
+# a fraction of this so the utilization story is explicit, not a bare
+# GFLOP/s number.
+TPU_V5E_BF16_PEAK_GFLOPS = 197_000.0
+
+# Last-known-good on-chip measurement, refreshed whenever a TPU-backed run
+# completes; embedded in the output when the tunnel is down so a CPU
+# fallback record never silently replaces the hardware story.
+LAST_TPU_RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "artifacts", "last_tpu_bench.json")
+
 # Stage timeouts (seconds), env-tunable for the driver.
 INIT_TIMEOUT = int(os.environ.get("COAST_BENCH_INIT_TIMEOUT", "420"))
 RETRY_TIMEOUT = int(os.environ.get("COAST_BENCH_RETRY_TIMEOUT", "180"))
@@ -140,23 +152,28 @@ def worker(backend: str) -> None:
         jax.block_until_ready(out)
         sec_per_run = (time.perf_counter() - t0) / reps
         lanes_flops = 3 * flag.meta["flops_per_run"]
+        gflops = lanes_flops / sec_per_run / 1e9
         fl_rec = {"stage": "result", "kind": "flagship",
                   "benchmark": flag_name, "strategy": "TMR",
                   "state_bytes": flag.meta["state_bytes"],
                   "seconds_per_run": round(sec_per_run, 6),
-                  "gflops_per_sec": round(
-                      lanes_flops / sec_per_run / 1e9, 2)}
+                  "gflops_per_sec": round(gflops, 2),
+                  "fraction_of_peak": round(
+                      gflops / TPU_V5E_BF16_PEAK_GFLOPS, 5),
+                  "peak_ref": "v5e bf16 197 TFLOP/s"}
         fl_runner = CampaignRunner(fl_prog, strategy_name="TMR")
         fl_batches = []
         for batch in batches:
             fl_runner.run(batch, seed=1, batch_size=batch)   # compile+warm
             res = fl_runner.run(2 * batch, seed=42, batch_size=batch)
+            camp_gflops = lanes_flops * res.n / res.seconds / 1e9
             fl_batches.append({
                 "batch_size": batch, "injections": res.n,
                 "seconds": round(res.seconds, 4),
                 "injections_per_sec": round(res.injections_per_sec, 2),
-                "gflops_per_sec": round(
-                    lanes_flops * res.n / res.seconds / 1e9, 2),
+                "gflops_per_sec": round(camp_gflops, 2),
+                "fraction_of_peak": round(
+                    camp_gflops / TPU_V5E_BF16_PEAK_GFLOPS, 5),
                 "counts": res.counts})
         fl_rec["campaign"] = fl_batches
         _emit(fl_rec)
@@ -296,9 +313,33 @@ def main() -> int:
         })
         if errors:
             line["error"] = "; ".join(errors)
-        if used == "cpu" and not force:
+        # One predicate for "this ran on the host": the worker-REPORTED
+        # backend, not the attempt label -- a "default" attempt on a
+        # TPU-less box silently resolves to CPU and must carry the same
+        # caveat as the explicit fallback.
+        on_cpu = (summary.get("backend") == "cpu")
+        if on_cpu and not force:
             line["note"] = ("TPU backend unreachable; value measured on the "
                             "CPU fallback backend")
+        if on_cpu:
+            # Never let a fallback record silently replace the hardware
+            # story: embed the last on-chip measurement alongside it.
+            try:
+                with open(LAST_TPU_RECORD) as f:
+                    line["last_known_tpu"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+        elif summary.get("backend"):
+            # A definite non-CPU backend measured this: it becomes the new
+            # last-known on-chip record.  backend-unknown records (init
+            # line never arrived) are saved nowhere.
+            try:
+                os.makedirs(os.path.dirname(LAST_TPU_RECORD), exist_ok=True)
+                with open(LAST_TPU_RECORD, "w") as f:
+                    json.dump({"measured_at": time.strftime("%Y-%m-%d %H:%M"),
+                               "record": line}, f, indent=1)
+            except OSError:
+                pass
         print(json.dumps(line))
         for e in errors:
             print(f"# {e}", file=sys.stderr)

@@ -146,6 +146,12 @@ def read_json_file(path: str) -> Dict[str, object]:
             # Single-line doc (write_columnar emits one line): the first
             # readline consumed and parsed the whole file already.
             return head
+        if head is None and os.path.exists(first.strip()):
+            # Reference container (write_reference_json / the reference's
+            # own campaign logs): line 1 is the guest-executable path,
+            # the rest one bare InjectionLog array (jsonParser.py:121-133).
+            return {"summary": {"exec": first.strip()},
+                    "runs": json.load(f)}
         f.seek(0)
         doc = json.load(f)
     if not isinstance(doc, dict) or not ("runs" in doc or "columns" in doc):

@@ -156,8 +156,17 @@ class CampaignRunner:
             merged = {k: np.zeros(0, np.int32)
                       for k in ("code", "errors", "corrected", "steps")}
         seconds = time.perf_counter() - t0
-        binc = np.bincount(merged["code"], minlength=cls.NUM_CLASSES)
+        # Cache draws outside the program footprint (t < 0) never fire a
+        # flip: a clean run that injected nothing is not a "survived
+        # injection", so they get their own bucket instead of inflating
+        # success -- the analogue of the reference summary's cacheValids
+        # column (jsonParser.py summarizeRuns counts lines whose cacheInfo
+        # says the chosen line was not dirty).
+        invalid_draw = np.asarray(sched.t) < 0
+        binc = np.bincount(merged["code"][~invalid_draw],
+                           minlength=cls.NUM_CLASSES)
         counts = {name: int(binc[i]) for i, name in enumerate(cls.CLASS_NAMES)}
+        counts["cache_invalid"] = int(invalid_draw.sum())
         return CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,

@@ -5,8 +5,15 @@ supportClasses.InjectionLog.getDict (supportClasses.py:338-353) with a
 result sub-dict whose discriminating keys match the FromDict dispatch
 (supportClasses.py:355-389): "core" -> RunResult, "timeout" ->
 TimeoutResult, "message" -> AbortResult, "invalid" -> InvalidResult.
-jsonParser.py-style analysis therefore carries over directly
-(coast_tpu.analysis.json_parser consumes the same files).
+
+Container formats: ``write_reference_json`` emits the reference's own
+file container (exec path line + bare InjectionLog array,
+jsonParser.py:121-133) and is consumed by the UNMODIFIED reference
+``simulation/platform/jsonParser.py`` (executed against it in
+tests/test_reference_parser.py).  ``write_json`` / ``write_ndjson`` /
+``write_columnar`` use repo-native containers (summary header + runs)
+that only ``coast_tpu.analysis.json_parser`` reads; their per-run dicts
+are FromDict-compatible, the file wrapper is not.
 
 Throughput note: the reference logs one injection per several seconds, so
 per-run Python dicts are free.  A batched campaign produces 10^6 runs in a
@@ -133,6 +140,37 @@ def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
                  + "\n").encode())
         return native.ndjson_stream_rows(0, res.n, col, kind_by_leaf,
                                          name_by_leaf, ts, f.write)
+
+
+def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
+                         exec_path: str = None) -> None:
+    """Campaign log in the reference tool's OWN container: line 1 names
+    the protected program (the guest-executable line; readJsonFile
+    refuses the file when that path does not exist on disk,
+    jsonParser.py:121-133), followed by one JSON array of InjectionLog
+    dicts.  The reference's simulation/platform/jsonParser.py -- not a
+    reimplementation -- parses these files directly, so its summary,
+    compare-files/-dirs, and MWTF reports run unmodified on campaigns
+    from this engine.  ``exec_path`` defaults to the benchmark's model
+    module (models.model_source).
+
+    Known reference-tool limitation (theirs, not this writer's): its
+    otherStats takes statistics.mean over fully-clean runs and raises
+    StatisticsError on a campaign with zero successes (e.g. a small TMR
+    campaign where every injection was corrected); its own QEMU
+    campaigns always contain clean runs, so the path was never guarded."""
+    import os
+    if exec_path is None:
+        from coast_tpu.models import model_source
+        exec_path = model_source(res.benchmark)
+    exec_path = os.path.realpath(exec_path)
+    if not os.path.exists(exec_path):
+        raise FileNotFoundError(
+            f"exec_path {exec_path!r} does not exist; the reference's "
+            "readJsonFile exits on logs whose line-1 path is missing")
+    with open(path, "w") as f:
+        f.write(exec_path + "\n")
+        json.dump(to_injection_logs(res, mmap), f, indent=1)
 
 
 def write_json(res: CampaignResult, mmap: MemoryMap, path: str) -> None:

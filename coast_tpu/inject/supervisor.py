@@ -92,10 +92,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "small sections are measured at the same "
                         "resolution as large ones")
     parser.add_argument("--log-format", type=str, default="json",
-                        choices=["json", "ndjson", "columnar"],
+                        choices=["json", "ndjson", "columnar", "reference"],
                         help="log writer: json = reference InjectionLog "
                         "schema, ndjson/columnar = bulk formats for "
-                        "10^6-run campaigns")
+                        "10^6-run campaigns, reference = the reference "
+                        "tool's own container (exec-path line + bare "
+                        "array; readable by its jsonParser.py unmodified)")
     args = parser.parse_args(argv)
 
     if args.board in ("pynq", "hifive1"):
@@ -241,7 +243,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             log_dir,
             f"{prog.region.name}_{strategy}_{args.section}.json")
         writer = {"json": logs.write_json, "ndjson": logs.write_ndjson,
-                  "columnar": logs.write_columnar}[args.log_format]
+                  "columnar": logs.write_columnar,
+                  "reference": logs.write_reference_json}[args.log_format]
         writer(res, mmap, path)
         print(f"wrote {path}")
     return 0

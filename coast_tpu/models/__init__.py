@@ -13,7 +13,27 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
         import importlib
         mod = importlib.import_module(f"coast_tpu.models.{modname}")
         return getattr(mod, fn)()
+    make.modname = modname
     return make
+
+
+def model_source(name: str) -> str:
+    """Absolute path of the model module behind a REGISTRY name -- the
+    analogue of the guest-executable path the reference records as line 1
+    of every campaign log (threadFunctions.py flushes it; jsonParser.py's
+    readJsonFile refuses files whose line-1 path does not exist).  Unknown
+    names (lifted or ad-hoc regions) fall back to the package itself."""
+    import importlib.util
+    import os
+    make = REGISTRY.get(name)
+    if make is not None and hasattr(make, "modname"):
+        # find_spec resolves the file without executing the module: the
+        # log writer only needs a path, not the model's import-time work.
+        spec = importlib.util.find_spec(f"coast_tpu.models.{make.modname}")
+        if spec is not None and spec.origin:
+            return os.path.realpath(spec.origin)
+    import coast_tpu
+    return os.path.realpath(coast_tpu.__file__)
 
 
 REGISTRY: Dict[str, Callable[[], Region]] = {

@@ -132,4 +132,13 @@ class ShardedCampaignRunner(CampaignRunner):
             valid = jnp.asarray(np.arange(batch_size) < n_part)
             total += np.asarray(jax.device_get(
                 self._hist_sharded(fault, valid)), np.int64)
-        return {name: int(total[i]) for i, name in enumerate(cls.CLASS_NAMES)}
+        counts = {name: int(total[i]) for i, name in enumerate(cls.CLASS_NAMES)}
+        # Parity with run_schedule's counts: never-fired draws (t < 0; none
+        # from generate(), which only emits in-footprint faults, but the
+        # key must match) are their own bucket, not success.  On-device
+        # such rows classify success, so the host-side re-bucketing is a
+        # plain subtraction.
+        n_invalid = int((np.asarray(sched.t) < 0).sum())
+        counts["success"] -= n_invalid
+        counts["cache_invalid"] = n_invalid
+        return counts

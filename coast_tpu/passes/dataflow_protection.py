@@ -551,10 +551,12 @@ class ProtectedProgram:
 
         ``unroll`` sets how many steps the early-exit loop executes per
         iteration; any value yields the identical run record (overshooting
-        sub-steps are masked to no-ops).  The default stays 1: measured
-        on-chip, with the flip masks hoisted the step cost is compute-
-        bound, so unrolling only adds masked no-op sub-steps.  The traced
-        path is a fixed-length scan, so ``unroll`` does not apply there.
+        sub-steps are masked to no-ops).  The default stays 1 pending an
+        on-chip sweep with the hoisted flip masks (the pre-hoist balance
+        no longer holds; see artifacts/unroll_sweep.json once captured) --
+        unrolling trades per-iteration loop overhead against masked no-op
+        sub-steps after the early exit.  The traced path is a fixed-length
+        scan, so ``unroll`` does not apply there.
         """
         if fault is not None:
             # Accept plain Python ints (the CLI / README ergonomics).
