@@ -34,7 +34,7 @@ _BOOL_FLAGS = {
     "noMemReplication", "noLoadSync", "noStoreDataSync", "noStoreAddrSync",
     "storeDataSync", "countErrors", "reportErrors", "countSyncs",
     "i", "s", "verbose", "dumpModule", "noMain", "noCloneOpsCheck",
-    "protectStack", "pallasVoters",
+    "protectStack", "pallasVoters", "noPallasVoters",
     # Utility passes (SURVEY.md §2.1 #6-#8), stackable with any strategy:
     # -DebugStatements (block trace), -SmallProfile (+ -noPrint), -ExitMarker.
     "DebugStatements", "SmallProfile", "noPrint", "ExitMarker",
@@ -131,7 +131,17 @@ def build_overrides(flags: Dict[str, object]) -> Dict[str, object]:
     overrides["segmented"] = bool(flags.get("s"))
     overrides["cfcss"] = bool(flags.get("CFCSS"))
     overrides["protect_stack"] = bool(flags.get("protectStack"))
-    overrides["pallas_voters"] = bool(flags.get("pallasVoters"))
+    # Only force the Pallas voters when a flag is present; absence keeps
+    # the config's auto default (on when the backend is the TPU).
+    # -noPallasVoters makes the jnp-voter baseline reachable from the CLI
+    # on TPU (bisecting a suspected kernel miscompare needs it).
+    if flags.get("pallasVoters") and flags.get("noPallasVoters"):
+        raise UsageError(
+            "-pallasVoters and -noPallasVoters are mutually exclusive")
+    if flags.get("pallasVoters"):
+        overrides["pallas_voters"] = True
+    elif flags.get("noPallasVoters"):
+        overrides["pallas_voters"] = False
     return overrides
 
 
@@ -165,6 +175,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     from coast_tpu.interface.config import ConfigError
     try:
         overrides = build_overrides(flags)
+    except UsageError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
     except ConfigError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1

@@ -103,7 +103,10 @@ class ProtectionConfig:
     # Pallas TPU kernel (ops/pallas_voters.py) instead of the jnp voter
     # XLA fuses; bit-identical, ~1.4x the bandwidth on flagship-sized
     # leaves, falls back automatically off-TPU / for small leaves.
-    pallas_voters: bool = False
+    # None = auto: ON whenever the default backend is the TPU (the kernel
+    # the README advertises should be what default campaigns run), OFF
+    # elsewhere.  The CLI flag forces it on; pass False to force it off.
+    pallas_voters: "bool | None" = None
     # -isrFunctions: interrupt handlers excluded from cloning.  There is no
     # interrupt concept in a stepped TPU region; a non-empty list is a hard
     # configuration error (refused, not silently inert).
@@ -225,10 +228,13 @@ class ProtectedProgram:
                 # top of the normal sync taxonomy: the saved return-address
                 # copies are voted even when store/ctrl syncs are disabled.
                 self.step_sync[name] = True
-        # Voter lowering: jnp reductions by default; -pallasVoters routes
-        # eligible large leaves through the fused Pallas kernel (which
-        # itself falls back to the jnp voter when not applicable).
-        if cfg.pallas_voters:
+        # Voter lowering: -pallasVoters (or auto-on when the backend IS the
+        # TPU) routes eligible large leaves through the fused Pallas kernel
+        # (which itself falls back to the jnp voter when not applicable);
+        # off-TPU defaults stay on the jnp reductions XLA fuses.
+        use_pallas = (cfg.pallas_voters if cfg.pallas_voters is not None
+                      else jax.default_backend() == "tpu")
+        if use_pallas:
             from coast_tpu.ops import pallas_voters
             self._vote = pallas_voters.vote
         else:

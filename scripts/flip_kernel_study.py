@@ -1,0 +1,103 @@
+"""Measure the two native-kernel questions of SURVEY §7 on the live backend.
+
+1. Flip kernel: the bit-flip is a per-leaf select+XOR that XLA fuses into
+   the step computation (ops/bitflip.py).  SURVEY §7 names it as the one
+   custom-call/Pallas obligation; the design bet is that a separate kernel
+   would UNFUSE it (an extra HBM pass over the leaf).  Measured here as
+   jitted step cost with fault=None vs an armed fault -- if the delta is
+   within run-to-run noise, the jnp-fused flip is the right lowering and
+   a custom kernel has nothing to win.
+2. Voter kernel A/B: default-on Pallas voters vs forced-off jnp voters on
+   the flagship (mm256), single-run latency -- the bench table line for
+   the default flip (VERDICT r2 #7).
+
+Writes artifacts/flip_kernel_study.json and prints it.  Run on the TPU
+for the record that matters; runs anywhere for smoke.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("COAST_STUDY_BACKEND") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def timed(fn, reps=20):
+    jax.block_until_ready(fn())          # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    from coast_tpu import TMR, ProtectionConfig, protect
+    from coast_tpu.models import REGISTRY
+
+    backend = jax.default_backend()
+    out = {"backend": backend, "metric": "flip_and_voter_kernel_study"}
+
+    # -- 1: flip select+XOR cost inside the fused step ---------------------
+    region = REGISTRY["matrixMultiply256"]()
+    prog = TMR(region)
+    run_nofault = jax.jit(lambda: prog.run(None))
+    fault = {"leaf_id": 0, "lane": 0, "word": 3, "bit": 7, "t": 2}
+    import jax.numpy as jnp
+    dev_fault = {k: jnp.asarray(v, jnp.int32) for k, v in fault.items()}
+    run_fault = jax.jit(lambda f: prog.run(f))
+    reps = 30
+    t_nofault = timed(run_nofault, reps)
+    t_fault = timed(lambda: run_fault(dev_fault), reps)
+    # Noise floor: spread of repeated nofault measurements at the SAME rep
+    # count as the means being differenced (a smaller-rep spread would
+    # overstate noise ~sqrt(reps ratio) and bias within_noise toward true).
+    samples = [timed(run_nofault, reps) for _ in range(6)]
+    noise = max(samples) - min(samples)
+    out["flip"] = {
+        "benchmark": "matrixMultiply256",
+        "seconds_per_run_nofault": round(t_nofault, 6),
+        "seconds_per_run_faulted": round(t_fault, 6),
+        "flip_overhead_seconds": round(t_fault - t_nofault, 6),
+        "flip_overhead_pct": round(100 * (t_fault - t_nofault)
+                                   / t_nofault, 2),
+        "noise_floor_seconds": round(noise, 6),
+        "within_noise": bool(abs(t_fault - t_nofault) <= noise),
+    }
+
+    # -- 2: voter A/B (auto default vs forced-off jnp) ---------------------
+    prog_off = protect(region, ProtectionConfig(num_clones=3,
+                                                pallas_voters=False))
+    prog_on = protect(region, ProtectionConfig(num_clones=3,
+                                               pallas_voters=True))
+    t_off = timed(jax.jit(lambda: prog_off.run(None)), reps)
+    t_on = timed(jax.jit(lambda: prog_on.run(None)), reps)
+    out["voter_ab"] = {
+        "benchmark": "matrixMultiply256",
+        "seconds_per_run_jnp": round(t_off, 6),
+        "seconds_per_run_pallas": round(t_on, 6),
+        "pallas_speedup_x": round(t_off / t_on, 3),
+        "note": ("pallas path only engages on the TPU backend; on other "
+                 "backends both rows measure the jnp voter"),
+    }
+
+    # A CPU smoke run must never clobber the on-chip record (the A/B is
+    # meaningless off-TPU: both rows are the jnp voter).
+    fname = ("flip_kernel_study.json" if backend != "cpu"
+             else "flip_kernel_study_cpu_smoke.json")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", fname)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

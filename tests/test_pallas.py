@@ -58,3 +58,40 @@ def test_cli_flag_parses():
     from coast_tpu.opt import build_overrides, parse_argv
     flags, pos = parse_argv(["-TMR", "-pallasVoters", "matrixMultiply"])
     assert build_overrides(flags)["pallas_voters"] is True
+
+
+def test_default_is_auto_by_backend(monkeypatch):
+    """pallas_voters=None resolves by backend: jnp voters on CPU, the
+    Pallas dispatch wrapper when the default backend is the TPU (VERDICT
+    r2 #7: the advertised kernel must be what default campaigns run)."""
+    from coast_tpu.models import mm
+    from coast_tpu.passes import dataflow_protection as dfp
+
+    region = mm.make_region()
+    prog_cpu = TMR(region)
+    assert prog_cpu._vote is voters.vote
+
+    monkeypatch.setattr(dfp.jax, "default_backend", lambda: "tpu")
+    prog_tpu = TMR(region)
+    assert prog_tpu._vote is pallas_voters.vote
+    # Forcing off still wins over auto.
+    prog_off = protect(region, ProtectionConfig(num_clones=3,
+                                                pallas_voters=False))
+    assert prog_off._vote is voters.vote
+
+
+def test_cli_absence_keeps_auto_default():
+    from coast_tpu.opt import build_overrides, parse_argv
+    flags, pos = parse_argv(["-TMR", "matrixMultiply"])
+    assert "pallas_voters" not in build_overrides(flags)
+
+
+def test_cli_no_pallas_voters_flag():
+    from coast_tpu.opt import UsageError, build_overrides, parse_argv
+    flags, _ = parse_argv(["-TMR", "-noPallasVoters", "matrixMultiply"])
+    assert build_overrides(flags)["pallas_voters"] is False
+    flags, _ = parse_argv(["-TMR", "-pallasVoters", "-noPallasVoters",
+                           "matrixMultiply"])
+    import pytest as _pytest
+    with _pytest.raises(UsageError):
+        build_overrides(flags)
