@@ -89,7 +89,9 @@ def main():
 
     # A CPU smoke run must never clobber the on-chip record (the A/B is
     # meaningless off-TPU: both rows are the jnp voter).
-    fname = ("flip_kernel_study.json" if backend != "cpu"
+    # Mirror the kernel's own predicate (pallas engages only when the
+    # backend is exactly "tpu"): anything else is a smoke run.
+    fname = ("flip_kernel_study.json" if backend == "tpu"
              else "flip_kernel_study_cpu_smoke.json")
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "artifacts", fname)
