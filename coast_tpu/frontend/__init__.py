@@ -12,4 +12,12 @@ block graph -- so no hand-written spec is needed.
 
 from coast_tpu.frontend.lifter import LiftError, lift_fn, lift_step
 
-__all__ = ["lift_step", "lift_fn", "LiftError"]
+
+def lift_c(*args, **kwargs):
+    """Restricted-C ingestion (frontend.c_lifter.lift_c); imported lazily
+    so the pycparser dependency stays off the default import path."""
+    from coast_tpu.frontend.c_lifter import lift_c as _lift_c
+    return _lift_c(*args, **kwargs)
+
+
+__all__ = ["lift_step", "lift_fn", "lift_c", "LiftError"]

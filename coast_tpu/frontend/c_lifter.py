@@ -1,0 +1,888 @@
+"""Restricted-C frontend: ingest reference benchmark sources directly.
+
+The reference protects arbitrary programs handed to ``opt`` as LLVM IR
+(cloning.cpp:62-288); its benchmarks are C files under tests/.  This
+module closes the ingestion boundary at demo scale (SURVEY §7's
+``-replicateTarget=tpu`` fallback, "a source-level frontend for the
+benchmarks"): it parses a restricted C subset with pycparser, compiles
+the AST to a jittable JAX function (globals become function inputs,
+``printf`` arguments become observed outputs), and hands that function
+to ``lift_fn`` -- so every top-level C loop becomes a stepped phase of
+the derived Region and the whole existing protection/injection stack
+applies unchanged.
+
+Supported subset (enough for tests/mm_common/mm.c and friends; refusals
+are loud and name the construct):
+
+  * global scalars/arrays of 32-bit integer types, with initializers;
+  * ``typedef`` of integer types; ``#define NAME literal``;
+  * functions with int parameters/locals, ``for`` loops (any bounds --
+    statically-counted loops lower to ``lax.scan``, general ones to
+    ``lax.while_loop``), ``if``/``else``, ternaries, assignments
+    (including ``+=`` family, ``++``/``--``), array subscripts,
+    integer arithmetic/bitwise/comparison ops, calls to other functions
+    defined in the same translation unit, and ``printf`` (its arguments
+    become program outputs -- the reference's QEMU loop greps stdout, so
+    stdout IS the observable; prints must sit OUTSIDE loops/branches,
+    where the printed value is a well-defined program output);
+  * narrow integer types (char/short/uint8_t/uint16_t) are REFUSED, not
+    silently widened: their mod-2^8/2^16 wraparound is not modeled;
+  * COAST.h annotation macros are stripped and recorded
+    (``__DEFAULT_NO_xMR``, ``__xMR``, ``__NO_xMR``).
+
+Integer model: ILP32, matching the reference's Cortex-A9/MSP430 targets
+-- ``int``/``long``/pointers-free code where ``unsigned long`` is 32
+bits.  All arithmetic is mod-2^32 (uint32) or int32.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.frontend.lifter import LiftError, lift_fn
+from coast_tpu.ir.region import LeafSpec, Region
+
+try:
+    from pycparser import c_ast, c_parser
+    _HAVE_PYCPARSER = True
+except Exception:  # pragma: no cover - pycparser ships with cffi
+    _HAVE_PYCPARSER = False
+
+
+class CLiftError(LiftError):
+    """Unsupported C construct; the message names it and the location."""
+
+
+# ---------------------------------------------------------------------------
+# Minimal preprocessing: the subset needs no system headers.
+# ---------------------------------------------------------------------------
+
+_COAST_MACROS = ("__DEFAULT_NO_xMR", "__DEFAULT_xMR", "__xMR", "__NO_xMR",
+                 "__xMR_FN", "__NO_xMR_FN", "__COAST_IGNORE_GLOBAL")
+
+_PRELUDE = """
+typedef unsigned int uint32_t;
+typedef int int32_t;
+typedef unsigned short uint16_t;
+typedef short int16_t;
+typedef unsigned char uint8_t;
+typedef signed char int8_t;
+"""
+
+
+def _strip_comments(text: str) -> str:
+    """Remove //... and /*...*/ outside string literals (pycparser wants
+    preprocessed input)."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append(text[i:j + 1])
+            i = j + 1
+        elif text.startswith("//", i):
+            i = text.find("\n", i)
+            i = n if i < 0 else i
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("\n" * text.count("\n", i, j))   # keep line numbers
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def preprocess(text: str, include_dirs: Sequence[str] = (),
+               defines: Optional[Dict[str, str]] = None,
+               ) -> Tuple[str, Dict[str, str], List[str]]:
+    """Strip/resolve the tiny preprocessor surface the benchmarks use.
+
+    Returns (source, defines, coast_annotations).  ``#include "local.c"``
+    is inlined from ``include_dirs`` (the mm_common.c pattern) and SHARES
+    the including file's ``#define`` table, exactly like cpp textual
+    inclusion; ``#include <...>`` system headers are dropped (the prelude
+    supplies the stdint names); object-like ``#define``s substitute.
+    """
+    text = _strip_comments(text)
+    defines = {} if defines is None else defines
+    annotations: List[str] = []
+    out: List[str] = []
+
+    def expand(line: str) -> str:
+        for name, val in defines.items():
+            line = re.sub(rf"\b{re.escape(name)}\b", val, line)
+        return line
+
+    for raw in text.splitlines():
+        line = raw
+        stripped = line.strip()
+        if stripped.startswith("#include"):
+            m = re.match(r'#include\s+"([^"]+)"', stripped)
+            if m:
+                fname = m.group(1)
+                for d in include_dirs:
+                    path = os.path.join(d, fname)
+                    if os.path.exists(path):
+                        if fname.endswith("COAST.h") or fname == "COAST.h":
+                            break
+                        with open(path) as f:
+                            sub, _, subann = preprocess(
+                                f.read(), include_dirs, defines)
+                        annotations.extend(subann)
+                        out.append(sub)
+                        break
+                else:
+                    if not fname.endswith("COAST.h"):
+                        raise CLiftError(
+                            f'#include "{fname}" not found in '
+                            f"{list(include_dirs)}")
+            continue
+        if stripped.startswith("#define"):
+            m = re.match(r"#define\s+(\w+)\s+(.+?)\s*$", stripped)
+            if m and "(" not in m.group(1):
+                defines[m.group(1)] = expand(m.group(2))
+            continue
+        if stripped.startswith("#"):
+            continue                      # #ifdef guards etc.: benign here
+        # Record + strip COAST annotation macros and GCC attributes.
+        for mac in _COAST_MACROS:
+            if re.search(rf"\b{mac}\b", line):
+                annotations.append(mac)
+                line = re.sub(rf"\b{mac}\b", "", line)
+        line = re.sub(r"__attribute__\s*\(\(.*?\)\)", "", line)
+        out.append(expand(line))
+    return "\n".join(out), defines, annotations
+
+
+# ---------------------------------------------------------------------------
+# Types
+# ---------------------------------------------------------------------------
+
+_UNSIGNED = {"unsigned", "uint32_t", "_Bool"}
+# Narrow types would need mod-2^8/2^16 wraparound modeling; silently
+# widening them to 32-bit lanes corrupts any benchmark that relies on
+# byte/short overflow (CRC tables, byte state machines) -- refuse loudly.
+_NARROW = {"char", "short", "uint8_t", "int8_t", "uint16_t", "int16_t"}
+
+
+class _NarrowType:
+    """Sentinel for a typedef of a narrow type: legal to DECLARE (the
+    prelude defines the stdint names so sources parse), refused on USE."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _dtype_of(names: List[str], typedefs: Dict[str, object]):
+    """ILP32 dtype for a declared type-name list (32-bit lanes only)."""
+    for n in names:
+        if n in _NARROW:
+            raise CLiftError(
+                f"narrow integer type {n!r} is not modeled (its C "
+                "wraparound is mod 2^8/2^16, not the 32-bit lane's); "
+                "widen the declaration to 32-bit")
+        if n in typedefs:
+            t = typedefs[n]
+            if isinstance(t, _NarrowType):
+                raise CLiftError(
+                    f"narrow integer type {t.name!r} is not modeled; "
+                    "widen the declaration to 32-bit")
+            return t
+    uns = any(n in _UNSIGNED for n in names) or "unsigned" in names
+    return jnp.uint32 if uns else jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# AST -> JAX compiler
+# ---------------------------------------------------------------------------
+
+class _NoPrintList(list):
+    """printf sentinel for traced sub-regions (loops, branches)."""
+
+    def __init__(self, coord):
+        super().__init__()
+        self.coord = coord
+
+    def _refuse(self):
+        raise CLiftError(
+            f"printf inside a loop or branch at {self.coord}: per-"
+            "iteration prints would be traced values that cannot escape "
+            "the loop; move the printf after the loop (print the final "
+            "value) or restructure")
+
+    def append(self, _):
+        self._refuse()
+
+    def extend(self, _):
+        self._refuse()
+
+
+class _Scope:
+    """Name -> traced value, with global-write tracking.
+
+    ``aliases`` implements C's array-argument pointer semantics at the
+    only granularity the subset needs: an array parameter whose call
+    argument names a GLOBAL array reads/writes that global directly
+    (matrix_multiply(first_matrix, ..., results_matrix) mutates
+    results_matrix, exactly as the pointer would)."""
+
+    def __init__(self, globals_: Dict[str, jax.Array]):
+        self.g = globals_          # shared, mutated in place
+        self.locals: Dict[str, jax.Array] = {}
+        self.aliases: Dict[str, str] = {}       # param name -> global name
+        self.printed: List[jax.Array] = []
+
+    def fork(self, no_print_at=None):
+        """Child scope for a traced sub-region (loop body/cond, branch).
+        ``no_print_at`` arms the printf guard: values printed inside a
+        traced sub-region are scan/cond tracers that cannot escape to the
+        program output, so the guard refuses loudly instead of letting
+        an opaque tracer-leak KeyError surface at lift time."""
+        sub = _Scope(dict(self.g))
+        sub.locals = dict(self.locals)
+        sub.aliases = dict(self.aliases)
+        sub.printed = (self.printed if no_print_at is None
+                       else _NoPrintList(no_print_at))
+        return sub
+
+    def read(self, name: str):
+        name = self.aliases.get(name, name)
+        if name in self.locals:
+            return self.locals[name]
+        if name in self.g:
+            return self.g[name]
+        raise CLiftError(f"undeclared identifier {name!r}")
+
+    def write(self, name: str, val):
+        name = self.aliases.get(name, name)
+        if name in self.locals:
+            self.locals[name] = val
+        elif name in self.g:
+            self.g[name] = val
+        else:
+            self.locals[name] = val
+
+
+def _const_int(node) -> Optional[int]:
+    # pycparser types suffixed literals "unsigned int"/"long int"/etc.
+    if isinstance(node, c_ast.Constant) and "int" in node.type:
+        return int(node.value.rstrip("uUlL"), 0)
+    if isinstance(node, c_ast.UnaryOp) and node.op == "-":
+        v = _const_int(node.expr)
+        return -v if v is not None else None
+    return None
+
+
+class _Compiler:
+    def __init__(self, tu, typedefs, funcs, name: str):
+        self.tu = tu
+        self.typedefs = typedefs
+        self.funcs = funcs
+        self.name = name
+
+    # -- expressions -------------------------------------------------------
+    def eval(self, node, sc: _Scope):
+        if isinstance(node, c_ast.Constant):
+            if "int" in node.type:
+                v = node.value.rstrip("uUlL")
+                base = int(v, 0)
+                uns = "u" in node.value.lower()
+                return (jnp.uint32(base & 0xFFFFFFFF) if uns
+                        else jnp.int32(np.int32(base & 0xFFFFFFFF)))
+            raise CLiftError(f"unsupported constant type {node.type!r}")
+        if isinstance(node, c_ast.ID):
+            return sc.read(node.name)
+        if isinstance(node, c_ast.ArrayRef):
+            arr, idx, _ = self._array_path(node, sc)
+            return arr[idx]
+        if isinstance(node, c_ast.BinaryOp):
+            return self._binop(node, sc)
+        if isinstance(node, c_ast.UnaryOp):
+            return self._unop(node, sc)
+        if isinstance(node, c_ast.TernaryOp):
+            c = self.eval(node.cond, sc)
+            a = self.eval(node.iftrue, sc)
+            b = self.eval(node.iffalse, sc)
+            a, b = self._usual_conv(a, b)
+            return jnp.where(jnp.not_equal(c, 0), a, b)
+        if isinstance(node, c_ast.FuncCall):
+            return self._call(node, sc)
+        if isinstance(node, c_ast.Cast):
+            dt = _dtype_of(node.to_type.type.type.names, self.typedefs)
+            return self.eval(node.expr, sc).astype(dt)
+        if isinstance(node, c_ast.Assignment):
+            # expression-position assignment (e.g. in for-next)
+            return self._assign(node, sc)
+        raise CLiftError(
+            f"unsupported expression {type(node).__name__} at {node.coord}")
+
+    def _usual_conv(self, a, b):
+        """C usual arithmetic conversions, ILP32 32-bit lane: if either
+        side is unsigned, both are."""
+        a = jnp.asarray(a)
+        b = jnp.asarray(b)
+        if a.dtype == jnp.uint32 or b.dtype == jnp.uint32:
+            return a.astype(jnp.uint32), b.astype(jnp.uint32)
+        return a.astype(jnp.int32), b.astype(jnp.int32)
+
+    def _binop(self, node, sc):
+        op = node.op
+        a = self.eval(node.left, sc)
+        b = self.eval(node.right, sc)
+        if op in ("&&", "||"):
+            az = jnp.not_equal(jnp.asarray(a), 0)
+            bz = jnp.not_equal(jnp.asarray(b), 0)
+            r = jnp.logical_and(az, bz) if op == "&&" else jnp.logical_or(az, bz)
+            return r.astype(jnp.int32)
+        a, b = self._usual_conv(a, b)
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return jax.lax.div(a, b) if a.dtype == jnp.int32 else a // b
+        if op == "%":
+            return jax.lax.rem(a, b) if a.dtype == jnp.int32 else a % b
+        if op == "^":
+            return a ^ b
+        if op == "&":
+            return a & b
+        if op == "|":
+            return a | b
+        if op == "<<":
+            return a << b
+        if op == ">>":
+            return a >> b
+        cmp = {"==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
+               ">": jnp.greater, "<=": jnp.less_equal,
+               ">=": jnp.greater_equal}.get(op)
+        if cmp is not None:
+            return cmp(a, b).astype(jnp.int32)
+        raise CLiftError(f"unsupported binary op {op!r} at {node.coord}")
+
+    def _unop(self, node, sc):
+        op = node.op
+        if op in ("++", "p++", "--", "p--"):
+            name = node.expr
+            old = self.eval(name, sc)
+            delta = jnp.asarray(1, old.dtype)
+            new = old + delta if "++" in op else old - delta
+            self._store(name, new, sc)
+            return old if op.startswith("p") else new
+        v = self.eval(node.expr, sc)
+        if op == "-":
+            return -v
+        if op == "+":
+            return v
+        if op == "~":
+            return ~v
+        if op == "!":
+            return jnp.equal(v, 0).astype(jnp.int32)
+        raise CLiftError(f"unsupported unary op {op!r} at {node.coord}")
+
+    def _array_path(self, node, sc):
+        """Flatten a[i][j]... into (array value, index tuple)."""
+        idxs = []
+        while isinstance(node, c_ast.ArrayRef):
+            idxs.append(node.subscript)
+            node = node.name
+        if not isinstance(node, c_ast.ID):
+            raise CLiftError(f"unsupported array base at {node.coord}")
+        arr = sc.read(node.name)
+        idx = tuple(self.eval(i, sc).astype(jnp.int32)
+                    for i in reversed(idxs))
+        return arr, (idx if len(idx) > 1 else idx[0]), node.name
+
+    def _store(self, lhs, val, sc):
+        if isinstance(lhs, c_ast.ID):
+            old = sc.read(lhs.name)
+            sc.write(lhs.name, jnp.asarray(val).astype(old.dtype)
+                     if hasattr(old, "dtype") else val)
+            return
+        if isinstance(lhs, c_ast.ArrayRef):
+            arr, idx, base = self._array_path(lhs, sc)
+            sc.write(base, arr.at[idx].set(
+                jnp.asarray(val).astype(arr.dtype)))
+            return
+        raise CLiftError(
+            f"unsupported assignment target {type(lhs).__name__}")
+
+    def _assign(self, node, sc):
+        op = node.op
+        if op == "=":
+            val = self.eval(node.rvalue, sc)
+        else:                               # += -= *= ^= ... read-mod-write
+            bin_op = op[:-1]
+            fake = c_ast.BinaryOp(bin_op, node.lvalue, node.rvalue,
+                                  node.coord)
+            val = self._binop(fake, sc)
+        self._store(node.lvalue, val, sc)
+        return val
+
+    def _call(self, node, sc):
+        if not isinstance(node.name, c_ast.ID):
+            raise CLiftError(f"unsupported indirect call at {node.coord}")
+        fname = node.name.name
+        arg_nodes = node.args.exprs if node.args else []
+        if fname == "printf":
+            # The QEMU loop's observable: everything printed is output.
+            # The format string itself is not evaluated (no string model).
+            sc.printed.extend(jnp.asarray(self.eval(a, sc))
+                              for a in arg_nodes[1:])
+            return jnp.int32(0)
+        # C array arguments are pointers: a bare ID naming a (possibly
+        # already-aliased) global array binds the parameter to that global.
+        args = []
+        for a in arg_nodes:
+            if isinstance(a, c_ast.ID):
+                tgt = sc.aliases.get(a.name, a.name)
+                if tgt in sc.g and jnp.ndim(sc.g[tgt]) >= 1:
+                    args.append(("__alias__", tgt))
+                    continue
+            args.append(self.eval(a, sc))
+        if fname in ("exit", "abort"):
+            raise CLiftError(
+                f"{fname}() needs the abort/DUE machinery; model it via "
+                "DWC (detect-only strategy) instead")
+        fn = self.funcs.get(fname)
+        if fn is None:
+            raise CLiftError(f"call to undefined function {fname!r} "
+                             f"at {node.coord}")
+        return self._run_function(fn, args, sc)
+
+    def _run_function(self, fndef, args, outer_sc: _Scope):
+        sc = _Scope(outer_sc.g)
+        sc.printed = outer_sc.printed       # printf threads through
+        params = []
+        decl = fndef.decl.type
+        if decl.args:
+            params = [p.name for p in decl.args.params
+                      if not isinstance(p, c_ast.EllipsisParam)
+                      and p.name is not None]
+        if len(params) != len(args):
+            raise CLiftError(
+                f"{fndef.decl.name}: {len(args)} args for {len(params)} "
+                "parameters (array parameters pass the global by name)")
+        for p, a in zip(params, args):
+            if isinstance(a, tuple) and len(a) == 2 and a[0] == "__alias__":
+                sc.aliases[p] = a[1]
+            else:
+                sc.locals[p] = a
+        ret = self._exec_block(fndef.body, sc)
+        return ret if ret is not None else jnp.int32(0)
+
+    # -- statements --------------------------------------------------------
+    def _exec_block(self, block, sc: _Scope):
+        if block is None:
+            return None
+        items = block.block_items or [] if isinstance(
+            block, c_ast.Compound) else [block]
+        for stmt in items:
+            ret = self._exec_stmt(stmt, sc)
+            if ret is not None:
+                return ret
+        return None
+
+    def _exec_stmt(self, stmt, sc: _Scope):
+        if isinstance(stmt, c_ast.Decl):
+            dt = _dtype_of(getattr(stmt.type.type, "names", ["int"]),
+                           self.typedefs)
+            val = (self.eval(stmt.init, sc).astype(dt)
+                   if stmt.init is not None else jnp.zeros((), dt))
+            sc.locals[stmt.name] = val
+            return None
+        if isinstance(stmt, c_ast.DeclList):
+            for d in stmt.decls:
+                self._exec_stmt(d, sc)
+            return None
+        if isinstance(stmt, c_ast.Assignment):
+            self._assign(stmt, sc)
+            return None
+        if isinstance(stmt, (c_ast.UnaryOp, c_ast.FuncCall)):
+            self.eval(stmt, sc)
+            return None
+        if isinstance(stmt, c_ast.If):
+            return self._exec_if(stmt, sc)
+        if isinstance(stmt, c_ast.For):
+            return self._exec_for(stmt, sc)
+        if isinstance(stmt, c_ast.While):
+            return self._exec_while(stmt, sc)
+        if isinstance(stmt, c_ast.Return):
+            return (self.eval(stmt.expr, sc) if stmt.expr is not None
+                    else jnp.int32(0))
+        if isinstance(stmt, c_ast.Compound):
+            return self._exec_block(stmt, sc)
+        if isinstance(stmt, c_ast.EmptyStatement):
+            return None
+        raise CLiftError(
+            f"unsupported statement {type(stmt).__name__} at {stmt.coord}")
+
+    def _assigned_names(self, node) -> List[str]:
+        """Names written anywhere under ``node`` (loop-carry discovery)."""
+        names: List[str] = []
+
+        class V(c_ast.NodeVisitor):
+            def visit_Assignment(v, n):
+                t = n.lvalue
+                while isinstance(t, c_ast.ArrayRef):
+                    t = t.name
+                if isinstance(t, c_ast.ID):
+                    names.append(t.name)
+                v.generic_visit(n)
+
+            def visit_UnaryOp(v, n):
+                if n.op in ("++", "p++", "--", "p--"):
+                    t = n.expr
+                    while isinstance(t, c_ast.ArrayRef):
+                        t = t.name
+                    if isinstance(t, c_ast.ID):
+                        names.append(t.name)
+                v.generic_visit(n)
+
+            def visit_Decl(v, n):
+                if n.name:
+                    names.append(n.name)
+                v.generic_visit(n)
+
+            def visit_FuncCall(v, n):
+                # A called function may write globals directly or through
+                # an array-pointer parameter; conservatively treat every
+                # ID argument and every callee-assigned name as written
+                # (read-only extras become loop-invariant carries, which
+                # XLA hoists).
+                if isinstance(n.name, c_ast.ID):
+                    for a in (n.args.exprs if n.args else []):
+                        if isinstance(a, c_ast.ID):
+                            names.append(a.name)
+                    callee = self.funcs.get(n.name.name)
+                    if callee is not None:
+                        names.extend(self._assigned_names(callee.body))
+                v.generic_visit(n)
+
+        V().visit(node)
+        return list(dict.fromkeys(names))
+
+    def written_globals(self, fndef, g_names, subst=None):
+        """Globals (transitively) written by ``fndef``, following array-
+        argument aliasing: a callee's writes through an array parameter
+        count against the global the caller passed."""
+        subst = subst or {}
+        out = set()
+        comp = self
+
+        def target_of(t):
+            while isinstance(t, c_ast.ArrayRef):
+                t = t.name
+            if isinstance(t, c_ast.ID):
+                return subst.get(t.name, t.name)
+            return None
+
+        class V(c_ast.NodeVisitor):
+            def visit_Assignment(v, n):
+                tgt = target_of(n.lvalue)
+                if tgt in g_names:
+                    out.add(tgt)
+                v.generic_visit(n)
+
+            def visit_UnaryOp(v, n):
+                if n.op in ("++", "p++", "--", "p--"):
+                    tgt = target_of(n.expr)
+                    if tgt in g_names:
+                        out.add(tgt)
+                v.generic_visit(n)
+
+            def visit_FuncCall(v, n):
+                if isinstance(n.name, c_ast.ID):
+                    callee = comp.funcs.get(n.name.name)
+                    if callee is not None:
+                        decl = callee.decl.type
+                        params = ([p.name for p in decl.args.params
+                                   if not isinstance(p, c_ast.EllipsisParam)
+                                   and p.name is not None]
+                                  if decl.args else [])
+                        sub2 = {}
+                        args = n.args.exprs if n.args else []
+                        for p, a in zip(params, args):
+                            if isinstance(a, c_ast.ID):
+                                tgt = subst.get(a.name, a.name)
+                                if tgt in g_names:
+                                    sub2[p] = tgt
+                        out.update(comp.written_globals(
+                            callee, g_names, sub2))
+                v.generic_visit(n)
+
+        V().visit(fndef.body)
+        return out
+
+    def _loop_carry(self, stmt, sc) -> List[str]:
+        """Variables the loop body writes that already exist in scope (the
+        scan/while carry); body-local declarations stay local."""
+        assigned = [sc.aliases.get(n, n) for n in self._assigned_names(stmt)]
+        return [n for n in dict.fromkeys(assigned)
+                if n in sc.locals or n in sc.g]
+
+    def _exec_for(self, stmt, sc: _Scope):
+        if stmt.init is not None:
+            self._exec_stmt(stmt.init, sc)
+        carry_names = self._loop_carry(stmt, sc)
+
+        def pack():
+            return tuple(sc.read(n) for n in carry_names)
+
+        def unpack(sub_sc, vals):
+            for n, v in zip(carry_names, vals):
+                sub_sc.write(n, v)
+
+        trip = self._static_trip(stmt, sc)
+        if trip is not None:
+            def body(carry, _):
+                sub = sc.fork(no_print_at=stmt.coord)
+                unpack(sub, carry)
+                ret = self._exec_block(stmt.stmt, sub)
+                if ret is not None:
+                    raise CLiftError(
+                        f"return inside a loop at {stmt.coord}; restructure")
+                if stmt.next is not None:
+                    self.eval(stmt.next, sub)
+                return tuple(sub.read(n) for n in carry_names), None
+
+            out, _ = jax.lax.scan(body, pack(), None, length=trip)
+            unpack(sc, out)
+            return None
+
+        # General for: lower as while with explicit cond/next.
+        def cond_f(carry):
+            sub = sc.fork(no_print_at=stmt.coord)
+            unpack(sub, carry)
+            c = (self.eval(stmt.cond, sub) if stmt.cond is not None
+                 else jnp.int32(1))
+            return jnp.not_equal(c, 0)
+
+        def body_f(carry):
+            sub = sc.fork(no_print_at=stmt.coord)
+            unpack(sub, carry)
+            ret = self._exec_block(stmt.stmt, sub)
+            if ret is not None:
+                raise CLiftError(
+                    f"return inside a loop at {stmt.coord}; restructure")
+            if stmt.next is not None:
+                self.eval(stmt.next, sub)
+            return tuple(sub.read(n) for n in carry_names)
+
+        out = jax.lax.while_loop(cond_f, body_f, pack())
+        unpack(sc, out)
+        return None
+
+    def _exec_while(self, stmt, sc: _Scope):
+        fake = c_ast.For(None, stmt.cond, None, stmt.stmt, stmt.coord)
+        return self._exec_for(fake, sc)
+
+    def _static_trip(self, stmt, sc) -> Optional[int]:
+        """Trip count for the canonical `for (i = A; i < B; i++)` shape
+        with literal A/B and the loop variable not written in the body."""
+        init, cond, nxt = stmt.init, stmt.cond, stmt.next
+        if init is None or cond is None or nxt is None:
+            return None
+        # init: i = A (assignment or single decl)
+        if isinstance(init, c_ast.DeclList) and len(init.decls) == 1:
+            var, a = init.decls[0].name, _const_int(init.decls[0].init)
+        elif isinstance(init, c_ast.Assignment) and init.op == "=" \
+                and isinstance(init.lvalue, c_ast.ID):
+            var, a = init.lvalue.name, _const_int(init.rvalue)
+        else:
+            return None
+        if a is None:
+            return None
+        if not (isinstance(cond, c_ast.BinaryOp) and cond.op in ("<", "<=")
+                and isinstance(cond.left, c_ast.ID)
+                and cond.left.name == var):
+            return None
+        b = _const_int(cond.right)
+        if b is None:
+            return None
+        inc_ok = (isinstance(nxt, c_ast.UnaryOp)
+                  and nxt.op in ("++", "p++")
+                  and isinstance(nxt.expr, c_ast.ID)
+                  and nxt.expr.name == var)
+        if not inc_ok:
+            return None
+        # The loop variable must not be written inside the body (the scan
+        # carries it via the next-expression only).
+        if var in self._assigned_names(stmt.stmt):
+            return None
+        trip = (b - a) + (1 if cond.op == "<=" else 0)
+        return max(0, trip)
+
+    def _exec_if(self, stmt, sc: _Scope):
+        carry_names = self._loop_carry(stmt, sc)
+        c = jnp.not_equal(self.eval(stmt.cond, sc), 0)
+
+        def branch(node):
+            def run(vals):
+                sub = sc.fork(no_print_at=stmt.coord)
+                for n, v in zip(carry_names, vals):
+                    sub.write(n, v)
+                if node is not None:
+                    ret = self._exec_block(node, sub)
+                    if ret is not None:
+                        raise CLiftError(
+                            f"return inside if at {stmt.coord}; restructure")
+                return tuple(sub.read(n) for n in carry_names)
+            return run
+
+        vals = tuple(sc.read(n) for n in carry_names)
+        out = jax.lax.cond(c, branch(stmt.iftrue), branch(stmt.iffalse),
+                           vals)
+        for n, v in zip(carry_names, out):
+            sc.write(n, v)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Translation-unit ingestion
+# ---------------------------------------------------------------------------
+
+def _parse_globals(tu, typedefs):
+    """Global declarations -> {name: jnp array} (initializers evaluated)."""
+    out: Dict[str, jax.Array] = {}
+
+    def flat_init(init) -> List[int]:
+        if isinstance(init, c_ast.InitList):
+            vals = []
+            for e in init.exprs:
+                vals.extend(flat_init(e))
+            return vals
+        v = _const_int(init)
+        if v is None:
+            raise CLiftError(f"unsupported global initializer at "
+                             f"{init.coord}")
+        return [v]
+
+    for ext in tu.ext:
+        if not isinstance(ext, c_ast.Decl) or isinstance(
+                ext.type, c_ast.FuncDecl):
+            continue
+        t = ext.type
+        shape = []
+        while isinstance(t, c_ast.ArrayDecl):
+            n = _const_int(t.dim)
+            if n is None:
+                raise CLiftError(f"non-literal array dim for {ext.name}")
+            shape.append(n)
+            t = t.type
+        if isinstance(t, c_ast.TypeDecl):
+            dt = _dtype_of(t.type.names, typedefs)
+        else:
+            raise CLiftError(f"unsupported global type for {ext.name}")
+        if ext.init is not None:
+            # int64 container so negative initializers wrap mod 2^32 (C
+            # conversion to a 32-bit lane); partial initializer lists
+            # zero-fill the tail, per C aggregate-initialization rules.
+            vals = np.array(flat_init(ext.init), dtype=np.int64)
+            total = int(np.prod(shape)) if shape else 1
+            if len(vals) > total:
+                raise CLiftError(
+                    f"{ext.name}: {len(vals)} initializers for "
+                    f"{total} elements")
+            vals = np.concatenate(
+                [vals, np.zeros(total - len(vals), np.int64)])
+            arr = jnp.asarray(
+                (vals & 0xFFFFFFFF).astype(np.uint32)).astype(dt)
+            arr = arr.reshape(shape) if shape else arr.reshape(())
+        else:
+            arr = jnp.zeros(tuple(shape) if shape else (), dt)
+        out[ext.name] = arr
+    return out
+
+
+def parse_c_sources(paths: Sequence[str]):
+    """Parse + link the restricted-C sources into (tu, globals, funcs,
+    typedefs, coast_annotations)."""
+    if not _HAVE_PYCPARSER:
+        raise CLiftError("pycparser is unavailable on this host")
+    include_dirs = sorted({os.path.dirname(os.path.abspath(p))
+                           for p in paths})
+    texts, anns = [], []
+    for p in paths:
+        with open(p) as f:
+            src, _, ann = preprocess(f.read(), include_dirs)
+        texts.append(src)
+        anns.extend(ann)
+    parser = c_parser.CParser()
+    tu = parser.parse(_PRELUDE + "\n".join(texts), filename="<coast_tpu>")
+
+    typedefs: Dict[str, object] = {}
+    funcs: Dict[str, object] = {}
+    for ext in tu.ext:
+        if isinstance(ext, c_ast.Typedef):
+            base = ext.type
+            if isinstance(base, c_ast.TypeDecl):
+                names = getattr(base.type, "names", ["int"])
+                if any(n in _NARROW for n in names) or any(
+                        isinstance(typedefs.get(n), _NarrowType)
+                        for n in names):
+                    typedefs[ext.name] = _NarrowType(ext.name)
+                else:
+                    typedefs[ext.name] = _dtype_of(names, typedefs)
+        elif isinstance(ext, c_ast.FuncDef):
+            funcs[ext.decl.name] = ext
+    globals_ = _parse_globals(tu, typedefs)
+    return tu, globals_, funcs, typedefs, anns
+
+
+def lift_c(name: str,
+           sources: Sequence[str],
+           *,
+           entry: str = "main",
+           annotations: Optional[Dict[str, LeafSpec]] = None,
+           default_xmr: Optional[bool] = None,
+           max_steps: Optional[int] = None,
+           meta: Optional[dict] = None) -> Region:
+    """Ingest C sources and derive a protected Region.
+
+    Globals become the lifted function's inputs (hence injectable leaves
+    named by ``lift_fn``'s layout); written globals plus every value the
+    program printf'd become its outputs.  ``entry`` (default ``main``) is
+    executed.  COAST.h macros in the source set ``default_xmr`` unless
+    overridden."""
+    tu, globals_, funcs, typedefs, anns = parse_c_sources(sources)
+    if entry not in funcs:
+        raise CLiftError(
+            f"entry function {entry!r} not defined; have "
+            f"{sorted(funcs)}")
+    if default_xmr is None:
+        default_xmr = "__DEFAULT_NO_xMR" not in anns
+
+    comp = _Compiler(tu, typedefs, funcs, name)
+    g_names = sorted(globals_)
+    out_globals = sorted(comp.written_globals(funcs[entry], set(g_names)))
+
+    def program(*g_vals):
+        sc = _Scope(dict(zip(g_names, g_vals)))
+        comp._run_function(funcs[entry], [], sc)
+        outs = [sc.g[n] for n in out_globals] + list(sc.printed)
+        return tuple(outs)
+
+    example = [globals_[n] for n in g_names]
+    region = lift_fn(
+        name, program, *example,
+        annotations=annotations, default_xmr=default_xmr,
+        max_steps=max_steps,
+        meta={"frontend": "c", "sources": [os.path.basename(s)
+                                           for s in sources],
+              "coast_annotations": sorted(set(anns)),
+              "observed_globals": out_globals, **(meta or {})})
+    return region

@@ -227,7 +227,7 @@ def lift_step(name: str,
 
 
 # ---------------------------------------------------------------------------
-# lift_fn: whole jittable function -> Region (auto-stepped at the main loop)
+# lift_fn: whole jittable function -> Region (auto-stepped at its loops)
 # ---------------------------------------------------------------------------
 
 def _read(env, v):
@@ -250,13 +250,187 @@ def _eval_eqns(eqns, env) -> None:
             env[v] = o
 
 
-def _loop_score(eqn) -> int:
-    """Rank candidate main loops by estimated dynamic work."""
-    if eqn.primitive.name == "scan":
-        body = eqn.params["jaxpr"].jaxpr
-        return int(eqn.params["length"]) * max(len(body.eqns), 1)
-    body = eqn.params["body_jaxpr"].jaxpr
-    return 64 * max(len(body.eqns), 1)   # trip count unknown; assume modest
+_HEAVY_PRIMS = ("dot_general", "conv_general_dilated", "scan", "while",
+                "sort", "fft")
+
+
+def _all_prims(eqns):
+    """Primitive names in ``eqns``, recursing into sub-jaxprs (pjit/jit
+    wrap whole calls like jnp.sort in one opaque equation)."""
+    for e in eqns:
+        yield e.primitive.name
+        for param in e.params.values():
+            objs = param if isinstance(param, (list, tuple)) else [param]
+            for obj in objs:
+                if hasattr(obj, "jaxpr"):          # ClosedJaxpr -> Jaxpr
+                    obj = obj.jaxpr
+                if hasattr(obj, "eqns"):
+                    yield from _all_prims(obj.eqns)
+
+
+def _warn_unstepped(eqns, where: str) -> None:
+    """Loudly flag program work that will execute OUTSIDE the stepped
+    injection window (inside output()): the reference engine protects the
+    whole module (cloning.cpp:62-288), so silently un-stepped compute
+    would under-report the program's cross-section."""
+    heavy = [p for p in _all_prims(eqns) if p in _HEAVY_PRIMS]
+    if heavy or len(eqns) > 24:
+        import warnings
+        what = (f"heavy ops {sorted(set(heavy))}" if heavy
+                else f"{len(eqns)} equations")
+        warnings.warn(
+            f"lift_fn: the {where} contains {what} that run inside "
+            "output(), OUTSIDE the stepped injection window -- faults are "
+            "never injected during that work.  Restructure so the work "
+            "lives in a top-level lax.scan/while_loop (each becomes a "
+            "stepped phase), or author the region via lift_step.",
+            stacklevel=3)
+
+
+class _Phase:
+    """One top-level loop as a stepped phase: leaf layout, per-iteration
+    step, completion predicate, and the mapping from state back to the
+    loop equation's outvars.  ``prefix`` namespaces the leaves (empty for
+    the single-loop layout, ``p<N>_`` for multi-phase regions); scans use
+    ``idx_name`` as their iteration counter leaf."""
+
+    def __init__(self, eqn, prefix: str, idx_name: str):
+        self.eqn = eqn
+        self.prefix = prefix
+        self.prim = eqn.primitive.name
+        self.idx_name = idx_name
+        if self.prim == "scan":
+            if eqn.params.get("reverse", False):
+                raise LiftError(
+                    "reverse scan is not supported; re-express the loop "
+                    "forward or use lift_step")
+            self.n_consts = eqn.params["num_consts"]
+            self.n_carry = eqn.params["num_carry"]
+            self.length = int(eqn.params["length"])
+            self.body = eqn.params["jaxpr"]              # ClosedJaxpr
+            self.n_xs = len(eqn.invars) - self.n_consts - self.n_carry
+            self.ys_avals = [ov.aval for ov in eqn.outvars[self.n_carry:]]
+        else:  # while
+            self.cn = eqn.params["cond_nconsts"]
+            self.bn = eqn.params["body_nconsts"]
+            self.cond_j = eqn.params["cond_jaxpr"]
+            self.body_j = eqn.params["body_jaxpr"]
+            self.n_carry = len(eqn.invars) - self.cn - self.bn
+
+    # -- leaf layout -------------------------------------------------------
+    def leaves_from_invals(self, in_vals) -> Dict[str, jax.Array]:
+        """Leaf dict for this phase given concrete/traced loop inputs."""
+        p = self.prefix
+        st: Dict[str, jax.Array] = {}
+        if self.prim == "scan":
+            st[self.idx_name] = jnp.int32(0)
+            consts = in_vals[:self.n_consts]
+            carry = in_vals[self.n_consts:self.n_consts + self.n_carry]
+            xs = in_vals[self.n_consts + self.n_carry:]
+            for j, v in enumerate(consts):
+                st[f"{p}k{j}"] = v
+            for j, v in enumerate(carry):
+                st[f"{p}c{j}"] = v
+            for j, v in enumerate(xs):
+                st[f"{p}x{j}"] = v
+            for j, av in enumerate(self.ys_avals):
+                st[f"{p}y{j}"] = jnp.zeros(av.shape, av.dtype)
+        else:
+            cconsts = in_vals[:self.cn]
+            bconsts = in_vals[self.cn:self.cn + self.bn]
+            carry = in_vals[self.cn + self.bn:]
+            for j, v in enumerate(cconsts):
+                st[f"{p}kc{j}"] = v
+            for j, v in enumerate(bconsts):
+                st[f"{p}k{j}"] = v
+            for j, v in enumerate(carry):
+                st[f"{p}c{j}"] = v
+        return st
+
+    def zero_leaves(self) -> Dict[str, jax.Array]:
+        """Placeholder leaves for a phase whose inputs arrive at runtime
+        (written by the preceding interlude transition)."""
+        zeros = [jnp.zeros(v.aval.shape, v.aval.dtype) for v in
+                 self.eqn.invars]
+        return self.leaves_from_invals(zeros)
+
+    # -- runtime behavior --------------------------------------------------
+    def iter_step(self, st):
+        p = self.prefix
+        new = dict(st)
+        if self.prim == "scan":
+            i = st[self.idx_name]
+            args = ([st[f"{p}k{j}"] for j in range(self.n_consts)]
+                    + [st[f"{p}c{j}"] for j in range(self.n_carry)]
+                    + [jax.lax.dynamic_index_in_dim(
+                        st[f"{p}x{j}"], i, axis=0, keepdims=False)
+                       for j in range(self.n_xs)])
+            outs = jax.core.eval_jaxpr(self.body.jaxpr, self.body.consts,
+                                       *args)
+            for j in range(self.n_carry):
+                new[f"{p}c{j}"] = outs[j]
+            for j, y in enumerate(outs[self.n_carry:]):
+                new[f"{p}y{j}"] = jax.lax.dynamic_update_index_in_dim(
+                    st[f"{p}y{j}"], y, i, axis=0)
+            new[self.idx_name] = i + 1
+        else:
+            args = ([st[f"{p}k{j}"] for j in range(self.bn)]
+                    + [st[f"{p}c{j}"] for j in range(self.n_carry)])
+            outs = jax.core.eval_jaxpr(self.body_j.jaxpr,
+                                       self.body_j.consts, *args)
+            for j, o in enumerate(outs):
+                new[f"{p}c{j}"] = o
+        return new
+
+    def phase_done(self, st):
+        p = self.prefix
+        if self.prim == "scan":
+            return st[self.idx_name] >= self.length
+        args = ([st[f"{p}kc{j}"] for j in range(self.cn)]
+                + [st[f"{p}c{j}"] for j in range(self.n_carry)])
+        (alive,) = jax.core.eval_jaxpr(self.cond_j.jaxpr,
+                                       self.cond_j.consts, *args)
+        return jnp.logical_not(alive)
+
+    def outs_from_state(self, st):
+        p = self.prefix
+        outs = [st[f"{p}c{j}"] for j in range(self.n_carry)]
+        if self.prim == "scan":
+            outs += [st[f"{p}y{j}"] for j in range(len(self.ys_avals))]
+        return outs
+
+
+def _free_prologue_vars(segments, loops, env, outvars) -> List[object]:
+    """Prologue-computed vars consumed after the loop boundary: by any
+    interlude/epilogue equation, any later loop's inputs, or the function
+    outputs.  These must stay injectable (ro leaves), not vanish into a
+    baked closure -- the reference engine protects them as globals."""
+    produced_after = set()
+    for seg in segments:
+        for eqn in seg:
+            produced_after.update(eqn.outvars)
+    for loop in loops:
+        produced_after.update(loop.outvars)
+    needed: List[object] = []
+    seen = set()
+
+    def visit(v):
+        if isinstance(v, Literal) or v in seen:
+            return
+        seen.add(v)
+        if v in env and v not in produced_after:
+            needed.append(v)
+
+    for seg in segments:
+        for eqn in seg:
+            for v in eqn.invars:
+                visit(v)
+    for loop in loops[1:]:
+        for v in loop.invars:
+            visit(v)
+    for v in outvars:              # fn may return a prologue value directly
+        visit(v)
+    return needed
 
 
 def lift_fn(name: str,
@@ -269,24 +443,31 @@ def lift_fn(name: str,
             meta: Optional[dict] = None) -> Region:
     """Derive a Region from a whole jittable function.
 
-    The dominant top-level ``lax.scan`` / ``lax.while_loop`` becomes the
-    step boundary; everything before it is evaluated once into the initial
-    state, everything after it becomes the output projection.  State leaf
-    names: ``c<i>`` loop carries, ``k<i>`` loop-invariant captures (read-
-    only), ``x<i>`` scanned inputs, ``y<i>`` stacked scan outputs, ``_t``
-    the step counter.
+    EVERY top-level ``lax.scan`` / ``lax.while_loop`` becomes a stepped
+    phase (the reference protects the whole module, cloning.cpp:62-288,
+    not just its hottest loop).  The prologue is evaluated once into the
+    initial state; code between loops (interludes) runs as stepped phase
+    transitions; the epilogue after the last loop becomes the output
+    projection (warned about loudly if it contains real work, since it
+    executes outside the injection window).
+
+    Single-loop leaf names: ``c<i>`` loop carries, ``k<i>`` loop-invariant
+    captures (read-only), ``x<i>`` scanned inputs, ``y<i>`` stacked scan
+    outputs, ``_t`` the step counter, ``g<i>`` prologue values the
+    epilogue reads (read-only, injectable).  Multi-loop regions prefix
+    per-phase leaves ``p<N>_`` and add ``_phase`` plus ``m<i>`` leaves for
+    interlude values consumed by later phases.
     """
     closed = jax.make_jaxpr(fn)(*example_args)
     jaxpr = closed.jaxpr
 
-    loops = [(i, e) for i, e in enumerate(jaxpr.eqns)
-             if e.primitive.name in ("scan", "while")]
-    if not loops:
+    loop_idx = [i for i, e in enumerate(jaxpr.eqns)
+                if e.primitive.name in ("scan", "while")]
+    if not loop_idx:
         raise LiftError(
             "no top-level lax.scan/lax.while_loop found to step the program "
             "at; write the main loop with lax.scan/while_loop, or author a "
             "stepped region via lift_step()")
-    k, loop = max(loops, key=lambda ie: _loop_score(ie[1]))
 
     # -- prologue: evaluate to concrete values at lift time ----------------
     env: Dict[object, object] = {}
@@ -299,114 +480,207 @@ def lift_fn(name: str,
         env[v] = jnp.asarray(val)
     for v, val in zip(jaxpr.constvars, closed.consts):
         env[v] = jnp.asarray(val)
-    _eval_eqns(jaxpr.eqns[:k], env)
+    _eval_eqns(jaxpr.eqns[:loop_idx[0]], env)
 
-    prim = loop.primitive.name
-    if prim == "scan":
-        if loop.params.get("reverse", False):
-            raise LiftError("reverse scan is not supported; re-express the "
-                            "loop forward or use lift_step")
-        n_consts = loop.params["num_consts"]
-        n_carry = loop.params["num_carry"]
-        length = int(loop.params["length"])
-        body = loop.params["jaxpr"]          # ClosedJaxpr
-        in_vals = [_read(env, v) for v in loop.invars]
-        consts, carry0 = in_vals[:n_consts], in_vals[n_consts:n_consts + n_carry]
-        xs = in_vals[n_consts + n_carry:]
-        ys_avals = [ov.aval for ov in loop.outvars[n_carry:]]
+    loops = [jaxpr.eqns[i] for i in loop_idx]
+    segments = [jaxpr.eqns[loop_idx[p] + 1:
+                           (loop_idx[p + 1] if p + 1 < len(loop_idx)
+                            else len(jaxpr.eqns))]
+                for p in range(len(loop_idx))]
+    _warn_unstepped(segments[-1], "epilogue (code after the last loop)")
 
-        def init_fn():
-            st = {"_t": jnp.int32(0)}
-            for j, v in enumerate(consts):
-                st[f"k{j}"] = v
-            for j, v in enumerate(carry0):
-                st[f"c{j}"] = v
-            for j, v in enumerate(xs):
-                st[f"x{j}"] = v
-            for j, av in enumerate(ys_avals):
-                st[f"y{j}"] = jnp.zeros(av.shape, av.dtype)
-            return st
+    # Prologue values consumed past the loop boundary become ro leaves
+    # (g<j>); non-32-bit ones cannot enter the word-addressed memory map
+    # and stay baked (same as the reference's non-word data).
+    g_vars = _free_prologue_vars(segments, loops, env, jaxpr.outvars)
+    g_map = {}                       # var -> leaf name (injectable)
+    baked = {}                       # var -> concrete value (not injectable)
+    for v in g_vars:
+        val = jnp.asarray(env[v])
+        if val.dtype in _32BIT:
+            g_map[v] = f"g{len(g_map)}"
+        else:
+            baked[v] = val
 
-        def step(st, t):
-            i = st["_t"]
-            args = ([st[f"k{j}"] for j in range(n_consts)]
-                    + [st[f"c{j}"] for j in range(n_carry)]
-                    + [jax.lax.dynamic_index_in_dim(st[f"x{j}"], i, axis=0,
-                                                    keepdims=False)
-                       for j in range(len(xs))])
-            outs = jax.core.eval_jaxpr(body.jaxpr, body.consts, *args)
-            new = dict(st)
-            for j in range(n_carry):
-                new[f"c{j}"] = outs[j]
-            for j, y in enumerate(outs[n_carry:]):
-                new[f"y{j}"] = jax.lax.dynamic_update_index_in_dim(
-                    st[f"y{j}"], y, i, axis=0)
-            new["_t"] = i + 1
-            return new
+    if len(loops) == 1:
+        region = _lift_fn_single(name, jaxpr, loops[0], segments[0], env,
+                                 g_map, baked, annotations, default_xmr,
+                                 max_steps, step_cap, meta)
+    else:
+        region = _lift_fn_multi(name, jaxpr, loops, segments, env,
+                                g_map, baked, annotations, default_xmr,
+                                max_steps, step_cap, meta)
+    return region
 
-        def done(st):
-            return st["_t"] >= length
 
-        def loop_outs_from_state(st):
-            return ([st[f"c{j}"] for j in range(n_carry)]
-                    + [st[f"y{j}"] for j in range(len(ys_avals))])
+def _seed_env(st, g_map, baked):
+    e = {v: st[leaf] for v, leaf in g_map.items()}
+    e.update(baked)
+    return e
 
-        nominal = length
-    else:  # while
-        cn = loop.params["cond_nconsts"]
-        bn = loop.params["body_nconsts"]
-        cond_j = loop.params["cond_jaxpr"]
-        body_j = loop.params["body_jaxpr"]
-        in_vals = [_read(env, v) for v in loop.invars]
-        cconsts, bconsts = in_vals[:cn], in_vals[cn:cn + bn]
-        carry0 = in_vals[cn + bn:]
 
-        def init_fn():
-            st = {}
-            for j, v in enumerate(cconsts):
-                st[f"kc{j}"] = v
-            for j, v in enumerate(bconsts):
-                st[f"k{j}"] = v
-            for j, v in enumerate(carry0):
-                st[f"c{j}"] = v
-            return st
+def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
+                    annotations, default_xmr, max_steps, step_cap, meta):
+    phase = _Phase(loop, prefix="", idx_name="_t")
+    in_vals = [_read(env, v) for v in loop.invars]
+    base_leaves = phase.leaves_from_invals(in_vals)
+    g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
 
-        def step(st, t):
-            args = ([st[f"k{j}"] for j in range(bn)]
-                    + [st[f"c{j}"] for j in range(len(carry0))])
-            outs = jax.core.eval_jaxpr(body_j.jaxpr, body_j.consts, *args)
-            new = dict(st)
-            for j, o in enumerate(outs):
-                new[f"c{j}"] = o
-            return new
+    def init_fn():
+        return {**base_leaves, **g_leaves}
 
-        def done(st):
-            args = ([st[f"kc{j}"] for j in range(cn)]
-                    + [st[f"c{j}"] for j in range(len(carry0))])
-            (alive,) = jax.core.eval_jaxpr(cond_j.jaxpr, cond_j.consts, *args)
-            return jnp.logical_not(alive)
+    def step(st, t):
+        return phase.iter_step(st)
 
-        def loop_outs_from_state(st):
-            return [st[f"c{j}"] for j in range(len(carry0))]
-
-        nominal = None  # measured by lift_step
-
-    # -- epilogue: output projection over the final state ------------------
-    epi_eqns = jaxpr.eqns[k + 1:]
-    # Values the epilogue / function outputs need from before the loop are
-    # baked in as constants (they are loop-invariant by construction).
-    frozen_env = dict(env)
+    def done(st):
+        return phase.phase_done(st)
 
     def output(st):
-        e = dict(frozen_env)
-        for v, val in zip(loop.outvars, loop_outs_from_state(st)):
+        e = _seed_env(st, g_map, baked)
+        for v, val in zip(loop.outvars, phase.outs_from_state(st)):
             e[v] = val
         _eval_eqns(epi_eqns, e)
         return _flat_u32([_read(e, v) for v in jaxpr.outvars])
 
+    nominal = phase.length if phase.prim == "scan" else None
     return lift_step(
         name, step, init_fn, done=done, output=output,
         nominal_steps=nominal, max_steps=max_steps,
         annotations=annotations, default_xmr=default_xmr,
         step_cap=step_cap,
-        meta={"lifted_from": "fn", "loop": prim, **(meta or {})})
+        meta={"lifted_from": "fn", "loop": phase.prim, **(meta or {})})
+
+
+def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
+                   annotations, default_xmr, max_steps, step_cap, meta):
+    """Multi-phase region: phase p executes loop p one iteration per step;
+    when loop p completes, ONE transition step evaluates the interlude
+    (code between loop p and loop p+1), seeds phase p+1's leaves, and
+    advances ``_phase``.  The epilogue stays in output()."""
+    m = len(loops)
+    phases = [_Phase(loops[p], prefix=f"p{p}_", idx_name=f"p{p}_i")
+              for p in range(m)]
+
+    # Interlude values consumed by LATER segments (beyond the transition
+    # that computes them) must live in state: m<j> leaves.
+    produced_by_seg = [set(ov for eqn in segments[p] for ov in eqn.outvars)
+                       for p in range(m)]
+    mm_map: Dict[object, str] = {}       # var -> m<j> leaf name
+    m_producer: Dict[object, int] = {}   # var -> producing segment index
+    for p in range(m - 1):               # the epilogue's outputs go nowhere
+        consumed_later = set()
+        for q in range(p + 1, m):
+            for eqn in segments[q]:
+                consumed_later.update(v for v in eqn.invars
+                                      if not isinstance(v, Literal))
+            consumed_later.update(v for v in loops[q].invars
+                                  if not isinstance(v, Literal))
+        consumed_later.update(v for v in jaxpr.outvars
+                              if not isinstance(v, Literal))
+        for v in produced_by_seg[p]:
+            if v in consumed_later and v not in mm_map:
+                aval = v.aval
+                if aval.dtype not in _32BIT:
+                    raise LiftError(
+                        f"interlude value of dtype {aval.dtype} is "
+                        "consumed by a later phase; only 32-bit values "
+                        "can cross phases (word-addressed memory map)")
+                mm_map[v] = f"m{len(mm_map)}"
+                m_producer[v] = p
+
+    g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
+    in_vals0 = [_read(env, v) for v in loops[0].invars]
+
+    def init_fn():
+        st = {"_phase": jnp.int32(0), **g_leaves}
+        st.update(phases[0].leaves_from_invals(in_vals0))
+        for p in range(1, m):
+            st.update(phases[p].zero_leaves())
+        for v, leaf in mm_map.items():
+            st[leaf] = jnp.zeros(v.aval.shape, v.aval.dtype)
+        return st
+
+    def full_env(st, upto: int):
+        """Env with g/m leaves and the outvars of loops 0..upto."""
+        e = _seed_env(st, g_map, baked)
+        for v, leaf in mm_map.items():
+            e[v] = st[leaf]
+        for q in range(upto + 1):
+            for v, val in zip(loops[q].outvars,
+                              phases[q].outs_from_state(st)):
+                e[v] = val
+        return e
+
+    def transition(p):
+        """Loop p finished: evaluate interlude p, seed phase p+1, advance."""
+        def tr(st):
+            new = dict(st)
+            if p < m - 1:
+                e = full_env(st, p)
+                _eval_eqns(segments[p], e)
+                in_vals = [_read(e, v) for v in loops[p + 1].invars]
+                new.update(phases[p + 1].leaves_from_invals(in_vals))
+                for v, leaf in mm_map.items():
+                    if m_producer[v] == p:
+                        new[leaf] = e[v]
+            new["_phase"] = st["_phase"] + 1
+            return new
+        return tr
+
+    def phase_branch(p):
+        def br(st):
+            return jax.lax.cond(phases[p].phase_done(st), transition(p),
+                                phases[p].iter_step, st)
+        return br
+
+    branches = [phase_branch(p) for p in range(m)]
+
+    def step(st, t):
+        ph = jnp.clip(st["_phase"], 0, m - 1)
+        return jax.lax.switch(ph, branches, st)
+
+    def done(st):
+        return st["_phase"] >= m
+
+    def output(st):
+        e = full_env(st, m - 1)
+        _eval_eqns(segments[m - 1], e)
+        return _flat_u32([_read(e, v) for v in jaxpr.outvars])
+
+    # Explicit prologue/loop/interlude/epilogue structure for CFCSS:
+    # entry=0, loop<p>=2p+1, inter<p>=2p+2, exit=2m+1.  inter<m-1> is the
+    # final transition into exit (the epilogue itself runs in output()).
+    names = ["entry"]
+    for p in range(m):
+        names += [f"loop{p}", f"inter{p}"]
+    names.append("exit")
+    edges = [(0, 1), (0, 2)]
+    for p in range(m):
+        lp, ip = 2 * p + 1, 2 * p + 2
+        edges += [(lp, lp), (lp, ip)]
+        nxt = 2 * (p + 1) + 1 if p + 1 < m else 2 * m + 1
+        edges.append((ip, nxt))
+        if p + 1 < m:
+            edges.append((ip, 2 * (p + 1) + 2))   # zero-trip next loop
+    exit_b = 2 * m + 1
+
+    def block_of(st):
+        def blk(p):
+            def b(s):
+                return jnp.where(phases[p].phase_done(s),
+                                 jnp.int32(2 * p + 2), jnp.int32(2 * p + 1))
+            return b
+        ph = jnp.clip(st["_phase"], 0, m - 1)
+        inner = jax.lax.switch(ph, [blk(p) for p in range(m)], st)
+        return jnp.where(st["_phase"] >= m, jnp.int32(exit_b),
+                         inner).astype(jnp.int32)
+
+    graph = BlockGraph(names=names, edges=edges, block_of=block_of)
+
+    return lift_step(
+        name, step, init_fn, done=done, output=output,
+        nominal_steps=None, max_steps=max_steps,
+        annotations=annotations, default_xmr=default_xmr,
+        step_cap=step_cap, graph=graph,
+        meta={"lifted_from": "fn", "loops": [ph.prim for ph in phases],
+              "phases": m, **(meta or {})})

@@ -262,3 +262,157 @@ def test_lift_step_rejects_nontermination():
     with pytest.raises(LiftError, match="did not terminate"):
         lift_step("hang", step, init, done=lambda s: s["i"] >= 4,
                   step_cap=1 << 10)
+
+
+# ---------------------------------------------------------------------------
+# Multi-loop functions -> multi-phase regions (VERDICT r2 #4).
+# ---------------------------------------------------------------------------
+
+def _two_phase_fn(data, key):
+    # prologue: scale is consumed by the epilogue -> must become a g leaf
+    scale = key * jnp.uint32(3)
+    def body1(acc, x):
+        acc = acc + x
+        return acc, acc                       # ys = prefix sums
+    tot, prefix = jax.lax.scan(body1, jnp.uint32(0), data * scale)
+    # interlude: consumed by loop 2 as scanned input
+    shifted = prefix + tot
+    def body2(acc, x):
+        acc = acc ^ x
+        return acc, acc * jnp.uint32(2)
+    h, doubled = jax.lax.scan(body2, key, shifted)
+    return h + scale, doubled
+
+
+def _mid_crossing_fn(data, key):
+    # interlude value `mid` is consumed by BOTH loop 2 and the epilogue:
+    # it must survive phase 1 as an m-leaf in state.
+    scale = key + jnp.uint32(7)
+    def body1(acc, x):
+        acc = acc + x
+        return acc, acc
+    tot, _ = jax.lax.scan(body1, jnp.uint32(0), data)
+    mid = tot ^ scale
+    def body2(acc, x):
+        return acc + x * mid, acc
+    h, trace = jax.lax.scan(body2, jnp.uint32(1), data)
+    return h + mid, trace
+
+
+def _mp_data():
+    return (jnp.arange(12, dtype=jnp.uint32) * jnp.uint32(2654435761)
+            ) & jnp.uint32(0x3FF)
+
+
+def _flat_expected(outs):
+    return np.concatenate([np.asarray(o).reshape(-1).view(np.uint32)
+                           for o in jax.tree.leaves(outs)])
+
+
+def test_lift_fn_two_phase_output_parity():
+    data, key = _mp_data(), jnp.uint32(5)
+    r = lift_fn("twophase", _two_phase_fn, data, key)
+    assert r.meta["phases"] == 2
+    assert r.meta["loops"] == ["scan", "scan"]
+    want = _flat_expected(jax.jit(_two_phase_fn)(data, key))
+    got = np.asarray(r.output(r.run_unprotected()))
+    np.testing.assert_array_equal(got, want)
+    # 12 + 12 iterations + 2 transition steps
+    assert r.nominal_steps == 26
+    kinds = {k: v.kind for k, v in r.spec.items()}
+    assert kinds["_phase"] == KIND_CTRL
+    assert kinds["g0"] == KIND_RO                 # scale
+    assert "p0_c0" in kinds and "p1_c0" in kinds
+
+
+def test_lift_fn_two_phase_protection():
+    data, key = _mp_data(), jnp.uint32(5)
+    r = lift_fn("twophase", _two_phase_fn, data, key)
+    tmr = TMR(r)
+    assert int(tmr.run(None)["errors"]) == 0
+    # Flip phase-2 carry DURING phase 2 (after the transition at step 12):
+    # TMR must mask it; unprotected must corrupt.
+    flip = {"leaf_id": jnp.int32(tmr.leaf_order.index("p1_c0")),
+            "lane": jnp.int32(1), "word": jnp.int32(0),
+            "bit": jnp.int32(3), "t": jnp.int32(15)}
+    assert int(tmr.run(flip)["errors"]) == 0
+    assert int(tmr.run(flip)["corrected"]) > 0
+    up = protect(r, ProtectionConfig(num_clones=1))
+    assert int(up.run({**flip, "lane": jnp.int32(0)})["errors"]) > 0
+
+
+def test_lift_fn_interlude_value_crosses_phases():
+    data, key = _mp_data(), jnp.uint32(9)
+    r = lift_fn("midcross", _mid_crossing_fn, data, key)
+    want = _flat_expected(jax.jit(_mid_crossing_fn)(data, key))
+    got = np.asarray(r.output(r.run_unprotected()))
+    np.testing.assert_array_equal(got, want)
+    # mid crossed phases in state
+    assert any(k.startswith("m") and k[1:].isdigit() for k in r.spec)
+
+
+def test_lift_fn_multi_phase_graph_blocks():
+    data, key = _mp_data(), jnp.uint32(5)
+    r = lift_fn("twophase", _two_phase_fn, data, key)
+    assert r.graph.names == ["entry", "loop0", "inter0",
+                             "loop1", "inter1", "exit"]
+    # CFCSS stacks on the lifted multi-phase graph.
+    prog = protect(r, ProtectionConfig(num_clones=3, cfcss=True))
+    rec = prog.run(None)
+    assert int(rec["errors"]) == 0
+    assert not bool(rec["cfc_fault"])
+
+
+def test_lift_fn_g_leaf_injectable():
+    """Prologue values used by the epilogue are injectable ro leaves, not
+    baked constants: a flip there must corrupt the output (shared leaf,
+    outside the sphere of replication -- the reference's global story)."""
+    data, key = _mp_data(), jnp.uint32(5)
+    r = lift_fn("twophase", _two_phase_fn, data, key)
+    tmr = TMR(r)
+    flip = {"leaf_id": jnp.int32(tmr.leaf_order.index("g0")),
+            "lane": jnp.int32(0), "word": jnp.int32(0),
+            "bit": jnp.int32(1), "t": jnp.int32(2)}
+    assert int(tmr.run(flip)["errors"]) > 0
+
+
+def test_lift_fn_while_then_scan():
+    def fn(a, b, data):
+        def cond(c):
+            return c[1] != 0
+        def body(c):
+            x, y = c
+            return (y, jax.lax.rem(x, y))
+        g, _ = jax.lax.while_loop(cond, body, (a, b))
+        def sbody(acc, x):
+            return acc + x * g, acc
+        tot, trace = jax.lax.scan(sbody, jnp.uint32(0), data)
+        return tot, trace
+    a, b, data = jnp.uint32(462), jnp.uint32(1071), _mp_data()
+    r = lift_fn("gcdscan", fn, a, b, data)
+    assert r.meta["loops"] == ["while", "scan"]
+    want = _flat_expected(jax.jit(fn)(a, b, data))
+    got = np.asarray(r.output(r.run_unprotected()))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lift_fn_multi_phase_campaign():
+    data, key = _mp_data(), jnp.uint32(5)
+    r = lift_fn("twophase", _two_phase_fn, data, key)
+    res = CampaignRunner(TMR(r), strategy_name="TMR").run(
+        128, seed=5, batch_size=128)
+    assert res.n == 128
+    fired = {k: v for k, v in res.counts.items() if k != "cache_invalid"}
+    assert sum(fired.values()) == 128
+    assert res.counts["success"] + res.counts["corrected"] > res.counts["sdc"]
+
+
+def test_lift_fn_epilogue_work_warns():
+    def fn(data):
+        def body(acc, x):
+            return acc + x, acc
+        tot, trace = jax.lax.scan(body, jnp.uint32(0), data)
+        # un-stepped heavy epilogue work: a sort after the loop
+        return jnp.sort(trace) + tot
+    with pytest.warns(UserWarning, match="OUTSIDE the stepped injection"):
+        lift_fn("sorty", fn, _mp_data())
