@@ -220,7 +220,16 @@ class ProtectedProgram:
                                         or not (in_load or in_store))
                 self.pre_sync[name] = in_load and not cfg.no_load_sync
             elif spec.kind == KIND_MEM:
-                self.step_sync[name] = not cfg.no_store_data_sync
+                # Store-data sync exists where STORES exist: the reference
+                # inserts its voter at each store site (syncStoreInst,
+                # synchronization.cpp:476-561), so a leaf the step never
+                # writes has no sync point and is NOT voted per step -- a
+                # flip there propagates through compute and is repaired at
+                # the written leaves' votes, exactly as in the reference.
+                # This is also the flagship HBM win: mm1024's never-written
+                # operand matrices are 2/3 of the per-step voter traffic.
+                self.step_sync[name] = (not cfg.no_store_data_sync
+                                        and name in flow.written)
             else:  # reg: registers are voted only where used by a sync point
                 self.step_sync[name] = False
             if cfg.protect_stack and spec.stack:

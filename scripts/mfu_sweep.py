@@ -1,0 +1,114 @@
+"""Flagship MFU sweep: where do the non-MXU cycles go, and what fixes them.
+
+Sweeps the mm-flagship design space on the live backend and reports each
+configuration's achieved FLOP/s as a fraction of the chip's bf16 peak
+(v5e: 197 TFLOP/s):
+
+  * block size (rows of output per step): bigger blocks mean fewer
+    steps, larger MXU calls, and fewer voter passes per FLOP;
+  * unroll (early-exit loop steps per iteration) on the campaign path;
+  * TMR vs unprotected single-run, so the protection overhead is priced
+    against the same roofline.
+
+The structural model this sweep tests is written up in docs/perf.md:
+per commit step the voter moves O(state) HBM bytes while the matmul does
+O(block * side^2) FLOPs, so fraction-of-peak should rise roughly
+linearly with block until the MXU term dominates.  Run on the TPU for
+the record (artifacts/mfu_sweep.json); CPU runs write the smoke file.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("COAST_STUDY_BACKEND") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+PEAK_GFLOPS = 197_000.0          # v5e bf16 single-chip peak
+
+
+def timed(fn, reps):
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    from coast_tpu import TMR, unprotected
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY, mm256
+
+    backend = jax.default_backend()
+    side = int(os.environ.get("COAST_MFU_SIDE", "1024"))
+    reps = int(os.environ.get("COAST_MFU_REPS", "10"))
+    out = {"metric": "flagship_mfu_sweep", "backend": backend,
+           "side": side, "peak_ref": "v5e bf16 197 TFLOP/s",
+           "blocks": []}
+
+    for block in (32, 128, 256, 512):
+        if side % block:
+            continue
+        region = mm256.make_region(side=side, block=block, bf16_matmul=True)
+        flops3 = 3 * region.meta["flops_per_run"]
+        row = {"block": block, "steps": region.nominal_steps}
+        for name, make in (("unprotected", unprotected), ("TMR", TMR)):
+            prog = make(region)
+            sec = timed(jax.jit(lambda p=prog: p.run(None)), reps)
+            fl = (flops3 if name == "TMR"
+                  else region.meta["flops_per_run"])
+            row[name] = {
+                "seconds_per_run": round(sec, 6),
+                "gflops_per_sec": round(fl / sec / 1e9, 2),
+                "fraction_of_peak": round(fl / sec / 1e9 / PEAK_GFLOPS, 5),
+            }
+        row["tmr_overhead_x"] = round(
+            row["TMR"]["seconds_per_run"]
+            / row["unprotected"]["seconds_per_run"], 3)
+        out["blocks"].append(row)
+        print(json.dumps(row))
+
+    # unroll sweep on the campaign path (small mm: loop-overhead bound)
+    import jax.numpy as jnp
+    from coast_tpu.inject.schedule import generate
+
+    runner = CampaignRunner(TMR(REGISTRY["matrixMultiply"]()))
+    prog = runner.prog
+    n = 4096
+    sched = generate(runner.mmap, n, 42, prog.region.nominal_steps)
+    out["unroll"] = []
+    for unroll in (1, 2, 4, 8):
+        batch = jax.jit(jax.vmap(lambda f: prog.run(f, unroll=unroll)))
+        fault = {k: jnp.asarray(getattr(sched, k)[:1024])
+                 for k in ("leaf_id", "lane", "word", "bit", "t")}
+        jax.block_until_ready(batch(fault))                # compile
+        t0 = time.perf_counter()
+        for lo in range(0, n, 1024):
+            f = {k: jnp.asarray(getattr(sched, k)[lo:lo + 1024])
+                 for k in ("leaf_id", "lane", "word", "bit", "t")}
+            o = batch(f)
+        jax.block_until_ready(o)
+        sec = time.perf_counter() - t0
+        out["unroll"].append({"unroll": unroll,
+                              "injections_per_sec": round(n / sec, 1)})
+        print(json.dumps(out["unroll"][-1]))
+
+    fname = ("mfu_sweep.json" if backend == "tpu"
+             else "mfu_sweep_cpu_smoke.json")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", fname)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,3 +158,27 @@ def test_dwc_check_before_store(mm_region):
     for name in want:
         assert jnp.array_equal(want[name], got[name]), (
             f"leaf {name} changed at the aborting step")
+
+
+def test_store_sync_only_where_stores_exist(mm_region):
+    """Store-data sync votes sit where STORES sit (the reference inserts
+    its voter at each store site, synchronization.cpp:476-561): a mem
+    leaf the step never writes has no sync point and is not voted per
+    step.  A flip there must still be masked -- repaired downstream at
+    the written leaves' votes -- never silently lost."""
+    prog = TMR(mm_region)
+    flow = analyze(mm_region)
+    for name, kind in ((n, s.kind) for n, s in mm_region.spec.items()):
+        if kind == "mem" and prog.replicated[name]:
+            assert prog.step_sync[name] == (name in flow.written), name
+    # mm's operand matrices are written only at init: not voted.
+    assert prog.step_sync["first"] is False
+    assert prog.step_sync["second"] is False
+    assert prog.step_sync["results"] is True
+    for leaf in ("first", "second"):
+        flip = {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+                "lane": jnp.int32(1), "word": jnp.int32(3),
+                "bit": jnp.int32(7), "t": jnp.int32(0)}
+        rec = jax.jit(prog.run)(flip)
+        assert int(rec["errors"]) == 0, leaf
+        assert int(rec["corrected"]) > 0, leaf
