@@ -137,7 +137,10 @@ def worker(backend: str) -> None:
     # window on a host core.
     flagships = (() if jax.default_backend() == "cpu" else
                  (("matrixMultiply256", (256, 512)),
-                  ("matrixMultiply1024", (32, 64))))
+                  ("matrixMultiply1024", (32, 64)),
+                  # block=512 variant: the high-MFU roofline row
+                  # (docs/perf.md) -- 4x less voter HBM per run.
+                  ("matrixMultiply1024b512", (32, 64))))
     for flag_name, batches in flagships:
         flag = REGISTRY[flag_name]()
         # Flagships ship with the fused Pallas voter kernel

@@ -41,6 +41,7 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     # TPU-shaped flagships: 1 MiB f32 / 4 MiB bf16-MXU (VERDICT r1 #7).
     "matrixMultiply256": _lazy("mm256"),
     "matrixMultiply1024": _lazy("mm256", "make_region_1024"),
+    "matrixMultiply1024b512": _lazy("mm256", "make_region_1024_b512"),
     "crc16": _lazy("crc16"),
     "quicksort": _lazy("quicksort"),
     "aes": _lazy("aes"),

@@ -48,7 +48,7 @@ def main():
 
     backend = jax.default_backend()
     side = int(os.environ.get("COAST_MFU_SIDE", "1024"))
-    reps = int(os.environ.get("COAST_MFU_REPS", "10"))
+    reps = max(1, int(os.environ.get("COAST_MFU_REPS", "10")))
     out = {"metric": "flagship_mfu_sweep", "backend": backend,
            "side": side, "peak_ref": "v5e bf16 197 TFLOP/s",
            "blocks": []}

@@ -62,6 +62,7 @@ FLIP_TARGETS = {
     # compute and commit
     "matrixMultiply256": ("acc", 777, 22, 3),
     "matrixMultiply1024": ("acc", 7777, 20, 3),
+    "matrixMultiply1024b512": ("acc", 7777, 20, 1),
     # corrupt the CRC task's accumulator before its next dispatch
     "rtos_app": ("acc_crc", 0, 9, 4),
 }
