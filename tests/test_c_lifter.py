@@ -244,3 +244,19 @@ def test_fn_returns_prologue_value(tmp_path):
     assert out[0] == 42
     assert out[1] == 15
     assert any(k.startswith("g") for k in r.spec)
+
+
+def test_second_reference_benchmark_simpletmr():
+    """A second real reference source end-to-end: tests/simpleTMR/test1.c
+    (function calls incl. the empty __begin/__end_TMR markers, a for loop
+    mixing a call with compound assignment, final printf).  C semantics:
+    a=1; ten iterations of a=(a+i)+i; a+=15 -> 106."""
+    src = "/root/reference/tests/simpleTMR/test1.c"
+    if not os.path.exists(src):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+    r = lift_c("simpleTMR_c", [src], default_xmr=True)
+    out = np.asarray(r.output(r.run_unprotected()))
+    assert out[-1] == 106
+    tmr = TMR(r)
+    assert int(tmr.run(None)["errors"]) == 0
