@@ -237,6 +237,32 @@ class ProtectedProgram:
                 # top of the normal sync taxonomy: the saved return-address
                 # copies are voted even when store/ctrl syncs are disabled.
                 self.step_sync[name] = True
+        # Store-slice hints: the reference's store sync votes the stored
+        # VALUE, not the whole array (syncStoreInst selects over the store
+        # operand, synchronization.cpp:476-561).  A region that knows which
+        # slice its step stores (meta["store_slice"]: leaf -> fn(view, t)
+        # -> (starts, sizes)) gets exactly that: the vote reads/writes only
+        # the stored rows, and divergence elsewhere is caught by the
+        # region-boundary sync -- the flagship's voter HBM traffic becomes
+        # O(stored block), not O(leaf).
+        self._store_slice = dict(region.meta.get("store_slice") or {})
+        if cfg.num_clones > 1:
+            for name in self._store_slice:
+                if name not in region.spec:
+                    raise ValueError(
+                        f"store_slice hint for unknown leaf {name!r}")
+                if not self.replicated.get(name):
+                    raise ValueError(
+                        f"store_slice hint for {name!r}: not a replicated "
+                        "leaf")
+                if not self.step_sync.get(name):
+                    raise ValueError(
+                        f"store_slice hint for {name!r}: leaf has no step "
+                        "store sync (register-class, never written, or "
+                        "store-data sync disabled) -- the hint would be "
+                        "dead code")
+        else:
+            self._store_slice = {}       # no votes exist to slice
         # Voter lowering: -pallasVoters (or auto-on when the backend IS the
         # TPU) routes eligible large leaves through the fused Pallas kernel
         # (which itself falls back to the jnp voter when not applicable);
@@ -465,19 +491,82 @@ class ProtectedProgram:
                 miscompares.append(call_mis[j])
             syncs = syncs + n_call_sync
 
+        # Pre-step view for store-slice hints: ctrl scalars voted (a single
+        # corrupted lane must not redirect the vote window), everything
+        # else lane 0 -- the hint only reads control state, and voting the
+        # large leaves here would re-create the traffic the hint removes.
+        slice_view = None
+        if self._store_slice and cfg.num_clones > 1:
+            slice_view = {}
+            for name, arr in region_state.items():
+                if not self.replicated[name]:
+                    slice_view[name] = arr
+                elif (self.region.spec[name].kind == KIND_CTRL
+                      and cfg.num_clones == 3):
+                    slice_view[name] = voters.tmr_vote(arr)[0]
+                else:
+                    slice_view[name] = arr[0]
+
         new_state: State = {}
         for name in region_state:
             out = laned[name]
             if self.replicated[name]:
                 if self.step_sync[name] and cfg.num_clones > 1:
-                    voted, mis = self._vote(out, cfg.num_clones)
-                    miscompares.append(mis)
-                    syncs = syncs + 1
-                    if cfg.num_clones == 3:
-                        # Voted value repairs every replica (the reference
-                        # stores the select output through original + cloned
-                        # stores, syncStoreInst :476-561).
-                        out = jnp.broadcast_to(voted, out.shape)
+                    hint = self._store_slice.get(name)
+                    if hint is not None:
+                        # Vote only the slice this step stored: the store
+                        # sync covers the store OPERAND (syncStoreInst);
+                        # rows committed earlier are re-checked once at the
+                        # region boundary, not every step.  Slice indices
+                        # come from the pre-step view with voted ctrl state
+                        # so a single corrupted lane cannot redirect the
+                        # vote window.  A 3-tuple hint adds a traced
+                        # ``active`` flag: steps that store nothing (e.g.
+                        # compute micro-steps) skip the vote entirely via
+                        # lax.cond, halving the slice traffic.
+                        hint_out = hint(slice_view, t)
+                        if len(hint_out) == 3:
+                            starts, sizes, active = hint_out
+                        else:
+                            starts, sizes = hint_out
+                            active = None
+                        starts = tuple(jnp.asarray(s, jnp.int32)
+                                       for s in starts)
+
+                        def vote_slice(lanes, _starts=starts,
+                                       _sizes=sizes):
+                            sl = jax.vmap(
+                                lambda lane: jax.lax.dynamic_slice(
+                                    lane, _starts, _sizes))(lanes)
+                            voted, m = self._vote(sl, cfg.num_clones)
+                            if cfg.num_clones == 3:
+                                rep = jnp.broadcast_to(voted, sl.shape)
+                                lanes = jax.vmap(
+                                    lambda lane, r:
+                                    jax.lax.dynamic_update_slice(
+                                        lane, r, _starts))(lanes, rep)
+                            return lanes, m
+
+                        if active is None:
+                            out, mis = vote_slice(out)
+                            syncs = syncs + 1
+                        else:
+                            out, mis = jax.lax.cond(
+                                active, vote_slice,
+                                lambda lanes: (lanes, jnp.bool_(False)),
+                                out)
+                            syncs = syncs + active.astype(jnp.int32)
+                        miscompares.append(mis)
+                    else:
+                        voted, mis = self._vote(out, cfg.num_clones)
+                        miscompares.append(mis)
+                        syncs = syncs + 1
+                        if cfg.num_clones == 3:
+                            # Voted value repairs every replica (the
+                            # reference stores the select output through
+                            # original + cloned stores, syncStoreInst
+                            # :476-561).
+                            out = jnp.broadcast_to(voted, out.shape)
                 new_state[name] = out
             else:
                 if self.region.spec[name].kind == KIND_RO:

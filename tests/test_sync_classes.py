@@ -182,3 +182,63 @@ def test_store_sync_only_where_stores_exist(mm_region):
         rec = jax.jit(prog.run)(flip)
         assert int(rec["errors"]) == 0, leaf
         assert int(rec["corrected"]) > 0, leaf
+
+
+def test_store_slice_hint_classification_faithful():
+    """Slice voting (vote only the stored rows on storing steps -- the
+    reference's stored-VALUE sync) against whole-leaf voting: harm
+    classes (SDC/DUE/invalid) must be IDENTICAL; the only permitted
+    difference is corrected -> success for flips the commit overwrites
+    before any sync sees them.  In the reference such a flip never
+    reaches a voter either (the store clobbers it): counting it
+    "corrected" was an artifact of over-voting, not fidelity."""
+    import numpy as np
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm256
+
+    r_slice = mm256.make_region()
+    assert "store_slice" in r_slice.meta
+    r_full = mm256.make_region()
+    r_full.meta = {k: v for k, v in r_full.meta.items()
+                   if k != "store_slice"}
+    ra = CampaignRunner(TMR(r_slice)).run(192, seed=7, batch_size=192)
+    rb = CampaignRunner(TMR(r_full)).run(192, seed=7, batch_size=192)
+    a, b = np.asarray(ra.codes), np.asarray(rb.codes)
+    diff = a != b
+    # Only corrected(1) -> success(0) shifts; harm classes untouched.
+    assert np.all(b[diff] == 1), (a[diff], b[diff])
+    assert np.all(a[diff] == 0), (a[diff], b[diff])
+    for k in ("sdc", "due_abort", "due_timeout", "invalid"):
+        assert ra.counts[k] == rb.counts[k], k
+
+
+def test_store_slice_dwc_late_flip_detected_at_boundary():
+    """Under DWC, a flip in an already-committed row is outside every
+    later storing step's compare window; the region-boundary compare
+    must still latch it -- detected, never silent."""
+    from coast_tpu.models import mm256
+    region = mm256.make_region()
+    prog = DWC(region)
+    late_t = region.nominal_steps - 2
+    flip = {"leaf_id": jnp.int32(prog.leaf_order.index("results")),
+            "lane": jnp.int32(1), "word": jnp.int32(0),
+            "bit": jnp.int32(12), "t": jnp.int32(late_t)}
+    rec = jax.jit(prog.run)(flip)
+    assert bool(rec["dwc_fault"])
+
+
+def test_store_slice_late_flip_still_corrected():
+    """A flip landing in an ALREADY-COMMITTED results row is outside every
+    later step's vote window; the region-boundary sync must still repair
+    and count it -- never SDC, never silent."""
+    from coast_tpu.models import mm256
+    region = mm256.make_region()
+    prog = TMR(region)
+    # word 0 = row 0, committed at step 1; flip it near the end of the run.
+    late_t = region.nominal_steps - 2
+    flip = {"leaf_id": jnp.int32(prog.leaf_order.index("results")),
+            "lane": jnp.int32(2), "word": jnp.int32(0),
+            "bit": jnp.int32(12), "t": jnp.int32(late_t)}
+    rec = jax.jit(prog.run)(flip)
+    assert int(rec["errors"]) == 0
+    assert int(rec["corrected"]) > 0
