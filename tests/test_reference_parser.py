@@ -118,3 +118,46 @@ def test_supervisor_reference_log_format(tmp_path):
     with open(path) as f:
         assert os.path.exists(f.readline().strip())
         assert len(json.load(f)) == 8
+
+
+def test_reference_jsonparser_compare_mode(campaign, tmp_path):
+    """The reference tool's compare-files mode (-k): its own MWTF report
+    must run unmodified on two repo campaign logs and print the error
+    rates the repo's classification implies."""
+    if not os.path.isdir(REF_PLATFORM):
+        pytest.skip("reference checkout not present")
+    from coast_tpu import unprotected
+    from coast_tpu.analysis import json_parser as jp
+
+    region = mm.make_region()
+    runner = CampaignRunner(unprotected(region), strategy_name="none")
+    res = runner.run(400, seed=13, batch_size=400)
+    unprot_path = str(tmp_path / "mm_unprot_ref.json")
+    write_reference_json(res, runner.mmap, unprot_path)
+    _, tmr_path, _ = campaign
+
+    # Premise guards, same as the summary test: the tool's otherStats
+    # means over fully-clean runs (StatisticsError on none) and its rate
+    # print clamps zero errors to 1 -- both logs must have clean runs and
+    # the unprotected one must have SDCs, or fail HERE with a clear
+    # message rather than inside the reference subprocess.
+    mine = jp.summarize_path(unprot_path)
+    assert mine.counts["success"] > 0
+    assert mine.counts["sdc"] > 0
+    assert jp.summarize_path(tmr_path).counts["success"] > 0
+
+    proc = subprocess.run(
+        [sys.executable, "jsonParser.py", unprot_path, "-k", tmr_path],
+        cwd=REF_PLATFORM, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    # Row 0 = unprotected: its printed error rate, anchored to the row.
+    rate0 = mine.counts["sdc"] / res.n * 100
+    m = re.search(r"┃\s+0\s+┃.*?(\d+\.\d+)%", out)
+    assert m, out
+    assert m.group(1) == f"{rate0:.2f}"
+    # The MWTF column carries a computed number (error-rate ratio over
+    # runtime ratio), not just the header.
+    m = re.search(r"(\d+\.\d+)x\s+┃\s*$", out, re.M)
+    assert m, out
+    assert float(m.group(1)) > 0
