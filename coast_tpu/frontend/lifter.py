@@ -300,10 +300,9 @@ class _Phase:
         self.prim = eqn.primitive.name
         self.idx_name = idx_name
         if self.prim == "scan":
-            if eqn.params.get("reverse", False):
-                raise LiftError(
-                    "reverse scan is not supported; re-express the loop "
-                    "forward or use lift_step")
+            # A reverse scan steps the same carries with flipped indexing:
+            # iteration i reads x[L-1-i] and writes y[L-1-i].
+            self.reverse = bool(eqn.params.get("reverse", False))
             self.n_consts = eqn.params["num_consts"]
             self.n_carry = eqn.params["num_carry"]
             self.length = int(eqn.params["length"])
@@ -360,10 +359,11 @@ class _Phase:
         new = dict(st)
         if self.prim == "scan":
             i = st[self.idx_name]
+            pos = (self.length - 1 - i) if self.reverse else i
             args = ([st[f"{p}k{j}"] for j in range(self.n_consts)]
                     + [st[f"{p}c{j}"] for j in range(self.n_carry)]
                     + [jax.lax.dynamic_index_in_dim(
-                        st[f"{p}x{j}"], i, axis=0, keepdims=False)
+                        st[f"{p}x{j}"], pos, axis=0, keepdims=False)
                        for j in range(self.n_xs)])
             outs = jax.core.eval_jaxpr(self.body.jaxpr, self.body.consts,
                                        *args)
@@ -371,7 +371,7 @@ class _Phase:
                 new[f"{p}c{j}"] = outs[j]
             for j, y in enumerate(outs[self.n_carry:]):
                 new[f"{p}y{j}"] = jax.lax.dynamic_update_index_in_dim(
-                    st[f"{p}y{j}"], y, i, axis=0)
+                    st[f"{p}y{j}"], y, pos, axis=0)
             new[self.idx_name] = i + 1
         else:
             args = ([st[f"{p}k{j}"] for j in range(self.bn)]

@@ -416,3 +416,24 @@ def test_lift_fn_epilogue_work_warns():
         return jnp.sort(trace) + tot
     with pytest.warns(UserWarning, match="OUTSIDE the stepped injection"):
         lift_fn("sorty", fn, _mp_data())
+
+
+def test_lift_fn_reverse_scan():
+    """Reverse scans step with flipped indexing (iteration i touches
+    x[L-1-i]/y[L-1-i]); previously a refusal."""
+    def suffix_sums(data):
+        def body(acc, x):
+            acc = acc + x
+            return acc, acc
+        tot, sums = jax.lax.scan(body, jnp.uint32(0), data, reverse=True)
+        return tot, sums
+
+    data = _mp_data()
+    r = lift_fn("revsum", suffix_sums, data)
+    want = _flat_expected(jax.jit(suffix_sums)(data))
+    got = np.asarray(r.output(r.run_unprotected()))
+    np.testing.assert_array_equal(got, want)
+    assert r.nominal_steps == len(data)
+    # Protection still applies.
+    tmr = TMR(r)
+    assert int(tmr.run(None)["errors"]) == 0
