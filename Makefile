@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr regression_test test_rtos bench clean
+.PHONY: build test test_all test_fast test_full test_tmr regression_test test_rtos bench fidelity mfu_sweep clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -35,6 +35,17 @@ test_rtos:
 
 bench: build
 	$(PYTHON) bench.py
+
+# Distribution-level classification-fidelity study (the blocked-QEMU
+# gate stand-in); writes artifacts/fidelity_study.json, exits nonzero on
+# any failed check.
+fidelity:
+	$(PYTHON) scripts/fidelity_study.py
+
+# Flagship block-size/unroll sweep with fraction-of-peak; writes
+# artifacts/mfu_sweep.json on TPU (smoke file elsewhere).
+mfu_sweep:
+	$(PYTHON) scripts/mfu_sweep.py
 
 clean:
 	$(MAKE) -C coast_tpu/native clean
