@@ -71,10 +71,15 @@ _SLOW_CAMPAIGN = {"chstone_jpeg", "chstone_gsm", "chstone_adpcm",
 
 def _relift(hand, annotated_leaves):
     annotations = {leaf: hand.spec[leaf] for leaf in annotated_leaves}
+    # Perf hints (store_slice) are part of the program's store-site
+    # knowledge and change WHEN a flip is counted corrected (overwritten
+    # flips never reach a voter) -- carry them, like the annotations.
+    meta = ({"store_slice": hand.meta["store_slice"]}
+            if "store_slice" in hand.meta else None)
     lifted = lift_step(
         hand.name + "_lifted", hand.step, hand.init, done=hand.done,
         check=hand.check, output=hand.output, max_steps=hand.max_steps,
-        annotations=annotations, default_xmr=hand.default_xmr)
+        annotations=annotations, default_xmr=hand.default_xmr, meta=meta)
     lifted.spec = {k: lifted.spec[k] for k in hand.spec}
     return lifted
 
