@@ -114,11 +114,17 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
     }
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as fh:
+    out = args.out
+    if (jax.default_backend() == "cpu"
+            and out == "artifacts/campaign_mm_1m.json"):
+        # Never let a CPU run clobber the on-chip record under the
+        # default path (same rule as flip_kernel_study / mfu_sweep).
+        out = "artifacts/campaign_mm_1m_cpu.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as fh:
         json.dump(artifact, fh, indent=1, sort_keys=True)
     print(json.dumps(artifact["campaign"]))
-    print(f"stages: {stages}  -> {args.out}")
+    print(f"stages: {stages}  -> {out}")
     return 0
 
 
