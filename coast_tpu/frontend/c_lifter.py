@@ -822,7 +822,11 @@ def parse_c_sources(paths: Sequence[str]):
         texts.append(src)
         anns.extend(ann)
     parser = c_parser.CParser()
-    tu = parser.parse(_PRELUDE + "\n".join(texts), filename="<coast_tpu>")
+    try:
+        tu = parser.parse(_PRELUDE + "\n".join(texts),
+                          filename="<coast_tpu>")
+    except Exception as e:          # pycparser ParseError and lexer errors
+        raise CLiftError(f"C parse error: {e}") from e
 
     typedefs: Dict[str, object] = {}
     funcs: Dict[str, object] = {}

@@ -154,9 +154,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     from coast_tpu.models import REGISTRY
-    if len(positional) != 1 or positional[0] not in REGISTRY:
+    is_c_source = (len(positional) == 1 and positional[0].endswith(".c")
+                   and os.path.exists(positional[0]))
+    if not is_c_source and (len(positional) != 1
+                            or positional[0] not in REGISTRY):
         print("usage: python -m coast_tpu.opt [-TMR|-DWC|-EDDI] [flags] "
-              f"<benchmark>\nbenchmarks: {', '.join(sorted(REGISTRY))}",
+              "<benchmark | program.c>\n"
+              f"benchmarks: {', '.join(sorted(REGISTRY))}\n"
+              "or a C source file (restricted subset; docs/lifter.md)",
               file=sys.stderr)
         return 2
     bench = positional[0]
@@ -194,7 +199,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     from coast_tpu import DWC, EDDI, TMR, unprotected
     from coast_tpu.passes.verification import SoRViolation
 
-    region = REGISTRY[bench]()
+    if is_c_source:
+        # The reference's opt consumes a program file, not a name
+        # (clang-emitted IR; here the restricted-C frontend): opt -TMR
+        # mm.c protects the program the file defines.
+        from coast_tpu.frontend import LiftError, lift_c
+        name = os.path.splitext(os.path.basename(bench))[0]
+        try:
+            region = lift_c(name, [bench])
+        except LiftError as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 1
+    else:
+        region = REGISTRY[bench]()
 
     strategy = strategies[0] if strategies else None
     try:

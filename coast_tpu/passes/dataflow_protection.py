@@ -196,6 +196,10 @@ class ProtectedProgram:
         self.replicated: Dict[str, bool] = {
             name: cfg.resolve_xmr(region, name) for name in region.spec
         }
+        # Spec-leaf view: CFCSS later registers synthetic replicated
+        # runtime leaves, but the lane axis only exists if some PROGRAM
+        # leaf is replicated.
+        self._any_replicated = any(self.replicated[k] for k in region.spec)
         # Address-forming roles from the provenance pass: which ctrl leaves
         # feed load indices vs store indices (the GEP-operand classification
         # of syncGEP, synchronization.cpp:413-474).
@@ -398,7 +402,12 @@ class ProtectedProgram:
         """
         n = self.cfg.num_clones
         no_mis = jnp.zeros((0,), jnp.bool_)
-        if n == 1:
+        if n == 1 or not self._any_replicated:
+            # Single lane, or an all-shared scope (e.g. __DEFAULT_NO_xMR
+            # with no __xMR marks): the reference's opt likewise compiles
+            # a -TMR build that replicates nothing (scopeLists empty, so
+            # zero sync points are inserted); there is no lane axis to
+            # vmap over and no votes downstream.
             out = self.region.bound_step()(pstate, t)
             return {k: v[None] for k, v in out.items()}, no_mis
 
@@ -571,7 +580,7 @@ class ProtectedProgram:
             else:
                 if self.region.spec[name].kind == KIND_RO:
                     new_state[name] = out[0]
-                elif cfg.num_clones > 1:
+                elif cfg.num_clones > 1 and self._any_replicated:
                     # Store crossing the sphere of replication: vote before
                     # the single store (verification.cpp forces these into
                     # syncGlobalStores :587,676).

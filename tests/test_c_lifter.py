@@ -21,12 +21,10 @@ from coast_tpu.models import mm
 
 MM_C = "/root/reference/tests/mm_common/mm.c"
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(MM_C), reason="reference checkout not present")
-
-
 @pytest.fixture(scope="module")
 def region():
+    if not os.path.exists(MM_C):
+        pytest.skip("reference checkout not present")
     from coast_tpu.frontend.c_lifter import lift_c
     # __DEFAULT_NO_xMR in the source sets default_xmr=False; the campaign
     # comparison protects everything, playing the -TMR default scope.
@@ -260,3 +258,69 @@ def test_second_reference_benchmark_simpletmr():
     assert out[-1] == 106
     tmr = TMR(r)
     assert int(tmr.run(None)["errors"]) == 0
+
+
+def test_opt_cli_accepts_c_source(tmp_path, capsys):
+    """The reference's opt consumes a program FILE; ours accepts a .c
+    path wherever a registry name is expected."""
+    from coast_tpu.opt import main as opt_main
+    src = tmp_path / "tiny.c"
+    src.write_text("""
+unsigned int data[4] = {3, 5, 7, 11};
+unsigned int total = 0;
+int main() {
+    int i;
+    for (i = 0; i < 4; i++) { total += data[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    rc = opt_main(["-TMR", "-countErrors", str(src)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "E: 0" in out
+
+
+def test_opt_cli_c_source_refusal_is_clean(tmp_path, capsys):
+    from coast_tpu.opt import main as opt_main
+    src = tmp_path / "bad.c"
+    src.write_text("int main() { goto x; x: return 0; }")
+    rc = opt_main(["-TMR", str(src)])
+    assert rc == 1
+    assert "ERROR" in capsys.readouterr().err
+    # A syntax error (pycparser ParseError) must take the same clean
+    # path, not an unhandled traceback.
+    src.write_text("int main( {")
+    rc = opt_main(["-TMR", str(src)])
+    assert rc == 1
+    assert "parse error" in capsys.readouterr().err
+
+
+def test_all_shared_scope_runs_without_lanes():
+    """__DEFAULT_NO_xMR with no __xMR marks: -TMR replicates nothing
+    (the reference's empty scopeLists compile fine); the engine must run
+    the all-shared program rather than fail building a lane axis."""
+    from coast_tpu import TMR
+    from coast_tpu.frontend.c_lifter import lift_c
+    if not os.path.exists(MM_C):
+        pytest.skip("reference checkout not present")
+    region = lift_c("mm_noscope", [MM_C])       # source default: no xMR
+    prog = TMR(region)
+    rec = prog.run(None)
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+
+
+def test_all_shared_scope_with_cfcss():
+    """-CFCSS stacks on an all-shared build: the synthetic CFCSS runtime
+    leaves are replicated, but the PROGRAM has no lane axis -- the guard
+    must look at spec leaves only."""
+    from coast_tpu import ProtectionConfig, protect
+    from coast_tpu.frontend.c_lifter import lift_c
+    if not os.path.exists(MM_C):
+        pytest.skip("reference checkout not present")
+    region = lift_c("mm_noscope_cfcss", [MM_C])
+    prog = protect(region, ProtectionConfig(num_clones=3, cfcss=True))
+    rec = prog.run(None)
+    assert int(rec["errors"]) == 0
+    assert not bool(rec["cfc_fault"])
