@@ -48,7 +48,8 @@ def parse_command_line(argv: Optional[List[str]] = None):
     parser = argparse.ArgumentParser(
         description="Supervisor for batched TPU fault injection")
     parser.add_argument("--filename", "-f", type=str, required=True,
-                        help="benchmark region to run (registry name)")
+                        help="program to run: a benchmark registry name "
+                        "or a path to a restricted-C source (.c)")
     parser.add_argument("--port-range", "-p", type=int, default=None,
                         help="accepted for compatibility; the batched "
                         "campaign needs no ports (scale-out is the mesh "
@@ -133,10 +134,19 @@ def build_program(bench: str, opt_passes: str):
     from coast_tpu.interface.config import ConfigError
     from coast_tpu.models import REGISTRY
     from coast_tpu.opt import UsageError, build_overrides, parse_argv
-    if bench not in REGISTRY:
+    # The reference supervisor takes the guest program by path; registry
+    # names and .c source paths resolve through the shared resolver (same
+    # path as `python -m coast_tpu.opt ... file.c`).
+    from coast_tpu.frontend import LiftError
+    from coast_tpu.models import resolve_region
+    try:
+        region = resolve_region(bench)
+    except (FileNotFoundError, KeyError):
         print(f"Error, file {bench} does not exist!", file=sys.stderr)
         sys.exit(-1)
-    region = REGISTRY[bench]()
+    except LiftError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        sys.exit(-1)
     try:
         flags, positional = parse_argv(opt_passes.split())
         if positional:

@@ -17,6 +17,23 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
     return make
 
 
+def resolve_region(arg: str) -> Region:
+    """One program-argument resolver for the CLIs (opt and supervisor take
+    the program by registry name or by .c source path -- the reference's
+    tools take the program by FILE).  Raises FileNotFoundError for a
+    missing .c path, KeyError for an unknown registry name, LiftError for
+    an out-of-subset source."""
+    import os
+    if arg.endswith(".c"):
+        if not os.path.exists(arg):
+            raise FileNotFoundError(arg)
+        from coast_tpu.frontend import lift_c
+        return lift_c(os.path.splitext(os.path.basename(arg))[0], [arg])
+    if arg in REGISTRY:
+        return REGISTRY[arg]()
+    raise KeyError(arg)
+
+
 def model_source(name: str) -> str:
     """Absolute path of the model module behind a REGISTRY name -- the
     analogue of the guest-executable path the reference records as line 1

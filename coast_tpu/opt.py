@@ -154,8 +154,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     from coast_tpu.models import REGISTRY
-    is_c_source = (len(positional) == 1 and positional[0].endswith(".c")
-                   and os.path.exists(positional[0]))
+    is_c_source = len(positional) == 1 and positional[0].endswith(".c")
+    if is_c_source and not os.path.exists(positional[0]):
+        print(f"ERROR: file {positional[0]} does not exist", file=sys.stderr)
+        return 2
     if not is_c_source and (len(positional) != 1
                             or positional[0] not in REGISTRY):
         print("usage: python -m coast_tpu.opt [-TMR|-DWC|-EDDI] [flags] "
@@ -199,19 +201,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     from coast_tpu import DWC, EDDI, TMR, unprotected
     from coast_tpu.passes.verification import SoRViolation
 
-    if is_c_source:
-        # The reference's opt consumes a program file, not a name
-        # (clang-emitted IR; here the restricted-C frontend): opt -TMR
-        # mm.c protects the program the file defines.
-        from coast_tpu.frontend import LiftError, lift_c
-        name = os.path.splitext(os.path.basename(bench))[0]
-        try:
-            region = lift_c(name, [bench])
-        except LiftError as e:
-            print(f"ERROR: {e}", file=sys.stderr)
-            return 1
-    else:
-        region = REGISTRY[bench]()
+    # The reference's opt consumes a program file, not a name
+    # (clang-emitted IR; here registry names or the restricted-C
+    # frontend): opt -TMR mm.c protects the program the file defines.
+    from coast_tpu.frontend import LiftError
+    from coast_tpu.models import resolve_region
+    try:
+        region = resolve_region(bench)
+    except LiftError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
 
     strategy = strategies[0] if strategies else None
     try:

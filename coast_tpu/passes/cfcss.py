@@ -89,7 +89,7 @@ def apply_cfcss(prog: ProtectedProgram, seed: int = 0) -> ProtectedProgram:
         int32.  The voted view is deliberately not used here: voting would
         repair the very control corruption CFCSS exists to detect."""
         region_state = {k: state[k] for k in region.spec}
-        if n_lanes == 1 or not any(prog.replicated[k] for k in region.spec):
+        if n_lanes == 1 or not prog._any_replicated:
             v = graph.block_of(region_state)
             return jnp.broadcast_to(jnp.asarray(v, jnp.int32), (n_lanes,))
         in_axes = ({k: (0 if prog.replicated[k] else None)

@@ -57,13 +57,21 @@ def main():
         if side % block:
             continue
         region = mm256.make_region(side=side, block=block, bf16_matmul=True)
-        flops3 = 3 * region.meta["flops_per_run"]
+        # The A/B that prices slice voting: the same region with the
+        # store_slice hint stripped falls back to whole-leaf votes.
+        region_wl = mm256.make_region(side=side, block=block,
+                                      bf16_matmul=True)
+        region_wl.meta = {k: v for k, v in region_wl.meta.items()
+                          if k != "store_slice"}
+        flops1 = region.meta["flops_per_run"]
+        flops3 = 3 * flops1
         row = {"block": block, "steps": region.nominal_steps}
-        for name, make in (("unprotected", unprotected), ("TMR", TMR)):
-            prog = make(region)
+        for name, make, reg, fl in (
+                ("unprotected", unprotected, region, flops1),
+                ("TMR", TMR, region, flops3),
+                ("TMR_wholeleaf_vote", TMR, region_wl, flops3)):
+            prog = make(reg)
             sec = timed(jax.jit(lambda p=prog: p.run(None)), reps)
-            fl = (flops3 if name == "TMR"
-                  else region.meta["flops_per_run"])
             row[name] = {
                 "seconds_per_run": round(sec, 6),
                 "gflops_per_sec": round(fl / sec / 1e9, 2),
@@ -72,6 +80,9 @@ def main():
         row["tmr_overhead_x"] = round(
             row["TMR"]["seconds_per_run"]
             / row["unprotected"]["seconds_per_run"], 3)
+        row["slice_vote_speedup_x"] = round(
+            row["TMR_wholeleaf_vote"]["seconds_per_run"]
+            / row["TMR"]["seconds_per_run"], 3)
         out["blocks"].append(row)
         print(json.dumps(row))
 

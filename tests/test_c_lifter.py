@@ -9,6 +9,7 @@ mm_common/mm.c) by printing error 0, and protection behavior must match
 the hand-written models/mm.py distributionally.
 """
 
+import json
 import os
 
 import jax.numpy as jnp
@@ -324,3 +325,28 @@ def test_all_shared_scope_with_cfcss():
     rec = prog.run(None)
     assert int(rec["errors"]) == 0
     assert not bool(rec["cfc_fault"])
+
+
+def test_supervisor_accepts_c_source(tmp_path):
+    """The supervisor takes the guest program by path, like the
+    reference's -f <binary>: a .c path runs a campaign on the ingested
+    source end-to-end."""
+    from coast_tpu.inject.supervisor import main as supervisor_main
+    src = tmp_path / "acc.c"
+    src.write_text("""
+unsigned int data[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+unsigned int total = 0;
+int main() {
+    int i;
+    for (i = 0; i < 8; i++) { total += data[i] * data[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    rc = supervisor_main(["-f", str(src), "-t", "8", "--batch-size", "8",
+                          "-l", str(tmp_path), "-d", "cpu"])
+    assert rc == 0
+    log = tmp_path / "acc_TMR_memory.json"
+    assert log.exists()
+    data = json.loads(log.read_text())
+    assert data["summary"]["injections"] == 8
