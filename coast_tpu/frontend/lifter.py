@@ -358,6 +358,11 @@ class _Phase:
         p = self.prefix
         new = dict(st)
         if self.prim == "scan":
+            if self.length == 0:
+                # Zero-trip phase: done at entry; the iteration branch is
+                # still traced by lax.cond, so it must not index 0-length
+                # xs -- a no-op keeps the trace valid.
+                return new
             i = st[self.idx_name]
             pos = (self.length - 1 - i) if self.reverse else i
             args = ([st[f"{p}k{j}"] for j in range(self.n_consts)]

@@ -437,3 +437,31 @@ def test_lift_fn_reverse_scan():
     # Protection still applies.
     tmr = TMR(r)
     assert int(tmr.run(None)["errors"]) == 0
+
+
+def test_lift_fn_zero_trip_loop_phase():
+    """A zero-length scan phase completes immediately: the phase machine
+    must pass through it (inter->inter edge) and still produce the right
+    output."""
+    def fn(data, empty):
+        def body(acc, x):
+            return acc + x, acc
+        tot, _ = jax.lax.scan(body, jnp.uint32(0), data)
+        def body2(acc, x):
+            return acc ^ x, acc
+        h, _ = jax.lax.scan(body2, tot, empty)      # length 0
+        def body3(acc, x):
+            return acc + 2 * x, acc
+        g, _ = jax.lax.scan(body3, h, data)
+        return g
+
+    data = _mp_data()
+    empty = jnp.zeros((0,), jnp.uint32)
+    r = lift_fn("zerotrip", fn, data, empty)
+    assert r.meta["phases"] == 3
+    want = _flat_expected(jax.jit(fn)(data, empty))
+    got = np.asarray(r.output(r.run_unprotected()))
+    np.testing.assert_array_equal(got, want)
+    # 12 + 0 + 12 iterations + 3 transitions
+    assert r.nominal_steps == 27
+    assert int(TMR(r).run(None)["errors"]) == 0
