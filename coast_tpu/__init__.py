@@ -7,6 +7,28 @@ reductions, CFCSS signatures are XOR tensor updates, and the QEMU+GDB fault
 injection campaign becomes one batched XLA program sharded across a slice.
 """
 
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache for every consumer of the package (the
+# CLIs each run in their own process; without this only pytest -- whose
+# conftest sets the same knobs -- benefited, and a CLI workflow like
+# opt -> supervisor -> analysis recompiled the same protected program
+# three times).  A user-configured cache dir or COAST_NO_COMPILE_CACHE=1
+# wins.
+if (not _os.environ.get("COAST_NO_COMPILE_CACHE")
+        and _jax.config.jax_compilation_cache_dir is None):
+    _repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    # Repo checkouts cache in-tree (gitignored); installed copies must
+    # not write into site-packages -- use the user cache dir instead.
+    _cache = (_os.path.join(_repo, ".jax_cache")
+              if _os.path.isdir(_os.path.join(_repo, ".git"))
+              else _os.path.join(_os.path.expanduser("~"), ".cache",
+                                 "coast_tpu", "jax"))
+    _jax.config.update("jax_compilation_cache_dir", _cache)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region)
 from coast_tpu.passes.dataflow_protection import (ProtectedProgram,

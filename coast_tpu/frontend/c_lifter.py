@@ -105,17 +105,24 @@ def _strip_comments(text: str) -> str:
 
 def preprocess(text: str, include_dirs: Sequence[str] = (),
                defines: Optional[Dict[str, str]] = None,
-               ) -> Tuple[str, Dict[str, str], List[str]]:
+               name_flags: Optional[Dict[str, bool]] = None,
+               ) -> Tuple[str, Dict[str, str], List[str], Dict[str, bool]]:
     """Strip/resolve the tiny preprocessor surface the benchmarks use.
 
-    Returns (source, defines, coast_annotations).  ``#include "local.c"``
-    is inlined from ``include_dirs`` (the mm_common.c pattern) and SHARES
-    the including file's ``#define`` table, exactly like cpp textual
-    inclusion; ``#include <...>`` system headers are dropped (the prelude
-    supplies the stdint names); object-like ``#define``s substitute.
+    Returns (source, defines, coast_macros, name_flags).  ``#include
+    "local.c"`` is inlined from ``include_dirs`` (the mm_common.c
+    pattern) and SHARES the including file's ``#define`` table, exactly
+    like cpp textual inclusion; ``#include <...>`` system headers are
+    dropped (the prelude supplies the stdint names); object-like
+    ``#define``s substitute.  ``name_flags`` collects per-declaration
+    scope annotations: ``uint32_t __xMR results[..]`` records
+    ``{"results": True}`` (and ``__NO_xMR`` False) -- the identifier
+    FOLLOWING the macro, matching the reference's declaration style
+    (tests/mm_common/mm_tmr.c).
     """
     text = _strip_comments(text)
     defines = {} if defines is None else defines
+    name_flags = {} if name_flags is None else name_flags
     annotations: List[str] = []
     out: List[str] = []
 
@@ -137,8 +144,9 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
                         if fname.endswith("COAST.h") or fname == "COAST.h":
                             break
                         with open(path) as f:
-                            sub, _, subann = preprocess(
-                                f.read(), include_dirs, defines)
+                            sub, _, subann, _ = preprocess(
+                                f.read(), include_dirs, defines,
+                                name_flags)
                         annotations.extend(subann)
                         out.append(sub)
                         break
@@ -155,6 +163,11 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
             continue
         if stripped.startswith("#"):
             continue                      # #ifdef guards etc.: benign here
+        # Per-declaration scope annotations: the identifier after the
+        # macro is the annotated name (__NO_xMR first: __xMR is its
+        # suffix-free cousin but word boundaries keep them distinct).
+        for m in re.finditer(r"\b(__NO_xMR|__xMR)\s+(\w+)", line):
+            name_flags[m.group(2)] = (m.group(1) == "__xMR")
         # Record + strip COAST annotation macros and GCC attributes.
         for mac in _COAST_MACROS:
             if re.search(rf"\b{mac}\b", line):
@@ -162,7 +175,7 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
                 line = re.sub(rf"\b{mac}\b", "", line)
         line = re.sub(r"__attribute__\s*\(\(.*?\)\)", "", line)
         out.append(expand(line))
-    return "\n".join(out), defines, annotations
+    return "\n".join(out), defines, annotations, name_flags
 
 
 # ---------------------------------------------------------------------------
@@ -816,9 +829,11 @@ def parse_c_sources(paths: Sequence[str]):
     include_dirs = sorted({os.path.dirname(os.path.abspath(p))
                            for p in paths})
     texts, anns = [], []
+    name_flags: Dict[str, bool] = {}
     for p in paths:
         with open(p) as f:
-            src, _, ann = preprocess(f.read(), include_dirs)
+            src, _, ann, _ = preprocess(f.read(), include_dirs,
+                                        name_flags=name_flags)
         texts.append(src)
         anns.extend(ann)
     parser = c_parser.CParser()
@@ -844,7 +859,7 @@ def parse_c_sources(paths: Sequence[str]):
         elif isinstance(ext, c_ast.FuncDef):
             funcs[ext.decl.name] = ext
     globals_ = _parse_globals(tu, typedefs)
-    return tu, globals_, funcs, typedefs, anns
+    return tu, globals_, funcs, typedefs, anns, name_flags
 
 
 def lift_c(name: str,
@@ -862,7 +877,8 @@ def lift_c(name: str,
     program printf'd become its outputs.  ``entry`` (default ``main``) is
     executed.  COAST.h macros in the source set ``default_xmr`` unless
     overridden."""
-    tu, globals_, funcs, typedefs, anns = parse_c_sources(sources)
+    tu, globals_, funcs, typedefs, anns, name_flags = \
+        parse_c_sources(sources)
     if entry not in funcs:
         raise CLiftError(
             f"entry function {entry!r} not defined; have "
@@ -887,6 +903,66 @@ def lift_c(name: str,
         max_steps=max_steps,
         meta={"frontend": "c", "sources": [os.path.basename(s)
                                            for s in sources],
+              "source_paths": [os.path.realpath(s) for s in sources],
               "coast_annotations": sorted(set(anns)),
+              "global_xmr": {n: f for n, f in sorted(name_flags.items())
+                             if n in globals_},
               "observed_globals": out_globals, **(meta or {})})
+
+    # Per-declaration __xMR/__NO_xMR annotations, lowered the way the
+    # reference's engine consumes them (tests/mm_common/mm_tmr.c):
+    #
+    #   * an annotated FUNCTION replicates its computation -- its locals
+    #     become the lifted loop machinery (carries, indices, _phase), so
+    #     those leaves inherit the function scope;
+    #   * an annotated GLOBAL maps onto the state leaf its argument
+    #     position became -- except UNWRITTEN globals, which the
+    #     reference never clones regardless of annotation (the
+    #     unwritten-global rule, cloning.cpp:62-288), so RO leaves keep
+    #     the shared default;
+    #   * globals consumed only through a transformed value have no
+    #     single leaf; warn, do not drop silently.
+    import dataclasses as _dc
+    from coast_tpu.ir.region import KIND_RO
+    arg_leaves = region.meta.get("arg_leaves", {})
+    global_leaves = set()
+    for gname, flag in sorted(name_flags.items()):
+        if gname not in globals_:
+            continue
+        idx = g_names.index(gname)
+        leaf = arg_leaves.get(idx)
+        if leaf is None:
+            import warnings
+            warnings.warn(
+                f"lift_c: __xMR annotation on global {gname!r} could not "
+                "be mapped to a state leaf (the value is transformed "
+                "before its first loop use); the region default applies",
+                stacklevel=2)
+            continue
+        global_leaves.add(leaf)
+        if region.spec[leaf].kind == KIND_RO:
+            continue                      # unwritten: never cloned
+        region.spec[leaf] = _dc.replace(region.spec[leaf], xmr=flag)
+    fn_flags = [f for n, f in name_flags.items() if n in funcs]
+    if fn_flags and all(fn_flags):
+        # Every annotated function is __xMR (and at least one is): the
+        # stepped machinery derived from their locals is inside the
+        # sphere of replication.
+        for leaf, spec in region.spec.items():
+            if leaf in global_leaves or spec.kind == KIND_RO:
+                continue
+            if spec.xmr is None:
+                region.spec[leaf] = _dc.replace(spec, xmr=True)
+    elif fn_flags:
+        # Mixed / __NO_xMR function scopes cannot be attributed to
+        # individual leaves (locals from different functions fuse into
+        # one stepped machinery); never drop annotations silently.
+        import warnings
+        warnings.warn(
+            "lift_c: mixed function-level __xMR/__NO_xMR annotations "
+            "cannot be lowered per-function (their locals fuse into one "
+            "stepped machinery); the region default applies to "
+            "machinery leaves.  Annotate globals, or split the scopes "
+            "with lift_fn annotations.", stacklevel=2)
+    region.validate()
     return region

@@ -346,6 +346,18 @@ class _Phase:
                 st[f"{p}c{j}"] = v
         return st
 
+    def leaf_names_by_position(self) -> List[str]:
+        """Leaf name for each loop input, aligned with eqn.invars order
+        (the inverse of leaves_from_invals' layout)."""
+        p = self.prefix
+        if self.prim == "scan":
+            return ([f"{p}k{j}" for j in range(self.n_consts)]
+                    + [f"{p}c{j}" for j in range(self.n_carry)]
+                    + [f"{p}x{j}" for j in range(self.n_xs)])
+        return ([f"{p}kc{j}" for j in range(self.cn)]
+                + [f"{p}k{j}" for j in range(self.bn)]
+                + [f"{p}c{j}" for j in range(self.n_carry)])
+
     def zero_leaves(self) -> Dict[str, jax.Array]:
         """Placeholder leaves for a phase whose inputs arrive at runtime
         (written by the preceding interlude transition)."""
@@ -507,14 +519,38 @@ def lift_fn(name: str,
         else:
             baked[v] = val
 
+    # One set of phase adapters, shared by the builders below and by the
+    # arg->leaf map, so the leaf-naming scheme lives in exactly one place.
+    if len(loops) == 1:
+        phases = [_Phase(loops[0], prefix="", idx_name="_t")]
+    else:
+        phases = [_Phase(loops[p], prefix=f"p{p}_", idx_name=f"p{p}_i")
+                  for p in range(len(loops))]
+
+    # Which state leaf each PROGRAM ARGUMENT became (by flat arg index):
+    # g leaves, or a loop input leaf when the arg feeds a loop unchanged.
+    # Args transformed before use (data * scale) have no single leaf and
+    # are absent.  Consumers (lift_c's per-global __xMR annotations) use
+    # this to map source-level names onto derived leaves.
+    arg_leaves: Dict[int, str] = {}
+    invar_index = {v: i for i, v in enumerate(jaxpr.invars)}
+    for v, leaf in g_map.items():
+        if v in invar_index:
+            arg_leaves[invar_index[v]] = leaf
+    for loop, phase in zip(loops, phases):
+        for v, leaf in zip(loop.invars, phase.leaf_names_by_position()):
+            if not isinstance(v, Literal) and v in invar_index:
+                arg_leaves.setdefault(invar_index[v], leaf)
+    meta = {"arg_leaves": arg_leaves, **(meta or {})}
+
     if len(loops) == 1:
         region = _lift_fn_single(name, jaxpr, loops[0], segments[0], env,
                                  g_map, baked, annotations, default_xmr,
-                                 max_steps, step_cap, meta)
+                                 max_steps, step_cap, meta, phases[0])
     else:
         region = _lift_fn_multi(name, jaxpr, loops, segments, env,
                                 g_map, baked, annotations, default_xmr,
-                                max_steps, step_cap, meta)
+                                max_steps, step_cap, meta, phases)
     return region
 
 
@@ -525,8 +561,8 @@ def _seed_env(st, g_map, baked):
 
 
 def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
-                    annotations, default_xmr, max_steps, step_cap, meta):
-    phase = _Phase(loop, prefix="", idx_name="_t")
+                    annotations, default_xmr, max_steps, step_cap, meta,
+                    phase):
     in_vals = [_read(env, v) for v in loop.invars]
     base_leaves = phase.leaves_from_invals(in_vals)
     g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
@@ -557,14 +593,13 @@ def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
 
 
 def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
-                   annotations, default_xmr, max_steps, step_cap, meta):
+                   annotations, default_xmr, max_steps, step_cap, meta,
+                   phases):
     """Multi-phase region: phase p executes loop p one iteration per step;
     when loop p completes, ONE transition step evaluates the interlude
     (code between loop p and loop p+1), seeds phase p+1's leaves, and
     advances ``_phase``.  The epilogue stays in output()."""
     m = len(loops)
-    phases = [_Phase(loops[p], prefix=f"p{p}_", idx_name=f"p{p}_i")
-              for p in range(m)]
 
     # Interlude values consumed by LATER segments (beyond the transition
     # that computes them) must live in state: m<j> leaves.

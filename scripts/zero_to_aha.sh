@@ -1,0 +1,56 @@
+#!/bin/sh
+# The complete reference workflow, end to end, from a C source file:
+#
+#   1. protect + run the program        (reference: clang | opt -TMR | board)
+#   2. forced single fault check        (reference: gdb injector setBreaking)
+#   3. a seeded fault-injection campaign (reference: supervisor.py + QEMU)
+#   4. analysis -- by the REFERENCE's own unmodified jsonParser.py when a
+#      checkout is present, else by the repo's analysis CLI
+#
+# Usage: sh scripts/zero_to_aha.sh [program.c] [n_injections]
+# Defaults to the reference's own mm.c when the checkout exists.
+set -e
+cd "$(dirname "$0")/.."
+
+SRC="${1:-/root/reference/tests/mm_common/mm.c}"
+N="${2:-2000}"
+LOGDIR="$(mktemp -d)"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-}"
+
+echo "== 1. opt -TMR: protect and run the program =="
+python -m coast_tpu.opt -TMR -countErrors "$SRC"
+
+echo "== 2. forced single fault (supervisor --forceBreak) =="
+NAME="$(basename "$SRC" .c)"
+FIRST_LEAF=$(python - "$SRC" <<'EOF'
+import os
+import sys
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # The axon site hook overrides the env var programmatically; honor
+    # the CPU request before any device touch (see tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+from coast_tpu.models import resolve_region
+region = resolve_region(sys.argv[1])
+mem = [n for n, s in region.spec.items() if s.kind == "mem"]
+print((mem or sorted(region.spec))[0])
+EOF
+)
+python -m coast_tpu.inject.supervisor -f "$SRC" \
+    --forceBreak "$FIRST_LEAF:0:0:7:1" --breakCount 1 --no-logging
+
+echo "== 3. $N-injection TMR campaign, reference-container log =="
+python -m coast_tpu.inject.supervisor -f "$SRC" -t "$N" \
+    --log-format reference -l "$LOGDIR"
+LOG="$LOGDIR/${NAME}_TMR_memory.json"
+
+echo "== 4. analysis =="
+if [ -f /root/reference/simulation/platform/jsonParser.py ]; then
+    echo "-- the reference's own jsonParser.py --"
+    (cd /root/reference/simulation/platform && python jsonParser.py "$LOG")
+else
+    python -m coast_tpu.analysis "$LOG"
+fi
+echo "log: $LOG"

@@ -350,3 +350,52 @@ int main() {
     assert log.exists()
     data = json.loads(log.read_text())
     assert data["summary"]["injections"] == 8
+
+
+MM_TMR_C = "/root/reference/tests/mm_common/mm_tmr.c"
+
+
+def test_annotated_mm_tmr_scope():
+    """The reference's ANNOTATED variant (mm_tmr.c: __DEFAULT_NO_xMR +
+    per-declaration __xMR on globals and functions) lowers to the
+    faithful scope: function-local machinery and written globals inside
+    the sphere of replication; unwritten globals shared regardless of
+    annotation (the unwritten-global rule, cloning.cpp:62-288); the
+    golden oracle still bit-exact."""
+    if not os.path.exists(MM_TMR_C):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+    r = lift_c("mm_tmr_c", [MM_TMR_C])
+    assert r.meta["global_xmr"]["results_matrix"] is True
+    prog = TMR(r)
+    repl = {k for k, v in prog.replicated.items() if v}
+    assert "_phase" in repl                  # machinery inside the SoR
+    # first/second/xor_golden are unwritten -> never cloned.
+    out = np.asarray(r.output(r.run_unprotected()))
+    assert out[-1] == 0
+    assert int(np.bitwise_xor.reduce(out[:81])) == 2802879457
+
+
+def test_annotation_scope_protects():
+    """Same program, reference sources: the __xMR-annotated variant's
+    campaign SDC rate must be far below the unannotated one's, with the
+    voters visibly correcting -- the reference's own zero-to-aha."""
+    if not os.path.exists(MM_TMR_C):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+    n = 600
+    plain = lift_c("mm_plain", [MM_C])
+    annot = lift_c("mm_annot", [MM_TMR_C])
+    rp = CampaignRunner(TMR(plain)).run(n, seed=3, batch_size=n)
+    runner_a = CampaignRunner(TMR(annot))
+    ra = runner_a.run(n, seed=3, batch_size=n)
+    assert rp.counts["corrected"] == 0           # nothing replicated
+    assert ra.counts["corrected"] > 0
+    assert ra.counts["sdc"] < rp.counts["sdc"] / 2
+    # Replicated-state flips never SDC (fidelity invariant).
+    import numpy as _np
+    mmap = CampaignRunner(TMR(annot)).mmap
+    repl = {s.leaf_id for s in mmap.sections if s.lanes > 1}
+    lid = _np.asarray(ra.schedule.leaf_id)
+    codes = _np.asarray(ra.codes)
+    assert not _np.any(codes[_np.isin(lid, list(repl))] == 2)
