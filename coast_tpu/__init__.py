@@ -27,7 +27,11 @@ if (not _os.environ.get("COAST_NO_COMPILE_CACHE")
               else _os.path.join(_os.path.expanduser("~"), ".cache",
                                  "coast_tpu", "jax"))
     _jax.config.update("jax_compilation_cache_dir", _cache)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Only lower the threshold when still at JAX's default (1.0): a
+    # user-configured value must survive the import.
+    if _jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.5)
 
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region)

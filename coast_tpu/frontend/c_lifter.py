@@ -163,11 +163,20 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
             continue
         if stripped.startswith("#"):
             continue                      # #ifdef guards etc.: benign here
-        # Per-declaration scope annotations: the identifier after the
-        # macro is the annotated name (__NO_xMR first: __xMR is its
-        # suffix-free cousin but word boundaries keep them distinct).
-        for m in re.finditer(r"\b(__NO_xMR|__xMR)\s+(\w+)", line):
-            name_flags[m.group(2)] = (m.group(1) == "__xMR")
+        # Per-declaration scope annotations.  Styles the reference corpus
+        # uses: mid-declaration ``uint32_t __xMR name[..]`` (the token
+        # after the macro is the name), prefix ``__xMR uint32_t name``
+        # (the SECOND token is; the first is a type and resolves to
+        # nothing), and trailing ``int foo() __xMR``.
+        for m in re.finditer(r"\b(__NO_xMR|__xMR)\s+(\w+)(?:\s+(\w+))?",
+                             line):
+            flag = m.group(1) == "__xMR"
+            name_flags.setdefault(m.group(2), flag)
+            if m.group(3):
+                name_flags.setdefault(m.group(3), flag)
+        for m in re.finditer(r"\b(\w+)\s*\([^()]*\)\s*(__NO_xMR|__xMR)\b",
+                             line):
+            name_flags.setdefault(m.group(1), m.group(2) == "__xMR")
         # Record + strip COAST annotation macros and GCC attributes.
         for mac in _COAST_MACROS:
             if re.search(rf"\b{mac}\b", line):
@@ -942,14 +951,22 @@ def lift_c(name: str,
         global_leaves.add(leaf)
         if region.spec[leaf].kind == KIND_RO:
             continue                      # unwritten: never cloned
-        region.spec[leaf] = _dc.replace(region.spec[leaf], xmr=flag)
+        if region.spec[leaf].xmr is None:     # explicit API override wins
+            region.spec[leaf] = _dc.replace(region.spec[leaf], xmr=flag)
+    # Every GLOBAL's leaf (annotated or not) keeps its own scope: the
+    # function-level blanket below covers only the machinery derived
+    # from function LOCALS -- an unannotated global under
+    # __DEFAULT_NO_xMR stays unprotected, as in the reference.
+    all_global_leaves = {arg_leaves[g_names.index(n)]
+                         for n in g_names
+                         if g_names.index(n) in arg_leaves}
     fn_flags = [f for n, f in name_flags.items() if n in funcs]
     if fn_flags and all(fn_flags):
         # Every annotated function is __xMR (and at least one is): the
         # stepped machinery derived from their locals is inside the
         # sphere of replication.
         for leaf, spec in region.spec.items():
-            if leaf in global_leaves or spec.kind == KIND_RO:
+            if leaf in all_global_leaves or spec.kind == KIND_RO:
                 continue
             if spec.xmr is None:
                 region.spec[leaf] = _dc.replace(spec, xmr=True)

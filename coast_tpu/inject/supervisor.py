@@ -255,7 +255,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         writer = {"json": logs.write_json, "ndjson": logs.write_ndjson,
                   "columnar": logs.write_columnar,
                   "reference": logs.write_reference_json}[args.log_format]
-        writer(res, mmap, path)
+        src_paths = prog.region.meta.get("source_paths")
+        if args.log_format == "reference" and src_paths:
+            # A lifted program's guest-executable line is its SOURCE file
+            # (the registry fallback would name the package).
+            writer(res, mmap, path, exec_path=src_paths[0])
+        else:
+            writer(res, mmap, path)
         print(f"wrote {path}")
     return 0
 

@@ -46,11 +46,29 @@ python -m coast_tpu.inject.supervisor -f "$SRC" -t "$N" \
     --log-format reference -l "$LOGDIR"
 LOG="$LOGDIR/${NAME}_TMR_memory.json"
 
+# The aha: when running the reference's unannotated mm.c, also campaign
+# its __xMR-ANNOTATED variant -- same program, same seeds; the voters
+# change the story.
+TMR_SRC="$(dirname "$SRC")/${NAME}_tmr.c"
+if [ "$NAME" = "mm" ] && [ -f "$TMR_SRC" ]; then
+    echo "== 3b. same campaign on the __xMR-annotated variant =="
+    python -m coast_tpu.inject.supervisor -f "$TMR_SRC" -t "$N" \
+        --log-format reference -l "$LOGDIR"
+fi
+
 echo "== 4. analysis =="
+TMR_LOG="$LOGDIR/${NAME}_tmr_TMR_memory.json"
 if [ -f /root/reference/simulation/platform/jsonParser.py ]; then
     echo "-- the reference's own jsonParser.py --"
-    (cd /root/reference/simulation/platform && python jsonParser.py "$LOG")
+    if [ -f "$TMR_LOG" ]; then
+        (cd /root/reference/simulation/platform \
+            && python jsonParser.py "$LOG" -k "$TMR_LOG")
+    else
+        (cd /root/reference/simulation/platform \
+            && python jsonParser.py "$LOG")
+    fi
 else
     python -m coast_tpu.analysis "$LOG"
+    [ -f "$TMR_LOG" ] && python -m coast_tpu.analysis "$TMR_LOG"
 fi
-echo "log: $LOG"
+echo "logs in: $LOGDIR"

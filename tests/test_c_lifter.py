@@ -394,7 +394,7 @@ def test_annotation_scope_protects():
     assert ra.counts["sdc"] < rp.counts["sdc"] / 2
     # Replicated-state flips never SDC (fidelity invariant).
     import numpy as _np
-    mmap = CampaignRunner(TMR(annot)).mmap
+    mmap = runner_a.mmap
     repl = {s.leaf_id for s in mmap.sections if s.lanes > 1}
     lid = _np.asarray(ra.schedule.leaf_id)
     codes = _np.asarray(ra.codes)
