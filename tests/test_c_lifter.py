@@ -399,3 +399,29 @@ def test_annotation_scope_protects():
     lid = _np.asarray(ra.schedule.leaf_id)
     codes = _np.asarray(ra.codes)
     assert not _np.any(codes[_np.isin(lid, list(repl))] == 2)
+
+
+def test_supervisor_reference_log_names_source(tmp_path):
+    """A lifted program's reference-container log must name its C source
+    on the exec-path line (the guest-executable analogue), not the
+    package fallback."""
+    from coast_tpu.inject.supervisor import main as supervisor_main
+    src = tmp_path / "tiny2.c"
+    src.write_text("""
+unsigned int data[4] = {9, 8, 7, 6};
+unsigned int total = 0;
+int main() {
+    int i;
+    for (i = 0; i < 4; i++) { total += data[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    rc = supervisor_main(["-f", str(src), "-t", "4", "--batch-size", "4",
+                          "-l", str(tmp_path), "--log-format", "reference",
+                          "-d", "cpu"])
+    assert rc == 0
+    log = tmp_path / "tiny2_TMR_memory.json"
+    with open(log) as f:
+        assert f.readline().strip() == os.path.realpath(str(src))
+        assert len(json.load(f)) == 4
