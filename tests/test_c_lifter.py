@@ -429,3 +429,31 @@ int main() {
     with open(log) as f:
         assert f.readline().strip() == os.path.realpath(str(src))
         assert len(json.load(f)) == 4
+
+
+def test_api_annotation_overrides_source_macro(tmp_path):
+    """Explicit lift_c annotations win over source-level __xMR (the
+    docstring contract: macros apply 'unless overridden')."""
+    from coast_tpu import LeafSpec
+    from coast_tpu.frontend.c_lifter import lift_c
+    src = tmp_path / "anno.c"
+    src.write_text("""
+unsigned int __xMR buf[4] = {1, 2, 3, 4};
+unsigned int __xMR total = 0;
+int main() {
+    int i;
+    for (i = 0; i < 4; i++) { buf[i] = buf[i] + total; total += buf[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    r = lift_c("anno", [str(src)])
+    # Source macro applies: buf's leaf replicated by annotation.
+    buf_leaf = r.meta["arg_leaves"][sorted(["buf", "total"]).index("buf")]
+    assert r.spec[buf_leaf].xmr is True
+    # Explicit API override flips it.
+    r2 = lift_c("anno2", [str(src)],
+                annotations={buf_leaf: LeafSpec(r.spec[buf_leaf].kind,
+                                                xmr=False,
+                                                no_verify=True)})
+    assert r2.spec[buf_leaf].xmr is False
