@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax.extend.core import Literal
 
 from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ops.indexing import row_select, row_update
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region, State)
 from coast_tpu.passes.verification import analyze_step, reads_of
@@ -379,16 +380,14 @@ class _Phase:
             pos = (self.length - 1 - i) if self.reverse else i
             args = ([st[f"{p}k{j}"] for j in range(self.n_consts)]
                     + [st[f"{p}c{j}"] for j in range(self.n_carry)]
-                    + [jax.lax.dynamic_index_in_dim(
-                        st[f"{p}x{j}"], pos, axis=0, keepdims=False)
+                    + [row_select(st[f"{p}x{j}"], pos)
                        for j in range(self.n_xs)])
             outs = jax.core.eval_jaxpr(self.body.jaxpr, self.body.consts,
                                        *args)
             for j in range(self.n_carry):
                 new[f"{p}c{j}"] = outs[j]
             for j, y in enumerate(outs[self.n_carry:]):
-                new[f"{p}y{j}"] = jax.lax.dynamic_update_index_in_dim(
-                    st[f"{p}y{j}"], y, pos, axis=0)
+                new[f"{p}y{j}"] = row_update(st[f"{p}y{j}"], y, pos)
             new[self.idx_name] = i + 1
         else:
             args = ([st[f"{p}k{j}"] for j in range(self.bn)]

@@ -76,15 +76,23 @@ class CampaignRunner:
 
     def __init__(self, prog: ProtectedProgram,
                  sections: Optional[Sequence[str]] = None,
-                 strategy_name: Optional[str] = None):
+                 strategy_name: Optional[str] = None,
+                 unroll: int = 1):
+        """``unroll`` forwards to ``ProtectedProgram.run``: how many
+        early-exit steps each loop iteration executes.  Classification is
+        identical at any value (overshoot sub-steps are masked no-ops);
+        it trades per-iteration loop overhead against masked work, which
+        matters on dispatch-bound backends (the small-benchmark TPU
+        campaign: scripts/mfu_sweep.py measures the trade)."""
         self.prog = prog
         self.mmap = MemoryMap(prog, sections)
         self.strategy_name = strategy_name or f"N={prog.cfg.num_clones}"
+        self.unroll = max(1, int(unroll))
         out_words = int(np.prod(jax.eval_shape(
             prog.region.output, jax.eval_shape(prog.region.init)).shape))
 
         def run_one(fault: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
-            rec = prog.run(fault)
+            rec = prog.run(fault, unroll=self.unroll)
             return {
                 "code": cls.classify(rec, out_words),
                 "errors": rec["errors"],

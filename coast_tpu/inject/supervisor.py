@@ -86,6 +86,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         help="resume the seeded campaign at injection "
                         "#N (gdbClient.py:401 --start-num analogue)")
     parser.add_argument("--batch-size", type=int, default=4096)
+    parser.add_argument("--unroll", type=int, default=1,
+                        help="early-exit loop steps per iteration in the "
+                        "batched runner; classification-identical at any "
+                        "value, trades loop dispatch overhead against "
+                        "masked overshoot work (sweep: scripts/"
+                        "mfu_sweep.py)")
     parser.add_argument("--stratified", action="store_true",
                         help="equal-allocation sampling per section: -t "
                         "is divided across sections (floored at 1 each, "
@@ -200,7 +206,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         runner = CampaignRunner(prog,
                                 sections=section_filter(prog, args.section),
-                                strategy_name=strategy)
+                                strategy_name=strategy,
+                                unroll=args.unroll)
     except ValueError:
         print(f"Error, {prog.region.name} has no injectable leaves in "
               f"section '{args.section}'!", file=sys.stderr)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from coast_tpu.ir.graph import BlockGraph
+from coast_tpu.ops.indexing import row_select, row_update
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region)
 
@@ -70,17 +71,17 @@ def make_region() -> Region:
 
     def step(state, t):
         i, phase = state["i"], state["phase"]
-        # Gather of row i: OOB (corrupted i) clamps, i.e. reads the wrong
+        # Row i access: OOB (corrupted i) clamps, i.e. reads the wrong
         # row rather than trapping -- documented fidelity envelope vs the
-        # A9's data aborts (SURVEY.md §7 "Hard parts").
-        row_a = jax.lax.dynamic_index_in_dim(
-            state["first"], i, axis=0, keepdims=False).astype(jnp.uint32)
+        # A9's data aborts (SURVEY.md §7 "Hard parts").  On TPU the
+        # select/update lower densely (ops/indexing.py) so the vmapped
+        # campaign never pays batched gather/scatter.
+        row_a = row_select(state["first"], i).astype(jnp.uint32)
         computed = jnp.sum(row_a[:, None] * state["second"].astype(jnp.uint32),
                            axis=0)
         compute_phase = phase == 0
         acc = jnp.where(compute_phase, computed, state["acc"])
-        stored = jax.lax.dynamic_update_index_in_dim(
-            state["results"], state["acc"], i, axis=0)
+        stored = row_update(state["results"], state["acc"], i)
         results = jnp.where(compute_phase, state["results"], stored)
         return {
             **state,

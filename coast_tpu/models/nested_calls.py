@@ -20,6 +20,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from coast_tpu.ops.indexing import row_select, row_update
+
 from coast_tpu.ir.graph import BlockGraph
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region)
@@ -50,12 +52,10 @@ def make_region() -> Region:
         }
 
     def step(state, t, fns):
-        x = jax.lax.dynamic_index_in_dim(state["data"], state["i"],
-                                         keepdims=False)
+        x = row_select(state["data"], state["i"])
         y = fns.mix(state["acc"] ^ x)
         z = fns.fold(y)
-        out = jax.lax.dynamic_update_index_in_dim(state["out"], z,
-                                                  state["i"], axis=0)
+        out = row_update(state["out"], z, state["i"])
         return {"data": state["data"], "out": out,
                 "i": state["i"] + 1, "acc": y}
 

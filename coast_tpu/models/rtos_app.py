@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from coast_tpu.ops.indexing import row_update
 import numpy as np
 
 from coast_tpu.ir.graph import BlockGraph
@@ -92,7 +94,7 @@ def saturate(v):
 def ring_push(ring, idx, v):
     """Protected queue send: write v at ring[idx] (xQueueSend stand-in;
     the protectedLibFn citizen -- replicated body, single-copy boundary)."""
-    return jax.lax.dynamic_update_index_in_dim(ring, v, idx, axis=0)
+    return row_update(ring, v, idx)
 
 
 def uart_fmt(v):
@@ -146,8 +148,7 @@ def make_region() -> Region:
 
         widx = fns.clampi(s["widx"], RING)
         ring = fns.ring_push(s["ring"], widx, val)
-        uart = jax.lax.dynamic_update_index_in_dim(
-            s["uart"], fns.uart_fmt(val), widx, axis=0)
+        uart = row_update(s["uart"], fns.uart_fmt(val), widx)
 
         return {
             "data": s["data"],

@@ -1,0 +1,106 @@
+"""Dynamic row indexing that stays dense on the TPU.
+
+The guest models step one row (or element) at a time with a *traced* index
+-- the reference's benchmarks walk arrays with a loop counter that faults
+can corrupt (e.g. matrixMultiply.c's ``i``).  The natural JAX spelling,
+``lax.dynamic_index_in_dim`` / ``lax.dynamic_update_index_in_dim``, is a
+dynamic-slice at batch=1 -- but under the campaign's ``vmap`` the start
+index becomes batch-varying and XLA lowers the pair to gather/scatter,
+which the TPU executes far off its dense-op roofline (the tiny-benchmark
+campaign's per-iteration cost is dominated by exactly these ops).
+
+``row_select``/``row_update`` offer the same clamped semantics with a
+selectable lowering:
+
+* ``"slice"``  -- the dynamic-slice spelling (gather/scatter under vmap);
+* ``"onehot"`` -- a dense formulation: select is a one-hot contraction,
+  update is a broadcast-where over a one-hot row mask.  Both are plain
+  elementwise/reduction ops, so the vmapped campaign stays on the VPU.
+* ``"auto"``   -- ``"onehot"`` when the default backend is a TPU AND the
+  indexed axis is small (<= ``ONEHOT_MAX_ROWS``), else ``"slice"``.
+  The dense form reads every row per access (O(n * row) vs the slice's
+  O(row)), so it is a win only where per-op dispatch/gather overhead
+  dominates -- the guest models' small working arrays.  Long arrays
+  (e.g. lifted scans over big inputs) keep the slice lowering until the
+  on-chip A/B (scripts/mfu_sweep.py) says otherwise.  Gathers are cheap
+  on CPU and the host fallback's throughput record lives there, so CPU
+  always resolves to ``"slice"``.
+
+Both lowerings treat an out-of-range index exactly like dynamic-slice
+does -- one python-style negative wrap, then clamp into range (a
+corrupted loop counter reads/writes a wrong row rather than trapping;
+the documented fidelity envelope vs the A9's data aborts, SURVEY.md
+§7) -- so campaign classifications are bit-identical across modes
+(tests/test_benchmarks.py::test_indexing_modes_bit_identical).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+# Auto-mode bound: above this row count the dense lowering's whole-array
+# read per access is assumed to cost more than the gather it replaces.
+ONEHOT_MAX_ROWS = 64
+
+
+def _resolve(mode: str, n_rows: int) -> str:
+    if mode == "auto":
+        # Resolved at TRACE time; COAST_INDEXING_MODE forces a lowering
+        # for A/B measurement (scripts/mfu_sweep.py) without touching
+        # model code.
+        forced = os.environ.get("COAST_INDEXING_MODE")
+        if forced in ("onehot", "slice"):
+            return forced
+        return ("onehot" if (jax.default_backend() == "tpu"
+                             and n_rows <= ONEHOT_MAX_ROWS) else "slice")
+    if mode not in ("onehot", "slice"):
+        raise ValueError(f"unknown indexing mode '{mode}'")
+    return mode
+
+
+def _clamped_onehot(i: jax.Array, n: int, dtype) -> jax.Array:
+    # Match lax.dynamic_slice index semantics exactly: one python-style
+    # negative wrap, then clamp into range.  Campaign classifications of
+    # corrupted loop counters depend on this being bit-identical to the
+    # dynamic-slice lowering.
+    ic = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+    return (jnp.arange(n, dtype=jnp.int32) == ic).astype(dtype)
+
+
+def row_select(mat: jax.Array, i: jax.Array, mode: str = "auto") -> jax.Array:
+    """``mat[clamp(i)]`` along axis 0, any rank >= 1."""
+    if _resolve(mode, mat.shape[0]) == "slice":
+        return jax.lax.dynamic_index_in_dim(mat, i, axis=0, keepdims=False)
+    if mat.dtype == jnp.bool_:
+        # No integer-multiply trick for bools; reduce through int32.
+        return row_select(mat.astype(jnp.int32), i, mode).astype(jnp.bool_)
+    if jnp.issubdtype(mat.dtype, jnp.inexact):
+        # Float arithmetic cannot implement an exact select: 0*inf=nan in
+        # a masked-out row would poison the sum and a selected -0.0 would
+        # come back +0.0.  Faulted guests hold exactly such values (a bit
+        # flip in an exponent makes inf/nan), so select through the bit
+        # pattern instead -- integer one-hot math is exact, and the
+        # round-trip preserves every payload bit.
+        bits = jax.lax.bitcast_convert_type(
+            mat, jnp.dtype(f"uint{mat.dtype.itemsize * 8}"))
+        return jax.lax.bitcast_convert_type(
+            row_select(bits, i, mode), mat.dtype)
+    hot = _clamped_onehot(i, mat.shape[0], mat.dtype)
+    hot = hot.reshape((mat.shape[0],) + (1,) * (mat.ndim - 1))
+    # dtype pinned: jnp.sum would promote sub-word ints (uint16 -> uint32),
+    # and the float path bitcasts the result back expecting the same width.
+    return jnp.sum(hot * mat, axis=0, dtype=mat.dtype)
+
+
+def row_update(mat: jax.Array, row: jax.Array, i: jax.Array,
+               mode: str = "auto") -> jax.Array:
+    """``mat.at[clamp(i)].set(row)`` along axis 0, any rank >= 1."""
+    if _resolve(mode, mat.shape[0]) == "slice":
+        return jax.lax.dynamic_update_index_in_dim(mat, row, i, axis=0)
+    hot = _clamped_onehot(i, mat.shape[0], jnp.bool_)
+    hot = hot.reshape((mat.shape[0],) + (1,) * (mat.ndim - 1))
+    return jnp.where(hot, jnp.asarray(row, mat.dtype)[None], mat)

@@ -90,26 +90,39 @@ def main():
     import jax.numpy as jnp
     from coast_tpu.inject.schedule import generate
 
-    runner = CampaignRunner(TMR(REGISTRY["matrixMultiply"]()))
-    prog = runner.prog
     n = 4096
-    sched = generate(runner.mmap, n, 42, prog.region.nominal_steps)
     out["unroll"] = []
-    for unroll in (1, 2, 4, 8):
-        batch = jax.jit(jax.vmap(lambda f: prog.run(f, unroll=unroll)))
-        fault = {k: jnp.asarray(getattr(sched, k)[:1024])
-                 for k in ("leaf_id", "lane", "word", "bit", "t")}
-        jax.block_until_ready(batch(fault))                # compile
-        t0 = time.perf_counter()
-        for lo in range(0, n, 1024):
-            f = {k: jnp.asarray(getattr(sched, k)[lo:lo + 1024])
-                 for k in ("leaf_id", "lane", "word", "bit", "t")}
-            o = batch(f)
-        jax.block_until_ready(o)
-        sec = time.perf_counter() - t0
-        out["unroll"].append({"unroll": unroll,
-                              "injections_per_sec": round(n / sec, 1)})
-        print(json.dumps(out["unroll"][-1]))
+    # Grid: indexing lowering (dense one-hot vs dynamic-slice -> the
+    # batched gather/scatter question, ops/indexing.py) x unroll (loop
+    # dispatch amortisation).  The region must be rebuilt per mode: the
+    # lowering is resolved at trace time from COAST_INDEXING_MODE.
+    prior_mode = os.environ.get("COAST_INDEXING_MODE")
+    try:
+        for mode in ("onehot", "slice"):
+            os.environ["COAST_INDEXING_MODE"] = mode
+            runner = CampaignRunner(TMR(REGISTRY["matrixMultiply"]()))
+            prog = runner.prog
+            sched = generate(runner.mmap, n, 42, prog.region.nominal_steps)
+            for unroll in (1, 2, 4, 8):
+                batch = jax.jit(jax.vmap(lambda f: prog.run(f, unroll=unroll)))
+                fault = {k: jnp.asarray(getattr(sched, k)[:1024])
+                         for k in ("leaf_id", "lane", "word", "bit", "t")}
+                jax.block_until_ready(batch(fault))                # compile
+                t0 = time.perf_counter()
+                for lo in range(0, n, 1024):
+                    f = {k: jnp.asarray(getattr(sched, k)[lo:lo + 1024])
+                         for k in ("leaf_id", "lane", "word", "bit", "t")}
+                    o = batch(f)
+                jax.block_until_ready(o)
+                sec = time.perf_counter() - t0
+                out["unroll"].append({"indexing": mode, "unroll": unroll,
+                                      "injections_per_sec": round(n / sec, 1)})
+                print(json.dumps(out["unroll"][-1]))
+    finally:
+        if prior_mode is None:
+            os.environ.pop("COAST_INDEXING_MODE", None)
+        else:
+            os.environ["COAST_INDEXING_MODE"] = prior_mode
 
     fname = ("mfu_sweep.json" if backend == "tpu"
              else "mfu_sweep_cpu_smoke.json")
