@@ -25,8 +25,19 @@ are loud and name the construct):
     become program outputs -- the reference's QEMU loop greps stdout, so
     stdout IS the observable; prints must sit OUTSIDE loops/branches,
     where the printed value is a well-defined program output);
-  * narrow integer types (char/short/uint8_t/uint16_t) are REFUSED, not
-    silently widened: their mod-2^8/2^16 wraparound is not modeled;
+  * narrow integer types (char/short/uint8_t/uint16_t): modeled with
+    exact C value semantics -- values live promoted in int32 lanes and
+    every store/cast re-normalizes (mask + sign-extend), so byte/short
+    wraparound (CRC state machines) is bit-exact; memory LAYOUT stays
+    one lane word per element (the word-addressed injection model;
+    bits above the declared width are masked at read, since they do
+    not exist in real byte memory);
+  * pointer parameters walked over a global array (``*p++``, ``p[i]``
+    after ``p++``, ``p + k``) and char-pointer globals initialized
+    with a string literal (crc16.c's message) -- the pointer becomes
+    an int32 walk cursor over the aliased global;
+  * ``while``/``for`` conditions with side effects (``while
+    (length--)``) via a rotated loop lowering;
   * COAST.h annotation macros are stripped and recorded
     (``__DEFAULT_NO_xMR``, ``__xMR``, ``__NO_xMR``).
 
@@ -192,37 +203,59 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
 # ---------------------------------------------------------------------------
 
 _UNSIGNED = {"unsigned", "uint32_t", "_Bool"}
-# Narrow types would need mod-2^8/2^16 wraparound modeling; silently
-# widening them to 32-bit lanes corrupts any benchmark that relies on
-# byte/short overflow (CRC tables, byte state machines) -- refuse loudly.
-_NARROW = {"char", "short", "uint8_t", "int8_t", "uint16_t", "int16_t"}
+_NARROW = {"char": 8, "short": 16, "uint8_t": 8, "int8_t": 8,
+           "uint16_t": 16, "int16_t": 16}
 
 
-class _NarrowType:
-    """Sentinel for a typedef of a narrow type: legal to DECLARE (the
-    prelude defines the stdint names so sources parse), refused on USE."""
+class _CType:
+    """A C integer type on the 32-bit lane model.
 
-    def __init__(self, name: str):
-        self.name = name
+    Narrow (8/16-bit) values live in int32 lanes holding their PROMOTED
+    value (C's integer promotions take unsigned char/short to int, which
+    int32 represents exactly), and every STORE to a narrow lvalue
+    re-normalizes: mask to the declared width, sign-extend if signed --
+    the mod-2^8/2^16 wraparound semantics the reference's byte/short
+    benchmarks rely on (crc16.c's ``unsigned char x``/``unsigned short
+    crc``).  Memory LAYOUT stays one lane word per element (the
+    injection model is word-addressed; byte packing is out of scope and
+    documented in docs/lifter.md)."""
+
+    __slots__ = ("dtype", "bits", "unsigned")
+
+    def __init__(self, dtype, bits: int = 32, unsigned: bool = False):
+        self.dtype = dtype
+        self.bits = bits
+        self.unsigned = unsigned
+
+    def store(self, v):
+        """Normalize a value being stored into this type's lane."""
+        v = jnp.asarray(v)
+        if self.bits == 32:
+            return v.astype(self.dtype)
+        mask = (1 << self.bits) - 1
+        v = v.astype(jnp.int32) & mask
+        if not self.unsigned:
+            sign = 1 << (self.bits - 1)
+            v = (v ^ sign) - sign
+        return v
 
 
-def _dtype_of(names: List[str], typedefs: Dict[str, object]):
-    """ILP32 dtype for a declared type-name list (32-bit lanes only)."""
+def _ctype_of(names: List[str], typedefs: Dict[str, object]) -> _CType:
+    """ILP32 _CType for a declared type-name list."""
+    for n in names:
+        if n in typedefs:
+            return typedefs[n]
+    uns = any(n in _UNSIGNED for n in names) or "unsigned" in names
+    # Plain char is UNSIGNED on the reference's ARM targets (AAPCS).
+    if "char" in names and "signed" not in names:
+        uns = True
+    bits = 32
     for n in names:
         if n in _NARROW:
-            raise CLiftError(
-                f"narrow integer type {n!r} is not modeled (its C "
-                "wraparound is mod 2^8/2^16, not the 32-bit lane's); "
-                "widen the declaration to 32-bit")
-        if n in typedefs:
-            t = typedefs[n]
-            if isinstance(t, _NarrowType):
-                raise CLiftError(
-                    f"narrow integer type {t.name!r} is not modeled; "
-                    "widen the declaration to 32-bit")
-            return t
-    uns = any(n in _UNSIGNED for n in names) or "unsigned" in names
-    return jnp.uint32 if uns else jnp.int32
+            bits = _NARROW[n]
+    if bits == 32:
+        return _CType(jnp.uint32 if uns else jnp.int32, 32, uns)
+    return _CType(jnp.int32, bits, uns)
 
 
 # ---------------------------------------------------------------------------
@@ -259,10 +292,12 @@ class _Scope:
     (matrix_multiply(first_matrix, ..., results_matrix) mutates
     results_matrix, exactly as the pointer would)."""
 
-    def __init__(self, globals_: Dict[str, jax.Array]):
+    def __init__(self, globals_: Dict[str, jax.Array],
+                 ctypes: Optional[Dict[str, "_CType"]] = None):
         self.g = globals_          # shared, mutated in place
         self.locals: Dict[str, jax.Array] = {}
         self.aliases: Dict[str, str] = {}       # param name -> global name
+        self.ctypes: Dict[str, _CType] = dict(ctypes or {})
         self.printed: List[jax.Array] = []
 
     def fork(self, no_print_at=None):
@@ -271,7 +306,7 @@ class _Scope:
         traced sub-region are scan/cond tracers that cannot escape to the
         program output, so the guard refuses loudly instead of letting
         an opaque tracer-leak KeyError surface at lift time."""
-        sub = _Scope(dict(self.g))
+        sub = _Scope(dict(self.g), self.ctypes)
         sub.locals = dict(self.locals)
         sub.aliases = dict(self.aliases)
         sub.printed = (self.printed if no_print_at is None
@@ -279,6 +314,11 @@ class _Scope:
         return sub
 
     def read(self, name: str):
+        # Locals FIRST: a pointer parameter holds its walk cursor as a
+        # local under its own name while aliasing the pointed-to global
+        # (``*p++`` support; _Compiler._ptr_parts).
+        if name in self.locals:
+            return self.locals[name]
         name = self.aliases.get(name, name)
         if name in self.locals:
             return self.locals[name]
@@ -287,6 +327,9 @@ class _Scope:
         raise CLiftError(f"undeclared identifier {name!r}")
 
     def write(self, name: str, val):
+        if name in self.locals:
+            self.locals[name] = val
+            return
         name = self.aliases.get(name, name)
         if name in self.locals:
             self.locals[name] = val
@@ -294,6 +337,14 @@ class _Scope:
             self.g[name] = val
         else:
             self.locals[name] = val
+
+    def ctype(self, name: str) -> Optional["_CType"]:
+        if name in self.locals:
+            # The local's own declared type.  A pointer parameter's walk
+            # cursor deliberately has none: it is a plain int32 offset,
+            # NOT the narrow pointee type the alias would resolve to.
+            return self.ctypes.get(name)
+        return self.ctypes.get(self.aliases.get(name, name))
 
 
 def _const_int(node) -> Optional[int]:
@@ -307,11 +358,13 @@ def _const_int(node) -> Optional[int]:
 
 
 class _Compiler:
-    def __init__(self, tu, typedefs, funcs, name: str):
+    def __init__(self, tu, typedefs, funcs, name: str,
+                 g_ctypes: Optional[Dict[str, _CType]] = None):
         self.tu = tu
         self.typedefs = typedefs
         self.funcs = funcs
         self.name = name
+        self.g_ctypes = dict(g_ctypes or {})
 
     # -- expressions -------------------------------------------------------
     def eval(self, node, sc: _Scope):
@@ -324,10 +377,22 @@ class _Compiler:
                         else jnp.int32(np.int32(base & 0xFFFFFFFF)))
             raise CLiftError(f"unsupported constant type {node.type!r}")
         if isinstance(node, c_ast.ID):
-            return sc.read(node.name)
+            v = sc.read(node.name)
+            ct = sc.ctype(node.name)
+            # Narrow SCALAR reads re-normalize: an injected bit above the
+            # declared width does not exist in real byte/short memory, so
+            # the promoted value masks it (docs/lifter.md, layout
+            # envelope).  Arrays pass through untouched -- an ID naming an
+            # array is C pointer decay, not a value read.
+            if ct is not None and ct.bits < 32 and jnp.ndim(v) == 0:
+                return ct.store(v)
+            return v
         if isinstance(node, c_ast.ArrayRef):
-            arr, idx, _ = self._array_path(node, sc)
-            return arr[idx]
+            arr, idx, base = self._array_path(node, sc)
+            v = arr[idx]
+            ct = sc.ctype(base)
+            return (ct.store(v) if ct is not None and ct.bits < 32
+                    else v)
         if isinstance(node, c_ast.BinaryOp):
             return self._binop(node, sc)
         if isinstance(node, c_ast.UnaryOp):
@@ -341,8 +406,11 @@ class _Compiler:
         if isinstance(node, c_ast.FuncCall):
             return self._call(node, sc)
         if isinstance(node, c_ast.Cast):
-            dt = _dtype_of(node.to_type.type.type.names, self.typedefs)
-            return self.eval(node.expr, sc).astype(dt)
+            ct = _ctype_of(node.to_type.type.type.names, self.typedefs)
+            # C cast semantics: value converted to the target type --
+            # truncate + re-sign for narrow targets, plain dtype change
+            # for 32-bit ones.
+            return ct.store(self.eval(node.expr, sc))
         if isinstance(node, c_ast.Assignment):
             # expression-position assignment (e.g. in for-next)
             return self._assign(node, sc)
@@ -404,6 +472,13 @@ class _Compiler:
             new = old + delta if "++" in op else old - delta
             self._store(name, new, sc)
             return old if op.startswith("p") else new
+        if op == "*":
+            base, off = self._ptr_parts(node.expr, sc)
+            arr = sc.g[base]
+            ct = sc.ctypes.get(base)
+            v = arr[off]
+            return (ct.store(v) if ct is not None and ct.bits < 32
+                    else v)
         v = self.eval(node.expr, sc)
         if op == "-":
             return -v
@@ -415,29 +490,74 @@ class _Compiler:
             return jnp.equal(v, 0).astype(jnp.int32)
         raise CLiftError(f"unsupported unary op {op!r} at {node.coord}")
 
+    def _ptr_parts(self, expr, sc) -> Tuple[str, jax.Array]:
+        """Resolve a pointer-valued expression to (global name, offset).
+
+        The subset's pointers are walked array parameters: ``p`` (cursor
+        or start), ``p++``/``++p``/``p--``/``--p`` (cursor effect applies,
+        value is the C-correct old/new pointer), and ``p + e``.  This is
+        the shape the reference's byte-stream benchmarks use
+        (crc16.c:26 ``*data_p++``)."""
+        if isinstance(expr, c_ast.ID) and expr.name in sc.aliases:
+            return (sc.aliases[expr.name],
+                    jnp.asarray(sc.locals.get(expr.name, 0), jnp.int32))
+        if (isinstance(expr, c_ast.UnaryOp)
+                and expr.op in ("++", "p++", "--", "p--")
+                and isinstance(expr.expr, c_ast.ID)
+                and expr.expr.name in sc.aliases):
+            if expr.expr.name not in sc.locals:
+                raise CLiftError(
+                    f"pointer arithmetic on unwalked parameter "
+                    f"{expr.expr.name!r} at {expr.coord}")
+            off = self._unop(expr, sc)          # applies the cursor effect
+            return sc.aliases[expr.expr.name], jnp.asarray(off, jnp.int32)
+        if isinstance(expr, c_ast.BinaryOp) and expr.op in ("+", "-"):
+            base, off = self._ptr_parts(expr.left, sc)
+            d = jnp.asarray(self.eval(expr.right, sc), jnp.int32)
+            return base, (off + d if expr.op == "+" else off - d)
+        raise CLiftError(
+            f"unsupported pointer expression at {getattr(expr, 'coord', '?')}")
+
     def _array_path(self, node, sc):
-        """Flatten a[i][j]... into (array value, index tuple)."""
+        """Flatten a[i][j]... into (array value, index tuple).  A pointer
+        parameter that has been walked (``p++``) indexes relative to its
+        cursor: ``p[i]`` reads the aliased global at cursor+i."""
         idxs = []
         while isinstance(node, c_ast.ArrayRef):
             idxs.append(node.subscript)
             node = node.name
         if not isinstance(node, c_ast.ID):
             raise CLiftError(f"unsupported array base at {node.coord}")
-        arr = sc.read(node.name)
+        name = node.name
+        cursor = (sc.locals.get(name) if name in sc.aliases else None)
+        arr = (sc.g[sc.aliases[name]] if name in sc.aliases
+               else sc.read(name))
         idx = tuple(self.eval(i, sc).astype(jnp.int32)
                     for i in reversed(idxs))
-        return arr, (idx if len(idx) > 1 else idx[0]), node.name
+        if cursor is not None:
+            if len(idx) != 1:
+                raise CLiftError(
+                    f"walked pointer {name!r} must be 1-D at {node.coord}")
+            idx = (idx[0] + cursor,)
+        base = sc.aliases.get(name, name)
+        return arr, (idx if len(idx) > 1 else idx[0]), base
 
     def _store(self, lhs, val, sc):
         if isinstance(lhs, c_ast.ID):
+            ct = sc.ctype(lhs.name)
+            if ct is not None:
+                sc.write(lhs.name, ct.store(val))
+                return
             old = sc.read(lhs.name)
             sc.write(lhs.name, jnp.asarray(val).astype(old.dtype)
                      if hasattr(old, "dtype") else val)
             return
         if isinstance(lhs, c_ast.ArrayRef):
             arr, idx, base = self._array_path(lhs, sc)
-            sc.write(base, arr.at[idx].set(
-                jnp.asarray(val).astype(arr.dtype)))
+            ct = sc.ctype(base)
+            stored = (ct.store(val) if ct is not None
+                      else jnp.asarray(val).astype(arr.dtype))
+            sc.write(base, arr.at[idx].set(stored.astype(arr.dtype)))
             return
         raise CLiftError(
             f"unsupported assignment target {type(lhs).__name__}")
@@ -485,24 +605,59 @@ class _Compiler:
                              f"at {node.coord}")
         return self._run_function(fn, args, sc)
 
+    def _walked_names(self, node) -> set:
+        """Names subject to POINTER arithmetic: ++/--/assignment on the
+        BARE identifier.  Element stores (``a[i] = v``) do not count --
+        they write the pointee, not the pointer (mm.c's r_matrix vs
+        crc16.c's data_p)."""
+        names: set = set()
+
+        class V(c_ast.NodeVisitor):
+            def visit_UnaryOp(v, n):
+                if (n.op in ("++", "p++", "--", "p--")
+                        and isinstance(n.expr, c_ast.ID)):
+                    names.add(n.expr.name)
+                v.generic_visit(n)
+
+            def visit_Assignment(v, n):
+                if isinstance(n.lvalue, c_ast.ID):
+                    names.add(n.lvalue.name)
+                v.generic_visit(n)
+
+        V().visit(node)
+        return names
+
     def _run_function(self, fndef, args, outer_sc: _Scope):
-        sc = _Scope(outer_sc.g)
+        sc = _Scope(outer_sc.g, self.g_ctypes)
         sc.printed = outer_sc.printed       # printf threads through
         params = []
         decl = fndef.decl.type
         if decl.args:
-            params = [p.name for p in decl.args.params
+            params = [p for p in decl.args.params
                       if not isinstance(p, c_ast.EllipsisParam)
                       and p.name is not None]
         if len(params) != len(args):
             raise CLiftError(
                 f"{fndef.decl.name}: {len(args)} args for {len(params)} "
                 "parameters (array parameters pass the global by name)")
+        walked = self._walked_names(fndef.body)
         for p, a in zip(params, args):
             if isinstance(a, tuple) and len(a) == 2 and a[0] == "__alias__":
-                sc.aliases[p] = a[1]
+                sc.aliases[p.name] = a[1]
+                if p.name in walked:
+                    # The body does pointer arithmetic on this parameter
+                    # (``p++``): give it a walk cursor, carried like any
+                    # other local through the body's loops.
+                    sc.locals[p.name] = jnp.int32(0)
             else:
-                sc.locals[p] = a
+                ct = (_ctype_of(getattr(p.type.type, "names", ["int"]),
+                                self.typedefs)
+                      if isinstance(p.type, c_ast.TypeDecl) else None)
+                if ct is not None:
+                    sc.locals[p.name] = ct.store(a)
+                    sc.ctypes[p.name] = ct
+                else:
+                    sc.locals[p.name] = a
         ret = self._exec_block(fndef.body, sc)
         return ret if ret is not None else jnp.int32(0)
 
@@ -520,11 +675,12 @@ class _Compiler:
 
     def _exec_stmt(self, stmt, sc: _Scope):
         if isinstance(stmt, c_ast.Decl):
-            dt = _dtype_of(getattr(stmt.type.type, "names", ["int"]),
+            ct = _ctype_of(getattr(stmt.type.type, "names", ["int"]),
                            self.typedefs)
-            val = (self.eval(stmt.init, sc).astype(dt)
-                   if stmt.init is not None else jnp.zeros((), dt))
+            val = (ct.store(self.eval(stmt.init, sc))
+                   if stmt.init is not None else jnp.zeros((), ct.dtype))
             sc.locals[stmt.name] = val
+            sc.ctypes[stmt.name] = ct
             return None
         if isinstance(stmt, c_ast.DeclList):
             for d in stmt.decls:
@@ -614,6 +770,13 @@ class _Compiler:
 
         class V(c_ast.NodeVisitor):
             def visit_Assignment(v, n):
+                # Reseating a pointer parameter (``p = p + 1``) writes the
+                # walk cursor, not the pointed-to global; only element
+                # stores (ArrayRef/deref lvalues) write the array.
+                if (isinstance(n.lvalue, c_ast.ID)
+                        and n.lvalue.name in subst):
+                    v.generic_visit(n)
+                    return
                 tgt = target_of(n.lvalue)
                 if tgt in g_names:
                     out.add(tgt)
@@ -621,6 +784,11 @@ class _Compiler:
 
             def visit_UnaryOp(v, n):
                 if n.op in ("++", "p++", "--", "p--"):
+                    # Same rule: ++/-- on a bare pointer-parameter ID is
+                    # cursor arithmetic.
+                    if (isinstance(n.expr, c_ast.ID)
+                            and n.expr.name in subst):
+                        return
                     tgt = target_of(n.expr)
                     if tgt in g_names:
                         out.add(tgt)
@@ -652,7 +820,21 @@ class _Compiler:
     def _loop_carry(self, stmt, sc) -> List[str]:
         """Variables the loop body writes that already exist in scope (the
         scan/while carry); body-local declarations stay local."""
-        assigned = [sc.aliases.get(n, n) for n in self._assigned_names(stmt)]
+        # A name that is itself a local (incl. a pointer parameter's walk
+        # cursor, which shares its name with an alias) carries as that
+        # local.  A WALKED pointer name additionally carries its aliased
+        # global: ``p[0] = v`` inside the loop stores into the global
+        # while ``p++`` moves the cursor, and both writes must survive
+        # the iteration (a read-only extra carry is loop-invariant and
+        # hoisted by XLA).
+        assigned: List[str] = []
+        for n in self._assigned_names(stmt):
+            if n in sc.locals:
+                assigned.append(n)
+                if n in sc.aliases:
+                    assigned.append(sc.aliases[n])
+            else:
+                assigned.append(sc.aliases.get(n, n))
         return [n for n in dict.fromkeys(assigned)
                 if n in sc.locals or n in sc.g]
 
@@ -683,6 +865,40 @@ class _Compiler:
 
             out, _ = jax.lax.scan(body, pack(), None, length=trip)
             unpack(sc, out)
+            return None
+
+        # A side-effecting condition (C's `while (length--)`) cannot be
+        # evaluated in the while cond function -- writes made there are
+        # discarded.  Rotate the loop instead: evaluate the condition once
+        # up front (its effects apply), carry its truth value, and have
+        # each iteration run body+next then re-evaluate the condition with
+        # effects inside the body.  Exact C semantics, including the final
+        # value of the side-effected variable after the failing test.
+        if stmt.cond is not None and self._loop_carry(stmt.cond, sc):
+            # int32 truth carry, not bool: every loop carry can become an
+            # injectable region leaf, and the memory map is 32-bit words.
+            t0 = jnp.not_equal(self.eval(stmt.cond, sc),
+                               0).astype(jnp.int32)
+
+            def cond_rot(carry):
+                return jnp.not_equal(carry[-1], 0)
+
+            def body_rot(carry):
+                sub = sc.fork(no_print_at=stmt.coord)
+                unpack(sub, carry[:-1])
+                ret = self._exec_block(stmt.stmt, sub)
+                if ret is not None:
+                    raise CLiftError(
+                        f"return inside a loop at {stmt.coord}; "
+                        "restructure")
+                if stmt.next is not None:
+                    self.eval(stmt.next, sub)
+                t = jnp.not_equal(self.eval(stmt.cond, sub),
+                                  0).astype(jnp.int32)
+                return tuple(sub.read(n) for n in carry_names) + (t,)
+
+            out = jax.lax.while_loop(cond_rot, body_rot, pack() + (t0,))
+            unpack(sc, out[:-1])
             return None
 
         # General for: lower as while with explicit cond/next.
@@ -777,9 +993,29 @@ class _Compiler:
 # Translation-unit ingestion
 # ---------------------------------------------------------------------------
 
+def _string_bytes(lit: str) -> List[int]:
+    """Decode a C string literal (quotes included) to its bytes + NUL."""
+    body = lit[1:-1]
+    decoded = body.encode("utf-8").decode("unicode_escape")
+    return [b for b in decoded.encode("latin-1")] + [0]
+
+
+def _normalize_init(vals: np.ndarray, ct: _CType) -> np.ndarray:
+    """C conversion of initializer values into the declared type's lane."""
+    if ct.bits == 32:
+        return (vals & 0xFFFFFFFF).astype(np.uint32)
+    mask = (1 << ct.bits) - 1
+    v = (vals & mask).astype(np.int64)
+    if not ct.unsigned:
+        sign = 1 << (ct.bits - 1)
+        v = ((v ^ sign) - sign)
+    return v.astype(np.int64)
+
+
 def _parse_globals(tu, typedefs):
-    """Global declarations -> {name: jnp array} (initializers evaluated)."""
+    """Global declarations -> ({name: jnp array}, {name: _CType})."""
     out: Dict[str, jax.Array] = {}
+    ctypes: Dict[str, _CType] = {}
 
     def flat_init(init) -> List[int]:
         if isinstance(init, c_ast.InitList):
@@ -805,8 +1041,26 @@ def _parse_globals(tu, typedefs):
                 raise CLiftError(f"non-literal array dim for {ext.name}")
             shape.append(n)
             t = t.type
+        if isinstance(t, c_ast.PtrDecl):
+            # The one pointer-global shape the corpus uses: a char pointer
+            # initialized with a string literal (crc16.c's message).  It
+            # becomes the byte array itself; ID uses alias it like any
+            # array (C pointer decay in reverse).
+            inner = t.type
+            if (isinstance(inner, c_ast.TypeDecl)
+                    and isinstance(ext.init, c_ast.Constant)
+                    and ext.init.type == "string"):
+                ct = _ctype_of(inner.type.names, typedefs)
+                vals = np.array(_string_bytes(ext.init.value), np.int64)
+                out[ext.name] = jnp.asarray(
+                    _normalize_init(vals, ct)).astype(ct.dtype)
+                ctypes[ext.name] = ct
+                continue
+            raise CLiftError(
+                f"unsupported pointer global {ext.name!r} (only char* "
+                "with a string-literal initializer is modeled)")
         if isinstance(t, c_ast.TypeDecl):
-            dt = _dtype_of(t.type.names, typedefs)
+            ct = _ctype_of(t.type.names, typedefs)
         else:
             raise CLiftError(f"unsupported global type for {ext.name}")
         if ext.init is not None:
@@ -822,12 +1076,13 @@ def _parse_globals(tu, typedefs):
             vals = np.concatenate(
                 [vals, np.zeros(total - len(vals), np.int64)])
             arr = jnp.asarray(
-                (vals & 0xFFFFFFFF).astype(np.uint32)).astype(dt)
+                _normalize_init(vals, ct)).astype(ct.dtype)
             arr = arr.reshape(shape) if shape else arr.reshape(())
         else:
-            arr = jnp.zeros(tuple(shape) if shape else (), dt)
+            arr = jnp.zeros(tuple(shape) if shape else (), ct.dtype)
         out[ext.name] = arr
-    return out
+        ctypes[ext.name] = ct
+    return out, ctypes
 
 
 def parse_c_sources(paths: Sequence[str]):
@@ -859,16 +1114,11 @@ def parse_c_sources(paths: Sequence[str]):
             base = ext.type
             if isinstance(base, c_ast.TypeDecl):
                 names = getattr(base.type, "names", ["int"])
-                if any(n in _NARROW for n in names) or any(
-                        isinstance(typedefs.get(n), _NarrowType)
-                        for n in names):
-                    typedefs[ext.name] = _NarrowType(ext.name)
-                else:
-                    typedefs[ext.name] = _dtype_of(names, typedefs)
+                typedefs[ext.name] = _ctype_of(names, typedefs)
         elif isinstance(ext, c_ast.FuncDef):
             funcs[ext.decl.name] = ext
-    globals_ = _parse_globals(tu, typedefs)
-    return tu, globals_, funcs, typedefs, anns, name_flags
+    globals_, g_ctypes = _parse_globals(tu, typedefs)
+    return tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes
 
 
 def lift_c(name: str,
@@ -886,7 +1136,7 @@ def lift_c(name: str,
     program printf'd become its outputs.  ``entry`` (default ``main``) is
     executed.  COAST.h macros in the source set ``default_xmr`` unless
     overridden."""
-    tu, globals_, funcs, typedefs, anns, name_flags = \
+    tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes = \
         parse_c_sources(sources)
     if entry not in funcs:
         raise CLiftError(
@@ -895,12 +1145,12 @@ def lift_c(name: str,
     if default_xmr is None:
         default_xmr = "__DEFAULT_NO_xMR" not in anns
 
-    comp = _Compiler(tu, typedefs, funcs, name)
+    comp = _Compiler(tu, typedefs, funcs, name, g_ctypes)
     g_names = sorted(globals_)
     out_globals = sorted(comp.written_globals(funcs[entry], set(g_names)))
 
     def program(*g_vals):
-        sc = _Scope(dict(zip(g_names, g_vals)))
+        sc = _Scope(dict(zip(g_names, g_vals)), g_ctypes)
         comp._run_function(funcs[entry], [], sc)
         outs = [sc.g[n] for n in out_globals] + list(sc.printed)
         return tuple(outs)
