@@ -1,0 +1,35 @@
+"""Differential-fuzz tier: the C frontend vs natively-executed gcc.
+
+Each seed generates a random program inside the documented restricted-C
+envelope, compiles and runs it with gcc (-fwrapv -funsigned-char: the
+ARM-model pins), lifts the same source with ``lift_c``, and requires
+every printed value -- per-array checksums plus both accumulators -- to
+match bit-for-bit.  This is the frontend analogue of the llvm-stress
+tier (testing/fuzz.py): semantics pinned on arbitrary programs, not
+just the curated reference sources.  Deeper sweeps:
+``python -m coast_tpu.testing.c_fuzz -n 200``.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+pycparser = pytest.importorskip("pycparser")
+
+if shutil.which("gcc") is None:                     # pragma: no cover
+    pytest.skip("gcc not available", allow_module_level=True)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_vs_gcc(seed):
+    from coast_tpu.testing.c_fuzz import check_seed
+    check_seed(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block", [8, 16, 24, 32])
+def test_differential_vs_gcc_deep(block):
+    from coast_tpu.testing.c_fuzz import check_seed
+    for seed in range(block, block + 8):
+        check_seed(seed)
