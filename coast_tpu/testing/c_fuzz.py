@@ -91,14 +91,32 @@ class _Gen:
             g.append(f"{ctype} a{ai}[{size}] = {{{init}}};")
         g.append("unsigned int acc0 = 0;")
         g.append("unsigned int acc1 = 1;")
+        # Named 'b' ON PURPOSE: it collides with MIXM's second parameter,
+        # so passing it as the FIRST argument pins simultaneous (non-
+        # sequential) macro substitution.
+        g.append(f"unsigned int b = {r.randrange(1, 10000)}u;")
+
+        # A function-like macro used in expressions (simultaneous,
+        # escape-safe substitution; the second parameter's name 'b'
+        # deliberately collides with common argument text).
+        mk = r.randrange(0, 6)
+        g.append(f"#define MIXM(a, b) (((a) ^ ((unsigned int)(b) "
+                 f"<< {mk})) + {r.randrange(1, 999)}u)")
 
         # A mix helper (exercises call inlining + promotions).
         k, c = r.randrange(0, 8), r.randrange(1, 99999)
         g.append(f"unsigned int mix(unsigned int a, unsigned int b) "
                  f"{{ return (a ^ ((unsigned int)(b) << {k})) + {c}u; }}")
+        # A writer helper taking an array by reference and storing
+        # through a walked pointer (deref stores + copy-in/out when the
+        # caller passes a LOCAL array).
+        g.append("void scale(unsigned int *p, uint8_t length, "
+                 "unsigned int v) { while (length--) { "
+                 "*p = (*p ^ v) + (unsigned int)sizeof(length); p++; } }")
         # A pointer-walk helper per array element type in use (exercises
         # *p++ / while (length--) / narrow deref promotion).
-        walked_types = sorted({t for _, t, _ in self.arrays})
+        walked_types = sorted({t for _, t, _ in self.arrays}
+                              | {"unsigned int"})
         for t in walked_types:
             g.append(
                 f"unsigned int walk_{t.replace(' ', '_')}"
@@ -107,6 +125,23 @@ class _Gen:
                 f"return s; }}")
 
         body: List[str] = ["  int i;"]
+        # A local array filled in a loop then passed BY REFERENCE to the
+        # walker and the deref-store writer (copy-in/copy-out path).
+        lsize = r.randrange(3, 8)
+        body.append(f"  unsigned int lbuf[{lsize}] = "
+                    f"{{{r.randrange(1, 50)}}};")
+        body.append(f"  for (i = 0; i < {lsize}; i++) "
+                    f"{{ lbuf[i] = lbuf[i] + (unsigned int)i * 3u; }}")
+        body.append(f"  scale(lbuf, {r.randrange(1, lsize + 1)}, "
+                    f"{r.randrange(1, 1000)}u);")
+        body.append(f"  acc1 += walk_unsigned_int(lbuf, "
+                    f"{r.randrange(1, lsize + 1)}) + "
+                    f"(unsigned int)sizeof(lbuf) + (unsigned int)'A';")
+        # Guaranteed macro-hazard exercise each seed: first argument is
+        # the identifier 'b' (collides with the second parameter), the
+        # second is a comma-bearing nested call into mix().
+        body.append(f"  acc0 ^= MIXM(b, mix(acc1, "
+                    f"{r.randrange(0, 99)}u));")
         for name, ctype, size in self.arrays:
             names = [f"{name}[i]", "(unsigned int)i", "acc0", "acc1"]
             stmts = []
@@ -122,6 +157,8 @@ class _Gen:
                         + " ".join(stmts) + " }")
             body.append(f"  acc1 += walk_{ctype.replace(' ', '_')}"
                         f"({name}, {r.randrange(1, size + 1)});")
+            if r.random() < 0.5:
+                body.append(f"  acc0 ^= MIXM(acc1, {r.randrange(0, 99)});")
         # Checksums: the whole written state becomes observable output.
         for name, _, size in self.arrays:
             body.append(f"  {{ unsigned int chk = 0; "
@@ -129,11 +166,25 @@ class _Gen:
                         f"{{ chk ^= (unsigned int){name}[i]; }} "
                         f'printf("%u\\n", chk); }}')
             self.printed += 1
+        # lbuf's FULL checksum: scale()'s deref-store tail must be
+        # observable even where the walk length is shorter.
+        body.append(f"  {{ unsigned int lchk = 0; "
+                    f"for (i = 0; i < {lsize}; i++) "
+                    f"{{ lchk ^= lbuf[i]; }} "
+                    f'printf("%u\\n", lchk); }}')
         body.append('  printf("%u\\n", acc0);')
         body.append('  printf("%u\\n", acc1);')
-        self.printed += 2
+        self.printed += 3
         g.append("int main() {")
-        g.extend(body)
+        if r.random() < 0.5:
+            # Run-once loop idiom (sha256.c main): the body -- prints
+            # included -- inlines into the enclosing scope.
+            g.append("  while (1) {")
+            g.extend(body)
+            g.append("  break;")
+            g.append("  }")
+        else:
+            g.extend(body)
         g.append("  return 0;")
         g.append("}")
         return "\n".join(g) + "\n"
