@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr regression_test test_rtos bench fidelity mfu_sweep clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos bench fidelity mfu_sweep clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -26,6 +26,9 @@ test_full: build
 
 test_tmr: build
 	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/full_tmr.yml
+
+test_csrc: build
+	$(CPU_ENV) $(PYTHON) unittest/unittest.py unittest/cfg/csrc.yml
 
 regression_test: build
 	$(CPU_ENV) $(PYTHON) unittest/pyDriver.py unittest/cfg/regression.yml

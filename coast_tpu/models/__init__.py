@@ -17,6 +17,20 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
     return make
 
 
+def c_source_paths(arg: str):
+    """Split a '+'-joined C-source argument (multi-translation-unit
+    programs: the reference links aes.c with TI_aes_128.c) and validate
+    existence; FileNotFoundError names the first missing file.  The ONE
+    place the '+' convention is interpreted -- the CLIs and the harness
+    all route here."""
+    import os
+    paths = arg.split("+")
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(missing[0])
+    return paths
+
+
 def resolve_region(arg: str) -> Region:
     """One program-argument resolver for the CLIs (opt and supervisor take
     the program by registry name or by .c source path -- the reference's
@@ -25,10 +39,10 @@ def resolve_region(arg: str) -> Region:
     an out-of-subset source."""
     import os
     if arg.endswith(".c"):
-        if not os.path.exists(arg):
-            raise FileNotFoundError(arg)
+        paths = c_source_paths(arg)
         from coast_tpu.frontend import lift_c
-        return lift_c(os.path.splitext(os.path.basename(arg))[0], [arg])
+        return lift_c(os.path.splitext(os.path.basename(paths[0]))[0],
+                      paths)
     if arg in REGISTRY:
         return REGISTRY[arg]()
     raise KeyError(arg)

@@ -155,9 +155,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from coast_tpu.models import REGISTRY
     is_c_source = len(positional) == 1 and positional[0].endswith(".c")
-    if is_c_source and not os.path.exists(positional[0]):
-        print(f"ERROR: file {positional[0]} does not exist", file=sys.stderr)
-        return 2
+    if is_c_source:
+        from coast_tpu.models import c_source_paths
+        try:
+            c_source_paths(positional[0])
+        except FileNotFoundError as e:
+            print(f"ERROR: file {e.args[0]} does not exist",
+                  file=sys.stderr)
+            return 2
     if not is_c_source and (len(positional) != 1
                             or positional[0] not in REGISTRY):
         print("usage: python -m coast_tpu.opt [-TMR|-DWC|-EDDI] [flags] "

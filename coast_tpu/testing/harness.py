@@ -65,6 +65,17 @@ def expand_benchmarks(cfg: dict) -> List[Tuple[str, Optional[str]]]:
             names = list(CHSTONE)
         elif path in REGISTRY:
             names = [path]
+        elif path.endswith(".c"):
+            # C source paths ('+'-joined for multi-TU programs) run
+            # through the same ingestion path as `opt ... file.c` -- the
+            # reference's harness likewise builds its tests from source.
+            from coast_tpu.models import c_source_paths
+            try:
+                c_source_paths(path)
+            except FileNotFoundError as e:
+                raise HarnessError(
+                    f"No benchmark source at {e.args[0]!r}") from e
+            names = [path]
         else:
             raise HarnessError(f"No benchmarks found at {path!r}")
         rows.extend((n, regex) for n in names)

@@ -68,3 +68,40 @@ def test_fuzz_deterministic():
 def test_fuzz_cli_reports_success(capsys):
     assert fuzz.main(["-n", "1", "-seed", "3"]) == 0
     assert "Success!" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_csrc_matrix():
+    """The ingested-C tier (unittest/cfg/csrc.yml): the reference's OWN
+    sources -- mm, crc16, sha256, aes (two '+'-joined translation
+    units) -- built from source through lift_c and regex-checked
+    against their guest self-check line, under a reduced protection
+    matrix.  This is the reference's unittest.py workflow applied to
+    its own tests/ files."""
+    import os
+
+    import yaml
+
+    pytest.importorskip("pycparser")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "unittest", "cfg", "csrc.yml")
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    srcs = [p for e in cfg["benchmarks"] for p in e["path"].split("+")]
+    if not all(os.path.exists(s) for s in srcs):
+        pytest.skip("reference checkout not present")
+    assert run_config(cfg, quiet=True) == \
+        len(cfg["benchmarks"]) * len(cfg["OPT_PASSES"])
+
+
+def test_csrc_single_cell():
+    """Fast-tier smoke of the C-source harness path: one crc16.c cell
+    through run_combo, '+'-join resolution included via expansion."""
+    import os
+    pytest.importorskip("pycparser")
+    src = "/root/reference/tests/crc16/crc16.c"
+    if not os.path.exists(src):
+        pytest.skip("reference checkout not present")
+    cfg = {"benchmarks": [{"path": src, "re": "E: 0"}],
+           "OPT_PASSES": ["-TMR"]}
+    assert run_config(cfg, quiet=True) == 1
