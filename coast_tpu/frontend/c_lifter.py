@@ -46,9 +46,15 @@ are loud and name the construct):
     macros with continuation lines (ROTRIGHT, DBL_INT_ADD), comma
     expressions in ``for`` init/next, character constants;
   * ``while``/``for`` conditions with side effects (``while
-    (length--)``) via a rotated loop lowering, and the run-once
-    ``while (1) { ...; break; }`` idiom (break anywhere else is
-    refused loudly);
+    (length--)``) via a rotated loop lowering; the run-once
+    ``while (1) { ...; break; }`` idiom; mid-loop conditional breaks
+    (``if (c) break;`` -- lowered to a carried flag with exact C
+    semantics: the broken-out iteration skips the rest of the body AND
+    the for-next); structured early ``return``s anywhere in a function
+    (carried flag pair, same masking discipline; a printf AFTER an
+    early-return point refuses loudly -- whether it prints would be
+    data-dependent, so it cannot be a fixed program output) -- other
+    break/goto placements refuse loudly;
   * COAST.h annotation macros are stripped and recorded
     (``__DEFAULT_NO_xMR``, ``__xMR``, ``__NO_xMR``).
 
@@ -341,11 +347,18 @@ def _ctype_of(names: List[str], typedefs: Dict[str, object]) -> _CType:
 class _NoPrintList(list):
     """printf sentinel for traced sub-regions (loops, branches)."""
 
-    def __init__(self, coord):
+    def __init__(self, coord, reason=None):
         super().__init__()
         self.coord = coord
+        self.reason = reason
 
     def _refuse(self):
+        if self.reason:
+            raise CLiftError(
+                f"printf {self.reason} at {self.coord}: whether the "
+                "print happens would depend on traced values, so it "
+                "cannot be a fixed program output; print before the "
+                "early exit or restructure")
         raise CLiftError(
             f"printf inside a loop or branch at {self.coord}: per-"
             "iteration prints would be traced values that cannot escape "
@@ -376,7 +389,7 @@ class _Scope:
         self.ctypes: Dict[str, _CType] = dict(ctypes or {})
         self.printed: List[jax.Array] = []
 
-    def fork(self, no_print_at=None):
+    def fork(self, no_print_at=None, no_print_reason=None):
         """Child scope for a traced sub-region (loop body/cond, branch).
         ``no_print_at`` arms the printf guard: values printed inside a
         traced sub-region are scan/cond tracers that cannot escape to the
@@ -386,7 +399,7 @@ class _Scope:
         sub.locals = dict(self.locals)
         sub.aliases = dict(self.aliases)
         sub.printed = (self.printed if no_print_at is None
-                       else _NoPrintList(no_print_at))
+                       else _NoPrintList(no_print_at, no_print_reason))
         return sub
 
     def read(self, name: str):
@@ -461,6 +474,10 @@ class _Compiler:
         self.name = name
         self.g_ctypes = dict(g_ctypes or {})
         self._tmp = 0          # transient copy-in/out slot counter
+        # id(node) -> reason, for synthesized guard Ifs whose printf
+        # refusal should name the REAL construct (pycparser nodes have
+        # __slots__, so no attribute can be set on them).
+        self._synth_reason = {}
 
     # -- expressions -------------------------------------------------------
     def eval(self, node, sc: _Scope):
@@ -861,7 +878,15 @@ class _Compiler:
                     sc.ctypes[p.name] = ct
                 else:
                     sc.locals[p.name] = a
-        ret = self._exec_block(fndef.body, sc)
+        new_items, set_n, val_n, synth = self._rewrite_early_returns(fndef)
+        if new_items is not None:
+            for n in synth:
+                sc.locals[n] = jnp.int32(0)
+            self._exec_block(
+                c_ast.Compound(new_items, fndef.body.coord), sc)
+            ret = sc.locals[val_n]
+        else:
+            ret = self._exec_block(fndef.body, sc)
         for temp, lname in copy_backs:
             outer_sc.locals[lname] = sc.g.pop(temp)
         return ret if ret is not None else jnp.int32(0)
@@ -1114,9 +1139,252 @@ class _Compiler:
         return [n for n in dict.fromkeys(assigned)
                 if n in sc.locals or n in sc.g]
 
+    @staticmethod
+    def _has_return(node) -> bool:
+        found = []
+
+        class V(c_ast.NodeVisitor):
+            def visit_Return(v, n):
+                found.append(n)
+
+        V().visit(node)
+        return bool(found)
+
+    def _rewrite_early_returns(self, fndef):
+        """Lower structured early returns to a carried flag pair.
+
+        ``return E`` anywhere becomes ``if (!__ret_set) { __ret_val = E;
+        __ret_set = 1; }``; every statement after a return-containing
+        one runs under ``if (!__ret_set)``; every loop whose subtree
+        returns gains ``&& !__ret_set`` in its condition with the
+        for-next moved into the body under the same guard (the exact
+        discipline of the break lowering, applied function-wide) -- so
+        ``if (hash[i] != golden[i]) return 1;`` inside a scan loop
+        (checkGolden, sha256_common_tmr.c:191-198) exits with C's
+        semantics.  Loop conditions become PURE carried variables primed
+        before the loop and re-evaluated at the end of each body under
+        the guard -- C's return exits WITHOUT re-testing the condition,
+        so a side-effecting condition must not run on the returning
+        exit.  Returns (new_body_items, set_name, val_name, synth_names)
+        where synth_names are locals the caller must pre-create, or
+        (None, None, None, None) when the body has no early return."""
+        items = list(fndef.body.block_items or [])
+        early = any(self._has_return(s) for s in items[:-1]) or (
+            items and not isinstance(items[-1], c_ast.Return)
+            and self._has_return(items[-1]))
+        if not early:
+            return None, None, None, None
+        set_n = f"__ret_set{self._tmp}"
+        val_n = f"__ret_val{self._tmp}"
+        self._tmp += 1
+        synth_names = [set_n, val_n]
+        not_set = lambda coord: c_ast.BinaryOp(  # noqa: E731
+            "==", c_ast.ID(set_n), c_ast.Constant("int", "0"), coord)
+
+        def ret_to_set(n):
+            expr = n.expr if n.expr is not None else c_ast.Constant(
+                "int", "0")
+            body = c_ast.Compound([
+                c_ast.Assignment("=", c_ast.ID(val_n), expr, n.coord),
+                c_ast.Assignment("=", c_ast.ID(set_n),
+                                 c_ast.Constant("int", "1"), n.coord),
+            ], n.coord)
+            return c_ast.If(not_set(n.coord), body, None, n.coord)
+
+        def xform(s):
+            """Transform ONE statement in place-ish; returns new stmt."""
+            if isinstance(s, c_ast.Return):
+                return ret_to_set(s)
+            if not self._has_return(s):
+                return s
+            if isinstance(s, c_ast.Compound):
+                return c_ast.Compound(seq(list(s.block_items or [])),
+                                      s.coord)
+            if isinstance(s, c_ast.If):
+                return c_ast.If(
+                    s.cond,
+                    xform(s.iftrue) if s.iftrue is not None else None,
+                    xform(s.iffalse) if s.iffalse is not None else None,
+                    s.coord)
+            if isinstance(s, (c_ast.For, c_ast.While)):
+                cond = getattr(s, "cond", None)
+                guard = not_set(s.coord)
+                body_items = (list(s.stmt.block_items or [])
+                              if isinstance(s.stmt, c_ast.Compound)
+                              else [s.stmt])
+                body_items = seq(body_items)
+                nxt = getattr(s, "next", None)
+                if nxt is not None:
+                    body_items.append(
+                        c_ast.If(not_set(s.coord), nxt, None, s.coord))
+                # Pure carried condition: primed before the loop,
+                # re-evaluated (effects included) at the body end under
+                # the !set guard so the returning exit never re-runs it.
+                cnd = f"__cnd{self._tmp}"
+                self._tmp += 1
+                synth_names.append(cnd)
+                pre = []
+                init = getattr(s, "init", None)
+                if init is not None:
+                    pre.append(init)
+                if cond is not None:
+                    cond_val = c_ast.BinaryOp(
+                        "!=", cond, c_ast.Constant("int", "0"), s.coord)
+                    prime = c_ast.If(
+                        guard,
+                        c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
+                                         s.coord),
+                        None, s.coord)
+                    body_items.append(c_ast.Assignment(
+                        "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
+                        s.coord))
+                    body_items.append(c_ast.If(
+                        guard,
+                        c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
+                                         s.coord),
+                        None, s.coord))
+                else:
+                    prime = c_ast.Assignment(
+                        "=", c_ast.ID(cnd), guard, s.coord)
+                    body_items.append(c_ast.Assignment(
+                        "=", c_ast.ID(cnd), guard, s.coord))
+                pre.append(c_ast.Assignment(
+                    "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
+                    s.coord))
+                pre.append(prime)
+                new_body = c_ast.Compound(body_items, s.coord)
+                loop = c_ast.For(None, c_ast.ID(cnd), None, new_body,
+                                 s.coord)
+                return c_ast.Compound(pre + [loop], s.coord)
+            raise CLiftError(
+                f"return in unsupported construct "
+                f"{type(s).__name__} at {getattr(s, 'coord', '?')}")
+
+        def seq(stmts):
+            out = []
+            for k, s in enumerate(stmts):
+                if not self._has_return(s):
+                    out.append(s)
+                    continue
+                out.append(xform(s))
+                rest = seq(stmts[k + 1:])
+                if rest:
+                    wrap = c_ast.If(
+                        not_set(getattr(s, "coord", None)),
+                        c_ast.Compound(rest, getattr(s, "coord", None)),
+                        None, getattr(s, "coord", None))
+                    self._synth_reason[id(wrap)] = \
+                        "after an early-return point"
+                    out.append(wrap)
+                return out
+            return out
+
+        return seq(items), set_n, val_n, synth_names
+
+    def _rewrite_breaks(self, stmt, sc: _Scope):
+        """Lower mid-loop conditional breaks (``if (c) break;``) to a
+        carried break flag: the loop condition gains ``&& !brk`` and
+        every statement after the break point runs under ``if (!brk)``,
+        so the exit is exact -- same iteration count, same final state
+        as the C program (sha256_tmr.c's for-100 early exit; the
+        quicksort error-break idiom).  Returns a rewritten For (or the
+        original when the body has no breaks).  Breaks in any other
+        position refuse loudly; breaks inside NESTED loops belong to
+        those loops and are left alone."""
+        items = (list(stmt.stmt.block_items or [])
+                 if isinstance(stmt.stmt, c_ast.Compound) else [stmt.stmt])
+        if not any(self._count_breaks(s) for s in items
+                   if not isinstance(s, (c_ast.While, c_ast.For))):
+            return stmt
+        brk = f"__brk{self._tmp}"
+        self._tmp += 1
+        sc.locals[brk] = jnp.int32(0)
+
+        def is_break_if(s):
+            """``if (c) break;`` / ``if (c) { break; }`` with no else."""
+            if not isinstance(s, c_ast.If) or s.iffalse is not None:
+                return False
+            body = (s.iftrue.block_items or []
+                    if isinstance(s.iftrue, c_ast.Compound) else [s.iftrue])
+            return len(body) == 1 and isinstance(body[0], c_ast.Break)
+
+        def rewrite(seq):
+            out = []
+            for k, s in enumerate(seq):
+                if isinstance(s, (c_ast.While, c_ast.For)):
+                    out.append(s)          # inner loop owns its breaks
+                    continue
+                if is_break_if(s):
+                    set_brk = c_ast.Assignment(
+                        "=", c_ast.ID(brk),
+                        c_ast.Constant("int", "1"), s.coord)
+                    out.append(c_ast.If(s.cond, set_brk, None, s.coord))
+                    rest = rewrite(seq[k + 1:])
+                    if rest:
+                        guard = c_ast.BinaryOp(
+                            "==", c_ast.ID(brk),
+                            c_ast.Constant("int", "0"), s.coord)
+                        wrap = c_ast.If(
+                            guard, c_ast.Compound(rest, s.coord), None,
+                            s.coord)
+                        self._synth_reason[id(wrap)] = \
+                            "after a mid-loop break point"
+                        out.append(wrap)
+                    return out
+                if self._count_breaks(s):
+                    raise CLiftError(
+                        f"break in unsupported position at "
+                        f"{getattr(s, 'coord', '?')}; only the "
+                        "'if (cond) break;' idiom is lowered")
+                out.append(s)
+            return out
+
+        body_stmts = rewrite(items)
+        not_brk = c_ast.BinaryOp("==", c_ast.ID(brk),
+                                 c_ast.Constant("int", "0"), stmt.coord)
+        # C does not run the increment on the broken-out iteration: move
+        # the next-expression into the body under the !brk guard (an If
+        # STATEMENT, so its side effects are genuinely masked -- a
+        # ternary would evaluate both arms under tracing).
+        if stmt.next is not None:
+            body_stmts.append(c_ast.If(not_brk, stmt.next, None,
+                                       stmt.coord))
+        # The loop condition becomes a PURE carried variable: C's break
+        # exits WITHOUT re-testing the condition, so a side-effecting
+        # condition (while (g--)) must not be evaluated on the
+        # broken-out exit.  The variable is primed here (the pre-loop
+        # test, effects apply once) and re-evaluated at the END of the
+        # body under the !brk guard.
+        cnd = f"__cnd{self._tmp}"
+        self._tmp += 1
+        sc.locals[cnd] = jnp.int32(0)
+        if stmt.cond is not None:
+            cond_val = c_ast.BinaryOp("!=", stmt.cond,
+                                      c_ast.Constant("int", "0"),
+                                      stmt.coord)
+            self._exec_stmt(c_ast.Assignment("=", c_ast.ID(cnd),
+                                             cond_val, stmt.coord), sc)
+            body_stmts.append(c_ast.Assignment(
+                "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
+                stmt.coord))
+            body_stmts.append(c_ast.If(
+                not_brk,
+                c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
+                                 stmt.coord),
+                None, stmt.coord))
+        else:
+            self._exec_stmt(c_ast.Assignment(
+                "=", c_ast.ID(cnd), c_ast.Constant("int", "1"),
+                stmt.coord), sc)
+            body_stmts.append(c_ast.Assignment(
+                "=", c_ast.ID(cnd), not_brk, stmt.coord))
+        new_body = c_ast.Compound(body_stmts, stmt.stmt.coord)
+        return c_ast.For(None, c_ast.ID(cnd), None, new_body, stmt.coord)
+
     def _exec_for(self, stmt, sc: _Scope):
         if stmt.init is not None:
             self._exec_stmt(stmt.init, sc)
+        stmt = self._rewrite_breaks(stmt, sc)
         carry_names = self._loop_carry(stmt, sc)
 
         def pack():
@@ -1280,7 +1548,9 @@ class _Compiler:
 
         def branch(node):
             def run(vals):
-                sub = sc.fork(no_print_at=stmt.coord)
+                sub = sc.fork(
+                    no_print_at=stmt.coord,
+                    no_print_reason=self._synth_reason.get(id(stmt)))
                 for n, v in zip(carry_names, vals):
                     sub.write_binding(n, v)
                 if node is not None:

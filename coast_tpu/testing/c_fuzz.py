@@ -113,6 +113,15 @@ class _Gen:
         g.append("void scale(unsigned int *p, uint8_t length, "
                  "unsigned int v) { while (length--) { "
                  "*p = (*p ^ v) + (unsigned int)sizeof(length); p++; } }")
+        # Early-return helper over a walked pointer: the returning
+        # iteration's tail (the mutation after the return point) must be
+        # masked exactly as C does.
+        g.append("unsigned int seek(unsigned int *p, uint8_t n, "
+                 "unsigned int v) { uint8_t i; "
+                 "for (i = 0; i < n; i++) { "
+                 "if ((p[i] & 7u) == (v & 7u)) return v + (unsigned int)i; "
+                 "p[i] = p[i] + 11u; } "
+                 "return v ^ 21u; }")
         # A pointer-walk helper per array element type in use (exercises
         # *p++ / while (length--) / narrow deref promotion).
         walked_types = sorted({t for _, t, _ in self.arrays}
@@ -142,6 +151,16 @@ class _Gen:
         # second is a comma-bearing nested call into mix().
         body.append(f"  acc0 ^= MIXM(b, mix(acc1, "
                     f"{r.randrange(0, 99)}u));")
+        # Early return through a walked pointer (data-dependent exit).
+        body.append(f"  acc1 += seek(lbuf, {lsize}, acc0);")
+        # Mid-loop conditional break with a data-dependent threshold and
+        # work after the break point (both must be masked on the broken
+        # iteration, incl. the i++).
+        body.append(f"  for (i = 0; i < {lsize}; i++) {{ "
+                    f"acc0 += lbuf[i]; "
+                    f"if ((acc0 & {r.randrange(3, 31)}u) == 1u) break; "
+                    f"acc1 ^= acc0 + (unsigned int)i; }}")
+        body.append("  acc1 += (unsigned int)i;")
         for name, ctype, size in self.arrays:
             names = [f"{name}[i]", "(unsigned int)i", "acc0", "acc1"]
             stmts = []

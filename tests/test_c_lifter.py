@@ -670,3 +670,123 @@ int main() { int i;
 """, name="sz")
     out = np.asarray(r.output(r.run_unprotected()))
     assert out[-1] == 4 + 16
+
+
+def test_mid_loop_break_exact(tmp_path):
+    """The 'if (cond) break;' idiom lowers to a carried flag with exact
+    C semantics: the broken-out iteration runs neither the statements
+    after the break point nor the for-next increment."""
+    r = _lift_src(tmp_path, """
+unsigned int data[8] = {3, 1, 4, 1, 5, 9, 2, 6};
+unsigned int total = 0;
+int stop_i = 0;
+int main() {
+    int i;
+    for (i = 0; i < 8; i++) {
+        total += data[i];
+        if (total > 13) break;
+        total += 1;
+    }
+    stop_i = i;
+    printf("%u\\n", total);
+    printf("%d\\n", stop_i);
+    return 0;
+}
+""", name="brk")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.uint32)
+    assert out[-2] == 18 and out[-1] == 4      # gcc-verified values
+
+
+def test_early_return_exact(tmp_path):
+    """Structured early returns lower to a carried flag pair: the
+    returning iteration's remaining statements (incl. the data mutation
+    after the return point) are masked, repeated calls see the mutated
+    state -- gcc-verified value."""
+    r = _lift_src(tmp_path, """
+unsigned int data[8] = {3, 1, 4, 1, 5, 9, 2, 6};
+unsigned int out = 0;
+unsigned int find(unsigned int needle) {
+    int i;
+    for (i = 0; i < 8; i++) {
+        if (data[i] == needle) return (unsigned int)i + 100u;
+        data[i] = data[i] + 1u;
+    }
+    return 999u;
+}
+int main() {
+    int k;
+    for (k = 0; k < 3; k++) {
+        out = out * 1000u + find(5u + (unsigned int)k);
+    }
+    printf("%u\\n", out);
+    return 0;
+}
+""", name="ret")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.uint32)
+    assert out[-1] == 104107999                # gcc-verified
+
+
+def test_sha256_tmr_full_main():
+    """sha256_tmr.c's FULL main now ingests: the 100-iteration
+    early-exit loop (if (error) break), checkGolden's early return, and
+    the final printf.  error == 0 is the program's own oracle."""
+    src = os.path.join(SHA_DIR, "sha256_tmr.c")
+    if not os.path.exists(src):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("sha256_tmr_c_main", [src])
+    out = np.asarray(r.output(r.run_unprotected()))
+    # printf("C:0 E:%d F:0 T:%uus", error, 0): last two printed args.
+    assert out[-2] == 0 and out[-1] == 0
+
+
+def test_break_return_side_effecting_cond_exact(tmp_path):
+    """C's break/return exit WITHOUT re-testing the loop condition: a
+    side-effecting condition (while (g--)) must not run once more on
+    the lowered exit.  gcc-verified values."""
+    r = _lift_src(tmp_path, """
+unsigned int g = 5;
+unsigned int w = 0;
+int main() {
+    while (g--) { if (g == 3) break; w += g; }
+    printf("%u\\n", g);
+    printf("%u\\n", w);
+    return 0;
+}
+""", name="sebrk")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.uint32)
+    assert out[-2] == 3 and out[-1] == 4       # gcc: g stays 3, w = 4
+
+    r2 = _lift_src(tmp_path, """
+unsigned int g = 5;
+unsigned int o = 0;
+unsigned int f() { while (g--) { if (g == 3) return 7u; } return 1u; }
+int main() {
+    int i;
+    for (i = 0; i < 1; i++) { o = f(); }
+    printf("%u\\n", g);
+    printf("%u\\n", o);
+    return 0;
+}
+""", name="seret")
+    out2 = np.asarray(r2.output(r2.run_unprotected())).astype(np.uint32)
+    assert out2[-2] == 3 and out2[-1] == 7
+
+
+def test_printf_after_early_return_refused(tmp_path):
+    """A printf after an early-return point names the REAL construct in
+    its refusal (not 'inside a loop or branch')."""
+    from coast_tpu.frontend.c_lifter import CLiftError
+    with pytest.raises(CLiftError, match="after an early-return point"):
+        _lift_src(tmp_path, """
+unsigned int g = 5;
+unsigned int x = 3;
+int main() {
+    int i;
+    for (i = 0; i < 1; i++) { x += 1u; }
+    if (g == 5u) return 1;
+    printf("%u\\n", x);
+    return 0;
+}
+""", name="pr")
