@@ -790,3 +790,30 @@ int main() {
     return 0;
 }
 """, name="pr")
+
+
+def test_cfcss_stacks_on_ingested_sha256():
+    """CFCSS (config 5 stacking) on an INGESTED program: the multi-phase
+    block graph synthesized for sha256.c must pass a fault-free
+    signature check under TMR+CFCSS, and a control-leaf flip must
+    classify (either corrected by the vote or flagged by CFCSS), never
+    silently alter the output."""
+    src = os.path.join(SHA_DIR, "sha256.c")
+    if not os.path.exists(src):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("sha256_cfc", [src])
+    prog = TMR(r, cfcss=True)
+    rec = jax.jit(prog.run)()
+    assert not bool(rec["cfc_fault"])
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+
+    ctrl = [n for n, s in r.spec.items() if s.kind == "ctrl"]
+    assert ctrl
+    lid = prog.leaf_order.index(ctrl[0])
+    rec_f = jax.jit(prog.run)({"leaf_id": lid, "lane": 1, "word": 0,
+                               "bit": 2, "t": 3})
+    assert int(rec_f["errors"]) == 0 or bool(rec_f["cfc_fault"]) \
+        or not bool(rec_f["done"])

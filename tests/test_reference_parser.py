@@ -161,3 +161,43 @@ def test_reference_jsonparser_compare_mode(campaign, tmp_path):
     m = re.search(r"(\d+\.\d+)x\s+┃\s*$", out, re.M)
     assert m, out
     assert float(m.group(1)) > 0
+
+
+def test_ingested_source_campaign_reference_tool_roundtrip(tmp_path):
+    """The strongest interop combination: ingest the reference's OWN
+    crc16.c, campaign it through the supervisor CLI with the reference
+    log container, then EXECUTE the reference's unmodified jsonParser.py
+    on the result and assert count parity with the repo's analysis."""
+    src = "/root/reference/tests/crc16/crc16.c"
+    if not os.path.exists(src) or not os.path.isdir(REF_PLATFORM):
+        pytest.skip("reference checkout not present")
+    pytest.importorskip("pycparser")
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject.supervisor import main as supervisor_main
+
+    rc = supervisor_main(["-f", src, "-t", "32", "--batch-size", "32",
+                          "-l", str(tmp_path), "-s", "memory",
+                          "--log-format", "reference", "-d", "cpu"])
+    assert rc == 0
+    logs = list(tmp_path.glob("*.json"))
+    assert len(logs) == 1
+    ref_path = str(logs[0])
+    with open(ref_path) as f:
+        # Line 1 must name a real file (the true C source for lifted
+        # programs) or the reference tool refuses the whole log.
+        assert os.path.exists(f.readline().strip())
+
+    mine = jp.summarize_path(ref_path)
+    # Premise guard (same as the sibling tests): the reference tool's
+    # otherStats takes statistics.mean over fully-clean runs, so a
+    # schedule change leaving none must fail HERE, not opaquely inside
+    # the subprocess.
+    assert mine.counts["success"] > 0
+    proc = subprocess.run(
+        [sys.executable, "jsonParser.py", ref_path],
+        cwd=REF_PLATFORM, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    m = re.search(r"Total runs: (\d+)", proc.stdout)
+    assert m and int(m.group(1)) == mine.n == 32
+    m = re.search(r"Errors:\s+(\d+) \(", proc.stdout)
+    assert m and int(m.group(1)) == mine.counts["sdc"]
