@@ -92,7 +92,21 @@ class CLiftError(LiftError):
 # ---------------------------------------------------------------------------
 
 _COAST_MACROS = ("__DEFAULT_NO_xMR", "__DEFAULT_xMR", "__xMR", "__NO_xMR",
-                 "__xMR_FN", "__NO_xMR_FN", "__COAST_IGNORE_GLOBAL")
+                 "__xMR_FN", "__NO_xMR_FN")
+
+# Further COAST.h attribute macros: recorded and stripped so annotated
+# sources PARSE (the annotations expand to __attribute__ in the real
+# header, COAST.h:11-67); behaviors already designed away (ISRs,
+# malloc/printf wrappers) surface later as loud refusals on the
+# construct itself, not as parse errors on the macro token.
+_COAST_STRIP_TOKENS = ("__xMR_FN_CALL", "__SKIP_FN_CALL",
+                       "__COAST_VOLATILE", "__ISR_FUNC", "__xMR_RET_VAL",
+                       "__xMR_PROT_LIB", "__xMR_ALL_AFTER_CALL",
+                       "__COAST_NO_INLINE")
+# Function-like COAST macros whose whole invocation line is a no-op
+# declaration in the real header (wrapper registration).
+_COAST_STRIP_CALLS = ("PRINTF_WRAPPER_REGISTER", "MALLOC_WRAPPER_REGISTER",
+                      "__COAST_IGNORE_GLOBAL")
 
 _PRELUDE = """
 typedef unsigned int uint32_t;
@@ -256,6 +270,10 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
             continue
         if stripped.startswith("#"):
             continue                      # #ifdef guards etc.: benign here
+        # Expand BEFORE the annotation passes: a source-local alias like
+        # `#define FUNCTION_TAG __xMR` must be recorded and stripped the
+        # same as a literal __xMR (load_store.c's style).
+        line = expand(line)
         # Per-declaration scope annotations.  Styles the reference corpus
         # uses: mid-declaration ``uint32_t __xMR name[..]`` (the token
         # after the macro is the name), prefix ``__xMR uint32_t name``
@@ -271,12 +289,16 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
                              line):
             name_flags.setdefault(m.group(1), m.group(2) == "__xMR")
         # Record + strip COAST annotation macros and GCC attributes.
-        for mac in _COAST_MACROS:
+        for mac in _COAST_MACROS + _COAST_STRIP_TOKENS:
             if re.search(rf"\b{mac}\b", line):
                 annotations.append(mac)
                 line = re.sub(rf"\b{mac}\b", "", line)
+        for mac in _COAST_STRIP_CALLS:
+            if re.search(rf"\b{mac}\s*\(", line):
+                annotations.append(mac)
+                line = re.sub(rf"\b{mac}\s*\([^)]*\)\s*;?", "", line)
         line = re.sub(r"__attribute__\s*\(\(.*?\)\)", "", line)
-        out.append(expand(line))
+        out.append(line)
     return "\n".join(out), defines, annotations, name_flags
 
 
@@ -683,6 +705,23 @@ class _Compiler:
             # the ALIASED array's -- reinterpreting an int array as bytes
             # would need sub-word addressing, outside the lane model.
             return self._ptr_parts(expr.expr, sc)
+        if isinstance(expr, c_ast.UnaryOp) and expr.op == "&":
+            # Address-of: &arr -> (arr, 0); &arr[k] -> (arr, k)
+            # (basicIR.c's `int *xp = &globalArr[0]`).
+            inner = expr.expr
+            if isinstance(inner, c_ast.ArrayRef) and isinstance(
+                    inner.name, c_ast.ID):
+                base, off = self._ptr_parts(inner.name, sc)
+                k = jnp.asarray(self.eval(inner.subscript, sc), jnp.int32)
+                return base, off + k
+            if (isinstance(inner, c_ast.ID) and inner.name in sc.locals
+                    and inner.name not in sc.aliases
+                    and jnp.ndim(sc.locals[inner.name]) == 0):
+                raise CLiftError(
+                    f"address-of scalar {inner.name!r} at "
+                    f"{getattr(expr, 'coord', '?')} is not supported "
+                    "(no out-parameter model; return the value instead)")
+            return self._ptr_parts(inner, sc)
         if isinstance(expr, c_ast.BinaryOp) and expr.op in ("+", "-"):
             base, off = self._ptr_parts(expr.left, sc)
             d = jnp.asarray(self.eval(expr.right, sc), jnp.int32)
