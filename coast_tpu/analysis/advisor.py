@@ -318,7 +318,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="coast_tpu.analysis.advisor",
         description="data-driven selective-xMR scope recommendation")
-    ap.add_argument("benchmark", choices=sorted(REGISTRY))
+    ap.add_argument("benchmark",
+                    help="registry name (one of: "
+                         + ", ".join(sorted(REGISTRY))
+                         + ") or a .c source path ('+'-joined for "
+                         "multi-TU programs), like the other CLIs")
     ap.add_argument("-e", type=int, default=8192, metavar="N",
                     help="injection budget (default 8192)")
     ap.add_argument("-t", type=float, default=0.0, metavar="RATE",
@@ -337,7 +341,23 @@ def main(argv=None) -> int:
     if __import__("os").environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    adv = advise(REGISTRY[args.benchmark](), budget=args.e,
+    from coast_tpu.frontend import LiftError
+    from coast_tpu.models import resolve_region
+    # Name/path validation FIRST, so an internal KeyError inside a valid
+    # model's make_region() surfaces as itself, not as 'unknown
+    # benchmark'.
+    if not args.benchmark.endswith(".c") and args.benchmark not in REGISTRY:
+        ap.error(f"unknown benchmark: {args.benchmark!r} (or pass a .c "
+                 "source path)")
+    try:
+        region = resolve_region(args.benchmark)
+    except FileNotFoundError as e:
+        ap.error(f"file {e.args[0]} does not exist")
+    except LiftError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+    adv = advise(region, budget=args.e,
                  target_harm=args.t, seed=args.seed,
                  validate=not args.no_validate,
                  cost_aware=args.cost_aware)

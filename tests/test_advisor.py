@@ -163,3 +163,22 @@ def test_cost_aware_never_larger_footprint(bench):
                 for h in cheap.ranked
                 if region.spec[h.name].kind == KIND_RO)
     assert resid_rate <= max(kw["target_harm"], floor) + 1e-9
+
+
+def test_advisor_cli_accepts_c_source(capsys):
+    """The advisor CLI resolves .c paths through the shared resolver like
+    opt and the supervisor: selective-hardening advice straight off the
+    reference's own source."""
+    import os
+
+    src = "/root/reference/tests/crc16/crc16.c"
+    if not os.path.exists(src):
+        pytest.skip("reference checkout not present")
+    pytest.importorskip("pycparser")
+    from coast_tpu.analysis.advisor import main
+
+    rc = main([src, "-e", "512", "-t", "0.5", "--no-validate"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "selective-hardening advice: crc16" in out
+    assert "replicated words:" in out
