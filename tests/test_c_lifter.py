@@ -555,6 +555,7 @@ int main() { out = total(buf); printf("%d\\n", out); return 0; }
 SHA_DIR = "/root/reference/tests/sha256_common"
 
 
+@pytest.mark.slow
 def test_sha256_reference_benchmark():
     """The reference's sha256.c -- a full crypto benchmark -- ingests:
     function-like macros (ROTRIGHT, DBL_INT_ADD with continuation
@@ -585,6 +586,7 @@ def test_sha256_reference_benchmark():
     assert res_u.counts["sdc"] > res.counts["sdc"]
 
 
+@pytest.mark.slow
 def test_sha256_tmr_annotated_entry():
     """The __xMR-annotated variant's sha_run_test entry (its main has a
     mid-loop conditional break, outside the envelope): globals hash
@@ -726,6 +728,7 @@ int main() {
     assert out[-1] == 104107999                # gcc-verified
 
 
+@pytest.mark.slow
 def test_sha256_tmr_full_main():
     """sha256_tmr.c's FULL main now ingests: the 100-iteration
     early-exit loop (if (error) break), checkGolden's early return, and
@@ -792,6 +795,7 @@ int main() {
 """, name="pr")
 
 
+@pytest.mark.slow
 def test_cfcss_stacks_on_ingested_sha256():
     """CFCSS (config 5 stacking) on an INGESTED program: the multi-phase
     block graph synthesized for sha256.c must pass a fault-free
