@@ -1,0 +1,186 @@
+"""Statistically real flagship campaign: >=50k injections on the b512 mm.
+
+Round-3 flagship campaigns ran 64-128 injections -- fine as throughput
+probes, far too small to quote SDC/corrected rates.  This script runs a
+full-size TMR campaign (and a DWC one) on matrixMultiply1024b512, the
+high-MFU roofline configuration (docs/perf.md), and reports rates with
+Wilson 95% intervals plus achieved FLOP/s as a fraction of bf16 peak.
+
+Batch sizing is physics, not preference: one campaign row holds the whole
+replica state independently (~18.9 MB state x 3 TMR lanes ~= 57 MB), so a
+batch of 512 rows needs ~29 GB -- over the 16 GB v5e HBM.  The script
+probes candidate batches and runs the main campaign at the measured-best
+one, recording the probe table and the HBM arithmetic in the artifact.
+
+The main campaign runs in resumable seeded chunks (run(seed, start_num))
+and rewrites the artifact after every chunk, so a tunnel wedge mid-way
+still leaves a usable partial record.
+
+Also measured here: the slice-vote A/B (store_slice hint vs whole-leaf
+voting) as campaign injections/sec, the number the round-3 verdict asked
+to see on-chip.
+
+Reference bar: campaign sizing convention `supervisor.py:339` (run until
+N errors, round to 1000); analysis taxonomy `jsonParser.py:148-201`.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("COAST_STUDY_BACKEND") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+PEAK_GFLOPS = 197_000.0          # v5e bf16 single-chip peak
+
+
+def wilson(k: int, n: int, z: float = 1.96):
+    """95% Wilson score interval for a binomial rate."""
+    if n == 0:
+        return (0.0, 0.0, 0.0)
+    p = k / n
+    denom = 1 + z * z / n
+    centre = (p + z * z / (2 * n)) / denom
+    half = (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
+    return (round(p, 6), round(max(0.0, centre - half), 6),
+            round(min(1.0, centre + half), 6))
+
+
+def rate_block(counts, n):
+    out = {}
+    for key in ("sdc", "corrected", "due_abort", "due_timeout"):
+        k = counts.get(key, 0)
+        p, lo, hi = wilson(k, n)
+        out[key] = {"count": k, "rate": p, "wilson95": [lo, hi]}
+    return out
+
+
+def main():
+    from coast_tpu import DWC, TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY, mm256
+
+    backend = jax.default_backend()
+    n_tmr = int(os.environ.get("COAST_FLAGSHIP_N", "50000"))
+    n_dwc = int(os.environ.get("COAST_FLAGSHIP_DWC_N", "20000"))
+    n_ab = int(os.environ.get("COAST_FLAGSHIP_AB_N", "2048"))
+    chunk = int(os.environ.get("COAST_FLAGSHIP_CHUNK", "8192"))
+    probe_batches = tuple(int(b) for b in os.environ.get(
+        "COAST_FLAGSHIP_BATCHES", "64,128,256").split(","))
+
+    bench = "matrixMultiply1024b512"
+    region = REGISTRY[bench]()
+    flops3 = 3 * region.meta["flops_per_run"]
+    state_mb = region.meta["state_bytes"] / 2**20
+
+    fname = ("flagship_campaign.json" if backend == "tpu"
+             else "flagship_campaign_cpu_smoke.json")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", fname)
+
+    out = {"metric": "flagship_campaign", "backend": backend,
+           "benchmark": bench,
+           "state_bytes": region.meta["state_bytes"],
+           "hbm_note": (f"one TMR campaign row ~= {3 * state_mb:.0f} MB "
+                        f"(state {state_mb:.1f} MB x 3 lanes); batch 512 "
+                        f"would need ~{512 * 3 * state_mb / 1024:.0f} GB vs "
+                        "16 GB v5e HBM -- batch chosen by probe instead"),
+           "peak_ref": "v5e bf16 197 TFLOP/s"}
+
+    def save():
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+
+    # -- batch probe (TMR) --------------------------------------------------
+    tmr_runner = CampaignRunner(TMR(region, pallas_voters=True),
+                                strategy_name="TMR")
+    out["batch_probe"] = []
+    best_batch, best_rate = None, -1.0
+    for batch in probe_batches:
+        try:
+            tmr_runner.run(batch, seed=1, batch_size=batch)      # compile+warm
+            res = tmr_runner.run(2 * batch, seed=2, batch_size=batch)
+        except Exception as e:  # noqa: BLE001 - OOM at large batch is data
+            out["batch_probe"].append({"batch": batch,
+                                       "error": type(e).__name__})
+            save()
+            continue
+        row = {"batch": batch,
+               "injections_per_sec": round(res.injections_per_sec, 2),
+               "fraction_of_peak": round(
+                   flops3 * res.n / res.seconds / 1e9 / PEAK_GFLOPS, 5)}
+        out["batch_probe"].append(row)
+        print(json.dumps(row))
+        save()
+        if res.injections_per_sec > best_rate:
+            best_rate, best_batch = res.injections_per_sec, batch
+    if best_batch is None:
+        save()
+        print(json.dumps({"error": "no batch size ran", "wrote": path}))
+        return 1
+    out["batch"] = best_batch
+
+    # -- main campaigns, chunked + resumable --------------------------------
+    for strat_name, runner, n_total in (
+            ("TMR", tmr_runner, n_tmr),
+            ("DWC", CampaignRunner(DWC(region, pallas_voters=True),
+                                   strategy_name="DWC"), n_dwc)):
+        counts, done, secs = {}, 0, 0.0
+        key = f"campaign_{strat_name}"
+        while done < n_total:
+            n_chunk = min(chunk, n_total - done)
+            res = runner.run(n_chunk, seed=42, batch_size=best_batch,
+                             start_num=done)
+            done += res.n
+            secs += res.seconds
+            for k, v in res.counts.items():
+                counts[k] = counts.get(k, 0) + v
+            lanes = 3 if strat_name == "TMR" else 2
+            fl = lanes * region.meta["flops_per_run"]
+            out[key] = {
+                "strategy": strat_name, "seed": 42,
+                "injections": done, "target": n_total,
+                "batch_size": best_batch,
+                "seconds": round(secs, 2),
+                "injections_per_sec": round(done / secs, 2),
+                "gflops_per_sec": round(fl * done / secs / 1e9, 2),
+                "fraction_of_peak": round(
+                    fl * done / secs / 1e9 / PEAK_GFLOPS, 5),
+                "counts": counts,
+                "rates": rate_block(counts, done),
+                "complete": done >= n_total,
+            }
+            save()
+            print(json.dumps({"strategy": strat_name, "done": done,
+                              "inj_per_sec": out[key]["injections_per_sec"]}))
+
+    # -- slice-vote vs whole-leaf-vote A/B (campaign inj/s) -----------------
+    region_wl = mm256.make_region(side=1024, block=512, bf16_matmul=True)
+    region_wl.meta = {k: v for k, v in region_wl.meta.items()
+                      if k != "store_slice"}
+    ab = {}
+    for name, reg in (("slice_vote", region), ("wholeleaf_vote", region_wl)):
+        r = CampaignRunner(TMR(reg, pallas_voters=True), strategy_name="TMR")
+        r.run(best_batch, seed=1, batch_size=best_batch)          # warm
+        res = r.run(n_ab, seed=7, batch_size=best_batch)
+        ab[name] = {"injections": res.n,
+                    "injections_per_sec": round(res.injections_per_sec, 2)}
+        print(json.dumps({name: ab[name]}))
+    if ab["wholeleaf_vote"]["injections_per_sec"] > 0:
+        ab["slice_vote_speedup_x"] = round(
+            ab["slice_vote"]["injections_per_sec"]
+            / ab["wholeleaf_vote"]["injections_per_sec"], 3)
+    out["slice_vote_ab"] = ab
+    save()
+    print(json.dumps({"wrote": path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

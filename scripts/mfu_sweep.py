@@ -53,6 +53,18 @@ def main():
            "side": side, "peak_ref": "v5e bf16 197 TFLOP/s",
            "blocks": []}
 
+    # Incremental save: the tunnel can wedge mid-sweep; every completed row
+    # must survive.
+    art_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts")
+    fname = ("mfu_sweep.json" if backend == "tpu"
+             else "mfu_sweep_cpu_smoke.json")
+    path = os.path.join(art_dir, fname)
+
+    def save():
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+
     for block in (32, 128, 256, 512):
         if side % block:
             continue
@@ -85,6 +97,7 @@ def main():
             / row["TMR"]["seconds_per_run"], 3)
         out["blocks"].append(row)
         print(json.dumps(row))
+        save()
 
     # unroll sweep on the campaign path (small mm: loop-overhead bound)
     import jax.numpy as jnp
@@ -118,18 +131,22 @@ def main():
                 out["unroll"].append({"indexing": mode, "unroll": unroll,
                                       "injections_per_sec": round(n / sec, 1)})
                 print(json.dumps(out["unroll"][-1]))
+                save()
     finally:
         if prior_mode is None:
             os.environ.pop("COAST_INDEXING_MODE", None)
         else:
             os.environ["COAST_INDEXING_MODE"] = prior_mode
 
-    fname = ("mfu_sweep.json" if backend == "tpu"
-             else "mfu_sweep_cpu_smoke.json")
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "artifacts", fname)
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    save()
+    # The indexing x unroll grid also stands alone as the artifact the
+    # engine docstring promises (dataflow_protection.py run(..., unroll=)).
+    un_name = ("unroll_sweep.json" if backend == "tpu"
+               else "unroll_sweep_cpu_smoke.json")
+    with open(os.path.join(art_dir, un_name), "w") as f:
+        json.dump({"metric": "campaign_indexing_unroll_sweep",
+                   "backend": backend, "benchmark": "matrixMultiply",
+                   "grid": out["unroll"]}, f, indent=1)
     print(json.dumps({"wrote": path}))
     return 0
 

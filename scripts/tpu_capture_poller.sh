@@ -1,9 +1,13 @@
 #!/bin/bash
-# Poll the axon TPU tunnel; on the first successful probe, run the on-chip
-# capture suite (MFU sweep, flip-kernel study, 1M campaign, bench refresh)
-# and commit the artifacts.  The tunnel wedges for long stretches (probes
-# block inside backend init), so every stage runs under a hard timeout and
-# the probe itself is a subprocess the shell can kill.
+# Poll the axon TPU tunnel; whenever a probe succeeds, run the on-chip
+# capture suite and commit the artifacts.  The tunnel wedges for long
+# stretches (probes block inside backend init) and has held windows as
+# short as ~10 minutes, so:
+#   * every stage runs under a hard timeout;
+#   * stages run in priority order (bench first -- the round record);
+#   * each stage commits its artifacts on success immediately;
+#   * per-stage completion is tracked in a state dir, and unfinished
+#     stages are re-attempted on later tunnel windows until all pass.
 #
 # Usage: setsid nohup scripts/tpu_capture_poller.sh &   (log: /tmp/tpu_poller.log)
 set -u
@@ -11,37 +15,65 @@ cd "$(dirname "$0")/.."
 LOG=${TPU_POLLER_LOG:-/tmp/tpu_poller.log}
 PROBE_S=${TPU_POLLER_PROBE_S:-75}
 SLEEP_S=${TPU_POLLER_SLEEP_S:-430}
+STATE=${TPU_POLLER_STATE:-/tmp/tpu_poller_state}
+mkdir -p "$STATE"
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
-note "poller start (pid $$)"
+# run_stage <name> <timeout_s> <cmd...>
+run_stage() {
+  local name=$1 tmo=$2; shift 2
+  if [ -e "$STATE/$name.done" ]; then return 0; fi
+  # Re-probe before each stage: a wedge in stage k must not burn the
+  # remaining stages' timeouts against a dead tunnel.
+  if ! timeout "$PROBE_S" python -c \
+      "import jax, jax.numpy as jnp; jnp.add(1,1).block_until_ready(); assert jax.default_backend() == 'tpu'" \
+      >/dev/null 2>&1; then
+    note "stage $name skipped: tunnel gone"
+    return 1
+  fi
+  note "stage $name start (timeout ${tmo}s)"
+  timeout "$tmo" "$@" >> "$LOG" 2>&1
+  local rc=$?
+  note "stage $name rc=$rc"
+  if [ "$rc" -eq 0 ]; then
+    touch "$STATE/$name.done"
+    # Pathspec-limited: this repo is actively worked in; the capture
+    # commit must never sweep up unrelated staged changes.
+    git add artifacts >> "$LOG" 2>&1
+    git commit -m "Record on-chip $name artifacts" -- artifacts \
+      >> "$LOG" 2>&1 || note "stage $name: nothing to commit"
+  fi
+  return $rc
+}
+
+all_done() {
+  for s in bench flagship_campaign mfu_sweep flip_kernel_study campaign_1m; do
+    [ -e "$STATE/$s.done" ] || return 1
+  done
+  return 0
+}
+
+note "poller start (pid $$, state $STATE)"
 while true; do
+  if all_done; then note "all stages done -- exiting"; break; fi
   # The probe must see a real TPU backend: a fast axon-init failure
   # falls back to CPU with only a warning, and a CPU run must never be
   # committed as the on-chip capture.
   if timeout "$PROBE_S" python -c \
       "import jax, jax.numpy as jnp; jnp.add(1,1).block_until_ready(); assert jax.default_backend() == 'tpu'" \
       >/dev/null 2>&1; then
-    note "tunnel up -- running capture suite"
-    timeout 2700 python -u scripts/mfu_sweep.py >> "$LOG" 2>&1
-    note "mfu_sweep rc=$?"
-    timeout 1500 python -u scripts/flip_kernel_study.py >> "$LOG" 2>&1
-    note "flip_kernel_study rc=$?"
-    timeout 2400 python -u scripts/campaign_1m.py \
-      --out artifacts/campaign_mm_1m.json --logdir /tmp >> "$LOG" 2>&1
-    note "campaign_1m rc=$?"
+    note "tunnel up -- running capture suite (pending stages)"
     # bench.py supervises itself (420s init + retry + 900s run budgets);
     # the outer bound only guards against a hang beyond its own design.
-    timeout 2700 python bench.py >> "$LOG" 2>&1
-    note "bench rc=$?"
-    # Pathspec-limited: this repo is actively worked in; the capture
-    # commit must never sweep up unrelated staged changes.
-    git add artifacts >> "$LOG" 2>&1
-    git commit -m "Record on-chip capture suite artifacts (MFU sweep, flip study, 1M campaign, bench)" \
-      -- artifacts >> "$LOG" 2>&1 || note "nothing to commit"
-    note "capture suite done"
-    break
+    run_stage bench             2700 python bench.py
+    run_stage flagship_campaign 2400 python -u scripts/flagship_campaign.py
+    run_stage mfu_sweep         2700 python -u scripts/mfu_sweep.py
+    run_stage flip_kernel_study 1500 python -u scripts/flip_kernel_study.py
+    run_stage campaign_1m       2400 python -u scripts/campaign_1m.py \
+      --out artifacts/campaign_mm_1m.json --logdir /tmp
+    if all_done; then note "capture suite complete -- exiting"; break; fi
   fi
-  note "tunnel down; sleeping ${SLEEP_S}s"
+  note "tunnel down or stages pending; sleeping ${SLEEP_S}s"
   sleep "$SLEEP_S"
 done
