@@ -47,6 +47,13 @@ class CampaignResult:
     steps: np.ndarray                 # int32 [n] T per run
     schedule: FaultSchedule
     seed: int
+    # For merged multi-chunk campaigns (run_until_errors): the exact
+    # (seed, n) of every chunk, in order.  The merged ``schedule``
+    # concatenates different-seed streams, so ``seed`` alone cannot
+    # regenerate it; replaying these chunks (CampaignRunner.replay_chunks)
+    # reproduces ``codes`` bit-for-bit.  None for single-seed campaigns,
+    # where ``seed`` + ``n`` suffice.
+    chunks: Optional[List[Dict[str, int]]] = None
 
     @property
     def injections_per_sec(self) -> float:
@@ -59,7 +66,7 @@ class CampaignResult:
         return self.counts["due_abort"] + self.counts["due_timeout"]
 
     def summary(self) -> Dict[str, object]:
-        return {
+        out = {
             "benchmark": self.benchmark,
             "strategy": self.strategy,
             "injections": self.n,
@@ -69,6 +76,9 @@ class CampaignResult:
             "injections_per_sec": round(self.injections_per_sec, 2),
             "seed": self.seed,
         }
+        if self.chunks is not None:
+            out["chunks"] = self.chunks
+        return out
 
 
 class CampaignRunner:
@@ -207,7 +217,12 @@ class CampaignRunner:
                          max_n: int = 1_000_000) -> CampaignResult:
         """The reference's campaign-sizing convention: inject until N SDC
         errors are seen, then round the campaign up to the next ``round_to``
-        (supervisor.py:339; threadFunctions.py:534-558)."""
+        (supervisor.py:339; threadFunctions.py:534-558).
+
+        The result's ``chunks`` records every chunk's exact (seed, n), and
+        ``replay_chunks(result.chunks)`` reproduces the campaign
+        bit-for-bit -- the merged schedule spans several seed streams, so
+        the master seed alone cannot."""
         results: List[CampaignResult] = []
         total = 0
         errors_seen = 0
@@ -229,6 +244,20 @@ class CampaignRunner:
             chunk_seed += 1
         return _merge_results(results, seed)
 
+    def replay_chunks(self, chunks: Sequence[Dict[str, int]],
+                      batch_size: int = 4096) -> CampaignResult:
+        """Re-run a recorded multi-chunk campaign exactly.
+
+        ``chunks`` is ``CampaignResult.chunks`` (each entry ``{"seed", "n"}``);
+        the replay regenerates each chunk's seeded schedule and merges in
+        the same order, so ``codes`` matches the original bit-for-bit --
+        the campaign-resume guarantee of gdbClient.py:401 extended to the
+        error-bounded sizing loop."""
+        results = [self.run(int(c["n"]), seed=int(c["seed"]),
+                            batch_size=batch_size) for c in chunks]
+        return _merge_results(results, int(chunks[0]["seed"]) if chunks
+                              else 0)
+
 
 def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
     first = parts[0]
@@ -249,4 +278,5 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
         steps=np.concatenate([p.steps for p in parts]),
         schedule=sched,
         seed=seed,
+        chunks=[{"seed": p.seed, "n": p.n} for p in parts],
     )

@@ -68,7 +68,11 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "board)")
     parser.add_argument("--opt-passes", "-O", type=str, default="-TMR",
                         help="protection to apply (opt CLI flag string); "
-                        "the reference bakes this into the ELF instead")
+                        "the reference bakes this into the ELF instead. "
+                        "All of `-O -TMR`, `-O '-TMR -countErrors'` and "
+                        "`--opt-passes=-TMR` work; pass flags that "
+                        "collide with supervisor flags (e.g. the `-s` "
+                        "segmenting flag) need the quoted or `=` form")
     parser.add_argument("--log-dir", "-l", type=str, default=None,
                         help="directory in which to create the log files")
     parser.add_argument("--no-logging", "-q", action="store_true",
@@ -105,7 +109,31 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "10^6-run campaigns, reference = the reference "
                         "tool's own container (exec-path line + bare "
                         "array; readable by its jsonParser.py unmodified)")
-    args = parser.parse_args(argv)
+    # `-O -TMR` ergonomics: argparse eats a bare `-TMR` as an (unknown)
+    # option, so the space-separated form the reference CLI uses routinely
+    # would fail with "expected one argument".  Pre-join the pass flags
+    # following -O/--opt-passes into `-O=<flags>` before argparse sees
+    # them.  Tokens that ARE supervisor options (e.g. `-s`, which is both
+    # the supervisor's section flag and the engine's segmenting flag) stop
+    # the join -- those need the quoted or `=` form, as --help documents.
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    known = {s for a in parser._actions for s in a.option_strings}
+    joined, i = [], 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok in ("-O", "--opt-passes") and i + 1 < len(argv):
+            passes, j = [], i + 1
+            while (j < len(argv) and argv[j].startswith("-")
+                   and argv[j] not in known):
+                passes.append(argv[j])
+                j += 1
+            if passes:
+                joined.append(tok + "=" + " ".join(passes))
+                i = j
+                continue
+        joined.append(tok)
+        i += 1
+    args = parser.parse_args(joined)
 
     if args.board in ("pynq", "hifive1"):
         print("This board not yet supported in this version", file=sys.stderr)

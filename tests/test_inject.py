@@ -110,6 +110,23 @@ def test_run_until_errors_rounds(region):
     assert res.n % 400 == 0
 
 
+def test_run_until_errors_replay(region):
+    """The merged campaign spans several seed streams; the recorded chunks
+    must reproduce it bit-for-bit (round-3 verdict: the merged result's
+    single seed label silently broke replayability for this entry point)."""
+    runner = CampaignRunner(unprotected(region))
+    res = runner.run_until_errors(min_errors=5, seed=1, batch_size=200,
+                                  round_to=400)
+    assert res.chunks and sum(c["n"] for c in res.chunks) == res.n
+    replay = runner.replay_chunks(res.chunks, batch_size=200)
+    assert np.array_equal(replay.codes, res.codes)
+    assert replay.counts == res.counts
+    # the schedule itself (the actual flips) must match too
+    for f in ("leaf_id", "lane", "word", "bit", "t"):
+        assert np.array_equal(getattr(replay.schedule, f),
+                              getattr(res.schedule, f))
+
+
 def test_injection_log_schema(region, tmp_path, campaigns):
     res = campaigns["TMR"]
     mmap = CampaignRunner(TMR(region)).mmap
