@@ -366,6 +366,8 @@ class _CType:
 
     def store(self, v):
         """Normalize a value being stored into this type's lane."""
+        if isinstance(v, _C64):
+            v = v.lo                    # C conversion 64 -> 32: mod 2^32
         v = jnp.asarray(v)
         if self.bits == 32:
             return v.astype(self.dtype)
@@ -376,9 +378,148 @@ class _CType:
             v = (v ^ sign) - sign
         return v
 
+    def zero(self):
+        return jnp.zeros((), self.dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class _C64:
+    """A 64-bit C integer as a uint32 limb pair (lo, hi).
+
+    JAX's x64 mode stays off (the whole lane/memory model is 32-bit
+    words, matching the reference's ILP32 targets); ``long long``
+    values instead live as two 32-bit lanes with explicit carry
+    arithmetic -- the same limb model the df64 softfloat re-expression
+    uses (models/chstone/df64.py).  Registered as a pytree so 64-bit
+    locals carry through lax.scan/cond like any other value."""
+
+    def __init__(self, lo, hi, unsigned: bool = False):
+        self.lo = jnp.asarray(lo, jnp.uint32)
+        self.hi = jnp.asarray(hi, jnp.uint32)
+        self.unsigned = bool(unsigned)
+
+    def tree_flatten(self):
+        return (self.lo, self.hi), self.unsigned
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    def with_sign(self, unsigned: bool) -> "_C64":
+        return _C64(self.lo, self.hi, unsigned)
+
+
+def _to64(v, unsigned_hint: bool = False) -> _C64:
+    """C conversion of a value to a 64-bit integer."""
+    if isinstance(v, _C64):
+        return v
+    v = jnp.asarray(v)
+    if v.dtype == jnp.uint32 or unsigned_hint:
+        return _C64(v, jnp.uint32(0), True)
+    v32 = v.astype(jnp.int32)
+    hi = jnp.where(v32 < 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    return _C64(v32, hi, False)
+
+
+def _mulhi_u32(x, y):
+    """High 32 bits of the exact 64-bit product of two uint32 (16-bit
+    limb decomposition; every partial product fits uint32)."""
+    x = jnp.asarray(x, jnp.uint32)
+    y = jnp.asarray(y, jnp.uint32)
+    xl, xh = x & 0xFFFF, x >> 16
+    yl, yh = y & 0xFFFF, y >> 16
+    ll = xl * yl
+    lh = xl * yh
+    hl = xh * yl
+    hh = xh * yh
+    cross = (ll >> 16) + (lh & 0xFFFF) + (hl & 0xFFFF)
+    return hh + (lh >> 16) + (hl >> 16) + (cross >> 16)
+
+
+def _c64_add(a: _C64, b: _C64, unsigned: bool) -> _C64:
+    lo = a.lo + b.lo
+    carry = (lo < a.lo).astype(jnp.uint32)
+    return _C64(lo, a.hi + b.hi + carry, unsigned)
+
+
+def _c64_neg(a: _C64) -> _C64:
+    return _c64_add(_C64(~a.lo, ~a.hi, a.unsigned),
+                    _C64(1, 0, a.unsigned), a.unsigned)
+
+
+def _c64_mul(a: _C64, b: _C64, unsigned: bool) -> _C64:
+    # Product mod 2^64: lo-lo full product + cross terms into hi.
+    lo = a.lo * b.lo
+    hi = _mulhi_u32(a.lo, b.lo) + a.lo * b.hi + a.hi * b.lo
+    return _C64(lo, hi, unsigned)
+
+
+def _c64_shl(a: _C64, s) -> _C64:
+    s = jnp.asarray(s, jnp.uint32) & 63
+    sl = jnp.clip(s, 0, 31)
+    sr = jnp.clip(32 - s.astype(jnp.int32), 0, 31).astype(jnp.uint32)
+    lo_small = a.lo << sl
+    hi_small = (a.hi << sl) | jnp.where(s > 0, a.lo >> sr, jnp.uint32(0))
+    big = jnp.clip(s - 32, 0, 31)
+    lo = jnp.where(s < 32, lo_small, jnp.uint32(0))
+    hi = jnp.where(s < 32, hi_small, a.lo << big)
+    return _C64(lo, hi, a.unsigned)
+
+
+def _c64_shr(a: _C64, s) -> _C64:
+    """C >> on the 64-bit value: logical for unsigned, arithmetic for
+    signed (the left operand's type governs, C11 6.5.7)."""
+    s = jnp.asarray(s, jnp.uint32) & 63
+    sl = jnp.clip(s, 0, 31)
+    sr = jnp.clip(32 - s.astype(jnp.int32), 0, 31).astype(jnp.uint32)
+    fill = (jnp.uint32(0) if a.unsigned else
+            jnp.where(a.hi.astype(jnp.int32) < 0,
+                      jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
+    hi_sh = ((a.hi >> sl) if a.unsigned
+             else (a.hi.astype(jnp.int32) >> sl.astype(jnp.int32)
+                   ).astype(jnp.uint32))
+    lo_small = (a.lo >> sl) | jnp.where(s > 0, a.hi << sr, jnp.uint32(0))
+    big = jnp.clip(s - 32, 0, 31)
+    lo_big = ((a.hi >> big) if a.unsigned
+              else (a.hi.astype(jnp.int32) >> big.astype(jnp.int32)
+                    ).astype(jnp.uint32))
+    lo = jnp.where(s < 32, lo_small, lo_big)
+    hi = jnp.where(s < 32, hi_sh, fill)
+    return _C64(lo, hi, a.unsigned)
+
+
+def _c64_lt(a: _C64, b: _C64, unsigned: bool):
+    if unsigned:
+        hi_lt = jnp.less(a.hi, b.hi)
+        hi_eq = jnp.equal(a.hi, b.hi)
+    else:
+        hi_lt = jnp.less(a.hi.astype(jnp.int32), b.hi.astype(jnp.int32))
+        hi_eq = jnp.equal(a.hi, b.hi)
+    return jnp.logical_or(hi_lt, jnp.logical_and(hi_eq,
+                                                 jnp.less(a.lo, b.lo)))
+
+
+class _CType64(_CType):
+    """``long long`` on the limb-pair model (no memory layout: 64-bit
+    GLOBALS/arrays are outside the word-addressed injection map and
+    refuse at declaration; 64-bit LOCALS are register values)."""
+
+    def __init__(self, unsigned: bool = False):
+        super().__init__(jnp.uint32, 64, unsigned)
+
+    def store(self, v):
+        # Extension is governed by the SOURCE's signedness (in _to64);
+        # the declared type only sets the result's signedness.
+        v64 = _to64(v)
+        return _C64(v64.lo, v64.hi, self.unsigned)
+
+    def zero(self):
+        return _C64(0, 0, self.unsigned)
+
 
 def _ctype_of(names: List[str], typedefs: Dict[str, object]) -> _CType:
-    """ILP32 _CType for a declared type-name list."""
+    """ILP32 _CType for a declared type-name list (``long long`` -> the
+    64-bit limb-pair type)."""
     for n in names:
         if n in typedefs:
             return typedefs[n]
@@ -386,6 +527,8 @@ def _ctype_of(names: List[str], typedefs: Dict[str, object]) -> _CType:
     # Plain char is UNSIGNED on the reference's ARM targets (AAPCS).
     if "char" in names and "signed" not in names:
         uns = True
+    if names.count("long") >= 2:
+        return _CType64(uns)
     bits = 32
     for n in names:
         if n in _NARROW:
@@ -558,6 +701,10 @@ class _Compiler:
                 uns = ("u" in node.value.lower()
                        or (base > 0x7FFFFFFF
                            and v.lower().startswith("0")))
+                if base > 0xFFFFFFFF:
+                    # Literal outside 32 bits: a long long constant.
+                    return _C64(base & 0xFFFFFFFF,
+                                (base >> 32) & 0xFFFFFFFF, uns)
                 return (jnp.uint32(base & 0xFFFFFFFF) if uns
                         else jnp.int32(np.int32(base & 0xFFFFFFFF)))
             raise CLiftError(f"unsupported constant type {node.type!r}")
@@ -626,6 +773,8 @@ class _Compiler:
             bz = jnp.not_equal(jnp.asarray(b), 0)
             r = jnp.logical_and(az, bz) if op == "&&" else jnp.logical_or(az, bz)
             return r.astype(jnp.int32)
+        if isinstance(a, _C64) or isinstance(b, _C64):
+            return self._binop64(op, a, b, node)
         a, b = self._usual_conv(a, b)
         if op == "+":
             return a + b
@@ -654,6 +803,50 @@ class _Compiler:
             return cmp(a, b).astype(jnp.int32)
         raise CLiftError(f"unsupported binary op {op!r} at {node.coord}")
 
+    def _binop64(self, op, a, b, node):
+        """Binary ops with a 64-bit (limb-pair) operand."""
+        if op in ("<<", ">>"):
+            # The SHIFT COUNT is not subject to the usual conversions:
+            # a << amount keeps a's type; the amount reduces to int.
+            a64 = _to64(a)
+            s = b.lo if isinstance(b, _C64) else jnp.asarray(b, jnp.uint32)
+            return _c64_shl(a64, s) if op == "<<" else _c64_shr(a64, s)
+        a64, b64 = _to64(a), _to64(b)
+        unsigned = a64.unsigned or b64.unsigned
+        if op == "+":
+            return _c64_add(a64, b64, unsigned)
+        if op == "-":
+            return _c64_add(a64, _c64_neg(b64), unsigned)
+        if op == "*":
+            return _c64_mul(a64, b64, unsigned)
+        if op == "&":
+            return _C64(a64.lo & b64.lo, a64.hi & b64.hi, unsigned)
+        if op == "|":
+            return _C64(a64.lo | b64.lo, a64.hi | b64.hi, unsigned)
+        if op == "^":
+            return _C64(a64.lo ^ b64.lo, a64.hi ^ b64.hi, unsigned)
+        if op == "==":
+            return jnp.logical_and(jnp.equal(a64.lo, b64.lo),
+                                   jnp.equal(a64.hi, b64.hi)
+                                   ).astype(jnp.int32)
+        if op == "!=":
+            return jnp.logical_or(jnp.not_equal(a64.lo, b64.lo),
+                                  jnp.not_equal(a64.hi, b64.hi)
+                                  ).astype(jnp.int32)
+        if op == "<":
+            return _c64_lt(a64, b64, unsigned).astype(jnp.int32)
+        if op == ">":
+            return _c64_lt(b64, a64, unsigned).astype(jnp.int32)
+        if op == "<=":
+            return jnp.logical_not(_c64_lt(b64, a64, unsigned)
+                                   ).astype(jnp.int32)
+        if op == ">=":
+            return jnp.logical_not(_c64_lt(a64, b64, unsigned)
+                                   ).astype(jnp.int32)
+        raise CLiftError(
+            f"unsupported 64-bit binary op {op!r} at {node.coord} "
+            "(long long supports + - * & | ^ << >> and comparisons)")
+
     def _unop(self, node, sc):
         op = node.op
         if op in ("++", "p++", "--", "p--"):
@@ -675,6 +868,17 @@ class _Compiler:
         if op == "sizeof":
             return jnp.int32(self._sizeof(node.expr, sc))
         v = self.eval(node.expr, sc)
+        if isinstance(v, _C64):
+            if op == "-":
+                return _c64_neg(v)
+            if op == "+":
+                return v
+            if op == "~":
+                return _C64(~v.lo, ~v.hi, v.unsigned)
+            if op == "!":
+                return jnp.equal(v.lo | v.hi, 0).astype(jnp.int32)
+            raise CLiftError(
+                f"unsupported unary op {op!r} on long long at {node.coord}")
         if op == "-":
             return -v
         if op == "+":
@@ -1271,6 +1475,11 @@ class _Compiler:
                     t = t.type
                 ct = _ctype_of(getattr(t.type, "names", ["int"]),
                                self.typedefs)
+                if isinstance(ct, _CType64):
+                    raise CLiftError(
+                        f"long long array {stmt.name!r} at {stmt.coord}: "
+                        "64-bit elements are outside the word-addressed "
+                        "memory model (locals only)")
                 arr = jnp.zeros(tuple(dims), ct.dtype)
                 if stmt.init is not None:
                     if not isinstance(stmt.init, c_ast.InitList):
@@ -1302,7 +1511,7 @@ class _Compiler:
             ct = _ctype_of(getattr(stmt.type.type, "names", ["int"]),
                            self.typedefs)
             val = (ct.store(self.eval(stmt.init, sc))
-                   if stmt.init is not None else jnp.zeros((), ct.dtype))
+                   if stmt.init is not None else ct.zero())
             sc.locals[stmt.name] = val
             sc.ctypes[stmt.name] = ct
             return None
@@ -2115,6 +2324,11 @@ def _parse_globals(tu, typedefs):
                 "with a string-literal initializer is modeled)")
         if isinstance(t, c_ast.TypeDecl):
             ct = _ctype_of(t.type.names, typedefs)
+            if isinstance(ct, _CType64):
+                raise CLiftError(
+                    f"long long global {ext.name!r}: 64-bit words are "
+                    "outside the word-addressed memory model (use "
+                    "uint32 limb pairs, as the dfkernels models do)")
         else:
             raise CLiftError(f"unsupported global type for {ext.name}")
         if ext.init is not None:
