@@ -91,6 +91,11 @@ class _Gen:
             g.append(f"{ctype} a{ai}[{size}] = {{{init}}};")
         g.append("unsigned int acc0 = 0;")
         g.append("unsigned int acc1 = 1;")
+        # A global array for pointer re-seating (local pointers may only
+        # seat on globals; seating on a LOCAL array refuses by design).
+        g.append("unsigned int rs[6] = {"
+                 + ", ".join(str(r.randrange(1, 500))
+                             for _ in range(6)) + "};")
         # Named 'b' ON PURPOSE: it collides with MIXM's second parameter,
         # so passing it as the FIRST argument pins simultaneous (non-
         # sequential) macro substitution.
@@ -178,7 +183,40 @@ class _Gen:
                         f"({name}, {r.randrange(1, size + 1)});")
             if r.random() < 0.5:
                 body.append(f"  acc0 ^= MIXM(acc1, {r.randrange(0, 99)});")
-        # Checksums: the whole written state becomes observable output.
+        # switch dispatch in a loop: stacked labels, a default, and
+        # break-terminated cases -- the desugared if-chain must match
+        # C's dispatch exactly, including the evaluate-once control.
+        mask = r.choice([3, 7])
+        body.append(f"  for (i = 0; i < {lsize}; i++) {{ "
+                    f"switch (lbuf[i] & {mask}u) {{ "
+                    f"case 0: case 1: acc0 += {r.randrange(1, 99)}u; break; "
+                    f"case 2: acc1 ^= acc0 + (unsigned int)i; break; "
+                    f"case 3: acc0 ^= acc1 >> {r.randrange(1, 5)}; break; "
+                    f"default: acc1 += 3u; break; }} }}")
+        # do..while: body-first execution, side-effected counter.
+        body.append(f"  {{ unsigned int dwc = {r.randrange(1, 6)}u; "
+                    f"do {{ acc0 += dwc * 7u; dwc--; }} "
+                    f"while (dwc != 0u); }}")
+        # long long round trip: signed and unsigned 32x32->64 products
+        # with both halves extracted (the limb-pair model vs gcc's
+        # native 64-bit arithmetic).
+        body.append(f"  {{ long long h; unsigned long long u; "
+                    f"h = (long long)(int)acc0 * "
+                    f"(long long)(int)(acc1 ^ {r.randrange(1, 999)}u); "
+                    f"acc0 ^= (unsigned int)(h & 0x00000000ffffffffULL); "
+                    f"acc1 += (unsigned int)(h >> 32); "
+                    f"u = (unsigned long long)acc0 * "
+                    f"(unsigned long long)b; "
+                    f"acc0 += (unsigned int)(u >> 32); "
+                    f"acc1 ^= (unsigned int)(u & 0xffffffffULL); }}")
+        # Pointer re-seating on a global: seat, walk, re-seat, index.
+        body.append(f"  {{ unsigned int *rp; rp = rs; "
+                    f"acc0 += *rp++; rp = rp + {r.randrange(1, 3)}; "
+                    f"acc1 ^= *rp; rp = rs; acc0 += rp[{r.randrange(0, 5)}]"
+                    f" + rp[1]; *rp = acc0 & 1023u; }}")
+        # Checksums: the whole written state becomes observable output
+        # (rs included -- the re-seating block deref-stores into it).
+        self.arrays.append(("rs", "unsigned int", 6))
         for name, _, size in self.arrays:
             body.append(f"  {{ unsigned int chk = 0; "
                         f"for (i = 0; i < {size}; i++) "
