@@ -150,10 +150,24 @@ def lift_step(name: str,
               default_xmr: bool = True,
               graph: Optional[BlockGraph] = None,
               step_cap: int = 1 << 16,
+              functions: Optional[Dict[str, Callable]] = None,
               meta: Optional[dict] = None) -> Region:
     """Derive a Region from a stepped user function.  Only ``step``,
-    ``init`` (dict of arrays, or a callable) and ``done`` are required."""
+    ``init`` (dict of arrays, or a callable) and ``done`` are required.
+
+    ``functions`` enables the MULTI-FUNCTION form: ``step(state, t,
+    fns)`` calling named sub-functions through the ``fns`` namespace
+    (the function-scope unit of the reference's -ignoreFns/-cloneFns
+    lists).  Classification and step measurement run with the raw
+    functions bound, exactly as Region.bound_step() does for analysis
+    passes; the derived Region keeps the 3-arg step + namespace so the
+    protection engine can wrap each function per its scope class."""
     init_fn = init if callable(init) else (lambda: dict(init))
+    user_step = step
+    if functions:
+        from coast_tpu.ir.region import FnNamespace
+        _raw_ns = FnNamespace(dict(functions))
+        step = lambda s, t: user_step(s, t, _raw_ns)  # noqa: E731
     state = jax.eval_shape(init_fn)
     if not isinstance(state, dict):
         raise LiftError("init must produce a flat dict of arrays "
@@ -212,7 +226,7 @@ def lift_step(name: str,
     region = Region(
         name=name,
         init=init_fn,
-        step=step,
+        step=user_step if functions else step,
         done=done,
         check=check,
         output=output,
@@ -221,6 +235,7 @@ def lift_step(name: str,
         spec=spec,
         default_xmr=default_xmr,
         graph=graph,
+        functions=dict(functions or {}),
         meta={"lifted": True, **(meta or {})},
     )
     region.validate()

@@ -13,9 +13,10 @@ For every model in the matrix:
     extended from 3 models to more than half the registry.
 
 nestedCalls / rtos_app use the multi-function step signature
-``step(s, t, fns)`` (function-scope machinery); lift_step's contract is
-the plain stepped form, so they are out of scope here and covered by
-tests/test_fn_scope.py.
+``step(s, t, fns)`` (function-scope machinery); lift_step's
+``functions=`` form re-derives them too, so the matrix covers the full
+registry minus the mm1024 flagship aliases (same region family as
+matrixMultiply256 at different shapes).
 """
 
 import jax
@@ -59,6 +60,9 @@ MATRIX = {
     "chstone_mips": ("pc", "n_inst", "hi", "lo"),
     "chstone_adpcm": ("accumd", "enc_s", "dec_s", "i"),
     "chstone_gsm": ("l_acf", "p", "larc", "scal"),
+    # -- multi-function step(s, t, fns) form (function-scope unit) ---------
+    "nestedCalls": ("acc",),
+    "rtos_app": ("ring", "uart", "seed", "depth"),
 }
 
 # Keep the fast tier fast: the heavyweight CHStone kernels run their
@@ -79,7 +83,8 @@ def _relift(hand, annotated_leaves):
     lifted = lift_step(
         hand.name + "_lifted", hand.step, hand.init, done=hand.done,
         check=hand.check, output=hand.output, max_steps=hand.max_steps,
-        annotations=annotations, default_xmr=hand.default_xmr, meta=meta)
+        annotations=annotations, default_xmr=hand.default_xmr,
+        functions=hand.functions, meta=meta)
     lifted.spec = {k: lifted.spec[k] for k in hand.spec}
     return lifted
 
