@@ -587,6 +587,13 @@ class _Scope:
         self.ptrs: set = set()                  # declared pointer locals
         self.ctypes: Dict[str, _CType] = dict(ctypes or {})
         self.printed: List[jax.Array] = []
+        # Constant shadow environment: scalar names whose CURRENT value
+        # is a compile-time-known int.  Inside jax.make_jaxpr every jnp
+        # value -- literals included -- is an abstract tracer, so
+        # trace-time control decisions (statically-taken branches,
+        # print-loop bounds) need classic constant propagation on the
+        # side.  Absent = unknown; every traced write invalidates.
+        self.consts: Dict[str, int] = {}
 
     def fork(self, no_print_at=None, no_print_reason=None):
         """Child scope for a traced sub-region (loop body/cond, branch).
@@ -598,6 +605,7 @@ class _Scope:
         sub.locals = dict(self.locals)
         sub.aliases = dict(self.aliases)
         sub.ptrs = set(self.ptrs)
+        sub.consts = dict(self.consts)
         sub.printed = (self.printed if no_print_at is None
                        else _NoPrintList(no_print_at, no_print_reason))
         return sub
@@ -659,9 +667,29 @@ def _const_int(node) -> Optional[int]:
     # pycparser types suffixed literals "unsigned int"/"long int"/etc.
     if isinstance(node, c_ast.Constant) and "int" in node.type:
         return int(node.value.rstrip("uUlL"), 0)
-    if isinstance(node, c_ast.UnaryOp) and node.op == "-":
+    if isinstance(node, c_ast.UnaryOp) and node.op in ("-", "+", "~"):
         v = _const_int(node.expr)
-        return -v if v is not None else None
+        if v is None:
+            return None
+        return {"-": -v, "+": v, "~": ~v}[node.op]
+    if isinstance(node, c_ast.BinaryOp):
+        # Constant folding for dimension/label expressions (blowfish's
+        # `BF_ROUNDS + 2`); division is C truncation toward zero.
+        a, b = _const_int(node.left), _const_int(node.right)
+        if a is None or b is None:
+            return None
+        try:
+            return {
+                "+": lambda: a + b, "-": lambda: a - b,
+                "*": lambda: a * b,
+                "/": lambda: int(a / b) if b else None,
+                "%": lambda: a - int(a / b) * b if b else None,
+                "<<": lambda: a << b, ">>": lambda: a >> b,
+                "&": lambda: a & b, "|": lambda: a | b,
+                "^": lambda: a ^ b,
+            }[node.op]()
+        except KeyError:
+            return None
     return None
 
 
@@ -683,7 +711,139 @@ class _Compiler:
         self._desugared: set = set()
         self._print_slots: Dict[int, List[Tuple[str, int]]] = {}
         self._sw_temps: Dict[int, List[str]] = {}
+        self._assigned_globals_cache: Dict[int, List[str]] = {}
         self.print_strings: List[str] = []     # slot id -> format string
+
+    # -- trace-time constant propagation -----------------------------------
+    @staticmethod
+    def _wrap32(v: int) -> int:
+        """Canonical signed-32 representation of a mod-2^32 value."""
+        v &= 0xFFFFFFFF
+        return v - (1 << 32) if v >= 0x80000000 else v
+
+    @staticmethod
+    def _has_effects(node) -> bool:
+        """Does evaluating ``node`` have side effects (writes/calls)?"""
+        found: List[object] = []
+
+        class V(c_ast.NodeVisitor):
+            def visit_Assignment(v, n):
+                found.append(n)
+
+            def visit_FuncCall(v, n):
+                found.append(n)
+
+            def visit_UnaryOp(v, n):
+                if n.op in ("++", "p++", "--", "p--"):
+                    found.append(n)
+                v.generic_visit(n)
+
+        if node is not None:
+            V().visit(node)
+        return bool(found)
+
+    def _const_eval(self, node, sc: _Scope) -> Optional[int]:
+        """Compile-time value of a PURE expression, or None if unknown.
+
+        Conservative by construction: every fold either matches the C
+        (ILP32) result exactly or returns None -- ordered comparisons
+        and ``>>`` bail out when a sign-domain ambiguity could flip the
+        result.  Values are kept in canonical signed-32 form."""
+        if isinstance(node, c_ast.Constant):
+            if "char" in node.type and node.value.startswith("'"):
+                body = node.value[1:-1].encode().decode("unicode_escape")
+                return ord(body)
+            if "int" in node.type:
+                v = int(node.value.rstrip("uUlL"), 0)
+                return self._wrap32(v) if v <= 0xFFFFFFFF else None
+            return None
+        if isinstance(node, c_ast.ID):
+            return sc.consts.get(node.name)
+        if isinstance(node, c_ast.Cast):
+            if isinstance(node.to_type.type, c_ast.PtrDecl):
+                return None
+            v = self._const_eval(node.expr, sc)
+            if v is None:
+                return None
+            ct = _ctype_of(node.to_type.type.type.names, self.typedefs)
+            if isinstance(ct, _CType64):
+                return None
+            return self._norm_const(ct, v)
+        if isinstance(node, c_ast.UnaryOp):
+            if node.op not in ("-", "+", "~", "!"):
+                return None
+            v = self._const_eval(node.expr, sc)
+            if v is None:
+                return None
+            if node.op == "!":
+                return int(v == 0)
+            return self._wrap32({"-": -v, "+": v, "~": ~v}[node.op])
+        if isinstance(node, c_ast.TernaryOp):
+            c = self._const_eval(node.cond, sc)
+            if c is None:
+                return None
+            return self._const_eval(node.iftrue if c else node.iffalse, sc)
+        if isinstance(node, c_ast.BinaryOp):
+            a = self._const_eval(node.left, sc)
+            if a is None:
+                return None
+            if node.op in ("&&", "||"):
+                if node.op == "&&" and a == 0:
+                    return 0
+                if node.op == "||" and a != 0:
+                    return 1
+                b = self._const_eval(node.right, sc)
+                return None if b is None else int(b != 0)
+            b = self._const_eval(node.right, sc)
+            if b is None:
+                return None
+            op = node.op
+            if op in ("==", "!="):
+                eq = (a & 0xFFFFFFFF) == (b & 0xFFFFFFFF)
+                return int(eq if op == "==" else not eq)
+            if op in ("<", ">", "<=", ">="):
+                # int vs unsigned compare agree only when both
+                # operands are non-negative in the signed view.
+                if a < 0 or b < 0:
+                    return None
+                return int({"<": a < b, ">": a > b,
+                            "<=": a <= b, ">=": a >= b}[op])
+            if op == ">>":
+                if a < 0:
+                    return None          # arithmetic-vs-logical ambiguity
+                return a >> (b & 31)
+            if op == "<<":
+                return self._wrap32(a << (b & 31))
+            if op in ("+", "-", "*", "&", "|", "^"):
+                return self._wrap32({"+": a + b, "-": a - b, "*": a * b,
+                                     "&": a & b, "|": a | b,
+                                     "^": a ^ b}[op])
+            if op in ("/", "%"):
+                # C truncates toward zero; Python floors -- fold only
+                # the unambiguous non-negative case.
+                if a < 0 or b <= 0:
+                    return None
+                return a // b if op == "/" else a % b
+            return None
+        return None
+
+    @staticmethod
+    def _norm_const(ct: _CType, v: int) -> int:
+        """C conversion of a known value into the declared type."""
+        mask = (1 << ct.bits) - 1
+        v &= mask
+        if not ct.unsigned and v >= (1 << (ct.bits - 1)):
+            v -= 1 << ct.bits
+        return v
+
+    def _const_set(self, sc: _Scope, name: str, v: Optional[int],
+                   ct: Optional[_CType] = None) -> None:
+        if v is None:
+            sc.consts.pop(name, None)
+        else:
+            if ct is not None and not isinstance(ct, _CType64):
+                v = self._norm_const(ct, v)
+            sc.consts[name] = v
 
     # -- expressions -------------------------------------------------------
     def eval(self, node, sc: _Scope):
@@ -744,6 +904,11 @@ class _Compiler:
         if isinstance(node, c_ast.FuncCall):
             return self._call(node, sc)
         if isinstance(node, c_ast.Cast):
+            if isinstance(node.to_type.type, c_ast.PtrDecl):
+                raise CLiftError(
+                    f"pointer cast in value position at {node.coord}; "
+                    "pointer casts are modeled only where a pointer "
+                    "flows (seatings, call arguments, derefs)")
             ct = _ctype_of(node.to_type.type.type.names, self.typedefs)
             # C cast semantics: value converted to the target type --
             # truncate + re-sign for narrow targets, plain dtype change
@@ -765,9 +930,11 @@ class _Compiler:
         return a.astype(jnp.int32), b.astype(jnp.int32)
 
     def _binop(self, node, sc):
-        op = node.op
         a = self.eval(node.left, sc)
         b = self.eval(node.right, sc)
+        return self._apply_binop(node.op, a, b, node)
+
+    def _apply_binop(self, op, a, b, node):
         if op in ("&&", "||"):
             az = jnp.not_equal(jnp.asarray(a), 0)
             bz = jnp.not_equal(jnp.asarray(b), 0)
@@ -855,6 +1022,13 @@ class _Compiler:
             delta = jnp.asarray(1, old.dtype)
             new = old + delta if "++" in op else old - delta
             self._store(name, new, sc)
+            if isinstance(name, c_ast.ID):
+                prev = sc.consts.get(name.name)
+                self._const_set(
+                    sc, name.name,
+                    None if prev is None else
+                    self._wrap32(prev + (1 if "++" in op else -1)),
+                    sc.ctype(name.name))
             return old if op.startswith("p") else new
         if op == "*":
             base, off = self._ptr_parts(node.expr, sc)
@@ -1076,15 +1250,67 @@ class _Compiler:
             base, off = self._ptr_parts(node.rvalue, sc)
             sc.aliases[name] = base
             sc.locals[name] = jnp.asarray(off, jnp.int32)
+            sc.consts.pop(name, None)
             return off
         if op == "=":
+            const = (self._const_eval(node.rvalue, sc)
+                     if isinstance(node.lvalue, c_ast.ID) else None)
             val = self.eval(node.rvalue, sc)
-        else:                               # += -= *= ^= ... read-mod-write
-            bin_op = op[:-1]
-            fake = c_ast.BinaryOp(bin_op, node.lvalue, node.rvalue,
-                                  node.coord)
-            val = self._binop(fake, sc)
+            self._store(node.lvalue, val, sc)
+            if isinstance(node.lvalue, c_ast.ID):
+                self._const_set(sc, node.lvalue.name, const,
+                                sc.ctype(node.lvalue.name))
+            return val
+        # Compound assignment (+= <<= ...): the lvalue designates ONE
+        # location, evaluated ONCE (C11 6.5.16.2) -- a side-effecting
+        # lvalue like GSM's rescale `*s++ <<= scalauto` must advance the
+        # cursor exactly once, with read and store hitting the SAME
+        # element (the old fake-binop path re-evaluated it for the
+        # store, double-stepping the cursor).
+        bin_op = op[:-1]
+        lhs = node.lvalue
+        if isinstance(lhs, c_ast.UnaryOp) and lhs.op == "*":
+            base, off = self._ptr_parts(lhs.expr, sc)   # effects, once
+            arr = sc.g[base]
+            flat = arr.reshape(-1) if jnp.ndim(arr) > 1 else arr
+            ct = sc.ctypes.get(base)
+            old = flat[off]
+            if ct is not None and ct.bits < 32:
+                old = ct.store(old)
+            val = self._apply_binop(bin_op, old,
+                                    self.eval(node.rvalue, sc), node)
+            stored = (ct.store(val) if ct is not None
+                      else jnp.asarray(val).astype(arr.dtype))
+            new = flat.at[off].set(stored.astype(arr.dtype))
+            if jnp.ndim(arr) > 1:
+                new = new.reshape(jnp.shape(arr))
+            sc.write_binding(base, new)
+            return val
+        if isinstance(lhs, c_ast.ArrayRef):
+            arr, idx, base = self._array_path(lhs, sc)  # subscripts, once
+            ct = sc.ctype(base)
+            old = arr[idx]
+            if ct is not None and ct.bits < 32:
+                old = ct.store(old)
+            val = self._apply_binop(bin_op, old,
+                                    self.eval(node.rvalue, sc), node)
+            stored = (ct.store(val) if ct is not None
+                      else jnp.asarray(val).astype(arr.dtype))
+            new = arr.at[idx].set(stored.astype(arr.dtype))
+            orig = sc.read_binding(base)
+            if jnp.shape(new) != jnp.shape(orig):
+                new = new.reshape(jnp.shape(orig))
+            sc.write_binding(base, new)
+            return val
+        # Plain identifier lvalue: no side effects to duplicate.
+        fake = c_ast.BinaryOp(bin_op, node.lvalue, node.rvalue, node.coord)
+        const = (self._const_eval(fake, sc)
+                 if isinstance(node.lvalue, c_ast.ID) else None)
+        val = self._binop(fake, sc)
         self._store(node.lvalue, val, sc)
+        if isinstance(node.lvalue, c_ast.ID):
+            self._const_set(sc, node.lvalue.name, const,
+                            sc.ctype(node.lvalue.name))
         return val
 
     def _call(self, node, sc):
@@ -1102,6 +1328,27 @@ class _Compiler:
         # already-aliased) global array binds the parameter to that global.
         args = []
         for a in arg_nodes:
+            # A pointer CAST on an argument changes the static type only
+            # ((unsigned char *)ivec): unwrap it and bind the underlying
+            # array/pointer as usual.
+            while (isinstance(a, c_ast.Cast)
+                   and isinstance(a.to_type.type, c_ast.PtrDecl)):
+                a = a.expr
+            if isinstance(a, c_ast.UnaryOp) and a.op == "&":
+                inner = a.expr
+                if (isinstance(inner, c_ast.ID) and inner.name in sc.locals
+                        and inner.name not in sc.aliases
+                        and jnp.ndim(sc.locals[inner.name]) == 0):
+                    # Scalar out-parameter (&num, blowfish's cfb64 state):
+                    # copy-in/copy-out through a 1-word transient slot,
+                    # like caller-local arrays.
+                    args.append(("__alias_scalar_local__", inner.name))
+                    continue
+                # &arr[k] / &glob: a pointer value -- forward base+offset.
+                base, off = self._ptr_parts(a, sc)
+                args.append(("__alias_off__", base,
+                             jnp.asarray(off, jnp.int32)))
+                continue
             if isinstance(a, c_ast.ID):
                 if (a.name in sc.locals and a.name not in sc.aliases
                         and jnp.ndim(sc.locals[a.name]) >= 1):
@@ -1133,7 +1380,11 @@ class _Compiler:
         if fn is None:
             raise CLiftError(f"call to undefined function {fname!r} "
                              f"at {node.coord}")
-        return self._run_function(fn, args, sc)
+        arg_consts = [None if isinstance(v, tuple)
+                      or self._has_effects(n2)
+                      else self._const_eval(n2, sc)
+                      for n2, v in zip(arg_nodes, args)]
+        return self._run_function(fn, args, sc, arg_consts)
 
     def _walked_names(self, node) -> set:
         """Names subject to POINTER arithmetic: ++/--/assignment on the
@@ -1356,28 +1607,55 @@ class _Compiler:
 
         fndef.body = xform_block(fndef.body, False)
 
-    def _run_function(self, fndef, args, outer_sc: _Scope):
+    def _run_function(self, fndef, args, outer_sc: _Scope,
+                      arg_consts: Optional[List[Optional[int]]] = None):
         self._desugar_fn(fndef)
         fid = id(fndef)
         sc = _Scope(outer_sc.g, self.g_ctypes)
         sc.printed = outer_sc.printed       # printf threads through
+        # Known-constant GLOBALS flow into the callee (locals shadowing
+        # a global keep their constness out of it).
+        sc.consts = {n: v for n, v in outer_sc.consts.items()
+                     if n not in outer_sc.locals}
         for nm, _k in self._print_slots.get(fid, ()):
             sc.locals[nm] = jnp.int32(-1)   # -1 = this line never printed
+            sc.consts[nm] = -1
         for nm in self._sw_temps.get(fid, ()):
             sc.locals[nm] = jnp.int32(0)
+            sc.consts.pop(nm, None)
         params = []
         decl = fndef.decl.type
         if decl.args:
             params = [p for p in decl.args.params
                       if not isinstance(p, c_ast.EllipsisParam)
-                      and p.name is not None]
+                      and getattr(p, "name", None) is not None]
+            if getattr(fndef, "param_decls", None):
+                # K&R-style definition (blowfish's OpenSSL-vintage
+                # `void BF_encrypt(data, key) BF_LONG *data; ...`):
+                # the identifier list carries bare IDs; the real Decls
+                # live in param_decls.
+                by_name = {d.name: d for d in fndef.param_decls}
+                params = [by_name.get(p.name, p) for p in params]
         if len(params) != len(args):
             raise CLiftError(
                 f"{fndef.decl.name}: {len(args)} args for {len(params)} "
                 "parameters (array parameters pass the global by name)")
         walked = self._walked_names(fndef.body)
         copy_backs: List[Tuple[str, str]] = []
-        for p, a in zip(params, args):
+        scalar_backs: List[Tuple[str, str]] = []
+        for pi, (p, a) in enumerate(zip(params, args)):
+            if (isinstance(a, tuple) and len(a) == 2
+                    and a[0] == "__alias_scalar_local__"):
+                temp = f"__loc{self._tmp}"
+                self._tmp += 1
+                sc.g[temp] = jnp.reshape(outer_sc.locals[a[1]], (1,))
+                oct_ = outer_sc.ctype(a[1])
+                if oct_ is not None:
+                    sc.ctypes[temp] = oct_
+                sc.aliases[p.name] = temp
+                sc.locals[p.name] = jnp.int32(0)
+                scalar_backs.append((temp, a[1]))
+                continue
             if (isinstance(a, tuple) and len(a) == 2
                     and a[0] == "__alias_local__"):
                 # Caller-local array passed by reference: copy into a
@@ -1416,10 +1694,15 @@ class _Compiler:
                     sc.ctypes[p.name] = ct
                 else:
                     sc.locals[p.name] = a
+                kc = arg_consts[pi] if arg_consts else None
+                self._const_set(sc, p.name, kc,
+                                ct if not isinstance(ct, _CType64)
+                                else None)
         new_items, set_n, val_n, synth = self._rewrite_early_returns(fndef)
         if new_items is not None:
             for n in synth:
                 sc.locals[n] = jnp.int32(0)
+                sc.consts[n] = 0
             self._exec_block(
                 c_ast.Compound(new_items, fndef.body.coord), sc)
             ret = sc.locals[val_n]
@@ -1427,6 +1710,21 @@ class _Compiler:
             ret = self._exec_block(fndef.body, sc)
         for temp, lname in copy_backs:
             outer_sc.locals[lname] = sc.g.pop(temp)
+        for temp, lname in scalar_backs:
+            outer_sc.locals[lname] = jnp.reshape(sc.g.pop(temp), ())
+            outer_sc.consts.pop(lname, None)   # written via the slot
+        # Global constness after the call: invalidate exactly the
+        # globals the callee may write (a callee-LOCAL shadowing a
+        # global -- AddRoundKey's `int j, nb;` -- must not kill the
+        # caller's knowledge of the global), then flow the callee's
+        # known globals back (its view of its own writes is the truth).
+        may_write = set(self._assigned_globals(fndef))
+        for n in list(outer_sc.consts):
+            if n not in outer_sc.locals and n in may_write:
+                outer_sc.consts.pop(n, None)
+        for n, v in sc.consts.items():
+            if n not in sc.locals and n not in outer_sc.locals:
+                outer_sc.consts[n] = v
         # A function's print slots join the output surface when it
         # returns (top-level call sites only: inside a traced loop the
         # printed sentinel refuses, as for any in-loop print).
@@ -1514,6 +1812,15 @@ class _Compiler:
                    if stmt.init is not None else ct.zero())
             sc.locals[stmt.name] = val
             sc.ctypes[stmt.name] = ct
+            if isinstance(ct, _CType64):
+                sc.consts.pop(stmt.name, None)
+            else:
+                # The model zero-initializes declared scalars, so a
+                # no-init local IS the constant 0 at this point.
+                self._const_set(
+                    sc, stmt.name,
+                    0 if stmt.init is None
+                    else self._const_eval(stmt.init, sc), ct)
             return None
         if isinstance(stmt, c_ast.DeclList):
             for d in stmt.decls:
@@ -1616,17 +1923,34 @@ class _Compiler:
 
             def visit_FuncCall(v, n):
                 # A called function may write globals directly or through
-                # an array-pointer parameter; conservatively treat every
-                # ID argument and every callee-assigned name as written
-                # (read-only extras become loop-invariant carries, which
-                # XLA hoists).
+                # an array-pointer parameter; treat ID arguments bound to
+                # POINTER/ARRAY parameters (and every callee-assigned
+                # name) as written.  Scalar by-value parameters cannot
+                # write the caller's variable -- and carrying them would
+                # also destroy trace-time concreteness (aes_enc.c's `nb`
+                # must stay concrete through the rounds loop for the
+                # ciphertext print loop's static bound).
                 if isinstance(n.name, c_ast.ID):
-                    for a in (n.args.exprs if n.args else []):
-                        if isinstance(a, c_ast.ID):
-                            names.append(a.name)
                     callee = self.funcs.get(n.name.name)
+                    params = []
+                    if (callee is not None
+                            and not getattr(callee, "param_decls", None)):
+                        decl = callee.decl.type
+                        if decl.args:
+                            params = [p for p in decl.args.params
+                                      if not isinstance(
+                                          p, c_ast.EllipsisParam)]
+                    for ai, a in enumerate(n.args.exprs if n.args else []):
+                        if not isinstance(a, c_ast.ID):
+                            continue
+                        if params and ai < len(params):
+                            pt = getattr(params[ai], "type", None)
+                            if not isinstance(pt, (c_ast.PtrDecl,
+                                                   c_ast.ArrayDecl)):
+                                continue    # by-value scalar
+                        names.append(a.name)
                     if callee is not None:
-                        names.extend(self._assigned_names(callee.body))
+                        names.extend(self._assigned_globals(callee))
                 v.generic_visit(n)
 
         V().visit(node)
@@ -1634,6 +1958,37 @@ class _Compiler:
         for p in dict.fromkeys(deref_targets):
             names.extend(seats.get(p, ()))
         return list(dict.fromkeys(names))
+
+    def _assigned_globals(self, fndef) -> List[str]:
+        """Names a callee writes OUTSIDE its own scope: its assigned
+        names minus its params and local declarations.  A callee-local
+        shadowing a global (AddRoundKey's `int j, nb;` vs the global
+        nb) must not count as a caller-side write -- it would both
+        over-carry and invalidate constant propagation."""
+        fid = id(fndef)
+        cached = self._assigned_globals_cache.get(fid)
+        if cached is not None:
+            return cached
+        self._assigned_globals_cache[fid] = []     # cut recursion cycles
+        names = self._assigned_names(fndef.body)
+        local: set = set()
+        decl = fndef.decl.type
+        if decl.args:
+            for p in decl.args.params:
+                nm = getattr(p, "name", None)
+                if nm:
+                    local.add(nm)
+
+        class V(c_ast.NodeVisitor):
+            def visit_Decl(v, n):
+                if n.name:
+                    local.add(n.name)
+                v.generic_visit(n)
+
+        V().visit(fndef.body)
+        out = [n for n in names if n not in local]
+        self._assigned_globals_cache[fid] = out
+        return out
 
     def written_globals(self, fndef, g_names, subst=None):
         """Globals (transitively) written by ``fndef``, following array-
@@ -2044,9 +2399,52 @@ class _Compiler:
         new_body = c_ast.Compound(body_stmts, stmt.stmt.coord)
         return c_ast.For(None, c_ast.ID(cnd), None, new_body, stmt.coord)
 
+    @staticmethod
+    def _contains_printf(node) -> bool:
+        found: List[object] = []
+
+        class V(c_ast.NodeVisitor):
+            def visit_FuncCall(v, n):
+                if isinstance(n.name, c_ast.ID) and n.name.name == "printf":
+                    found.append(n)
+                v.generic_visit(n)
+
+        V().visit(node)
+        return bool(found)
+
     def _exec_for(self, stmt, sc: _Scope):
         if stmt.init is not None:
             self._exec_stmt(stmt.init, sc)
+        # PRINT-ONLY loop (aes.c dumping the ciphertext bytes): a loop
+        # whose body writes nothing (beyond print slots) but prints
+        # per-iteration values.  Its observable IS the printed sequence,
+        # so it unrolls at trace time under a concrete bound -- each
+        # iteration's printf appends one program output.  A traced bound
+        # refuses loudly (the output arity must be static).
+        if (stmt.cond is not None and stmt.stmt is not None
+                and self._contains_printf(stmt.stmt)
+                and all(n.startswith("__print_sel_")
+                        for n in self._assigned_names(stmt.stmt))):
+            for _ in range(4096):
+                live = (self._const_eval(stmt.cond, sc)
+                        if not self._has_effects(stmt.cond) else None)
+                if live is None:
+                    raise CLiftError(
+                        f"print-only loop at {stmt.coord} has a traced "
+                        "bound; the number of printed outputs must be "
+                        "static")
+                if not live:
+                    return None
+                ret = self._exec_block(stmt.stmt, sc)
+                if ret is not None:
+                    raise CLiftError(
+                        f"return inside a loop at {stmt.coord}; "
+                        "restructure")
+                if stmt.next is not None:
+                    self.eval(stmt.next, sc)
+            raise CLiftError(
+                f"print-only loop at {stmt.coord} exceeds the 4096-"
+                "iteration unroll bound")
         stmt = self._rewrite_breaks(stmt, sc)
         self._preseat(stmt, sc)
         carry_names = self._loop_carry(stmt, sc)
@@ -2057,6 +2455,7 @@ class _Compiler:
         def unpack(sub_sc, vals):
             for n, v in zip(carry_names, vals):
                 sub_sc.write_binding(n, v)
+                sub_sc.consts.pop(n, None)   # traced write: value unknown
 
         trip = self._static_trip(stmt, sc)
         if trip is not None:
@@ -2211,8 +2610,21 @@ class _Compiler:
 
     def _exec_if(self, stmt, sc: _Scope):
         self._preseat(stmt, sc)
+        if not self._has_effects(stmt.cond):
+            kc = self._const_eval(stmt.cond, sc)
+            if kc is not None:
+                # Statically-decided predicate: execute only the taken
+                # branch INLINE (exact C semantics; keeps trace-time
+                # constants known -- aes_enc.c's switch on a literal
+                # `type` must yield a known nb for the ciphertext print
+                # loop -- and keeps prints in statically-taken branches
+                # legal program outputs).
+                node = stmt.iftrue if kc else stmt.iffalse
+                return (self._exec_block(node, sc)
+                        if node is not None else None)
+        cval = self.eval(stmt.cond, sc)      # cond effects apply once
         carry_names = self._loop_carry(stmt, sc)
-        c = jnp.not_equal(self.eval(stmt.cond, sc), 0)
+        c = jnp.not_equal(cval, 0)
 
         def branch(node):
             def run(vals):
@@ -2235,6 +2647,7 @@ class _Compiler:
                            vals)
         for n, v in zip(carry_names, out):
             sc.write_binding(n, v)
+            sc.consts.pop(n, None)           # traced write: value unknown
         return None
 
 
