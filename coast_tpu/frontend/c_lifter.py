@@ -223,9 +223,25 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
                 # replacement: sequential re.sub would re-substitute an
                 # argument that mentions a later parameter's name, and a
                 # string template would reinterpret backslashes in the
-                # argument ('\n' in a char constant).
-                amap = {p: f"({a.strip()})"
-                        for p, a in zip(params, args)}
+                # argument ('\n' in a char constant).  An argument that
+                # is already one parenthesized unit is not re-wrapped
+                # (_ANSI_ARGS_((void)) must yield (void), not ((void))).
+                def wrap_arg(s: str) -> str:
+                    s = s.strip()
+                    if s.startswith("(") and s.endswith(")"):
+                        depth = 0
+                        for k, ch in enumerate(s):
+                            if ch == "(":
+                                depth += 1
+                            elif ch == ")":
+                                depth -= 1
+                                if depth == 0 and k != len(s) - 1:
+                                    break
+                        else:
+                            return s
+                    return f"({s})"
+
+                amap = {p: wrap_arg(a) for p, a in zip(params, args)}
                 if amap:
                     pat = "|".join(rf"\b{re.escape(p)}\b" for p in amap)
                     sub = re.sub(pat, lambda m: amap[m.group(0)], body)
@@ -264,9 +280,69 @@ def preprocess(text: str, include_dirs: Sequence[str] = (),
         return re.sub(r"\x01(\d+)\x02", lambda m: lits[int(m.group(1))],
                       line)
 
-    for raw in text.splitlines():
+    def _paren_balance(s: str) -> int:
+        s = _LIT_RE.sub("", s)
+        return s.count("(") - s.count(")")
+
+    # Conditional-inclusion stack: [taking, evaluable, satisfied].
+    # #ifdef/#ifndef evaluate against the defines tables (motion's
+    # global.h selects the _ANSI_ARGS_ variant this way); other #if
+    # forms keep the legacy include-everything behavior
+    # (evaluable=False), their #else/#elif branches included too.
+    cond_stack: List[List[bool]] = []
+
+    lines_in = text.splitlines()
+    li = 0
+    while li < len(lines_in):
+        raw = lines_in[li]
+        li += 1
+        # A function-like macro call spanning lines (motion's
+        # _ANSI_ARGS_((int *PMV, ...) prototypes): join until balanced.
+        if (any(re.search(rf"\b{re.escape(n)}\s*\(", raw)
+                for n in fdefines)
+                and not raw.lstrip().startswith("#")):
+            guard = 0
+            while (_paren_balance(raw) > 0 and li < len(lines_in)
+                   and guard < 100):
+                raw += " " + lines_in[li]
+                li += 1
+                guard += 1
         line = raw
         stripped = line.strip()
+        if stripped.startswith("#"):
+            # cpp allows whitespace between # and the directive name
+            # (global.h's `#   define _ANSI_ARGS_(x) x`).
+            stripped = re.sub(r"^#\s+", "#", stripped)
+        if stripped.startswith("#ifdef") or stripped.startswith("#ifndef"):
+            m = re.match(r"#ifn?def\s+(\w+)", stripped)
+            if m:
+                known = (m.group(1) in defines or m.group(1) in fdefines)
+                taking = (known if stripped.startswith("#ifdef")
+                          else not known)
+                cond_stack.append([taking, True, taking])
+            else:
+                cond_stack.append([True, False, True])
+            continue
+        if stripped.startswith("#if"):
+            cond_stack.append([True, False, True])
+            continue
+        if stripped.startswith("#elif"):
+            if cond_stack and cond_stack[-1][1]:
+                if cond_stack[-1][2]:        # a branch was taken: skip rest
+                    cond_stack[-1][0] = False
+                else:                        # unknown #elif: legacy include
+                    cond_stack[-1] = [True, False, True]
+            continue
+        if stripped.startswith("#else"):
+            if cond_stack and cond_stack[-1][1]:
+                cond_stack[-1][0] = not cond_stack[-1][2]
+            continue
+        if stripped.startswith("#endif"):
+            if cond_stack:
+                cond_stack.pop()
+            continue
+        if not all(e[0] for e in cond_stack):
+            continue                          # skipped conditional branch
         if stripped.startswith("#include"):
             m = re.match(r'#include\s+"([^"]+)"', stripped)
             if m:
@@ -695,12 +771,19 @@ def _const_int(node) -> Optional[int]:
 
 class _Compiler:
     def __init__(self, tu, typedefs, funcs, name: str,
-                 g_ctypes: Optional[Dict[str, _CType]] = None):
+                 g_ctypes: Optional[Dict[str, _CType]] = None,
+                 g_ptrs: Optional[set] = None):
         self.tu = tu
         self.typedefs = typedefs
         self.funcs = funcs
         self.name = name
         self.g_ctypes = dict(g_ctypes or {})
+        # Global pointer variables: their int32 CURSOR lives in the
+        # globals dict (runtime, injectable state); the aliased base
+        # array is static, resolved at the first seating and required
+        # to stay the same (motion's ld_Rdptr over ld_Rdbfr).
+        self.g_ptrs: set = set(g_ptrs or ())
+        self.g_ptr_base: Dict[str, str] = {}
         self._tmp = 0          # transient copy-in/out slot counter
         # id(node) -> reason, for synthesized guard Ifs whose printf
         # refusal should name the REAL construct (pycparser nodes have
@@ -929,7 +1012,42 @@ class _Compiler:
             return a.astype(jnp.uint32), b.astype(jnp.uint32)
         return a.astype(jnp.int32), b.astype(jnp.int32)
 
+    def _ptrish(self, node, sc) -> bool:
+        """Is this expression a pointer value (decayed array, walked or
+        global pointer, &-expr, pointer +/- offset)?"""
+        if isinstance(node, c_ast.ID):
+            if node.name in sc.aliases:
+                return True
+            if (node.name in self.g_ptrs
+                    and node.name not in sc.locals):
+                return True
+            tgt = node.name
+            return tgt in sc.g and jnp.ndim(sc.g[tgt]) >= 1
+        if isinstance(node, c_ast.Cast):
+            return (isinstance(node.to_type.type, c_ast.PtrDecl)
+                    and self._ptrish(node.expr, sc))
+        if isinstance(node, c_ast.UnaryOp) and node.op == "&":
+            return True
+        if isinstance(node, c_ast.BinaryOp) and node.op in ("+", "-"):
+            return (self._ptrish(node.left, sc)
+                    or self._ptrish(node.right, sc))
+        return False
+
     def _binop(self, node, sc):
+        if (node.op in ("==", "!=", "<", ">", "<=", ">=", "-")
+                and (self._ptrish(node.left, sc)
+                     or self._ptrish(node.right, sc))):
+            # Pointer comparison / difference: both sides resolve to
+            # (base, offset); same base -> compare/subtract offsets
+            # (element-indexed cursors, matching C's element units).
+            ba, oa = self._ptr_parts(node.left, sc)
+            bb, ob = self._ptr_parts(node.right, sc)
+            if ba != bb:
+                raise CLiftError(
+                    f"pointer {node.op} across different arrays "
+                    f"({ba!r} vs {bb!r}) at {node.coord}")
+            return self._apply_binop(node.op, jnp.asarray(oa, jnp.int32),
+                                     jnp.asarray(ob, jnp.int32), node)
         a = self.eval(node.left, sc)
         b = self.eval(node.right, sc)
         return self._apply_binop(node.op, a, b, node)
@@ -1097,6 +1215,14 @@ class _Compiler:
         if isinstance(expr, c_ast.ID) and expr.name in sc.aliases:
             return (sc.aliases[expr.name],
                     jnp.asarray(sc.locals.get(expr.name, 0), jnp.int32))
+        if (isinstance(expr, c_ast.ID) and expr.name in self.g_ptrs
+                and expr.name not in sc.locals):
+            base = self.g_ptr_base.get(expr.name)
+            if base is None:
+                raise CLiftError(
+                    f"global pointer {expr.name!r} used before any "
+                    "seating; seat it (p = arr) first")
+            return base, jnp.asarray(sc.read(expr.name), jnp.int32)
         if isinstance(expr, c_ast.ID) and expr.name in sc.locals:
             # A LOCAL array (possibly shadowing a same-name global)
             # cannot be a pointer target -- aliases only bind into the
@@ -1112,14 +1238,23 @@ class _Compiler:
             return expr.name, jnp.int32(0)
         if (isinstance(expr, c_ast.UnaryOp)
                 and expr.op in ("++", "p++", "--", "p--")
-                and isinstance(expr.expr, c_ast.ID)
-                and expr.expr.name in sc.aliases):
-            if expr.expr.name not in sc.locals:
-                raise CLiftError(
-                    f"pointer arithmetic on unwalked parameter "
-                    f"{expr.expr.name!r} at {expr.coord}")
-            off = self._unop(expr, sc)          # applies the cursor effect
-            return sc.aliases[expr.expr.name], jnp.asarray(off, jnp.int32)
+                and isinstance(expr.expr, c_ast.ID)):
+            nm = expr.expr.name
+            if nm in sc.aliases:
+                if nm not in sc.locals:
+                    raise CLiftError(
+                        f"pointer arithmetic on unwalked parameter "
+                        f"{nm!r} at {expr.coord}")
+                off = self._unop(expr, sc)      # applies the cursor effect
+                return sc.aliases[nm], jnp.asarray(off, jnp.int32)
+            if nm in self.g_ptrs and nm not in sc.locals:
+                base = self.g_ptr_base.get(nm)
+                if base is None:
+                    raise CLiftError(
+                        f"global pointer {nm!r} walked before any "
+                        f"seating at {expr.coord}")
+                off = self._unop(expr, sc)      # global cursor effect
+                return base, jnp.asarray(off, jnp.int32)
         if isinstance(expr, c_ast.Cast):
             # Pointer casts ((void*)buf, (char*)p) change the static type,
             # not the address: pass through.  The pointee's ctype stays
@@ -1238,6 +1373,24 @@ class _Compiler:
     def _assign(self, node, sc):
         op = node.op
         if (op == "=" and isinstance(node.lvalue, c_ast.ID)
+                and node.lvalue.name in self.g_ptrs
+                and node.lvalue.name not in sc.locals
+                and node.lvalue.name not in sc.aliases):
+            # GLOBAL pointer (re-)seating: static single base, runtime
+            # cursor stored in the int32 cursor global.
+            name = node.lvalue.name
+            base, off = self._ptr_parts(node.rvalue, sc)
+            prev = self.g_ptr_base.get(name)
+            if prev is not None and prev != base:
+                raise CLiftError(
+                    f"global pointer {name!r} re-seated from {prev!r} "
+                    f"to {base!r} at {node.coord}: a single static base "
+                    "per global pointer is the modeled envelope")
+            self.g_ptr_base[name] = base
+            sc.write(name, jnp.asarray(off, jnp.int32))
+            sc.consts.pop(name, None)
+            return off
+        if (op == "=" and isinstance(node.lvalue, c_ast.ID)
                 and (node.lvalue.name in sc.ptrs
                      or node.lvalue.name in sc.aliases)):
             # Pointer (re-)seating: `p = arr`, `p = q`, `p = p + k`,
@@ -1344,6 +1497,24 @@ class _Compiler:
                     # like caller-local arrays.
                     args.append(("__alias_scalar_local__", inner.name))
                     continue
+                # &localarr[k]: caller-LOCAL array element address
+                # (motion's &PMV[0]) -- transient slot + cursor k.
+                idxs, node2 = [], inner
+                while isinstance(node2, c_ast.ArrayRef):
+                    idxs.append(node2.subscript)
+                    node2 = node2.name
+                if (isinstance(node2, c_ast.ID) and node2.name in sc.locals
+                        and node2.name not in sc.aliases
+                        and jnp.ndim(sc.locals[node2.name]) >= 1):
+                    shape = jnp.shape(sc.locals[node2.name])
+                    flat = jnp.int32(0)
+                    for d, ix in enumerate(reversed(idxs)):
+                        stride = int(np.prod(shape[d + 1:],
+                                             dtype=np.int64))
+                        flat = flat + jnp.asarray(
+                            self.eval(ix, sc), jnp.int32) * stride
+                    args.append(("__alias_local_off__", node2.name, flat))
+                    continue
                 # &arr[k] / &glob: a pointer value -- forward base+offset.
                 base, off = self._ptr_parts(a, sc)
                 args.append(("__alias_off__", base,
@@ -1371,6 +1542,45 @@ class _Compiler:
                         continue
                     args.append(("__alias__", tgt))
                     continue
+            if isinstance(a, c_ast.ArrayRef):
+                # PARTIAL indexing of a multi-dim array (motion.c's
+                # motion_vector(PMV[0][s], ...)): C decays the sub-array
+                # to a pointer -- forward base + flattened row offset so
+                # callee writes land in the caller's array.  FULL
+                # indexing stays a by-value element.
+                idxs, node2 = [], a
+                while isinstance(node2, c_ast.ArrayRef):
+                    idxs.append(node2.subscript)
+                    node2 = node2.name
+                if isinstance(node2, c_ast.ID):
+                    nm2 = node2.name
+                    arrv = cur = None
+                    basen, is_local = nm2, False
+                    if nm2 in sc.aliases:
+                        basen = sc.aliases[nm2]
+                        arrv = sc.g.get(basen)
+                        cur = sc.locals.get(nm2)
+                    elif (nm2 in sc.locals
+                            and jnp.ndim(sc.locals[nm2]) >= 1):
+                        arrv, is_local = sc.locals[nm2], True
+                    elif nm2 in sc.g and jnp.ndim(sc.g[nm2]) >= 1:
+                        arrv = sc.g[nm2]
+                    if arrv is not None and len(idxs) < jnp.ndim(arrv):
+                        shape = jnp.shape(arrv)
+                        flat = jnp.int32(0)
+                        for d, ix in enumerate(reversed(idxs)):
+                            stride = int(np.prod(shape[d + 1:],
+                                                 dtype=np.int64))
+                            flat = flat + jnp.asarray(
+                                self.eval(ix, sc), jnp.int32) * stride
+                        if cur is not None:
+                            flat = flat + jnp.asarray(cur, jnp.int32)
+                        if is_local:
+                            args.append(("__alias_local_off__", nm2,
+                                         flat))
+                        else:
+                            args.append(("__alias_off__", basen, flat))
+                        continue
             args.append(self.eval(a, sc))
         if fname in ("exit", "abort"):
             raise CLiftError(
@@ -1655,6 +1865,19 @@ class _Compiler:
                 sc.aliases[p.name] = temp
                 sc.locals[p.name] = jnp.int32(0)
                 scalar_backs.append((temp, a[1]))
+                continue
+            if isinstance(a, tuple) and a[0] == "__alias_local_off__":
+                # Caller-local array element address: transient slot
+                # with the cursor starting at the element's offset.
+                temp = f"__loc{self._tmp}"
+                self._tmp += 1
+                sc.g[temp] = outer_sc.locals[a[1]]
+                oct_ = outer_sc.ctype(a[1])
+                if oct_ is not None:
+                    sc.ctypes[temp] = oct_
+                sc.aliases[p.name] = temp
+                sc.locals[p.name] = jnp.asarray(a[2], jnp.int32)
+                copy_backs.append((temp, a[1]))
                 continue
             if (isinstance(a, tuple) and len(a) == 2
                     and a[0] == "__alias_local__"):
@@ -1959,6 +2182,49 @@ class _Compiler:
             names.extend(seats.get(p, ()))
         return list(dict.fromkeys(names))
 
+    def _g_ptr_static_base(self, name: str) -> Optional[str]:
+        """Static whole-program resolution of a global pointer's base:
+        scan every function for `name = <expr>` seatings and return the
+        single base array they agree on (None if unseated/ambiguous)."""
+        cache = getattr(self, "_g_ptr_seat_cache", None)
+        if cache is None:
+            cache = {}
+            comp = self
+
+            class V(c_ast.NodeVisitor):
+                def visit_Assignment(v, n):
+                    if (n.op == "=" and isinstance(n.lvalue, c_ast.ID)
+                            and n.lvalue.name in comp.g_ptrs):
+                        for b in comp._base_ids(n.rvalue):
+                            if b != n.lvalue.name:
+                                cache.setdefault(n.lvalue.name,
+                                                 set()).add(b)
+                    v.generic_visit(n)
+
+            for fn in self.funcs.values():
+                V().visit(fn.body)
+            self._g_ptr_seat_cache = cache
+        bases = cache.get(name)
+        # Cursors seated on one another (ld_Rdmax = ld_Rdptr) collapse
+        # through the other pointer's bases.
+        for _ in range(4):
+            if not bases:
+                return None
+            flat = set()
+            again = False
+            for b in bases:
+                if b in self.g_ptrs:
+                    sub = cache.get(b)
+                    if sub:
+                        flat |= sub
+                        again = True
+                else:
+                    flat.add(b)
+            bases = flat
+            if not again:
+                break
+        return bases.pop() if bases and len(bases) == 1 else None
+
     def _assigned_globals(self, fndef) -> List[str]:
         """Names a callee writes OUTSIDE its own scope: its assigned
         names minus its params and local declarations.  A callee-local
@@ -2010,6 +2276,11 @@ class _Compiler:
                 if nm in local_ptr:
                     nm = local_ptr[nm]
                     continue
+                if nm in comp.g_ptrs:
+                    base = comp._g_ptr_static_base(nm)
+                    if base is not None and base != nm:
+                        nm = base
+                        continue
                 break
             return subst.get(nm, nm)
 
@@ -2685,6 +2956,7 @@ def _parse_globals(tu, typedefs):
     out: Dict[str, jax.Array] = {}
     ctypes: Dict[str, _CType] = {}
     inited: set = set()
+    g_ptrs: set = set()          # uninitialized pointer globals (cursors)
 
     def flat_init(init) -> List[int]:
         if isinstance(init, c_ast.InitList):
@@ -2704,6 +2976,7 @@ def _parse_globals(tu, typedefs):
             continue
         t = ext.type
         shape = []
+        deferred = False
         while isinstance(t, c_ast.ArrayDecl):
             n = _const_int(t.dim)
             if n is None:
@@ -2712,16 +2985,28 @@ def _parse_globals(tu, typedefs):
                 if (t.dim is None and not shape
                         and isinstance(ext.init, c_ast.InitList)):
                     n = len(ext.init.exprs)
+                elif t.dim is None and ext.init is None:
+                    # extern/tentative unsized array (motion.h's
+                    # `extern const unsigned char inRdbfr[];`): an
+                    # incomplete type the defining declaration
+                    # completes; defer -- never-defined names fail
+                    # loudly at first read.
+                    deferred = True
+                    break
                 else:
                     raise CLiftError(
                         f"non-literal array dim for {ext.name}")
             shape.append(n)
             t = t.type
+        if deferred:
+            continue
         if isinstance(t, c_ast.PtrDecl):
-            # The one pointer-global shape the corpus uses: a char pointer
-            # initialized with a string literal (crc16.c's message).  It
-            # becomes the byte array itself; ID uses alias it like any
-            # array (C pointer decay in reverse).
+            # Two pointer-global shapes: a char pointer initialized with
+            # a string literal (crc16.c's message) becomes the byte
+            # array itself; an UNINITIALIZED pointer global (motion's
+            # ld_Rdptr) becomes an int32 CURSOR global -- runtime,
+            # injectable pointer state -- whose aliased base array is
+            # resolved at its first seating (single static base).
             inner = t.type
             if (isinstance(inner, c_ast.TypeDecl)
                     and isinstance(ext.init, c_ast.Constant)
@@ -2732,9 +3017,15 @@ def _parse_globals(tu, typedefs):
                     _normalize_init(vals, ct)).astype(ct.dtype)
                 ctypes[ext.name] = ct
                 continue
+            if ext.init is None:
+                if ext.name not in out:
+                    out[ext.name] = jnp.int32(0)
+                    g_ptrs.add(ext.name)
+                continue
             raise CLiftError(
                 f"unsupported pointer global {ext.name!r} (only char* "
-                "with a string-literal initializer is modeled)")
+                "with a string-literal initializer, or an uninitialized "
+                "pointer seated at runtime, is modeled)")
         if isinstance(t, c_ast.TypeDecl):
             ct = _ctype_of(t.type.names, typedefs)
             if isinstance(ct, _CType64):
@@ -2768,7 +3059,7 @@ def _parse_globals(tu, typedefs):
             arr = jnp.zeros(tuple(shape) if shape else (), ct.dtype)
         out[ext.name] = arr
         ctypes[ext.name] = ct
-    return out, ctypes
+    return out, ctypes, g_ptrs
 
 
 def parse_c_sources(paths: Sequence[str]):
@@ -2808,8 +3099,9 @@ def parse_c_sources(paths: Sequence[str]):
                 typedefs[ext.name] = _ctype_of(names, typedefs)
         elif isinstance(ext, c_ast.FuncDef):
             funcs[ext.decl.name] = ext
-    globals_, g_ctypes = _parse_globals(tu, typedefs)
-    return tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes
+    globals_, g_ctypes, g_ptrs = _parse_globals(tu, typedefs)
+    return (tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes,
+            g_ptrs)
 
 
 def lift_c(name: str,
@@ -2827,8 +3119,8 @@ def lift_c(name: str,
     program printf'd become its outputs.  ``entry`` (default ``main``) is
     executed.  COAST.h macros in the source set ``default_xmr`` unless
     overridden."""
-    tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes = \
-        parse_c_sources(sources)
+    (tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes,
+     g_ptrs) = parse_c_sources(sources)
     if entry not in funcs:
         raise CLiftError(
             f"entry function {entry!r} not defined; have "
@@ -2836,7 +3128,8 @@ def lift_c(name: str,
     if default_xmr is None:
         default_xmr = "__DEFAULT_NO_xMR" not in anns
 
-    comp = _Compiler(tu, typedefs, funcs, name, g_ctypes)
+    comp = _Compiler(tu, typedefs, funcs, name, g_ctypes,
+                     g_ptrs=g_ptrs)
     g_names = sorted(globals_)
     out_globals = sorted(comp.written_globals(funcs[entry], set(g_names)))
 

@@ -865,7 +865,7 @@ int main() {
     return 0;
 }
 """)
-    tu, g, funcs, tds, anns, flags, cts = parse_c_sources([str(src)])
+    tu, g, funcs, tds, anns, flags, cts, _gp = parse_c_sources([str(src)])
     assert "__xMR" in anns
     assert flags.get("counter") is True
 
