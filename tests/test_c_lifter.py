@@ -1213,3 +1213,22 @@ int main() {
     r = lift_c("si", [str(src)])
     out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
     assert list(out[-4:]) == [3, 7, 14, 21]
+
+
+@pytest.mark.slow
+def test_chstone_motion_from_source():
+    """motion/{mpeg2,motion,getbits,getvlc}.c: MPEG-2 motion vector
+    decoding ingests whole -- cpp conditional inclusion selecting the
+    _ANSI_ARGS_ variant, global pointer variables (ld_Rdptr as an
+    injectable int32 cursor over ld_Rdbfr), pointer comparisons
+    (ld_Rdptr < ld_Rdbfr + 2044), and sub-array call arguments
+    (motion_vector(PMV[0][s], ...)).  Oracle: 4 mvfs + 8 PMV -> 12."""
+    srcs = [os.path.join(CHSTONE, "motion", f)
+            for f in ("mpeg2.c", "motion.c", "getbits.c", "getvlc.c")]
+    if not os.path.exists(srcs[0]):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("motion_c", srcs)
+    _chstone_oracle(r, 12)
+    _masking_invariants(r)
