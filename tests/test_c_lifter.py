@@ -198,15 +198,39 @@ int main() {
     assert out[-1] == 10
 
 
-def test_printf_in_loop_refused(tmp_path):
-    from coast_tpu.frontend.c_lifter import CLiftError
-    with pytest.raises(CLiftError, match="printf inside a loop"):
-        _lift_src(tmp_path, """
+def test_printf_in_scan_loop_stacks(tmp_path):
+    """Per-iteration prints in a STATIC-trip loop become one stacked
+    observable per printf argument (dfmul's per-vector diagnostic
+    line) -- every printed value is program output, as in the QEMU
+    loop's stdout."""
+    r = _lift_src(tmp_path, """
 unsigned int data[4] = {1, 2, 3, 4};
 unsigned int total = 0;
 int main() {
     int i;
     for (i = 0; i < 4; i++) { total += data[i]; printf("%u\\n", total); }
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected()))
+    # outputs: total (written global), then the stacked per-iteration
+    # prints [1, 3, 6, 10]
+    assert list(out[-4:].astype(np.int64)) == [1, 3, 6, 10]
+    assert out[-5] == 10                       # final total
+
+
+def test_printf_in_dynamic_loop_refused(tmp_path):
+    """A while-lowered loop (data-dependent trip) has no stacked-output
+    channel; per-iteration value prints still refuse loudly."""
+    from coast_tpu.frontend.c_lifter import CLiftError
+    with pytest.raises(CLiftError, match="printf inside a loop"):
+        _lift_src(tmp_path, """
+unsigned int data[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+unsigned int total = 0;
+int main() {
+    int i;
+    i = 0;
+    while (total < 10) { total += data[i]; printf("%u\\n", total); i++; }
     return 0;
 }
 """)
@@ -1231,4 +1255,40 @@ def test_chstone_motion_from_source():
 
     r = lift_c("motion_c", srcs)
     _chstone_oracle(r, 12)
+    _masking_invariants(r)
+
+
+@pytest.mark.slow
+def test_chstone_dfmul_from_source():
+    """dfmul/{dfmul.c,softfloat.c}: IEC 60559 double multiplication on
+    the uint32 limb-pair model -- 64-bit GLOBAL test-vector arrays laid
+    out as (N, 2) memory words, 64-bit scalar out-parameters
+    (&zSig0/&zSig1 through mul64To128), LIT64 token paste, and
+    per-vector diagnostic prints stacked as scan outputs.
+    Oracle: all 20 vectors."""
+    srcs = [os.path.join(CHSTONE, "dfmul", f)
+            for f in ("dfmul.c", "softfloat.c")]
+    if not os.path.exists(srcs[0]):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("dfmul_c", srcs)
+    _chstone_oracle(r, 20)
+    _masking_invariants(r)
+
+
+@pytest.mark.slow
+def test_chstone_dfdiv_from_source():
+    """dfdiv/{dfdiv.c,softfloat.c}: IEC 60559 double division --
+    unsigned 64/64 division lowered to a 64-step restoring
+    shift-subtract on limb pairs (estimateDiv128To64), 64-bit ++/--.
+    Oracle: all 22 vectors."""
+    srcs = [os.path.join(CHSTONE, "dfdiv", f)
+            for f in ("dfdiv.c", "softfloat.c")]
+    if not os.path.exists(srcs[0]):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("dfdiv_c", srcs)
+    _chstone_oracle(r, 22)
     _masking_invariants(r)
