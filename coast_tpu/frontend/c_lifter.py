@@ -485,7 +485,13 @@ class _C64:
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        # Bypass __init__: jax's tree-structure checks unflatten with
+        # sentinel (non-array) leaves, and the strict constructor must
+        # keep raising on real misuse.
+        obj = object.__new__(cls)
+        obj.lo, obj.hi = children
+        obj.unsigned = aux
+        return obj
 
     def with_sign(self, unsigned: bool) -> "_C64":
         return _C64(self.lo, self.hi, unsigned)
@@ -1662,7 +1668,17 @@ class _Compiler:
                         arrv, is_local = sc.locals[nm2], True
                     elif nm2 in sc.g and jnp.ndim(sc.g[nm2]) >= 1:
                         arrv = sc.g[nm2]
-                    if arrv is not None and len(idxs) < jnp.ndim(arrv):
+                    eff_nd = None
+                    if arrv is not None:
+                        eff_nd = jnp.ndim(arrv)
+                        # The BASE array's element type decides the
+                        # logical arity (a walked cursor's own ctype is
+                        # deliberately None, so resolve the base).
+                        ctn = (sc.ctype(nm2) if is_local
+                               else sc.ctypes.get(basen))
+                        if isinstance(ctn, _CType64):
+                            eff_nd -= 1     # trailing dim is the limb pair
+                    if arrv is not None and len(idxs) < eff_nd:
                         shape = jnp.shape(arrv)
                         flat = jnp.int32(0)
                         for d, ix in enumerate(reversed(idxs)):
@@ -1912,7 +1928,168 @@ class _Compiler:
                     c_ast.Constant("int", str(k), stmt.coord), stmt.coord)]
             return [stmt]
 
-        fndef.body = xform_block(fndef.body, False)
+        body = xform_block(fndef.body, False)
+        fndef.body = self._rewrite_gotos(body, temps)
+
+    def _rewrite_gotos(self, body, temps) -> "c_ast.Compound":
+        """Lower FORWARD gotos to top-level labels into skip flags
+        (softfloat's addFloat64Sigs/subFloat64Sigs shape):
+
+          goto L;   ->  __goto_L = 1;
+          L: stmt   ->  __goto_L = 0; <stmt guarded like the rest>
+
+        and every statement after the first goto point runs under
+        ``if ((flagA | flagB | ...) == 0)`` -- the early-return flag
+        discipline applied to jumps.  Bounds of the envelope, refused
+        loudly: backward gotos, labels below top level, gotos inside
+        loops (no loop sits between a softfloat goto and its label)."""
+        items = list(body.block_items or [])
+
+        gotos: List[str] = []
+        labels: Dict[str, int] = {}
+
+        def scan(n, depth_ok=True):
+            class V(c_ast.NodeVisitor):
+                def visit_Goto(v, nn):
+                    gotos.append(nn.name)
+
+                def visit_Label(v, nn):
+                    raise CLiftError(
+                        f"label {nn.name!r} below function top level at "
+                        f"{nn.coord}; only top-level labels are modeled")
+
+                def visit_For(v, nn):
+                    v._loop(nn)
+
+                def visit_While(v, nn):
+                    v._loop(nn)
+
+                def visit_DoWhile(v, nn):
+                    v._loop(nn)
+
+                def _loop(v, nn):
+                    before = len(gotos)
+                    v.generic_visit(nn)
+                    if len(gotos) != before:
+                        raise CLiftError(
+                            f"goto inside a loop at {nn.coord} is "
+                            "outside the modeled envelope; restructure")
+            V().visit(n)
+
+        for k, it in enumerate(items):
+            if isinstance(it, c_ast.Label):
+                labels[it.name] = k
+                scan(it.stmt)
+            else:
+                scan(it)
+        if not gotos:
+            return body
+        for k, it in enumerate(items):
+            holder = it.stmt if isinstance(it, c_ast.Label) else it
+            sub: List[str] = []
+
+            class G(c_ast.NodeVisitor):
+                def visit_Goto(v, nn):
+                    sub.append(nn.name)
+
+            G().visit(holder)
+            for g in sub:
+                if g not in labels:
+                    raise CLiftError(f"goto to unknown label {g!r}")
+                if labels[g] <= k:
+                    raise CLiftError(
+                        f"backward goto {g!r} is outside the modeled "
+                        "envelope (forward jumps only)")
+
+        flag = {L: f"__goto_{L}" for L in labels}
+        for nm in flag.values():
+            temps.append(nm)               # zero-initialized at entry
+
+        def no_flags(coord):
+            expr = None
+            for nm in flag.values():
+                e = c_ast.ID(nm, coord)
+                expr = e if expr is None else c_ast.BinaryOp("|", expr, e,
+                                                             coord)
+            return c_ast.BinaryOp("==", expr, c_ast.Constant("int", "0"),
+                                  coord)
+
+        def has_goto(n) -> bool:
+            found: List[object] = []
+
+            class V(c_ast.NodeVisitor):
+                def visit_Goto(v, nn):
+                    found.append(nn)
+
+            V().visit(n)
+            return bool(found)
+
+        def xform(s):
+            if isinstance(s, c_ast.Goto):
+                return c_ast.Assignment(
+                    "=", c_ast.ID(flag[s.name], s.coord),
+                    c_ast.Constant("int", "1", s.coord), s.coord)
+            if not has_goto(s):
+                return s
+            if isinstance(s, c_ast.Compound):
+                return c_ast.Compound(g_seq(list(s.block_items or [])),
+                                      s.coord)
+            if isinstance(s, c_ast.If):
+                return c_ast.If(
+                    s.cond,
+                    xform(s.iftrue) if s.iftrue is not None else None,
+                    xform(s.iffalse) if s.iffalse is not None else None,
+                    s.coord)
+            raise CLiftError(
+                f"goto in unsupported construct {type(s).__name__} at "
+                f"{getattr(s, 'coord', '?')}")
+
+        def g_seq(stmts):
+            out = []
+            for k, s in enumerate(stmts):
+                if not has_goto(s):
+                    out.append(s)
+                    continue
+                out.append(xform(s))
+                rest = g_seq(stmts[k + 1:])
+                if rest:
+                    wrap = c_ast.If(
+                        no_flags(getattr(s, "coord", None)),
+                        c_ast.Compound(rest, getattr(s, "coord", None)),
+                        None, getattr(s, "coord", None))
+                    self._synth_reason[id(wrap)] = "after a goto point"
+                    out.append(wrap)
+                return out
+            return out
+
+        # Top level: split at labels; each label clears its own flag
+        # unconditionally, then its statement (and everything after)
+        # runs under the combined no-flags guard.
+        out: List[object] = []
+        seen_goto = False
+        for it in items:
+            if isinstance(it, c_ast.Label):
+                out.append(c_ast.Assignment(
+                    "=", c_ast.ID(flag[it.name], it.coord),
+                    c_ast.Constant("int", "0", it.coord), it.coord))
+                inner = xform(it.stmt) if has_goto(it.stmt) else it.stmt
+                wrap = c_ast.If(no_flags(it.coord), inner, None, it.coord)
+                self._synth_reason[id(wrap)] = "after a goto point"
+                out.append(wrap)
+                # A goto INSIDE the labeled statement arms the guards
+                # for everything after, like any other goto point.
+                seen_goto = seen_goto or has_goto(it.stmt)
+                continue
+            if seen_goto:
+                inner = xform(it) if has_goto(it) else it
+                wrap = c_ast.If(no_flags(getattr(it, "coord", None)),
+                                inner, None, getattr(it, "coord", None))
+                self._synth_reason[id(wrap)] = "after a goto point"
+                out.append(wrap)
+            else:
+                out.append(xform(it) if has_goto(it) else it)
+                seen_goto = seen_goto or has_goto(it)
+        return c_ast.Compound(out, body.coord)
 
     def _run_function(self, fndef, args, outer_sc: _Scope,
                       arg_consts: Optional[List[Optional[int]]] = None):
@@ -2028,12 +2205,18 @@ class _Compiler:
                              self.typedefs)
                    if isinstance(rett, c_ast.TypeDecl) else None)
             for n in synth:
-                if n == val_n and isinstance(rct, _CType64):
-                    # 64-bit-returning function: the carried return
-                    # value must be a limb pair from the start (pytree
-                    # consistency across cond branches).
+                if n == val_n and rct is not None:
+                    # The carried return value takes the declared return
+                    # type from the start: every `return E` then
+                    # converts E at the store (C semantics), and a
+                    # 64-bit return stays a limb pair across cond
+                    # branches (pytree consistency).
                     sc.locals[n] = rct.zero()
-                    sc.consts.pop(n, None)
+                    sc.ctypes[n] = rct
+                    if isinstance(rct, _CType64):
+                        sc.consts.pop(n, None)
+                    else:
+                        sc.consts[n] = 0
                 else:
                     sc.locals[n] = jnp.int32(0)
                     sc.consts[n] = 0
@@ -2272,6 +2455,10 @@ class _Compiler:
                 # must stay concrete through the rounds loop for the
                 # ciphertext print loop's static bound).
                 if isinstance(n.name, c_ast.ID):
+                    if n.name.name == "printf":
+                        # printf only READS its arguments.
+                        v.generic_visit(n)
+                        return
                     callee = self.funcs.get(n.name.name)
                     params = []
                     if (callee is not None
@@ -2282,6 +2469,27 @@ class _Compiler:
                                       if not isinstance(
                                           p, c_ast.EllipsisParam)]
                     for ai, a in enumerate(n.args.exprs if n.args else []):
+                        if isinstance(a, c_ast.UnaryOp) and a.op == "&":
+                            # Out-parameter (&aSig): the callee writes
+                            # through it -- the pointee is written.
+                            names.extend(_Compiler._base_ids(a))
+                            continue
+                        if isinstance(a, c_ast.ArrayRef):
+                            # Sub-array argument (PMV[0][s]) decays to a
+                            # pointer; conservatively count the base as
+                            # written -- unless the callee's parameter
+                            # is a by-value scalar (full indexing).
+                            if params and ai < len(params):
+                                pt = getattr(params[ai], "type", None)
+                                if not isinstance(pt, (c_ast.PtrDecl,
+                                                       c_ast.ArrayDecl)):
+                                    continue
+                            t2 = a
+                            while isinstance(t2, c_ast.ArrayRef):
+                                t2 = t2.name
+                            if isinstance(t2, c_ast.ID):
+                                names.append(t2.name)
+                            continue
                         if not isinstance(a, c_ast.ID):
                             continue
                         if params and ai < len(params):
