@@ -1578,6 +1578,23 @@ class _Compiler:
                     vals.extend([v.lo, v.hi])
                 else:
                     vals.append(jnp.asarray(v))
+            if (vals and isinstance(sc.printed, _NoPrintList)
+                    and "__print_buf" in sc.g):
+                # UART-buffer model: dynamically-reached prints append
+                # into the bounded __print_buf observable (overflowing
+                # words drop; __print_cnt keeps the true total).
+                buf = sc.g["__print_buf"]
+                cnt = sc.g["__print_cnt"]
+                for v in vals:
+                    idx = jnp.clip(cnt, 0, _PRINT_BUF_WORDS - 1)
+                    keep = cnt < _PRINT_BUF_WORDS
+                    buf = buf.at[idx].set(
+                        jnp.where(keep, jnp.asarray(v).astype(jnp.uint32),
+                                  buf[idx]))
+                    cnt = cnt + 1
+                sc.g["__print_buf"] = buf
+                sc.g["__print_cnt"] = cnt
+                return jnp.int32(0)
             sc.printed.extend(vals)
             return jnp.int32(0)
         # C array arguments are pointers: a bare ID naming a (possibly
@@ -1695,9 +1712,25 @@ class _Compiler:
                             args.append(("__alias_off__", basen, flat))
                         continue
             args.append(self.eval(a, sc))
-        if fname in ("exit", "abort"):
+        if fname == "exit":
+            # exit(n) on an error path (jpeg's "Not Jpeg File!"/huffman
+            # read error): modeled as an OBSERVABLE poison -- the
+            # synthetic global __exit_state records 1+n and joins the
+            # output surface.  Fault-free runs never take these paths,
+            # so the oracle is exact; under injection the poisoned flag
+            # plus divergent outputs classify the run, though in-model
+            # execution continues past the exit (documented fidelity
+            # envelope -- the QEMU guest would stop).
+            code = (args[0] if args else jnp.int32(0))
+            # POSIX truncates the exit status to 8 bits; 1+(n & 0xFF)
+            # is in [1, 256], never colliding with 0 = ran to end.
+            sc.g["__exit_state"] = (
+                (jnp.asarray(code, jnp.int32) & jnp.int32(0xFF))
+                + jnp.int32(1))
+            return jnp.int32(0)
+        if fname == "abort":
             raise CLiftError(
-                f"{fname}() needs the abort/DUE machinery; model it via "
+                "abort() needs the abort/DUE machinery; model it via "
                 "DWC (detect-only strategy) instead")
         fn = self.funcs.get(fname)
         if fn is None:
@@ -1932,164 +1965,206 @@ class _Compiler:
         fndef.body = self._rewrite_gotos(body, temps)
 
     def _rewrite_gotos(self, body, temps) -> "c_ast.Compound":
-        """Lower FORWARD gotos to top-level labels into skip flags
-        (softfloat's addFloat64Sigs/subFloat64Sigs shape):
+        """Lower FORWARD gotos into skip flags, per enclosing compound:
 
-          goto L;   ->  __goto_L = 1;
+          goto L;   ->  __goto_L = 1;  (+ exit any FOR loops between)
           L: stmt   ->  __goto_L = 0; <stmt guarded like the rest>
 
-        and every statement after the first goto point runs under
-        ``if ((flagA | flagB | ...) == 0)`` -- the early-return flag
-        discipline applied to jumps.  Bounds of the envelope, refused
-        loudly: backward gotos, labels below top level, gotos inside
-        loops (no loop sits between a softfloat goto and its label)."""
-        items = list(body.block_items or [])
+        A label lives at the top level of SOME compound (the function
+        body, a loop body, a branch); its gotos may sit anywhere below
+        that compound, including inside nested FOR loops (jpeg's
+        id_found search: the loop gains a flag-conditional break, and
+        the in-loop statements after the jump run under the no-flags
+        guard -- one masked partial iteration, no effects).  Statements
+        of the label's compound between the goto point and the label
+        run under ``if ((flagA | flagB | ...) == 0)`` -- the
+        early-return discipline applied to jumps.  Refused loudly:
+        backward gotos, gotos escaping while/do-while loops, unknown
+        labels."""
 
-        gotos: List[str] = []
-        labels: Dict[str, int] = {}
+        def goto_names(n) -> List[str]:
+            out: List[str] = []
 
-        def scan(n, depth_ok=True):
             class V(c_ast.NodeVisitor):
                 def visit_Goto(v, nn):
-                    gotos.append(nn.name)
+                    out.append(nn.name)
 
-                def visit_Label(v, nn):
-                    raise CLiftError(
-                        f"label {nn.name!r} below function top level at "
-                        f"{nn.coord}; only top-level labels are modeled")
+            if n is not None:
+                V().visit(n)
+            return out
 
-                def visit_For(v, nn):
-                    v._loop(nn)
-
-                def visit_While(v, nn):
-                    v._loop(nn)
-
-                def visit_DoWhile(v, nn):
-                    v._loop(nn)
-
-                def _loop(v, nn):
-                    before = len(gotos)
-                    v.generic_visit(nn)
-                    if len(gotos) != before:
-                        raise CLiftError(
-                            f"goto inside a loop at {nn.coord} is "
-                            "outside the modeled envelope; restructure")
-            V().visit(n)
-
-        for k, it in enumerate(items):
-            if isinstance(it, c_ast.Label):
-                labels[it.name] = k
-                scan(it.stmt)
-            else:
-                scan(it)
-        if not gotos:
+        if not goto_names(body):
             return body
-        for k, it in enumerate(items):
-            holder = it.stmt if isinstance(it, c_ast.Label) else it
-            sub: List[str] = []
 
-            class G(c_ast.NodeVisitor):
-                def visit_Goto(v, nn):
-                    sub.append(nn.name)
+        flag: Dict[str, str] = {}
 
-            G().visit(holder)
-            for g in sub:
-                if g not in labels:
-                    raise CLiftError(f"goto to unknown label {g!r}")
-                if labels[g] <= k:
-                    raise CLiftError(
-                        f"backward goto {g!r} is outside the modeled "
-                        "envelope (forward jumps only)")
+        def flag_for(name: str) -> str:
+            if name not in flag:
+                flag[name] = f"__goto_{name}"
+                temps.append(flag[name])
+            return flag[name]
 
-        flag = {L: f"__goto_{L}" for L in labels}
-        for nm in flag.values():
-            temps.append(nm)               # zero-initialized at entry
-
-        def no_flags(coord):
+        def no_flags(names, coord):
             expr = None
-            for nm in flag.values():
-                e = c_ast.ID(nm, coord)
+            for L in names:
+                e = c_ast.ID(flag_for(L), coord)
                 expr = e if expr is None else c_ast.BinaryOp("|", expr, e,
                                                              coord)
             return c_ast.BinaryOp("==", expr, c_ast.Constant("int", "0"),
                                   coord)
 
-        def has_goto(n) -> bool:
-            found: List[object] = []
+        def as_items(node):
+            if node is None:
+                return []
+            if isinstance(node, c_ast.Compound):
+                return list(node.block_items or [])
+            return [node]
 
-            class V(c_ast.NodeVisitor):
-                def visit_Goto(v, nn):
-                    found.append(nn)
-
-            V().visit(n)
-            return bool(found)
-
-        def xform(s):
-            if isinstance(s, c_ast.Goto):
+        def rewrite(stmt, active):
+            """Replace active gotos under ``stmt``; loops crossed by a
+            jump gain guard+break discipline.  Returns the new stmt."""
+            hit = [g for g in goto_names(stmt) if g in active]
+            if not hit:
+                return stmt
+            if isinstance(stmt, c_ast.Goto):
                 return c_ast.Assignment(
-                    "=", c_ast.ID(flag[s.name], s.coord),
-                    c_ast.Constant("int", "1", s.coord), s.coord)
-            if not has_goto(s):
-                return s
-            if isinstance(s, c_ast.Compound):
-                return c_ast.Compound(g_seq(list(s.block_items or [])),
-                                      s.coord)
-            if isinstance(s, c_ast.If):
+                    "=", c_ast.ID(flag_for(stmt.name), stmt.coord),
+                    c_ast.Constant("int", "1", stmt.coord), stmt.coord)
+            if isinstance(stmt, c_ast.Compound):
+                return c_ast.Compound(
+                    seq_guard(as_items(stmt), active, stmt.coord),
+                    stmt.coord)
+            if isinstance(stmt, c_ast.If):
                 return c_ast.If(
-                    s.cond,
-                    xform(s.iftrue) if s.iftrue is not None else None,
-                    xform(s.iffalse) if s.iffalse is not None else None,
-                    s.coord)
+                    stmt.cond,
+                    rewrite(stmt.iftrue, active)
+                    if stmt.iftrue is not None else None,
+                    rewrite(stmt.iffalse, active)
+                    if stmt.iffalse is not None else None,
+                    stmt.coord)
+            if isinstance(stmt, c_ast.For):
+                items2 = seq_guard(as_items(stmt.stmt), active, stmt.coord)
+                esc = sorted({g for g in goto_names(stmt.stmt)
+                              if g in active})
+                brk = c_ast.If(
+                    c_ast.BinaryOp("==", no_flags(esc, stmt.coord),
+                                   c_ast.Constant("int", "0", stmt.coord),
+                                   stmt.coord),
+                    c_ast.Break(stmt.coord), None, stmt.coord)
+                return c_ast.For(stmt.init, stmt.cond, stmt.next,
+                                 c_ast.Compound(items2 + [brk],
+                                                stmt.coord), stmt.coord)
+            if isinstance(stmt, (c_ast.While, c_ast.DoWhile)):
+                raise CLiftError(
+                    f"goto escaping a while/do-while at {stmt.coord} is "
+                    "outside the modeled envelope; restructure")
+            if isinstance(stmt, c_ast.Label):
+                return c_ast.Label(stmt.name, rewrite(stmt.stmt, active),
+                                   stmt.coord)
             raise CLiftError(
-                f"goto in unsupported construct {type(s).__name__} at "
-                f"{getattr(s, 'coord', '?')}")
+                f"goto in unsupported construct {type(stmt).__name__} at "
+                f"{getattr(stmt, 'coord', '?')}")
 
-        def g_seq(stmts):
+        def seq_guard(stmts, active, coord):
+            """Within a compound below the label level: statements after
+            a goto point run under the no-flags guard."""
             out = []
             for k, s in enumerate(stmts):
-                if not has_goto(s):
+                hit = [g for g in goto_names(s) if g in active]
+                if not hit:
                     out.append(s)
                     continue
-                out.append(xform(s))
-                rest = g_seq(stmts[k + 1:])
+                out.append(rewrite(s, active))
+                rest = seq_guard(stmts[k + 1:], active, coord)
                 if rest:
                     wrap = c_ast.If(
-                        no_flags(getattr(s, "coord", None)),
-                        c_ast.Compound(rest, getattr(s, "coord", None)),
-                        None, getattr(s, "coord", None))
+                        no_flags(sorted(active), coord),
+                        c_ast.Compound(rest, coord), None, coord)
                     self._synth_reason[id(wrap)] = "after a goto point"
                     out.append(wrap)
                 return out
             return out
 
-        # Top level: split at labels; each label clears its own flag
-        # unconditionally, then its statement (and everything after)
-        # runs under the combined no-flags guard.
-        out: List[object] = []
-        seen_goto = False
-        for it in items:
-            if isinstance(it, c_ast.Label):
-                out.append(c_ast.Assignment(
-                    "=", c_ast.ID(flag[it.name], it.coord),
-                    c_ast.Constant("int", "0", it.coord), it.coord))
-                inner = xform(it.stmt) if has_goto(it.stmt) else it.stmt
-                wrap = c_ast.If(no_flags(it.coord), inner, None, it.coord)
-                self._synth_reason[id(wrap)] = "after a goto point"
-                out.append(wrap)
-                # A goto INSIDE the labeled statement arms the guards
-                # for everything after, like any other goto point.
-                seen_goto = seen_goto or has_goto(it.stmt)
-                continue
-            if seen_goto:
-                inner = xform(it) if has_goto(it) else it
-                wrap = c_ast.If(no_flags(getattr(it, "coord", None)),
-                                inner, None, getattr(it, "coord", None))
-                self._synth_reason[id(wrap)] = "after a goto point"
-                out.append(wrap)
-            else:
-                out.append(xform(it) if has_goto(it) else it)
-                seen_goto = seen_goto or has_goto(it)
-        return c_ast.Compound(out, body.coord)
+        def process(items, coord):
+            """Handle labels at THIS compound level (recursing into
+            nested compounds for deeper labels first)."""
+            # Recurse structurally so deeper compounds resolve their own
+            # label/goto pairs before this level's flags apply.
+            def descend(s):
+                if isinstance(s, c_ast.Compound):
+                    return c_ast.Compound(
+                        process(as_items(s), s.coord), s.coord)
+                if isinstance(s, c_ast.If):
+                    return c_ast.If(
+                        s.cond,
+                        descend(s.iftrue) if s.iftrue is not None
+                        else None,
+                        descend(s.iffalse) if s.iffalse is not None
+                        else None, s.coord)
+                if isinstance(s, (c_ast.For, c_ast.While, c_ast.DoWhile)):
+                    body2 = c_ast.Compound(
+                        process(as_items(s.stmt), s.coord), s.coord)
+                    if isinstance(s, c_ast.For):
+                        return c_ast.For(s.init, s.cond, s.next, body2,
+                                         s.coord)
+                    if isinstance(s, c_ast.While):
+                        return c_ast.While(s.cond, body2, s.coord)
+                    return c_ast.DoWhile(s.cond, body2, s.coord)
+                if isinstance(s, c_ast.Label):
+                    return c_ast.Label(s.name, descend(s.stmt), s.coord)
+                return s
+
+            items = [descend(s) for s in items]
+            labels_here = {it.name: k for k, it in enumerate(items)
+                           if isinstance(it, c_ast.Label)}
+            if not labels_here:
+                return items
+            active = set(labels_here)
+            # Forward check at this level.
+            for k, it in enumerate(items):
+                holder = it.stmt if isinstance(it, c_ast.Label) else it
+                for g in goto_names(holder):
+                    if g in labels_here and labels_here[g] <= k:
+                        raise CLiftError(
+                            f"backward goto {g!r} is outside the "
+                            "modeled envelope (forward jumps only)")
+            out: List[object] = []
+            seen_goto = False
+            for it in items:
+                if isinstance(it, c_ast.Label) and it.name in active:
+                    out.append(c_ast.Assignment(
+                        "=", c_ast.ID(flag_for(it.name), it.coord),
+                        c_ast.Constant("int", "0", it.coord), it.coord))
+                    inner = rewrite(it.stmt, active)
+                    wrap = c_ast.If(no_flags(sorted(active), it.coord),
+                                    inner, None, it.coord)
+                    self._synth_reason[id(wrap)] = "after a goto point"
+                    out.append(wrap)
+                    seen_goto = seen_goto or bool(
+                        [g for g in goto_names(it.stmt) if g in active])
+                    continue
+                if seen_goto:
+                    inner = rewrite(it, active)
+                    wrap = c_ast.If(
+                        no_flags(sorted(active),
+                                 getattr(it, "coord", None)),
+                        inner, None, getattr(it, "coord", None))
+                    self._synth_reason[id(wrap)] = "after a goto point"
+                    out.append(wrap)
+                else:
+                    out.append(rewrite(it, active))
+                    seen_goto = seen_goto or bool(
+                        [g for g in goto_names(it) if g in active])
+            return out
+
+        new_items = process(as_items(body), body.coord)
+        stray = goto_names(c_ast.Compound(new_items, body.coord))
+        if stray:
+            raise CLiftError(
+                f"goto to unknown/backward label(s) {sorted(set(stray))}; "
+                "only forward jumps to a label in an enclosing compound "
+                "are modeled")
+        return c_ast.Compound(new_items, body.coord)
 
     def _run_function(self, fndef, args, outer_sc: _Scope,
                       arg_consts: Optional[List[Optional[int]]] = None):
@@ -2250,10 +2325,26 @@ class _Compiler:
             if n not in sc.locals and n not in outer_sc.locals:
                 outer_sc.consts[n] = v
         # A function's print slots join the output surface when it
-        # returns (top-level call sites only: inside a traced loop the
-        # printed sentinel refuses, as for any in-loop print).
+        # returns.  At a traced call site (inside a loop/branch) the
+        # slots flow into the UART buffer when the program has one --
+        # only slots that actually fired (id >= 0) append -- otherwise
+        # the printed sentinel refuses, as for any in-loop print.
         for nm, _k in self._print_slots.get(fid, ()):
-            sc.printed.append(jnp.asarray(sc.locals[nm]))
+            v = jnp.asarray(sc.locals[nm])
+            if (isinstance(sc.printed, _NoPrintList)
+                    and "__print_buf" in sc.g):
+                buf = sc.g["__print_buf"]
+                cnt = sc.g["__print_cnt"]
+                fired = v >= 0
+                idx = jnp.clip(cnt, 0, _PRINT_BUF_WORDS - 1)
+                keep = jnp.logical_and(fired, cnt < _PRINT_BUF_WORDS)
+                buf = buf.at[idx].set(
+                    jnp.where(keep, v.astype(jnp.uint32), buf[idx]))
+                cnt = cnt + fired.astype(jnp.int32)
+                sc.g["__print_buf"] = buf
+                sc.g["__print_cnt"] = cnt
+            else:
+                sc.printed.append(v)
         if ret is None:
             return jnp.int32(0)
         # C return-value conversion: the value converts to the declared
@@ -2419,6 +2510,10 @@ class _Compiler:
                     t = t.name if isinstance(t, c_ast.ArrayRef) else t.expr
                 if isinstance(t, c_ast.ID):
                     names.append(t.name)
+                    if t.name.startswith("__print_sel_"):
+                        # Desugared branch print: its slot flows into
+                        # the UART buffer at function end.
+                        names.extend(["__print_buf", "__print_cnt"])
                     if derefed:
                         deref_targets.append(t.name)
                     elif n.op == "=":
@@ -2456,9 +2551,15 @@ class _Compiler:
                 # ciphertext print loop's static bound).
                 if isinstance(n.name, c_ast.ID):
                     if n.name.name == "printf":
-                        # printf only READS its arguments.
+                        # printf only READS its arguments -- but under
+                        # the UART-buffer model it writes the buffer.
+                        names.extend(["__print_buf", "__print_cnt"])
                         v.generic_visit(n)
                         return
+                    if n.name.name == "exit":
+                        # exit() writes the poison observable; without
+                        # this the write would die in a branch fork.
+                        names.append("__exit_state")
                     callee = self.funcs.get(n.name.name)
                     params = []
                     if (callee is not None
@@ -2674,6 +2775,12 @@ class _Compiler:
 
             def visit_FuncCall(v, n):
                 if isinstance(n.name, c_ast.ID):
+                    if (n.name.name == "exit"
+                            and "__exit_state" in g_names):
+                        out.add("__exit_state")
+                    if n.name.name == "printf":
+                        out.update({"__print_buf", "__print_cnt"}
+                                   & set(g_names))
                     callee = comp.funcs.get(n.name.name)
                     if callee is not None:
                         decl = callee.decl.type
@@ -3021,6 +3128,7 @@ class _Compiler:
         if (stmt.cond is not None and stmt.stmt is not None
                 and self._contains_printf(stmt.stmt)
                 and all(n.startswith("__print_sel_")
+                        or n in ("__print_buf", "__print_cnt")
                         for n in self._assigned_names(stmt.stmt))):
             for _ in range(4096):
                 live = (self._const_eval(stmt.cond, sc)
@@ -3420,6 +3528,87 @@ def _parse_globals(tu, typedefs):
     return out, ctypes, g_ptrs
 
 
+_PRINT_BUF_WORDS = 256
+
+
+def _static_for_shape(n) -> bool:
+    """AST-only mirror of _static_trip's canonical literal-bound shape."""
+    init, cond, nxt = n.init, n.cond, n.next
+    if init is None or cond is None or nxt is None:
+        return False
+    if isinstance(init, c_ast.DeclList) and len(init.decls) == 1:
+        var, a = init.decls[0].name, _const_int(init.decls[0].init)
+    elif (isinstance(init, c_ast.Assignment) and init.op == "="
+          and isinstance(init.lvalue, c_ast.ID)):
+        var, a = init.lvalue.name, _const_int(init.rvalue)
+    else:
+        return False
+    if a is None:
+        return False
+    if not (isinstance(cond, c_ast.BinaryOp) and cond.op in ("<", "<=")
+            and isinstance(cond.left, c_ast.ID) and cond.left.name == var):
+        return False
+    if _const_int(cond.right) is None:
+        return False
+    if not (isinstance(nxt, c_ast.UnaryOp) and nxt.op in ("++", "p++")
+            and isinstance(nxt.expr, c_ast.ID) and nxt.expr.name == var):
+        return False
+
+    # Mirror _static_trip's last condition: the loop variable must not
+    # be written in the body (else the runtime classifier disagrees).
+    written: List[bool] = []
+
+    class _W(c_ast.NodeVisitor):
+        def visit_Assignment(self, nn):
+            if isinstance(nn.lvalue, c_ast.ID) and nn.lvalue.name == var:
+                written.append(True)
+            self.generic_visit(nn)
+
+        def visit_UnaryOp(self, nn):
+            if (nn.op in ("++", "p++", "--", "p--")
+                    and isinstance(nn.expr, c_ast.ID)
+                    and nn.expr.name == var):
+                written.append(True)
+            self.generic_visit(nn)
+
+    _W().visit(n.stmt)
+    return not written
+
+
+def _needs_print_buffer(funcs) -> bool:
+    """Does any value-printing printf sit where the printed arity
+    cannot be static (dynamic loop, or branch under any loop)?"""
+    need: List[bool] = []
+
+    def walk(n, dyn_loop: int, any_loop: int, branch: int):
+        if n is None or not isinstance(n, c_ast.Node):
+            return
+        if isinstance(n, (c_ast.While, c_ast.DoWhile)):
+            walk(n.stmt, dyn_loop + 1, any_loop + 1, branch)
+            return
+        if isinstance(n, c_ast.For):
+            d = 0 if _static_for_shape(n) else 1
+            walk(n.stmt, dyn_loop + d, any_loop + 1, branch)
+            return
+        if isinstance(n, c_ast.If):
+            walk(n.iftrue, dyn_loop, any_loop, branch + 1)
+            walk(n.iffalse, dyn_loop, any_loop, branch + 1)
+            return
+        if (isinstance(n, c_ast.FuncCall)
+                and isinstance(n.name, c_ast.ID)
+                and n.name.name == "printf"
+                and n.args is not None and len(n.args.exprs) > 1):
+            if dyn_loop > 0 or (any_loop > 0 and branch > 0):
+                need.append(True)
+            return
+        for _, ch in n.children():
+            walk(ch, dyn_loop, any_loop, branch)
+
+    for fn in funcs.values():
+        walk(fn.body, 0, 0, 0)
+    return bool(need)
+
+
 def parse_c_sources(paths: Sequence[str]):
     """Parse + link the restricted-C sources into (tu, globals, funcs,
     typedefs, coast_annotations)."""
@@ -3458,6 +3647,33 @@ def parse_c_sources(paths: Sequence[str]):
         elif isinstance(ext, c_ast.FuncDef):
             funcs[ext.decl.name] = ext
     globals_, g_ctypes, g_ptrs = _parse_globals(tu, typedefs)
+
+    # Any exit() call introduces the synthetic observable __exit_state
+    # (0 = ran to completion; 1+n = exited with code n).
+    class _ExitScan(c_ast.NodeVisitor):
+        found = False
+
+        def visit_FuncCall(self, n):
+            if isinstance(n.name, c_ast.ID) and n.name.name == "exit":
+                _ExitScan.found = True
+            self.generic_visit(n)
+
+    for fn in funcs.values():
+        _ExitScan().visit(fn.body)
+    if _ExitScan.found:
+        globals_["__exit_state"] = jnp.int32(0)
+        g_ctypes["__exit_state"] = _CType(jnp.int32, 32, False)
+
+    # Value prints whose arity cannot be static -- under a dynamic loop
+    # or under a branch inside any loop (jpeg's for(;;) marker loop) --
+    # get the UART-buffer model: a synthetic bounded __print_buf plus
+    # __print_cnt become the stdout observable.  Only created when
+    # needed, so every other program's leaf layout is untouched.
+    if _needs_print_buffer(funcs):
+        globals_["__print_buf"] = jnp.zeros(_PRINT_BUF_WORDS, jnp.uint32)
+        globals_["__print_cnt"] = jnp.int32(0)
+        g_ctypes["__print_cnt"] = _CType(jnp.int32, 32, False)
+        g_ctypes["__print_buf"] = _CType(jnp.uint32, 32, True)
     return (tu, globals_, funcs, typedefs, anns, name_flags, g_ctypes,
             g_ptrs)
 
