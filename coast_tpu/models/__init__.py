@@ -41,8 +41,15 @@ def resolve_region(arg: str) -> Region:
     if arg.endswith(".c"):
         paths = c_source_paths(arg)
         from coast_tpu.frontend import lift_c
-        return lift_c(os.path.splitext(os.path.basename(paths[0]))[0],
-                      paths)
+        # Single-TU programs name after the file; multi-TU programs
+        # after their common directory (gsm's add.c+gsm.c+lpc.c is
+        # "gsm", not "add").
+        if len(paths) == 1:
+            name = os.path.splitext(os.path.basename(paths[0]))[0]
+        else:
+            name = os.path.basename(os.path.dirname(
+                os.path.abspath(paths[0]))) or "program"
+        return lift_c(name, paths)
     if arg in REGISTRY:
         return REGISTRY[arg]()
     raise KeyError(arg)
