@@ -1019,7 +1019,8 @@ class _Compiler:
             return v
         if isinstance(node, c_ast.ArrayRef):
             arr, idx, base = self._array_path(node, sc)
-            ct = sc.ctype(base)
+            ct = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
+                  else sc.ctype(base))
             if isinstance(ct, _CType64):
                 row = arr[idx]                  # (..., 2) limb pair
                 return _C64(row[..., 0], row[..., 1], ct.unsigned)
@@ -1227,6 +1228,11 @@ class _Compiler:
             return old if op.startswith("p") else new
         if op == "*":
             base, off = self._ptr_parts(node.expr, sc)
+            if isinstance(base, tuple):          # union pointer
+                ct = sc.ctypes.get(base[0])
+                v = self._union_read(sc, base)[off]
+                return (ct.store(v) if ct is not None and ct.bits < 32
+                        else v)
             arr = sc.g[base]
             ct = sc.ctypes.get(base)
             if isinstance(ct, _CType64):
@@ -1376,6 +1382,29 @@ class _Compiler:
             base, off = self._ptr_parts(expr.left, sc)
             d = jnp.asarray(self.eval(expr.right, sc), jnp.int32)
             return base, (off + d if expr.op == "+" else off - d)
+        if isinstance(expr, c_ast.ArrayRef):
+            # PARTIAL indexing decays a sub-array to a pointer
+            # (`p = ta[i]` over int ta[2][4] -> base ta, offset i*4).
+            idxs, node2 = [], expr
+            while isinstance(node2, c_ast.ArrayRef):
+                idxs.append(node2.subscript)
+                node2 = node2.name
+            if isinstance(node2, c_ast.ID):
+                base, off0 = self._ptr_parts(node2, sc)
+                if not isinstance(base, tuple):
+                    arrv = sc.g[base]
+                    eff_nd = jnp.ndim(arrv)
+                    if isinstance(sc.ctypes.get(base), _CType64):
+                        eff_nd -= 1
+                    if len(idxs) < eff_nd:
+                        shape = jnp.shape(arrv)
+                        flat = jnp.int32(0)
+                        for d2, ix in enumerate(reversed(idxs)):
+                            stride = int(np.prod(shape[d2 + 1:eff_nd],
+                                                 dtype=np.int64))
+                            flat = flat + jnp.asarray(
+                                self.eval(ix, sc), jnp.int32) * stride
+                        return base, off0 + flat
         raise CLiftError(
             f"unsupported pointer expression at {getattr(expr, 'coord', '?')}")
 
@@ -1391,8 +1420,12 @@ class _Compiler:
             raise CLiftError(f"unsupported array base at {node.coord}")
         name = node.name
         cursor = (sc.locals.get(name) if name in sc.aliases else None)
-        arr = (sc.g[sc.aliases[name]] if name in sc.aliases
-               else sc.read(name))
+        if name in sc.aliases and isinstance(sc.aliases[name], tuple):
+            arr = self._union_read(sc, sc.aliases[name])
+        elif name in sc.aliases:
+            arr = sc.g[sc.aliases[name]]
+        else:
+            arr = sc.read(name)
         idx = tuple(self.eval(i, sc).astype(jnp.int32)
                     for i in reversed(idxs))
         if cursor is not None:
@@ -1422,6 +1455,13 @@ class _Compiler:
             return
         if isinstance(lhs, c_ast.ArrayRef):
             arr, idx, base = self._array_path(lhs, sc)
+            if isinstance(base, tuple):          # union pointer
+                ct = sc.ctypes.get(base[0])
+                stored = (ct.store(val) if ct is not None
+                          else jnp.asarray(val).astype(arr.dtype))
+                self._union_write(
+                    sc, base, arr.at[idx].set(stored.astype(arr.dtype)))
+                return
             ct = sc.ctype(base)
             if isinstance(ct, _CType64):
                 v64 = _to64(val)
@@ -1446,6 +1486,14 @@ class _Compiler:
             # pointer value BEFORE any ++/-- side effect, which
             # _ptr_parts implements (p++ yields the old offset).
             base, off = self._ptr_parts(lhs.expr, sc)
+            if isinstance(base, tuple):          # union pointer
+                ct = sc.ctypes.get(base[0])
+                flat = self._union_read(sc, base)
+                stored = (ct.store(val) if ct is not None
+                          else jnp.asarray(val).astype(flat.dtype))
+                self._union_write(
+                    sc, base, flat.at[off].set(stored.astype(flat.dtype)))
+                return
             arr = sc.g[base]
             ct = sc.ctypes.get(base)
             if isinstance(ct, _CType64):
@@ -1497,7 +1545,14 @@ class _Compiler:
             # into the cursor local).
             name = node.lvalue.name
             base, off = self._ptr_parts(node.rvalue, sc)
-            sc.aliases[name] = base
+            union = self._union_bases(sc.aliases.get(name))
+            if union is not None and not isinstance(base, tuple):
+                # Union pointer: a seat on a member re-bases the cursor
+                # into that member's segment of the concatenation.
+                off = self._union_offset(sc, union, base) + jnp.asarray(
+                    off, jnp.int32)
+            else:
+                sc.aliases[name] = base
             sc.locals[name] = jnp.asarray(off, jnp.int32)
             sc.consts.pop(name, None)
             return off
@@ -1520,6 +1575,20 @@ class _Compiler:
         lhs = node.lvalue
         if isinstance(lhs, c_ast.UnaryOp) and lhs.op == "*":
             base, off = self._ptr_parts(lhs.expr, sc)   # effects, once
+            if isinstance(base, tuple):          # union pointer
+                ct = sc.ctypes.get(base[0])
+                flat0 = self._union_read(sc, base)
+                old = flat0[off]
+                if ct is not None and ct.bits < 32:
+                    old = ct.store(old)
+                val = self._apply_binop(bin_op, old,
+                                        self.eval(node.rvalue, sc), node)
+                stored = (ct.store(val) if ct is not None
+                          else jnp.asarray(val).astype(flat0.dtype))
+                self._union_write(
+                    sc, base,
+                    flat0.at[off].set(stored.astype(flat0.dtype)))
+                return val
             arr = sc.g[base]
             flat = arr.reshape(-1) if jnp.ndim(arr) > 1 else arr
             ct = sc.ctypes.get(base)
@@ -1537,7 +1606,8 @@ class _Compiler:
             return val
         if isinstance(lhs, c_ast.ArrayRef):
             arr, idx, base = self._array_path(lhs, sc)  # subscripts, once
-            ct = sc.ctype(base)
+            ct = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
+                  else sc.ctype(base))
             old = arr[idx]
             if ct is not None and ct.bits < 32:
                 old = ct.store(old)
@@ -1546,6 +1616,9 @@ class _Compiler:
             stored = (ct.store(val) if ct is not None
                       else jnp.asarray(val).astype(arr.dtype))
             new = arr.at[idx].set(stored.astype(arr.dtype))
+            if isinstance(base, tuple):              # union pointer
+                self._union_write(sc, base, new)
+                return val
             orig = sc.read_binding(base)
             if jnp.shape(new) != jnp.shape(orig):
                 new = new.reshape(jnp.shape(orig))
@@ -1578,6 +1651,20 @@ class _Compiler:
                     vals.extend([v.lo, v.hi])
                 else:
                     vals.append(jnp.asarray(v))
+            if (not vals and isinstance(sc.printed, _NoPrintList)
+                    and "__print_buf" in sc.g and arg_nodes
+                    and isinstance(arg_nodes[0], c_ast.Constant)
+                    and arg_nodes[0].type == "string"):
+                # String-only print at a dynamically-reached site: its
+                # string-table id is the buffered word.
+                text = (arg_nodes[0].value[1:-1]
+                        .encode("utf-8").decode("unicode_escape"))
+                if text in self.print_strings:
+                    sid = self.print_strings.index(text)
+                else:
+                    self.print_strings.append(text)
+                    sid = len(self.print_strings) - 1
+                vals = [jnp.uint32(sid)]
             if (vals and isinstance(sc.printed, _NoPrintList)
                     and "__print_buf" in sc.g):
                 # UART-buffer model: dynamically-reached prints append
@@ -1617,6 +1704,15 @@ class _Compiler:
                     # like caller-local arrays.
                     args.append(("__alias_scalar_local__", inner.name))
                     continue
+                if (isinstance(inner, c_ast.ID) and inner.name in sc.g
+                        and jnp.ndim(sc.g[inner.name]) == 0):
+                    # Address of a GLOBAL scalar (jpeg's
+                    # &OutData_image_width): same slot model, copied
+                    # back into the global when the callee returns
+                    # (in-call aliasing with direct reads of the same
+                    # global is outside the envelope).
+                    args.append(("__alias_scalar_global__", inner.name))
+                    continue
                 # &localarr[k]: caller-LOCAL array element address
                 # (motion's &PMV[0]) -- transient slot + cursor k.
                 idxs, node2 = [], inner
@@ -1650,6 +1746,11 @@ class _Compiler:
                     args.append(("__alias_local__", a.name))
                     continue
                 tgt = sc.aliases.get(a.name, a.name)
+                if isinstance(tgt, tuple):       # union pointer forwards
+                    args.append(("__alias_off__", tgt,
+                                 jnp.asarray(sc.locals.get(a.name, 0),
+                                             jnp.int32)))
+                    continue
                 if tgt in sc.g and jnp.ndim(sc.g[tgt]) >= 1:
                     if a.name in sc.aliases and a.name in sc.locals:
                         # A WALKED/SEATED pointer forwards base AND
@@ -1922,7 +2023,98 @@ class _Compiler:
                                  c_ast.BinaryOp("||", cond_expr, eq,
                                                 sw.coord))
                 node = c_ast.If(cond_expr, body, node, sw.coord)
-            return pre + ([node] if node is not None else [])
+            out_sw = pre + ([node] if node is not None else [])
+            # MID-CASE breaks (beyond the stripped terminators) exit the
+            # SWITCH, not any enclosing loop: lower them as a forward
+            # goto to a label right after the if-chain, BEFORE any
+            # enclosing loop's deep-break pass could misbind them.
+            swend = None
+
+            def rb(s):
+                nonlocal swend
+                if isinstance(s, c_ast.Break):
+                    if swend is None:
+                        swend = f"__swend{self._tmp}"
+                        self._tmp += 1
+                    return c_ast.Goto(swend, s.coord)
+                if isinstance(s, (c_ast.While, c_ast.For, c_ast.DoWhile,
+                                  c_ast.Switch)):
+                    return s                     # inner construct's own
+                if isinstance(s, c_ast.If):
+                    return c_ast.If(
+                        s.cond,
+                        rb(s.iftrue) if s.iftrue is not None else None,
+                        rb(s.iffalse) if s.iffalse is not None else None,
+                        s.coord)
+                if isinstance(s, c_ast.Compound):
+                    return c_ast.Compound(
+                        [rb(x) for x in (s.block_items or [])], s.coord)
+                return s
+
+            out_sw = [rb(s) for s in out_sw]
+            if swend is not None:
+                out_sw.append(c_ast.Label(
+                    swend, c_ast.EmptyStatement(sw.coord), sw.coord))
+            return out_sw
+
+        def is_break_if(s) -> bool:
+            if not isinstance(s, c_ast.If) or s.iffalse is not None:
+                return False
+            b = (s.iftrue.block_items or []
+                 if isinstance(s.iftrue, c_ast.Compound) else [s.iftrue])
+            return len(b) == 1 and isinstance(b[0], c_ast.Break)
+
+        def lower_deep_breaks(loop) -> list:
+            """Breaks beyond the `if (c) break;` idiom (jpeg's
+            `if (s) { if ((k += n) >= 64) break; ... }`) lower through
+            the goto machinery: break -> goto __brkN with the label
+            right after the loop."""
+            lbl = None
+
+            def replace(s, top):
+                nonlocal lbl
+                if isinstance(s, c_ast.Break):
+                    if top:
+                        return s                 # the direct idiom's own
+                    if lbl is None:
+                        lbl = f"__brk{self._tmp}"
+                        self._tmp += 1
+                    return c_ast.Goto(lbl, s.coord)
+                if isinstance(s, (c_ast.While, c_ast.For, c_ast.DoWhile,
+                                  c_ast.Switch)):
+                    return s                     # inner loop owns breaks
+                if isinstance(s, c_ast.If):
+                    if top and is_break_if(s):
+                        return s
+                    return c_ast.If(
+                        s.cond,
+                        replace(s.iftrue, False)
+                        if s.iftrue is not None else None,
+                        replace(s.iffalse, False)
+                        if s.iffalse is not None else None, s.coord)
+                if isinstance(s, c_ast.Compound):
+                    return c_ast.Compound(
+                        [replace(x, top) for x in as_items(s)], s.coord)
+                return s
+
+            items2 = as_items(loop.stmt)
+            new_items = []
+            for k, s in enumerate(items2):
+                if isinstance(s, c_ast.Break) and k == len(items2) - 1:
+                    new_items.append(s)          # run-once trailing break
+                else:
+                    new_items.append(replace(s, True))
+            body2 = c_ast.Compound(new_items, loop.coord)
+            if isinstance(loop, c_ast.For):
+                new_loop = c_ast.For(loop.init, loop.cond, loop.next,
+                                     body2, loop.coord)
+            else:
+                new_loop = c_ast.While(loop.cond, body2, loop.coord)
+            if lbl is None:
+                return [new_loop]
+            return [new_loop,
+                    c_ast.Label(lbl, c_ast.EmptyStatement(loop.coord),
+                                loop.coord)]
 
         def xform(stmt, in_branch: bool) -> list:
             if isinstance(stmt, c_ast.Switch):
@@ -1944,8 +2136,9 @@ class _Compiler:
                 return [c_ast.While(stmt.cond, body, stmt.coord)]
             if isinstance(stmt, c_ast.For):
                 body = xform_block(stmt.stmt, True)
-                return [c_ast.For(stmt.init, stmt.cond, stmt.next, body,
-                                  stmt.coord)]
+                return lower_deep_breaks(
+                    c_ast.For(stmt.init, stmt.cond, stmt.next, body,
+                              stmt.coord))
             if isinstance(stmt, c_ast.If):
                 t = (xform_block(stmt.iftrue, True)
                      if stmt.iftrue is not None else None)
@@ -2202,7 +2395,21 @@ class _Compiler:
         walked = self._walked_names(fndef.body)
         copy_backs: List[Tuple[str, str]] = []
         scalar_backs: List[Tuple[str, str]] = []
+        g_scalar_backs: List[Tuple[str, str, object]] = []
         for pi, (p, a) in enumerate(zip(params, args)):
+            if (isinstance(a, tuple) and len(a) == 2
+                    and a[0] == "__alias_scalar_global__"):
+                temp = f"__loc{self._tmp}"
+                self._tmp += 1
+                gv = sc.g[a[1]]
+                sc.g[temp] = jnp.reshape(gv, (1,))
+                oct_ = self.g_ctypes.get(a[1])
+                if oct_ is not None:
+                    sc.ctypes[temp] = oct_
+                sc.aliases[p.name] = temp
+                sc.locals[p.name] = jnp.int32(0)
+                g_scalar_backs.append((temp, a[1], gv.dtype))
+                continue
             if (isinstance(a, tuple) and len(a) == 2
                     and a[0] == "__alias_scalar_local__"):
                 temp = f"__loc{self._tmp}"
@@ -2273,6 +2480,11 @@ class _Compiler:
                 self._const_set(sc, p.name, kc,
                                 ct if not isinstance(ct, _CType64)
                                 else None)
+        # Function-wide pointer pre-seating: a pointer seated over
+        # DIFFERENT arrays in different loops (ChenIDct's aptr over x
+        # then y) must take its union alias before the first loop
+        # traces, not per-loop.
+        self._preseat(fndef.body, sc)
         new_items, set_n, val_n, synth = self._rewrite_early_returns(fndef)
         if new_items is not None:
             rett = fndef.decl.type.type
@@ -2302,6 +2514,10 @@ class _Compiler:
             ret = self._exec_block(fndef.body, sc)
         for temp, lname in copy_backs:
             outer_sc.locals[lname] = sc.g.pop(temp)
+        for temp, gname, dt in g_scalar_backs:
+            slot = sc.g.pop(temp)
+            sc.g[gname] = jnp.reshape(slot, ()).astype(dt)
+            outer_sc.consts.pop(gname, None)
         for temp, lname in scalar_backs:
             slot = sc.g.pop(temp)
             oct_ = outer_sc.ctype(lname)
@@ -2414,11 +2630,17 @@ class _Compiler:
                 if stmt.init is None:
                     # Declared-but-unbound: a bare cursor with no alias
                     # until `p = arr;` re-seats it (adpcm.c's h_ptr);
-                    # any deref before that fails loudly.
-                    sc.locals[stmt.name] = jnp.int32(0)
+                    # any deref before that fails loudly.  A function-
+                    # wide pre-seat may already have aliased it.
+                    sc.locals.setdefault(stmt.name, jnp.int32(0))
                     return None
                 base, off = self._ptr_parts(stmt.init, sc)
-                sc.aliases[stmt.name] = base
+                union = self._union_bases(sc.aliases.get(stmt.name))
+                if union is not None and not isinstance(base, tuple):
+                    off = (self._union_offset(sc, union, base)
+                           + jnp.asarray(off, jnp.int32))
+                else:
+                    sc.aliases[stmt.name] = base
                 sc.locals[stmt.name] = off
                 return None
             ct = _ctype_of(getattr(stmt.type.type, "names", ["int"]),
@@ -2697,6 +2919,7 @@ class _Compiler:
         # against the right global (chains and casts included).
         local_ptr: Dict[str, str] = {}
         ptr_names: set = set()
+        multi_seats: Dict[str, set] = {}        # union-pointer candidates
 
         def resolve(nm):
             for _ in range(8):
@@ -2753,11 +2976,26 @@ class _Compiler:
                         base = seat_base(n.rvalue)
                         if base is not None and base != n.lvalue.name:
                             local_ptr[n.lvalue.name] = base
+                            r = resolve(n.lvalue.name)
+                            if r in g_names:
+                                multi_seats.setdefault(
+                                    n.lvalue.name, set()).add(r)
                     v.generic_visit(n)
                     return
                 tgt = target_of(n.lvalue)
                 if tgt in g_names:
                     out.add(tgt)
+                # A deref store through a MULTI-seated (union) pointer
+                # may write any of its candidate bases.
+                t2 = n.lvalue
+                derefed = False
+                while isinstance(t2, (c_ast.ArrayRef, c_ast.UnaryOp)):
+                    derefed = True
+                    t2 = (t2.name if isinstance(t2, c_ast.ArrayRef)
+                          else t2.expr)
+                if (derefed and isinstance(t2, c_ast.ID)
+                        and len(multi_seats.get(t2.name, ())) > 1):
+                    out.update(multi_seats[t2.name])
                 v.generic_visit(n)
 
             def visit_UnaryOp(v, n):
@@ -2795,6 +3033,13 @@ class _Compiler:
                                 tgt = resolve(a.name)
                                 if tgt in g_names:
                                     sub2[p] = tgt
+                            elif (isinstance(a, c_ast.UnaryOp)
+                                    and a.op == "&"):
+                                # &global out-param: the callee may
+                                # write the pointee.
+                                for b in comp._base_ids(a):
+                                    if resolve(b) in g_names:
+                                        out.add(resolve(b))
                         out.update(comp.written_globals(
                             callee, g_names, sub2))
                 v.generic_visit(n)
@@ -2802,13 +3047,47 @@ class _Compiler:
         V().visit(fndef.body)
         return out
 
+    @staticmethod
+    def _union_bases(alias) -> Optional[Tuple[str, ...]]:
+        """The member tuple of a union alias, or None for plain ones."""
+        return alias if isinstance(alias, tuple) else None
+
+    def _union_offset(self, sc: _Scope, bases: Tuple[str, ...],
+                      member: str):
+        off = 0
+        for b in bases:
+            if b == member:
+                return jnp.int32(off)
+            off += int(np.prod(jnp.shape(sc.g[b])))
+        raise CLiftError(
+            f"array {member!r} is not a member of the union pointer "
+            f"over {bases}")
+
+    def _union_read(self, sc: _Scope, bases: Tuple[str, ...]):
+        return jnp.concatenate([sc.g[b].reshape(-1) for b in bases])
+
+    def _union_write(self, sc: _Scope, bases: Tuple[str, ...],
+                     flat) -> None:
+        off = 0
+        for b in bases:
+            n = int(np.prod(jnp.shape(sc.g[b])))
+            sc.write_binding(b, flat[off:off + n].reshape(
+                jnp.shape(sc.g[b])))
+            off += n
+
     def _preseat(self, node, sc: _Scope) -> None:
         """Seat outer-declared pointers whose FIRST seating happens inside
         ``node`` (a loop body or branch) before tracing it: the alias map
-        is trace-time state, so the seating must be hoisted.  Only a
-        statically unambiguous single base qualifies; anything else is
-        left for _guard_reseat's loud refusal."""
+        is trace-time state, so the seating must be hoisted.  A single
+        static base seats plainly; MULTIPLE same-dtype candidate bases
+        (jpeg's huffman tables: `p = ac_tbl[i]` in one branch,
+        `p = dc_tbl[i]` in the other) seat as a UNION pointer -- the
+        cursor indexes the concatenation of the members, reads gather
+        from it, writes split back, so the runtime branch merely picks
+        the cursor's segment.  Anything else is left for _guard_reseat's
+        loud refusal."""
         seats: Dict[str, List[str]] = {}
+        decl_ptrs: set = set()
 
         class V(c_ast.NodeVisitor):
             def visit_Assignment(v, n):
@@ -2817,15 +3096,44 @@ class _Compiler:
                         _Compiler._base_ids(n.rvalue))
                 v.generic_visit(n)
 
+            def visit_Decl(v, n):
+                if isinstance(n.type, c_ast.PtrDecl) and n.name:
+                    decl_ptrs.add(n.name)
+                    if n.init is not None:
+                        seats.setdefault(n.name, []).extend(
+                            _Compiler._base_ids(n.init))
+                v.generic_visit(n)
+
         V().visit(node)
         for p, cands in seats.items():
-            if p not in sc.ptrs or p in sc.aliases:
+            if (p not in sc.ptrs and p not in decl_ptrs) \
+                    or p in sc.aliases:
                 continue
             bases = {sc.aliases.get(c, c) for c in cands}
             bases = {b for b in bases
                      if b in sc.g and jnp.ndim(sc.g[b]) >= 1}
             if len(bases) == 1:
                 sc.aliases[p] = bases.pop()
+            elif len(bases) > 1:
+                members = tuple(sorted(bases))
+                dts = {sc.g[b].dtype for b in members}
+
+                def ctkey(b):
+                    ct = sc.ctypes.get(b)
+                    # None and any 32-bit ctype behave identically on
+                    # the lane model (no store narrowing); only NARROW
+                    # members must match exactly.  64-bit members never
+                    # unify (the limb-pair access paths do not speak
+                    # unions) -- a unique key forces the loud
+                    # _guard_reseat refusal instead.
+                    if ct is not None and ct.bits == 64:
+                        return ("w64", b)
+                    if ct is None or ct.bits == 32:
+                        return "w32"
+                    return (ct.dtype, ct.bits, ct.unsigned)
+
+                if len(dts) == 1 and len({ctkey(b) for b in members}) == 1:
+                    sc.aliases[p] = members
 
     def _guard_reseat(self, sc, sub, coord):
         """Refuse pointer re-seating to a DIFFERENT array inside a traced
@@ -2851,13 +3159,20 @@ class _Compiler:
         # the iteration (a read-only extra carry is loop-invariant and
         # hoisted by XLA).
         assigned: List[str] = []
+
+        def add_alias(alias):
+            if isinstance(alias, tuple):
+                assigned.extend(alias)           # union: every member
+            else:
+                assigned.append(alias)
+
         for n in self._assigned_names(stmt):
             if n in sc.locals:
                 assigned.append(n)
                 if n in sc.aliases:
-                    assigned.append(sc.aliases[n])
+                    add_alias(sc.aliases[n])
             else:
-                assigned.append(sc.aliases.get(n, n))
+                add_alias(sc.aliases.get(n, n))
         return [n for n in dict.fromkeys(assigned)
                 if n in sc.locals or n in sc.g]
 
@@ -3186,7 +3501,26 @@ class _Compiler:
             out, ys = jax.lax.scan(body, pack(), None, length=trip)
             unpack(sc, out)
             if ys:
-                sc.printed.extend(list(ys))
+                if (isinstance(sc.printed, _NoPrintList)
+                        and "__print_buf" in sc.g
+                        and all(jnp.ndim(y) == 1 for y in ys)):
+                    # Stacked prints inside a DYNAMIC outer context flow
+                    # into the UART buffer in true stdout order
+                    # (iteration-major interleave).
+                    flat = jnp.stack(
+                        [y.astype(jnp.uint32) for y in ys],
+                        axis=1).reshape(-1)
+                    buf = sc.g["__print_buf"]
+                    cnt = sc.g["__print_cnt"]
+                    idx = cnt + jnp.arange(flat.size, dtype=jnp.int32)
+                    cidx = jnp.clip(idx, 0, _PRINT_BUF_WORDS - 1)
+                    keep = idx < _PRINT_BUF_WORDS
+                    buf = buf.at[cidx].set(
+                        jnp.where(keep, flat, buf[cidx]))
+                    sc.g["__print_buf"] = buf
+                    sc.g["__print_cnt"] = cnt + flat.size
+                else:
+                    sc.printed.extend(list(ys))
             return None
 
         # A side-effecting condition (C's `while (length--)`) cannot be

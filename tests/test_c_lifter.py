@@ -925,13 +925,16 @@ CHSTONE = "/root/reference/tests/chstone"
 def _chstone_oracle(region, want_result):
     """Run the lifted kernel; assert its own oracle: printed
     Result == want_result, RESULT: PASS slot selected, FAIL slot never
-    printed (print_strings ids 0/1 in source order)."""
+    printed.  main's two slots are the last two outputs; programs with
+    a UART buffer (jpeg) carry more strings in the table, so the ids
+    are looked up rather than assumed 0/1."""
     out = np.asarray(region.output(region.run_unprotected()))
     strings = region.meta["print_strings"]
-    assert strings == ["RESULT: PASS\n", "RESULT: FAIL\n"]
+    pass_id = strings.index("RESULT: PASS\n")
+    assert "RESULT: FAIL\n" in strings
     result, pass_slot, fail_slot = out[-3:].astype(np.int64)
     assert result == want_result, f"Result: {result} != {want_result}"
-    assert pass_slot == 0, "RESULT: PASS not printed"
+    assert pass_slot == pass_id, "RESULT: PASS not printed"
     assert fail_slot == 0xFFFFFFFF, "RESULT: FAIL printed"
 
 
@@ -1436,3 +1439,117 @@ int main() {
     vals = dict(zip(obs, out[: len(obs)]))
     assert vals["y"] == 7                      # the branch ran
     assert vals["__exit_state"] == 3           # 1 + 2
+
+
+@pytest.mark.slow
+def test_chstone_jpeg_from_source():
+    """jpeg/ (8 TUs): the full CHStone JPEG decoder ingests whole --
+    UNION pointers (p_xhtbl_bits seated on the ac or dc huffman table
+    per traced branch: the cursor indexes the concatenation of the
+    members, writes split back), function-wide pointer pre-seating
+    (ChenIDct's aptr over x then y), deep breaks lowered through the
+    goto machinery, &global-scalar out-parameters, and the UART print
+    buffer absorbing the marker loop's diagnostics.  Oracle: Result
+    21745 (bit-equal to the native decode), RESULT: PASS."""
+    import glob
+    srcs = sorted(glob.glob(os.path.join(CHSTONE, "jpeg", "*.c")))
+    if not srcs:
+        pytest.skip("reference checkout not present")
+    from coast_tpu.frontend.c_lifter import lift_c
+
+    r = lift_c("jpeg_c", srcs)
+    _chstone_oracle(r, 21745)
+    # No campaign here: one full decode is ~5 min on this 1-core host
+    # and every injection replays the whole decode -- the masking
+    # invariants are covered across the other 11 kernels; jpeg's
+    # protected-run behavior is exercised by the supervisor CLI
+    # (resolve_region accepts the 8-TU path) when chip time allows.
+
+
+def test_union_pointer_exactness(tmp_path):
+    """A pointer seated on DIFFERENT same-shaped arrays per traced
+    branch (the jpeg huffman-table shape): reads gather from the member
+    concatenation, writes split back -- bit-exact vs the C program."""
+    r = _lift_src(tmp_path, """
+int ta[2][4];
+int tb[2][4];
+const int sel[4] = {0, 1, 1, 0};
+int chk;
+int main() {
+    int i, j;
+    int *p;
+    for (i = 0; i < 4; i++) {
+        if (sel[i]) {
+            p = ta[i & 1];
+        } else {
+            p = tb[i & 1];
+        }
+        for (j = 0; j < 4; j++) { p[j] = i * 10 + j; }
+    }
+    for (i = 0; i < 2; i++)
+        for (j = 0; j < 4; j++) { chk = chk * 31 + ta[i][j] + tb[i][j] * 7; }
+    printf("%d\\n", chk);
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected()))
+    assert int(np.int32(out[-1])) == 654832672   # gcc-verified
+
+
+def test_deep_break_via_goto(tmp_path):
+    """A break nested beyond the `if (c) break;` idiom lowers through
+    the goto machinery with exact exit state."""
+    r = _lift_src(tmp_path, """
+int out[8];
+int total;
+int main() {
+    int i, k;
+    k = 0;
+    for (i = 0; i < 8; i++) {
+        if (i > 2) {
+            if (i + k >= 7) break;
+            out[i] = i * 3;
+        } else {
+            out[i] = i;
+        }
+        k += 2;
+    }
+    total = k * 100 + i;
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    assert int(out[-1]) == 603                   # k=6, i=3 at the break
+
+
+def test_switch_break_inside_loop(tmp_path):
+    """A mid-case break binds to the SWITCH (exits the if-chain via a
+    forward goto), never to an enclosing loop (review finding: the
+    deep-break pass previously captured it as a loop exit)."""
+    r = _lift_src(tmp_path, """
+const int x[8] = {1, 1, 2, 1, 2, 1, 1, 2};
+int w;
+int total;
+int main() {
+    int i;
+    for (i = 0; i < 8; i++) {
+        switch (x[i]) {
+        case 1:
+            if (i >= 2) break;      /* exits the SWITCH only */
+            w += 100;
+            break;
+        default:
+            w += 1;
+            break;
+        }
+        w++;
+    }
+    total = w * 1000 + i;
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    # C: i=0,1 -> +100+1 each; i=2,4,7 default -> +1+1; i=3,5,6 case1
+    # break -> +1 each; w = 202 + 6 + 3 = 211; total = 211008
+    # (outputs: sorted written globals [total, w])
+    assert int(out[-2]) == 211 * 1000 + 8 and int(out[-1]) == 211
