@@ -1420,10 +1420,23 @@ class _Compiler:
             raise CLiftError(f"unsupported array base at {node.coord}")
         name = node.name
         cursor = (sc.locals.get(name) if name in sc.aliases else None)
+        base = sc.aliases.get(name, name)
         if name in sc.aliases and isinstance(sc.aliases[name], tuple):
             arr = self._union_read(sc, sc.aliases[name])
         elif name in sc.aliases:
             arr = sc.g[sc.aliases[name]]
+        elif (name in self.g_ptrs and name not in sc.locals):
+            # Subscripting a GLOBAL pointer (gp[i]) routes through its
+            # seated base + cursor, same as _ptr_parts' deref path --
+            # sc.read(name) would hand back the int32 cursor scalar.
+            seated = self.g_ptr_base.get(name)
+            if seated is None:
+                raise CLiftError(
+                    f"global pointer {name!r} subscripted before any "
+                    f"seating at {node.coord}; seat it (p = arr) first")
+            arr = sc.g[seated]
+            cursor = jnp.asarray(sc.read(name), jnp.int32)
+            base = seated
         else:
             arr = sc.read(name)
         idx = tuple(self.eval(i, sc).astype(jnp.int32)
@@ -1432,10 +1445,18 @@ class _Compiler:
             if len(idx) != 1:
                 raise CLiftError(
                     f"walked pointer {name!r} must be 1-D at {node.coord}")
-            if jnp.ndim(arr) > 1:           # cursor over row-major memory
+            # Cursor over row-major memory: flatten to element rows.  A
+            # 64-bit base keeps its trailing limb-pair axis -- the cursor
+            # counts ELEMENTS, and the _CType64 load/store consume (n, 2)
+            # rows; a full flatten would index half-pairs.
+            ct_c = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
+                    else sc.ctype(base))
+            if isinstance(ct_c, _CType64):
+                if jnp.ndim(arr) > 2:
+                    arr = arr.reshape(-1, 2)
+            elif jnp.ndim(arr) > 1:
                 arr = arr.reshape(-1)
             idx = (idx[0] + cursor,)
-        base = sc.aliases.get(name, name)
         return arr, (idx if len(idx) > 1 else idx[0]), base
 
     def _store(self, lhs, val, sc):
@@ -1466,6 +1487,12 @@ class _Compiler:
             if isinstance(ct, _CType64):
                 v64 = _to64(val)
                 new = arr.at[idx].set(jnp.stack([v64.lo, v64.hi]))
+                orig = sc.read_binding(base)
+                if jnp.shape(new) != jnp.shape(orig):
+                    # _array_path flattened a cursor view over a
+                    # multi-dim 64-bit array to (-1, 2) limb rows;
+                    # restore the canonical shape.
+                    new = new.reshape(jnp.shape(orig))
                 sc.write_binding(base, new)
                 return
             stored = (ct.store(val) if ct is not None
@@ -2826,15 +2853,28 @@ class _Compiler:
                 v.generic_visit(n)
 
         V().visit(node)
-        # Deref-written pointers write their seated arrays.
+        # Deref-written pointers write their seated arrays.  A GLOBAL
+        # pointer seated outside the analyzed node (gp = A before the
+        # loop, gp[i] = v inside it) has no local seat entry; its
+        # statically-known candidate bases stand in -- without them the
+        # written array would drop out of a scan's carry.
         for p in dict.fromkeys(deref_targets):
             names.extend(seats.get(p, ()))
+            if p in self.g_ptrs and p not in seats:
+                names.extend(sorted(self._g_ptr_static_bases(p)))
         return list(dict.fromkeys(names))
 
     def _g_ptr_static_base(self, name: str) -> Optional[str]:
         """Static whole-program resolution of a global pointer's base:
-        scan every function for `name = <expr>` seatings and return the
-        single base array they agree on (None if unseated/ambiguous)."""
+        the single base array every seating agrees on (None if
+        unseated/ambiguous)."""
+        bases = self._g_ptr_static_bases(name)
+        return next(iter(bases)) if len(bases) == 1 else None
+
+    def _g_ptr_static_bases(self, name: str) -> frozenset:
+        """ALL candidate base arrays a global pointer's seatings alias:
+        scan every function for `name = <expr>` seatings, collapsing
+        cursor-on-cursor chains.  Empty if never seated."""
         cache = getattr(self, "_g_ptr_seat_cache", None)
         if cache is None:
             cache = {}
@@ -2858,7 +2898,7 @@ class _Compiler:
         # through the other pointer's bases.
         for _ in range(4):
             if not bases:
-                return None
+                return frozenset()
             flat = set()
             again = False
             for b in bases:
@@ -2872,7 +2912,7 @@ class _Compiler:
             bases = flat
             if not again:
                 break
-        return bases.pop() if bases and len(bases) == 1 else None
+        return frozenset(bases)
 
     def _assigned_globals(self, fndef) -> List[str]:
         """Names a callee writes OUTSIDE its own scope: its assigned
@@ -2934,12 +2974,40 @@ class _Compiler:
                 break
             return subst.get(nm, nm)
 
-        def target_of(t):
+        def resolve_all(nm):
+            """Every base a store through ``nm`` may write.  Unlike
+            ``resolve``, an AMBIGUOUS global-pointer seating (gp = A in
+            one function, gp = B in another) unions every candidate:
+            conservatively over-reporting keeps injections into the
+            really-written array out of the masked bucket."""
+            out_s: set = set()
+            frontier, seen = {nm}, set()
+            for _ in range(8):
+                nxt: set = set()
+                for x in frontier:
+                    if x in seen:
+                        continue
+                    seen.add(x)
+                    if x in local_ptr:
+                        nxt.add(local_ptr[x])
+                        continue
+                    if x in comp.g_ptrs:
+                        bases = comp._g_ptr_static_bases(x) - {x}
+                        if bases:
+                            nxt.update(bases)
+                            continue
+                    out_s.add(subst.get(x, x))
+                if not nxt:
+                    break
+                frontier = nxt
+            return out_s
+
+        def targets_of(t):
             while isinstance(t, (c_ast.ArrayRef, c_ast.UnaryOp)):
                 t = t.name if isinstance(t, c_ast.ArrayRef) else t.expr
             if isinstance(t, c_ast.ID):
-                return resolve(t.name)
-            return None
+                return resolve_all(t.name)
+            return set()
 
         def seat_base(expr):
             """First base identifier a seating RHS aliases, resolved."""
@@ -2982,9 +3050,8 @@ class _Compiler:
                                     n.lvalue.name, set()).add(r)
                     v.generic_visit(n)
                     return
-                tgt = target_of(n.lvalue)
-                if tgt in g_names:
-                    out.add(tgt)
+                out.update(t for t in targets_of(n.lvalue)
+                           if t in g_names)
                 # A deref store through a MULTI-seated (union) pointer
                 # may write any of its candidate bases.
                 t2 = n.lvalue
@@ -3006,9 +3073,8 @@ class _Compiler:
                             and (n.expr.name in subst
                                  or n.expr.name in local_ptr)):
                         return
-                    tgt = target_of(n.expr)
-                    if tgt in g_names:
-                        out.add(tgt)
+                    out.update(t for t in targets_of(n.expr)
+                               if t in g_names)
                 v.generic_visit(n)
 
             def visit_FuncCall(v, n):
@@ -3513,10 +3579,13 @@ class _Compiler:
                     buf = sc.g["__print_buf"]
                     cnt = sc.g["__print_cnt"]
                     idx = cnt + jnp.arange(flat.size, dtype=jnp.int32)
-                    cidx = jnp.clip(idx, 0, _PRINT_BUF_WORDS - 1)
-                    keep = idx < _PRINT_BUF_WORDS
-                    buf = buf.at[cidx].set(
-                        jnp.where(keep, flat, buf[cidx]))
+                    # mode="drop" discards out-of-range writes outright:
+                    # clipping them onto the last word would scatter
+                    # duplicate indices with conflicting values, and JAX
+                    # leaves duplicate-index order unspecified -- the
+                    # legit final word could lose to a stale overflow row
+                    # exactly when the buffer fills.
+                    buf = buf.at[idx].set(flat, mode="drop")
                     sc.g["__print_buf"] = buf
                     sc.g["__print_cnt"] = cnt + flat.size
                 else:

@@ -48,7 +48,7 @@ run_stage() {
 }
 
 all_done() {
-  for s in bench flagship_campaign mfu_sweep flip_kernel_study campaign_1m; do
+  for s in bench unroll_sweep mfu_sweep flagship_campaign flip_kernel_study campaign_1m; do
     [ -e "$STATE/$s.done" ] || return 1
   done
   return 0
@@ -67,8 +67,9 @@ while true; do
     # bench.py supervises itself (420s init + retry + 900s run budgets);
     # the outer bound only guards against a hang beyond its own design.
     run_stage bench             2700 python bench.py
-    run_stage flagship_campaign 2400 python -u scripts/flagship_campaign.py
+    run_stage unroll_sweep      2700 python -u scripts/unroll_sweep.py
     run_stage mfu_sweep         2700 python -u scripts/mfu_sweep.py
+    run_stage flagship_campaign 2400 python -u scripts/flagship_campaign.py
     run_stage flip_kernel_study 1500 python -u scripts/flip_kernel_study.py
     run_stage campaign_1m       2400 python -u scripts/campaign_1m.py \
       --out artifacts/campaign_mm_1m.json --logdir /tmp

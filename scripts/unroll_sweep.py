@@ -1,0 +1,112 @@
+"""On-chip A/B that settles the toy-campaign defaults (VERDICT r4 #2).
+
+The diagnosis of the small-benchmark campaign's TPU deficit (docs/perf.md
+"Campaign throughput") is that batch-varying dynamic-slice indexing lowers
+to gather/scatter, off the dense-op roofline.  Both countermeasures are in
+tree -- ``ops/indexing.py`` one-hot lowering and ``CampaignRunner(unroll=N)``
+-- but as of round 4 the ``"auto"`` default turns one-hot ON on TPU on an
+unverified hypothesis.  This sweep measures the full cross product
+
+    indexing mode {slice, onehot} x unroll {1, 2, 4, 8}
+
+on matrixMultiply under TMR (the campaign the deficit was observed on),
+with a fixed seeded schedule so every cell classifies the identical fault
+list -- asserted, since ops/indexing.py promises bit-identical semantics
+across modes.  The artifact records inj/s per cell plus the winning cell;
+``ops/indexing.py`` and ``CampaignRunner`` defaults are set from it.
+
+Resumable: completed cells found in an existing artifact are kept, so a
+short tunnel window that captures only some cells is not wasted.
+
+Writes artifacts/unroll_sweep.json (TPU) / unroll_sweep_cpu_smoke.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_INJ = int(os.environ.get("COAST_SWEEP_N", 50_000))
+BATCH = int(os.environ.get("COAST_SWEEP_BATCH", 2048))
+SEED = 2026
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("COAST_STUDY_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()
+    out = ("artifacts/unroll_sweep.json" if backend == "tpu"
+           else "artifacts/unroll_sweep_cpu_smoke.json")
+
+    art = {"backend": backend, "device": str(jax.devices()[0]),
+           "n_per_cell": N_INJ, "batch": BATCH, "seed": SEED, "cells": {}}
+    if os.path.exists(out):
+        try:
+            with open(out) as fh:
+                prev = json.load(fh)
+            if (prev.get("backend") == backend
+                    and prev.get("n_per_cell") == N_INJ):
+                art["cells"] = prev.get("cells", {})
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY
+
+    ref_counts = None
+    for mode in ("slice", "onehot"):
+        for unroll in (1, 2, 4, 8):
+            key = f"{mode}_u{unroll}"
+            if key in art["cells"]:
+                ref_counts = ref_counts or art["cells"][key]["counts"]
+                continue
+            # Resolved at trace time inside ops/indexing.py `_resolve`;
+            # each cell builds a fresh runner so its jit cache traces
+            # under this forcing.
+            os.environ["COAST_INDEXING_MODE"] = mode
+            prog = TMR(REGISTRY["matrixMultiply"]())
+            runner = CampaignRunner(prog, strategy_name="TMR",
+                                    unroll=unroll)
+            t0 = time.perf_counter()
+            runner.run(BATCH, seed=1, batch_size=BATCH)  # warm compile
+            compile_s = time.perf_counter() - t0
+            res = runner.run(N_INJ, seed=SEED, batch_size=BATCH)
+            cell = {"inj_per_sec": round(res.injections_per_sec, 1),
+                    "seconds": round(res.seconds, 3),
+                    "compile_s": round(compile_s, 2),
+                    "counts": res.counts}
+            if ref_counts is None:
+                ref_counts = res.counts
+            else:
+                assert res.counts == ref_counts, (
+                    f"classification drift in {key}: "
+                    f"{res.counts} != {ref_counts}")
+            art["cells"][key] = cell
+            print(f"# {key}: {cell['inj_per_sec']:.0f} inj/s "
+                  f"(compile {compile_s:.0f}s)", file=sys.stderr, flush=True)
+            with open(out, "w") as fh:   # persist per cell (resumable)
+                json.dump(art, fh, indent=1, sort_keys=True)
+    os.environ.pop("COAST_INDEXING_MODE", None)
+
+    best = max(art["cells"], key=lambda k: art["cells"][k]["inj_per_sec"])
+    art["winner"] = best
+    art["decision"] = (
+        f"fastest cell {best} at {art['cells'][best]['inj_per_sec']:.0f} "
+        f"inj/s; defaults in ops/indexing.py / CampaignRunner should match")
+    with open(out, "w") as fh:
+        json.dump(art, fh, indent=1, sort_keys=True)
+    print(json.dumps({k: v["inj_per_sec"] for k, v in art["cells"].items()}))
+    print(f"winner: {best} -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1553,3 +1553,142 @@ int main() {
     # break -> +1 each; w = 202 + 6 + 3 = 211; total = 211008
     # (outputs: sorted written globals [total, w])
     assert int(out[-2]) == 211 * 1000 + 8 and int(out[-1]) == 211
+
+
+def test_macro_never_substitutes_inside_literals():
+    """cpp parity (ADVICE r3/r4): a macro name inside a string or char
+    literal must survive expansion -- both object-like and
+    function-like forms (c_lifter.preprocess masks literals)."""
+    from coast_tpu.frontend.c_lifter import preprocess
+    out, _, _, _ = preprocess("""
+#define N 5
+#define ADD(a, b) ((a) + (b))
+int main() {
+    printf("N = %d ADD(N, 1)\\n", ADD(N, 2));
+    char c = 'N';
+    return 0;
+}
+""", [])
+    assert '"N = %d ADD(N, 1)\\n"' in out     # literal untouched
+    assert "'N'" in out                       # char literal untouched
+    assert "(((5)) + ((2)))" in out           # real call expanded
+
+
+def test_global_pointer_subscript(tmp_path):
+    """gp[i] on a seated GLOBAL pointer reads/writes the seated base at
+    cursor+i (ADVICE r4: previously an opaque IndexError -- only the
+    *(gp+i) deref spelling worked)."""
+    r = _lift_src(tmp_path, """
+unsigned int A[4];
+unsigned int *gp;
+unsigned int total = 0;
+int main() {
+    int i;
+    gp = A;
+    for (i = 0; i < 4; i++) { gp[i] = i + 1; }
+    for (i = 0; i < 4; i++) { total += gp[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    assert out[-1] == 10
+
+
+def test_ambiguous_global_pointer_seating_observed(tmp_path):
+    """When a global pointer's static seatings disagree across functions
+    (never() seats gp = B, main seats gp = A), the written set must
+    conservatively contain every candidate base -- dropping A would
+    classify injections corrupting it as masked (ADVICE r4 medium)."""
+    r = _lift_src(tmp_path, """
+unsigned int A[4];
+unsigned int B[4];
+unsigned int *gp;
+unsigned int total = 0;
+void never() { gp = B; }
+int main() {
+    int i;
+    gp = A;
+    for (i = 0; i < 4; i++) { gp[i] = i + 1; }
+    for (i = 0; i < 4; i++) { total += A[i]; }
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    obs = r.meta["observed_globals"]
+    assert "A" in obs, obs                    # the really-written array
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    assert out[-1] == 10
+
+
+def test_walked_longlong_pointer_subscript(tmp_path):
+    """p[i] on a WALKED long long* parameter indexes limb-pair rows
+    (ADVICE r4: the cursor branch used to flatten (n,2) to 1-D words
+    and crash in the _CType64 load; only *(p+i) worked)."""
+    r = _lift_src(tmp_path, """
+long long vals[4] = {1, 2, 3, 4};
+unsigned int total = 0;
+void addfrom(long long *p) {
+    int i;
+    p++;
+    for (i = 0; i < 2; i++) { total += (unsigned int)p[i]; }
+}
+int main() {
+    addfrom(vals);
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    assert out[-1] == 5                       # vals[1] + vals[2]
+
+
+def test_print_buffer_overflow_boundary_deterministic(tmp_path):
+    """Exactly-filling the dynamic-context print buffer must keep the
+    final in-bounds word (ADVICE r4: the clipped scatter aliased every
+    overflow index onto the last word with unspecified write order)."""
+    r = _lift_src(tmp_path, """
+unsigned int total = 0;
+unsigned int sink = 0;
+int main() {
+    int i;
+    while (total < 2) {
+        for (i = 0; i < 150; i++) { sink += 1; printf("%u\\n", sink); }
+        total += 1;
+    }
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    # outputs: __print_buf(256), __print_cnt, sink, total
+    buf, cnt = out[:256], out[256]
+    assert cnt == 300                         # all prints counted
+    assert buf[0] == 1 and buf[149] == 150    # first pass
+    assert buf[255] == 256                    # final in-bounds word kept
+
+
+def test_walked_longlong_pointer_store_multidim(tmp_path):
+    """Storing through a walked long long* over a MULTI-dim array must
+    restore the canonical binding shape after _array_path's (-1, 2)
+    limb-row flatten (review finding on the r5 cursor fix)."""
+    r = _lift_src(tmp_path, """
+long long m[2][2];
+unsigned int total = 0;
+void poke(long long *p) {
+    int i;
+    p++;
+    for (i = 0; i < 2; i++) { p[i] = 9; }
+}
+int main() {
+    int i; int j;
+    for (i = 0; i < 2; i++)
+        for (j = 0; j < 2; j++) m[i][j] = 2 * i + j + 1;
+    poke(m);
+    for (i = 0; i < 2; i++)
+        for (j = 0; j < 2; j++) total += (unsigned int)m[i][j];
+    printf("%u\\n", total);
+    return 0;
+}
+""")
+    out = np.asarray(r.output(r.run_unprotected())).astype(np.int64)
+    assert out[-1] == 1 + 9 + 9 + 4           # m[0][1], m[1][0] poked
