@@ -2350,7 +2350,18 @@ class _Compiler:
                             "modeled envelope (forward jumps only)")
             out: List[object] = []
             seen_goto = False
-            for it in items:
+            for k_i, it in enumerate(items):
+                if (seen_goto and isinstance(it, c_ast.Break)
+                        and k_i == len(items) - 1):
+                    # A trailing break (the run-once while(1) idiom) is
+                    # reached on every path: forward-only jumps mean all
+                    # this level's labels precede it, and each label
+                    # resets its flag -- so by here every guard passes.
+                    # It must also STAY a syntactic Break, or
+                    # _exec_while no longer recognizes the idiom and the
+                    # loop falls to the dynamic-while lowering.
+                    out.append(it)
+                    continue
                 if isinstance(it, c_ast.Label) and it.name in active:
                     out.append(c_ast.Assignment(
                         "=", c_ast.ID(flag_for(it.name), it.coord),

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.extend.core import Literal
 
 from coast_tpu.ir.graph import BlockGraph
@@ -284,23 +285,27 @@ def _all_prims(eqns):
                     yield from _all_prims(obj.eqns)
 
 
-def _warn_unstepped(eqns, where: str) -> None:
-    """Loudly flag program work that will execute OUTSIDE the stepped
-    injection window (inside output()): the reference engine protects the
-    whole module (cloning.cpp:62-288), so silently un-stepped compute
-    would under-report the program's cross-section."""
-    heavy = [p for p in _all_prims(eqns) if p in _HEAVY_PRIMS]
-    if heavy or len(eqns) > 24:
-        import warnings
-        what = (f"heavy ops {sorted(set(heavy))}" if heavy
-                else f"{len(eqns)} equations")
-        warnings.warn(
-            f"lift_fn: the {where} contains {what} that run inside "
-            "output(), OUTSIDE the stepped injection window -- faults are "
-            "never injected during that work.  Restructure so the work "
-            "lives in a top-level lax.scan/while_loop (each becomes a "
-            "stepped phase), or author the region via lift_step.",
-            stacklevel=3)
+def _epilogue_is_heavy(eqns) -> bool:
+    """Epilogues with real work (heavy primitives, or enough equations
+    to carry a meaningful cross-section) are lowered into a final
+    stepped transition so they execute INSIDE the injection window --
+    the reference engine protects the whole module (cloning.cpp:62-288).
+    Trivial epilogues (output projections, a handful of reshapes) stay
+    in output(): stepping them would churn every region's leaf layout
+    for no injectable surface."""
+    if len(eqns) > 24:
+        return True
+    return any(p in _HEAVY_PRIMS for p in _all_prims(eqns))
+
+
+def _out_words(outvars) -> int:
+    """Word count of the flattened u32 output image (_flat_u32)."""
+    total = 0
+    for v in outvars:
+        shape = (np.shape(v.val) if isinstance(v, Literal)
+                 else v.aval.shape)
+        total += int(np.prod(shape, dtype=np.int64))
+    return total
 
 
 class _Phase:
@@ -478,9 +483,11 @@ def lift_fn(name: str,
     phase (the reference protects the whole module, cloning.cpp:62-288,
     not just its hottest loop).  The prologue is evaluated once into the
     initial state; code between loops (interludes) runs as stepped phase
-    transitions; the epilogue after the last loop becomes the output
-    projection (warned about loudly if it contains real work, since it
-    executes outside the injection window).
+    transitions; an epilogue with real work (heavy primitives or many
+    equations) runs as a FINAL stepped transition writing the flattened
+    output image into an ``_outbuf`` memory leaf -- inside the injection
+    window -- while a trivial epilogue stays in output() as a pure
+    projection.
 
     Single-loop leaf names: ``c<i>`` loop carries, ``k<i>`` loop-invariant
     captures (read-only), ``x<i>`` scanned inputs, ``y<i>`` stacked scan
@@ -518,7 +525,6 @@ def lift_fn(name: str,
                            (loop_idx[p + 1] if p + 1 < len(loop_idx)
                             else len(jaxpr.eqns))]
                 for p in range(len(loop_idx))]
-    _warn_unstepped(segments[-1], "epilogue (code after the last loop)")
 
     # Prologue values consumed past the loop boundary become ro leaves
     # (g<j>); non-32-bit ones cannot enter the word-addressed memory map
@@ -581,6 +587,60 @@ def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
     base_leaves = phase.leaves_from_invals(in_vals)
     g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
 
+    def eval_epilogue(st):
+        e = _seed_env(st, g_map, baked)
+        for v, val in zip(loop.outvars, phase.outs_from_state(st)):
+            e[v] = val
+        _eval_eqns(epi_eqns, e)
+        return _flat_u32([_read(e, v) for v in jaxpr.outvars])
+
+    if _epilogue_is_heavy(epi_eqns):
+        # The epilogue runs as ONE extra stepped transition that writes
+        # the flattened output image into an ``_outbuf`` memory leaf --
+        # inside the injection window, like everything else the program
+        # computes (the reference's exitMarker breakpoints on exactly
+        # this final memory image, exitMarker.cpp:96-140).  output()
+        # then just reads the leaf.
+        #
+        # Cost note: under a vmapped campaign lax.cond lowers to select
+        # (both branches execute per lane per step), so the epilogue is
+        # re-evaluated every step -- the same shape multi-phase
+        # transitions already have.  That prices fidelity over
+        # throughput deliberately: outside the window the work was
+        # invisible to injection, which under-reports the program's
+        # cross-section (the reference protects the whole module).
+        def init_fn():
+            return {**base_leaves, **g_leaves,
+                    "_phase": jnp.int32(0),
+                    "_outbuf": jnp.zeros((_out_words(jaxpr.outvars),),
+                                         jnp.uint32)}
+
+        def epi_transition(st):
+            new = dict(st)
+            new["_outbuf"] = eval_epilogue(st)
+            new["_phase"] = jnp.int32(1)
+            return new
+
+        def step(st, t):
+            return jax.lax.cond(
+                jnp.logical_and(phase.phase_done(st), st["_phase"] == 0),
+                epi_transition, phase.iter_step, st)
+
+        def done(st):
+            return st["_phase"] >= 1
+
+        def output(st):
+            return st["_outbuf"]
+
+        nominal = (phase.length + 1 if phase.prim == "scan" else None)
+        return lift_step(
+            name, step, init_fn, done=done, output=output,
+            nominal_steps=nominal, max_steps=max_steps,
+            annotations=annotations, default_xmr=default_xmr,
+            step_cap=step_cap,
+            meta={"lifted_from": "fn", "loop": phase.prim,
+                  "stepped_epilogue": True, **(meta or {})})
+
     def init_fn():
         return {**base_leaves, **g_leaves}
 
@@ -591,11 +651,7 @@ def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
         return phase.phase_done(st)
 
     def output(st):
-        e = _seed_env(st, g_map, baked)
-        for v, val in zip(loop.outvars, phase.outs_from_state(st)):
-            e[v] = val
-        _eval_eqns(epi_eqns, e)
-        return _flat_u32([_read(e, v) for v in jaxpr.outvars])
+        return eval_epilogue(st)
 
     nominal = phase.length if phase.prim == "scan" else None
     return lift_step(
@@ -612,7 +668,8 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
     """Multi-phase region: phase p executes loop p one iteration per step;
     when loop p completes, ONE transition step evaluates the interlude
     (code between loop p and loop p+1), seeds phase p+1's leaves, and
-    advances ``_phase``.  The epilogue stays in output()."""
+    advances ``_phase``.  A heavy epilogue runs in the final transition
+    (into ``_outbuf``); a trivial one stays in output()."""
     m = len(loops)
 
     # Interlude values consumed by LATER segments (beyond the transition
@@ -644,6 +701,11 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
 
     g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
     in_vals0 = [_read(env, v) for v in loops[0].invars]
+    # A heavy epilogue executes inside the FINAL transition step (the
+    # last inter-phase), writing the flattened output image into an
+    # ``_outbuf`` memory leaf -- inside the injection window; output()
+    # reads the leaf (the exitMarker final-memory-image discipline).
+    stepped_epi = _epilogue_is_heavy(segments[m - 1])
 
     def init_fn():
         st = {"_phase": jnp.int32(0), **g_leaves}
@@ -652,6 +714,9 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
             st.update(phases[p].zero_leaves())
         for v, leaf in mm_map.items():
             st[leaf] = jnp.zeros(v.aval.shape, v.aval.dtype)
+        if stepped_epi:
+            st["_outbuf"] = jnp.zeros((_out_words(jaxpr.outvars),),
+                                      jnp.uint32)
         return st
 
     def full_env(st, upto: int):
@@ -666,7 +731,9 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
         return e
 
     def transition(p):
-        """Loop p finished: evaluate interlude p, seed phase p+1, advance."""
+        """Loop p finished: evaluate interlude p, seed phase p+1, advance.
+        The final transition (p == m-1) evaluates a heavy epilogue into
+        ``_outbuf`` so its work is stepped."""
         def tr(st):
             new = dict(st)
             if p < m - 1:
@@ -677,6 +744,11 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
                 for v, leaf in mm_map.items():
                     if m_producer[v] == p:
                         new[leaf] = e[v]
+            elif stepped_epi:
+                e = full_env(st, m - 1)
+                _eval_eqns(segments[m - 1], e)
+                new["_outbuf"] = _flat_u32(
+                    [_read(e, v) for v in jaxpr.outvars])
             new["_phase"] = st["_phase"] + 1
             return new
         return tr
@@ -697,13 +769,16 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
         return st["_phase"] >= m
 
     def output(st):
+        if stepped_epi:
+            return st["_outbuf"]
         e = full_env(st, m - 1)
         _eval_eqns(segments[m - 1], e)
         return _flat_u32([_read(e, v) for v in jaxpr.outvars])
 
     # Explicit prologue/loop/interlude/epilogue structure for CFCSS:
     # entry=0, loop<p>=2p+1, inter<p>=2p+2, exit=2m+1.  inter<m-1> is the
-    # final transition into exit (the epilogue itself runs in output()).
+    # final transition into exit (and runs a heavy epilogue; a trivial
+    # one stays in output()).
     names = ["entry"]
     for p in range(m):
         names += [f"loop{p}", f"inter{p}"]

@@ -91,9 +91,12 @@ class CampaignRunner:
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
-        it trades per-iteration loop overhead against masked work, which
-        matters on dispatch-bound backends (the small-benchmark TPU
-        campaign: scripts/mfu_sweep.py measures the trade)."""
+        it trades per-iteration loop overhead against masked work.
+        MEASURED on-chip (artifacts/unroll_sweep.json, 2026-08-01): with
+        one-hot indexing the knob is noise (27.2-27.7k inj/s across
+        {1,2,4,8}) and under the slice lowering it HURTS (5.8k -> 2.2k),
+        so the default stays 1; the win the hypothesis predicted belonged
+        to the indexing mode, not the unroll."""
         self.prog = prog
         self.mmap = MemoryMap(prog, sections)
         self.strategy_name = strategy_name or f"N={prog.cfg.num_clones}"

@@ -18,13 +18,17 @@ selectable lowering:
   elementwise/reduction ops, so the vmapped campaign stays on the VPU.
 * ``"auto"``   -- ``"onehot"`` when the default backend is a TPU AND the
   indexed axis is small (<= ``ONEHOT_MAX_ROWS``), else ``"slice"``.
-  The dense form reads every row per access (O(n * row) vs the slice's
-  O(row)), so it is a win only where per-op dispatch/gather overhead
-  dominates -- the guest models' small working arrays.  Long arrays
-  (e.g. lifted scans over big inputs) keep the slice lowering until the
-  on-chip A/B (scripts/mfu_sweep.py) says otherwise.  Gathers are cheap
-  on CPU and the host fallback's throughput record lives there, so CPU
-  always resolves to ``"slice"``.
+  MEASURED on-chip (v5 lite, 2026-08-01, 50k injections/cell,
+  ``artifacts/unroll_sweep.json``): one-hot carries the mm-TMR campaign
+  at 27.2-27.7k inj/s across unroll {1,2,4,8} vs 5.8k for the slice
+  lowering at unroll=1 (degrading to 2.2k at unroll=8) -- a 4.7x win at
+  the defaults, 10x at the bench batch (``artifacts/mfu_sweep.json``
+  "unroll" grid: ~54k vs ~5.5k).  The dense form reads every row per
+  access (O(n * row) vs the slice's O(row)), so the win is confined to
+  small indexed axes where gather/scatter dispatch dominates; long
+  arrays (e.g. lifted scans over big inputs) keep the slice lowering.
+  Gathers are cheap on CPU and the host fallback's throughput record
+  lives there, so CPU always resolves to ``"slice"``.
 
 Both lowerings treat an out-of-range index exactly like dynamic-slice
 does -- one python-style negative wrap, then clamp into range (a

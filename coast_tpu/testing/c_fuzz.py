@@ -96,6 +96,11 @@ class _Gen:
         g.append("unsigned int rs[6] = {"
                  + ", ".join(str(r.randrange(1, 500))
                              for _ in range(6)) + "};")
+        # Same-shaped partner for the union-pointer block (a pointer
+        # seated on rs OR ua per traced branch).
+        g.append("unsigned int ua[6] = {"
+                 + ", ".join(str(r.randrange(1, 500))
+                             for _ in range(6)) + "};")
         # Named 'b' ON PURPOSE: it collides with MIXM's second parameter,
         # so passing it as the FIRST argument pins simultaneous (non-
         # sequential) macro substitution.
@@ -214,9 +219,48 @@ class _Gen:
                     f"acc0 += *rp++; rp = rp + {r.randrange(1, 3)}; "
                     f"acc1 ^= *rp; rp = rs; acc0 += rp[{r.randrange(0, 5)}]"
                     f" + rp[1]; *rp = acc0 & 1023u; }}")
+        # Forward goto over live work (the CHStone adpcm/dfdiv shape):
+        # the skipped statements must be masked exactly per the
+        # data-dependent predicate, including a skipped array store.
+        body.append(f"  if ((acc0 & {r.choice([3, 7, 15])}u) == "
+                    f"{r.randrange(0, 3)}u) goto fskip; "
+                    f"acc1 += {r.randrange(1, 999)}u; "
+                    f"rs[{r.randrange(0, 6)}] ^= acc1; "
+                    f"acc0 = acc0 * 5u + 1u; "
+                    f"fskip: acc0 ^= {r.randrange(1, 99)}u;")
+        # Union pointer: seated on DIFFERENT same-shaped globals per
+        # traced branch (jpeg huffman-table shape); writes through the
+        # branch-seated pointer must split back to the right member.
+        body.append(f"  {{ unsigned int *up; int ui; "
+                    f"for (ui = 0; ui < {lsize}; ui++) {{ "
+                    f"if ((lbuf[ui] & {r.choice([1, 3])}u) == 0u) "
+                    f"{{ up = rs; }} else {{ up = ua; }} "
+                    f"up[ui % 6] = up[ui % 6] * 3u + (unsigned int)ui; }} }}")
+        # 64-bit limb ARITHMETIC chain (not just one product): a long
+        # long accumulator looped over an array with add/sub/shift and
+        # a 64-bit comparison driving control flow -- the limb-pair
+        # carry/borrow/shift model vs gcc's native 64-bit.
+        body.append(f"  {{ long long s64; int li; s64 = 0; "
+                    f"for (li = 0; li < {lsize}; li++) {{ "
+                    f"s64 += (long long)(int)lbuf[li] * "
+                    f"(long long)({r.randrange(3, 1000)} - (int)(li * 7)); "
+                    f"s64 -= (long long)(int)acc0; }} "
+                    # Shift through unsigned: s64 << k on a negative
+                    # value is UB in ISO C; the round-trip is the
+                    # defined spelling of the same bit pattern (and what
+                    # the limb model computes).
+                    f"s64 = (long long)((unsigned long long)s64 "
+                    f"<< {r.randrange(1, 5)}); "
+                    f"if (s64 > (long long){r.randrange(100, 100000)}) "
+                    f"{{ acc0 ^= 77u; }} "
+                    f"acc0 += (unsigned int)(s64 & 0xffffffffULL); "
+                    f"acc1 ^= (unsigned int)((unsigned long long)s64 >> 32);"
+                    f" }}")
         # Checksums: the whole written state becomes observable output
-        # (rs included -- the re-seating block deref-stores into it).
+        # (rs/ua included -- the re-seating and union-pointer blocks
+        # deref-store into them).
         self.arrays.append(("rs", "unsigned int", 6))
+        self.arrays.append(("ua", "unsigned int", 6))
         for name, _, size in self.arrays:
             body.append(f"  {{ unsigned int chk = 0; "
                         f"for (i = 0; i < {size}; i++) "

@@ -13,6 +13,10 @@ Config format is the reference's, unchanged:
     benchmarks:
       - path: matrixMultiply         # registry name, or a suite name
         re: "Number of errors: 0"    # optional stdout regex oracle
+        passes: ["-TMR", "-DWC"]     # optional: OVERRIDES the global
+                                     # OPT_PASSES column for this entry
+                                     # (reduced combos for heavy
+                                     # programs, e.g. CHStone jpeg)
     OPT_PASSES:
       - ""
       - "-DWC"
@@ -47,8 +51,11 @@ class HarnessError(Exception):
     pass
 
 
-def expand_benchmarks(cfg: dict) -> List[Tuple[str, Optional[str]]]:
-    """Resolve cfg benchmark entries to (registry_name, regex) rows.
+def expand_benchmarks(
+        cfg: dict) -> List[Tuple[str, Optional[str], Optional[List[str]]]]:
+    """Resolve cfg benchmark entries to (registry_name, regex,
+    passes_override) rows; passes_override is None for benchmarks using
+    the global OPT_PASSES column.
 
     ``path`` may name one region or a suite ('chstone' expands to the
     CHSTONE tuple; 'all' to the whole registry), the analogue of the
@@ -78,7 +85,15 @@ def expand_benchmarks(cfg: dict) -> List[Tuple[str, Optional[str]]]:
             names = [path]
         else:
             raise HarnessError(f"No benchmarks found at {path!r}")
-        rows.extend((n, regex) for n in names)
+        passes = entry.get("passes")
+        if passes is not None and (not isinstance(passes, list)
+                                   or not passes):
+            # An empty list would silently exclude the benchmark from
+            # every run (skipped in the global matrix, zero own combos).
+            raise HarnessError(
+                f"'passes' for {path!r} must be a non-empty list of "
+                "combo strings (omit it to use the global OPT_PASSES)")
+        rows.extend((n, regex, passes) for n in names)
     return rows
 
 
@@ -94,26 +109,44 @@ def run_combo(bench: str, opt_passes: str) -> Tuple[int, str]:
 
 def run_config(cfg: dict, quiet: bool = False) -> int:
     """The unittest.py main loop: every combo x every benchmark.  Returns
-    the number of cells run; raises HarnessError on the first failure."""
+    the number of cells run; raises HarnessError on the first failure.
+    Benchmarks with a ``passes`` override run their own (reduced) combo
+    column after the global matrix."""
     benches = expand_benchmarks(cfg)
     cells = 0
+
+    def one_cell(bench, regex, opt_pass):
+        rc, out = run_combo(bench, opt_pass)
+        if rc != 0:
+            print(out)
+            raise HarnessError(
+                f"Could not run {bench} with OPT_PASSES='{opt_pass}' "
+                f"(exit {rc})")
+        if regex is not None and not re.search(regex, out):
+            print(out)
+            raise HarnessError(
+                f"Could not match stdout of {bench} using re "
+                f"expression: {regex}")
+
     for opt_pass in cfg["OPT_PASSES"]:
         if not quiet:
             print(f"{_Colors.HEADER}OPT_PASSES: {opt_pass}{_Colors.ENDC}")
-        for bench, regex in benches:
+        for bench, regex, passes in benches:
+            if passes is not None:
+                continue                 # own column below
             if not quiet:
                 print(f"  {_Colors.OKBLUE}{bench}{_Colors.ENDC}")
-            rc, out = run_combo(bench, opt_pass)
-            if rc != 0:
-                print(out)
-                raise HarnessError(
-                    f"Could not run {bench} with OPT_PASSES='{opt_pass}' "
-                    f"(exit {rc})")
-            if regex is not None and not re.search(regex, out):
-                print(out)
-                raise HarnessError(
-                    f"Could not match stdout of {bench} using re "
-                    f"expression: {regex}")
+            one_cell(bench, regex, opt_pass)
+            cells += 1
+    for bench, regex, passes in benches:
+        if passes is None:
+            continue
+        for opt_pass in passes:
+            if not quiet:
+                print(f"{_Colors.HEADER}OPT_PASSES: {opt_pass}"
+                      f"{_Colors.ENDC}  {_Colors.OKBLUE}{bench}"
+                      f"{_Colors.ENDC}")
+            one_cell(bench, regex, opt_pass)
             cells += 1
     return cells
 

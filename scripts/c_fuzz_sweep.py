@@ -1,0 +1,134 @@
+"""Recorded differential C-fuzz sweep (VERDICT r4 missing #2 / ask #4).
+
+The README's fuzz claims previously lived in commit messages; this
+script makes them auditable the way the reference's stress tier leaves
+run records (llvm-stress.py writes per-run work products): it runs the
+differential fuzzer (``coast_tpu.testing.c_fuzz``: generated program ->
+gcc ground truth vs lift_c, whole observable state compared) over a
+seed range and writes ``artifacts/c_fuzz_sweep.json`` with
+
+  * the ENVELOPE HASH (sha256 of the generator source) so a recorded
+    sweep is tied to the generator that produced it -- editing the
+    envelope invalidates prior evidence and restarts the record;
+  * the exact seed ranges that passed, merged across resumed runs;
+  * any failures with their error text (the seed replays the failure:
+    ``python -m coast_tpu.testing.c_fuzz -seed N``).
+
+Resumable: progress is flushed every --chunk seeds, and a rerun skips
+seeds already recorded under the same envelope hash.
+
+Usage: python scripts/c_fuzz_sweep.py [--start 0] [-n 1000] [--chunk 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GEN_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "coast_tpu", "testing", "c_fuzz.py")
+
+
+def envelope_sha() -> str:
+    with open(GEN_SRC, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()[:16]
+
+
+def merge_ranges(ranges):
+    """Merge [lo, hi) pairs."""
+    out = []
+    for lo, hi in sorted(ranges):
+        if out and lo <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return out
+
+
+def covered(ranges, seed: int) -> bool:
+    return any(lo <= seed < hi for lo, hi in ranges)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("-n", type=int, default=1000)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--out", default="artifacts/c_fuzz_sweep.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from coast_tpu.testing.c_fuzz import check_seed
+
+    sha = envelope_sha()
+    art = {"generator": "coast_tpu/testing/c_fuzz.py",
+           "envelope_sha": sha, "ranges": [], "n_pass": 0,
+           "failures": [], "seconds": 0.0}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as fh:
+                prev = json.load(fh)
+            if prev.get("envelope_sha") == sha:
+                art = prev
+            else:
+                print(f"# envelope changed ({prev.get('envelope_sha')} -> "
+                      f"{sha}); prior record invalidated", file=sys.stderr)
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    def flush(pending_lo, next_seed):
+        if next_seed > pending_lo:
+            art["ranges"] = merge_ranges(
+                art["ranges"] + [[pending_lo, next_seed]])
+        art["n_pass"] = sum(hi - lo for lo, hi in art["ranges"]) \
+            - len({f["seed"] for f in art["failures"]
+                   if covered(art["ranges"], f["seed"])})
+        art["date"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(art, fh, indent=1, sort_keys=True)
+
+    t0 = time.perf_counter()
+    lo = args.start
+    done = 0
+    for seed in range(args.start, args.start + args.n):
+        if covered(art["ranges"], seed):
+            if seed == lo:
+                lo = seed + 1
+            continue
+        try:
+            check_seed(seed)
+        except Exception as e:  # noqa: BLE001 -- recorded, not fatal
+            art["failures"].append(
+                {"seed": seed, "error": str(e)[:500]})
+            print(f"# seed {seed}: FAIL", file=sys.stderr, flush=True)
+        done += 1
+        if done % args.chunk == 0:
+            art["seconds"] = round(
+                art.get("seconds", 0.0) + time.perf_counter() - t0, 1)
+            t0 = time.perf_counter()
+            flush(lo, seed + 1)
+            lo = seed + 1
+            print(f"# {seed + 1 - args.start}/{args.n} "
+                  f"({len(art['failures'])} failures)",
+                  file=sys.stderr, flush=True)
+    art["seconds"] = round(
+        art.get("seconds", 0.0) + time.perf_counter() - t0, 1)
+    flush(lo, args.start + args.n)
+    print(json.dumps({"envelope_sha": sha, "n_pass": art["n_pass"],
+                      "n_fail": len(art["failures"]),
+                      "ranges": art["ranges"]}))
+    return 1 if art["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
