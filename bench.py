@@ -105,12 +105,16 @@ def worker(backend: str) -> None:
     # measurement with tunnel-latency drift (a recorded artifact once
     # showed TMR 3x FASTER than unprotected -- physically impossible for
     # triplicated work, pure drift).
+    # Single-run timings arm a never-firing fault as a traced input so
+    # XLA cannot fold the zero-arg computation (ops.bitflip.noop_fault).
+    from coast_tpu.ops.bitflip import noop_fault as _noop
+    noop_fault = _noop()
     runs = {}
     for name, make in (("unprotected", unprotected), ("DWC", DWC),
                        ("TMR", TMR)):
-        run = jax.jit(lambda p=make(region): p.run(None))
-        jax.block_until_ready(run())            # compile
-        runs[name] = run
+        jit_run = jax.jit(lambda f, p=make(region): p.run(f))
+        jax.block_until_ready(jit_run(noop_fault))      # compile
+        runs[name] = (lambda r=jit_run: r(noop_fault))
     blocks = {name: [] for name in runs}
     for _ in range(5):
         for name, run in runs.items():
@@ -173,7 +177,8 @@ def worker(backend: str) -> None:
         # Flagships ship with the fused Pallas voter kernel
         # (bit-identical to the jnp voter; ~2x mm256's single-run rate).
         fl_prog = TMR(flag, pallas_voters=True)
-        fl_run = jax.jit(lambda p=fl_prog: p.run(None))
+        fl_jit = jax.jit(lambda f, p=fl_prog: p.run(f))
+        fl_run = lambda: fl_jit(noop_fault)      # noqa: E731
         jax.block_until_ready(fl_run())
         reps = 10
         t0 = time.perf_counter()

@@ -83,742 +83,19 @@ except Exception:  # pragma: no cover - pycparser ships with cffi
     _HAVE_PYCPARSER = False
 
 
-class CLiftError(LiftError):
-    """Unsupported C construct; the message names it and the location."""
-
-
-# ---------------------------------------------------------------------------
-# Minimal preprocessing: the subset needs no system headers.
-# ---------------------------------------------------------------------------
-
-_COAST_MACROS = ("__DEFAULT_NO_xMR", "__DEFAULT_xMR", "__xMR", "__NO_xMR",
-                 "__xMR_FN", "__NO_xMR_FN")
-
-# Further COAST.h attribute macros: recorded and stripped so annotated
-# sources PARSE (the annotations expand to __attribute__ in the real
-# header, COAST.h:11-67); behaviors already designed away (ISRs,
-# malloc/printf wrappers) surface later as loud refusals on the
-# construct itself, not as parse errors on the macro token.
-_COAST_STRIP_TOKENS = ("__xMR_FN_CALL", "__SKIP_FN_CALL",
-                       "__COAST_VOLATILE", "__ISR_FUNC", "__xMR_RET_VAL",
-                       "__xMR_PROT_LIB", "__xMR_ALL_AFTER_CALL",
-                       "__COAST_NO_INLINE")
-# Function-like COAST macros whose whole invocation line is a no-op
-# declaration in the real header (wrapper registration).
-_COAST_STRIP_CALLS = ("PRINTF_WRAPPER_REGISTER", "MALLOC_WRAPPER_REGISTER",
-                      "__COAST_IGNORE_GLOBAL")
-
-_PRELUDE = """
-typedef unsigned int uint32_t;
-typedef int int32_t;
-typedef unsigned short uint16_t;
-typedef short int16_t;
-typedef unsigned char uint8_t;
-typedef signed char int8_t;
-"""
-
-
-def _strip_comments(text: str) -> str:
-    """Remove //... and /*...*/ outside string literals (pycparser wants
-    preprocessed input)."""
-    out = []
-    i, n = 0, len(text)
-    while i < n:
-        c = text[i]
-        if c == '"':
-            j = i + 1
-            while j < n and text[j] != '"':
-                j += 2 if text[j] == "\\" else 1
-            out.append(text[i:j + 1])
-            i = j + 1
-        elif text.startswith("//", i):
-            i = text.find("\n", i)
-            i = n if i < 0 else i
-        elif text.startswith("/*", i):
-            j = text.find("*/", i + 2)
-            j = n if j < 0 else j + 2
-            out.append("\n" * text.count("\n", i, j))   # keep line numbers
-            i = j
-        else:
-            out.append(c)
-            i += 1
-    return "".join(out)
-
-
-def preprocess(text: str, include_dirs: Sequence[str] = (),
-               defines: Optional[Dict[str, str]] = None,
-               name_flags: Optional[Dict[str, bool]] = None,
-               fdefines: Optional[Dict[str, Tuple[List[str], str]]] = None,
-               ) -> Tuple[str, Dict[str, str], List[str], Dict[str, bool]]:
-    """Strip/resolve the tiny preprocessor surface the benchmarks use.
-
-    Returns (source, defines, coast_macros, name_flags).  ``#include
-    "local.c"`` is inlined from ``include_dirs`` (the mm_common.c
-    pattern) and SHARES the including file's ``#define`` table, exactly
-    like cpp textual inclusion; ``#include <...>`` system headers are
-    dropped (the prelude supplies the stdint names); object-like AND
-    function-like ``#define``s substitute (continuation lines joined;
-    arguments are paren-wrapped on substitution, which the benchmark
-    macros -- ROTRIGHT, DBL_INT_ADD -- are written to tolerate).
-    ``name_flags`` collects per-declaration scope annotations:
-    ``uint32_t __xMR results[..]`` records ``{"results": True}`` (and
-    ``__NO_xMR`` False) -- the identifier FOLLOWING the macro, matching
-    the reference's declaration style (tests/mm_common/mm_tmr.c).
-    """
-    text = _strip_comments(text).replace("\\\n", " ")
-    defines = {} if defines is None else defines
-    fdefines = {} if fdefines is None else fdefines
-    name_flags = {} if name_flags is None else name_flags
-    annotations: List[str] = []
-    out: List[str] = []
-
-    def expand_fn(line: str) -> str:
-        """Expand function-like macro calls with balanced-paren args."""
-        for _ in range(8):                       # bounded nesting
-            changed = False
-            for name, (params, body) in fdefines.items():
-                m = re.search(rf"\b{re.escape(name)}\s*\(", line)
-                if not m:
-                    continue
-                start, i = m.start(), m.end()
-                depth, args, cur = 1, [], ""
-                while i < len(line) and depth:
-                    ch = line[i]
-                    if ch == "(":
-                        depth += 1
-                    elif ch == ")":
-                        depth -= 1
-                        if depth == 0:
-                            break
-                    if depth == 1 and ch == ",":
-                        args.append(cur)
-                        cur = ""
-                    else:
-                        cur += ch
-                    i += 1
-                if depth:
-                    raise CLiftError(
-                        f"unbalanced macro call {name}(... in: {line!r}")
-                args.append(cur)
-                if not params:
-                    args = [a for a in args if a.strip()]
-                if len(args) != len(params):
-                    raise CLiftError(
-                        f"macro {name} expects {len(params)} args, "
-                        f"got {len(args)} in: {line!r}")
-                # Token paste FIRST (cpp order): a parameter adjacent to
-                # ## substitutes its RAW argument (no parens, no prior
-                # expansion), then the operator splices the tokens --
-                # CHStone sha's `f##n(B,C,D)` / `CONST##n`.
-                raw = {p: a.strip() for p, a in zip(params, args)}
-
-                def paste(m):
-                    l, r2 = m.group(1), m.group(2)
-                    return raw.get(l, l) + raw.get(r2, r2)
-
-                while re.search(r"\w+\s*##\s*\w+", body):
-                    body = re.sub(r"(\w+)\s*##\s*(\w+)", paste, body,
-                                  count=1)
-                # SIMULTANEOUS parameter substitution with a function
-                # replacement: sequential re.sub would re-substitute an
-                # argument that mentions a later parameter's name, and a
-                # string template would reinterpret backslashes in the
-                # argument ('\n' in a char constant).  An argument that
-                # is already one parenthesized unit is not re-wrapped
-                # (_ANSI_ARGS_((void)) must yield (void), not ((void))).
-                def wrap_arg(s: str) -> str:
-                    s = s.strip()
-                    if s.startswith("(") and s.endswith(")"):
-                        depth = 0
-                        for k, ch in enumerate(s):
-                            if ch == "(":
-                                depth += 1
-                            elif ch == ")":
-                                depth -= 1
-                                if depth == 0 and k != len(s) - 1:
-                                    break
-                        else:
-                            return s
-                    return f"({s})"
-
-                amap = {p: wrap_arg(a) for p, a in zip(params, args)}
-                if amap:
-                    pat = "|".join(rf"\b{re.escape(p)}\b" for p in amap)
-                    sub = re.sub(pat, lambda m: amap[m.group(0)], body)
-                else:
-                    sub = body
-                line = line[:start] + sub + line[i + 1:]
-                changed = True
-            if not changed:
-                return line
-        return line
-
-    _LIT_RE = re.compile(r'"(?:[^"\\]|\\.)*"|\'(?:[^\'\\]|\\.)*\'')
-
-    def expand(line: str) -> str:
-        # String/char literals are masked out before substitution (cpp
-        # never substitutes inside them -- a macro name appearing in a
-        # printf format must survive) and restored after; literals
-        # introduced BY an expansion are masked on the next pass.
-        lits: List[str] = []
-
-        def mask(m):
-            lits.append(m.group(0))
-            return f"\x01{len(lits) - 1}\x02"
-
-        for _ in range(8):                       # rescan until stable
-            line = _LIT_RE.sub(mask, line)
-            before = line
-            for name, val in defines.items():
-                # Function replacement: a value containing backslashes
-                # must not be reinterpreted as a regex template.
-                line = re.sub(rf"\b{re.escape(name)}\b", lambda m: val,
-                              line)
-            line = expand_fn(line)
-            if line == before:
-                break
-        return re.sub(r"\x01(\d+)\x02", lambda m: lits[int(m.group(1))],
-                      line)
-
-    def _paren_balance(s: str) -> int:
-        s = _LIT_RE.sub("", s)
-        return s.count("(") - s.count(")")
-
-    # Conditional-inclusion stack: [taking, evaluable, satisfied].
-    # #ifdef/#ifndef evaluate against the defines tables (motion's
-    # global.h selects the _ANSI_ARGS_ variant this way); other #if
-    # forms keep the legacy include-everything behavior
-    # (evaluable=False), their #else/#elif branches included too.
-    cond_stack: List[List[bool]] = []
-
-    lines_in = text.splitlines()
-    li = 0
-    while li < len(lines_in):
-        raw = lines_in[li]
-        li += 1
-        # A function-like macro call spanning lines (motion's
-        # _ANSI_ARGS_((int *PMV, ...) prototypes): join until balanced.
-        if (any(re.search(rf"\b{re.escape(n)}\s*\(", raw)
-                for n in fdefines)
-                and not raw.lstrip().startswith("#")):
-            guard = 0
-            while (_paren_balance(raw) > 0 and li < len(lines_in)
-                   and guard < 100):
-                raw += " " + lines_in[li]
-                li += 1
-                guard += 1
-        line = raw
-        stripped = line.strip()
-        if stripped.startswith("#"):
-            # cpp allows whitespace between # and the directive name
-            # (global.h's `#   define _ANSI_ARGS_(x) x`).
-            stripped = re.sub(r"^#\s+", "#", stripped)
-        if stripped.startswith("#ifdef") or stripped.startswith("#ifndef"):
-            m = re.match(r"#ifn?def\s+(\w+)", stripped)
-            if m:
-                known = (m.group(1) in defines or m.group(1) in fdefines)
-                taking = (known if stripped.startswith("#ifdef")
-                          else not known)
-                cond_stack.append([taking, True, taking])
-            else:
-                cond_stack.append([True, False, True])
-            continue
-        if stripped.startswith("#if"):
-            cond_stack.append([True, False, True])
-            continue
-        if stripped.startswith("#elif"):
-            if cond_stack and cond_stack[-1][1]:
-                if cond_stack[-1][2]:        # a branch was taken: skip rest
-                    cond_stack[-1][0] = False
-                else:                        # unknown #elif: legacy include
-                    cond_stack[-1] = [True, False, True]
-            continue
-        if stripped.startswith("#else"):
-            if cond_stack and cond_stack[-1][1]:
-                cond_stack[-1][0] = not cond_stack[-1][2]
-            continue
-        if stripped.startswith("#endif"):
-            if cond_stack:
-                cond_stack.pop()
-            continue
-        if not all(e[0] for e in cond_stack):
-            continue                          # skipped conditional branch
-        if stripped.startswith("#include"):
-            m = re.match(r'#include\s+"([^"]+)"', stripped)
-            if m:
-                fname = m.group(1)
-                for d in include_dirs:
-                    path = os.path.join(d, fname)
-                    if os.path.exists(path):
-                        if fname.endswith("COAST.h") or fname == "COAST.h":
-                            break
-                        with open(path) as f:
-                            sub, _, subann, _ = preprocess(
-                                f.read(), include_dirs, defines,
-                                name_flags, fdefines)
-                        annotations.extend(subann)
-                        out.append(sub)
-                        break
-                else:
-                    if not fname.endswith("COAST.h"):
-                        raise CLiftError(
-                            f'#include "{fname}" not found in '
-                            f"{list(include_dirs)}")
-            continue
-        if stripped.startswith("#define"):
-            fm = re.match(r"#define\s+(\w+)\(([^)]*)\)\s+(.+?)\s*$",
-                          stripped)
-            if fm:
-                params = [p.strip() for p in fm.group(2).split(",")
-                          if p.strip()]
-                fdefines[fm.group(1)] = (params, fm.group(3))
-                continue
-            m = re.match(r"#define\s+(\w+)\s+(.+?)\s*$", stripped)
-            if m:
-                defines[m.group(1)] = expand(m.group(2))
-                continue
-            m = re.match(r"#define\s+(\w+)\s*$", stripped)
-            if m:
-                # Valueless define (SPARC-GCC.h's `#define INLINE`):
-                # substitutes to nothing, and flips #ifdef decisions.
-                defines[m.group(1)] = ""
-            continue
-        if stripped.startswith("#"):
-            continue                      # #ifdef guards etc.: benign here
-        # Expand BEFORE the annotation passes: a source-local alias like
-        # `#define FUNCTION_TAG __xMR` must be recorded and stripped the
-        # same as a literal __xMR (load_store.c's style).
-        line = expand(line)
-        # Per-declaration scope annotations.  Styles the reference corpus
-        # uses: mid-declaration ``uint32_t __xMR name[..]`` (the token
-        # after the macro is the name), prefix ``__xMR uint32_t name``
-        # (the SECOND token is; the first is a type and resolves to
-        # nothing), and trailing ``int foo() __xMR``.
-        for m in re.finditer(r"\b(__NO_xMR|__xMR)\s+(\w+)(?:\s+(\w+))?",
-                             line):
-            flag = m.group(1) == "__xMR"
-            name_flags.setdefault(m.group(2), flag)
-            if m.group(3):
-                name_flags.setdefault(m.group(3), flag)
-        for m in re.finditer(r"\b(\w+)\s*\([^()]*\)\s*(__NO_xMR|__xMR)\b",
-                             line):
-            name_flags.setdefault(m.group(1), m.group(2) == "__xMR")
-        # Record + strip COAST annotation macros and GCC attributes.
-        for mac in _COAST_MACROS + _COAST_STRIP_TOKENS:
-            if re.search(rf"\b{mac}\b", line):
-                annotations.append(mac)
-                line = re.sub(rf"\b{mac}\b", "", line)
-        for mac in _COAST_STRIP_CALLS:
-            if re.search(rf"\b{mac}\s*\(", line):
-                annotations.append(mac)
-                line = re.sub(rf"\b{mac}\s*\([^)]*\)\s*;?", "", line)
-        line = re.sub(r"__attribute__\s*\(\(.*?\)\)", "", line)
-        out.append(line)
-    return "\n".join(out), defines, annotations, name_flags
-
-
-# ---------------------------------------------------------------------------
-# Types
-# ---------------------------------------------------------------------------
-
-_UNSIGNED = {"unsigned", "uint32_t", "_Bool"}
-_NARROW = {"char": 8, "short": 16, "uint8_t": 8, "int8_t": 8,
-           "uint16_t": 16, "int16_t": 16}
-
-
-class _CType:
-    """A C integer type on the 32-bit lane model.
-
-    Narrow (8/16-bit) values live in int32 lanes holding their PROMOTED
-    value (C's integer promotions take unsigned char/short to int, which
-    int32 represents exactly), and every STORE to a narrow lvalue
-    re-normalizes: mask to the declared width, sign-extend if signed --
-    the mod-2^8/2^16 wraparound semantics the reference's byte/short
-    benchmarks rely on (crc16.c's ``unsigned char x``/``unsigned short
-    crc``).  Memory LAYOUT stays one lane word per element (the
-    injection model is word-addressed; byte packing is out of scope and
-    documented in docs/lifter.md)."""
-
-    __slots__ = ("dtype", "bits", "unsigned")
-
-    def __init__(self, dtype, bits: int = 32, unsigned: bool = False):
-        self.dtype = dtype
-        self.bits = bits
-        self.unsigned = unsigned
-
-    def store(self, v):
-        """Normalize a value being stored into this type's lane."""
-        if isinstance(v, _C64):
-            v = v.lo                    # C conversion 64 -> 32: mod 2^32
-        v = jnp.asarray(v)
-        if self.bits == 32:
-            return v.astype(self.dtype)
-        mask = (1 << self.bits) - 1
-        v = v.astype(jnp.int32) & mask
-        if not self.unsigned:
-            sign = 1 << (self.bits - 1)
-            v = (v ^ sign) - sign
-        return v
-
-    def zero(self):
-        return jnp.zeros((), self.dtype)
-
-
-@jax.tree_util.register_pytree_node_class
-class _C64:
-    """A 64-bit C integer as a uint32 limb pair (lo, hi).
-
-    JAX's x64 mode stays off (the whole lane/memory model is 32-bit
-    words, matching the reference's ILP32 targets); ``long long``
-    values instead live as two 32-bit lanes with explicit carry
-    arithmetic -- the same limb model the df64 softfloat re-expression
-    uses (models/chstone/df64.py).  Registered as a pytree so 64-bit
-    locals carry through lax.scan/cond like any other value."""
-
-    def __init__(self, lo, hi, unsigned: bool = False):
-        self.lo = jnp.asarray(lo, jnp.uint32)
-        self.hi = jnp.asarray(hi, jnp.uint32)
-        self.unsigned = bool(unsigned)
-
-    def tree_flatten(self):
-        return (self.lo, self.hi), self.unsigned
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        # Bypass __init__: jax's tree-structure checks unflatten with
-        # sentinel (non-array) leaves, and the strict constructor must
-        # keep raising on real misuse.
-        obj = object.__new__(cls)
-        obj.lo, obj.hi = children
-        obj.unsigned = aux
-        return obj
-
-    def with_sign(self, unsigned: bool) -> "_C64":
-        return _C64(self.lo, self.hi, unsigned)
-
-
-def _to64(v, unsigned_hint: bool = False) -> _C64:
-    """C conversion of a value to a 64-bit integer."""
-    if isinstance(v, _C64):
-        return v
-    v = jnp.asarray(v)
-    if v.dtype == jnp.uint32 or unsigned_hint:
-        return _C64(v, jnp.uint32(0), True)
-    v32 = v.astype(jnp.int32)
-    hi = jnp.where(v32 < 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    return _C64(v32, hi, False)
-
-
-def _mulhi_u32(x, y):
-    """High 32 bits of the exact 64-bit product of two uint32 (16-bit
-    limb decomposition; every partial product fits uint32)."""
-    x = jnp.asarray(x, jnp.uint32)
-    y = jnp.asarray(y, jnp.uint32)
-    xl, xh = x & 0xFFFF, x >> 16
-    yl, yh = y & 0xFFFF, y >> 16
-    ll = xl * yl
-    lh = xl * yh
-    hl = xh * yl
-    hh = xh * yh
-    cross = (ll >> 16) + (lh & 0xFFFF) + (hl & 0xFFFF)
-    return hh + (lh >> 16) + (hl >> 16) + (cross >> 16)
-
-
-def _c64_add(a: _C64, b: _C64, unsigned: bool) -> _C64:
-    lo = a.lo + b.lo
-    carry = (lo < a.lo).astype(jnp.uint32)
-    return _C64(lo, a.hi + b.hi + carry, unsigned)
-
-
-def _c64_neg(a: _C64) -> _C64:
-    return _c64_add(_C64(~a.lo, ~a.hi, a.unsigned),
-                    _C64(1, 0, a.unsigned), a.unsigned)
-
-
-def _c64_mul(a: _C64, b: _C64, unsigned: bool) -> _C64:
-    # Product mod 2^64: lo-lo full product + cross terms into hi.
-    lo = a.lo * b.lo
-    hi = _mulhi_u32(a.lo, b.lo) + a.lo * b.hi + a.hi * b.lo
-    return _C64(lo, hi, unsigned)
-
-
-def _c64_shl(a: _C64, s) -> _C64:
-    s = jnp.asarray(s, jnp.uint32) & 63
-    sl = jnp.clip(s, 0, 31)
-    sr = jnp.clip(32 - s.astype(jnp.int32), 0, 31).astype(jnp.uint32)
-    lo_small = a.lo << sl
-    hi_small = (a.hi << sl) | jnp.where(s > 0, a.lo >> sr, jnp.uint32(0))
-    big = jnp.clip(s - 32, 0, 31)
-    lo = jnp.where(s < 32, lo_small, jnp.uint32(0))
-    hi = jnp.where(s < 32, hi_small, a.lo << big)
-    return _C64(lo, hi, a.unsigned)
-
-
-def _c64_shr(a: _C64, s) -> _C64:
-    """C >> on the 64-bit value: logical for unsigned, arithmetic for
-    signed (the left operand's type governs, C11 6.5.7)."""
-    s = jnp.asarray(s, jnp.uint32) & 63
-    sl = jnp.clip(s, 0, 31)
-    sr = jnp.clip(32 - s.astype(jnp.int32), 0, 31).astype(jnp.uint32)
-    fill = (jnp.uint32(0) if a.unsigned else
-            jnp.where(a.hi.astype(jnp.int32) < 0,
-                      jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
-    hi_sh = ((a.hi >> sl) if a.unsigned
-             else (a.hi.astype(jnp.int32) >> sl.astype(jnp.int32)
-                   ).astype(jnp.uint32))
-    lo_small = (a.lo >> sl) | jnp.where(s > 0, a.hi << sr, jnp.uint32(0))
-    big = jnp.clip(s - 32, 0, 31)
-    lo_big = ((a.hi >> big) if a.unsigned
-              else (a.hi.astype(jnp.int32) >> big.astype(jnp.int32)
-                    ).astype(jnp.uint32))
-    lo = jnp.where(s < 32, lo_small, lo_big)
-    hi = jnp.where(s < 32, hi_sh, fill)
-    return _C64(lo, hi, a.unsigned)
-
-
-def _c64_divmod(a: _C64, b: _C64) -> Tuple[_C64, _C64]:
-    """Unsigned 64/64 division: 64-step restoring shift-subtract on
-    limb pairs (softfloat's estimateDiv128To64 path).  The classic
-    overflow trick keeps the remainder in 64 bits: when the shifted
-    remainder wraps past 2^64 its true value exceeds the divisor, so
-    the subtraction is taken and the mod-2^64 result is exact."""
-
-    def step(i, st):
-        qlo, qhi, rlo, rhi = st
-        bit = 63 - i
-        nbit = jnp.where(
-            bit >= 32,
-            (a.hi >> jnp.uint32(jnp.clip(bit - 32, 0, 31))) & 1,
-            (a.lo >> jnp.uint32(jnp.clip(bit, 0, 31))) & 1)
-        ov = rhi >> 31
-        r2 = _c64_shl(_C64(rlo, rhi, True), 1)
-        r2 = _C64(r2.lo | nbit, r2.hi, True)
-        ge = jnp.logical_or(
-            ov.astype(bool),
-            jnp.logical_not(_c64_lt(r2, b, True)))
-        r3 = _c64_add(r2, _c64_neg(b), True)
-        rlo2 = jnp.where(ge, r3.lo, r2.lo)
-        rhi2 = jnp.where(ge, r3.hi, r2.hi)
-        q2 = _c64_shl(_C64(qlo, qhi, True), 1)
-        qlo2 = q2.lo | ge.astype(jnp.uint32)
-        return (qlo2, q2.hi, rlo2, rhi2)
-
-    z = jnp.uint32(0)
-    qlo, qhi, rlo, rhi = jax.lax.fori_loop(0, 64, step, (z, z, z, z))
-    # b == 0 is C UB; pin it to q=~0, r=a (softfloat never divides by 0).
-    bz = jnp.equal(b.lo | b.hi, 0)
-    q = _C64(jnp.where(bz, jnp.uint32(0xFFFFFFFF), qlo),
-             jnp.where(bz, jnp.uint32(0xFFFFFFFF), qhi), True)
-    r = _C64(jnp.where(bz, a.lo, rlo), jnp.where(bz, a.hi, rhi), True)
-    return q, r
-
-
-def _c64_lt(a: _C64, b: _C64, unsigned: bool):
-    if unsigned:
-        hi_lt = jnp.less(a.hi, b.hi)
-        hi_eq = jnp.equal(a.hi, b.hi)
-    else:
-        hi_lt = jnp.less(a.hi.astype(jnp.int32), b.hi.astype(jnp.int32))
-        hi_eq = jnp.equal(a.hi, b.hi)
-    return jnp.logical_or(hi_lt, jnp.logical_and(hi_eq,
-                                                 jnp.less(a.lo, b.lo)))
-
-
-class _CType64(_CType):
-    """``long long`` on the limb-pair model (no memory layout: 64-bit
-    GLOBALS/arrays are outside the word-addressed injection map and
-    refuse at declaration; 64-bit LOCALS are register values)."""
-
-    def __init__(self, unsigned: bool = False):
-        super().__init__(jnp.uint32, 64, unsigned)
-
-    def store(self, v):
-        # Extension is governed by the SOURCE's signedness (in _to64);
-        # the declared type only sets the result's signedness.
-        v64 = _to64(v)
-        return _C64(v64.lo, v64.hi, self.unsigned)
-
-    def zero(self):
-        return _C64(0, 0, self.unsigned)
-
-
-def _ctype_of(names: List[str], typedefs: Dict[str, object]) -> _CType:
-    """ILP32 _CType for a declared type-name list (``long long`` -> the
-    64-bit limb-pair type)."""
-    for n in names:
-        if n in typedefs:
-            return typedefs[n]
-    uns = any(n in _UNSIGNED for n in names) or "unsigned" in names
-    # Plain char is UNSIGNED on the reference's ARM targets (AAPCS).
-    if "char" in names and "signed" not in names:
-        uns = True
-    if names.count("long") >= 2:
-        return _CType64(uns)
-    bits = 32
-    for n in names:
-        if n in _NARROW:
-            bits = _NARROW[n]
-    if bits == 32:
-        return _CType(jnp.uint32 if uns else jnp.int32, 32, uns)
-    return _CType(jnp.int32, bits, uns)
-
-
-# ---------------------------------------------------------------------------
-# AST -> JAX compiler
-# ---------------------------------------------------------------------------
-
-class _NoPrintList(list):
-    """printf sentinel for traced sub-regions (loops, branches)."""
-
-    def __init__(self, coord, reason=None):
-        super().__init__()
-        self.coord = coord
-        self.reason = reason
-
-    def _refuse(self):
-        if self.reason:
-            raise CLiftError(
-                f"printf {self.reason} at {self.coord}: whether the "
-                "print happens would depend on traced values, so it "
-                "cannot be a fixed program output; print before the "
-                "early exit or restructure")
-        raise CLiftError(
-            f"printf inside a loop or branch at {self.coord}: per-"
-            "iteration prints would be traced values that cannot escape "
-            "the loop; move the printf after the loop (print the final "
-            "value) or restructure")
-
-    def append(self, _):
-        self._refuse()
-
-    def extend(self, _):
-        self._refuse()
-
-
-class _Scope:
-    """Name -> traced value, with global-write tracking.
-
-    ``aliases`` implements C's array-argument pointer semantics at the
-    only granularity the subset needs: an array parameter whose call
-    argument names a GLOBAL array reads/writes that global directly
-    (matrix_multiply(first_matrix, ..., results_matrix) mutates
-    results_matrix, exactly as the pointer would)."""
-
-    def __init__(self, globals_: Dict[str, jax.Array],
-                 ctypes: Optional[Dict[str, "_CType"]] = None):
-        self.g = globals_          # shared, mutated in place
-        self.locals: Dict[str, jax.Array] = {}
-        self.aliases: Dict[str, str] = {}       # param name -> global name
-        self.ptrs: set = set()                  # declared pointer locals
-        self.ctypes: Dict[str, _CType] = dict(ctypes or {})
-        self.printed: List[jax.Array] = []
-        # Constant shadow environment: scalar names whose CURRENT value
-        # is a compile-time-known int.  Inside jax.make_jaxpr every jnp
-        # value -- literals included -- is an abstract tracer, so
-        # trace-time control decisions (statically-taken branches,
-        # print-loop bounds) need classic constant propagation on the
-        # side.  Absent = unknown; every traced write invalidates.
-        self.consts: Dict[str, int] = {}
-
-    def fork(self, no_print_at=None, no_print_reason=None):
-        """Child scope for a traced sub-region (loop body/cond, branch).
-        ``no_print_at`` arms the printf guard: values printed inside a
-        traced sub-region are scan/cond tracers that cannot escape to the
-        program output, so the guard refuses loudly instead of letting
-        an opaque tracer-leak KeyError surface at lift time."""
-        sub = _Scope(dict(self.g), self.ctypes)
-        sub.locals = dict(self.locals)
-        sub.aliases = dict(self.aliases)
-        sub.ptrs = set(self.ptrs)
-        sub.consts = dict(self.consts)
-        sub.printed = (self.printed if no_print_at is None
-                       else _NoPrintList(no_print_at, no_print_reason))
-        return sub
-
-    def read(self, name: str):
-        # Locals FIRST: a pointer parameter holds its walk cursor as a
-        # local under its own name while aliasing the pointed-to global
-        # (``*p++`` support; _Compiler._ptr_parts).
-        if name in self.locals:
-            return self.locals[name]
-        name = self.aliases.get(name, name)
-        if name in self.locals:
-            return self.locals[name]
-        if name in self.g:
-            return self.g[name]
-        raise CLiftError(f"undeclared identifier {name!r}")
-
-    def write(self, name: str, val):
-        if name in self.locals:
-            self.locals[name] = val
-            return
-        name = self.aliases.get(name, name)
-        if name in self.locals:
-            self.locals[name] = val
-        elif name in self.g:
-            self.g[name] = val
-        else:
-            self.locals[name] = val
-
-    def read_binding(self, name: str):
-        """Read an already-RESOLVED binding (a local name or a global/
-        transient-slot name) with NO alias resolution.  Loop/branch
-        carries hold resolved names; re-resolving them through this
-        scope's alias map would mis-route when a parameter shadows a
-        global of the same name (sha256_hash's ``data`` param vs the
-        global ``data``)."""
-        if name in self.locals:
-            return self.locals[name]
-        if name in self.g:
-            return self.g[name]
-        raise CLiftError(f"unbound carry name {name!r}")
-
-    def write_binding(self, name: str, val):
-        if name in self.locals:
-            self.locals[name] = val
-        else:
-            self.g[name] = val
-
-    def ctype(self, name: str) -> Optional["_CType"]:
-        if name in self.locals:
-            # The local's own declared type.  A pointer parameter's walk
-            # cursor deliberately has none: it is a plain int32 offset,
-            # NOT the narrow pointee type the alias would resolve to.
-            return self.ctypes.get(name)
-        return self.ctypes.get(self.aliases.get(name, name))
-
-
-def _const_int(node) -> Optional[int]:
-    # pycparser types suffixed literals "unsigned int"/"long int"/etc.
-    if isinstance(node, c_ast.Constant) and "int" in node.type:
-        return int(node.value.rstrip("uUlL"), 0)
-    if isinstance(node, c_ast.UnaryOp) and node.op in ("-", "+", "~"):
-        v = _const_int(node.expr)
-        if v is None:
-            return None
-        return {"-": -v, "+": v, "~": ~v}[node.op]
-    if isinstance(node, c_ast.BinaryOp):
-        # Constant folding for dimension/label expressions (blowfish's
-        # `BF_ROUNDS + 2`); division is C truncation toward zero.
-        a, b = _const_int(node.left), _const_int(node.right)
-        if a is None or b is None:
-            return None
-        try:
-            return {
-                "+": lambda: a + b, "-": lambda: a - b,
-                "*": lambda: a * b,
-                "/": lambda: int(a / b) if b else None,
-                "%": lambda: a - int(a / b) * b if b else None,
-                "<<": lambda: a << b, ">>": lambda: a >> b,
-                "&": lambda: a & b, "|": lambda: a | b,
-                "^": lambda: a ^ b,
-            }[node.op]()
-        except KeyError:
-            return None
-    return None
-
-
-class _Compiler:
+from coast_tpu.frontend.c_types import (           # noqa: F401  (re-export)
+    _PRINT_BUF_WORDS, CLiftError, _C64, _CType, _CType64, _NoPrintList,
+    _Scope, _c64_add,
+    _c64_divmod, _c64_lt, _c64_mul, _c64_neg, _c64_shl, _c64_shr,
+    _const_int, _ctype_of, _mulhi_u32, _to64)
+from coast_tpu.frontend.c_preproc import (         # noqa: F401  (re-export)
+    _COAST_MACROS, _COAST_STRIP_CALLS, _COAST_STRIP_TOKENS, _PRELUDE,
+    _strip_comments, preprocess)
+from coast_tpu.frontend.c_eval import _EvalMixin
+from coast_tpu.frontend.c_flow import _FlowMixin
+
+
+class _Compiler(_EvalMixin, _FlowMixin):
     def __init__(self, tu, typedefs, funcs, name: str,
                  g_ctypes: Optional[Dict[str, _CType]] = None,
                  g_ptrs: Optional[set] = None):
@@ -845,1557 +122,6 @@ class _Compiler:
         self._sw_temps: Dict[int, List[str]] = {}
         self._assigned_globals_cache: Dict[int, List[str]] = {}
         self.print_strings: List[str] = []     # slot id -> format string
-
-    # -- trace-time constant propagation -----------------------------------
-    @staticmethod
-    def _wrap32(v: int) -> int:
-        """Canonical signed-32 representation of a mod-2^32 value."""
-        v &= 0xFFFFFFFF
-        return v - (1 << 32) if v >= 0x80000000 else v
-
-    @staticmethod
-    def _has_effects(node) -> bool:
-        """Does evaluating ``node`` have side effects (writes/calls)?"""
-        found: List[object] = []
-
-        class V(c_ast.NodeVisitor):
-            def visit_Assignment(v, n):
-                found.append(n)
-
-            def visit_FuncCall(v, n):
-                found.append(n)
-
-            def visit_UnaryOp(v, n):
-                if n.op in ("++", "p++", "--", "p--"):
-                    found.append(n)
-                v.generic_visit(n)
-
-        if node is not None:
-            V().visit(node)
-        return bool(found)
-
-    def _const_eval(self, node, sc: _Scope) -> Optional[int]:
-        """Compile-time value of a PURE expression, or None if unknown.
-
-        Conservative by construction: every fold either matches the C
-        (ILP32) result exactly or returns None -- ordered comparisons
-        and ``>>`` bail out when a sign-domain ambiguity could flip the
-        result.  Values are kept in canonical signed-32 form."""
-        if isinstance(node, c_ast.Constant):
-            if "char" in node.type and node.value.startswith("'"):
-                body = node.value[1:-1].encode().decode("unicode_escape")
-                return ord(body)
-            if "int" in node.type:
-                v = int(node.value.rstrip("uUlL"), 0)
-                return self._wrap32(v) if v <= 0xFFFFFFFF else None
-            return None
-        if isinstance(node, c_ast.ID):
-            return sc.consts.get(node.name)
-        if isinstance(node, c_ast.Cast):
-            if isinstance(node.to_type.type, c_ast.PtrDecl):
-                return None
-            v = self._const_eval(node.expr, sc)
-            if v is None:
-                return None
-            ct = _ctype_of(node.to_type.type.type.names, self.typedefs)
-            if isinstance(ct, _CType64):
-                return None
-            return self._norm_const(ct, v)
-        if isinstance(node, c_ast.UnaryOp):
-            if node.op not in ("-", "+", "~", "!"):
-                return None
-            v = self._const_eval(node.expr, sc)
-            if v is None:
-                return None
-            if node.op == "!":
-                return int(v == 0)
-            return self._wrap32({"-": -v, "+": v, "~": ~v}[node.op])
-        if isinstance(node, c_ast.TernaryOp):
-            c = self._const_eval(node.cond, sc)
-            if c is None:
-                return None
-            return self._const_eval(node.iftrue if c else node.iffalse, sc)
-        if isinstance(node, c_ast.BinaryOp):
-            a = self._const_eval(node.left, sc)
-            if a is None:
-                return None
-            if node.op in ("&&", "||"):
-                if node.op == "&&" and a == 0:
-                    return 0
-                if node.op == "||" and a != 0:
-                    return 1
-                b = self._const_eval(node.right, sc)
-                return None if b is None else int(b != 0)
-            b = self._const_eval(node.right, sc)
-            if b is None:
-                return None
-            op = node.op
-            if op in ("==", "!="):
-                eq = (a & 0xFFFFFFFF) == (b & 0xFFFFFFFF)
-                return int(eq if op == "==" else not eq)
-            if op in ("<", ">", "<=", ">="):
-                # int vs unsigned compare agree only when both
-                # operands are non-negative in the signed view.
-                if a < 0 or b < 0:
-                    return None
-                return int({"<": a < b, ">": a > b,
-                            "<=": a <= b, ">=": a >= b}[op])
-            if op == ">>":
-                if a < 0:
-                    return None          # arithmetic-vs-logical ambiguity
-                return a >> (b & 31)
-            if op == "<<":
-                return self._wrap32(a << (b & 31))
-            if op in ("+", "-", "*", "&", "|", "^"):
-                return self._wrap32({"+": a + b, "-": a - b, "*": a * b,
-                                     "&": a & b, "|": a | b,
-                                     "^": a ^ b}[op])
-            if op in ("/", "%"):
-                # C truncates toward zero; Python floors -- fold only
-                # the unambiguous non-negative case.
-                if a < 0 or b <= 0:
-                    return None
-                return a // b if op == "/" else a % b
-            return None
-        return None
-
-    @staticmethod
-    def _norm_const(ct: _CType, v: int) -> int:
-        """C conversion of a known value into the declared type."""
-        mask = (1 << ct.bits) - 1
-        v &= mask
-        if not ct.unsigned and v >= (1 << (ct.bits - 1)):
-            v -= 1 << ct.bits
-        return v
-
-    def _const_set(self, sc: _Scope, name: str, v: Optional[int],
-                   ct: Optional[_CType] = None) -> None:
-        if v is None:
-            sc.consts.pop(name, None)
-        else:
-            if ct is not None and not isinstance(ct, _CType64):
-                v = self._norm_const(ct, v)
-            sc.consts[name] = v
-
-    # -- expressions -------------------------------------------------------
-    def eval(self, node, sc: _Scope):
-        if isinstance(node, c_ast.Constant):
-            if "char" in node.type and node.value.startswith("'"):
-                # Character constant: type int in C.
-                body = node.value[1:-1].encode().decode("unicode_escape")
-                return jnp.int32(ord(body))
-            if "int" in node.type:
-                v = node.value.rstrip("uUlL")
-                base = int(v, 0)
-                # C type of the literal: explicit u suffix, or a hex/octal
-                # literal too big for int (0xffffffff is unsigned int in
-                # ILP32; decimal literals never become unsigned).
-                uns = ("u" in node.value.lower()
-                       or (base > 0x7FFFFFFF
-                           and v.lower().startswith("0")))
-                if base > 0xFFFFFFFF:
-                    # Literal outside 32 bits: a long long constant.
-                    return _C64(base & 0xFFFFFFFF,
-                                (base >> 32) & 0xFFFFFFFF, uns)
-                return (jnp.uint32(base & 0xFFFFFFFF) if uns
-                        else jnp.int32(np.int32(base & 0xFFFFFFFF)))
-            raise CLiftError(f"unsupported constant type {node.type!r}")
-        if isinstance(node, c_ast.ExprList):
-            # C comma expression: evaluate left to right, value is last.
-            v = jnp.int32(0)
-            for e in node.exprs:
-                v = self.eval(e, sc)
-            return v
-        if isinstance(node, c_ast.ID):
-            v = sc.read(node.name)
-            ct = sc.ctype(node.name)
-            # Narrow SCALAR reads re-normalize: an injected bit above the
-            # declared width does not exist in real byte/short memory, so
-            # the promoted value masks it (docs/lifter.md, layout
-            # envelope).  Arrays pass through untouched -- an ID naming an
-            # array is C pointer decay, not a value read.
-            if ct is not None and ct.bits < 32 and jnp.ndim(v) == 0:
-                return ct.store(v)
-            return v
-        if isinstance(node, c_ast.ArrayRef):
-            arr, idx, base = self._array_path(node, sc)
-            ct = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
-                  else sc.ctype(base))
-            if isinstance(ct, _CType64):
-                row = arr[idx]                  # (..., 2) limb pair
-                return _C64(row[..., 0], row[..., 1], ct.unsigned)
-            v = arr[idx]
-            return (ct.store(v) if ct is not None and ct.bits < 32
-                    else v)
-        if isinstance(node, c_ast.BinaryOp):
-            return self._binop(node, sc)
-        if isinstance(node, c_ast.UnaryOp):
-            return self._unop(node, sc)
-        if isinstance(node, c_ast.TernaryOp):
-            c = self.eval(node.cond, sc)
-            a = self.eval(node.iftrue, sc)
-            b = self.eval(node.iffalse, sc)
-            if isinstance(a, _C64) or isinstance(b, _C64):
-                a64, b64 = _to64(a), _to64(b)
-                t_ = self._truth(c)
-                return _C64(jnp.where(t_, a64.lo, b64.lo),
-                            jnp.where(t_, a64.hi, b64.hi),
-                            a64.unsigned or b64.unsigned)
-            a, b = self._usual_conv(a, b)
-            return jnp.where(jnp.not_equal(c, 0), a, b)
-        if isinstance(node, c_ast.FuncCall):
-            return self._call(node, sc)
-        if isinstance(node, c_ast.Cast):
-            if isinstance(node.to_type.type, c_ast.PtrDecl):
-                raise CLiftError(
-                    f"pointer cast in value position at {node.coord}; "
-                    "pointer casts are modeled only where a pointer "
-                    "flows (seatings, call arguments, derefs)")
-            ct = _ctype_of(node.to_type.type.type.names, self.typedefs)
-            # C cast semantics: value converted to the target type --
-            # truncate + re-sign for narrow targets, plain dtype change
-            # for 32-bit ones.
-            return ct.store(self.eval(node.expr, sc))
-        if isinstance(node, c_ast.Assignment):
-            # expression-position assignment (e.g. in for-next)
-            return self._assign(node, sc)
-        raise CLiftError(
-            f"unsupported expression {type(node).__name__} at {node.coord}")
-
-    def _usual_conv(self, a, b):
-        """C usual arithmetic conversions, ILP32 32-bit lane: if either
-        side is unsigned, both are."""
-        a = jnp.asarray(a)
-        b = jnp.asarray(b)
-        if a.dtype == jnp.uint32 or b.dtype == jnp.uint32:
-            return a.astype(jnp.uint32), b.astype(jnp.uint32)
-        return a.astype(jnp.int32), b.astype(jnp.int32)
-
-    @staticmethod
-    def _truth(v):
-        """C truth value of a scalar or limb-pair value."""
-        if isinstance(v, _C64):
-            return jnp.not_equal(v.lo | v.hi, 0)
-        return jnp.not_equal(jnp.asarray(v), 0)
-
-    def _ptrish(self, node, sc) -> bool:
-        """Is this expression a pointer value (decayed array, walked or
-        global pointer, &-expr, pointer +/- offset)?"""
-        if isinstance(node, c_ast.ID):
-            if node.name in sc.aliases:
-                return True
-            if (node.name in self.g_ptrs
-                    and node.name not in sc.locals):
-                return True
-            tgt = node.name
-            return tgt in sc.g and jnp.ndim(sc.g[tgt]) >= 1
-        if isinstance(node, c_ast.Cast):
-            return (isinstance(node.to_type.type, c_ast.PtrDecl)
-                    and self._ptrish(node.expr, sc))
-        if isinstance(node, c_ast.UnaryOp) and node.op == "&":
-            return True
-        if isinstance(node, c_ast.BinaryOp) and node.op in ("+", "-"):
-            return (self._ptrish(node.left, sc)
-                    or self._ptrish(node.right, sc))
-        return False
-
-    def _binop(self, node, sc):
-        if (node.op in ("==", "!=", "<", ">", "<=", ">=", "-")
-                and (self._ptrish(node.left, sc)
-                     or self._ptrish(node.right, sc))):
-            # Pointer comparison / difference: both sides resolve to
-            # (base, offset); same base -> compare/subtract offsets
-            # (element-indexed cursors, matching C's element units).
-            ba, oa = self._ptr_parts(node.left, sc)
-            bb, ob = self._ptr_parts(node.right, sc)
-            if ba != bb:
-                raise CLiftError(
-                    f"pointer {node.op} across different arrays "
-                    f"({ba!r} vs {bb!r}) at {node.coord}")
-            return self._apply_binop(node.op, jnp.asarray(oa, jnp.int32),
-                                     jnp.asarray(ob, jnp.int32), node)
-        a = self.eval(node.left, sc)
-        b = self.eval(node.right, sc)
-        return self._apply_binop(node.op, a, b, node)
-
-    def _apply_binop(self, op, a, b, node):
-        if op in ("&&", "||"):
-            az = self._truth(a)
-            bz = self._truth(b)
-            r = jnp.logical_and(az, bz) if op == "&&" else jnp.logical_or(az, bz)
-            return r.astype(jnp.int32)
-        if isinstance(a, _C64) or isinstance(b, _C64):
-            return self._binop64(op, a, b, node)
-        a, b = self._usual_conv(a, b)
-        if op == "+":
-            return a + b
-        if op == "-":
-            return a - b
-        if op == "*":
-            return a * b
-        if op == "/":
-            return jax.lax.div(a, b) if a.dtype == jnp.int32 else a // b
-        if op == "%":
-            return jax.lax.rem(a, b) if a.dtype == jnp.int32 else a % b
-        if op == "^":
-            return a ^ b
-        if op == "&":
-            return a & b
-        if op == "|":
-            return a | b
-        if op == "<<":
-            return a << b
-        if op == ">>":
-            return a >> b
-        cmp = {"==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
-               ">": jnp.greater, "<=": jnp.less_equal,
-               ">=": jnp.greater_equal}.get(op)
-        if cmp is not None:
-            return cmp(a, b).astype(jnp.int32)
-        raise CLiftError(f"unsupported binary op {op!r} at {node.coord}")
-
-    def _binop64(self, op, a, b, node):
-        """Binary ops with a 64-bit (limb-pair) operand."""
-        if op in ("<<", ">>"):
-            # The SHIFT COUNT is not subject to the usual conversions:
-            # a << amount keeps a's type; the amount reduces to int.
-            a64 = _to64(a)
-            s = b.lo if isinstance(b, _C64) else jnp.asarray(b, jnp.uint32)
-            return _c64_shl(a64, s) if op == "<<" else _c64_shr(a64, s)
-        a64, b64 = _to64(a), _to64(b)
-        unsigned = a64.unsigned or b64.unsigned
-        if op == "+":
-            return _c64_add(a64, b64, unsigned)
-        if op == "-":
-            return _c64_add(a64, _c64_neg(b64), unsigned)
-        if op == "*":
-            return _c64_mul(a64, b64, unsigned)
-        if op in ("/", "%"):
-            if not unsigned:
-                raise CLiftError(
-                    f"signed 64-bit {op} at {node.coord} is outside the "
-                    "modeled envelope (softfloat divides unsigned)")
-            q, r = _c64_divmod(a64, b64)
-            return q if op == "/" else r
-        if op == "&":
-            return _C64(a64.lo & b64.lo, a64.hi & b64.hi, unsigned)
-        if op == "|":
-            return _C64(a64.lo | b64.lo, a64.hi | b64.hi, unsigned)
-        if op == "^":
-            return _C64(a64.lo ^ b64.lo, a64.hi ^ b64.hi, unsigned)
-        if op == "==":
-            return jnp.logical_and(jnp.equal(a64.lo, b64.lo),
-                                   jnp.equal(a64.hi, b64.hi)
-                                   ).astype(jnp.int32)
-        if op == "!=":
-            return jnp.logical_or(jnp.not_equal(a64.lo, b64.lo),
-                                  jnp.not_equal(a64.hi, b64.hi)
-                                  ).astype(jnp.int32)
-        if op == "<":
-            return _c64_lt(a64, b64, unsigned).astype(jnp.int32)
-        if op == ">":
-            return _c64_lt(b64, a64, unsigned).astype(jnp.int32)
-        if op == "<=":
-            return jnp.logical_not(_c64_lt(b64, a64, unsigned)
-                                   ).astype(jnp.int32)
-        if op == ">=":
-            return jnp.logical_not(_c64_lt(a64, b64, unsigned)
-                                   ).astype(jnp.int32)
-        raise CLiftError(
-            f"unsupported 64-bit binary op {op!r} at {node.coord} "
-            "(long long supports + - * & | ^ << >> and comparisons)")
-
-    def _unop(self, node, sc):
-        op = node.op
-        if op in ("++", "p++", "--", "p--"):
-            name = node.expr
-            old = self.eval(name, sc)
-            if isinstance(old, _C64):
-                one = _C64(1, 0, old.unsigned)
-                new = (_c64_add(old, one, old.unsigned) if "++" in op
-                       else _c64_add(old, _c64_neg(one), old.unsigned))
-            else:
-                delta = jnp.asarray(1, old.dtype)
-                new = old + delta if "++" in op else old - delta
-            self._store(name, new, sc)
-            if isinstance(name, c_ast.ID):
-                prev = sc.consts.get(name.name)
-                self._const_set(
-                    sc, name.name,
-                    None if prev is None else
-                    self._wrap32(prev + (1 if "++" in op else -1)),
-                    sc.ctype(name.name))
-            return old if op.startswith("p") else new
-        if op == "*":
-            base, off = self._ptr_parts(node.expr, sc)
-            if isinstance(base, tuple):          # union pointer
-                ct = sc.ctypes.get(base[0])
-                v = self._union_read(sc, base)[off]
-                return (ct.store(v) if ct is not None and ct.bits < 32
-                        else v)
-            arr = sc.g[base]
-            ct = sc.ctypes.get(base)
-            if isinstance(ct, _CType64):
-                row = arr.reshape(-1, 2)[off]   # limb-pair element
-                return _C64(row[0], row[1], ct.unsigned)
-            if jnp.ndim(arr) > 1:
-                arr = arr.reshape(-1)       # cursors walk row-major memory
-            v = arr[off]
-            return (ct.store(v) if ct is not None and ct.bits < 32
-                    else v)
-        if op == "sizeof":
-            return jnp.int32(self._sizeof(node.expr, sc))
-        v = self.eval(node.expr, sc)
-        if isinstance(v, _C64):
-            if op == "-":
-                return _c64_neg(v)
-            if op == "+":
-                return v
-            if op == "~":
-                return _C64(~v.lo, ~v.hi, v.unsigned)
-            if op == "!":
-                return jnp.equal(v.lo | v.hi, 0).astype(jnp.int32)
-            raise CLiftError(
-                f"unsupported unary op {op!r} on long long at {node.coord}")
-        if op == "-":
-            return -v
-        if op == "+":
-            return v
-        if op == "~":
-            return ~v
-        if op == "!":
-            return jnp.equal(v, 0).astype(jnp.int32)
-        raise CLiftError(f"unsupported unary op {op!r} at {node.coord}")
-
-    def _sizeof(self, expr, sc) -> int:
-        """C sizeof in the REAL C layout (not the lane layout): element
-        count times the declared element width in bytes.  The benchmarks
-        use it for byte-array lengths (aes.c's sizeof(input))."""
-        if isinstance(expr, c_ast.Typename):
-            ct = _ctype_of(getattr(expr.type.type, "names", ["int"]),
-                           self.typedefs)
-            return ct.bits // 8
-        if isinstance(expr, c_ast.ID):
-            name = expr.name
-            if name in sc.aliases:
-                # Array/pointer PARAMETERS and local pointer variables
-                # decay: C's sizeof is the pointer size (ILP32: 4), the
-                # classic sizeof-of-parameter trap included.
-                return 4
-            arr = sc.read(name)
-            ct = sc.ctype(name)
-            width = (ct.bits // 8) if ct is not None else 4
-            n = int(np.prod(arr.shape)) if jnp.ndim(arr) else 1
-            return n * width
-        raise CLiftError(
-            f"unsupported sizeof operand at {getattr(expr, 'coord', '?')}")
-
-    def _ptr_parts(self, expr, sc) -> Tuple[str, jax.Array]:
-        """Resolve a pointer-valued expression to (global name, offset).
-
-        The subset's pointers are walked array parameters: ``p`` (cursor
-        or start), ``p++``/``++p``/``p--``/``--p`` (cursor effect applies,
-        value is the C-correct old/new pointer), and ``p + e``.  This is
-        the shape the reference's byte-stream benchmarks use
-        (crc16.c:26 ``*data_p++``)."""
-        if isinstance(expr, c_ast.ID) and expr.name in sc.aliases:
-            return (sc.aliases[expr.name],
-                    jnp.asarray(sc.locals.get(expr.name, 0), jnp.int32))
-        if (isinstance(expr, c_ast.ID) and expr.name in self.g_ptrs
-                and expr.name not in sc.locals):
-            base = self.g_ptr_base.get(expr.name)
-            if base is None:
-                raise CLiftError(
-                    f"global pointer {expr.name!r} used before any "
-                    "seating; seat it (p = arr) first")
-            return base, jnp.asarray(sc.read(expr.name), jnp.int32)
-        if isinstance(expr, c_ast.ID) and expr.name in sc.locals:
-            # A LOCAL array (possibly shadowing a same-name global)
-            # cannot be a pointer target -- aliases only bind into the
-            # globals dict.  Refuse loudly instead of silently binding
-            # the shadowed global.
-            raise CLiftError(
-                f"pointer to local array {expr.name!r} at "
-                f"{getattr(expr, 'coord', '?')} is not supported; make "
-                "the array a global or pass it as a call argument")
-        if (isinstance(expr, c_ast.ID) and expr.name in sc.g
-                and jnp.ndim(sc.g[expr.name]) >= 1):
-            # A global array name decays to a pointer to its start.
-            return expr.name, jnp.int32(0)
-        if (isinstance(expr, c_ast.UnaryOp)
-                and expr.op in ("++", "p++", "--", "p--")
-                and isinstance(expr.expr, c_ast.ID)):
-            nm = expr.expr.name
-            if nm in sc.aliases:
-                if nm not in sc.locals:
-                    raise CLiftError(
-                        f"pointer arithmetic on unwalked parameter "
-                        f"{nm!r} at {expr.coord}")
-                off = self._unop(expr, sc)      # applies the cursor effect
-                return sc.aliases[nm], jnp.asarray(off, jnp.int32)
-            if nm in self.g_ptrs and nm not in sc.locals:
-                base = self.g_ptr_base.get(nm)
-                if base is None:
-                    raise CLiftError(
-                        f"global pointer {nm!r} walked before any "
-                        f"seating at {expr.coord}")
-                off = self._unop(expr, sc)      # global cursor effect
-                return base, jnp.asarray(off, jnp.int32)
-        if isinstance(expr, c_ast.Cast):
-            # Pointer casts ((void*)buf, (char*)p) change the static type,
-            # not the address: pass through.  The pointee's ctype stays
-            # the ALIASED array's -- reinterpreting an int array as bytes
-            # would need sub-word addressing, outside the lane model.
-            return self._ptr_parts(expr.expr, sc)
-        if isinstance(expr, c_ast.UnaryOp) and expr.op == "&":
-            # Address-of: &arr -> (arr, 0); &arr[k] -> (arr, k); multi-dim
-            # &arr[j][k] -> (arr, j*cols + k) -- the cursor indexes the
-            # row-major FLATTENED array (sha_stream's &indata[j][0]).
-            inner = expr.expr
-            if isinstance(inner, c_ast.ArrayRef):
-                idxs, node2 = [], inner
-                while isinstance(node2, c_ast.ArrayRef):
-                    idxs.append(node2.subscript)
-                    node2 = node2.name
-                if isinstance(node2, c_ast.ID):
-                    base, off = self._ptr_parts(node2, sc)
-                    shape = jnp.shape(sc.g[base])
-                    idxs = list(reversed(idxs))
-                    if len(idxs) > len(shape):
-                        raise CLiftError(
-                            f"too many subscripts under & at {expr.coord}")
-                    flat = jnp.int32(0)
-                    for d, ix in enumerate(idxs):
-                        stride = int(np.prod(shape[d + 1:], dtype=np.int64))
-                        flat = flat + jnp.asarray(
-                            self.eval(ix, sc), jnp.int32) * stride
-                    return base, off + flat
-            if (isinstance(inner, c_ast.ID) and inner.name in sc.locals
-                    and inner.name not in sc.aliases
-                    and jnp.ndim(sc.locals[inner.name]) == 0):
-                raise CLiftError(
-                    f"address-of scalar {inner.name!r} at "
-                    f"{getattr(expr, 'coord', '?')} is not supported "
-                    "(no out-parameter model; return the value instead)")
-            return self._ptr_parts(inner, sc)
-        if isinstance(expr, c_ast.BinaryOp) and expr.op in ("+", "-"):
-            base, off = self._ptr_parts(expr.left, sc)
-            d = jnp.asarray(self.eval(expr.right, sc), jnp.int32)
-            return base, (off + d if expr.op == "+" else off - d)
-        if isinstance(expr, c_ast.ArrayRef):
-            # PARTIAL indexing decays a sub-array to a pointer
-            # (`p = ta[i]` over int ta[2][4] -> base ta, offset i*4).
-            idxs, node2 = [], expr
-            while isinstance(node2, c_ast.ArrayRef):
-                idxs.append(node2.subscript)
-                node2 = node2.name
-            if isinstance(node2, c_ast.ID):
-                base, off0 = self._ptr_parts(node2, sc)
-                if not isinstance(base, tuple):
-                    arrv = sc.g[base]
-                    eff_nd = jnp.ndim(arrv)
-                    if isinstance(sc.ctypes.get(base), _CType64):
-                        eff_nd -= 1
-                    if len(idxs) < eff_nd:
-                        shape = jnp.shape(arrv)
-                        flat = jnp.int32(0)
-                        for d2, ix in enumerate(reversed(idxs)):
-                            stride = int(np.prod(shape[d2 + 1:eff_nd],
-                                                 dtype=np.int64))
-                            flat = flat + jnp.asarray(
-                                self.eval(ix, sc), jnp.int32) * stride
-                        return base, off0 + flat
-        raise CLiftError(
-            f"unsupported pointer expression at {getattr(expr, 'coord', '?')}")
-
-    def _array_path(self, node, sc):
-        """Flatten a[i][j]... into (array value, index tuple).  A pointer
-        parameter that has been walked (``p++``) indexes relative to its
-        cursor: ``p[i]`` reads the aliased global at cursor+i."""
-        idxs = []
-        while isinstance(node, c_ast.ArrayRef):
-            idxs.append(node.subscript)
-            node = node.name
-        if not isinstance(node, c_ast.ID):
-            raise CLiftError(f"unsupported array base at {node.coord}")
-        name = node.name
-        cursor = (sc.locals.get(name) if name in sc.aliases else None)
-        base = sc.aliases.get(name, name)
-        if name in sc.aliases and isinstance(sc.aliases[name], tuple):
-            arr = self._union_read(sc, sc.aliases[name])
-        elif name in sc.aliases:
-            arr = sc.g[sc.aliases[name]]
-        elif (name in self.g_ptrs and name not in sc.locals):
-            # Subscripting a GLOBAL pointer (gp[i]) routes through its
-            # seated base + cursor, same as _ptr_parts' deref path --
-            # sc.read(name) would hand back the int32 cursor scalar.
-            seated = self.g_ptr_base.get(name)
-            if seated is None:
-                raise CLiftError(
-                    f"global pointer {name!r} subscripted before any "
-                    f"seating at {node.coord}; seat it (p = arr) first")
-            arr = sc.g[seated]
-            cursor = jnp.asarray(sc.read(name), jnp.int32)
-            base = seated
-        else:
-            arr = sc.read(name)
-        idx = tuple(self.eval(i, sc).astype(jnp.int32)
-                    for i in reversed(idxs))
-        if cursor is not None:
-            if len(idx) != 1:
-                raise CLiftError(
-                    f"walked pointer {name!r} must be 1-D at {node.coord}")
-            # Cursor over row-major memory: flatten to element rows.  A
-            # 64-bit base keeps its trailing limb-pair axis -- the cursor
-            # counts ELEMENTS, and the _CType64 load/store consume (n, 2)
-            # rows; a full flatten would index half-pairs.
-            ct_c = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
-                    else sc.ctype(base))
-            if isinstance(ct_c, _CType64):
-                if jnp.ndim(arr) > 2:
-                    arr = arr.reshape(-1, 2)
-            elif jnp.ndim(arr) > 1:
-                arr = arr.reshape(-1)
-            idx = (idx[0] + cursor,)
-        return arr, (idx if len(idx) > 1 else idx[0]), base
-
-    def _store(self, lhs, val, sc):
-        if isinstance(lhs, c_ast.ID):
-            ct = sc.ctype(lhs.name)
-            if ct is not None:
-                sc.write(lhs.name, ct.store(val))
-                return
-            if isinstance(val, _C64):
-                # Untyped slot receiving a 64-bit value (early-return
-                # carries of 64-bit functions): store the pair as-is.
-                sc.write(lhs.name, val)
-                return
-            old = sc.read(lhs.name)
-            sc.write(lhs.name, jnp.asarray(val).astype(old.dtype)
-                     if hasattr(old, "dtype") else val)
-            return
-        if isinstance(lhs, c_ast.ArrayRef):
-            arr, idx, base = self._array_path(lhs, sc)
-            if isinstance(base, tuple):          # union pointer
-                ct = sc.ctypes.get(base[0])
-                stored = (ct.store(val) if ct is not None
-                          else jnp.asarray(val).astype(arr.dtype))
-                self._union_write(
-                    sc, base, arr.at[idx].set(stored.astype(arr.dtype)))
-                return
-            ct = sc.ctype(base)
-            if isinstance(ct, _CType64):
-                v64 = _to64(val)
-                new = arr.at[idx].set(jnp.stack([v64.lo, v64.hi]))
-                orig = sc.read_binding(base)
-                if jnp.shape(new) != jnp.shape(orig):
-                    # _array_path flattened a cursor view over a
-                    # multi-dim 64-bit array to (-1, 2) limb rows;
-                    # restore the canonical shape.
-                    new = new.reshape(jnp.shape(orig))
-                sc.write_binding(base, new)
-                return
-            stored = (ct.store(val) if ct is not None
-                      else jnp.asarray(val).astype(arr.dtype))
-            new = arr.at[idx].set(stored.astype(arr.dtype))
-            orig = sc.read_binding(base)
-            if jnp.shape(new) != jnp.shape(orig):
-                # _array_path flattened a cursor view over a multi-dim
-                # array; restore the canonical shape.
-                new = new.reshape(jnp.shape(orig))
-            # base is already alias-RESOLVED: write the binding
-            # directly (re-resolving would mis-route when a parameter
-            # shadows a global of the same name).
-            sc.write_binding(base, new)
-            return
-        if isinstance(lhs, c_ast.UnaryOp) and lhs.op == "*":
-            # Deref store (*p++ = c): C order -- the store targets the
-            # pointer value BEFORE any ++/-- side effect, which
-            # _ptr_parts implements (p++ yields the old offset).
-            base, off = self._ptr_parts(lhs.expr, sc)
-            if isinstance(base, tuple):          # union pointer
-                ct = sc.ctypes.get(base[0])
-                flat = self._union_read(sc, base)
-                stored = (ct.store(val) if ct is not None
-                          else jnp.asarray(val).astype(flat.dtype))
-                self._union_write(
-                    sc, base, flat.at[off].set(stored.astype(flat.dtype)))
-                return
-            arr = sc.g[base]
-            ct = sc.ctypes.get(base)
-            if isinstance(ct, _CType64):
-                v64 = _to64(val)
-                flat = arr.reshape(-1, 2).at[off].set(
-                    jnp.stack([v64.lo, v64.hi]))
-                sc.write_binding(base, flat.reshape(jnp.shape(arr)))
-                return
-            stored = (ct.store(val) if ct is not None
-                      else jnp.asarray(val).astype(arr.dtype))
-            if jnp.ndim(arr) > 1:           # cursors walk row-major memory
-                flat = arr.reshape(-1).at[off].set(stored.astype(arr.dtype))
-                sc.write_binding(base, flat.reshape(jnp.shape(arr)))
-            else:
-                sc.write_binding(base,
-                                 arr.at[off].set(stored.astype(arr.dtype)))
-            return
-        raise CLiftError(
-            f"unsupported assignment target {type(lhs).__name__}")
-
-    def _assign(self, node, sc):
-        op = node.op
-        if (op == "=" and isinstance(node.lvalue, c_ast.ID)
-                and node.lvalue.name in self.g_ptrs
-                and node.lvalue.name not in sc.locals
-                and node.lvalue.name not in sc.aliases):
-            # GLOBAL pointer (re-)seating: static single base, runtime
-            # cursor stored in the int32 cursor global.
-            name = node.lvalue.name
-            base, off = self._ptr_parts(node.rvalue, sc)
-            prev = self.g_ptr_base.get(name)
-            if prev is not None and prev != base:
-                raise CLiftError(
-                    f"global pointer {name!r} re-seated from {prev!r} "
-                    f"to {base!r} at {node.coord}: a single static base "
-                    "per global pointer is the modeled envelope")
-            self.g_ptr_base[name] = base
-            sc.write(name, jnp.asarray(off, jnp.int32))
-            sc.consts.pop(name, None)
-            return off
-        if (op == "=" and isinstance(node.lvalue, c_ast.ID)
-                and (node.lvalue.name in sc.ptrs
-                     or node.lvalue.name in sc.aliases)):
-            # Pointer (re-)seating: `p = arr`, `p = q`, `p = p + k`,
-            # `p = (T*)s`, `p = &a[k]` -- resolve the RHS to
-            # (array, offset) and re-bind the cursor.  An unresolvable
-            # RHS refuses loudly in _ptr_parts (the round-3 advisor
-            # found the old scalar path silently storing a whole array
-            # into the cursor local).
-            name = node.lvalue.name
-            base, off = self._ptr_parts(node.rvalue, sc)
-            union = self._union_bases(sc.aliases.get(name))
-            if union is not None and not isinstance(base, tuple):
-                # Union pointer: a seat on a member re-bases the cursor
-                # into that member's segment of the concatenation.
-                off = self._union_offset(sc, union, base) + jnp.asarray(
-                    off, jnp.int32)
-            else:
-                sc.aliases[name] = base
-            sc.locals[name] = jnp.asarray(off, jnp.int32)
-            sc.consts.pop(name, None)
-            return off
-        if op == "=":
-            const = (self._const_eval(node.rvalue, sc)
-                     if isinstance(node.lvalue, c_ast.ID) else None)
-            val = self.eval(node.rvalue, sc)
-            self._store(node.lvalue, val, sc)
-            if isinstance(node.lvalue, c_ast.ID):
-                self._const_set(sc, node.lvalue.name, const,
-                                sc.ctype(node.lvalue.name))
-            return val
-        # Compound assignment (+= <<= ...): the lvalue designates ONE
-        # location, evaluated ONCE (C11 6.5.16.2) -- a side-effecting
-        # lvalue like GSM's rescale `*s++ <<= scalauto` must advance the
-        # cursor exactly once, with read and store hitting the SAME
-        # element (the old fake-binop path re-evaluated it for the
-        # store, double-stepping the cursor).
-        bin_op = op[:-1]
-        lhs = node.lvalue
-        if isinstance(lhs, c_ast.UnaryOp) and lhs.op == "*":
-            base, off = self._ptr_parts(lhs.expr, sc)   # effects, once
-            if isinstance(base, tuple):          # union pointer
-                ct = sc.ctypes.get(base[0])
-                flat0 = self._union_read(sc, base)
-                old = flat0[off]
-                if ct is not None and ct.bits < 32:
-                    old = ct.store(old)
-                val = self._apply_binop(bin_op, old,
-                                        self.eval(node.rvalue, sc), node)
-                stored = (ct.store(val) if ct is not None
-                          else jnp.asarray(val).astype(flat0.dtype))
-                self._union_write(
-                    sc, base,
-                    flat0.at[off].set(stored.astype(flat0.dtype)))
-                return val
-            arr = sc.g[base]
-            flat = arr.reshape(-1) if jnp.ndim(arr) > 1 else arr
-            ct = sc.ctypes.get(base)
-            old = flat[off]
-            if ct is not None and ct.bits < 32:
-                old = ct.store(old)
-            val = self._apply_binop(bin_op, old,
-                                    self.eval(node.rvalue, sc), node)
-            stored = (ct.store(val) if ct is not None
-                      else jnp.asarray(val).astype(arr.dtype))
-            new = flat.at[off].set(stored.astype(arr.dtype))
-            if jnp.ndim(arr) > 1:
-                new = new.reshape(jnp.shape(arr))
-            sc.write_binding(base, new)
-            return val
-        if isinstance(lhs, c_ast.ArrayRef):
-            arr, idx, base = self._array_path(lhs, sc)  # subscripts, once
-            ct = (sc.ctypes.get(base[0]) if isinstance(base, tuple)
-                  else sc.ctype(base))
-            old = arr[idx]
-            if ct is not None and ct.bits < 32:
-                old = ct.store(old)
-            val = self._apply_binop(bin_op, old,
-                                    self.eval(node.rvalue, sc), node)
-            stored = (ct.store(val) if ct is not None
-                      else jnp.asarray(val).astype(arr.dtype))
-            new = arr.at[idx].set(stored.astype(arr.dtype))
-            if isinstance(base, tuple):              # union pointer
-                self._union_write(sc, base, new)
-                return val
-            orig = sc.read_binding(base)
-            if jnp.shape(new) != jnp.shape(orig):
-                new = new.reshape(jnp.shape(orig))
-            sc.write_binding(base, new)
-            return val
-        # Plain identifier lvalue: no side effects to duplicate.
-        fake = c_ast.BinaryOp(bin_op, node.lvalue, node.rvalue, node.coord)
-        const = (self._const_eval(fake, sc)
-                 if isinstance(node.lvalue, c_ast.ID) else None)
-        val = self._binop(fake, sc)
-        self._store(node.lvalue, val, sc)
-        if isinstance(node.lvalue, c_ast.ID):
-            self._const_set(sc, node.lvalue.name, const,
-                            sc.ctype(node.lvalue.name))
-        return val
-
-    def _call(self, node, sc):
-        if not isinstance(node.name, c_ast.ID):
-            raise CLiftError(f"unsupported indirect call at {node.coord}")
-        fname = node.name.name
-        arg_nodes = node.args.exprs if node.args else []
-        if fname == "printf":
-            # The QEMU loop's observable: everything printed is output.
-            # The format string itself is not evaluated (no string
-            # model); a 64-bit value prints as its two limbs.
-            vals = []
-            for a in arg_nodes[1:]:
-                v = self.eval(a, sc)
-                if isinstance(v, _C64):
-                    vals.extend([v.lo, v.hi])
-                else:
-                    vals.append(jnp.asarray(v))
-            if (not vals and isinstance(sc.printed, _NoPrintList)
-                    and "__print_buf" in sc.g and arg_nodes
-                    and isinstance(arg_nodes[0], c_ast.Constant)
-                    and arg_nodes[0].type == "string"):
-                # String-only print at a dynamically-reached site: its
-                # string-table id is the buffered word.
-                text = (arg_nodes[0].value[1:-1]
-                        .encode("utf-8").decode("unicode_escape"))
-                if text in self.print_strings:
-                    sid = self.print_strings.index(text)
-                else:
-                    self.print_strings.append(text)
-                    sid = len(self.print_strings) - 1
-                vals = [jnp.uint32(sid)]
-            if (vals and isinstance(sc.printed, _NoPrintList)
-                    and "__print_buf" in sc.g):
-                # UART-buffer model: dynamically-reached prints append
-                # into the bounded __print_buf observable (overflowing
-                # words drop; __print_cnt keeps the true total).
-                buf = sc.g["__print_buf"]
-                cnt = sc.g["__print_cnt"]
-                for v in vals:
-                    idx = jnp.clip(cnt, 0, _PRINT_BUF_WORDS - 1)
-                    keep = cnt < _PRINT_BUF_WORDS
-                    buf = buf.at[idx].set(
-                        jnp.where(keep, jnp.asarray(v).astype(jnp.uint32),
-                                  buf[idx]))
-                    cnt = cnt + 1
-                sc.g["__print_buf"] = buf
-                sc.g["__print_cnt"] = cnt
-                return jnp.int32(0)
-            sc.printed.extend(vals)
-            return jnp.int32(0)
-        # C array arguments are pointers: a bare ID naming a (possibly
-        # already-aliased) global array binds the parameter to that global.
-        args = []
-        for a in arg_nodes:
-            # A pointer CAST on an argument changes the static type only
-            # ((unsigned char *)ivec): unwrap it and bind the underlying
-            # array/pointer as usual.
-            while (isinstance(a, c_ast.Cast)
-                   and isinstance(a.to_type.type, c_ast.PtrDecl)):
-                a = a.expr
-            if isinstance(a, c_ast.UnaryOp) and a.op == "&":
-                inner = a.expr
-                if (isinstance(inner, c_ast.ID) and inner.name in sc.locals
-                        and inner.name not in sc.aliases
-                        and jnp.ndim(sc.locals[inner.name]) == 0):
-                    # Scalar out-parameter (&num, blowfish's cfb64 state):
-                    # copy-in/copy-out through a 1-word transient slot,
-                    # like caller-local arrays.
-                    args.append(("__alias_scalar_local__", inner.name))
-                    continue
-                if (isinstance(inner, c_ast.ID) and inner.name in sc.g
-                        and jnp.ndim(sc.g[inner.name]) == 0):
-                    # Address of a GLOBAL scalar (jpeg's
-                    # &OutData_image_width): same slot model, copied
-                    # back into the global when the callee returns
-                    # (in-call aliasing with direct reads of the same
-                    # global is outside the envelope).
-                    args.append(("__alias_scalar_global__", inner.name))
-                    continue
-                # &localarr[k]: caller-LOCAL array element address
-                # (motion's &PMV[0]) -- transient slot + cursor k.
-                idxs, node2 = [], inner
-                while isinstance(node2, c_ast.ArrayRef):
-                    idxs.append(node2.subscript)
-                    node2 = node2.name
-                if (isinstance(node2, c_ast.ID) and node2.name in sc.locals
-                        and node2.name not in sc.aliases
-                        and jnp.ndim(sc.locals[node2.name]) >= 1):
-                    shape = jnp.shape(sc.locals[node2.name])
-                    flat = jnp.int32(0)
-                    for d, ix in enumerate(reversed(idxs)):
-                        stride = int(np.prod(shape[d + 1:],
-                                             dtype=np.int64))
-                        flat = flat + jnp.asarray(
-                            self.eval(ix, sc), jnp.int32) * stride
-                    args.append(("__alias_local_off__", node2.name, flat))
-                    continue
-                # &arr[k] / &glob: a pointer value -- forward base+offset.
-                base, off = self._ptr_parts(a, sc)
-                args.append(("__alias_off__", base,
-                             jnp.asarray(off, jnp.int32)))
-                continue
-            if isinstance(a, c_ast.ID):
-                if (a.name in sc.locals and a.name not in sc.aliases
-                        and jnp.ndim(sc.locals[a.name]) >= 1):
-                    # A caller-LOCAL array argument: C passes a pointer to
-                    # it.  Modeled as copy-in/copy-out through a transient
-                    # slot (run_function), sound because the subset has no
-                    # overlapping aliases.
-                    args.append(("__alias_local__", a.name))
-                    continue
-                tgt = sc.aliases.get(a.name, a.name)
-                if isinstance(tgt, tuple):       # union pointer forwards
-                    args.append(("__alias_off__", tgt,
-                                 jnp.asarray(sc.locals.get(a.name, 0),
-                                             jnp.int32)))
-                    continue
-                if tgt in sc.g and jnp.ndim(sc.g[tgt]) >= 1:
-                    if a.name in sc.aliases and a.name in sc.locals:
-                        # A WALKED/SEATED pointer forwards base AND
-                        # cursor, so the callee continues from the
-                        # caller's position (sha_stream passing
-                        # &indata[j][0] onward to sha_update).
-                        args.append(("__alias_off__", tgt,
-                                     jnp.asarray(sc.locals[a.name],
-                                                 jnp.int32)))
-                        continue
-                    args.append(("__alias__", tgt))
-                    continue
-            if isinstance(a, c_ast.ArrayRef):
-                # PARTIAL indexing of a multi-dim array (motion.c's
-                # motion_vector(PMV[0][s], ...)): C decays the sub-array
-                # to a pointer -- forward base + flattened row offset so
-                # callee writes land in the caller's array.  FULL
-                # indexing stays a by-value element.
-                idxs, node2 = [], a
-                while isinstance(node2, c_ast.ArrayRef):
-                    idxs.append(node2.subscript)
-                    node2 = node2.name
-                if isinstance(node2, c_ast.ID):
-                    nm2 = node2.name
-                    arrv = cur = None
-                    basen, is_local = nm2, False
-                    if nm2 in sc.aliases:
-                        basen = sc.aliases[nm2]
-                        arrv = sc.g.get(basen)
-                        cur = sc.locals.get(nm2)
-                    elif (nm2 in sc.locals
-                            and jnp.ndim(sc.locals[nm2]) >= 1):
-                        arrv, is_local = sc.locals[nm2], True
-                    elif nm2 in sc.g and jnp.ndim(sc.g[nm2]) >= 1:
-                        arrv = sc.g[nm2]
-                    eff_nd = None
-                    if arrv is not None:
-                        eff_nd = jnp.ndim(arrv)
-                        # The BASE array's element type decides the
-                        # logical arity (a walked cursor's own ctype is
-                        # deliberately None, so resolve the base).
-                        ctn = (sc.ctype(nm2) if is_local
-                               else sc.ctypes.get(basen))
-                        if isinstance(ctn, _CType64):
-                            eff_nd -= 1     # trailing dim is the limb pair
-                    if arrv is not None and len(idxs) < eff_nd:
-                        shape = jnp.shape(arrv)
-                        flat = jnp.int32(0)
-                        for d, ix in enumerate(reversed(idxs)):
-                            stride = int(np.prod(shape[d + 1:],
-                                                 dtype=np.int64))
-                            flat = flat + jnp.asarray(
-                                self.eval(ix, sc), jnp.int32) * stride
-                        if cur is not None:
-                            flat = flat + jnp.asarray(cur, jnp.int32)
-                        if is_local:
-                            args.append(("__alias_local_off__", nm2,
-                                         flat))
-                        else:
-                            args.append(("__alias_off__", basen, flat))
-                        continue
-            args.append(self.eval(a, sc))
-        if fname == "exit":
-            # exit(n) on an error path (jpeg's "Not Jpeg File!"/huffman
-            # read error): modeled as an OBSERVABLE poison -- the
-            # synthetic global __exit_state records 1+n and joins the
-            # output surface.  Fault-free runs never take these paths,
-            # so the oracle is exact; under injection the poisoned flag
-            # plus divergent outputs classify the run, though in-model
-            # execution continues past the exit (documented fidelity
-            # envelope -- the QEMU guest would stop).
-            code = (args[0] if args else jnp.int32(0))
-            # POSIX truncates the exit status to 8 bits; 1+(n & 0xFF)
-            # is in [1, 256], never colliding with 0 = ran to end.
-            sc.g["__exit_state"] = (
-                (jnp.asarray(code, jnp.int32) & jnp.int32(0xFF))
-                + jnp.int32(1))
-            return jnp.int32(0)
-        if fname == "abort":
-            raise CLiftError(
-                "abort() needs the abort/DUE machinery; model it via "
-                "DWC (detect-only strategy) instead")
-        fn = self.funcs.get(fname)
-        if fn is None:
-            raise CLiftError(f"call to undefined function {fname!r} "
-                             f"at {node.coord}")
-        arg_consts = [None if isinstance(v, tuple)
-                      or self._has_effects(n2)
-                      else self._const_eval(n2, sc)
-                      for n2, v in zip(arg_nodes, args)]
-        return self._run_function(fn, args, sc, arg_consts)
-
-    def _walked_names(self, node) -> set:
-        """Names subject to POINTER arithmetic: ++/--/assignment on the
-        BARE identifier.  Element stores (``a[i] = v``) do not count --
-        they write the pointee, not the pointer (mm.c's r_matrix vs
-        crc16.c's data_p)."""
-        names: set = set()
-
-        class V(c_ast.NodeVisitor):
-            def visit_UnaryOp(v, n):
-                if (n.op in ("++", "p++", "--", "p--")
-                        and isinstance(n.expr, c_ast.ID)):
-                    names.add(n.expr.name)
-                v.generic_visit(n)
-
-            def visit_Assignment(v, n):
-                if isinstance(n.lvalue, c_ast.ID):
-                    names.add(n.lvalue.name)
-                v.generic_visit(n)
-
-        V().visit(node)
-        return names
-
-    # -- desugar pre-pass --------------------------------------------------
-    @staticmethod
-    def _string_only_printf(stmt) -> bool:
-        return (isinstance(stmt, c_ast.FuncCall)
-                and isinstance(stmt.name, c_ast.ID)
-                and stmt.name.name == "printf"
-                and stmt.args is not None
-                and len(stmt.args.exprs) == 1
-                and isinstance(stmt.args.exprs[0], c_ast.Constant)
-                and stmt.args.exprs[0].type == "string")
-
-    def _desugar_fn(self, fndef) -> None:
-        """Memoized per-function AST pre-pass, run before execution and
-        before the early-return rewrite:
-
-        * ``switch`` -> evaluate-once + ``if``/``else if`` chain (the
-          subset's switches are break/return-terminated, CHStone mips.c
-          style; fallthrough refuses loudly);
-        * ``do {B} while (C)`` -> ``B; while (C) {B}`` (the body AST is
-          shared; execution is functional over it);
-        * ``while (1)`` whose body always returns at its tail runs
-          exactly once -> body inlined (mips.c's outer retry loop), so
-          its printfs stay program outputs;
-        * a string-only ``printf("...")`` under a branch/loop becomes a
-          PRINT SLOT: ``__print_sel_k = <string id>`` with the slot
-          initialized to -1 (never printed) and appended to the output
-          surface when the function returns.  The reference's oracle IS
-          stdout ("RESULT: PASS", unittest/cfg/full.yml) and which
-          string prints is data -- a selected-constant output captures
-          exactly that bit.  The id -> string table lands in
-          ``region.meta['print_strings']``.  printf with VALUE arguments
-          inside branches still refuses loudly (a traced per-iteration
-          value cannot escape as a fixed output).
-        """
-        fid = id(fndef)
-        if fid in self._desugared:
-            return
-        self._desugared.add(fid)
-        slots = self._print_slots.setdefault(fid, [])
-        temps = self._sw_temps.setdefault(fid, [])
-        slot_by_node: Dict[int, Tuple[str, int]] = {}
-
-        def as_items(node) -> list:
-            if node is None:
-                return []
-            if isinstance(node, c_ast.Compound):
-                return list(node.block_items or [])
-            return [node]
-
-        def ends_in_return(items) -> bool:
-            if not items:
-                return False
-            last = items[-1]
-            if isinstance(last, c_ast.Return):
-                return True
-            if isinstance(last, c_ast.Compound):
-                return ends_in_return(as_items(last))
-            if isinstance(last, c_ast.If) and last.iffalse is not None:
-                return (ends_in_return(as_items(last.iftrue))
-                        and ends_in_return(as_items(last.iffalse)))
-            return False
-
-        def loose_break(items) -> bool:
-            """A break/continue that would bind to the statement being
-            flattened (not to a nested loop of its own)."""
-            for s in items:
-                if isinstance(s, (c_ast.Break, c_ast.Continue)):
-                    return True
-                if isinstance(s, (c_ast.While, c_ast.For, c_ast.DoWhile,
-                                  c_ast.Switch)):
-                    continue
-                if isinstance(s, c_ast.Compound):
-                    if loose_break(as_items(s)):
-                        return True
-                elif isinstance(s, c_ast.If):
-                    if (loose_break(as_items(s.iftrue))
-                            or loose_break(as_items(s.iffalse))):
-                        return True
-            return False
-
-        def slot_for(stmt) -> Tuple[str, int]:
-            sid = id(stmt)
-            if sid not in slot_by_node:
-                text = stmt.args.exprs[0].value[1:-1]
-                self.print_strings.append(
-                    text.encode("utf-8").decode("unicode_escape"))
-                k = len(self.print_strings) - 1
-                slot_by_node[sid] = (f"__print_sel_{k}", k)
-                slots.append(slot_by_node[sid])
-            return slot_by_node[sid]
-
-        def xform_block(node, in_branch: bool):
-            items = []
-            for s in as_items(node):
-                items.extend(xform(s, in_branch))
-            return c_ast.Compound(items, getattr(node, "coord", None))
-
-        def desugar_switch(sw) -> list:
-            body_items = as_items(sw.stmt)
-            if isinstance(sw.cond, (c_ast.ID, c_ast.Constant)):
-                ctrl, pre = sw.cond, []
-            else:
-                nm = f"__sw_{len(temps)}"
-                temps.append(nm)
-                ctrl = c_ast.ID(nm, sw.cond.coord)
-                pre = [c_ast.Assignment("=", c_ast.ID(nm, sw.cond.coord),
-                                        sw.cond, sw.cond.coord)]
-            groups: list = []          # (conds | None-for-default, stmts)
-            pending: list = []
-            pending_default = False
-            for it in body_items:
-                if isinstance(it, c_ast.Case):
-                    pending.append(it.expr)
-                    stmts = list(it.stmts or [])
-                elif isinstance(it, c_ast.Default):
-                    pending_default = True
-                    stmts = list(it.stmts or [])
-                else:
-                    raise CLiftError(
-                        f"unsupported statement between switch cases at "
-                        f"{getattr(it, 'coord', '?')}")
-                if not stmts:
-                    continue                      # label stacking
-                if pending_default and pending:
-                    raise CLiftError(
-                        f"case labels stacked with default at {it.coord} "
-                        "are not supported; restructure")
-                groups.append((None if pending_default else list(pending),
-                               stmts, it.coord))
-                pending, pending_default = [], False
-            # Validate break/return termination (fallthrough refuses);
-            # the FINAL group may simply fall out of the switch.
-            cleaned = []
-            for gi, (conds, stmts, coord) in enumerate(groups):
-                if isinstance(stmts[-1], c_ast.Break):
-                    stmts = stmts[:-1]
-                elif not ends_in_return(stmts) and gi != len(groups) - 1:
-                    raise CLiftError(
-                        f"switch case at {coord} falls through; add "
-                        "break/return (fallthrough is outside the subset)")
-                cleaned.append((conds, stmts, coord))
-            default_body = None
-            chain_groups = []
-            for conds, stmts, coord in cleaned:
-                body = xform_block(c_ast.Compound(stmts, coord), True)
-                if conds is None:
-                    default_body = body
-                else:
-                    chain_groups.append((conds, body))
-            node = default_body
-            for conds, body in reversed(chain_groups):
-                cond_expr = None
-                for cexpr in conds:
-                    eq = c_ast.BinaryOp("==", ctrl, cexpr, sw.coord)
-                    cond_expr = (eq if cond_expr is None else
-                                 c_ast.BinaryOp("||", cond_expr, eq,
-                                                sw.coord))
-                node = c_ast.If(cond_expr, body, node, sw.coord)
-            out_sw = pre + ([node] if node is not None else [])
-            # MID-CASE breaks (beyond the stripped terminators) exit the
-            # SWITCH, not any enclosing loop: lower them as a forward
-            # goto to a label right after the if-chain, BEFORE any
-            # enclosing loop's deep-break pass could misbind them.
-            swend = None
-
-            def rb(s):
-                nonlocal swend
-                if isinstance(s, c_ast.Break):
-                    if swend is None:
-                        swend = f"__swend{self._tmp}"
-                        self._tmp += 1
-                    return c_ast.Goto(swend, s.coord)
-                if isinstance(s, (c_ast.While, c_ast.For, c_ast.DoWhile,
-                                  c_ast.Switch)):
-                    return s                     # inner construct's own
-                if isinstance(s, c_ast.If):
-                    return c_ast.If(
-                        s.cond,
-                        rb(s.iftrue) if s.iftrue is not None else None,
-                        rb(s.iffalse) if s.iffalse is not None else None,
-                        s.coord)
-                if isinstance(s, c_ast.Compound):
-                    return c_ast.Compound(
-                        [rb(x) for x in (s.block_items or [])], s.coord)
-                return s
-
-            out_sw = [rb(s) for s in out_sw]
-            if swend is not None:
-                out_sw.append(c_ast.Label(
-                    swend, c_ast.EmptyStatement(sw.coord), sw.coord))
-            return out_sw
-
-        def is_break_if(s) -> bool:
-            if not isinstance(s, c_ast.If) or s.iffalse is not None:
-                return False
-            b = (s.iftrue.block_items or []
-                 if isinstance(s.iftrue, c_ast.Compound) else [s.iftrue])
-            return len(b) == 1 and isinstance(b[0], c_ast.Break)
-
-        def lower_deep_breaks(loop) -> list:
-            """Breaks beyond the `if (c) break;` idiom (jpeg's
-            `if (s) { if ((k += n) >= 64) break; ... }`) lower through
-            the goto machinery: break -> goto __brkN with the label
-            right after the loop."""
-            lbl = None
-
-            def replace(s, top):
-                nonlocal lbl
-                if isinstance(s, c_ast.Break):
-                    if top:
-                        return s                 # the direct idiom's own
-                    if lbl is None:
-                        lbl = f"__brk{self._tmp}"
-                        self._tmp += 1
-                    return c_ast.Goto(lbl, s.coord)
-                if isinstance(s, (c_ast.While, c_ast.For, c_ast.DoWhile,
-                                  c_ast.Switch)):
-                    return s                     # inner loop owns breaks
-                if isinstance(s, c_ast.If):
-                    if top and is_break_if(s):
-                        return s
-                    return c_ast.If(
-                        s.cond,
-                        replace(s.iftrue, False)
-                        if s.iftrue is not None else None,
-                        replace(s.iffalse, False)
-                        if s.iffalse is not None else None, s.coord)
-                if isinstance(s, c_ast.Compound):
-                    return c_ast.Compound(
-                        [replace(x, top) for x in as_items(s)], s.coord)
-                return s
-
-            items2 = as_items(loop.stmt)
-            new_items = []
-            for k, s in enumerate(items2):
-                if isinstance(s, c_ast.Break) and k == len(items2) - 1:
-                    new_items.append(s)          # run-once trailing break
-                else:
-                    new_items.append(replace(s, True))
-            body2 = c_ast.Compound(new_items, loop.coord)
-            if isinstance(loop, c_ast.For):
-                new_loop = c_ast.For(loop.init, loop.cond, loop.next,
-                                     body2, loop.coord)
-            else:
-                new_loop = c_ast.While(loop.cond, body2, loop.coord)
-            if lbl is None:
-                return [new_loop]
-            return [new_loop,
-                    c_ast.Label(lbl, c_ast.EmptyStatement(loop.coord),
-                                loop.coord)]
-
-        def xform(stmt, in_branch: bool) -> list:
-            if isinstance(stmt, c_ast.Switch):
-                return desugar_switch(stmt)
-            if isinstance(stmt, c_ast.DoWhile):
-                body = xform_block(stmt.stmt, True)
-                if loose_break(as_items(body)):
-                    raise CLiftError(
-                        f"break/continue in do-while body at {stmt.coord} "
-                        "is outside the subset; restructure")
-                return [body, c_ast.While(stmt.cond, body, stmt.coord)]
-            if isinstance(stmt, c_ast.While):
-                body = xform_block(stmt.stmt, True)
-                if (_const_int(stmt.cond) and ends_in_return(as_items(body))
-                        and not loose_break(as_items(body))):
-                    # while(1) whose body always returns: exactly one
-                    # iteration -- inline it.
-                    return as_items(body)
-                return [c_ast.While(stmt.cond, body, stmt.coord)]
-            if isinstance(stmt, c_ast.For):
-                body = xform_block(stmt.stmt, True)
-                return lower_deep_breaks(
-                    c_ast.For(stmt.init, stmt.cond, stmt.next, body,
-                              stmt.coord))
-            if isinstance(stmt, c_ast.If):
-                t = (xform_block(stmt.iftrue, True)
-                     if stmt.iftrue is not None else None)
-                f = (xform_block(stmt.iffalse, True)
-                     if stmt.iffalse is not None else None)
-                return [c_ast.If(stmt.cond, t, f, stmt.coord)]
-            if isinstance(stmt, c_ast.Compound):
-                return [xform_block(stmt, in_branch)]
-            if in_branch and self._string_only_printf(stmt):
-                nm, k = slot_for(stmt)
-                return [c_ast.Assignment(
-                    "=", c_ast.ID(nm, stmt.coord),
-                    c_ast.Constant("int", str(k), stmt.coord), stmt.coord)]
-            return [stmt]
-
-        body = xform_block(fndef.body, False)
-        fndef.body = self._rewrite_gotos(body, temps)
-
-    def _rewrite_gotos(self, body, temps) -> "c_ast.Compound":
-        """Lower FORWARD gotos into skip flags, per enclosing compound:
-
-          goto L;   ->  __goto_L = 1;  (+ exit any FOR loops between)
-          L: stmt   ->  __goto_L = 0; <stmt guarded like the rest>
-
-        A label lives at the top level of SOME compound (the function
-        body, a loop body, a branch); its gotos may sit anywhere below
-        that compound, including inside nested FOR loops (jpeg's
-        id_found search: the loop gains a flag-conditional break, and
-        the in-loop statements after the jump run under the no-flags
-        guard -- one masked partial iteration, no effects).  Statements
-        of the label's compound between the goto point and the label
-        run under ``if ((flagA | flagB | ...) == 0)`` -- the
-        early-return discipline applied to jumps.  Refused loudly:
-        backward gotos, gotos escaping while/do-while loops, unknown
-        labels."""
-
-        def goto_names(n) -> List[str]:
-            out: List[str] = []
-
-            class V(c_ast.NodeVisitor):
-                def visit_Goto(v, nn):
-                    out.append(nn.name)
-
-            if n is not None:
-                V().visit(n)
-            return out
-
-        if not goto_names(body):
-            return body
-
-        flag: Dict[str, str] = {}
-
-        def flag_for(name: str) -> str:
-            if name not in flag:
-                flag[name] = f"__goto_{name}"
-                temps.append(flag[name])
-            return flag[name]
-
-        def no_flags(names, coord):
-            expr = None
-            for L in names:
-                e = c_ast.ID(flag_for(L), coord)
-                expr = e if expr is None else c_ast.BinaryOp("|", expr, e,
-                                                             coord)
-            return c_ast.BinaryOp("==", expr, c_ast.Constant("int", "0"),
-                                  coord)
-
-        def as_items(node):
-            if node is None:
-                return []
-            if isinstance(node, c_ast.Compound):
-                return list(node.block_items or [])
-            return [node]
-
-        def rewrite(stmt, active):
-            """Replace active gotos under ``stmt``; loops crossed by a
-            jump gain guard+break discipline.  Returns the new stmt."""
-            hit = [g for g in goto_names(stmt) if g in active]
-            if not hit:
-                return stmt
-            if isinstance(stmt, c_ast.Goto):
-                return c_ast.Assignment(
-                    "=", c_ast.ID(flag_for(stmt.name), stmt.coord),
-                    c_ast.Constant("int", "1", stmt.coord), stmt.coord)
-            if isinstance(stmt, c_ast.Compound):
-                return c_ast.Compound(
-                    seq_guard(as_items(stmt), active, stmt.coord),
-                    stmt.coord)
-            if isinstance(stmt, c_ast.If):
-                return c_ast.If(
-                    stmt.cond,
-                    rewrite(stmt.iftrue, active)
-                    if stmt.iftrue is not None else None,
-                    rewrite(stmt.iffalse, active)
-                    if stmt.iffalse is not None else None,
-                    stmt.coord)
-            if isinstance(stmt, c_ast.For):
-                items2 = seq_guard(as_items(stmt.stmt), active, stmt.coord)
-                esc = sorted({g for g in goto_names(stmt.stmt)
-                              if g in active})
-                brk = c_ast.If(
-                    c_ast.BinaryOp("==", no_flags(esc, stmt.coord),
-                                   c_ast.Constant("int", "0", stmt.coord),
-                                   stmt.coord),
-                    c_ast.Break(stmt.coord), None, stmt.coord)
-                return c_ast.For(stmt.init, stmt.cond, stmt.next,
-                                 c_ast.Compound(items2 + [brk],
-                                                stmt.coord), stmt.coord)
-            if isinstance(stmt, (c_ast.While, c_ast.DoWhile)):
-                raise CLiftError(
-                    f"goto escaping a while/do-while at {stmt.coord} is "
-                    "outside the modeled envelope; restructure")
-            if isinstance(stmt, c_ast.Label):
-                return c_ast.Label(stmt.name, rewrite(stmt.stmt, active),
-                                   stmt.coord)
-            raise CLiftError(
-                f"goto in unsupported construct {type(stmt).__name__} at "
-                f"{getattr(stmt, 'coord', '?')}")
-
-        def seq_guard(stmts, active, coord):
-            """Within a compound below the label level: statements after
-            a goto point run under the no-flags guard."""
-            out = []
-            for k, s in enumerate(stmts):
-                hit = [g for g in goto_names(s) if g in active]
-                if not hit:
-                    out.append(s)
-                    continue
-                out.append(rewrite(s, active))
-                rest = seq_guard(stmts[k + 1:], active, coord)
-                if rest:
-                    wrap = c_ast.If(
-                        no_flags(sorted(active), coord),
-                        c_ast.Compound(rest, coord), None, coord)
-                    self._synth_reason[id(wrap)] = "after a goto point"
-                    out.append(wrap)
-                return out
-            return out
-
-        def process(items, coord):
-            """Handle labels at THIS compound level (recursing into
-            nested compounds for deeper labels first)."""
-            # Recurse structurally so deeper compounds resolve their own
-            # label/goto pairs before this level's flags apply.
-            def descend(s):
-                if isinstance(s, c_ast.Compound):
-                    return c_ast.Compound(
-                        process(as_items(s), s.coord), s.coord)
-                if isinstance(s, c_ast.If):
-                    return c_ast.If(
-                        s.cond,
-                        descend(s.iftrue) if s.iftrue is not None
-                        else None,
-                        descend(s.iffalse) if s.iffalse is not None
-                        else None, s.coord)
-                if isinstance(s, (c_ast.For, c_ast.While, c_ast.DoWhile)):
-                    body2 = c_ast.Compound(
-                        process(as_items(s.stmt), s.coord), s.coord)
-                    if isinstance(s, c_ast.For):
-                        return c_ast.For(s.init, s.cond, s.next, body2,
-                                         s.coord)
-                    if isinstance(s, c_ast.While):
-                        return c_ast.While(s.cond, body2, s.coord)
-                    return c_ast.DoWhile(s.cond, body2, s.coord)
-                if isinstance(s, c_ast.Label):
-                    return c_ast.Label(s.name, descend(s.stmt), s.coord)
-                return s
-
-            items = [descend(s) for s in items]
-            labels_here = {it.name: k for k, it in enumerate(items)
-                           if isinstance(it, c_ast.Label)}
-            if not labels_here:
-                return items
-            active = set(labels_here)
-            # Forward check at this level.
-            for k, it in enumerate(items):
-                holder = it.stmt if isinstance(it, c_ast.Label) else it
-                for g in goto_names(holder):
-                    if g in labels_here and labels_here[g] <= k:
-                        raise CLiftError(
-                            f"backward goto {g!r} is outside the "
-                            "modeled envelope (forward jumps only)")
-            out: List[object] = []
-            seen_goto = False
-            for k_i, it in enumerate(items):
-                if (seen_goto and isinstance(it, c_ast.Break)
-                        and k_i == len(items) - 1):
-                    # A trailing break (the run-once while(1) idiom) is
-                    # reached on every path: forward-only jumps mean all
-                    # this level's labels precede it, and each label
-                    # resets its flag -- so by here every guard passes.
-                    # It must also STAY a syntactic Break, or
-                    # _exec_while no longer recognizes the idiom and the
-                    # loop falls to the dynamic-while lowering.
-                    out.append(it)
-                    continue
-                if isinstance(it, c_ast.Label) and it.name in active:
-                    out.append(c_ast.Assignment(
-                        "=", c_ast.ID(flag_for(it.name), it.coord),
-                        c_ast.Constant("int", "0", it.coord), it.coord))
-                    inner = rewrite(it.stmt, active)
-                    wrap = c_ast.If(no_flags(sorted(active), it.coord),
-                                    inner, None, it.coord)
-                    self._synth_reason[id(wrap)] = "after a goto point"
-                    out.append(wrap)
-                    seen_goto = seen_goto or bool(
-                        [g for g in goto_names(it.stmt) if g in active])
-                    continue
-                if seen_goto:
-                    inner = rewrite(it, active)
-                    wrap = c_ast.If(
-                        no_flags(sorted(active),
-                                 getattr(it, "coord", None)),
-                        inner, None, getattr(it, "coord", None))
-                    self._synth_reason[id(wrap)] = "after a goto point"
-                    out.append(wrap)
-                else:
-                    out.append(rewrite(it, active))
-                    seen_goto = seen_goto or bool(
-                        [g for g in goto_names(it) if g in active])
-            return out
-
-        new_items = process(as_items(body), body.coord)
-        stray = goto_names(c_ast.Compound(new_items, body.coord))
-        if stray:
-            raise CLiftError(
-                f"goto to unknown/backward label(s) {sorted(set(stray))}; "
-                "only forward jumps to a label in an enclosing compound "
-                "are modeled")
-        return c_ast.Compound(new_items, body.coord)
 
     def _run_function(self, fndef, args, outer_sc: _Scope,
                       arg_consts: Optional[List[Optional[int]]] = None):
@@ -3253,534 +979,6 @@ class _Compiler:
         return [n for n in dict.fromkeys(assigned)
                 if n in sc.locals or n in sc.g]
 
-    @staticmethod
-    def _has_return(node) -> bool:
-        found = []
-
-        class V(c_ast.NodeVisitor):
-            def visit_Return(v, n):
-                found.append(n)
-
-        V().visit(node)
-        return bool(found)
-
-    def _rewrite_early_returns(self, fndef):
-        """Lower structured early returns to a carried flag pair.
-
-        ``return E`` anywhere becomes ``if (!__ret_set) { __ret_val = E;
-        __ret_set = 1; }``; every statement after a return-containing
-        one runs under ``if (!__ret_set)``; every loop whose subtree
-        returns gains ``&& !__ret_set`` in its condition with the
-        for-next moved into the body under the same guard (the exact
-        discipline of the break lowering, applied function-wide) -- so
-        ``if (hash[i] != golden[i]) return 1;`` inside a scan loop
-        (checkGolden, sha256_common_tmr.c:191-198) exits with C's
-        semantics.  Loop conditions become PURE carried variables primed
-        before the loop and re-evaluated at the end of each body under
-        the guard -- C's return exits WITHOUT re-testing the condition,
-        so a side-effecting condition must not run on the returning
-        exit.  Returns (new_body_items, set_name, val_name, synth_names)
-        where synth_names are locals the caller must pre-create, or
-        (None, None, None, None) when the body has no early return."""
-        items = list(fndef.body.block_items or [])
-        early = any(self._has_return(s) for s in items[:-1]) or (
-            items and not isinstance(items[-1], c_ast.Return)
-            and self._has_return(items[-1]))
-        if not early:
-            return None, None, None, None
-        set_n = f"__ret_set{self._tmp}"
-        val_n = f"__ret_val{self._tmp}"
-        self._tmp += 1
-        synth_names = [set_n, val_n]
-        not_set = lambda coord: c_ast.BinaryOp(  # noqa: E731
-            "==", c_ast.ID(set_n), c_ast.Constant("int", "0"), coord)
-
-        def ret_to_set(n):
-            expr = n.expr if n.expr is not None else c_ast.Constant(
-                "int", "0")
-            body = c_ast.Compound([
-                c_ast.Assignment("=", c_ast.ID(val_n), expr, n.coord),
-                c_ast.Assignment("=", c_ast.ID(set_n),
-                                 c_ast.Constant("int", "1"), n.coord),
-            ], n.coord)
-            return c_ast.If(not_set(n.coord), body, None, n.coord)
-
-        def xform(s):
-            """Transform ONE statement in place-ish; returns new stmt."""
-            if isinstance(s, c_ast.Return):
-                return ret_to_set(s)
-            if not self._has_return(s):
-                return s
-            if isinstance(s, c_ast.Compound):
-                return c_ast.Compound(seq(list(s.block_items or [])),
-                                      s.coord)
-            if isinstance(s, c_ast.If):
-                return c_ast.If(
-                    s.cond,
-                    xform(s.iftrue) if s.iftrue is not None else None,
-                    xform(s.iffalse) if s.iffalse is not None else None,
-                    s.coord)
-            if isinstance(s, (c_ast.For, c_ast.While)):
-                cond = getattr(s, "cond", None)
-                guard = not_set(s.coord)
-                body_items = (list(s.stmt.block_items or [])
-                              if isinstance(s.stmt, c_ast.Compound)
-                              else [s.stmt])
-                body_items = seq(body_items)
-                nxt = getattr(s, "next", None)
-                if nxt is not None:
-                    body_items.append(
-                        c_ast.If(not_set(s.coord), nxt, None, s.coord))
-                # Pure carried condition: primed before the loop,
-                # re-evaluated (effects included) at the body end under
-                # the !set guard so the returning exit never re-runs it.
-                cnd = f"__cnd{self._tmp}"
-                self._tmp += 1
-                synth_names.append(cnd)
-                pre = []
-                init = getattr(s, "init", None)
-                if init is not None:
-                    pre.append(init)
-                if cond is not None:
-                    cond_val = c_ast.BinaryOp(
-                        "!=", cond, c_ast.Constant("int", "0"), s.coord)
-                    prime = c_ast.If(
-                        guard,
-                        c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
-                                         s.coord),
-                        None, s.coord)
-                    body_items.append(c_ast.Assignment(
-                        "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
-                        s.coord))
-                    body_items.append(c_ast.If(
-                        guard,
-                        c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
-                                         s.coord),
-                        None, s.coord))
-                else:
-                    prime = c_ast.Assignment(
-                        "=", c_ast.ID(cnd), guard, s.coord)
-                    body_items.append(c_ast.Assignment(
-                        "=", c_ast.ID(cnd), guard, s.coord))
-                pre.append(c_ast.Assignment(
-                    "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
-                    s.coord))
-                pre.append(prime)
-                new_body = c_ast.Compound(body_items, s.coord)
-                loop = c_ast.For(None, c_ast.ID(cnd), None, new_body,
-                                 s.coord)
-                return c_ast.Compound(pre + [loop], s.coord)
-            raise CLiftError(
-                f"return in unsupported construct "
-                f"{type(s).__name__} at {getattr(s, 'coord', '?')}")
-
-        def seq(stmts):
-            out = []
-            for k, s in enumerate(stmts):
-                if not self._has_return(s):
-                    out.append(s)
-                    continue
-                out.append(xform(s))
-                rest = seq(stmts[k + 1:])
-                if rest:
-                    wrap = c_ast.If(
-                        not_set(getattr(s, "coord", None)),
-                        c_ast.Compound(rest, getattr(s, "coord", None)),
-                        None, getattr(s, "coord", None))
-                    self._synth_reason[id(wrap)] = \
-                        "after an early-return point"
-                    out.append(wrap)
-                return out
-            return out
-
-        return seq(items), set_n, val_n, synth_names
-
-    def _rewrite_breaks(self, stmt, sc: _Scope):
-        """Lower mid-loop conditional breaks (``if (c) break;``) to a
-        carried break flag: the loop condition gains ``&& !brk`` and
-        every statement after the break point runs under ``if (!brk)``,
-        so the exit is exact -- same iteration count, same final state
-        as the C program (sha256_tmr.c's for-100 early exit; the
-        quicksort error-break idiom).  Returns a rewritten For (or the
-        original when the body has no breaks).  Breaks in any other
-        position refuse loudly; breaks inside NESTED loops belong to
-        those loops and are left alone."""
-        items = (list(stmt.stmt.block_items or [])
-                 if isinstance(stmt.stmt, c_ast.Compound) else [stmt.stmt])
-        if not any(self._count_breaks(s) for s in items
-                   if not isinstance(s, (c_ast.While, c_ast.For))):
-            return stmt
-        brk = f"__brk{self._tmp}"
-        self._tmp += 1
-        sc.locals[brk] = jnp.int32(0)
-
-        def is_break_if(s):
-            """``if (c) break;`` / ``if (c) { break; }`` with no else."""
-            if not isinstance(s, c_ast.If) or s.iffalse is not None:
-                return False
-            body = (s.iftrue.block_items or []
-                    if isinstance(s.iftrue, c_ast.Compound) else [s.iftrue])
-            return len(body) == 1 and isinstance(body[0], c_ast.Break)
-
-        def rewrite(seq):
-            out = []
-            for k, s in enumerate(seq):
-                if isinstance(s, (c_ast.While, c_ast.For)):
-                    out.append(s)          # inner loop owns its breaks
-                    continue
-                if is_break_if(s):
-                    set_brk = c_ast.Assignment(
-                        "=", c_ast.ID(brk),
-                        c_ast.Constant("int", "1"), s.coord)
-                    out.append(c_ast.If(s.cond, set_brk, None, s.coord))
-                    rest = rewrite(seq[k + 1:])
-                    if rest:
-                        guard = c_ast.BinaryOp(
-                            "==", c_ast.ID(brk),
-                            c_ast.Constant("int", "0"), s.coord)
-                        wrap = c_ast.If(
-                            guard, c_ast.Compound(rest, s.coord), None,
-                            s.coord)
-                        self._synth_reason[id(wrap)] = \
-                            "after a mid-loop break point"
-                        out.append(wrap)
-                    return out
-                if self._count_breaks(s):
-                    raise CLiftError(
-                        f"break in unsupported position at "
-                        f"{getattr(s, 'coord', '?')}; only the "
-                        "'if (cond) break;' idiom is lowered")
-                out.append(s)
-            return out
-
-        body_stmts = rewrite(items)
-        not_brk = c_ast.BinaryOp("==", c_ast.ID(brk),
-                                 c_ast.Constant("int", "0"), stmt.coord)
-        # C does not run the increment on the broken-out iteration: move
-        # the next-expression into the body under the !brk guard (an If
-        # STATEMENT, so its side effects are genuinely masked -- a
-        # ternary would evaluate both arms under tracing).
-        if stmt.next is not None:
-            body_stmts.append(c_ast.If(not_brk, stmt.next, None,
-                                       stmt.coord))
-        # The loop condition becomes a PURE carried variable: C's break
-        # exits WITHOUT re-testing the condition, so a side-effecting
-        # condition (while (g--)) must not be evaluated on the
-        # broken-out exit.  The variable is primed here (the pre-loop
-        # test, effects apply once) and re-evaluated at the END of the
-        # body under the !brk guard.
-        cnd = f"__cnd{self._tmp}"
-        self._tmp += 1
-        sc.locals[cnd] = jnp.int32(0)
-        if stmt.cond is not None:
-            cond_val = c_ast.BinaryOp("!=", stmt.cond,
-                                      c_ast.Constant("int", "0"),
-                                      stmt.coord)
-            self._exec_stmt(c_ast.Assignment("=", c_ast.ID(cnd),
-                                             cond_val, stmt.coord), sc)
-            body_stmts.append(c_ast.Assignment(
-                "=", c_ast.ID(cnd), c_ast.Constant("int", "0"),
-                stmt.coord))
-            body_stmts.append(c_ast.If(
-                not_brk,
-                c_ast.Assignment("=", c_ast.ID(cnd), cond_val,
-                                 stmt.coord),
-                None, stmt.coord))
-        else:
-            self._exec_stmt(c_ast.Assignment(
-                "=", c_ast.ID(cnd), c_ast.Constant("int", "1"),
-                stmt.coord), sc)
-            body_stmts.append(c_ast.Assignment(
-                "=", c_ast.ID(cnd), not_brk, stmt.coord))
-        new_body = c_ast.Compound(body_stmts, stmt.stmt.coord)
-        return c_ast.For(None, c_ast.ID(cnd), None, new_body, stmt.coord)
-
-    @staticmethod
-    def _contains_printf(node) -> bool:
-        found: List[object] = []
-
-        class V(c_ast.NodeVisitor):
-            def visit_FuncCall(v, n):
-                if isinstance(n.name, c_ast.ID) and n.name.name == "printf":
-                    found.append(n)
-                v.generic_visit(n)
-
-        V().visit(node)
-        return bool(found)
-
-    def _exec_for(self, stmt, sc: _Scope):
-        if stmt.init is not None:
-            self._exec_stmt(stmt.init, sc)
-        # PRINT-ONLY loop (aes.c dumping the ciphertext bytes): a loop
-        # whose body writes nothing (beyond print slots) but prints
-        # per-iteration values.  Its observable IS the printed sequence,
-        # so it unrolls at trace time under a concrete bound -- each
-        # iteration's printf appends one program output.  A traced bound
-        # refuses loudly (the output arity must be static).
-        if (stmt.cond is not None and stmt.stmt is not None
-                and self._contains_printf(stmt.stmt)
-                and all(n.startswith("__print_sel_")
-                        or n in ("__print_buf", "__print_cnt")
-                        for n in self._assigned_names(stmt.stmt))):
-            for _ in range(4096):
-                live = (self._const_eval(stmt.cond, sc)
-                        if not self._has_effects(stmt.cond) else None)
-                if live is None:
-                    raise CLiftError(
-                        f"print-only loop at {stmt.coord} has a traced "
-                        "bound; the number of printed outputs must be "
-                        "static")
-                if not live:
-                    return None
-                ret = self._exec_block(stmt.stmt, sc)
-                if ret is not None:
-                    raise CLiftError(
-                        f"return inside a loop at {stmt.coord}; "
-                        "restructure")
-                if stmt.next is not None:
-                    self.eval(stmt.next, sc)
-            raise CLiftError(
-                f"print-only loop at {stmt.coord} exceeds the 4096-"
-                "iteration unroll bound")
-        stmt = self._rewrite_breaks(stmt, sc)
-        self._preseat(stmt, sc)
-        carry_names = self._loop_carry(stmt, sc)
-
-        def pack():
-            return tuple(sc.read_binding(n) for n in carry_names)
-
-        def unpack(sub_sc, vals):
-            for n, v in zip(carry_names, vals):
-                sub_sc.write_binding(n, v)
-                sub_sc.consts.pop(n, None)   # traced write: value unknown
-
-        trip = self._static_trip(stmt, sc)
-        if trip is not None:
-            def body(carry, _):
-                sub = sc.fork(no_print_at=stmt.coord)
-                # Per-iteration prints become STACKED scan outputs (one
-                # [trip]-shaped observable per printed value, dfmul's
-                # per-vector diagnostic line); the arity is fixed by the
-                # single body trace.  Branch prints inside the body
-                # still go through slots / loud refusals as usual.
-                sub.printed = []
-                unpack(sub, carry)
-                ret = self._exec_block(stmt.stmt, sub)
-                if ret is not None:
-                    raise CLiftError(
-                        f"return inside a loop at {stmt.coord}; restructure")
-                if stmt.next is not None:
-                    self.eval(stmt.next, sub)
-                self._guard_reseat(sc, sub, stmt.coord)
-                return (tuple(sub.read_binding(n) for n in carry_names),
-                        tuple(jnp.asarray(p) for p in sub.printed))
-
-            out, ys = jax.lax.scan(body, pack(), None, length=trip)
-            unpack(sc, out)
-            if ys:
-                if (isinstance(sc.printed, _NoPrintList)
-                        and "__print_buf" in sc.g
-                        and all(jnp.ndim(y) == 1 for y in ys)):
-                    # Stacked prints inside a DYNAMIC outer context flow
-                    # into the UART buffer in true stdout order
-                    # (iteration-major interleave).
-                    flat = jnp.stack(
-                        [y.astype(jnp.uint32) for y in ys],
-                        axis=1).reshape(-1)
-                    buf = sc.g["__print_buf"]
-                    cnt = sc.g["__print_cnt"]
-                    idx = cnt + jnp.arange(flat.size, dtype=jnp.int32)
-                    # mode="drop" discards out-of-range writes outright:
-                    # clipping them onto the last word would scatter
-                    # duplicate indices with conflicting values, and JAX
-                    # leaves duplicate-index order unspecified -- the
-                    # legit final word could lose to a stale overflow row
-                    # exactly when the buffer fills.
-                    buf = buf.at[idx].set(flat, mode="drop")
-                    sc.g["__print_buf"] = buf
-                    sc.g["__print_cnt"] = cnt + flat.size
-                else:
-                    sc.printed.extend(list(ys))
-            return None
-
-        # A side-effecting condition (C's `while (length--)`) cannot be
-        # evaluated in the while cond function -- writes made there are
-        # discarded.  Rotate the loop instead: evaluate the condition once
-        # up front (its effects apply), carry its truth value, and have
-        # each iteration run body+next then re-evaluate the condition with
-        # effects inside the body.  Exact C semantics, including the final
-        # value of the side-effected variable after the failing test.
-        if stmt.cond is not None and self._loop_carry(stmt.cond, sc):
-            # int32 truth carry, not bool: every loop carry can become an
-            # injectable region leaf, and the memory map is 32-bit words.
-            t0 = self._truth(self.eval(stmt.cond, sc)).astype(jnp.int32)
-
-            def cond_rot(carry):
-                return jnp.not_equal(carry[-1], 0)
-
-            def body_rot(carry):
-                sub = sc.fork(no_print_at=stmt.coord)
-                unpack(sub, carry[:-1])
-                ret = self._exec_block(stmt.stmt, sub)
-                if ret is not None:
-                    raise CLiftError(
-                        f"return inside a loop at {stmt.coord}; "
-                        "restructure")
-                if stmt.next is not None:
-                    self.eval(stmt.next, sub)
-                t = self._truth(self.eval(stmt.cond, sub)
-                                ).astype(jnp.int32)
-                self._guard_reseat(sc, sub, stmt.coord)
-                return tuple(sub.read_binding(n) for n in carry_names) + (t,)
-
-            out = jax.lax.while_loop(cond_rot, body_rot, pack() + (t0,))
-            unpack(sc, out[:-1])
-            return None
-
-        # General for: lower as while with explicit cond/next.
-        def cond_f(carry):
-            sub = sc.fork(no_print_at=stmt.coord)
-            unpack(sub, carry)
-            c = (self.eval(stmt.cond, sub) if stmt.cond is not None
-                 else jnp.int32(1))
-            return self._truth(c)
-
-        def body_f(carry):
-            sub = sc.fork(no_print_at=stmt.coord)
-            unpack(sub, carry)
-            ret = self._exec_block(stmt.stmt, sub)
-            if ret is not None:
-                raise CLiftError(
-                    f"return inside a loop at {stmt.coord}; restructure")
-            if stmt.next is not None:
-                self.eval(stmt.next, sub)
-            self._guard_reseat(sc, sub, stmt.coord)
-            return tuple(sub.read_binding(n) for n in carry_names)
-
-        out = jax.lax.while_loop(cond_f, body_f, pack())
-        unpack(sc, out)
-        return None
-
-    def _count_breaks(self, node) -> int:
-        count = 0
-
-        class V(c_ast.NodeVisitor):
-            def visit_Break(v, n):
-                nonlocal count
-                count += 1
-
-            def visit_While(v, n):      # breaks inside nested loops bind
-                pass                    # to THOSE loops; don't descend
-
-            def visit_For(v, n):
-                pass
-
-        V().visit(node)
-        return count
-
-    def _exec_while(self, stmt, sc: _Scope):
-        # The run-once idiom ``while (1) { ...; break; }`` (sha256.c's
-        # main): a body whose LAST top-level statement is the loop's only
-        # break executes exactly once under the condition -- and with a
-        # static-true condition it inlines into the enclosing scope, so
-        # printf stays a program output.
-        items = (stmt.stmt.block_items or []
-                 if isinstance(stmt.stmt, c_ast.Compound) else [stmt.stmt])
-        if items and isinstance(items[-1], c_ast.Break):
-            body = c_ast.Compound(list(items[:-1]), stmt.stmt.coord)
-            if self._count_breaks(body):
-                raise CLiftError(
-                    f"break before the tail of the loop at {stmt.coord}; "
-                    "restructure")
-            if _const_int(stmt.cond):
-                return self._exec_block(body, sc)
-            return self._exec_stmt(
-                c_ast.If(stmt.cond, body, None, stmt.coord), sc)
-        fake = c_ast.For(None, stmt.cond, None, stmt.stmt, stmt.coord)
-        return self._exec_for(fake, sc)
-
-    def _static_trip(self, stmt, sc) -> Optional[int]:
-        """Trip count for the canonical `for (i = A; i < B; i++)` shape
-        with literal A/B and the loop variable not written in the body."""
-        init, cond, nxt = stmt.init, stmt.cond, stmt.next
-        if init is None or cond is None or nxt is None:
-            return None
-        # init: i = A (assignment or single decl)
-        if isinstance(init, c_ast.DeclList) and len(init.decls) == 1:
-            var, a = init.decls[0].name, _const_int(init.decls[0].init)
-        elif isinstance(init, c_ast.Assignment) and init.op == "=" \
-                and isinstance(init.lvalue, c_ast.ID):
-            var, a = init.lvalue.name, _const_int(init.rvalue)
-        else:
-            return None
-        if a is None:
-            return None
-        if not (isinstance(cond, c_ast.BinaryOp) and cond.op in ("<", "<=")
-                and isinstance(cond.left, c_ast.ID)
-                and cond.left.name == var):
-            return None
-        b = _const_int(cond.right)
-        if b is None:
-            return None
-        inc_ok = (isinstance(nxt, c_ast.UnaryOp)
-                  and nxt.op in ("++", "p++")
-                  and isinstance(nxt.expr, c_ast.ID)
-                  and nxt.expr.name == var)
-        if not inc_ok:
-            return None
-        # The loop variable must not be written inside the body (the scan
-        # carries it via the next-expression only).
-        if var in self._assigned_names(stmt.stmt):
-            return None
-        trip = (b - a) + (1 if cond.op == "<=" else 0)
-        return max(0, trip)
-
-    def _exec_if(self, stmt, sc: _Scope):
-        self._preseat(stmt, sc)
-        if not self._has_effects(stmt.cond):
-            kc = self._const_eval(stmt.cond, sc)
-            if kc is not None:
-                # Statically-decided predicate: execute only the taken
-                # branch INLINE (exact C semantics; keeps trace-time
-                # constants known -- aes_enc.c's switch on a literal
-                # `type` must yield a known nb for the ciphertext print
-                # loop -- and keeps prints in statically-taken branches
-                # legal program outputs).
-                node = stmt.iftrue if kc else stmt.iffalse
-                return (self._exec_block(node, sc)
-                        if node is not None else None)
-        cval = self.eval(stmt.cond, sc)      # cond effects apply once
-        carry_names = self._loop_carry(stmt, sc)
-        c = self._truth(cval)
-
-        def branch(node):
-            def run(vals):
-                sub = sc.fork(
-                    no_print_at=stmt.coord,
-                    no_print_reason=self._synth_reason.get(id(stmt)))
-                for n, v in zip(carry_names, vals):
-                    sub.write_binding(n, v)
-                if node is not None:
-                    ret = self._exec_block(node, sub)
-                    if ret is not None:
-                        raise CLiftError(
-                            f"return inside if at {stmt.coord}; restructure")
-                self._guard_reseat(sc, sub, stmt.coord)
-                return tuple(sub.read_binding(n) for n in carry_names)
-            return run
-
-        vals = tuple(sc.read_binding(n) for n in carry_names)
-        out = jax.lax.cond(c, branch(stmt.iftrue), branch(stmt.iffalse),
-                           vals)
-        for n, v in zip(carry_names, out):
-            sc.write_binding(n, v)
-            sc.consts.pop(n, None)           # traced write: value unknown
-        return None
-
-
-# ---------------------------------------------------------------------------
-# Translation-unit ingestion
-# ---------------------------------------------------------------------------
 
 def _string_bytes(lit: str) -> List[int]:
     """Decode a C string literal (quotes included) to its bytes + NUL."""
@@ -3942,7 +1140,6 @@ def _parse_globals(tu, typedefs):
     return out, ctypes, g_ptrs
 
 
-_PRINT_BUF_WORDS = 256
 
 
 def _static_for_shape(n) -> bool:

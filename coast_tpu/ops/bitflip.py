@@ -105,3 +105,18 @@ def make_flipper(leaf_order: List[str]):
     flip.build_masks = build_masks
     flip.apply_masks = apply_masks
     return flip
+
+
+def noop_fault():
+    """A well-formed fault that never fires: ``t = -1`` matches no
+    step index, so the armed select+XOR is a per-step no-op.
+
+    Use as a TRACED jit input when timing single runs: a zero-argument
+    jitted run has only compile-time-constant inputs and XLA may fold
+    the whole computation, timing buffer returns instead of compute (a
+    recorded mfu_sweep row measured 85% of bf16 peak this way).
+    Campaigns always run fault-armed, so the armed-but-inert path is
+    also the representative per-run cost."""
+    return {"leaf_id": jnp.int32(0), "lane": jnp.int32(0),
+            "word": jnp.int32(0), "bit": jnp.int32(0),
+            "t": jnp.int32(-1)}

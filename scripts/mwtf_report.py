@@ -27,7 +27,12 @@ BENCH_ALIASES = {"mm": "matrixMultiply", "mm256": "matrixMultiply256"}
 
 def _runtime_s(prog, reps=20) -> float:
     import jax
-    run = jax.jit(lambda: prog.run(None))
+    # Armed-but-inert fault as a traced input: a zero-arg jitted run can
+    # be constant-folded whole by XLA (ops.bitflip.noop_fault).
+    from coast_tpu.ops.bitflip import noop_fault
+    noop = noop_fault()
+    jit_run = jax.jit(lambda f: prog.run(f))
+    run = lambda: jit_run(noop)  # noqa: E731
     jax.block_until_ready(run())
     t0 = time.perf_counter()
     for _ in range(reps):
