@@ -32,15 +32,6 @@ if os.environ.get("COAST_STUDY_BACKEND") == "cpu":
 PEAK_GFLOPS = 197_000.0          # v5e bf16 single-chip peak
 
 
-def timed(fn, reps):
-    jax.block_until_ready(fn())
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
-
-
 def main():
     from coast_tpu import TMR, unprotected
     from coast_tpu.inject.campaign import CampaignRunner
@@ -83,14 +74,35 @@ def main():
                           if k != "store_slice"}
         flops1 = region.meta["flops_per_run"]
         flops3 = 3 * flops1
-        row = {"block": block, "steps": region.nominal_steps}
+        row = {"block": block, "steps": region.nominal_steps,
+               "timing": "median of interleaved per-variant samples"}
+        # Single runs at this state size are remote-tunnel-latency-bound
+        # (~3-7 ms); one long block per variant confounds the comparison
+        # with latency drift (a capture once showed TMR "faster" than
+        # unprotected -- impossible for triplicated work).  Interleave
+        # the variants round-robin and take per-variant MEDIANS, the
+        # bench.py overhead methodology.
+        variants = []
         for name, make, reg, fl in (
                 ("unprotected", unprotected, region, flops1),
                 ("TMR", TMR, region, flops3),
                 ("TMR_wholeleaf_vote", TMR, region_wl, flops3)):
             prog = make(reg)
             jit_run = jax.jit(lambda f, p=prog: p.run(f))
-            sec = timed(lambda: jit_run(noop), reps)
+            jax.block_until_ready(jit_run(noop))          # compile
+            variants.append((name, jit_run, fl))
+        samples = {name: [] for name, _, _ in variants}
+        inner = 4          # back-to-back dispatches per sample: amortizes
+        for _ in range(reps):              # the tunnel round-trip latency
+            for name, jit_run, _ in variants:
+                t0 = time.perf_counter()
+                for _ in range(inner):
+                    r = jit_run(noop)
+                jax.block_until_ready(r)
+                samples[name].append((time.perf_counter() - t0) / inner)
+        for name, _, fl in variants:
+            s = sorted(samples[name])
+            sec = s[len(s) // 2]
             row[name] = {
                 "seconds_per_run": round(sec, 6),
                 "gflops_per_sec": round(fl / sec / 1e9, 2),

@@ -70,7 +70,7 @@ def test_fuzz_cli_reports_success(capsys):
     assert "Success!" in capsys.readouterr().out
 
 
-@pytest.mark.slow
+@pytest.mark.csrc
 def test_csrc_matrix():
     """The ingested-C tier (unittest/cfg/csrc.yml): the reference's OWN
     sources -- mm, crc16, sha256, aes (two '+'-joined translation
