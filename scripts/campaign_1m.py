@@ -2,18 +2,21 @@
 
 The demonstration VERDICT round 1 #6 asks for: schedule -> batched run ->
 bulk logs -> analysis, at the scale the >=1000x throughput story is about,
-with wall-clock recorded per stage so the host-side log path is provably
-not dominant.  The reference's loop at seconds-per-injection would need
-~12 days for this campaign (supervisor.py); here it is minutes on one
-chip.
+with wall-clock recorded per stage so the host/device split is explicit.
+(At the measured batch-65536 device rate the host-side ndjson write IS
+the dominant stage -- 6.9 s vs 3.6 s of run time in the committed
+artifact; host_log_fraction records it.)  The reference's loop at
+seconds-per-injection would need ~12 days for this campaign
+(supervisor.py); here it is seconds on one chip.
 
 Writes the per-run log (ndjson, the InjectionLog schema of
 supportClasses.py:278-389) to --logdir and a machine-readable summary
 artifact (stage timings, classification counts, analysis cross-check) to
 --out; the committed artifact lives at artifacts/campaign_mm_1m.json.
 
-Usage:  python scripts/campaign_1m.py [-n 1000000] [--batch 2048]
+Usage:  python scripts/campaign_1m.py [-n 1000000] [--batch N]
         [--out artifacts/campaign_mm_1m.json] [--logdir /tmp]
+        (--batch defaults per backend: 65536 on TPU, 2048 on CPU)
 """
 
 from __future__ import annotations
@@ -30,7 +33,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", type=int, default=1_000_000)
-    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="vmap batch per dispatch; default 65536 on TPU "
+                    "(measured knee of artifacts/bench_full.json's "
+                    "batch sweep), 2048 on CPU")
     ap.add_argument("--seed", type=int, default=2026)
     ap.add_argument("--out", default="artifacts/campaign_mm_1m.json")
     ap.add_argument("--logdir", default="/tmp")
@@ -41,6 +47,11 @@ def main(argv=None) -> int:
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.batch is None:
+        # Measured: throughput scales with batch to ~739k inj/s at
+        # 131072 (bench_full.json); 65536 keeps the tail chunk's padding
+        # waste under 7% at n=1e6 while sitting at ~86% of that peak.
+        args.batch = 65536 if jax.default_backend() != "cpu" else 2048
 
     from coast_tpu import TMR
     from coast_tpu.analysis import json_parser
