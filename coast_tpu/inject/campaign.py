@@ -93,8 +93,8 @@ class CampaignRunner:
         identical at any value (overshoot sub-steps are masked no-ops);
         it trades per-iteration loop overhead against masked work.
         MEASURED on-chip (artifacts/unroll_sweep.json, 2026-08-01): with
-        one-hot indexing the knob is noise (27.2-27.7k inj/s across
-        {1,2,4,8}) and under the slice lowering it HURTS (5.8k -> 2.2k),
+        one-hot indexing the knob is noise (48.4-57.7k inj/s across
+        {1,2,4,8}) and under the slice lowering it HURTS (5.8k -> 3.7k),
         so the default stays 1; the win the hypothesis predicted belonged
         to the indexing mode, not the unroll."""
         self.prog = prog

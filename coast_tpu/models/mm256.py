@@ -38,6 +38,7 @@ from coast_tpu.ir.graph import BlockGraph
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
                                  LeafSpec, Region)
 from coast_tpu.models.common import lcg_words
+from coast_tpu.ops.indexing import row_select, row_update
 
 SIDE = 256
 BLOCK = 32
@@ -98,9 +99,18 @@ def make_region(side: int = SIDE, block: int = BLOCK,
 
     def step(state, t):
         i, phase = state["i"], state["phase"]
-        row0 = jnp.clip(i, 0, n_blocks - 1) * block
-        block_a = jax.lax.dynamic_slice(state["first"], (row0, 0),
-                                        (block, side))
+        # Block-row access goes through ops/indexing.py over a
+        # (n_blocks, block, side) view: a corrupted ``i`` clamps into
+        # range (same fidelity envelope as the toy mm), and under the
+        # campaign's vmap the access lowers densely on TPU instead of
+        # the batched gather/scatter a dynamic-slice would become --
+        # the same lesson artifacts/unroll_sweep.json measured for the
+        # toy campaign, applied to the flagship's block walk.  The
+        # leaves keep their (side, side) shapes, so the word-addressed
+        # injection map is unchanged.
+        blk_i = jnp.clip(i, 0, n_blocks - 1)
+        block_a = row_select(
+            state["first"].reshape(n_blocks, block, side), blk_i)
         if bf16_matmul:
             computed = jnp.dot(block_a.astype(jnp.bfloat16),
                                state["second"].astype(jnp.bfloat16),
@@ -109,8 +119,9 @@ def make_region(side: int = SIDE, block: int = BLOCK,
             computed = block_a @ state["second"]    # MXU, f32
         compute_phase = phase == 0
         acc = jnp.where(compute_phase, computed, state["acc"])
-        stored = jax.lax.dynamic_update_slice(state["results"], state["acc"],
-                                              (row0, 0))
+        stored = row_update(
+            state["results"].reshape(n_blocks, block, side),
+            state["acc"], blk_i).reshape(side, side)
         results = jnp.where(compute_phase, state["results"], stored)
         return {
             **state,

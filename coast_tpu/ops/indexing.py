@@ -17,18 +17,20 @@ selectable lowering:
   update is a broadcast-where over a one-hot row mask.  Both are plain
   elementwise/reduction ops, so the vmapped campaign stays on the VPU.
 * ``"auto"``   -- ``"onehot"`` when the default backend is a TPU AND the
-  indexed axis is small (<= ``ONEHOT_MAX_ROWS``), else ``"slice"``.
+  indexed axis is small (<= ``ONEHOT_MAX_ROWS``) AND the per-row
+  payload is small (<= ``ONEHOT_MAX_ROW_BYTES``), else ``"slice"``.
   MEASURED on-chip (v5 lite, 2026-08-01, 50k injections/cell,
   ``artifacts/unroll_sweep.json``): one-hot carries the mm-TMR campaign
-  at 27.2-27.7k inj/s across unroll {1,2,4,8} vs 5.8k for the slice
-  lowering at unroll=1 (degrading to 2.2k at unroll=8) -- a 4.7x win at
-  the defaults, 10x at the bench batch (``artifacts/mfu_sweep.json``
-  "unroll" grid: ~54k vs ~5.5k).  The dense form reads every row per
-  access (O(n * row) vs the slice's O(row)), so the win is confined to
-  small indexed axes where gather/scatter dispatch dominates; long
-  arrays (e.g. lifted scans over big inputs) keep the slice lowering.
-  Gathers are cheap on CPU and the host fallback's throughput record
-  lives there, so CPU always resolves to ``"slice"``.
+  at 48.4-57.7k inj/s across unroll {1,2,4,8} vs 5.8k for the slice
+  lowering at unroll=1 (degrading to 3.7k at unroll=8) -- a ~10x win.
+  The dense form reads every row per access (O(n * row) vs the slice's
+  O(row)), so the win is confined to small indexed axes where
+  gather/scatter dispatch dominates; long arrays (e.g. lifted scans
+  over big inputs) and MB-scale rows (the flagships' block panels,
+  pending ``scripts/flagship_indexing_ab.py``'s on-chip record) keep
+  the slice lowering.  Gathers are cheap on CPU and the host
+  fallback's throughput record lives there, so CPU always resolves to
+  ``"slice"``.
 
 Both lowerings treat an out-of-range index exactly like dynamic-slice
 does -- one python-style negative wrap, then clamp into range (a
@@ -44,14 +46,34 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
-# Auto-mode bound: above this row count the dense lowering's whole-array
+def _tag(x: jax.Array, role: str) -> jax.Array:
+    """Identity at runtime; a ``name[name=coast:<role>]`` marker in the
+    jaxpr.  The provenance pass (passes/verification.py) classifies
+    address-forming ctrl leaves by scanning for gather/dynamic-slice
+    primitives -- which the dense lowering deliberately has none of --
+    so BOTH lowerings tag the index here and the pass reads the tag:
+    a region's sync structure (load-addr pre-votes, store-addr votes,
+    syncGEP's GEP-operand classification) is therefore identical
+    whichever lowering resolves, not an artifact of the mode."""
+    return checkpoint_name(x, f"coast:{role}")
+
+
+# Auto-mode bounds: above this row count the dense lowering's whole-array
 # read per access is assumed to cost more than the gather it replaces.
 ONEHOT_MAX_ROWS = 64
+# Row-size bound: the measured one-hot win (unroll_sweep.json) is for the
+# toy benchmarks' KiB-scale leaves (36-byte rows); whether it survives at
+# the flagships' MB-scale block panels (a 2 MB "row" for mm1024b512's
+# block walk) is exactly what scripts/flagship_indexing_ab.py measures
+# on-chip.  Until that artifact exists, auto stays on the measured side
+# of the line: dense only for small rows.
+ONEHOT_MAX_ROW_BYTES = 4096
 
 
-def _resolve(mode: str, n_rows: int) -> str:
+def _resolve(mode: str, n_rows: int, row_bytes: int) -> str:
     if mode == "auto":
         # Resolved at TRACE time; COAST_INDEXING_MODE forces a lowering
         # for A/B measurement (scripts/mfu_sweep.py) without touching
@@ -60,10 +82,19 @@ def _resolve(mode: str, n_rows: int) -> str:
         if forced in ("onehot", "slice"):
             return forced
         return ("onehot" if (jax.default_backend() == "tpu"
-                             and n_rows <= ONEHOT_MAX_ROWS) else "slice")
+                             and n_rows <= ONEHOT_MAX_ROWS
+                             and row_bytes <= ONEHOT_MAX_ROW_BYTES)
+                else "slice")
     if mode not in ("onehot", "slice"):
         raise ValueError(f"unknown indexing mode '{mode}'")
     return mode
+
+
+def _row_bytes(mat: jax.Array) -> int:
+    n = mat.dtype.itemsize
+    for d in mat.shape[1:]:
+        n *= d
+    return n
 
 
 def _clamped_onehot(i: jax.Array, n: int, dtype) -> jax.Array:
@@ -77,7 +108,8 @@ def _clamped_onehot(i: jax.Array, n: int, dtype) -> jax.Array:
 
 def row_select(mat: jax.Array, i: jax.Array, mode: str = "auto") -> jax.Array:
     """``mat[clamp(i)]`` along axis 0, any rank >= 1."""
-    if _resolve(mode, mat.shape[0]) == "slice":
+    i = _tag(i, "load_addr")
+    if _resolve(mode, mat.shape[0], _row_bytes(mat)) == "slice":
         return jax.lax.dynamic_index_in_dim(mat, i, axis=0, keepdims=False)
     if mat.dtype == jnp.bool_:
         # No integer-multiply trick for bools; reduce through int32.
@@ -103,7 +135,9 @@ def row_select(mat: jax.Array, i: jax.Array, mode: str = "auto") -> jax.Array:
 def row_update(mat: jax.Array, row: jax.Array, i: jax.Array,
                mode: str = "auto") -> jax.Array:
     """``mat.at[clamp(i)].set(row)`` along axis 0, any rank >= 1."""
-    if _resolve(mode, mat.shape[0]) == "slice":
+    i = _tag(i, "store_addr")
+    mat = _tag(mat, "stored_into")
+    if _resolve(mode, mat.shape[0], _row_bytes(mat)) == "slice":
         return jax.lax.dynamic_update_index_in_dim(mat, row, i, axis=0)
     hot = _clamped_onehot(i, mat.shape[0], jnp.bool_)
     hot = hot.reshape((mat.shape[0],) + (1,) * (mat.ndim - 1))

@@ -132,6 +132,20 @@ def _trace_provenance(jaxpr, names):
                 if len(ins) > 1:
                     store_addr.update(ins[1])
                 stored_into.update(ins[0])
+            elif prim == "name":
+                # ops/indexing.py tags its index (and store target) with
+                # checkpoint_name so address roles survive the dense
+                # lowering, which deliberately contains no gather/slice
+                # primitive for this walk to find.  Both lowerings carry
+                # the tag, so a region's sync structure is identical
+                # whichever one the backend resolves.
+                tag = str(eqn.params.get("name", ""))
+                if tag == "coast:load_addr":
+                    load_addr.update(ins[0])
+                elif tag == "coast:store_addr":
+                    store_addr.update(ins[0])
+                elif tag == "coast:stored_into":
+                    stored_into.update(ins[0])
             elif prim == "select_n":
                 branch_pred.update(ins[0])
 

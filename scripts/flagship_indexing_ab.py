@@ -1,0 +1,133 @@
+"""On-chip A/B: the flagship block walk's indexing lowering.
+
+mm256.py's step now routes its block-row extract/commit through
+``ops/indexing.py`` over a (n_blocks, block, side) view, so the campaign
+no longer pays batched gather/scatter for the batch-varying block index
+-- IF the dense lowering actually wins at flagship block sizes, where
+each "row" is a whole (block, side) panel (2 MB for the b512 flagship)
+rather than the toy benchmark's 36-byte row the recorded sweep measured
+(``artifacts/unroll_sweep.json``).  This script settles that with data,
+the same way unroll_sweep.py settled the toy defaults:
+
+  * per flagship (mm256, mm1024, mm1024b512), campaign throughput and
+    single-run seconds under COAST_INDEXING_MODE=slice vs =onehot;
+  * classification codes asserted BIT-IDENTICAL between the modes
+    (the parity the CPU tier pins at small shapes,
+    test_flagship_block_indexing_modes_bit_identical);
+  * artifact: artifacts/flagship_indexing_ab.json (backend-stamped;
+    a CPU run writes the _cpu_smoke variant instead).
+
+Usage: python scripts/flagship_indexing_ab.py [--out PATH] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (registry name, campaign batch, injections) -- batches from the HBM
+# probe in flagship_campaign.json (b512 OOMs at 256) and bench.py's caps.
+CELLS = (
+    ("matrixMultiply256", 256, 1024),
+    ("matrixMultiply1024", 64, 256),
+    ("matrixMultiply1024b512", 128, 512),
+)
+
+
+def measure(mode: str, flag_name: str, batch: int, n: int, smoke: bool):
+    """Build + run one (mode, flagship) cell; env is read at trace time."""
+    os.environ["COAST_INDEXING_MODE"] = mode
+    import jax
+    import numpy as np
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY
+    from coast_tpu.ops.bitflip import noop_fault
+
+    region = REGISTRY[flag_name]()
+    prog = TMR(region, pallas_voters=(jax.default_backend() == "tpu"))
+    # single-run seconds (noop fault traced in so nothing folds away)
+    fault = noop_fault()
+    jit_run = jax.jit(prog.run)
+    jax.block_until_ready(jit_run(fault))
+    reps = 3 if smoke else 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jit_run(fault)
+    jax.block_until_ready(out)
+    sec_per_run = (time.perf_counter() - t0) / reps
+
+    runner = CampaignRunner(prog, strategy_name="TMR")
+    runner.run(batch, seed=1, batch_size=batch)          # compile + warm
+    res = runner.run(n, seed=42, batch_size=batch)
+    return {
+        "mode": mode,
+        "seconds_per_run": round(sec_per_run, 6),
+        "injections": res.n,
+        "seconds": round(res.seconds, 4),
+        "injections_per_sec": round(res.injections_per_sec, 2),
+        "counts": res.counts,
+    }, np.asarray(res.codes)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/flagship_indexing_ab.json")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny injection counts (CI / dev boxes)")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    smoke = args.smoke or jax.default_backend() == "cpu"
+    artifact = {"metric": "flagship_indexing_ab",
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0]),
+                "cells": []}
+    # Smoke tier: the GFLOP-scale 1024 flagships would run minutes per
+    # cell on a host core; mm256 alone exercises the whole code path.
+    cells = (CELLS[:1] if smoke else CELLS)
+    for flag_name, batch, n in cells:
+        if smoke:
+            batch, n = 16, 32
+        row = {"benchmark": flag_name, "batch_size": batch}
+        codes = {}
+        for mode in ("slice", "onehot"):
+            rec, codes[mode] = measure(mode, flag_name, batch, n, smoke)
+            row[mode] = rec
+            print(f"# {flag_name} {mode}: {rec['injections_per_sec']} inj/s, "
+                  f"{rec['seconds_per_run']*1e3:.2f} ms/run",
+                  file=sys.stderr, flush=True)
+        identical = bool(np.array_equal(codes["slice"], codes["onehot"]))
+        row["codes_bit_identical"] = identical
+        assert identical, f"{flag_name}: classification diverged between modes"
+        row["onehot_speedup_x"] = round(
+            row["onehot"]["injections_per_sec"]
+            / max(row["slice"]["injections_per_sec"], 1e-9), 3)
+        artifact["cells"].append(row)
+
+    out = args.out
+    if (jax.default_backend() == "cpu"
+            and out == "artifacts/flagship_indexing_ab.json"):
+        out = "artifacts/flagship_indexing_ab_cpu_smoke.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+    print(json.dumps({"cells": [
+        {"benchmark": c["benchmark"],
+         "onehot_speedup_x": c["onehot_speedup_x"]}
+        for c in artifact["cells"]], "out": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,48 +150,3 @@ def test_flip_dwc_detects(named_region):
     # The frozen mid-run state may fail the self-check; like the reference's
     # aborted guest (no UART line), classification ranks the abort first
     # (inject.classify), so the E field of an aborted run is not asserted.
-
-
-def test_indexing_modes_bit_identical():
-    """The dense (one-hot) and dynamic-slice lowerings of traced row
-    select/update must agree bit-for-bit, INCLUDING out-of-range indices
-    (both clamp, the corrupted-loop-counter envelope of SURVEY §7) --
-    campaigns classify identically whichever lowering the backend picks
-    (ops/indexing.py)."""
-    import numpy as np
-
-    from coast_tpu.ops.indexing import row_select, row_update
-
-    rng = np.random.RandomState(7)
-    cases = [((9,), ()), ((9, 7), (7,)), ((5, 3, 4), (3, 4))]
-    for shape, rowshape in cases:
-        mat = jnp.asarray(rng.randint(0, 2**31, size=shape), jnp.uint32)
-        row = jnp.asarray(rng.randint(0, 2**31, size=rowshape), jnp.uint32)
-        for i in (-3, 0, shape[0] - 1, shape[0] + 11):
-            ii = jnp.int32(i)
-            assert np.array_equal(row_select(mat, ii, "slice"),
-                                  row_select(mat, ii, "onehot")), (shape, i)
-            assert np.array_equal(row_update(mat, row, ii, "slice"),
-                                  row_update(mat, row, ii, "onehot")), (shape, i)
-    bm = jnp.asarray(rng.randint(0, 2, size=(6, 4)), bool)
-    for i in (0, 3, 9):
-        assert np.array_equal(row_select(bm, jnp.int32(i), "slice"),
-                              row_select(bm, jnp.int32(i), "onehot"))
-    # Floats must be BIT-identical even with inf/nan/-0.0 in other rows
-    # (a flipped exponent bit makes exactly these; 0*inf=nan in a naive
-    # one-hot sum would poison the select) -- compare bit patterns, since
-    # nan != nan under value comparison.
-    for dt in (jnp.float32, jnp.bfloat16):
-        fm = jnp.asarray([[1.0, 2.0], [np.nan, np.inf], [3.0, -0.0]], dt)
-        for i in (-1, 0, 1, 2, 5):
-            a = row_select(fm, jnp.int32(i), "slice")
-            b = row_select(fm, jnp.int32(i), "onehot")
-            assert np.array_equal(
-                np.asarray(a).view(np.uint8),
-                np.asarray(b).view(np.uint8)), (str(dt), i)
-            r = jnp.asarray([np.inf, -0.0], dt)
-            c = row_update(fm, r, jnp.int32(i), "slice")
-            d = row_update(fm, r, jnp.int32(i), "onehot")
-            assert np.array_equal(
-                np.asarray(c).view(np.uint8),
-                np.asarray(d).view(np.uint8)), (str(dt), i)

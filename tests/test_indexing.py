@@ -1,0 +1,123 @@
+"""Traced row/block indexing: lowering parity and provenance stability.
+
+``ops/indexing.py`` gives the guest models' data-dependent row walks a
+selectable lowering (dynamic-slice vs the dense one-hot form the TPU
+campaign wants; see the module docstring for the measured defaults).
+This file pins the two invariants that make the mode a pure performance
+knob:
+
+  * **bit-identical values** -- select/update agree bit-for-bit across
+    modes for every dtype, including out-of-range (clamped) indices and
+    inf/nan/-0.0 payloads a bit flip produces;
+  * **identical protected-program structure** -- the provenance pass
+    reads the address-role TAGS both lowerings carry
+    (``name[name=coast:*]`` markers, ops/indexing.py ``_tag``) rather
+    than pattern-matching gather/dynamic-slice primitives the dense
+    form deliberately avoids, so sync placement (load-addr pre-votes,
+    store-addr votes -- the syncGEP operand classification,
+    synchronization.cpp:413-474) is the same whichever mode resolves,
+    and campaign classifications match run-for-run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.ops.indexing import row_select, row_update
+
+
+def test_indexing_modes_bit_identical():
+    """The dense (one-hot) and dynamic-slice lowerings of traced row
+    select/update must agree bit-for-bit, INCLUDING out-of-range indices
+    (both clamp, the corrupted-loop-counter envelope of SURVEY §7) --
+    campaigns classify identically whichever lowering the backend picks
+    (ops/indexing.py)."""
+    rng = np.random.RandomState(7)
+    cases = [((9,), ()), ((9, 7), (7,)), ((5, 3, 4), (3, 4))]
+    for shape, rowshape in cases:
+        mat = jnp.asarray(rng.randint(0, 2**31, size=shape), jnp.uint32)
+        row = jnp.asarray(rng.randint(0, 2**31, size=rowshape), jnp.uint32)
+        for i in (-3, 0, shape[0] - 1, shape[0] + 11):
+            ii = jnp.int32(i)
+            assert np.array_equal(row_select(mat, ii, "slice"),
+                                  row_select(mat, ii, "onehot")), (shape, i)
+            assert np.array_equal(row_update(mat, row, ii, "slice"),
+                                  row_update(mat, row, ii, "onehot")), (shape, i)
+    bm = jnp.asarray(rng.randint(0, 2, size=(6, 4)), bool)
+    for i in (0, 3, 9):
+        assert np.array_equal(row_select(bm, jnp.int32(i), "slice"),
+                              row_select(bm, jnp.int32(i), "onehot"))
+    # Floats must be BIT-identical even with inf/nan/-0.0 in other rows
+    # (a flipped exponent bit makes exactly these; 0*inf=nan in a naive
+    # one-hot sum would poison the select) -- compare bit patterns, since
+    # nan != nan under value comparison.
+    for dt in (jnp.float32, jnp.bfloat16):
+        fm = jnp.asarray([[1.0, 2.0], [np.nan, np.inf], [3.0, -0.0]], dt)
+        for i in (-1, 0, 1, 2, 5):
+            a = row_select(fm, jnp.int32(i), "slice")
+            b = row_select(fm, jnp.int32(i), "onehot")
+            assert np.array_equal(
+                np.asarray(a).view(np.uint8),
+                np.asarray(b).view(np.uint8)), (str(dt), i)
+            r = jnp.asarray([np.inf, -0.0], dt)
+            c = row_update(fm, r, jnp.int32(i), "slice")
+            d = row_update(fm, r, jnp.int32(i), "onehot")
+            assert np.array_equal(
+                np.asarray(c).view(np.uint8),
+                np.asarray(d).view(np.uint8)), (str(dt), i)
+
+
+@pytest.mark.parametrize("region_name", ["mm", "mm256"])
+def test_address_roles_mode_invariant(monkeypatch, region_name):
+    """analyze() must report the SAME address roles and the engine the
+    SAME sync tables under either lowering: the dense form has no
+    gather/dynamic-slice for the jaxpr walk to find, so the roles ride
+    the coast:* tags both lowerings emit (ops/indexing.py _tag).
+    branch_pred is exempt -- the one-hot select legitimately routes the
+    index through select_n -- and sync placement never reads it for
+    address-role leaves."""
+    from coast_tpu.models import mm, mm256
+    from coast_tpu.passes.verification import analyze
+
+    make = (mm.make_region if region_name == "mm"
+            else lambda: mm256.make_region(side=32, block=8))
+    roles, tables = {}, {}
+    for mode in ("slice", "onehot"):
+        monkeypatch.setenv("COAST_INDEXING_MODE", mode)
+        region = make()
+        flow = analyze(region)
+        roles[mode] = {"load_addr": set(flow.load_addr),
+                       "store_addr": set(flow.store_addr),
+                       "written": set(flow.written)}
+        prog = TMR(region)
+        tables[mode] = (dict(prog.pre_sync), dict(prog.step_sync))
+    assert roles["slice"] == roles["onehot"], roles
+    assert tables["slice"] == tables["onehot"], tables
+    # The index leaf keeps its load-address role under the dense
+    # lowering: its pre-step vote exists (the syncGEP guarantee).
+    assert "i" in roles["onehot"]["load_addr"]
+    assert tables["onehot"][0]["i"] is True
+
+
+def test_flagship_block_indexing_modes_bit_identical(monkeypatch):
+    """The flagship's block walk goes through ops/indexing.py over a
+    (n_blocks, block, side) view (mm256.py step), so the dense TPU
+    lowering and the dynamic-slice lowering must produce bit-identical
+    campaign classifications -- the op-level parity above, asserted
+    through a whole protected campaign on a small flagship instance
+    (valid because the sync structure is also mode-invariant:
+    test_address_roles_mode_invariant)."""
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm256
+
+    codes = {}
+    for mode in ("slice", "onehot"):
+        monkeypatch.setenv("COAST_INDEXING_MODE", mode)
+        region = mm256.make_region(side=64, block=16)
+        res = CampaignRunner(TMR(region)).run(160, seed=11, batch_size=160)
+        codes[mode] = np.asarray(res.codes)
+        # clean-run sanity: the campaign exercised real faults
+        assert res.counts["corrected"] > 0
+    assert np.array_equal(codes["slice"], codes["onehot"])
