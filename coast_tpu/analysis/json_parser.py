@@ -17,7 +17,13 @@ supportClasses.py:338-353) and reproduces the reference's analyses
     :340-455 / elfUtils.py:105-176 rolled into one table, since TPU
     "sections" already are named leaves);
   * injection-time histogram (``pcStats`` :216-230, cycle-count histogram --
-    text, no matplotlib dependency).
+    text, no matplotlib dependency);
+  * pipeline stage breakdown -- the per-stage wall-clock block
+    (schedule/pad/dispatch/collect/classify/serialize) the telemetry
+    layer (coast_tpu.obs) records into every log's summary, printed
+    under the timing line and summed key-wise over directories.  This
+    has no reference analogue: at one injection every few seconds the
+    reference never needed stage attribution.
 
 CLI (mirroring ``jsonParser.py logs/ -p | -k fileB | -d dirB``)::
 
@@ -81,6 +87,11 @@ class Summary:
     counts: Dict[str, int]
     seconds: float
     mean_steps: float            # mean guest runtime T over completed runs
+    # Per-stage wall-clock breakdown (schedule/pad/dispatch/collect/
+    # classify/serialize seconds) recorded by the telemetry layer into
+    # each log's summary block; summed key-wise over a directory.  None
+    # for logs written before the stages block existed.
+    stages: Optional[Dict[str, float]] = None
 
     @property
     def due(self) -> int:
@@ -112,6 +123,13 @@ class Summary:
             lines.append(
                 f"  {self.seconds_per_injection() * 1e6:.2f} usec per "
                 f"injection ({self.n / self.seconds:.1f} injections/sec)")
+        if self.stages:
+            lines.append("  --- stage breakdown ---")
+            total = sum(self.stages.values()) or 1.0
+            for stage, sec in sorted(self.stages.items(),
+                                     key=lambda kv: -kv[1]):
+                lines.append(f"  {stage:<12} {sec:>10.4f}s "
+                             f"({100.0 * sec / total:5.1f}%)")
         return "\n".join(lines)
 
 
@@ -184,6 +202,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     seconds = 0.0
     step_sum = 0
     step_n = 0
+    stages: Dict[str, float] = {}
     for doc in docs:
         if "columns" in doc:                      # vectorised columnar path
             import numpy as np
@@ -209,8 +228,11 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                     step_n += 1
         summary = doc.get("summary") or {}
         seconds += float(summary.get("seconds", 0.0))
+        for stage, sec in (summary.get("stages") or {}).items():
+            stages[stage] = stages.get(stage, 0.0) + float(sec)
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
-                   mean_steps=step_sum / step_n if step_n else 0.0)
+                   mean_steps=step_sum / step_n if step_n else 0.0,
+                   stages=stages or None)
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -238,7 +260,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             n=n,
             counts={cls: int(counts[i]) for i, cls in enumerate(_CLASSES)},
             seconds=float(head["summary"].get("seconds", 0.0)),
-            mean_steps=step_sum / step_n if step_n else 0.0)
+            mean_steps=step_sum / step_n if step_n else 0.0,
+            stages=head["summary"].get("stages") or None)
     except OSError:
         return None
 

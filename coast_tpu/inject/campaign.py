@@ -20,12 +20,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from coast_tpu import obs
 from coast_tpu.inject import classify as cls
 from coast_tpu.inject.mem import MemoryMap
 from coast_tpu.inject.schedule import FaultSchedule, generate
@@ -47,17 +48,34 @@ class CampaignResult:
     steps: np.ndarray                 # int32 [n] T per run
     schedule: FaultSchedule
     seed: int
-    # For merged multi-chunk campaigns (run_until_errors): the exact
-    # (seed, n) of every chunk, in order.  The merged ``schedule``
-    # concatenates different-seed streams, so ``seed`` alone cannot
-    # regenerate it; replaying these chunks (CampaignRunner.replay_chunks)
-    # reproduces ``codes`` bit-for-bit.  None for single-seed campaigns,
-    # where ``seed`` + ``n`` suffice.
+    # For merged multi-chunk campaigns (run_until_errors, resumable
+    # flagship loops): the exact (seed, n, start_num) of every chunk, in
+    # order.  The merged ``schedule`` concatenates several seeded
+    # streams, so ``seed`` alone cannot regenerate it; replaying these
+    # chunks (CampaignRunner.replay_chunks) reproduces ``codes``
+    # bit-for-bit.  None for single-seed campaigns, where ``seed`` +
+    # ``n`` suffice -- including externally-sliced ones
+    # (scripts/campaign_1m.py): slices of one seed stream are NOT
+    # replayable as independent chunk records, because generate(n)'s t
+    # column depends on the stream length n.
     chunks: Optional[List[Dict[str, int]]] = None
+    # Per-stage wall-clock attribution (schedule/pad/dispatch/collect/
+    # classify seconds, plus serialize once a logs writer ran), recorded
+    # by the runner's Telemetry; {} when telemetry is disabled.
+    stages: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # First injection number of this campaign within its seed stream
+    # (CampaignRunner.run's resume offset); chunk records carry it so
+    # replay_chunks can regenerate resumed chunks exactly.
+    start_num: int = 0
 
     @property
     def injections_per_sec(self) -> float:
         return self.n / self.seconds if self.seconds > 0 else float("inf")
+
+    def record_stage(self, name: str, seconds: float) -> None:
+        """Accumulate ``seconds`` into one stage bucket (log writers add
+        'serialize' here after the campaign object already exists)."""
+        self.stages[name] = self.stages.get(name, 0.0) + float(seconds)
 
     @property
     def due(self) -> int:
@@ -75,6 +93,7 @@ class CampaignResult:
             "seconds": round(self.seconds, 6),
             "injections_per_sec": round(self.injections_per_sec, 2),
             "seed": self.seed,
+            "stages": {k: round(v, 6) for k, v in self.stages.items()},
         }
         if self.chunks is not None:
             out["chunks"] = self.chunks
@@ -87,7 +106,8 @@ class CampaignRunner:
     def __init__(self, prog: ProtectedProgram,
                  sections: Optional[Sequence[str]] = None,
                  strategy_name: Optional[str] = None,
-                 unroll: int = 1):
+                 unroll: int = 1,
+                 telemetry: Optional[obs.Telemetry] = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -96,9 +116,18 @@ class CampaignRunner:
         one-hot indexing the knob is noise (48.4-57.7k inj/s across
         {1,2,4,8}) and under the slice lowering it HURTS (5.8k -> 3.7k),
         so the default stays 1; the win the hypothesis predicted belonged
-        to the indexing mode, not the unroll."""
+        to the indexing mode, not the unroll.
+
+        ``telemetry`` is the runner's stage recorder (coast_tpu.obs);
+        default a fresh enabled one (COAST_TELEMETRY=0 disables).  Every
+        campaign records per-stage wall-clock into it and exposes the
+        totals as ``CampaignResult.stages``; export the full timeline
+        with ``obs.write_trace(runner.telemetry, path)``."""
         self.prog = prog
-        self.mmap = MemoryMap(prog, sections)
+        self.telemetry = telemetry if telemetry is not None \
+            else obs.Telemetry()
+        with self.telemetry.activate():
+            self.mmap = MemoryMap(prog, sections)
         self.strategy_name = strategy_name or f"N={prog.cfg.num_clones}"
         self.unroll = max(1, int(unroll))
         out_words = int(np.prod(jax.eval_shape(
@@ -145,7 +174,21 @@ class CampaignRunner:
 
     # -- execution ----------------------------------------------------------
     def run_schedule(self, sched: FaultSchedule,
-                     batch_size: int = 4096) -> CampaignResult:
+                     batch_size: int = 4096,
+                     progress: Optional[
+                         Callable[[int, Dict[str, int]], None]] = None,
+                     _telemetry_mark: Optional[int] = None
+                     ) -> CampaignResult:
+        """Run every row of ``sched`` in edge-padded batches.
+
+        ``progress(done, counts_so_far)`` is called after each collected
+        batch (for heartbeats; ``counts_so_far`` is the cumulative class
+        histogram of the rows fetched so far).  Stage wall-clock (pad /
+        dispatch / collect / classify, plus per-batch pad-waste) is
+        recorded into ``self.telemetry`` and summed onto the result's
+        ``stages``; ``_telemetry_mark`` lets ``run`` extend the stage
+        window back over its schedule-generation span.
+        """
         # Deliberately no clamp to len(sched) here: every batch is
         # edge-padded to batch_size so all chunks (including a caller's
         # externally-sliced tail, e.g. scripts/campaign_1m.py) share one
@@ -153,41 +196,70 @@ class CampaignRunner:
         # site (advisor, supervisor) where a single smaller compile beats
         # padding waste.
         batch_size = self._round_batch(batch_size)
+        tel = self.telemetry
+        mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
         t0 = time.perf_counter()
         outs: List[Dict[str, np.ndarray]] = []
+        done = 0
+        live_counts = np.zeros(cls.NUM_CLASSES, np.int64)
+        live_invalid = 0
+
+        def _grab(pending, n_prev: int, part_t: np.ndarray) -> None:
+            """Block on one batch; update progress accounting."""
+            nonlocal done, live_invalid
+            with tel.span("collect", n=n_prev):
+                got = self._collect(pending)
+            outs.append({k: v[:n_prev] for k, v in got.items()})
+            done += n_prev
+            if progress is not None:
+                fired = part_t[:n_prev] >= 0
+                live_counts[:] += np.bincount(
+                    outs[-1]["code"][fired], minlength=cls.NUM_CLASSES)
+                live_invalid += int(n_prev - fired.sum())
+                counts_so_far = {name: int(live_counts[i])
+                                 for i, name in enumerate(cls.CLASS_NAMES)}
+                counts_so_far["cache_invalid"] = live_invalid
+                progress(done, counts_so_far)
+
         # Double-buffered: dispatch batch i+1 before collecting batch i, so
         # the host-side fetch (one tunnel round-trip per batch) overlaps the
         # device work -- jax dispatch is async, the device_get is the only
-        # blocking point.
-        in_flight: List[Tuple[object, int]] = []
+        # blocking point.  The dispatch span therefore times the host-side
+        # enqueue; device execution time lands in the matching collect span.
+        in_flight: List[Tuple[object, int, np.ndarray]] = []
         for lo in range(0, len(sched), batch_size):
-            part = sched.slice(lo, min(lo + batch_size, len(sched)))
-            fault, n_part = self._padded_fault(part, batch_size)
-            in_flight.append((self._dispatch(fault), n_part))
+            with tel.span("pad", lo=lo):
+                part = sched.slice(lo, min(lo + batch_size, len(sched)))
+                fault, n_part = self._padded_fault(part, batch_size)
+            if batch_size - n_part:
+                tel.count("pad_waste_rows", batch_size - n_part)
+            with tel.span("dispatch", n=n_part):
+                pending = self._dispatch(fault)
+            in_flight.append((pending, n_part, part.t))
             if len(in_flight) > 1:
-                pending, n_prev = in_flight.pop(0)
-                got = self._collect(pending)
-                outs.append({k: v[:n_prev] for k, v in got.items()})
-        for pending, n_prev in in_flight:
-            got = self._collect(pending)
-            outs.append({k: v[:n_prev] for k, v in got.items()})
-        if outs:
-            merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
-        else:
-            merged = {k: np.zeros(0, np.int32)
-                      for k in ("code", "errors", "corrected", "steps")}
+                _grab(*in_flight.pop(0))
+        for flight in in_flight:
+            _grab(*flight)
+        with tel.span("classify"):
+            if outs:
+                merged = {k: np.concatenate([o[k] for o in outs])
+                          for k in outs[0]}
+            else:
+                merged = {k: np.zeros(0, np.int32)
+                          for k in ("code", "errors", "corrected", "steps")}
+            # Cache draws outside the program footprint (t < 0) never fire
+            # a flip: a clean run that injected nothing is not a "survived
+            # injection", so they get their own bucket instead of inflating
+            # success -- the analogue of the reference summary's cacheValids
+            # column (jsonParser.py summarizeRuns counts lines whose
+            # cacheInfo says the chosen line was not dirty).
+            invalid_draw = np.asarray(sched.t) < 0
+            binc = np.bincount(merged["code"][~invalid_draw],
+                               minlength=cls.NUM_CLASSES)
+            counts = {name: int(binc[i])
+                      for i, name in enumerate(cls.CLASS_NAMES)}
+            counts["cache_invalid"] = int(invalid_draw.sum())
         seconds = time.perf_counter() - t0
-        # Cache draws outside the program footprint (t < 0) never fire a
-        # flip: a clean run that injected nothing is not a "survived
-        # injection", so they get their own bucket instead of inflating
-        # success -- the analogue of the reference summary's cacheValids
-        # column (jsonParser.py summarizeRuns counts lines whose cacheInfo
-        # says the chosen line was not dirty).
-        invalid_draw = np.asarray(sched.t) < 0
-        binc = np.bincount(merged["code"][~invalid_draw],
-                           minlength=cls.NUM_CLASSES)
-        counts = {name: int(binc[i]) for i, name in enumerate(cls.CLASS_NAMES)}
-        counts["cache_invalid"] = int(invalid_draw.sum())
         return CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
@@ -200,19 +272,29 @@ class CampaignRunner:
             steps=merged["steps"],
             schedule=sched,
             seed=sched.seed,
+            stages=tel.stage_totals(since=mark),
         )
 
     def run(self, n: int, seed: int = 0,
-            batch_size: int = 4096, start_num: int = 0) -> CampaignResult:
+            batch_size: int = 4096, start_num: int = 0,
+            progress: Optional[
+                Callable[[int, Dict[str, int]], None]] = None
+            ) -> CampaignResult:
         """``start_num`` resumes a seeded campaign at injection #start_num:
         the schedule stream for (seed, start_num+n) is generated and the
         first start_num rows skipped, so a resumed campaign injects exactly
         the faults the interrupted one would have (the --start-num counter
         of gdbClient.py:401)."""
-        sched = generate(self.mmap, start_num + n, seed,
-                         self.prog.region.nominal_steps)
-        return self.run_schedule(sched.slice(start_num, start_num + n),
-                                 batch_size)
+        tel = self.telemetry
+        mark = tel.mark()
+        with tel.activate():        # generate() records its schedule span
+            sched = generate(self.mmap, start_num + n, seed,
+                             self.prog.region.nominal_steps)
+        res = self.run_schedule(sched.slice(start_num, start_num + n),
+                                batch_size, progress=progress,
+                                _telemetry_mark=mark)
+        res.start_num = start_num
+        return res
 
     def run_until_errors(self, min_errors: int, seed: int = 0,
                          batch_size: int = 4096,
@@ -251,13 +333,18 @@ class CampaignRunner:
                       batch_size: int = 4096) -> CampaignResult:
         """Re-run a recorded multi-chunk campaign exactly.
 
-        ``chunks`` is ``CampaignResult.chunks`` (each entry ``{"seed", "n"}``);
-        the replay regenerates each chunk's seeded schedule and merges in
-        the same order, so ``codes`` matches the original bit-for-bit --
-        the campaign-resume guarantee of gdbClient.py:401 extended to the
-        error-bounded sizing loop."""
+        ``chunks`` is ``CampaignResult.chunks`` (each entry ``{"seed",
+        "n"}`` plus an optional ``"start_num"`` resume offset, honored so
+        a resumed-chunk campaign -- e.g. the flagship loop's
+        ``run(seed, start_num=done)`` chunks -- replays the exact rows it
+        ran); the replay regenerates each chunk's seeded schedule and
+        merges in the same order, so ``codes`` matches the original
+        bit-for-bit -- the campaign-resume guarantee of gdbClient.py:401
+        extended to the error-bounded sizing loop."""
         results = [self.run(int(c["n"]), seed=int(c["seed"]),
-                            batch_size=batch_size) for c in chunks]
+                            batch_size=batch_size,
+                            start_num=int(c.get("start_num", 0)))
+                   for c in chunks]
         return _merge_results(results, int(chunks[0]["seed"]) if chunks
                               else 0)
 
@@ -265,6 +352,10 @@ class CampaignRunner:
 def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
     first = parts[0]
     counts = {k: sum(p.counts[k] for p in parts) for k in first.counts}
+    stages: Dict[str, float] = {}
+    for p in parts:
+        for k, v in p.stages.items():
+            stages[k] = stages.get(k, 0.0) + v
     sched = FaultSchedule(
         *(np.concatenate([getattr(p.schedule, f) for p in parts])
           for f in ("leaf_id", "lane", "word", "bit", "t", "section_idx")),
@@ -281,5 +372,7 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
         steps=np.concatenate([p.steps for p in parts]),
         schedule=sched,
         seed=seed,
-        chunks=[{"seed": p.seed, "n": p.n} for p in parts],
+        chunks=[{"seed": p.seed, "n": p.n, "start_num": p.start_num}
+                for p in parts],
+        stages=stages,
     )

@@ -27,10 +27,13 @@ both consumed by coast_tpu.analysis.json_parser.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import json
+import time
 from typing import Dict, List
 
+from coast_tpu import obs
 from coast_tpu.inject import classify as cls
 from coast_tpu.inject.campaign import CampaignResult
 from coast_tpu.inject.mem import MemoryMap
@@ -38,6 +41,25 @@ from coast_tpu.inject.mem import MemoryMap
 
 def _timestamp() -> str:
     return datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")
+
+
+@contextlib.contextmanager
+def _serialize_stage(res: CampaignResult, writer: str, path: str):
+    """Bill a writer's wall-clock to the campaign's 'serialize' stage
+    (and to the ambient telemetry's timeline, for trace export).  The
+    campaign object exists before any log is written, so serialization
+    lands in ``res.stages`` after the fact via record_stage.
+
+    Recording follows the telemetry on/off knob: bill only when the
+    campaign recorded stages (its runner's telemetry was on) or an
+    enabled ambient recorder is active -- otherwise a disabled-telemetry
+    campaign would end up with a stages block containing *only*
+    serialize, reading as ~100% of a pipeline that was never timed."""
+    with obs.span("serialize", writer=writer, path=path):
+        t0 = time.perf_counter()
+        yield
+        if res.stages or obs.current().enabled:
+            res.record_stage("serialize", time.perf_counter() - t0)
 
 
 def _result_dict(code: int, errors: int, corrected: int, steps: int,
@@ -168,19 +190,21 @@ def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
         raise FileNotFoundError(
             f"exec_path {exec_path!r} does not exist; the reference's "
             "readJsonFile exits on logs whose line-1 path is missing")
-    with open(path, "w") as f:
-        f.write(exec_path + "\n")
-        json.dump(to_injection_logs(res, mmap), f, indent=1)
+    with _serialize_stage(res, "reference_json", path):
+        with open(path, "w") as f:
+            f.write(exec_path + "\n")
+            json.dump(to_injection_logs(res, mmap), f, indent=1)
 
 
 def write_json(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
     """Reference-schema structured log (threadFunctions.py:195-198 flushes
     per injection; we flush per campaign)."""
-    with open(path, "w") as f:
-        json.dump({
-            "summary": res.summary(),
-            "runs": to_injection_logs(res, mmap),
-        }, f, indent=1)
+    with _serialize_stage(res, "json", path):
+        with open(path, "w") as f:
+            json.dump({
+                "summary": res.summary(),
+                "runs": to_injection_logs(res, mmap),
+            }, f, indent=1)
 
 
 def write_ndjson(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
@@ -190,10 +214,21 @@ def write_ndjson(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
     C++ encoder (coast_ndjson_encode) when available -- one C pass over
     the columns -- with this function's template loop as the bit-identical
     Python fallback, so a 10^6-run campaign serialises in well under a
-    second natively and in seconds otherwise."""
+    second natively and in seconds otherwise.
+
+    The stage accounting (res.stages['serialize']) is recorded *after*
+    the write, so the summary line inside the file reflects the stages
+    known before this serialization -- the serialize stage of a log file
+    describes earlier writers, not itself."""
     ts = _timestamp()
-    if _ndjson_try_native(res, mmap, ts, path):
-        return
+    with _serialize_stage(res, "ndjson", path):
+        if _ndjson_try_native(res, mmap, ts, path):
+            return
+        _write_ndjson_py(res, mmap, ts, path)
+
+
+def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
+                     path: str) -> None:
     col, secs = _columns(res, mmap)
     # One result template per class, mirroring _result_dict (timestamps
     # identical across the campaign, as with write_json).
@@ -250,12 +285,14 @@ def write_columnar(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
     section table -- O(1) Python objects regardless of campaign size, and
     the natural format for numpy-side analysis.  json_parser summarises it
     directly without materialising per-run dicts."""
-    col, secs = _columns(res, mmap)
-    with open(path, "w") as f:
-        json.dump({
-            "summary": {**res.summary(), "format": "columnar"},
-            "sections": [{"leaf_id": s.leaf_id, "name": s.name,
-                          "kind": s.kind, "lanes": s.lanes, "words": s.words}
-                         for s in secs.values()],
-            "columns": col,
-        }, f)
+    with _serialize_stage(res, "columnar", path):
+        col, secs = _columns(res, mmap)
+        with open(path, "w") as f:
+            json.dump({
+                "summary": {**res.summary(), "format": "columnar"},
+                "sections": [{"leaf_id": s.leaf_id, "name": s.name,
+                              "kind": s.kind, "lanes": s.lanes,
+                              "words": s.words}
+                             for s in secs.values()],
+                "columns": col,
+            }, f)

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from coast_tpu import obs
 from coast_tpu.passes.dataflow_protection import ProtectedProgram
 
 
@@ -43,22 +44,26 @@ class MemoryMap:
 
     def __init__(self, prog: ProtectedProgram,
                  sections: Optional[Sequence[str]] = None):
-        self.sections: List[MemorySection] = []
-        for leaf_id, (name, kind, lanes, words) in enumerate(
-                prog.injectable_sections()):
-            if sections is not None and kind not in sections \
-                    and name not in sections:
-                continue
-            self.sections.append(MemorySection(
-                name=name,
-                leaf_id=leaf_id,
-                kind=kind,
-                lanes=lanes,
-                words=max(words, 1),
-            ))
-        if not self.sections:
-            raise ValueError("no injectable sections selected")
-        self.total_bits = sum(s.bits for s in self.sections)
+        # Span via the ambient telemetry (CampaignRunner activates its
+        # recorder around construction): map building walks the whole
+        # state pytree, part of the schedule-build stage.
+        with obs.span("memory_map"):
+            self.sections: List[MemorySection] = []
+            for leaf_id, (name, kind, lanes, words) in enumerate(
+                    prog.injectable_sections()):
+                if sections is not None and kind not in sections \
+                        and name not in sections:
+                    continue
+                self.sections.append(MemorySection(
+                    name=name,
+                    leaf_id=leaf_id,
+                    kind=kind,
+                    lanes=lanes,
+                    words=max(words, 1),
+                ))
+            if not self.sections:
+                raise ValueError("no injectable sections selected")
+            self.total_bits = sum(s.bits for s in self.sections)
 
     def by_name(self, name: str) -> MemorySection:
         for s in self.sections:

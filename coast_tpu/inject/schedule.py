@@ -21,6 +21,7 @@ from typing import Dict
 
 import numpy as np
 
+from coast_tpu import obs
 from coast_tpu.inject.mem import MemoryMap
 from coast_tpu.native import splitmix_fill
 
@@ -54,12 +55,13 @@ def generate(mmap: MemoryMap, n: int, seed: int,
              nominal_steps: int) -> FaultSchedule:
     """n seeded draws: uniform over all injectable bits x uniform over the
     nominal runtime window (the injection window of threadFunctions.py:451)."""
-    raw = splitmix_fill(seed, 2 * n)          # uint64 stream, native or numpy
-    flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
-    t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
-    leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
-    return FaultSchedule(leaf_id, lane, word, bit, t,
-                         sec_idx.astype(np.int32), seed)
+    with obs.span("schedule", n=n, seed=seed):
+        raw = splitmix_fill(seed, 2 * n)      # uint64 stream, native or numpy
+        flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
+        t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
+        leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
+        return FaultSchedule(leaf_id, lane, word, bit, t,
+                             sec_idx.astype(np.int32), seed)
 
 
 def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
@@ -78,6 +80,13 @@ def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
     (not seed+idx, which would make adjacent master seeds share stream
     bits shifted one section over), so campaigns replay per stratum and
     different master seeds are decorrelated."""
+    with obs.span("schedule", n_per_section=n_per_section, seed=seed,
+                  stratified=True):
+        return _generate_stratified(mmap, n_per_section, seed, nominal_steps)
+
+
+def _generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
+                         nominal_steps: int) -> FaultSchedule:
     keys = splitmix_fill(seed, len(mmap.sections))
     section_start = np.cumsum([0] + [s.bits for s in mmap.sections])
     flat_parts = []

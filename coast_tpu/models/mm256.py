@@ -101,11 +101,15 @@ def make_region(side: int = SIDE, block: int = BLOCK,
         i, phase = state["i"], state["phase"]
         # Block-row access goes through ops/indexing.py over a
         # (n_blocks, block, side) view: a corrupted ``i`` clamps into
-        # range (same fidelity envelope as the toy mm), and under the
-        # campaign's vmap the access lowers densely on TPU instead of
-        # the batched gather/scatter a dynamic-slice would become --
-        # the same lesson artifacts/unroll_sweep.json measured for the
-        # toy campaign, applied to the flagship's block walk.  The
+        # range (same fidelity envelope as the toy mm), and routing
+        # through indexing.py makes the lowering of the batch-varying
+        # index *selectable* (COAST_INDEXING_MODE / the mode arg), so
+        # slice vs one-hot can be A/B'd on-chip
+        # (scripts/flagship_indexing_ab.py).  Note "auto" currently
+        # stays on the ``slice`` lowering here: a flagship block row is
+        # a whole (block, side) panel, far above the
+        # ONEHOT_MAX_ROW_BYTES=4096 cutoff the toy-scale sweep
+        # (artifacts/unroll_sweep.json) justified for one-hot.  The
         # leaves keep their (side, side) shapes, so the word-addressed
         # injection map is unchanged.
         blk_i = jnp.clip(i, 0, n_blocks - 1)

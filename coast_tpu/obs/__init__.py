@@ -1,0 +1,20 @@
+"""coast_tpu.obs: campaign telemetry (spans, counters, trace export).
+
+The observability layer of the injection pipeline: nested wall-clock
+spans and counters (:mod:`coast_tpu.obs.spans`), Chrome/Perfetto
+``trace_event`` export (:mod:`coast_tpu.obs.trace_export`), and a
+rate-limited progress heartbeat (:mod:`coast_tpu.obs.heartbeat`).
+See docs/observability.md for the workflow.
+"""
+
+from coast_tpu.obs.heartbeat import Heartbeat
+from coast_tpu.obs.spans import (NULL, Telemetry, count, current, instant,
+                                 span)
+from coast_tpu.obs.trace_export import (to_trace_doc, to_trace_events,
+                                        write_trace)
+
+__all__ = [
+    "Telemetry", "NULL", "current", "span", "count", "instant",
+    "to_trace_events", "to_trace_doc", "write_trace",
+    "Heartbeat",
+]

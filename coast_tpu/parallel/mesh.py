@@ -120,18 +120,28 @@ class ShardedCampaignRunner(CampaignRunner):
                       batch_size: int = 4096) -> Dict[str, int]:
         """Classification counts for n seeded injections; per-run records
         never leave the devices (padding masked out of the histogram)."""
-        sched = generate(self.mmap, n, seed, self.prog.region.nominal_steps)
+        tel = self.telemetry
+        with tel.activate():        # generate() records its schedule span
+            sched = generate(self.mmap, n, seed,
+                             self.prog.region.nominal_steps)
         # One-shot campaign drawn here: clamp the batch to the schedule so
         # a small n does not pay for padding rows (the clamp happens
         # before device rounding, which floors at one row per device).
         batch_size = self._round_batch(min(batch_size, len(sched)))
         total = np.zeros(cls.NUM_CLASSES, np.int64)
         for lo in range(0, len(sched), batch_size):
-            part = sched.slice(lo, min(lo + batch_size, len(sched)))
-            fault, n_part = self._padded_fault(part, batch_size)
-            valid = jnp.asarray(np.arange(batch_size) < n_part)
-            total += np.asarray(jax.device_get(
-                self._hist_sharded(fault, valid)), np.int64)
+            with tel.span("pad", lo=lo):
+                part = sched.slice(lo, min(lo + batch_size, len(sched)))
+                fault, n_part = self._padded_fault(part, batch_size)
+                valid = jnp.asarray(np.arange(batch_size) < n_part)
+            if batch_size - n_part:
+                tel.count("pad_waste_rows", batch_size - n_part)
+            with tel.span("dispatch", n=n_part):
+                pending = self._hist_sharded(fault, valid)
+            # One collective per batch: the device_get of 6 ints is the
+            # only blocking point, so device execution bills here.
+            with tel.span("collect", n=n_part):
+                total += np.asarray(jax.device_get(pending), np.int64)
         counts = {name: int(total[i]) for i, name in enumerate(cls.CLASS_NAMES)}
         # Parity with run_schedule's counts: never-fired draws (t < 0; none
         # from generate(), which only emits in-footprint faults, but the

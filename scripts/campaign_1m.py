@@ -3,20 +3,29 @@
 The demonstration VERDICT round 1 #6 asks for: schedule -> batched run ->
 bulk logs -> analysis, at the scale the >=1000x throughput story is about,
 with wall-clock recorded per stage so the host/device split is explicit.
-(At the measured batch-65536 device rate the host-side ndjson write IS
-the dominant stage -- 6.9 s vs 3.6 s of run time in the committed
-artifact; host_log_fraction records it.)  The reference's loop at
-seconds-per-injection would need ~12 days for this campaign
-(supervisor.py); here it is seconds on one chip.
+Stage attribution is now recorded by the telemetry layer (coast_tpu.obs)
+on every campaign -- the artifact's ``campaign.stages`` block breaks the
+pipeline into schedule/pad/dispatch/collect/classify/serialize seconds,
+and ``--trace-out`` exports the full per-batch timeline as a
+Chrome/Perfetto trace_event JSON (open at https://ui.perfetto.dev).
+The reference's loop at seconds-per-injection would need ~12 days for
+this campaign (supervisor.py); here it is seconds on one chip.
 
 Writes the per-run log (ndjson, the InjectionLog schema of
 supportClasses.py:278-389) to --logdir and a machine-readable summary
 artifact (stage timings, classification counts, analysis cross-check) to
 --out; the committed artifact lives at artifacts/campaign_mm_1m.json.
 
+Replay note: this campaign is ONE seed stream sliced into dispatch
+chunks, so the artifact records no ``chunks`` list -- (seed, n) alone
+regenerates it exactly (CampaignRunner.run(n, seed)); per-chunk records
+would NOT replay bit-for-bit because generate(n)'s time column depends
+on the stream length.
+
 Usage:  python scripts/campaign_1m.py [-n 1000000] [--batch N]
         [--out artifacts/campaign_mm_1m.json] [--logdir /tmp]
-        (--batch defaults per backend: 65536 on TPU, 2048 on CPU)
+        [--trace-out trace.json] [--heartbeat SECONDS]
+        (--batch defaults per backend: 65536 on TPU, 2048 elsewhere)
 """
 
 from __future__ import annotations
@@ -36,10 +45,17 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=None,
                     help="vmap batch per dispatch; default 65536 on TPU "
                     "(measured knee of artifacts/bench_full.json's "
-                    "batch sweep), 2048 on CPU")
+                    "batch sweep), 2048 elsewhere")
     ap.add_argument("--seed", type=int, default=2026)
     ap.add_argument("--out", default="artifacts/campaign_mm_1m.json")
     ap.add_argument("--logdir", default="/tmp")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the campaign's Perfetto trace_event JSON "
+                    "here (per-batch dispatch/collect spans, pad-waste "
+                    "counter, heartbeats)")
+    ap.add_argument("--heartbeat", type=float, default=5.0,
+                    help="progress heartbeat interval in seconds "
+                    "(0 disables)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (dev boxes)")
     args = ap.parse_args(argv)
@@ -51,8 +67,11 @@ def main(argv=None) -> int:
         # Measured: throughput scales with batch to ~739k inj/s at
         # 131072 (bench_full.json); 65536 keeps the tail chunk's padding
         # waste under 7% at n=1e6 while sitting at ~86% of that peak.
-        args.batch = 65536 if jax.default_backend() != "cpu" else 2048
+        # The knee was measured on TPU v5e only, so only TPU gets it;
+        # any other backend (CPU, GPU) falls back to 2048.
+        args.batch = 65536 if jax.default_backend() == "tpu" else 2048
 
+    from coast_tpu import obs
     from coast_tpu import TMR
     from coast_tpu.analysis import json_parser
     from coast_tpu.inject import logs
@@ -68,42 +87,79 @@ def main(argv=None) -> int:
     note("building protected program")
     prog = TMR(REGISTRY["matrixMultiply"]())
     runner = CampaignRunner(prog, strategy_name="TMR")
+    telemetry = runner.telemetry
     stages["build_s"] = round(time.perf_counter() - t0, 3)
 
     t0 = time.perf_counter()
     note("generating schedule")
-    sched = generate(runner.mmap, args.n, args.seed,
-                     prog.region.nominal_steps)
+    with telemetry.activate():
+        sched = generate(runner.mmap, args.n, args.seed,
+                         prog.region.nominal_steps)
     stages["schedule_s"] = round(time.perf_counter() - t0, 3)
 
-    # warm the compile outside the measured run
+    # warm the compile outside the measured run; in the trace it shows
+    # as one parent "warmup" span so the compile-dominated first
+    # dispatch is visually separate from the steady-state batches
     note("warm compile")
-    runner.run(args.batch, seed=1, batch_size=args.batch)
+    with telemetry.span("warmup"):
+        runner.run(args.batch, seed=1, batch_size=args.batch)
     note("campaign")
+
+    heartbeat = (obs.Heartbeat(args.n, interval_s=args.heartbeat)
+                 if args.heartbeat > 0 else None)
+    agg_counts = {}
 
     t0 = time.perf_counter()
     parts = []
     chunk = max(args.batch, 100_000 // args.batch * args.batch)
     for lo in range(0, len(sched), chunk):
+        def _progress(done, counts, _lo=lo):
+            merged = dict(agg_counts)
+            for k, v in counts.items():
+                merged[k] = merged.get(k, 0) + v
+            with telemetry.activate():
+                heartbeat.update(_lo + done, merged)
         part = runner.run_schedule(sched.slice(lo, min(lo + chunk,
                                                        len(sched))),
-                                   batch_size=args.batch)
+                                   batch_size=args.batch,
+                                   # None keeps the per-batch progress
+                                   # accounting entirely off when the
+                                   # heartbeat is disabled
+                                   progress=(_progress if heartbeat
+                                             is not None else None))
         parts.append(part)
+        for k, v in part.counts.items():
+            agg_counts[k] = agg_counts.get(k, 0) + v
         done_n = min(lo + chunk, len(sched))
         note(f"{done_n}/{len(sched)} at "
              f"{part.injections_per_sec:.0f} inj/s")
     from coast_tpu.inject.campaign import _merge_results
     res = _merge_results(parts, args.seed)
     res.schedule = sched
+    # One seed stream sliced into chunks: (seed, n) regenerates it
+    # exactly, and per-chunk records would replay WRONG (each chunk
+    # record would regenerate the first `chunk` rows of the stream, not
+    # its slice) -- the single-seed case of CampaignResult.chunks' doc.
+    res.chunks = None
+    # The schedule was generated once up front (outside the per-chunk
+    # stage windows _merge_results summed), so bill it onto the merged
+    # result explicitly -- every campaign artifact carries the full
+    # schedule/pad/dispatch/collect/classify/serialize breakdown.
+    res.record_stage("schedule", stages["schedule_s"])
     stages["run_s"] = round(time.perf_counter() - t0, 3)
+    if heartbeat is not None:
+        with telemetry.activate():
+            heartbeat.update(res.n, agg_counts, force=True)
 
     log_path = os.path.join(args.logdir, f"mm_tmr_{args.n}.ndjson")
     t0 = time.perf_counter()
-    logs.write_ndjson(res, runner.mmap, log_path)
+    with telemetry.activate():
+        logs.write_ndjson(res, runner.mmap, log_path)
     stages["log_s"] = round(time.perf_counter() - t0, 3)
 
     t0 = time.perf_counter()
-    summary = json_parser.summarize_path(log_path)
+    with telemetry.span("analysis"):
+        summary = json_parser.summarize_path(log_path)
     stages["analysis_s"] = round(time.perf_counter() - t0, 3)
 
     # Cross-check: the analysis read back exactly what the campaign saw.
@@ -125,6 +181,17 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
     }
+    if args.trace_out:
+        os.makedirs(os.path.dirname(args.trace_out) or ".", exist_ok=True)
+        obs.write_trace(telemetry, args.trace_out,
+                        metadata={"benchmark": "matrixMultiply",
+                                  "strategy": "TMR", "n": res.n,
+                                  "batch": args.batch,
+                                  "backend": jax.default_backend()},
+                        process_name=f"campaign_1m n={res.n}")
+        artifact["trace_out"] = args.trace_out
+        note(f"trace -> {args.trace_out} "
+             f"({len(telemetry.events)} events; open at ui.perfetto.dev)")
     out = args.out
     if (jax.default_backend() == "cpu"
             and out == "artifacts/campaign_mm_1m.json"):

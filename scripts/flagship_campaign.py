@@ -14,7 +14,12 @@ one, recording the probe table and the HBM arithmetic in the artifact.
 
 The main campaign runs in resumable seeded chunks (run(seed, start_num))
 and rewrites the artifact after every chunk, so a tunnel wedge mid-way
-still leaves a usable partial record.
+still leaves a usable partial record.  ``--heartbeat`` prints a periodic
+progress line (inj/s, ETA, class counts so far) between chunk saves;
+``--trace-out`` exports the whole session -- batch probe, both
+campaigns, the A/B -- as one Perfetto trace_event JSON, and each
+campaign block records its stage breakdown (coast_tpu.obs) under
+``stages``.
 
 Also measured here: the slice-vote A/B (store_slice hint vs whole-leaf
 voting) as campaign injections/sec, the number the round-3 verdict asked
@@ -61,10 +66,27 @@ def rate_block(counts, n):
     return out
 
 
-def main():
-    from coast_tpu import DWC, TMR
+def main(argv=None):
+    import argparse
+
+    from coast_tpu import DWC, TMR, obs
     from coast_tpu.inject.campaign import CampaignRunner
     from coast_tpu.models import REGISTRY, mm256
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace-out", default=None,
+                    help="write the whole session (probe + campaigns + "
+                    "A/B) as one Perfetto trace_event JSON")
+    ap.add_argument("--heartbeat", type=float, default=30.0,
+                    help="progress heartbeat interval in seconds "
+                    "(0 disables); flagship chunks run minutes, so the "
+                    "heartbeat is the liveness signal")
+    args = ap.parse_args(argv)
+
+    # One shared recorder across every runner of the session, so the
+    # exported trace shows probe, TMR, DWC, and A/B phases on one
+    # timeline.
+    telemetry = obs.Telemetry()
 
     backend = jax.default_backend()
     n_tmr = int(os.environ.get("COAST_FLAGSHIP_N", "50000"))
@@ -99,13 +121,14 @@ def main():
 
     # -- batch probe (TMR) --------------------------------------------------
     tmr_runner = CampaignRunner(TMR(region, pallas_voters=True),
-                                strategy_name="TMR")
+                                strategy_name="TMR", telemetry=telemetry)
     out["batch_probe"] = []
     best_batch, best_rate = None, -1.0
     for batch in probe_batches:
         try:
-            tmr_runner.run(batch, seed=1, batch_size=batch)      # compile+warm
-            res = tmr_runner.run(2 * batch, seed=2, batch_size=batch)
+            with telemetry.span("probe", batch=batch):
+                tmr_runner.run(batch, seed=1, batch_size=batch)  # compile+warm
+                res = tmr_runner.run(2 * batch, seed=2, batch_size=batch)
         except Exception as e:  # noqa: BLE001 - OOM at large batch is data
             out["batch_probe"].append({"batch": batch,
                                        "error": type(e).__name__})
@@ -130,17 +153,33 @@ def main():
     for strat_name, runner, n_total in (
             ("TMR", tmr_runner, n_tmr),
             ("DWC", CampaignRunner(DWC(region, pallas_voters=True),
-                                   strategy_name="DWC"), n_dwc)):
+                                   strategy_name="DWC",
+                                   telemetry=telemetry), n_dwc)):
         counts, done, secs = {}, 0, 0.0
+        stages = {}
+        heartbeat = (obs.Heartbeat(n_total, interval_s=args.heartbeat,
+                                   label=f"heartbeat {strat_name}")
+                     if args.heartbeat > 0 else None)
         key = f"campaign_{strat_name}"
         while done < n_total:
             n_chunk = min(chunk, n_total - done)
+
+            def _progress(chunk_done, chunk_counts, _base=done):
+                merged = dict(counts)
+                for k, v in chunk_counts.items():
+                    merged[k] = merged.get(k, 0) + v
+                with telemetry.activate():
+                    heartbeat.update(_base + chunk_done, merged)
             res = runner.run(n_chunk, seed=42, batch_size=best_batch,
-                             start_num=done)
+                             start_num=done,
+                             progress=(_progress if heartbeat is not None
+                                       else None))
             done += res.n
             secs += res.seconds
             for k, v in res.counts.items():
                 counts[k] = counts.get(k, 0) + v
+            for k, v in res.stages.items():
+                stages[k] = round(stages.get(k, 0.0) + v, 6)
             lanes = 3 if strat_name == "TMR" else 2
             fl = lanes * region.meta["flops_per_run"]
             out[key] = {
@@ -154,6 +193,7 @@ def main():
                     fl * done / secs / 1e9 / PEAK_GFLOPS, 5),
                 "counts": counts,
                 "rates": rate_block(counts, done),
+                "stages": stages,
                 "complete": done >= n_total,
             }
             save()
@@ -166,9 +206,11 @@ def main():
                       if k != "store_slice"}
     ab = {}
     for name, reg in (("slice_vote", region), ("wholeleaf_vote", region_wl)):
-        r = CampaignRunner(TMR(reg, pallas_voters=True), strategy_name="TMR")
-        r.run(best_batch, seed=1, batch_size=best_batch)          # warm
-        res = r.run(n_ab, seed=7, batch_size=best_batch)
+        r = CampaignRunner(TMR(reg, pallas_voters=True), strategy_name="TMR",
+                           telemetry=telemetry)
+        with telemetry.span("slice_vote_ab", cell=name):
+            r.run(best_batch, seed=1, batch_size=best_batch)      # warm
+            res = r.run(n_ab, seed=7, batch_size=best_batch)
         ab[name] = {"injections": res.n,
                     "injections_per_sec": round(res.injections_per_sec, 2)}
         print(json.dumps({name: ab[name]}))
@@ -178,6 +220,15 @@ def main():
             / ab["wholeleaf_vote"]["injections_per_sec"], 3)
     out["slice_vote_ab"] = ab
     save()
+    if args.trace_out:
+        os.makedirs(os.path.dirname(args.trace_out) or ".", exist_ok=True)
+        obs.write_trace(telemetry, args.trace_out,
+                        metadata={"benchmark": bench, "backend": backend},
+                        process_name=f"flagship_campaign {bench}")
+        out["trace_out"] = args.trace_out
+        save()
+        print(json.dumps({"trace": args.trace_out,
+                          "events": len(telemetry.events)}))
     print(json.dumps({"wrote": path}))
     return 0
 

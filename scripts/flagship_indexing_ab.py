@@ -40,39 +40,51 @@ CELLS = (
 
 
 def measure(mode: str, flag_name: str, batch: int, n: int, smoke: bool):
-    """Build + run one (mode, flagship) cell; env is read at trace time."""
+    """Build + run one (mode, flagship) cell; env is read at trace time.
+
+    COAST_INDEXING_MODE is restored (or deleted) in a finally so a
+    forced lowering can never leak past this cell into later traces --
+    an escaped override would silently skew every subsequent build.
+    """
+    prev_mode = os.environ.get("COAST_INDEXING_MODE")
     os.environ["COAST_INDEXING_MODE"] = mode
-    import jax
-    import numpy as np
-    from coast_tpu import TMR
-    from coast_tpu.inject.campaign import CampaignRunner
-    from coast_tpu.models import REGISTRY
-    from coast_tpu.ops.bitflip import noop_fault
+    try:
+        import jax
+        import numpy as np
+        from coast_tpu import TMR
+        from coast_tpu.inject.campaign import CampaignRunner
+        from coast_tpu.models import REGISTRY
+        from coast_tpu.ops.bitflip import noop_fault
 
-    region = REGISTRY[flag_name]()
-    prog = TMR(region, pallas_voters=(jax.default_backend() == "tpu"))
-    # single-run seconds (noop fault traced in so nothing folds away)
-    fault = noop_fault()
-    jit_run = jax.jit(prog.run)
-    jax.block_until_ready(jit_run(fault))
-    reps = 3 if smoke else 10
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = jit_run(fault)
-    jax.block_until_ready(out)
-    sec_per_run = (time.perf_counter() - t0) / reps
+        region = REGISTRY[flag_name]()
+        prog = TMR(region, pallas_voters=(jax.default_backend() == "tpu"))
+        # single-run seconds (noop fault traced in so nothing folds away)
+        fault = noop_fault()
+        jit_run = jax.jit(prog.run)
+        jax.block_until_ready(jit_run(fault))
+        reps = 3 if smoke else 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = jit_run(fault)
+        jax.block_until_ready(out)
+        sec_per_run = (time.perf_counter() - t0) / reps
 
-    runner = CampaignRunner(prog, strategy_name="TMR")
-    runner.run(batch, seed=1, batch_size=batch)          # compile + warm
-    res = runner.run(n, seed=42, batch_size=batch)
-    return {
-        "mode": mode,
-        "seconds_per_run": round(sec_per_run, 6),
-        "injections": res.n,
-        "seconds": round(res.seconds, 4),
-        "injections_per_sec": round(res.injections_per_sec, 2),
-        "counts": res.counts,
-    }, np.asarray(res.codes)
+        runner = CampaignRunner(prog, strategy_name="TMR")
+        runner.run(batch, seed=1, batch_size=batch)      # compile + warm
+        res = runner.run(n, seed=42, batch_size=batch)
+        return {
+            "mode": mode,
+            "seconds_per_run": round(sec_per_run, 6),
+            "injections": res.n,
+            "seconds": round(res.seconds, 4),
+            "injections_per_sec": round(res.injections_per_sec, 2),
+            "counts": res.counts,
+        }, np.asarray(res.codes)
+    finally:
+        if prev_mode is None:
+            os.environ.pop("COAST_INDEXING_MODE", None)
+        else:
+            os.environ["COAST_INDEXING_MODE"] = prev_mode
 
 
 def main(argv=None) -> int:
@@ -109,7 +121,15 @@ def main(argv=None) -> int:
                   file=sys.stderr, flush=True)
         identical = bool(np.array_equal(codes["slice"], codes["onehot"]))
         row["codes_bit_identical"] = identical
-        assert identical, f"{flag_name}: classification diverged between modes"
+        if not identical:
+            # A real error, not an assert: the parity invariant must hold
+            # under `python -O` too, and the message should survive into
+            # any wrapper's logs.
+            raise RuntimeError(
+                f"{flag_name}: classification diverged between indexing "
+                f"modes (slice vs onehot) -- "
+                f"{int((codes['slice'] != codes['onehot']).sum())} of "
+                f"{len(codes['slice'])} codes differ")
         row["onehot_speedup_x"] = round(
             row["onehot"]["injections_per_sec"]
             / max(row["slice"]["injections_per_sec"], 1e-9), 3)

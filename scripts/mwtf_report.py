@@ -7,6 +7,9 @@ real campaigns on this chip: for each requested benchmark it runs an
 unprotected baseline campaign and a protected campaign (TMR and DWC),
 measures the protected/unprotected runtime ratio on-device, and emits
 one comparison artifact (committed at artifacts/mwtf_report.json).
+Each campaign's recorded stage breakdown (coast_tpu.obs) is printed to
+stderr and kept in the artifact under ``benchmarks.<name>.stages`` so
+"which stage dominated" is data, not recollection.
 
 Usage: python scripts/mwtf_report.py [-n 20000] [--benchmarks mm,crc16]
        [--out artifacts/mwtf_report.json] [--cpu]
@@ -67,13 +70,15 @@ def main(argv=None) -> int:
         region = REGISTRY[name]()
         progs = {"unprotected": unprotected(region),
                  "DWC": DWC(region), "TMR": TMR(region)}
-        summaries, runtimes = {}, {}
+        summaries, runtimes, stage_blocks = {}, {}, {}
         for strat, prog in progs.items():
             runtimes[strat] = _runtime_s(prog)
             runner = CampaignRunner(prog, strategy_name=strat)
             batch = min(args.batch, args.n)
             runner.run(batch, seed=1, batch_size=batch)       # warm
             res = runner.run(args.n, seed=2026, batch_size=batch)
+            stage_blocks[strat] = {k: round(v, 6)
+                                   for k, v in res.stages.items()}
             summaries[strat] = Summary(
                 name=f"{name}-{strat}", n=res.n, counts=res.counts,
                 # MWTF's runtime ratio must be the *guest* runtime, not
@@ -81,10 +86,19 @@ def main(argv=None) -> int:
                 # time, threadFunctions.py:387-449): use the on-device
                 # seconds per fault-free run.
                 seconds=runtimes[strat] * res.n,
-                mean_steps=float(res.steps.mean()))
+                mean_steps=float(res.steps.mean()),
+                stages=res.stages or None)
+            dominant = max(res.stages, key=res.stages.get) \
+                if res.stages else "?"
+            print(f"#   {name}-{strat} stages: " + " ".join(
+                f"{k}={v:.3f}s" for k, v in sorted(
+                    res.stages.items(), key=lambda kv: -kv[1]))
+                + f"  (dominant: {dominant})",
+                file=sys.stderr, flush=True)
         row = {"campaigns": {s: summaries[s].counts for s in summaries},
                "seconds_per_run": {s: round(runtimes[s], 6)
                                    for s in runtimes},
+               "stages": stage_blocks,
                "injections_per_sec": {}}
         def _j(v):
             # Strict-JSON-safe: infinities (zero protected SDCs) as "inf".

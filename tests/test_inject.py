@@ -229,6 +229,10 @@ def test_native_ndjson_encoder_byte_parity(region, tmp_path, monkeypatch):
     monkeypatch.setattr(logs, "_timestamp",
                         lambda: "2026-01-01 00:00:00.000000")
     logs.write_ndjson(res, runner.mmap, str(tmp_path / "native.json"))
+    # Writers bill res.stages['serialize'] only for telemetry-on
+    # campaigns (this synthetic result never recorded stages and no
+    # ambient recorder is active), so both headers stay byte-identical.
+    assert "serialize" not in res.stages
     monkeypatch.setattr(native, "native_available", lambda: False)
     logs.write_ndjson(res, runner.mmap, str(tmp_path / "python.json"))
     a = (tmp_path / "native.json").read_bytes()
