@@ -52,6 +52,25 @@ _CLASSES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
             "invalid")
 
 
+def mean_steps_or_nan(step_sum: float, step_n: int, n: int,
+                      name: str) -> float:
+    """Mean guest runtime over completed runs, or NaN (with a warning)
+    for a non-empty campaign that completed none.  The single policy
+    point for the zero-clean-runs case: the reference tool crashes here
+    (statistics.mean over an empty list raises StatisticsError, its
+    otherStats path); we report NaN so comparisons and MWTF propagate
+    NaN rather than aborting.  Shared by both log readers and
+    scripts/mwtf_report.py."""
+    if step_n:
+        return step_sum / step_n
+    if n:
+        print(f"warning: {name}: campaign has no completed runs; "
+              "mean runtime (and any MWTF using it) is NaN",
+              file=sys.stderr)
+        return float("nan")
+    return 0.0
+
+
 def classify_run(run: Dict[str, object]) -> str:
     """Reconstruct the outcome class of one logged run.
 
@@ -231,7 +250,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         for stage, sec in (summary.get("stages") or {}).items():
             stages[stage] = stages.get(stage, 0.0) + float(sec)
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
-                   mean_steps=step_sum / step_n if step_n else 0.0,
+                   mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
                    stages=stages or None)
 
 
@@ -255,12 +274,13 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
         if got is None:
             return None
         counts, step_sum, step_n, n = got
+        name = os.path.basename(path.rstrip("/")) or path
         return Summary(
-            name=os.path.basename(path.rstrip("/")) or path,
+            name=name,
             n=n,
             counts={cls: int(counts[i]) for i, cls in enumerate(_CLASSES)},
             seconds=float(head["summary"].get("seconds", 0.0)),
-            mean_steps=step_sum / step_n if step_n else 0.0,
+            mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
             stages=head["summary"].get("stages") or None)
     except OSError:
         return None
@@ -291,7 +311,14 @@ def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
     seconds-per-injection ratio and falls back to the step ratio when a
     summary carries no timing.
     """
+    import math
+
     def _ratio(a: float, b: float) -> float:
+        if math.isnan(a) or math.isnan(b):
+            # A campaign with no completed runs has no mean runtime: the
+            # comparison is undefined, not infinite (the reference's
+            # StatisticsError path, reported as NaN upstream).
+            return float("nan")
         if b == 0.0:
             return float("inf") if a > 0 else 1.0
         return a / b
@@ -304,7 +331,10 @@ def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
         runtime_x = steps_x
     error_rate_x = _ratio(new.error_rate, base.error_rate)
     improvement = _ratio(base.error_rate, new.error_rate)
-    mwtf = improvement / runtime_x if runtime_x > 0 else float("inf")
+    if math.isnan(runtime_x) or math.isnan(improvement):
+        mwtf = float("nan")
+    else:
+        mwtf = improvement / runtime_x if runtime_x > 0 else float("inf")
     return {
         "runtime_x": runtime_x,
         "steps_x": steps_x,

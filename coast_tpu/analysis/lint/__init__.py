@@ -1,0 +1,71 @@
+"""Replication-integrity linter: does the protected program still carry
+its redundancy?
+
+Two levels (ISSUE: the real meaning of the reference's
+``-noCloneOpsCheck`` post-pass check):
+
+  * :mod:`provenance` -- static jaxpr lane-provenance rules over the
+    traced protected step (lane-collapse, SPOF, voter coverage vs the
+    ProtectionConfig, unreplicated imports);
+  * :mod:`survival` -- post-XLA checks over the *compiled* step
+    (voter ops present in optimized HLO, semantic lane-perturbation
+    probe, segmented CSE fingerprint).
+
+Entry points::
+
+    from coast_tpu.analysis import lint
+    report = lint.lint_program(prog)              # both levels
+    report = lint.lint_program(prog, survival=False)   # static only
+    lint.check(prog)        # raise ReplicationLintError on errors
+
+CLI: ``python -m coast_tpu.analysis.lint -TMR matrixMultiply crc16``
+(see __main__.py; ``--all`` sweeps the benchmark REGISTRY).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from coast_tpu.analysis.lint.findings import (Finding, LintReport,
+                                              ReplicationLintError,
+                                              load_baseline, merge_reports)
+from coast_tpu.analysis.lint.provenance import (expected_sync_classes,
+                                                lint_provenance, trace_step)
+from coast_tpu.analysis.lint.survival import lint_survival
+
+__all__ = ["Finding", "LintReport", "ReplicationLintError", "check",
+           "expected_sync_classes", "lint_program", "lint_provenance",
+           "lint_survival", "load_baseline", "merge_reports", "trace_step"]
+
+
+def lint_program(prog, provenance: bool = True, survival: bool = True,
+                 strategy: Optional[str] = None,
+                 baseline: Optional[Set[str]] = None,
+                 closed=None) -> LintReport:
+    """Run the requested lint levels over a ProtectedProgram.
+    ``closed`` forwards an already-traced step jaxpr (callers that also
+    dump the jaxpr, e.g. opt, trace once and share)."""
+    name = strategy or f"N={prog.cfg.num_clones}"
+    report = LintReport(benchmark=prog.region.name, strategy=name)
+    # One trace shared by both passes (flagship steps take seconds to
+    # trace; the survival pass only needs the jaxpr for vote counting).
+    if closed is None and (provenance or survival):
+        closed = trace_step(prog)
+    if provenance:
+        lint_provenance(prog, report, closed=closed)
+    if survival:
+        lint_survival(prog, report, closed=closed)
+    if baseline:
+        report.apply_baseline(baseline)
+    return report
+
+
+def check(prog, provenance: bool = True, survival: bool = True,
+          baseline: Optional[Set[str]] = None) -> LintReport:
+    """Gate: lint and raise :class:`ReplicationLintError` on any
+    unsuppressed error finding (the refuse-to-emit analogue)."""
+    report = lint_program(prog, provenance=provenance, survival=survival,
+                          baseline=baseline)
+    if not report.ok:
+        raise ReplicationLintError(report)
+    return report

@@ -1,0 +1,138 @@
+"""``python -m coast_tpu.analysis.lint``: replication-integrity linter CLI.
+
+Takes the same single-dash protection flags as ``python -m coast_tpu.opt``
+(one parser -- opt's -- so the semantics cannot drift) plus linter
+options::
+
+    python -m coast_tpu.analysis.lint -TMR matrixMultiply crc16
+    python -m coast_tpu.analysis.lint -DWC -s sha256
+    python -m coast_tpu.analysis.lint -TMR --all --json artifacts/lint.json
+    python -m coast_tpu.analysis.lint -TMR crc16 --no-survival
+    python -m coast_tpu.analysis.lint -TMR crc16 --baseline lint_baseline.json
+    python -m coast_tpu.analysis.lint -TMR crc16 --write-baseline b.json
+
+Exit status: 0 when every report is error-free (after baseline
+suppression), 1 otherwise, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+
+    json_out = None
+    baseline_path = None
+    write_baseline = None
+    survival = True
+    sweep_all = False
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg in ("--json", "--baseline", "--write-baseline"):
+            i += 1
+            if i >= len(argv):
+                print(f"ERROR: {arg} needs a path", file=sys.stderr)
+                return 2
+            if arg == "--json":
+                json_out = argv[i]
+            elif arg == "--baseline":
+                baseline_path = argv[i]
+            else:
+                write_baseline = argv[i]
+        elif arg == "--no-survival":
+            survival = False
+        elif arg == "--all":
+            sweep_all = True
+        elif arg.startswith("--"):
+            print(f"ERROR: unknown flag {arg}", file=sys.stderr)
+            return 2
+        else:
+            rest.append(arg)
+        i += 1
+
+    from coast_tpu.opt import UsageError, build_overrides, parse_argv
+    try:
+        flags, positional = parse_argv(rest)
+        overrides = build_overrides(flags)
+    except UsageError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The axon site hook registers its PJRT plugin and
+        # *programmatically* selects jax_platforms="axon,cpu" at
+        # interpreter start, overriding the env var; honor the user's
+        # CPU request explicitly (same idiom as opt.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import DWC, TMR
+    from coast_tpu.analysis import lint
+    from coast_tpu.models import REGISTRY, resolve_region
+    from coast_tpu.passes.verification import SoRViolation
+
+    strategies = [s for s in ("TMR", "DWC") if flags.get(s)]
+    if len(strategies) > 1:
+        print("ERROR: choose one of -TMR/-DWC", file=sys.stderr)
+        return 2
+    strategy = strategies[0] if strategies else "TMR"
+    make = {"TMR": TMR, "DWC": DWC}[strategy]
+
+    benches = sorted(REGISTRY) if sweep_all else positional
+    if not benches:
+        print(__doc__, file=sys.stderr)
+        print(f"benchmarks: {', '.join(sorted(REGISTRY))}", file=sys.stderr)
+        return 2
+    unknown = [b for b in benches
+               if b not in REGISTRY and not b.endswith(".c")]
+    if unknown:
+        print(f"ERROR: unknown benchmark(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    base = None
+    if baseline_path is not None:
+        try:
+            base = lint.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+
+    reports = []
+    for bench in benches:
+        try:
+            region = resolve_region(bench)
+            prog = make(region, **overrides)
+        except SoRViolation as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        rep = lint.lint_program(prog, survival=survival,
+                                strategy=strategy, baseline=base)
+        reports.append(rep)
+        print(rep.format())
+
+    if write_baseline is not None:
+        from coast_tpu.analysis.lint.findings import write_baseline_set
+        write_baseline_set(reports, write_baseline)
+        print(f"baseline written: {write_baseline}", file=sys.stderr)
+    if json_out is not None:
+        doc = {"strategy": strategy,
+               "survival": survival,
+               "reports": [r.to_dict() for r in reports]}
+        os.makedirs(os.path.dirname(json_out) or ".", exist_ok=True)
+        with open(json_out, "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+
+    return 0 if all(r.ok for r in reports) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,599 @@
+"""Jaxpr lane-provenance pass: static replication-integrity rules.
+
+The engine's replicas live as a leading lane axis on every replicated
+state leaf; redundancy is intact exactly while every value derived from
+replicated state keeps that axis until a *sanctioned* voter collapses it
+(ops/voters.py tags each voter's lane input ``coast:voter`` /
+``coast:sync:<class>:<leaf>``).  This pass traces the protected ``step``
+to a jaxpr and propagates a replicated/shared lattice over its equation
+vars -- the TPU-native analogue of the reference's post-pass cloning
+check (``verifyCloningSuccess``, cloning.cpp:2305-2376, gated by
+``-noCloneOpsCheck``):
+
+  * **lane-collapse** (error): a reduction (reduce_*/dot contraction)
+    merges the lane axis outside a sanctioned voter -- e.g. an averaging
+    ``sum(lanes)/3`` that silently replaces majority voting.
+  * **spof** (error/note): a single lane is extracted from live replicated
+    dataflow outside a voter.  Extracting *every* lane of a source (the
+    segmented scheduler's fan-out) is sanctioned; a ``coast:spof:<fn>``
+    tag from the ``skipLibCalls``/``cloneAfterCall`` wrappers downgrades
+    the finding to a note -- the SPOF report's accepted allowlist.
+  * **voter-coverage** (error/warning): the classified vote tags found in
+    the live jaxpr, compared against an *independently re-derived*
+    expectation from the ``ProtectionConfig`` + region dataflow roles --
+    ``-noStoreDataSync`` must remove exactly the store-data votes, a
+    dropped terminator vote is an error even though the program still
+    runs.
+  * **unreplicated-import** (error): a mutable shared leaf is consumed by
+    replicated dataflow while its own committed value never passed
+    through a voter -- corrupt unprotected state imported identically
+    into every replica (the NotProtected->Protected rule of
+    verification.cpp:686-718, checked here *after* transformation).
+
+Laned-ness propagation is structural: slice/squeeze/reduce/transpose/
+broadcast/reshape/dot_general/control-flow primitives are modelled
+exactly; any other primitive keeps the lane axis when the output shape
+retains it and otherwise degrades the value to *unknown*, which never
+produces findings -- the pass prefers false negatives through exotic ops
+over a noisy report.  Findings are only emitted for equations that are
+live (reach the step's outputs); dead collapses are XLA-DCE'd and harm
+nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.extend.core import Literal
+
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO
+from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
+from coast_tpu.analysis.lint.findings import LintReport
+
+# Sync classes with an independently derivable expectation; other classes
+# (call_boundary, cfcss, boundary, view) are observed and reported but
+# carry no per-leaf expectation from the config alone.
+COVERAGE_CLASSES = ("load_addr", "store_data", "ctrl", "stack",
+                    "sor_crossing")
+
+_SHARED, _LANED, _UNKNOWN = "shared", "laned", "unknown"
+
+
+@dataclasses.dataclass(frozen=True)
+class _Val:
+    """Lattice value of one jaxpr var."""
+
+    status: str = _SHARED
+    axis: int = 0                  # lane axis position when status == laned
+    sanct: bool = False            # laned value inside a sanctioned voter
+    voted: bool = False            # some upstream vote in the provenance
+    deps: FrozenSet[str] = frozenset()
+
+    def relaned(self, axis: int) -> "_Val":
+        return dataclasses.replace(self, status=_LANED, axis=axis)
+
+    def collapsed(self) -> "_Val":
+        return dataclasses.replace(self, status=_SHARED, axis=0)
+
+
+def _join(a: _Val, b: _Val) -> _Val:
+    deps = a.deps | b.deps
+    voted = a.voted or b.voted
+    if a.status == b.status == _LANED and a.axis == b.axis:
+        return _Val(_LANED, a.axis, a.sanct and b.sanct, voted, deps)
+    if a.status == b.status == _SHARED:
+        return _Val(_SHARED, 0, False, voted, deps)
+    return _Val(_UNKNOWN, 0, False, voted, deps)
+
+
+_REDUCE_PRIMS = ("reduce_sum", "reduce_max", "reduce_min", "reduce_and",
+                 "reduce_or", "reduce_prod", "reduce_xor", "argmax",
+                 "argmin")
+
+
+class _Walker:
+    """Forward lattice walk over a (recursively nested) jaxpr."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.env: Dict[object, _Val] = {}
+        # Collapse candidates keyed by id(eqn) (deduped across loop
+        # fixpoint passes): eqn -> record dict.
+        self.candidates: Dict[int, Dict[str, object]] = {}
+        # Observed tag eqns: id(eqn) -> tag string.
+        self.tags: Dict[int, str] = {}
+
+    # -- var access -----------------------------------------------------
+    def val(self, v) -> _Val:
+        if isinstance(v, Literal):
+            return _Val()
+        return self.env.get(v, _Val())
+
+    def _set(self, v, val: _Val) -> None:
+        old = self.env.get(v)
+        self.env[v] = val if old is None else _join(old, val)
+
+    def seed(self, inner_vars, vals: Sequence[_Val]) -> None:
+        for iv, val in zip(inner_vars, vals):
+            self._set(iv, val)
+
+    # -- candidate recording --------------------------------------------
+    def _candidate(self, eqn, kind: str, src, lane: Optional[int],
+                   deps: FrozenSet[str]) -> None:
+        self.candidates[id(eqn)] = {
+            "eqn": eqn, "kind": kind, "prim": eqn.primitive.name,
+            "src": src, "lane": lane, "deps": deps}
+
+    # -- one equation ---------------------------------------------------
+    def _eqn_outs(self, eqn, ins: List[_Val]) -> List[_Val]:
+        prim = eqn.primitive.name
+        params = eqn.params
+        n = self.n
+        deps = frozenset().union(*(v.deps for v in ins)) if ins \
+            else frozenset()
+        voted = any(v.voted for v in ins)
+        laned_ins = [v for v in ins if v.status == _LANED]
+        unknown = any(v.status == _UNKNOWN for v in ins)
+
+        def out_shapes():
+            return [getattr(ov.aval, "shape", ()) for ov in eqn.outvars]
+
+        if prim == "name":
+            tag = str(params.get("name", ""))
+            v = ins[0]
+            if tag.startswith((TAG_VOTER, TAG_SYNC, TAG_SPOF, TAG_VIEW)):
+                self.tags[id(eqn)] = tag
+                v = dataclasses.replace(v, sanct=True, voted=True)
+            return [v]
+
+        if unknown:
+            return [_Val(_UNKNOWN, 0, False, voted, deps)
+                    for _ in eqn.outvars]
+        if not laned_ins:
+            return [_Val(_SHARED, 0, False, voted, deps)
+                    for _ in eqn.outvars]
+        a = laned_ins[0].axis
+        sanct = all(v.sanct for v in laned_ins)
+        src = next(iv for iv, v in zip(eqn.invars, ins)
+                   if v.status == _LANED)
+
+        def laned_out(axis: int) -> _Val:
+            return _Val(_LANED, axis, sanct, voted, deps)
+
+        def unknown_out() -> _Val:
+            return _Val(_UNKNOWN, 0, False, voted, deps)
+
+        # -- structural primitives over the lane axis --
+        if prim == "slice":
+            start = params["start_indices"][a]
+            limit = params["limit_indices"][a]
+            strides = params["strides"]
+            if strides is not None and strides[a] != 1:
+                # A strided read of the lane axis keeps only some
+                # replicas; that is not full replication -- degrade
+                # rather than claim laned.
+                return [unknown_out()]
+            if limit - start >= n:
+                return [laned_out(a)]
+            if limit - start == 1:
+                if not sanct:
+                    self._candidate(eqn, "spof", src, int(start), deps)
+                return [_Val(_SHARED, 0, sanct, voted, deps).collapsed()]
+            return [unknown_out()]
+        if prim == "dynamic_slice":
+            if params["slice_sizes"][a] >= n:
+                return [laned_out(a)]
+            if params["slice_sizes"][a] == 1:
+                if not sanct:
+                    self._candidate(eqn, "spof", src, None, deps)
+                return [_Val(_SHARED, 0, sanct, voted, deps)]
+            return [unknown_out()]
+        if prim == "squeeze":
+            dims = params["dimensions"]
+            if a in dims:
+                # Only a size-1 axis can be squeezed; a laned axis has
+                # size n >= 2, so this cannot be the lane axis anymore --
+                # degrade rather than guess.
+                return [unknown_out()]
+            new_a = a - sum(1 for d in dims if d < a)
+            return [laned_out(new_a)]
+        if prim in _REDUCE_PRIMS:
+            axes = params["axes"]
+            if a in axes:
+                if not sanct:
+                    self._candidate(eqn, "lane-collapse", src, None, deps)
+                return [_Val(_SHARED, 0, sanct, voted, deps)]
+            new_a = a - sum(1 for d in axes if d < a)
+            return [laned_out(new_a)] * len(eqn.outvars)
+        if prim == "transpose":
+            perm = params["permutation"]
+            return [laned_out(list(perm).index(a))]
+        if prim == "broadcast_in_dim":
+            bdims = params["broadcast_dimensions"]
+            return [laned_out(bdims[a])]
+        if prim == "reshape":
+            in_shape = getattr(eqn.invars[0].aval, "shape", None)
+            new_sizes = params["new_sizes"]
+            if (in_shape is not None and a < len(new_sizes)
+                    and tuple(in_shape[:a + 1]) == tuple(
+                        new_sizes[:a + 1])):
+                return [laned_out(a)]
+            return [unknown_out()]
+        if prim == "dot_general":
+            (cl, cr), (bl, br) = params["dimension_numbers"]
+            outs = []
+            lhs, rhs = ins[0], ins[1]
+            for side, (c, b) in ((lhs, (cl, bl)), (rhs, (cr, br))):
+                if side.status != _LANED:
+                    continue
+                ax = side.axis
+                if ax in c:
+                    if not side.sanct:
+                        self._candidate(eqn, "lane-collapse",
+                                        eqn.invars[0 if side is lhs else 1],
+                                        None, deps)
+                    outs.append(_Val(_SHARED, 0, side.sanct, voted, deps))
+                elif ax in b:
+                    outs.append(laned_out(list(b).index(ax)))
+                else:
+                    # Free dim: batch dims first, then lhs free, then rhs
+                    # free (dot_general output layout).
+                    if side is lhs:
+                        pos = len(bl) + sum(
+                            1 for d in range(ax)
+                            if d not in bl and d not in cl)
+                    else:
+                        lhs_shape = getattr(eqn.invars[0].aval, "shape", ())
+                        lhs_free = len(lhs_shape) - len(bl) - len(cl)
+                        pos = len(bl) + lhs_free + sum(
+                            1 for d in range(ax)
+                            if d not in br and d not in cr)
+                    outs.append(laned_out(pos))
+            out = outs[0]
+            for o in outs[1:]:
+                out = _join(out, o)
+            return [out]
+
+        # -- control flow / nested jaxprs --
+        if prim == "cond" and "branches" in params:
+            per_branch = []
+            for br in params["branches"]:
+                self.seed(br.jaxpr.invars, ins[1:])
+                per_branch.append(self.walk(br.jaxpr))
+            outs = []
+            for i in range(len(eqn.outvars)):
+                o = per_branch[0][i]
+                for b in per_branch[1:]:
+                    o = _join(o, b[i])
+                outs.append(dataclasses.replace(
+                    o, deps=o.deps | ins[0].deps,
+                    voted=o.voted or voted))
+            return outs
+        if prim == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            cj, bj = params["cond_jaxpr"].jaxpr, params["body_jaxpr"].jaxpr
+            carry = list(ins[cn + bn:])
+            for _ in range(len(carry) + 2):
+                self.seed(cj.invars, ins[:cn] + carry)
+                self.walk(cj)
+                self.seed(bj.invars, ins[cn:cn + bn] + carry)
+                new_carry = self.walk(bj)
+                joined = [_join(c, nc) for c, nc in zip(carry, new_carry)]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry
+        if prim == "scan":
+            sub = params["jaxpr"].jaxpr
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts, carry = list(ins[:nc]), list(ins[nc:nc + ncar])
+            xs = []
+            for v in ins[nc + ncar:]:
+                if v.status == _LANED:
+                    # Scanning OVER the lane axis would be a collapse we
+                    # cannot attribute; anything else loses one leading
+                    # axis.
+                    xs.append(dataclasses.replace(v, status=_UNKNOWN)
+                              if v.axis == 0 else v.relaned(v.axis - 1))
+                else:
+                    xs.append(v)
+            outs = None
+            for _ in range(max(ncar, 1) + 2):
+                self.seed(sub.invars, consts + carry + xs)
+                outs = self.walk(sub)
+                joined = [_join(c, nc_) for c, nc_ in
+                          zip(carry, outs[:ncar])]
+                if joined == carry:
+                    break
+                carry = joined
+            ys = []
+            for v in outs[ncar:]:
+                ys.append(v.relaned(v.axis + 1) if v.status == _LANED
+                          else v)
+            return carry + ys
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in params:
+                sub = params[key]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                self.seed(sub.invars, ins)
+                return self.walk(sub)
+
+        # -- generic fallback: lane axis survives iff the output keeps a
+        #    dim of size n at the same position; otherwise degrade --
+        outs = []
+        for shape in out_shapes():
+            if len(shape) > a and shape[a] == n:
+                outs.append(laned_out(a))
+            else:
+                outs.append(unknown_out())
+        return outs
+
+    def walk(self, jaxpr) -> List[_Val]:
+        for eqn in jaxpr.eqns:
+            ins = [self.val(v) for v in eqn.invars]
+            outs = self._eqn_outs(eqn, ins)
+            if len(outs) != len(eqn.outvars):
+                deps = frozenset().union(*(v.deps for v in ins)) \
+                    if ins else frozenset()
+                outs = [_Val(_UNKNOWN if any(
+                    v.status != _SHARED for v in ins) else _SHARED,
+                    0, False, any(v.voted for v in ins), deps)
+                    for _ in eqn.outvars]
+            for v, val in zip(eqn.outvars, outs):
+                self._set(v, val)
+        return [self.val(v) for v in jaxpr.outvars]
+
+
+# -- liveness ---------------------------------------------------------------
+
+def _mark_all(jaxpr, live: Set[int]) -> None:
+    for eqn in jaxpr.eqns:
+        live.add(id(eqn))
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                sub = v.jaxpr if hasattr(v.jaxpr, "eqns") else v
+                _mark_all(sub, live)
+            elif isinstance(v, (list, tuple)):
+                for b in v:
+                    if hasattr(b, "jaxpr"):
+                        _mark_all(b.jaxpr, live)
+
+
+def _live_eqns(jaxpr, live_out, live: Set[int]) -> None:
+    """Backward liveness: mark eqns whose outputs reach ``live_out``.
+    Precise positional mapping into pjit/cond sub-jaxprs; loops (while/
+    scan) conservatively keep their whole body live."""
+    live_vars = set(v for v in live_out if not isinstance(v, Literal))
+    for eqn in reversed(jaxpr.eqns):
+        if not any(ov in live_vars for ov in eqn.outvars):
+            continue
+        live.add(id(eqn))
+        prim = eqn.primitive.name
+        params = eqn.params
+        for v in eqn.invars:
+            if not isinstance(v, Literal):
+                live_vars.add(v)
+        if prim == "cond" and "branches" in params:
+            for br in params["branches"]:
+                sub_live = [br.jaxpr.outvars[i]
+                            for i, ov in enumerate(eqn.outvars)
+                            if ov in live_vars]
+                _live_eqns(br.jaxpr, sub_live, live)
+        elif prim in ("while", "scan"):
+            for key in ("jaxpr", "cond_jaxpr", "body_jaxpr"):
+                if key in params:
+                    _mark_all(params[key].jaxpr, live)
+        else:
+            for key in ("jaxpr", "call_jaxpr"):
+                if key in params:
+                    sub = params[key]
+                    sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                    sub_live = [sub.outvars[i]
+                                for i, ov in enumerate(eqn.outvars)
+                                if ov in live_vars]
+                    _live_eqns(sub, sub_live, live)
+
+
+# -- expected voter coverage -------------------------------------------------
+
+def expected_sync_classes(region, cfg) -> Dict[str, Set[str]]:
+    """Per-leaf expected vote classes, re-derived from the config and the
+    region's dataflow roles -- deliberately NOT read from the engine's
+    ``step_sync``/``pre_sync`` tables, so an engine bug in the sync-point
+    policy shows up as a coverage mismatch."""
+    from coast_tpu.passes.verification import analyze
+    flow = analyze(region)
+    replicated = {name: cfg.resolve_xmr(region, name)
+                  for name in region.spec}
+    expected: Dict[str, Set[str]] = {name: set() for name in region.spec}
+    if cfg.num_clones <= 1 or not any(replicated.values()):
+        return expected
+    for name, spec in region.spec.items():
+        if replicated[name]:
+            if cfg.protect_stack and spec.stack:
+                expected[name].add("stack")
+            if spec.kind == KIND_CTRL:
+                in_load = name in flow.load_addr
+                in_store = name in flow.store_addr
+                if in_load and not cfg.no_load_sync:
+                    expected[name].add("load_addr")
+                if ((in_store and not cfg.no_store_addr_sync)
+                        or not (in_load or in_store)):
+                    if not (cfg.protect_stack and spec.stack):
+                        expected[name].add("ctrl")
+            elif spec.kind == KIND_MEM:
+                if (not cfg.no_store_data_sync and name in flow.written
+                        and not (cfg.protect_stack and spec.stack)):
+                    expected[name].add("store_data")
+        else:
+            if spec.kind != KIND_RO and name in flow.written:
+                expected[name].add("sor_crossing")
+    return expected
+
+
+def _parse_sync_tag(tag: str) -> Optional[Tuple[str, str]]:
+    if not tag.startswith(TAG_SYNC):
+        return None
+    rest = tag[len(TAG_SYNC):]
+    klass, _, leaf = rest.partition(":")
+    return klass, leaf
+
+
+# -- the pass ----------------------------------------------------------------
+
+def trace_step(prog):
+    """The protected step's ClosedJaxpr (shared by the provenance and
+    survival passes so a full lint traces the step only once)."""
+    pstate, flags = jax.eval_shape(prog.init_pstate)
+    return jax.make_jaxpr(prog.step)(pstate, flags, jnp.int32(0))
+
+
+def lint_provenance(prog, report: Optional[LintReport] = None,
+                    closed=None) -> LintReport:
+    """Run the lane-provenance rules over ``prog.step``'s jaxpr."""
+    cfg = prog.cfg
+    region = prog.region
+    if report is None:
+        report = LintReport(benchmark=region.name,
+                            strategy=f"N={cfg.num_clones}")
+    report.passes_run.append("provenance")
+    n = cfg.num_clones
+
+    pstate, flags = jax.eval_shape(prog.init_pstate)
+    if closed is None:
+        closed = trace_step(prog)
+    jaxpr = closed.jaxpr
+
+    state_names = sorted(pstate)
+    flag_names = sorted(flags)
+    assert len(jaxpr.invars) == len(state_names) + len(flag_names) + 1, (
+        len(jaxpr.invars), len(state_names), len(flag_names))
+
+    if n <= 1 or not any(prog.replicated.get(k) for k in pstate):
+        # Nothing is replicated: no lanes to lose.  (The reference's
+        # check likewise has nothing to verify on an empty clone set.)
+        return report
+
+    walker = _Walker(n)
+    for name, var in zip(state_names, jaxpr.invars):
+        if prog.replicated.get(name):
+            walker.env[var] = _Val(_LANED, 0, False, False,
+                                   frozenset({name}))
+        else:
+            walker.env[var] = _Val(_SHARED, 0, False, False,
+                                   frozenset({name}))
+    # Flags and t carry no leaf provenance.
+    out_vals = walker.walk(jaxpr)
+
+    live: Set[int] = set()
+    _live_eqns(jaxpr, list(jaxpr.outvars), live)
+
+    # -- lane-collapse / spof findings ----------------------------------
+    live_cands = [c for k, c in walker.candidates.items() if k in live]
+    by_src: Dict[int, List[Dict[str, object]]] = {}
+    for c in live_cands:
+        by_src.setdefault(id(c["src"]), []).append(c)
+    for cands in by_src.values():
+        lanes_seen = {c["lane"] for c in cands}
+        if (all(c["kind"] == "spof" for c in cands)
+                and None not in lanes_seen
+                and lanes_seen == set(range(n))):
+            # Every lane extracted from this source: the segmented
+            # scheduler's fan-out, each replica consumed exactly once.
+            continue
+        for c in cands:
+            leaves = "+".join(sorted(c["deps"])) or "?"
+            if c["kind"] == "spof":
+                lane = c["lane"]
+                where = f"lane {lane}" if lane is not None \
+                    else "a traced lane index"
+                report.add(
+                    "spof", "error", f"eqn:{c['prim']}:{leaves}",
+                    f"single lane ({where}) extracted from live "
+                    f"replicated dataflow of {leaves} outside a "
+                    "sanctioned voter: one corruptible copy now stands "
+                    "for all replicas")
+            else:
+                report.add(
+                    "lane-collapse", "error",
+                    f"eqn:{c['prim']}:{leaves}",
+                    f"{c['prim']} merges the lane axis of {leaves} "
+                    "outside a sanctioned voter: replicas are combined "
+                    "without majority voting")
+
+    # -- observed tags (live only) --------------------------------------
+    live_tags = [t for k, t in walker.tags.items() if k in live]
+    observed: Dict[str, Set[str]] = {}
+    spof_tags: Set[str] = set()
+    for tag in live_tags:
+        parsed = _parse_sync_tag(tag)
+        if parsed is not None:
+            klass, leaf = parsed
+            observed.setdefault(leaf, set()).add(klass)
+        elif tag.startswith(TAG_SPOF):
+            spof_tags.add(tag[len(TAG_SPOF):])
+
+    # -- SPOF allowlist report ------------------------------------------
+    allow = set(cfg.skip_lib_calls) | set(cfg.clone_after_call_fns)
+    for fn in sorted(spof_tags):
+        if fn in allow:
+            report.add(
+                "spof", "note", f"fn:{fn}",
+                f"accepted single point of failure: '{fn}' runs once on "
+                "lane 0's arguments (skipLibCalls/cloneAfterCall "
+                "allowlist)")
+        else:
+            report.add(
+                "spof", "error", f"fn:{fn}",
+                f"single-lane call to '{fn}' is not in the skipLibCalls/"
+                "cloneAfterCall allowlist")
+
+    # -- voter coverage vs. the config ----------------------------------
+    expected = expected_sync_classes(region, cfg)
+    for name in sorted(region.spec):
+        want = expected.get(name, set())
+        have = {k for k in observed.get(name, set())
+                if k in COVERAGE_CLASSES}
+        for klass in sorted(want - have):
+            report.add(
+                "voter-coverage", "error", f"leaf:{name}",
+                f"expected a {klass} vote for leaf '{name}' under this "
+                "ProtectionConfig, but the protected step contains none "
+                "(the sync point was dropped or compiled around)")
+        for klass in sorted(have - want):
+            report.add(
+                "voter-coverage", "warning", f"leaf:{name}",
+                f"unexpected {klass} vote for leaf '{name}': the "
+                "ProtectionConfig does not call for this sync point")
+
+    # -- unreplicated-import --------------------------------------------
+    out_by_name: Dict[str, _Val] = {}
+    outvar_by_name: Dict[str, object] = {}
+    invar_by_name = dict(zip(state_names, jaxpr.invars))
+    for name, var, val in zip(state_names, jaxpr.outvars, out_vals):
+        out_by_name[name] = val
+        outvar_by_name[name] = var
+    for name in sorted(region.spec):
+        if prog.replicated.get(name):
+            continue
+        spec = region.spec[name]
+        if spec.kind == KIND_RO:
+            continue
+        outvar = outvar_by_name.get(name)
+        written = not (outvar is invar_by_name.get(name))
+        if not written:
+            continue
+        consumers = [r for r in sorted(region.spec)
+                     if prog.replicated.get(r)
+                     and name in out_by_name.get(r, _Val()).deps]
+        if consumers and not out_by_name[name].voted:
+            report.add(
+                "unreplicated-import", "error", f"leaf:{name}",
+                f"mutable shared leaf '{name}' feeds replicated leaves "
+                f"({', '.join(consumers)}) but its committed value never "
+                "passes a voter: corrupt unprotected state would be "
+                "imported identically into every replica")
+    return report

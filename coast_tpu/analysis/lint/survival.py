@@ -1,0 +1,277 @@
+"""Post-XLA redundancy-survival pass.
+
+The provenance pass proves the *traced* program keeps its replicas; this
+pass checks that the redundancy survived **compilation** -- the hazard
+named in ops/bitflip.py: XLA may CSE replicated computations into one,
+quietly turning TMR into a single point of failure while every test still
+passes (the reference's motivation for running verifyCloningSuccess on
+the transformed module, not the source).
+
+Three checks over the *compiled* protected step:
+
+  * **hlo-voter-missing** (error): the optimized HLO must still contain
+    at least one ``select`` (TMR majority) / ``compare`` (both modes) per
+    vote the traced jaxpr carried.  A voter folded away by the compiler
+    is a silent loss of repair/detection.
+  * **lane-dedup** (error): a semantic probe of the compiled executable.
+    For each probed replicated leaf and each lane, one input bit is
+    flipped and the step re-run: a redundant program must respond --
+    either the flip survives into the committed state (bitwise diff) or
+    a voter observes the divergence (TMR correction count / DWC fault
+    flag).  A lane whose perturbation provokes *no* response at any probe
+    site is dead weight: its replica was deduplicated (or never
+    distinct), and an injection there can neither be corrected nor
+    detected.  This runs the actual XLA executable, so it catches
+    compiler-introduced sharing the jaxpr cannot show.
+  * **segment-cse** (error, segmented ``-s`` mode only): an opcode
+    fingerprint of the optimized HLO.  The unrolled per-lane bodies must
+    contribute ~``num_clones`` times the arithmetic of a single lane
+    (lowered from the bare region step); a ratio collapsing toward 1x
+    means the lanes were deduplicated into one fingerprint.
+
+Probe-site selection is honest about observability: a leaf whose step
+output does not depend on its own previous value (fully rewritten each
+step) cannot show a one-step response and is skipped with a note; voted
+TMR leaves need ``count_errors`` for the correction counter to witness
+the repair.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.analysis.lint.findings import LintReport
+
+# Arithmetic opcodes counted by the segmented fingerprint.  Deliberately
+# excludes select/compare/and/or/not (voter machinery) and data movement
+# (broadcast/reshape/copy), which differ between the protected and bare
+# lowering.
+_SIG_OPS = ("add", "subtract", "multiply", "divide", "remainder", "xor",
+            "shift-left", "shift-right-logical", "shift-right-arithmetic",
+            "dot", "maximum", "minimum", "power")
+_SIG_FLOOR = 8          # fingerprint is meaningless on near-empty steps
+_MAX_PROBE_LEAVES = 4   # per-program probe budget (lanes x sites each)
+
+
+def _count_ops(hlo: str, ops: Tuple[str, ...]) -> Dict[str, int]:
+    counts = {op: 0 for op in ops}
+    # HLO text: "%name = type op(operands...)" (also inside fusion bodies).
+    for m in re.finditer(r"= \S+ ([a-z0-9-]+)\(", hlo):
+        op = m.group(1)
+        if op in counts:
+            counts[op] += 1
+    return counts
+
+
+def _lower_hlo(fn, *args) -> str:
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def _count_votes(prog, closed=None) -> int:
+    """Number of classified vote sites in the traced step (live or not:
+    XLA decides liveness itself; the sync tags are inserted one per vote
+    call)."""
+    from coast_tpu.analysis.lint import provenance as P
+    if closed is None:
+        closed = P.trace_step(prog)
+    n = 0
+
+    def walk(jaxpr):
+        nonlocal n
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "name":
+                tag = str(eqn.params.get("name", ""))
+                if P._parse_sync_tag(tag) is not None:
+                    n += 1
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr if hasattr(v.jaxpr, "eqns") else v)
+                elif isinstance(v, (list, tuple)):
+                    for b in v:
+                        if hasattr(b, "jaxpr"):
+                            walk(b.jaxpr)
+
+    walk(closed.jaxpr)
+    return n
+
+
+# Bits probed per site: a flip must SURVIVE the program's own arithmetic
+# to be observable through an unvoted leaf, and real programs mask high
+# bits (crc16's ``& 0xFFFF``) or low bits (flag words) freely -- so probe
+# the bottom, middle, and top of the word and accept any responder.
+_PROBE_BITS = (0, 15, 31)
+# Successive program states probed (phase-gated leaves respond only in
+# the micro-step that consumes them; 3 covers every 2-phase region with
+# one spare).
+_PROBE_STATES = 3
+
+
+def _flip_bit(arr: np.ndarray, lane: int, word: int, bit: int) -> np.ndarray:
+    """XOR one bit of flat 32-bit word ``word`` of ``lane``."""
+    out = np.array(arr)
+    flat = out.reshape(out.shape[0], -1).view(np.uint32)
+    flat[lane, word] ^= np.uint32(1 << bit)
+    return out
+
+
+def _tree_bytes(tree) -> bytes:
+    return b"".join(np.asarray(leaf).tobytes()
+                    for leaf in jax.tree.leaves(tree))
+
+
+def _probe_leaves(prog) -> Tuple[List[str], List[str]]:
+    """(probed, skipped-with-reason) leaf selections for the lane probe."""
+    from coast_tpu.passes.verification import analyze
+    flow = analyze(prog.region)
+    probed: List[str] = []
+    skipped: List[str] = []
+    for name in prog.leaf_order:
+        if name not in prog.region.spec:
+            continue                     # synthetic (CFCSS) leaves
+        if not prog.replicated.get(name):
+            continue
+        self_dep = name in flow.deps.get(name, frozenset())
+        passthrough = name not in flow.written
+        if not (self_dep or passthrough):
+            skipped.append(
+                f"{name}: fully rewritten each step, no one-step response "
+                "channel")
+            continue
+        voted = prog.step_sync.get(name) or prog.pre_sync.get(name)
+        if (voted and prog.cfg.num_clones == 3
+                and not prog.cfg.count_errors):
+            skipped.append(
+                f"{name}: voted leaf but -countErrors is off, repair "
+                "leaves no witness")
+            continue
+        probed.append(name)
+    for name in probed[_MAX_PROBE_LEAVES:]:
+        # Honest coverage: a budget-dropped leaf must say so -- a clean
+        # report that silently skipped a leaf is not a clean report.
+        skipped.append(f"{name}: probe budget ({_MAX_PROBE_LEAVES} "
+                       "leaves per program) exhausted")
+    return probed[:_MAX_PROBE_LEAVES], skipped
+
+
+def lint_survival(prog, report: Optional[LintReport] = None,
+                  closed=None) -> LintReport:
+    """Run the post-XLA checks.  Compiles the protected step for the
+    current default backend and executes the lane probe on it.
+    ``closed`` forwards an already-traced step jaxpr (lint_program's,
+    so a full lint traces once)."""
+    cfg = prog.cfg
+    region = prog.region
+    if report is None:
+        report = LintReport(benchmark=region.name,
+                            strategy=f"N={cfg.num_clones}")
+    report.passes_run.append("survival")
+    n = cfg.num_clones
+    if n <= 1 or not prog._any_replicated:
+        return report
+
+    pstate_s, flags_s = jax.eval_shape(prog.init_pstate)
+    t_s = jax.ShapeDtypeStruct((), jnp.int32)
+    step = jax.jit(prog.step)
+    hlo = step.lower(pstate_s, flags_s, t_s).compile().as_text()
+
+    # -- voter survival -------------------------------------------------
+    votes = _count_votes(prog, closed)
+    counts = _count_ops(hlo, ("select", "compare"))
+    if n == 3 and counts["select"] < votes:
+        report.add(
+            "hlo-voter-missing", "error", "hlo:select",
+            f"optimized HLO contains {counts['select']} select op(s) for "
+            f"{votes} traced TMR vote(s): majority voters were compiled "
+            "away")
+    if counts["compare"] < votes:
+        report.add(
+            "hlo-voter-missing", "error", "hlo:compare",
+            f"optimized HLO contains {counts['compare']} compare op(s) "
+            f"for {votes} traced vote(s): miscompare detection was "
+            "compiled away")
+
+    # -- semantic lane probe --------------------------------------------
+    probed, skipped = _probe_leaves(prog)
+    for reason in skipped:
+        report.add("lane-probe", "note", f"leaf:{reason.split(':', 1)[0]}",
+                   f"lane probe skipped -- {reason.split(': ', 1)[1]}")
+    if probed:
+        # Probe at several successive program states, not just init:
+        # phase-gated leaves (e.g. a compute/store micro-step accumulator)
+        # are only observable in the phase that consumes them.
+        pstate_t, flags_t = jax.jit(prog.init_pstate)()
+        states = []
+        for t in range(_PROBE_STATES):
+            states.append((pstate_t, flags_t, jnp.int32(t)))
+            if t + 1 < _PROBE_STATES:
+                pstate_t, flags_t = step(pstate_t, flags_t, jnp.int32(t))
+        bases = [_tree_bytes(jax.device_get(step(*s))) for s in states]
+        for name in probed:
+            lane0 = np.asarray(states[0][0][name])[0]
+            if lane0.nbytes % 4:
+                # Defensive: the engine's init_pstate enforces 32-bit
+                # leaves, but a probe must never crash on a future
+                # exotic dtype -- skip with a note instead.
+                report.add("lane-probe", "note", f"leaf:{name}",
+                           "lane probe skipped -- leaf is not "
+                           "32-bit-word addressable")
+                continue
+            words = lane0.nbytes // 4
+            sites = sorted({0, words - 1, words // 2})
+            for lane in range(n):
+                responded = False
+                for (pstate_s, flags_s, t_s), base in zip(states, bases):
+                    arr = np.asarray(pstate_s[name])
+                    for word in sites:
+                        for bit in _PROBE_BITS:
+                            perturbed = dict(pstate_s)
+                            perturbed[name] = jnp.asarray(
+                                _flip_bit(arr, lane, word, bit))
+                            got = _tree_bytes(jax.device_get(
+                                step(perturbed, flags_s, t_s)))
+                            if got != base:
+                                responded = True
+                                break
+                        if responded:
+                            break
+                    if responded:
+                        break
+                if not responded:
+                    report.add(
+                        "lane-dedup", "error", f"leaf:{name}:lane{lane}",
+                        f"perturbing lane {lane} of replicated leaf "
+                        f"'{name}' (bits {list(_PROBE_BITS)} of words "
+                        f"{sites}, steps 0..{_PROBE_STATES - 1}) "
+                        "produced no observable response in the "
+                        "compiled step: this replica was deduplicated "
+                        "or never distinct -- faults there are "
+                        "invisible to voting and detection")
+
+    # -- segmented CSE fingerprint --------------------------------------
+    if cfg.segmented:
+        base_hlo = _lower_hlo(region.bound_step(),
+                              jax.eval_shape(region.init),
+                              jax.ShapeDtypeStruct((), jnp.int32))
+        base_counts = _count_ops(base_hlo, _SIG_OPS)
+        prot_counts = _count_ops(hlo, _SIG_OPS)
+        s1 = sum(base_counts.values())
+        sn = sum(prot_counts.values())
+        if s1 < _SIG_FLOOR:
+            report.add(
+                "segment-cse", "note", "hlo:fingerprint",
+                f"fingerprint skipped: single-lane step has only {s1} "
+                f"arithmetic op(s) (< {_SIG_FLOOR}), ratio would be "
+                "noise")
+        elif sn < (n - 0.5) * s1:
+            report.add(
+                "segment-cse", "error", "hlo:fingerprint",
+                f"segmented lowering carries {sn} arithmetic op(s) vs "
+                f"{s1} for a single lane (ratio {sn / s1:.2f} < "
+                f"{n - 0.5}): the unrolled replica bodies were "
+                "deduplicated into one fingerprint")
+    return report

@@ -107,7 +107,8 @@ class CampaignRunner:
                  sections: Optional[Sequence[str]] = None,
                  strategy_name: Optional[str] = None,
                  unroll: int = 1,
-                 telemetry: Optional[obs.Telemetry] = None):
+                 telemetry: Optional[obs.Telemetry] = None,
+                 preflight: "bool | str" = False):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -122,7 +123,19 @@ class CampaignRunner:
         default a fresh enabled one (COAST_TELEMETRY=0 disables).  Every
         campaign records per-stage wall-clock into it and exposes the
         totals as ``CampaignResult.stages``; export the full timeline
-        with ``obs.write_trace(runner.telemetry, path)``."""
+        with ``obs.write_trace(runner.telemetry, path)``.
+
+        ``preflight`` runs the replication-integrity linter before any
+        schedule is built and raises ``ReplicationLintError`` on an error
+        finding -- a multi-hour campaign must refuse to start on a
+        program whose redundancy was compiled away (every injection
+        would measure a protection that no longer exists).  ``True`` or
+        ``"full"`` runs both the static lane-provenance rules and the
+        post-XLA survival checks; ``"static"`` skips the survival
+        compile for quick iteration."""
+        if preflight:
+            from coast_tpu.analysis import lint as lint_mod
+            lint_mod.check(prog, survival=(preflight != "static"))
         self.prog = prog
         self.telemetry = telemetry if telemetry is not None \
             else obs.Telemetry()

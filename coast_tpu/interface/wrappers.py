@@ -157,77 +157,94 @@ def _gather_args(args):
         lambda x: jax.lax.all_gather(jnp.asarray(x), LANE_AXIS), tuple(args))
 
 
-def _vote_tree(tree, num_clones, log):
+def _vote_tree(tree, num_clones, log, fn_name: str = "-"):
     flat, treedef = jax.tree.flatten(tree)
     voted = []
     for leaf in flat:
+        # Classified for the replication-integrity linter: one
+        # call-boundary vote per crossing argument/return leaf
+        # (processCallSync, synchronization.cpp:563-738).
+        leaf = voters.sync_tag(leaf, "call_boundary", fn_name)
         v, m = voters.vote(leaf, num_clones)
         log.append(m)
         voted.append(v)
     return jax.tree.unflatten(treedef, voted)
 
 
-def lane_ignored(fn: Callable, num_clones: int, log) -> Callable:
+def lane_ignored(fn: Callable, num_clones: int, log,
+                 name: str = None) -> Callable:
     """-ignoreFns: the function is *outside* the sphere of replication --
     one logical call with synchronized arguments.  Every crossing argument
     is voted across lanes (the forced call-boundary sync of
     verification.cpp:587,676), the body runs once on the voted copies, and
     the single result re-enters every lane identically."""
+    fname = name or getattr(fn, "__name__", "fn")
 
     def wrapper(*args):
-        voted = _vote_tree(_gather_args(args), num_clones, log)
+        voted = _vote_tree(_gather_args(args), num_clones, log, fname)
         return fn(*voted)
 
-    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_IGNORED"
+    wrapper.__name__ = f"{fname}_IGNORED"
     return wrapper
 
 
-def _call_on_lane0(fn: Callable) -> Callable:
+def _call_on_lane0(fn: Callable, spof_name: str) -> Callable:
     """Single unsynced call on lane 0's arguments (shared by -skipLibCalls
-    and -cloneAfterCall, whose mechanics coincide under the lane axis)."""
+    and -cloneAfterCall, whose mechanics coincide under the lane axis).
+    The lane-0 read is tagged ``coast:spof:<fn>`` so the linter's SPOF
+    report can match it against the accepted allowlist instead of
+    flagging an unexplained single point of failure."""
+    from jax.ad_checkpoint import checkpoint_name
 
     def wrapper(*args):
         gathered = _gather_args(args)
-        lane0 = jax.tree.map(lambda g: g[0], gathered)
+        lane0 = jax.tree.map(
+            lambda g: checkpoint_name(g, voters.TAG_SPOF + spof_name)[0],
+            gathered)
         return fn(*lane0)
 
     return wrapper
 
 
-def lane_skip_lib(fn: Callable, num_clones: int) -> Callable:
+def lane_skip_lib(fn: Callable, num_clones: int,
+                  name: str = None) -> Callable:
     """-skipLibCalls: single call, *no* argument sync -- lane 0's arguments
     are used verbatim (the reference simply does not clone or sync the
     call, interface.cpp:82-100).  A fault in lane 0's arguments therefore
     corrupts every replica: the single point of failure the flag
     deliberately accepts for cheap library calls."""
-    wrapper = _call_on_lane0(fn)
-    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_SKIPLIB"
+    fname = name or getattr(fn, "__name__", "fn")
+    wrapper = _call_on_lane0(fn, fname)
+    wrapper.__name__ = f"{fname}_SKIPLIB"
     return wrapper
 
 
-def lane_protected_lib(fn: Callable, num_clones: int, log) -> Callable:
+def lane_protected_lib(fn: Callable, num_clones: int, log,
+                       name: str = None) -> Callable:
     """-protectedLibFn (__xMR_PROT_LIB): replicated body behind a
     single-copy signature (cloning.cpp:562-564).  Arguments are voted in,
     the body runs per lane, and the return is voted out -- both boundary
     syncs are logged."""
+    fname = name or getattr(fn, "__name__", "fn")
 
     def wrapper(*args):
-        voted_in = _vote_tree(_gather_args(args), num_clones, log)
+        voted_in = _vote_tree(_gather_args(args), num_clones, log, fname)
         out = fn(*voted_in)
         (gathered_out,) = _gather_args((out,))
-        return _vote_tree(gathered_out, num_clones, log)
+        return _vote_tree(gathered_out, num_clones, log, fname)
 
-    wrapper.__name__ = f"{getattr(fn, '__name__', 'fn')}_COAST_WRAPPER"
+    wrapper.__name__ = f"{fname}_COAST_WRAPPER"
     return wrapper
 
 
-def lane_clone_after_call(fn: Callable, num_clones: int) -> Callable:
+def lane_clone_after_call(fn: Callable, num_clones: int,
+                          name: str = None) -> Callable:
     """-cloneAfterCall: call once on lane 0's (single-copy) arguments and
     fan the result out -- each lane receives an identical copy that then
     lives and corrupts independently (cloning.cpp:1700-1768, the scanf
     pattern).  Under the lane axis the returned value is already per-lane;
     the fan-out is the identity."""
-    wrapper = _call_on_lane0(fn)
-    wrapper.__name__ = (
-        f"{getattr(fn, '__name__', 'fn')}_CLONE_AFTER_CALL_1_2")
+    fname = name or getattr(fn, "__name__", "fn")
+    wrapper = _call_on_lane0(fn, fname)
+    wrapper.__name__ = f"{fname}_CLONE_AFTER_CALL_1_2"
     return wrapper

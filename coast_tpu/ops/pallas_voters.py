@@ -112,5 +112,9 @@ def _vote_pallas(lanes: jax.Array):
 def vote(lanes: jax.Array, num_clones: int):
     """Drop-in for voters.vote with the Pallas fast path when eligible."""
     if num_clones > 1 and eligible(lanes):
-        return _vote_pallas(lanes)
+        from jax.ad_checkpoint import checkpoint_name
+        # Same sanction marker the jnp voters carry (voters.TAG_VOTER):
+        # the lane collapse happens inside the opaque Pallas kernel, so
+        # the linter must learn from the tag that this is a voter.
+        return _vote_pallas(checkpoint_name(lanes, voters.TAG_VOTER))
     return voters.vote(lanes, num_clones)

@@ -12,6 +12,14 @@ All voters return ``(value, miscompare)`` where ``miscompare`` is a bool
 scalar: "some lane disagreed somewhere in this tensor".  TMR uses it to bump
 the ``TMR_ERROR_CNT`` analogue (synchronization.cpp:1354-1465); DWC uses it
 to raise the abort flag.
+
+Every voter tags its lane input with a ``name[name=coast:voter]`` marker
+(the identity-tag idiom of ops/indexing.py): the replication-integrity
+linter (analysis/lint) reads these to tell a *sanctioned* lane collapse --
+the voter's own ``lanes[0]``/``lanes[1]`` reads -- from an accidental one
+that silently turns xMR into a single point of failure.  Call sites
+additionally classify their vote with :func:`sync_tag` so the linter can
+check voter coverage per sync class against the ProtectionConfig.
 """
 
 from __future__ import annotations
@@ -20,6 +28,29 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+# Tag namespace shared with analysis/lint: any ``name`` eqn whose tag
+# starts with one of these marks its output as a sanctioned lane source.
+TAG_VOTER = "coast:voter"
+TAG_SYNC = "coast:sync:"      # coast:sync:<class>:<leaf> -- classified vote
+TAG_SPOF = "coast:spof:"      # coast:spof:<fn> -- accepted single-lane call
+TAG_VIEW = "coast:view:"      # boundary lane-0 views (DWC _voted_view)
+
+
+def sync_tag(lanes: jax.Array, klass: str, leaf: str) -> jax.Array:
+    """Identity at runtime; marks ``lanes`` as the input of a vote at sync
+    class ``klass`` covering ``leaf`` (the linter's voter-coverage unit)."""
+    return checkpoint_name(lanes, f"{TAG_SYNC}{klass}:{leaf}")
+
+
+def lane_view(lanes: jax.Array) -> jax.Array:
+    """Lane 0 of a replica set, tagged as a sanctioned boundary view --
+    the DWC ``_voted_view`` read (no majority exists to vote; the final
+    compare has already latched any divergence).  Without the tag the
+    linter would report this deliberate read as a single point of
+    failure."""
+    return checkpoint_name(lanes, TAG_VIEW + "lane0")[0]
 
 
 def tmr_vote(lanes: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -32,6 +63,7 @@ def tmr_vote(lanes: jax.Array) -> Tuple[jax.Array, jax.Array]:
     the voted value through the original *and* cloned store instructions,
     syncStoreInst synchronization.cpp:476-561).
     """
+    lanes = checkpoint_name(lanes, TAG_VOTER)
     l0, l1, l2 = lanes[0], lanes[1], lanes[2]
     agree01 = l0 == l1
     voted = jnp.where(agree01, l0, l2)
@@ -50,6 +82,7 @@ def dwc_check(lanes: jax.Array) -> Tuple[jax.Array, jax.Array]:
     per-element compares mirrors processCallSync's OR of per-arg compares
     (synchronization.cpp:709-726).
     """
+    lanes = checkpoint_name(lanes, TAG_VOTER)
     miscompare = jnp.logical_not(jnp.all(lanes[0] == lanes[1]))
     return lanes[0], miscompare
 

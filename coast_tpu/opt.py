@@ -19,8 +19,16 @@ main()'s return convention).
     python -m coast_tpu.opt -TMR -inject=results:1:0:20:5 matrixMultiply
 
 ``-dumpModule`` prints the jaxpr of the protected step -- the analogue of
-dumping the transformed LLVM module (utils.cpp:909-929).  ``-inject`` is
-the forced-injection debug hook (--forceBreak, injector.py:59-68).
+dumping the transformed LLVM module (utils.cpp:909-929);
+``-dumpModule=hlo`` prints the *optimized* HLO instead (the module the
+redundancy-survival lint pass analyzes).  ``-inject`` is the
+forced-injection debug hook (--forceBreak, injector.py:59-68).
+
+Every protected build runs the replication-integrity linter's static
+rules first (analysis/lint; the ``verifyCloningSuccess`` analogue) and
+refuses to run on an error finding; ``-noCloneOpsCheck`` bypasses the
+gate and ``-lintOut=<path>`` writes the JSON findings next to whatever
+``-dumpModule`` dumps.
 """
 
 from __future__ import annotations
@@ -33,7 +41,7 @@ _BOOL_FLAGS = {
     "TMR", "DWC", "EDDI", "CFCSS",
     "noMemReplication", "noLoadSync", "noStoreDataSync", "noStoreAddrSync",
     "storeDataSync", "countErrors", "reportErrors", "countSyncs",
-    "i", "s", "verbose", "dumpModule", "noMain", "noCloneOpsCheck",
+    "i", "s", "verbose", "noMain", "noCloneOpsCheck",
     "protectStack", "pallasVoters", "noPallasVoters",
     # Utility passes (SURVEY.md §2.1 #6-#8), stackable with any strategy:
     # -DebugStatements (block trace), -SmallProfile (+ -noPrint), -ExitMarker.
@@ -48,7 +56,10 @@ _LIST_FLAGS = {
 # List flags that feed the scope config (ScopeConfig.merge_cl); fnPrintList
 # is instrumentation-only.
 _SCOPE_LIST_FLAGS = _LIST_FLAGS - {"fnPrintList"}
-_STR_FLAGS = {"configFile", "inject", "printFnName"}
+_STR_FLAGS = {"configFile", "inject", "printFnName", "lintOut"}
+# Flags accepted bare (-dumpModule, today's jaxpr behavior) or with a
+# value (-dumpModule=jaxpr|hlo).
+_OPT_VALUE_FLAGS = {"dumpModule"}
 
 
 class UsageError(Exception):
@@ -63,7 +74,9 @@ def parse_argv(argv: List[str]) -> Tuple[Dict[str, object], List[str]]:
             positional.append(arg)
             continue
         name, sep, value = arg[1:].partition("=")
-        if name in _BOOL_FLAGS:
+        if name in _OPT_VALUE_FLAGS:
+            flags[name] = value if sep else True
+        elif name in _BOOL_FLAGS:
             if sep:
                 raise UsageError(f"flag -{name} takes no value")
             flags[name] = True
@@ -242,10 +255,53 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"# leaf {name}: kind={region.spec[name].kind} "
                   f"replicated={prog.replicated[name]}", file=sys.stderr)
 
-    if flags.get("dumpModule"):
+    # Replication-integrity check (verifyCloningSuccess analogue): the
+    # static lane-provenance/coverage rules run on every protected build
+    # and refuse to run the program on an error, exactly as the reference
+    # refuses to emit; -noCloneOpsCheck disables the gate (its reference
+    # meaning), -lintOut=<path> writes the JSON findings either way.  The
+    # heavier post-XLA survival pass stays with the lint CLI / campaign
+    # pre-flight (python -m coast_tpu.analysis.lint).
+    step_jaxpr = None          # shared: lint trace doubles as the dump
+    if "lintOut" in flags or (strategy in ("TMR", "DWC")
+                              and not flags.get("noCloneOpsCheck")):
+        from coast_tpu.analysis import lint as lint_mod
+        step_jaxpr = lint_mod.trace_step(prog)
+        lint_report = lint_mod.lint_program(
+            prog, survival=False, strategy=strategy or "unprotected",
+            closed=step_jaxpr)
+        if "lintOut" in flags:
+            # Honored for every build (an unprotected report is trivially
+            # clean, but the requested file must exist).
+            lint_report.write_json(flags["lintOut"])    # type: ignore
+        if (strategy in ("TMR", "DWC")
+                and not flags.get("noCloneOpsCheck")
+                and not lint_report.ok):
+            print(lint_report.format(include_notes=False), file=sys.stderr)
+            print("ERROR: replication-integrity check failed; rerun with "
+                  "-noCloneOpsCheck to bypass", file=sys.stderr)
+            return 1
+
+    if "dumpModule" in flags:
+        dump = flags["dumpModule"]
         import jax.numpy as jnp
         pstate, fl = jax.eval_shape(prog.init_pstate)
-        print(jax.make_jaxpr(prog.step)(pstate, fl, jnp.int32(0)))
+        if dump is True or dump == "jaxpr":
+            if step_jaxpr is None:
+                step_jaxpr = jax.make_jaxpr(prog.step)(pstate, fl,
+                                                       jnp.int32(0))
+            print(step_jaxpr)
+        elif dump == "hlo":
+            # The optimized HLO the redundancy-survival pass analyzes
+            # (analysis/lint/survival.py) -- the transformed module as
+            # the compiler will actually run it.
+            print(jax.jit(prog.step)
+                  .lower(pstate, fl, jax.ShapeDtypeStruct((), jnp.int32))
+                  .compile().as_text())
+        else:
+            print(f"ERROR: -dumpModule={dump}: format must be jaxpr or "
+                  "hlo", file=sys.stderr)
+            return 2
 
     fault = None
     if "inject" in flags:

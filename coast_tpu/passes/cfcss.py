@@ -98,12 +98,17 @@ def apply_cfcss(prog: ProtectedProgram, seed: int = 0) -> ProtectedProgram:
             region_state).astype(jnp.int32)
 
     def cfcss_step(new_state, flags, t, halted):
+        from coast_tpu.ops import voters
         v = lane_blocks(new_state)                       # (n_lanes,)
         g = new_state[G_LEAF]
         prev = new_state[PREV_LEAF]
         adj = jnp.where(fanin[v], dedge[prev, v], jnp.uint32(0))
         g_new = g ^ diffs[v] ^ adj
-        mismatch = jnp.any(g_new != sigs[v])
+        # The any() collapses the lane axis by design (a mismatch in ANY
+        # lane aborts); tag it as the CFCSS sync point so the replication
+        # linter does not read the reduction as a lost replica.
+        mismatch = jnp.any(
+            voters.sync_tag(g_new != sigs[v], "cfcss", G_LEAF))
         flags = {**flags,
                  "cfc_fault": jnp.logical_or(
                      flags["cfc_fault"],

@@ -360,6 +360,20 @@ class ProtectedProgram:
             pstate.update(self._cfcss_init())
         return pstate, _flags_init(self.cfg)
 
+    def _sync_class_of(self, name: str) -> str:
+        """The sync-point class a commit-boundary vote on ``name`` belongs
+        to -- the label baked into the vote's ``coast:sync:`` tag and
+        independently re-derived by the replication-integrity linter
+        (analysis/lint/provenance.py expected-coverage table)."""
+        spec = self.region.spec[name]
+        if self.cfg.protect_stack and spec.stack:
+            return "stack"
+        if spec.kind == KIND_MEM:
+            return "store_data"
+        if spec.kind == KIND_CTRL:
+            return "ctrl"
+        return "stack"
+
     # -- lane execution -----------------------------------------------------
     def _fn_env(self):
         """Build the per-trace function namespace: each named sub-function
@@ -379,13 +393,15 @@ class ProtectedProgram:
                 # is also the per-lane default here.
                 wrapped[name] = fn
             elif cls == "ignored":
-                wrapped[name] = W.lane_ignored(fn, n, env.miscompares)
+                wrapped[name] = W.lane_ignored(fn, n, env.miscompares,
+                                               name=name)
             elif cls == "skip_lib":
-                wrapped[name] = W.lane_skip_lib(fn, n)
+                wrapped[name] = W.lane_skip_lib(fn, n, name=name)
             elif cls == "protected_lib":
-                wrapped[name] = W.lane_protected_lib(fn, n, env.miscompares)
+                wrapped[name] = W.lane_protected_lib(fn, n, env.miscompares,
+                                                     name=name)
             else:  # clone_after_call
-                wrapped[name] = W.lane_clone_after_call(fn, n)
+                wrapped[name] = W.lane_clone_after_call(fn, n, name=name)
         env._fns = wrapped
         return env
 
@@ -465,7 +481,9 @@ class ProtectedProgram:
         if cfg.num_clones > 1:
             for name in region_state:
                 if self.pre_sync.get(name, False):
-                    voted, mis = self._vote(region_state[name], cfg.num_clones)
+                    lanes = voters.sync_tag(region_state[name],
+                                            "load_addr", name)
+                    voted, mis = self._vote(lanes, cfg.num_clones)
                     miscompares.append(mis)
                     syncs = syncs + 1
                     if cfg.num_clones == 3:
@@ -510,9 +528,16 @@ class ProtectedProgram:
             for name, arr in region_state.items():
                 if not self.replicated[name]:
                     slice_view[name] = arr
-                elif (self.region.spec[name].kind == KIND_CTRL
-                      and cfg.num_clones == 3):
-                    slice_view[name] = voters.tmr_vote(arr)[0]
+                elif self.region.spec[name].kind == KIND_CTRL:
+                    # TMR: majority -- one corrupted lane cannot redirect
+                    # the vote window.  DWC has no majority; lane 0 is
+                    # read through the tagged boundary view: a diverged
+                    # ctrl lane latches dwc_fault at this step's own
+                    # ctrl commit compare, so a wrong window can only
+                    # accompany an already-detected fault.
+                    slice_view[name] = (voters.tmr_vote(arr)[0]
+                                        if cfg.num_clones == 3
+                                        else voters.lane_view(arr))
                 else:
                     slice_view[name] = arr[0]
 
@@ -543,10 +568,11 @@ class ProtectedProgram:
                                        for s in starts)
 
                         def vote_slice(lanes, _starts=starts,
-                                       _sizes=sizes):
+                                       _sizes=sizes, _name=name):
                             sl = jax.vmap(
                                 lambda lane: jax.lax.dynamic_slice(
                                     lane, _starts, _sizes))(lanes)
+                            sl = voters.sync_tag(sl, "store_data", _name)
                             voted, m = self._vote(sl, cfg.num_clones)
                             if cfg.num_clones == 3:
                                 rep = jnp.broadcast_to(voted, sl.shape)
@@ -567,7 +593,9 @@ class ProtectedProgram:
                             syncs = syncs + active.astype(jnp.int32)
                         miscompares.append(mis)
                     else:
-                        voted, mis = self._vote(out, cfg.num_clones)
+                        lanes = voters.sync_tag(
+                            out, self._sync_class_of(name), name)
+                        voted, mis = self._vote(lanes, cfg.num_clones)
                         miscompares.append(mis)
                         syncs = syncs + 1
                         if cfg.num_clones == 3:
@@ -584,7 +612,8 @@ class ProtectedProgram:
                     # Store crossing the sphere of replication: vote before
                     # the single store (verification.cpp forces these into
                     # syncGlobalStores :587,676).
-                    voted, mis = self._vote(out, cfg.num_clones)
+                    lanes = voters.sync_tag(out, "sor_crossing", name)
+                    voted, mis = self._vote(lanes, cfg.num_clones)
                     miscompares.append(mis)
                     syncs = syncs + 1
                     new_state[name] = voted
@@ -643,7 +672,10 @@ class ProtectedProgram:
             elif self.cfg.num_clones == 3:
                 view[name] = voters.tmr_vote(arr)[0]
             else:
-                view[name] = arr[0]
+                # DWC has no majority; the boundary read is lane 0, tagged
+                # as a sanctioned view for the replication linter (the
+                # final compare in run() latches any divergence first).
+                view[name] = voters.lane_view(arr)
         return view
 
     def run(self, fault: Optional[Dict[str, jax.Array]] = None,
@@ -774,7 +806,8 @@ class ProtectedProgram:
             for name, arr in pstate.items():
                 if not self.replicated[name]:
                     continue
-                _, m = self._vote(arr, self.cfg.num_clones)
+                lanes = voters.sync_tag(arr, "boundary", name)
+                _, m = self._vote(lanes, self.cfg.num_clones)
                 mis = jnp.logical_or(mis, m)
                 mis_cnt = mis_cnt + m.astype(jnp.int32)
             reached_call = jnp.logical_and(

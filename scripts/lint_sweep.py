@@ -1,0 +1,111 @@
+"""Replication-integrity lint sweep over the benchmark REGISTRY.
+
+Runs the full linter (jaxpr lane-provenance + post-XLA redundancy
+survival) over every registry benchmark under the TMR and DWC default
+configs and writes one artifact, ``artifacts/lint_sweep.json`` -- the
+recorded proof that the default protected builds carry their redundancy
+through compilation (ISSUE acceptance: the default-TMR sweep must be
+finding-free).  Exit status 1 if any error finding survives.
+
+Usage: python scripts/lint_sweep.py [--out artifacts/lint_sweep.json]
+       [--strategies TMR,DWC] [--benchmarks a,b | --fast] [--no-survival]
+       [--cpu]
+
+``--fast`` sweeps the small tier-1 subset (the same one
+tests/test_lint.py::test_registry_subset_sweep_clean checks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Small, quick-to-compile subset for tier-1 / --fast runs: covers mem
+# (matrixMultiply), reg/ctrl (crc16), function scopes (nestedCalls), and
+# a control-heavy region (towersOfHanoi).
+FAST_SUBSET = ("matrixMultiply", "crc16", "nestedCalls", "towersOfHanoi")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/lint_sweep.json")
+    ap.add_argument("--strategies", default="TMR,DWC")
+    ap.add_argument("--benchmarks", default=None,
+                    help="comma list; default: full REGISTRY")
+    ap.add_argument("--fast", action="store_true",
+                    help=f"sweep only {','.join(FAST_SUBSET)}")
+    ap.add_argument("--no-survival", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import DWC, TMR
+    from coast_tpu.analysis import lint
+    from coast_tpu.models import REGISTRY
+
+    makers = {"TMR": TMR, "DWC": DWC}
+    strategies = [s.strip() for s in args.strategies.split(",") if s.strip()]
+    for s in strategies:
+        if s not in makers:
+            print(f"ERROR: unknown strategy {s}", file=sys.stderr)
+            return 2
+    if args.benchmarks:
+        benches = [b.strip() for b in args.benchmarks.split(",") if b.strip()]
+    elif args.fast:
+        benches = list(FAST_SUBSET)
+    else:
+        benches = sorted(REGISTRY)
+    unknown = [b for b in benches if b not in REGISTRY]
+    if unknown:
+        print(f"ERROR: unknown benchmark(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    survival = not args.no_survival
+    t_start = time.time()
+    doc = {"backend": jax.default_backend(),
+           "survival": survival,
+           "strategies": strategies,
+           "benchmarks": {}}
+    n_errors = 0
+    for bench in benches:
+        row = {}
+        for strat in strategies:
+            t0 = time.time()
+            prog = makers[strat](REGISTRY[bench]())
+            rep = lint.lint_program(prog, survival=survival, strategy=strat)
+            row[strat] = {**rep.to_dict(),
+                          "seconds": round(time.time() - t0, 3)}
+            n_errors += len(rep.errors())
+            status = "ok" if rep.ok else "FINDINGS"
+            print(f"# {bench:<24} {strat:<4} {status:<9} "
+                  f"{rep.counts()} [{time.time() - t0:.1f}s]",
+                  file=sys.stderr, flush=True)
+            if not rep.ok:
+                for f in rep.errors():
+                    print("#   " + f.format(), file=sys.stderr, flush=True)
+        doc["benchmarks"][bench] = row
+    doc["seconds"] = round(time.time() - t_start, 3)
+    doc["total_errors"] = n_errors
+    doc["ok"] = n_errors == 0
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": doc["ok"], "total_errors": n_errors,
+                      "benchmarks": len(benches),
+                      "seconds": doc["seconds"], "out": args.out}))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

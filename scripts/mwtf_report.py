@@ -79,6 +79,16 @@ def main(argv=None) -> int:
             res = runner.run(args.n, seed=2026, batch_size=batch)
             stage_blocks[strat] = {k: round(v, 6)
                                    for k, v in res.stages.items()}
+            # Mean guest runtime over *completed* runs (success/
+            # corrected/sdc), matching Summary semantics.  The
+            # zero-completed-runs policy (NaN + warning instead of the
+            # reference's StatisticsError crash) lives in one place:
+            # json_parser.mean_steps_or_nan.
+            from coast_tpu.analysis.json_parser import mean_steps_or_nan
+            completed = res.codes <= 2
+            mean_steps = mean_steps_or_nan(
+                float(res.steps[completed].sum()), int(completed.sum()),
+                res.n, f"{name}-{strat}")
             summaries[strat] = Summary(
                 name=f"{name}-{strat}", n=res.n, counts=res.counts,
                 # MWTF's runtime ratio must be the *guest* runtime, not
@@ -86,7 +96,7 @@ def main(argv=None) -> int:
                 # time, threadFunctions.py:387-449): use the on-device
                 # seconds per fault-free run.
                 seconds=runtimes[strat] * res.n,
-                mean_steps=float(res.steps.mean()),
+                mean_steps=mean_steps,
                 stages=res.stages or None)
             dominant = max(res.stages, key=res.stages.get) \
                 if res.stages else "?"
@@ -101,9 +111,12 @@ def main(argv=None) -> int:
                "stages": stage_blocks,
                "injections_per_sec": {}}
         def _j(v):
-            # Strict-JSON-safe: infinities (zero protected SDCs) as "inf".
+            # Strict-JSON-safe: infinities (zero protected SDCs) as
+            # "inf", undefined ratios (no completed runs) as "nan".
             import math
             if isinstance(v, float):
+                if math.isnan(v):
+                    return "nan"
                 return round(v, 4) if math.isfinite(v) else "inf"
             return v
 

@@ -1,0 +1,377 @@
+"""Replication-integrity linter tests (coast_tpu.analysis.lint).
+
+Seeded-defect regressions: each class of replication damage the ISSUE
+names -- hand-collapsed lanes, a dropped voter, segmented-mode lane
+dedup -- must raise the matching finding; the healthy default builds
+must stay finding-free across the ProtectionConfig knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.analysis import lint
+from coast_tpu.analysis.lint.findings import ReplicationLintError
+from coast_tpu.analysis.lint.provenance import expected_sync_classes
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
+                                 LeafSpec, Region)
+from coast_tpu.models import REGISTRY
+
+
+def _rules(report, severity="error"):
+    return sorted({f.rule for f in report.findings
+                   if f.severity == severity and not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# healthy builds are finding-free
+# ---------------------------------------------------------------------------
+
+def test_registry_subset_sweep_clean():
+    """The fast sweep subset (scripts/lint_sweep.py --fast) under default
+    TMR and DWC: full linter (provenance + survival), zero findings."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from lint_sweep import FAST_SUBSET
+    for bench in FAST_SUBSET:
+        for make in (TMR, DWC):
+            prog = make(REGISTRY[bench]())
+            rep = lint.lint_program(prog)
+            assert rep.ok, f"{bench}/{make.__name__}:\n{rep.format()}"
+            assert "provenance" in rep.passes_run
+            assert "survival" in rep.passes_run
+
+
+@pytest.mark.parametrize("overrides", [
+    {},
+    {"no_store_data_sync": True},
+    {"no_load_sync": True},
+    {"no_store_addr_sync": True},
+    {"no_mem_replication": True},
+    {"segmented": True},
+    {"count_errors": False},
+    {"count_syncs": True},
+])
+def test_voter_coverage_clean_across_knobs(overrides):
+    """Every ProtectionConfig knob shifts the voter set AND the linter's
+    independently re-derived expectation the same way: static lint stays
+    clean (e.g. -noStoreDataSync removes exactly the store-data votes)."""
+    for make in (TMR, DWC):
+        prog = make(REGISTRY["matrixMultiply"](), **overrides)
+        rep = lint.lint_program(prog, survival=False)
+        assert rep.ok, f"{make.__name__} {overrides}:\n{rep.format()}"
+
+
+def test_unprotected_has_nothing_to_lint():
+    rep = lint.lint_program(unprotected(REGISTRY["crc16"]()))
+    assert rep.ok and not rep.findings
+
+
+def test_expected_sync_classes_mirror_config():
+    region = REGISTRY["matrixMultiply"]()
+    prog = TMR(region)
+    exp = expected_sync_classes(region, prog.cfg)
+    assert "store_data" in exp["results"]
+    assert "load_addr" in exp["i"]           # loop index forms addresses
+    # -noStoreDataSync drops exactly the store-data expectation.
+    cfg2 = TMR(region, no_store_data_sync=True).cfg
+    exp2 = expected_sync_classes(region, cfg2)
+    assert "store_data" not in exp2["results"]
+    assert exp2["i"] == exp["i"]
+
+
+# ---------------------------------------------------------------------------
+# seeded defects: each one must raise the matching finding
+# ---------------------------------------------------------------------------
+
+def test_seeded_dropped_voter_caught():
+    """Engine 'forgets' a commit vote the config calls for: the coverage
+    rule flags the missing store-data vote (the -noCloneOpsCheck class:
+    the transform silently lost a sync point)."""
+    prog = TMR(REGISTRY["matrixMultiply"]())
+    assert prog.step_sync["results"]
+    prog.step_sync["results"] = False
+    rep = lint.lint_program(prog, survival=False)
+    assert not rep.ok
+    assert "voter-coverage" in _rules(rep)
+    assert any("results" in f.locus for f in rep.errors())
+
+
+def test_seeded_extra_voter_warns():
+    prog = TMR(REGISTRY["matrixMultiply"](), no_store_data_sync=True)
+    prog.step_sync["results"] = True          # vote the config disabled
+    rep = lint.lint_program(prog, survival=False)
+    assert rep.ok                             # warning, not error
+    assert "voter-coverage" in _rules(rep, "warning")
+
+
+def test_seeded_hand_collapsed_lanes_caught():
+    """A replicated leaf collapsed to lane 0 and broadcast back: the
+    classic silently-lost-redundancy defect -> spof finding."""
+    prog = TMR(REGISTRY["crc16"]())
+    orig = prog.step
+
+    def bad_step(pstate, flags, t):
+        new_state, flags = orig(pstate, flags, t)
+        new_state = dict(new_state)
+        new_state["crc"] = jnp.broadcast_to(new_state["crc"][0],
+                                            new_state["crc"].shape)
+        return new_state, flags
+
+    prog.step = bad_step
+    rep = lint.lint_program(prog, survival=False)
+    assert "spof" in _rules(rep)
+
+
+def test_seeded_lane_averaging_caught():
+    """Replacing majority voting by a lane average is a lane-collapsing
+    reduction outside a sanctioned voter."""
+    prog = TMR(REGISTRY["crc16"]())
+    orig = prog.step
+
+    def avg_step(pstate, flags, t):
+        new_state, flags = orig(pstate, flags, t)
+        new_state = dict(new_state)
+        avg = jnp.sum(new_state["crc"], axis=0) // 3
+        new_state["crc"] = jnp.broadcast_to(avg, new_state["crc"].shape)
+        return new_state, flags
+
+    prog.step = avg_step
+    rep = lint.lint_program(prog, survival=False)
+    assert "lane-collapse" in _rules(rep)
+
+
+def _dedup_lanes(prog):
+    """Seed the segmented-dedup defect: every 'replica' computed from
+    lane 0's state -- three syntactically identical bodies XLA folds."""
+    def bad_run_lanes(pstate, t):
+        step = prog.region.bound_step()
+        outs = []
+        for _ in range(prog.cfg.num_clones):
+            lane_state = {k: (v[0] if prog.replicated[k] else v)
+                          for k, v in pstate.items()}
+            outs.append(step(lane_state, t))
+        return ({k: jnp.stack([o[k] for o in outs]) for k in outs[0]},
+                jnp.zeros((0,), jnp.bool_))
+
+    prog._run_lanes = bad_run_lanes
+    return prog
+
+
+@pytest.mark.slow
+def test_seeded_segmented_dedup_caught_full():
+    """Segmented-TMR CSE survival: deduplicated lanes are caught at all
+    three levels (static slicing, HLO fingerprint, semantic probe)."""
+    prog = _dedup_lanes(TMR(REGISTRY["crc16"](), segmented=True))
+    rep = lint.lint_program(prog)
+    rules = _rules(rep)
+    assert "spof" in rules
+    assert "segment-cse" in rules
+    assert "lane-dedup" in rules
+
+
+def test_seeded_segmented_dedup_caught_static():
+    prog = _dedup_lanes(TMR(REGISTRY["crc16"](), segmented=True))
+    rep = lint.lint_program(prog, survival=False)
+    assert "spof" in _rules(rep)
+
+
+def test_healthy_segmented_tmr_survives():
+    """The real segmented scheduler slices DISTINCT lanes: the unrolled
+    bodies must not be merged and the full linter stays clean."""
+    prog = TMR(REGISTRY["crc16"](), segmented=True)
+    rep = lint.lint_program(prog)
+    assert rep.ok, rep.format()
+
+
+def test_seeded_unreplicated_import_caught():
+    """A mutable shared leaf feeding replicated dataflow whose committed
+    value bypasses the SoR-crossing vote."""
+    def init():
+        return {"sh": jnp.int32(1), "r": jnp.int32(0), "i": jnp.int32(0)}
+
+    def step(state, t):
+        return {"sh": state["sh"] + 1,
+                "r": state["r"] + state["sh"],
+                "i": state["i"] + 1}
+
+    region = Region(
+        name="shared_import", init=init, step=step,
+        done=lambda s: s["i"] >= 4,
+        check=lambda s: jnp.int32(0),
+        output=lambda s: s["r"].reshape(1).astype(jnp.uint32),
+        nominal_steps=4, max_steps=8,
+        spec={"sh": LeafSpec(KIND_MEM, xmr=False),
+              # no_verify: get past the build-time SoR verifier; the
+              # linter must still catch the post-transform defect.
+              "r": LeafSpec(KIND_REG, no_verify=True),
+              "i": LeafSpec(KIND_CTRL)},
+    )
+    prog = TMR(region)
+    # Healthy: the engine votes the shared store (SoR crossing).
+    assert lint.lint_program(prog, survival=False).ok
+    orig = prog.step
+
+    def bad_step(pstate, flags, t):
+        new_state, flags = orig(pstate, flags, t)
+        new_state = dict(new_state)
+        new_state["sh"] = pstate["sh"] + 1      # unvoted recommit
+        return new_state, flags
+
+    prog.step = bad_step
+    rep = lint.lint_program(prog, survival=False)
+    assert "unreplicated-import" in _rules(rep)
+    assert any("sh" in f.locus for f in rep.errors())
+
+
+def test_skip_lib_spof_is_an_accepted_note():
+    """-skipLibCalls single-lane calls appear in the SPOF report as
+    accepted notes, not errors (the allowlist semantics)."""
+    prog = TMR(REGISTRY["nestedCalls"](), skip_lib_calls=("fold",))
+    rep = lint.lint_program(prog, survival=False)
+    assert rep.ok, rep.format()
+    notes = [f for f in rep.findings if f.severity == "note"]
+    assert any(f.rule == "spof" and "fold" in f.locus for f in notes)
+
+
+# ---------------------------------------------------------------------------
+# suppression / baseline, JSON, gating
+# ---------------------------------------------------------------------------
+
+def test_baseline_suppression_roundtrip(tmp_path):
+    prog = TMR(REGISTRY["matrixMultiply"]())
+    prog.step_sync["results"] = False
+    rep = lint.lint_program(prog, survival=False)
+    assert not rep.ok
+    bpath = tmp_path / "baseline.json"
+    rep.write_baseline(str(bpath))
+    base = lint.load_baseline(str(bpath))
+    rep2 = lint.lint_program(prog, survival=False, baseline=base)
+    assert rep2.ok
+    assert rep2.counts()["suppressed"] >= 1
+
+
+def test_baseline_is_benchmark_scoped(tmp_path):
+    """A baseline written for one benchmark must not suppress the
+    same-named finding in another (fingerprints are benchmark:rule:locus;
+    'leaf:results' exists in both mm and mm256)."""
+    bad_mm = TMR(REGISTRY["matrixMultiply"]())
+    bad_mm.step_sync["results"] = False
+    bpath = tmp_path / "mm_baseline.json"
+    lint.lint_program(bad_mm, survival=False).write_baseline(str(bpath))
+    base = lint.load_baseline(str(bpath))
+    assert any(fp.startswith("matrixMultiply:") for fp in base)
+    bad_256 = TMR(REGISTRY["matrixMultiply256"]())
+    bad_256.step_sync["results"] = False
+    rep = lint.lint_program(bad_256, survival=False, baseline=base)
+    assert not rep.ok                 # other benchmark still gates
+
+
+def test_json_export(tmp_path):
+    prog = TMR(REGISTRY["crc16"]())
+    rep = lint.lint_program(prog, survival=False)
+    out = tmp_path / "lint.json"
+    rep.write_json(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "crc16"
+    assert doc["ok"] is True
+    assert doc["passes_run"] == ["provenance"]
+
+
+def test_check_raises_on_errors():
+    prog = TMR(REGISTRY["matrixMultiply"]())
+    prog.step_sync["results"] = False
+    with pytest.raises(ReplicationLintError) as ei:
+        lint.check(prog, survival=False)
+    assert "voter-coverage" in str(ei.value)
+
+
+def test_campaign_preflight_gates():
+    from coast_tpu.inject.campaign import CampaignRunner
+    prog = TMR(REGISTRY["crc16"]())
+    CampaignRunner(prog, preflight="static")      # healthy: constructs
+    bad = TMR(REGISTRY["matrixMultiply"]())
+    bad.step_sync["results"] = False
+    with pytest.raises(ReplicationLintError):
+        CampaignRunner(bad, preflight="static")
+
+
+# ---------------------------------------------------------------------------
+# opt CLI wiring
+# ---------------------------------------------------------------------------
+
+def test_opt_gate_and_lint_out(tmp_path, capsys):
+    from coast_tpu.opt import main as opt_main
+    out = tmp_path / "findings.json"
+    rc = opt_main(["-TMR", f"-lintOut={out}", "crc16"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["ok"] is True
+    # -noCloneOpsCheck still accepted (now actually gating something).
+    assert opt_main(["-TMR", "-noCloneOpsCheck", "crc16"]) == 0
+    capsys.readouterr()
+
+
+def test_opt_dump_module_formats(capsys):
+    from coast_tpu.opt import main as opt_main
+    assert opt_main(["-TMR", "-dumpModule", "trivial"]) == 0
+    assert "lambda" in capsys.readouterr().out        # jaxpr text
+    assert opt_main(["-TMR", "-dumpModule=jaxpr", "trivial"]) == 0
+    assert "lambda" in capsys.readouterr().out
+    assert opt_main(["-TMR", "-dumpModule=hlo", "trivial"]) == 0
+    assert "HloModule" in capsys.readouterr().out
+    assert opt_main(["-TMR", "-dumpModule=bogus", "trivial"]) == 2
+
+
+def test_lint_cli(tmp_path, capsys):
+    from coast_tpu.analysis.lint.__main__ import main as lint_main
+    out = tmp_path / "lint.json"
+    rc = lint_main(["-TMR", "crc16", "--no-survival",
+                    "--json", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["strategy"] == "TMR"
+    assert doc["reports"][0]["ok"] is True
+    assert lint_main(["-TMR", "nonesuch"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# zero-success MWTF guard (satellite)
+# ---------------------------------------------------------------------------
+
+def _columnar_doc(codes, steps):
+    # No "seconds": the runtime ratio then falls back to the step ratio,
+    # which is where the zero-completed-runs NaN must propagate.
+    return {"summary": {},
+            "columns": {"code": list(codes), "steps": list(steps),
+                        "leaf_id": [0] * len(codes),
+                        "word": [0] * len(codes), "bit": [0] * len(codes),
+                        "lane": [0] * len(codes), "t": [0] * len(codes),
+                        "errors": [0] * len(codes),
+                        "corrected": [0] * len(codes)}}
+
+
+def test_zero_success_campaign_reports_nan(capsys):
+    from coast_tpu.analysis.json_parser import (compare_runs,
+                                                summarize_runs)
+    # Every run DUE: no completed runs at all.
+    dead = summarize_runs("dead", [_columnar_doc([4, 4, 3], [9, 9, 9])])
+    assert math.isnan(dead.mean_steps)
+    assert "no completed runs" in capsys.readouterr().err
+    live = summarize_runs("live", [_columnar_doc([0, 2, 0], [5, 5, 5])])
+    cmp_ = compare_runs(live, dead)
+    assert math.isnan(cmp_["mwtf"])           # undefined, not a crash
+    assert math.isnan(cmp_["steps_x"])
+    # Formatting must not raise on the NaN summary.
+    assert "nan" in dead.format()
+    cmp2 = compare_runs(dead, live)
+    assert math.isnan(cmp2["mwtf"])
