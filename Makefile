@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos bench fidelity mfu_sweep clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -42,6 +42,13 @@ regression_test: build
 
 test_rtos:
 	sh unittest/rtos_test.sh
+
+# Canonical RTOS kernel builds only (the CI smoke row).  rtos_app and
+# the _dwc variants stay with test_rtos; the kernel targets built here
+# re-run there too, but the in-tree XLA compile cache (.jax_cache)
+# absorbs the second build.
+rtos:
+	$(MAKE) -C rtos rtos_mm rtos_kUser
 
 bench: build
 	$(PYTHON) bench.py

@@ -34,7 +34,7 @@ if (not _os.environ.get("COAST_NO_COMPILE_CACHE")
                            0.5)
 
 from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
-                                 LeafSpec, Region)
+                                 KIND_STACK, LeafSpec, Region)
 from coast_tpu.passes.dataflow_protection import (ProtectedProgram,
                                                   ProtectionConfig, protect)
 from coast_tpu.passes.strategies import DWC, EDDI, TMR, unprotected
@@ -43,6 +43,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Region", "LeafSpec", "KIND_MEM", "KIND_REG", "KIND_CTRL", "KIND_RO",
+    "KIND_STACK",
     "ProtectionConfig", "ProtectedProgram", "protect",
     "TMR", "DWC", "EDDI", "unprotected",
 ]

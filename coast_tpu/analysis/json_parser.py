@@ -49,7 +49,15 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Outcome classes, matching coast_tpu.inject.classify codes / CLASS_NAMES.
 _CLASSES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
-            "invalid")
+            "invalid", "due_stack_overflow", "due_assert")
+# DUE bucket membership (classify.DUE_CLASSES): aborts / stack overflows /
+# assert fails all count as timeouts in the reference's summary
+# (jsonParser.py:165-172; decoder classes decoder.py:67-69).
+_DUE_CLASSES = ("due_abort", "due_timeout", "due_stack_overflow",
+                "due_assert")
+# Codes <= _COMPLETED_MAX (success/corrected/sdc) ran to completion and
+# contribute to the mean-runtime statistic.
+_COMPLETED_MAX = 2
 
 
 def mean_steps_or_nan(step_sum: float, step_n: int, n: int,
@@ -77,11 +85,18 @@ def classify_run(run: Dict[str, object]) -> str:
     Dispatch on the result sub-dict's discriminating keys, exactly the
     ``InjectionLog.FromDict`` scheme (supportClasses.py:355-389): ``core`` ->
     RunResult, ``timeout`` -> TimeoutResult, ``message`` -> Abort-like,
-    ``invalid`` -> InvalidResult.
+    ``stackOverflow`` -> StackOverflowResult, ``assertion`` ->
+    AssertionFailResult, ``invalid`` -> InvalidResult.  Priority mirrors
+    classify.classify (INVALID > stack-overflow > assert > abort >
+    timeout).
     """
     res = run.get("result") or {}
     if "invalid" in res:
         return "invalid"
+    if "stackOverflow" in res:
+        return "due_stack_overflow"
+    if "assertion" in res:
+        return "due_assert"
     if "timeout" in res:
         return "due_timeout"
     if "message" in res:
@@ -114,9 +129,10 @@ class Summary:
 
     @property
     def due(self) -> int:
-        # Aborts also count into the DUE/timeout bucket in the reference's
-        # summary (jsonParser.py:165-172).
-        return self.counts["due_abort"] + self.counts["due_timeout"]
+        # Aborts (and the stack-overflow / assert-fail sub-buckets) also
+        # count into the DUE/timeout bucket in the reference's summary
+        # (jsonParser.py:165-172).
+        return sum(self.counts.get(k, 0) for k in _DUE_CLASSES)
 
     @property
     def error_rate(self) -> float:
@@ -132,10 +148,19 @@ class Summary:
     def format(self) -> str:
         lines = [f"=== {self.name}: {self.n} injections ==="]
         for cls in _CLASSES:
+            if cls in ("due_stack_overflow", "due_assert"):
+                continue          # printed as DUE sub-counts below
             lines.append(f"  {cls:<12} {self.counts[cls]:>8}  "
                          f"({self.pct(cls):6.2f}%)")
         lines.append(f"  {'due (total)':<12} {self.due:>8}  "
                      f"({100.0 * self.due / self.n if self.n else 0.0:6.2f}%)")
+        # The reference summary's three DUE sub-counts (its Timeouts row
+        # folds aborts/stack-overflows/assert-fails in, then reports each
+        # decoder class; decoder.py:67-69 / jsonParser.py:165-172).
+        for label, key in (("aborts", "due_abort"),
+                           ("stack overflows", "due_stack_overflow"),
+                           ("assert fails", "due_assert")):
+            lines.append(f"    {label:<16} {self.counts.get(key, 0):>6}")
         lines.append(f"  error rate   {self.error_rate:.6f}")
         lines.append(f"  mean runtime {self.mean_steps:.1f} steps")
         if self.seconds:
@@ -232,7 +257,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             for i, cls in enumerate(_CLASSES):
                 counts[cls] += int(binc[i])
             n += len(codes)
-            completed = codes <= 2                # success/corrected/sdc
+            completed = codes <= _COMPLETED_MAX   # success/corrected/sdc
             step_sum += int(steps[completed].sum())
             step_n += int(completed.sum())
         else:
@@ -439,7 +464,7 @@ def format_section_stats(table: Dict[str, Dict[str, int]]) -> str:
              f"{'due':>6} {'inv':>5}  sdc%"]
     for sym in sorted(table, key=lambda s: -table[s]["sdc"]):
         row = table[sym]
-        due = row["due_abort"] + row["due_timeout"]
+        due = sum(row.get(k, 0) for k in _DUE_CLASSES)
         pct = 100.0 * row["sdc"] / row["injections"] if row["injections"] else 0
         lines.append(f"  {sym:<20} {row['injections']:>7} {row['sdc']:>6} "
                      f"{row['corrected']:>6} {due:>6} {row['invalid']:>5}  "
@@ -481,6 +506,33 @@ def format_cycle_histogram(hist: List[Tuple[int, int, int]]) -> str:
     return "\n".join(lines)
 
 
+# Eight-level bar glyphs for the one-line sparkline rendering.
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def format_cycle_sparkline(hist: List[Tuple[int, int, int]]) -> str:
+    """One-line rendering of the injection-step histogram (the pcStats
+    cycle plot, jsonParser.py:216-230, without the matplotlib dependency):
+    one block glyph per bin, height proportional to count."""
+    if not hist:
+        return "steps: (no runs)"
+    peak = max(c for _, _, c in hist) or 1
+    bars = "".join(
+        _SPARK_GLYPHS[(c * (len(_SPARK_GLYPHS) - 1)) // peak]
+        for _, _, c in hist)
+    lo, hi = hist[0][0], hist[-1][1]
+    return f"  steps {lo}-{hi}  {bars}  (peak {peak}/bin)"
+
+
+def histogram_json(hist: List[Tuple[int, int, int]]) -> Dict[str, object]:
+    """JSON document for ``--hist-out``: the pcStats data as machine-
+    readable bins rather than rendered text."""
+    return {"metric": "injection_step_histogram",
+            "bins": [{"lo": int(lo), "hi": int(hi), "count": int(c)}
+                     for lo, hi, c in hist],
+            "total": int(sum(c for _, _, c in hist))}
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -489,6 +541,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     compare_path: Optional[str] = None
     per_section = False
     histogram = False
+    hist_out: Optional[str] = None
     registers = False
     count_trap = False
     no_summary = False
@@ -507,6 +560,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif arg == "-p":
             per_section = True
         elif arg == "-c":
+            histogram = True
+        elif arg == "--hist-out" or arg.startswith("--hist-out="):
+            # pcStats JSON export; implies the histogram pass (-c).
+            if arg.startswith("--hist-out="):
+                hist_out = arg.partition("=")[2]
+            else:
+                i += 1
+                if i >= len(argv):
+                    print("ERROR: --hist-out needs a path", file=sys.stderr)
+                    return 2
+                hist_out = argv[i]
+            if not hist_out:
+                print("ERROR: --hist-out needs a path", file=sys.stderr)
+                return 2
             histogram = True
         elif arg == "-r":
             registers = True
@@ -574,7 +641,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             traps, timeouts = trap_counts(docs)
             print(f"traps: {traps} of {timeouts} timeouts")
         if histogram:
-            print(format_cycle_histogram(cycle_histogram(docs)))
+            hist = cycle_histogram(docs)
+            print(format_cycle_histogram(hist))
+            print(format_cycle_sparkline(hist))
+            if hist_out:
+                with open(hist_out, "w") as fh:
+                    json.dump(histogram_json(hist), fh, indent=1)
+                print(f"# wrote {hist_out}", file=sys.stderr)
     return 0
 
 

@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax.extend.core import Literal
 
-from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO
+from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO, KIND_STACK
 from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
 from coast_tpu.analysis.lint.findings import LintReport
 
@@ -428,6 +428,12 @@ def expected_sync_classes(region, cfg) -> Dict[str, Set[str]]:
                 if (not cfg.no_store_data_sync and name in flow.written
                         and not (cfg.protect_stack and spec.stack)):
                     expected[name].add("store_data")
+            elif spec.kind == KIND_STACK:
+                # Per-task kernel stacks: store-rule sync points voting
+                # under the 'stack' class (the engine's _sync_class_of for
+                # KIND_STACK leaves).
+                if not cfg.no_store_data_sync and name in flow.written:
+                    expected[name].add("stack")
         else:
             if spec.kind != KIND_RO and name in flow.written:
                 expected[name].add("sor_crossing")

@@ -79,9 +79,10 @@ class CampaignResult:
 
     @property
     def due(self) -> int:
-        """DUE bucket: aborts also count as timeouts in the reference's
-        summary (jsonParser.py:165-172)."""
-        return self.counts["due_abort"] + self.counts["due_timeout"]
+        """DUE bucket: aborts (and the stack-overflow / assert-fail
+        sub-buckets) also count as timeouts in the reference's summary
+        (jsonParser.py:165-172)."""
+        return sum(self.counts[k] for k in cls.DUE_CLASSES)
 
     def summary(self) -> Dict[str, object]:
         out = {

@@ -1,24 +1,39 @@
 """Run classification: the reference's SDC/DUE taxonomy as device-side codes.
 
 Mirrors the result-class lattice of supportClasses.py (RunResult /
-TimeoutResult / AbortResult / StackOverflowResult / InvalidResult) and the
-counting rules of jsonParser.summarizeRuns (jsonParser.py:148-201):
+TimeoutResult / AbortResult / StackOverflowResult / AssertionFailResult /
+InvalidResult) and the counting rules of jsonParser.summarizeRuns
+(jsonParser.py:148-201):
 
-  * abort and stack-overflow *also* count as timeouts (DUE) there; here
-    DUE_ABORT and DUE_TIMEOUT are distinct codes that both aggregate into
-    the DUE bucket.
+  * abort, stack-overflow, and assert-fail *also* count as timeouts (DUE)
+    there (the decoder classes of decoder.py:67-69); here they are
+    distinct codes that all aggregate into the DUE bucket
+    (``CampaignResult.due`` / ``Summary.due``).
   * a RunResult with errors>0 is SDC regardless of faults; faults>0 with
     errors==0 is a corrected run; otherwise success.
 
+DUE sub-buckets (the FreeRTOS production config's failure modes):
+``DUE_STACK_OVERFLOW`` is a tripped kernel stack check -- blown
+canary/watermark word or out-of-bounds saved stack pointer, the
+vApplicationStackOverflowHook class (decoder.py:69).  ``DUE_ASSERT`` is a
+tripped kernel/task assertion (the configASSERT class, decoder.py:67).
+Both are latched by a region's declared guards
+(Region.stack_guard/assert_guard), checked per lane like the replicated
+kernel's own checks in the reference rtos build.
+
 Precedence (a DWC abort freezes an incomplete results matrix, so E>0 there
-must not be read as SDC): INVALID > DUE_ABORT > DUE_TIMEOUT > SDC >
-CORRECTED > SUCCESS.
+must not be read as SDC; a guard that tripped names the failure more
+precisely than the generic abort): INVALID > DUE_STACK_OVERFLOW >
+DUE_ASSERT > DUE_ABORT > DUE_TIMEOUT > SDC > CORRECTED > SUCCESS.
 
 Timeout on TPU: "hang" is defined by the watchdog step bound
 (Region.max_steps; the reference arms a threading.Timer watchdog on every
 continue, gdbHandlers.py:22-47).  INVALID (unparseable UART in the
 reference, decoder.py:62-116) maps to a self-check result outside its
 representable domain -- reachable when a flip corrupts the check machinery.
+
+New codes append after the pre-existing six so that every recorded
+campaign log (codes are serialised as integers) keeps its meaning.
 """
 
 from __future__ import annotations
@@ -34,10 +49,18 @@ SDC = 2         # "errors" column: silent data corruption
 DUE_ABORT = 3   # DWC / CFCSS detected -> abort()
 DUE_TIMEOUT = 4  # watchdog bound hit (hang)
 INVALID = 5
+DUE_STACK_OVERFLOW = 6  # kernel stack check: blown canary / sp out of range
+DUE_ASSERT = 7          # kernel/task assertion tripped (configASSERT class)
 
-NUM_CLASSES = 6
+NUM_CLASSES = 8
 CLASS_NAMES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
-               "invalid")
+               "invalid", "due_stack_overflow", "due_assert")
+
+# The DUE bucket's members (abort/timeout/stack-overflow/assert all count
+# as DUE, jsonParser.py:165-172 "aborts also count as timeouts"); single
+# source of truth for CampaignResult.due / Summary.due.
+DUE_CLASSES = ("due_abort", "due_timeout", "due_stack_overflow",
+               "due_assert")
 
 
 def classify(rec: Dict[str, jax.Array], output_words: int) -> jax.Array:
@@ -49,6 +72,8 @@ def classify(rec: Dict[str, jax.Array], output_words: int) -> jax.Array:
     code = jnp.where(jnp.logical_not(rec["done"]), DUE_TIMEOUT, code)
     code = jnp.where(jnp.logical_or(rec["dwc_fault"], rec["cfc_fault"]),
                      DUE_ABORT, code)
+    code = jnp.where(rec["assert_fault"], DUE_ASSERT, code)
+    code = jnp.where(rec["stack_fault"], DUE_STACK_OVERFLOW, code)
     code = jnp.where(invalid, INVALID, code)
     return code.astype(jnp.int32)
 

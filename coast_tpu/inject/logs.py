@@ -73,6 +73,16 @@ def _result_dict(code: int, errors: int, corrected: int, steps: int,
     if code == cls.DUE_TIMEOUT:
         return {"trap": False, "timeout": f"hit step bound at {int(steps)}",
                 "timestamp": ts}
+    if code == cls.DUE_STACK_OVERFLOW:
+        # StackOverflowResult class: the guest's FreeRTOS hook line names
+        # the overflowing task (decoder.py:69); the batched campaign
+        # records which step the kernel's check tripped at instead.
+        return {"stackOverflow": f"stack check tripped at step {int(steps)}",
+                "taskName": "<kernel>", "timestamp": ts, "errors": 1}
+    if code == cls.DUE_ASSERT:
+        # AssertionFailResult class (decoder.py:67 configASSERT line).
+        return {"assertion": f"kernel assert tripped at step {int(steps)}",
+                "timestamp": ts, "errors": 1}
     return {"invalid": f"self-check out of domain (E={int(errors)})",
             "timestamp": ts}
 
@@ -244,6 +254,12 @@ def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
                           '%%(steps)d", "timestamp": "%s"}' % ts),
         cls.INVALID: ('{"invalid": "self-check out of domain '
                       '(E=%%(errors)d)", "timestamp": "%s"}' % ts),
+        cls.DUE_STACK_OVERFLOW: (
+            '{"stackOverflow": "stack check tripped at step %%(steps)d", '
+            '"taskName": "<kernel>", "timestamp": "%s", "errors": 1}' % ts),
+        cls.DUE_ASSERT: (
+            '{"assertion": "kernel assert tripped at step %%(steps)d", '
+            '"timestamp": "%s", "errors": 1}' % ts),
     }
     line_tpl = (
         '{"timestamp": "%s", "number": %%(i)d, "section": "%%(section)s", '

@@ -208,7 +208,12 @@ def section_filter(prog, section: str):
     if section in _KIND_SECTIONS:
         return _KIND_SECTIONS[section]
     if section == "stack":
-        names = [n for n, s in prog.region.spec.items() if s.stack]
+        # Both stack notions qualify: -protectStack return-address copies
+        # (LeafSpec.stack) and the RTOS kernel's per-task KIND_STACK
+        # stacks (coast_tpu.rtos).
+        from coast_tpu.ir.region import KIND_STACK
+        names = [n for n, s in prog.region.spec.items()
+                 if s.stack or s.kind == KIND_STACK]
         if not names:
             print(f"Error, {prog.region.name} has no stack-class leaves!",
                   file=sys.stderr)

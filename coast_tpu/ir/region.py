@@ -46,8 +46,15 @@ KIND_MEM = "mem"    # written memory (store sync points)
 KIND_REG = "reg"    # loop-carried data registers
 KIND_CTRL = "ctrl"  # control state: loop counters, predicates (terminator sync)
 KIND_RO = "ro"      # read-only inputs (.rodata); never written by step()
+# Per-task call stacks of an RTOS kernel region (coast_tpu.rtos): memory
+# semantics (store-synced when written) but its own section kind so
+# campaign attribution can separate stack hits from heap/TCB hits --
+# exactly the reference injector's distinct 'stack' ELF section
+# (supervisor.py:340 section list).  Votes on these leaves are tagged
+# with the 'stack' sync class.
+KIND_STACK = "stack"
 
-_VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO)
+_VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO, KIND_STACK)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,10 +78,21 @@ class LeafSpec:
     # ProtectionConfig.protect_stack is set these leaves are voted every
     # step regardless of the per-kind sync flags.
     stack: bool = False
+    # KIND_STACK leaves only: the flat word index (within each lane) of the
+    # canary/watermark word guarding the stack -- the FreeRTOS
+    # tskSTACK_FILL_BYTE pattern at the stack limit that
+    # taskCHECK_FOR_STACK_OVERFLOW inspects.  Pure metadata for tooling
+    # (lint preflight verifies the init image holds the declared canary);
+    # the region's ``stack_guard`` owns the runtime check.
+    canary_word: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in _VALID_KINDS:
             raise ValueError(f"bad leaf kind {self.kind!r}; one of {_VALID_KINDS}")
+        if self.canary_word is not None and self.kind != KIND_STACK:
+            raise ValueError(
+                f"canary_word is only meaningful on {KIND_STACK!r} leaves, "
+                f"not {self.kind!r}")
 
 
 class FnNamespace:
@@ -144,6 +162,19 @@ class Region:
     functions: Dict[str, Callable] = dataclasses.field(default_factory=dict)
     # Extra metadata (benchmark golden values etc.)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # RTOS kernel guards (None for regions without a kernel model).  Both
+    # take a single-lane state view and return a bool scalar (True =
+    # tripped).  The engine evaluates them PER LANE on the stepped,
+    # pre-vote state -- the replicated kernel's own checks run inside each
+    # replica in the reference rtos build, firing before any store-sync
+    # vote repairs the corruption they saw:
+    #   * ``stack_guard``: taskCHECK_FOR_STACK_OVERFLOW -- blown
+    #     canary/watermark word or saved stack pointer out of bounds;
+    #     latches DUE_STACK_OVERFLOW (decoder.py:69 hook line class).
+    #   * ``assert_guard``: configASSERT -- a kernel/task invariant does
+    #     not hold; latches DUE_ASSERT (decoder.py:67 class).
+    stack_guard: Optional[Callable[[State], jax.Array]] = None
+    assert_guard: Optional[Callable[[State], jax.Array]] = None
 
     def leaf_is_xmr(self, name: str) -> bool:
         """Resolve the replication scope of a leaf (annotation > default)."""

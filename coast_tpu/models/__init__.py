@@ -115,6 +115,12 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     # RTOS-scale scope-config demonstrator (rtos/pynq rtos_mm analogue,
     # §2.3 #33); canonical config in rtos/.
     "rtos_app": _lazy("rtos_app"),
+    # Preemptive RTOS kernel targets (coast_tpu.rtos): tick-driven
+    # scheduler with per-task stacks/TCBs and the DUE sub-bucket guards
+    # (stack overflow / assert); canonical builds in rtos/Makefile +
+    # rtos/kernel.config.
+    "rtos_mm": _lazy("rtos_kernel", "make_rtos_mm"),
+    "rtos_kUser": _lazy("rtos_kernel", "make_rtos_kuser"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

@@ -1,0 +1,12 @@
+"""Registry shim for the RTOS kernel targets.
+
+The kernel model lives in its own subsystem (coast_tpu.rtos); this module
+exists so the benchmark registry's modname convention (model_source
+resolves ``coast_tpu.models.<modname>`` to the file recorded as line 1 of
+reference-container campaign logs) covers the rtos_mm / rtos_kUser
+targets too.
+"""
+
+from coast_tpu.rtos.apps import make_rtos_kuser, make_rtos_mm
+
+__all__ = ["make_rtos_mm", "make_rtos_kuser"]

@@ -53,6 +53,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if os.path.exists(_LIB_PATH):
         try:
             lib = ctypes.CDLL(_LIB_PATH)
+            try:
+                lib.coast_abi_version.argtypes = []
+                lib.coast_abi_version.restype = ctypes.c_int32
+            except AttributeError:
+                pass
             lib.coast_rand64.argtypes = [
                 ctypes.c_uint64, ctypes.c_int64,
                 np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")]
@@ -105,6 +110,25 @@ def native_available() -> bool:
     return get_lib() is not None
 
 
+# Class-taxonomy ABI this Python layer speaks: must match the NUM_CLASSES
+# result codes of inject/classify.py.  The ndjson entry points refuse an
+# older .so (missing or lower coast_abi_version): a pre-sub-bucket binary
+# would render DUE_STACK_OVERFLOW/DUE_ASSERT rows as malformed (-2) or
+# classify their result keys into 'invalid' -- silent divergence from the
+# Python paths, which is worse than falling back to them.
+NDJSON_ABI = 2
+NUM_CLASSES = 8
+
+
+def _ndjson_lib() -> Optional[ctypes.CDLL]:
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "coast_abi_version"):
+        return None
+    if lib.coast_abi_version() < NDJSON_ABI:
+        return None
+    return lib
+
+
 def splitmix_fill(seed: int, n: int) -> np.ndarray:
     """n counter-mode splitmix64 draws (uint64).  Counter-based (value i =
     finalizer(seed + (i+1)*golden)) so the C++ and numpy paths are trivially
@@ -136,7 +160,7 @@ def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
     writing anything) when the native core is unavailable, so the caller
     can fall back to the Python loop; raises on malformed input, which
     indicates a bug rather than a missing compiler."""
-    lib = get_lib()
+    lib = _ndjson_lib()
     if lib is None or not hasattr(lib, "coast_ndjson_encode"):
         return False
     n_leaves = len(sec_kind_by_leaf)
@@ -187,13 +211,14 @@ def ndjson_classify_stream(read_chunk, chunk_bytes: int = 32 << 20):
 
     ``read_chunk(n)`` returns up to n bytes (an open binary file's
     ``read``); partial trailing lines are carried across chunks.  Returns
-    ``(counts[6], step_sum, step_n, n_lines)`` or None when the native
-    core is unavailable; raises ValueError if a line is not
-    InjectionLog-shaped (caller falls back to the Python parser)."""
-    lib = get_lib()
+    ``(counts[NUM_CLASSES], step_sum, step_n, n_lines)`` or None when the
+    native core is unavailable (or predates the current class-taxonomy
+    ABI); raises ValueError if a line is not InjectionLog-shaped (caller
+    falls back to the Python parser)."""
+    lib = _ndjson_lib()
     if lib is None or not hasattr(lib, "coast_ndjson_classify"):
         return None
-    counts = np.zeros(6, np.int64)
+    counts = np.zeros(NUM_CLASSES, np.int64)
     step_sum = ctypes.c_int64(0)
     step_n = ctypes.c_int64(0)
     total = 0

@@ -97,6 +97,15 @@ inline void put_i64(Writer& w, int64_t v) {
 
 extern "C" {
 
+// ABI version of the class taxonomy the ndjson entry points speak.
+// Version 2 added the DUE sub-bucket classes (DUE_STACK_OVERFLOW=6,
+// DUE_ASSERT=7): counts arrays are 8 slots and the encoder/classifier
+// know the stackOverflow/assertion result templates.  Python callers
+// check this BEFORE using the ndjson paths: an older .so (rebuild failed
+// on a compiler-less host) must degrade to the Python formatter/parser,
+// never silently misclassify the new codes.
+int32_t coast_abi_version(void) { return 2; }
+
 void coast_rand64(uint64_t seed, int64_t n, uint64_t* out) {
   for (int64_t i = 0; i < n; ++i) out[i] = splitmix_at(seed, (uint64_t)i);
 }
@@ -204,7 +213,8 @@ int32_t coast_cfcss_assign(int32_t n, int32_t n_edges, const int32_t* edges,
 // searched only INSIDE the result object (the "name"/"symbol" fields can
 // legitimately contain "<invalid-line>").
 //
-// counts must hold 6 zeroed int64 (SUCCESS..INVALID, classify.py order).
+// counts must hold 8 zeroed int64 (SUCCESS..DUE_ASSERT, classify.py
+// order; the DUE sub-bucket classes appended after INVALID).
 // Returns the number of lines classified, or -1 if any non-empty line
 // lacks the "result" marker (caller falls back to the Python parser).
 int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
@@ -232,6 +242,7 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
                            // string, null) gets Python's quirky membership
                            // semantics, so the caller must fall back.
     bool invalid = false, timeout = false, message = false, core = false;
+    bool stack_overflow = false, assertion = false;
     int64_t errors = 0, faults = 0, runtime = 0;
   };
   auto scan_result = [](const char* q, const char* end) -> ResultKeys {
@@ -276,6 +287,8 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
               return klen == n && std::memcmp(kb, w, n) == 0;
             };
             if (is("invalid", 7)) r.invalid = true;
+            else if (is("stackOverflow", 13)) r.stack_overflow = true;
+            else if (is("assertion", 9)) r.assertion = true;
             else if (is("timeout", 7)) r.timeout = true;
             else if (is("message", 7)) r.message = true;
             else if (is("core", 4)) r.core = true;
@@ -348,6 +361,10 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
     if (!rk.object) return -1;
     if (rk.invalid) {
       counts[5]++;
+    } else if (rk.stack_overflow) {
+      counts[6]++;
+    } else if (rk.assertion) {
+      counts[7]++;
     } else if (rk.timeout) {
       counts[4]++;
     } else if (rk.message) {
@@ -374,7 +391,8 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
 // formatter.  String fields (section kind/name, timestamp) arrive
 // pre-JSON-escaped from Python -- per-campaign work, not per-row.  Class
 // codes match inject/classify.py (asserted at the call site):
-//   0 SUCCESS, 1 CORRECTED, 2 SDC, 3 DUE_ABORT, 4 DUE_TIMEOUT, 5 INVALID.
+//   0 SUCCESS, 1 CORRECTED, 2 SDC, 3 DUE_ABORT, 4 DUE_TIMEOUT, 5 INVALID,
+//   6 DUE_STACK_OVERFLOW, 7 DUE_ASSERT.
 // Rows with t < 0 are cache draws outside the program footprint (never
 // fired) and attribute to the "cache-invalid" pseudo-section.
 //
@@ -468,6 +486,20 @@ int64_t coast_ndjson_encode(
         put_lit(w, ")\", \"timestamp\": \"");
         put_str(w, ts, ts_len);
         put_lit(w, "\"}");
+        break;
+      case 6:  // DUE_STACK_OVERFLOW
+        put_lit(w, "{\"stackOverflow\": \"stack check tripped at step ");
+        put_i64(w, steps[i]);
+        put_lit(w, "\", \"taskName\": \"<kernel>\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"errors\": 1}");
+        break;
+      case 7:  // DUE_ASSERT
+        put_lit(w, "{\"assertion\": \"kernel assert tripped at step ");
+        put_i64(w, steps[i]);
+        put_lit(w, "\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"errors\": 1}");
         break;
       default:
         return -2;

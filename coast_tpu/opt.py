@@ -343,6 +343,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"{k}={v:#010x}" for k, v in digest.items()))
 
     errors = int(rec["errors"])
+    if bool(rec.get("stack_fault", False)):
+        # The FreeRTOS stack-overflow hook line the decoder recognises
+        # (decoder.py:69): the kernel's canary/watermark check tripped.
+        print("HALT: stack overflow in task <kernel>", file=sys.stderr)
+        return 134
+    if bool(rec.get("assert_fault", False)):
+        # configASSERT class (decoder.py:67): assert() calls abort().
+        print("ASSERT FAILED: kernel invariant", file=sys.stderr)
+        return 134
     if bool(rec["dwc_fault"]):
         # FAULT_DETECTED_DWC -> abort(): no UART success line is printed
         # (decoder.py classifies the absence as abort/DUE).

@@ -40,7 +40,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO, Region, State
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, KIND_STACK,
+                                 Region, State)
 from coast_tpu.ops import voters
 from coast_tpu.ops.bitflip import make_flipper
 
@@ -157,7 +158,8 @@ class ProtectionConfig:
             return False
         if name in self.xmr_globals:
             return True
-        if self.no_mem_replication and region.spec[name].kind in (KIND_MEM, KIND_RO):
+        if self.no_mem_replication and region.spec[name].kind in (
+                KIND_MEM, KIND_RO, KIND_STACK):
             return False
         if region.spec[name].kind == KIND_RO:
             # Read-only inputs are never cloned: same rule as constants /
@@ -171,11 +173,22 @@ def _flags_init(cfg: ProtectionConfig) -> Dict[str, jax.Array]:
     return {
         "dwc_fault": jnp.bool_(False),      # DWC miscompare latched -> DUE
         "cfc_fault": jnp.bool_(False),      # CFCSS signature fault -> DUE
+        # RTOS kernel guard latches (Region.stack_guard/assert_guard):
+        # stack check / configASSERT trip -> their own DUE sub-buckets.
+        "stack_fault": jnp.bool_(False),
+        "assert_fault": jnp.bool_(False),
         "tmr_cnt": jnp.int32(0),            # TMR_ERROR_CNT
         "sync_cnt": jnp.int32(0),           # __SYNC_COUNT
         "steps": jnp.int32(0),              # guest runtime T in steps
         "done": jnp.bool_(False),
     }
+
+
+def _halted(flags: Dict[str, jax.Array]) -> jax.Array:
+    """A run stops evolving once ANY terminal latch is set: completion,
+    DWC/CFCSS abort, or a tripped kernel guard."""
+    return (flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
+            | flags["stack_fault"] | flags["assert_fault"])
 
 
 class ProtectedProgram:
@@ -223,7 +236,7 @@ class ProtectedProgram:
                 self.step_sync[name] = ((in_store and not cfg.no_store_addr_sync)
                                         or not (in_load or in_store))
                 self.pre_sync[name] = in_load and not cfg.no_load_sync
-            elif spec.kind == KIND_MEM:
+            elif spec.kind in (KIND_MEM, KIND_STACK):
                 # Store-data sync exists where STORES exist: the reference
                 # inserts its voter at each store site (syncStoreInst,
                 # synchronization.cpp:476-561), so a leaf the step never
@@ -232,6 +245,8 @@ class ProtectedProgram:
                 # the written leaves' votes, exactly as in the reference.
                 # This is also the flagship HBM win: mm1024's never-written
                 # operand matrices are 2/3 of the per-step voter traffic.
+                # KIND_STACK (per-task kernel stacks) follows the same
+                # store rule; its votes carry the 'stack' sync class tag.
                 self.step_sync[name] = (not cfg.no_store_data_sync
                                         and name in flow.written)
             else:  # reg: registers are voted only where used by a sync point
@@ -372,6 +387,8 @@ class ProtectedProgram:
             return "store_data"
         if spec.kind == KIND_CTRL:
             return "ctrl"
+        # KIND_STACK kernel stacks and -protectStack register copies both
+        # vote under the 'stack' class.
         return "stack"
 
     # -- lane execution -----------------------------------------------------
@@ -466,8 +483,7 @@ class ProtectedProgram:
     def step(self, pstate: State, flags: Dict[str, jax.Array],
              t: jax.Array) -> Tuple[State, Dict[str, jax.Array]]:
         cfg = self.cfg
-        halted = jnp.logical_or(flags["done"], flags["dwc_fault"])
-        halted = jnp.logical_or(halted, flags["cfc_fault"])
+        halted = _halted(flags)
 
         region_state = {k: pstate[k] for k in self.region.spec}
         miscompares = []
@@ -509,6 +525,38 @@ class ProtectedProgram:
                          if k not in self.region.spec}}
 
         laned, call_mis = self._run_lanes(region_state, t)
+
+        # Kernel guards: the RTOS stack check / configASSERT of
+        # coast_tpu.rtos regions, evaluated PER LANE on the stepped,
+        # PRE-VOTE state -- the replicated kernel's own check code runs
+        # inside each replica in the reference rtos build, so a blown
+        # canary in one clone's stack trips the hook even though the
+        # store-sync vote would have repaired that lane at commit
+        # (detection is not maskable by TMR; the reference's TMR FreeRTOS
+        # campaigns record stack-overflow DUEs for exactly this reason).
+        # The lane collapse of the any() reduction is sanctioned for the
+        # replication linter by tagging every guard input with the
+        # 'guard' sync class (a detector, like a voter compare).
+        trip_stack = jnp.bool_(False)
+        trip_assert = jnp.bool_(False)
+        if (self.region.stack_guard is not None
+                or self.region.assert_guard is not None):
+            gview = {name: voters.sync_tag(laned[name], "guard", name)
+                     for name in laned}
+            if self.region.stack_guard is not None:
+                trip_stack = jnp.any(jax.vmap(self.region.stack_guard)(gview))
+            if self.region.assert_guard is not None:
+                trip_assert = jnp.any(
+                    jax.vmap(self.region.assert_guard)(gview))
+            trip_stack = jnp.logical_and(~halted, trip_stack)
+            trip_assert = jnp.logical_and(~halted, trip_assert)
+            flags = {**flags,
+                     "stack_fault": jnp.logical_or(flags["stack_fault"],
+                                                   trip_stack),
+                     "assert_fault": jnp.logical_or(flags["assert_fault"],
+                                                    trip_assert)}
+        trip_now = jnp.logical_or(trip_stack, trip_assert)
+
         # Call-boundary syncs executed by function-scope wrappers inside the
         # lane trace (processCallSync, synchronization.cpp:563-738): each
         # entry is one vote/compare at a sub-function call site.
@@ -648,9 +696,14 @@ class ProtectedProgram:
         # (syncTerminator votes branch predicates, :741-1113).
         commit_halt = jnp.logical_or(halted, fault_now)
         done_now = self.region.done(self._voted_view(new_state))
+        # A step whose kernel guard tripped still commits (the blown-stack
+        # image is the memory a debugger reads at the hook) but cannot
+        # reach completion: the hook preempts the guest before any success
+        # line, exactly like the reference's overflow/assert hooks.
+        done_gate = jnp.logical_and(~commit_halt, ~trip_now)
         flags = {**flags,
                  "done": jnp.logical_or(flags["done"],
-                                        jnp.logical_and(~commit_halt, done_now)),
+                                        jnp.logical_and(done_gate, done_now)),
                  "steps": flags["steps"] + jnp.where(commit_halt, 0, 1)}
 
         # Freeze state once halted (DWC abort semantics in a batch: the run's
@@ -719,7 +772,7 @@ class ProtectedProgram:
 
         def body(carry, t):
             pstate, flags = carry
-            halted = flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
+            halted = _halted(flags)
             if fault is not None:
                 # No injection once halted: the reference's sleep window is
                 # bounded by the measured runtime, so flips always land in a
@@ -766,9 +819,7 @@ class ProtectedProgram:
 
             def cond(carry):
                 (pstate, flags), t = carry
-                live = ~(flags["done"] | flags["dwc_fault"]
-                         | flags["cfc_fault"])
-                return jnp.logical_and(t < limit, live)
+                return jnp.logical_and(t < limit, ~_halted(flags))
 
             def guarded(carry, t):
                 """One sub-step, masked to a no-op past the watchdog bound
@@ -810,10 +861,16 @@ class ProtectedProgram:
                 _, m = self._vote(lanes, self.cfg.num_clones)
                 mis = jnp.logical_or(mis, m)
                 mis_cnt = mis_cnt + m.astype(jnp.int32)
+            # Only a run that completed without ANY detected fault (abort
+            # or kernel-guard trip) reaches the external call.
             reached_call = jnp.logical_and(
                 flags["done"], jnp.logical_not(flags["dwc_fault"]))
             reached_call = jnp.logical_and(
                 reached_call, jnp.logical_not(flags["cfc_fault"]))
+            reached_call = jnp.logical_and(
+                reached_call, jnp.logical_not(flags["stack_fault"]))
+            reached_call = jnp.logical_and(
+                reached_call, jnp.logical_not(flags["assert_fault"]))
             if self.cfg.num_clones == 2:
                 flags = {**flags,
                          "dwc_fault": jnp.logical_or(
@@ -833,6 +890,8 @@ class ProtectedProgram:
             "done": flags["done"],
             "dwc_fault": flags["dwc_fault"],
             "cfc_fault": flags["cfc_fault"],
+            "stack_fault": flags["stack_fault"],
+            "assert_fault": flags["assert_fault"],
             "output": self.region.output(view),
         }
         if trace:

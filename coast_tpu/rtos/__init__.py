@@ -1,0 +1,39 @@
+"""coast_tpu.rtos: the preemptive RTOS kernel model subsystem.
+
+The reference's canonical *production* configuration is a FreeRTOS port:
+rtos/pynq builds the kernel + app sources under ``-TMR -countErrors`` with
+dozens-long scope lists, and its campaigns corrupt preemptive-task state --
+per-task stacks, TCBs, the ready list, the current-task pointer -- with
+stack overflows and assertion failures decoded as their own DUE classes
+(supportClasses.py:278-389; decoder.py:67-69).
+
+This package is that capability re-expressed on the stepped region model:
+
+  * :mod:`coast_tpu.rtos.kernel` -- a tick-driven preemptive round-robin
+    scheduler as a protected region.  Every step is one tick interrupt:
+    save the running task's context onto its stack, pick the next ready
+    task, restore its context, run one slice of it.  Per-task stacks are
+    ``KIND_STACK`` leaves with a canary/watermark word; TCB saved-SP
+    words, the ready list and the current-task pointer are ordinary
+    injectable leaves, each independently corruptible per lane.
+  * :mod:`coast_tpu.rtos.apps` -- the task sets: ``rtos_mm`` (the
+    matrix-multiply workload of the reference's rtos_mm target) and
+    ``rtos_kUser`` (a producer/consumer queue app, the kernel+user
+    protection-scope split of rtos_kUser).
+
+The kernel regions declare ``stack_guard`` / ``assert_guard`` hooks: the
+engine evaluates them per lane on pre-vote state (the replicated kernel's
+own checks), latching ``DUE_STACK_OVERFLOW`` / ``DUE_ASSERT`` -- the DUE
+sub-bucket taxonomy that flows through inject/classify -> inject/logs ->
+analysis/json_parser -> scripts/mwtf_report.
+
+Canonical build config: ``rtos/Makefile`` (targets ``rtos_mm`` /
+``rtos_kUser``) + ``rtos/kernel.config`` (the file half of the scope
+lists), mirroring the reference's Makefile/functions.config split.
+"""
+
+from coast_tpu.rtos.kernel import (CANARY, FRAME_WORDS, N_TASKS,
+                                   STACK_WORDS, make_kernel_region)
+
+__all__ = ["make_kernel_region", "CANARY", "N_TASKS", "STACK_WORDS",
+           "FRAME_WORDS"]

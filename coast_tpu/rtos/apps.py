@@ -1,0 +1,87 @@
+"""RTOS application task sets: the rtos_mm / rtos_kUser targets.
+
+The reference builds two FreeRTOS app flavours under the production COAST
+config (rtos/pynq/Makefile): ``rtos_mm`` runs the matrix-multiply workload
+as preemptive tasks, ``rtos_kUser`` protects kernel AND user code of a
+queue-passing user app.  Each task function here is one *slice* of its
+task -- the work between two tick interrupts -- over the task's restored
+register file ``regs`` ([acc, x, scratch, count], FRAME_WORDS words):
+
+    task(regs, d, seed, tick, qin) -> regs'
+
+``d`` is the tick's input word, ``seed`` the tick entropy stream, ``qin``
+the queue-receive view (consumer tasks).  Task state lives ONLY in regs:
+between slices it sits as a saved frame on the task's stack, which is
+what makes stack corruption consequential.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from coast_tpu.rtos.kernel import MASK, make_kernel_region
+
+
+def _pack(acc, x, scratch, count):
+    return (jnp.stack([acc, x, scratch, count])
+            & jnp.int32(MASK)).astype(jnp.int32)
+
+
+# -- rtos_mm: the matrix-multiply workload as tasks -------------------------
+
+def task_mm(regs, d, seed, tick, qin):
+    """Multiply-accumulate worker (the rtos_mm payload)."""
+    acc = regs[0] + d * d
+    return _pack(acc, d, regs[2] ^ acc, regs[3] + 1)
+
+
+def task_crc(regs, d, seed, tick, qin):
+    """CRC-ish fold worker."""
+    x = (regs[0] ^ d) & jnp.int32(0xFFFF)
+    acc = ((regs[0] << 5) ^ (x * jnp.int32(0x5BD1)) ^ (x >> 3))
+    return _pack(acc, x, regs[2] + d, regs[3] + 1)
+
+
+def task_idle(regs, d, seed, tick, qin):
+    """Idle/heartbeat task: checksum over the tick entropy."""
+    acc = regs[0] + tick * jnp.int32(31) + (seed & jnp.int32(0xFFFF))
+    return _pack(acc, seed, regs[2], regs[3] + 1)
+
+
+def make_rtos_mm():
+    return make_kernel_region(
+        name="rtos_mm",
+        tasks=(task_mm, task_crc, task_idle),
+        task_init=(0, 0x1D0F, 0),
+        task_names=("task_mm", "task_crc", "task_idle"),
+        ticks=48, quota=10)
+
+
+# -- rtos_kUser: queue-passing user app (kernel+user protection scope) ------
+
+def task_prod(regs, d, seed, tick, qin):
+    """Producer: derives a message from the tick entropy and its own
+    running state; the kernel queue_send publishes it."""
+    acc = (regs[0] * jnp.int32(0x9E3B) + (seed & jnp.int32(0xFFFFF)) + d)
+    return _pack(acc, seed, regs[2] ^ d, regs[3] + 1)
+
+
+def task_cons(regs, d, seed, tick, qin):
+    """Consumer: folds the queue-receive view into its accumulator."""
+    acc = ((regs[0] << 3) ^ qin ^ (regs[0] >> 11)) + jnp.int32(0x101)
+    return _pack(acc, qin, regs[2] + qin, regs[3] + 1)
+
+
+def task_wdg(regs, d, seed, tick, qin):
+    """Watchdog/idle: liveness counter over ticks."""
+    acc = regs[0] + (tick ^ jnp.int32(0x5A5)) + 1
+    return _pack(acc, tick, regs[2], regs[3] + 1)
+
+
+def make_rtos_kuser():
+    return make_kernel_region(
+        name="rtos_kUser",
+        tasks=(task_prod, task_cons, task_wdg),
+        task_init=(1, 0, 0),
+        task_names=("task_prod", "task_cons", "task_wdg"),
+        ticks=60, quota=12)

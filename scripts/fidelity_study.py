@@ -80,7 +80,9 @@ def section_table(res, mmap):
 
 
 def harm(row):
-    return row["sdc"] + row["due_abort"] + row["due_timeout"] + row["invalid"]
+    return (row["sdc"] + row["due_abort"] + row["due_timeout"]
+            + row.get("due_stack_overflow", 0) + row.get("due_assert", 0)
+            + row["invalid"])
 
 
 def population_harm_rate(table):

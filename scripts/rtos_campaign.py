@@ -1,0 +1,154 @@
+"""Seeded RTOS kernel campaign with DUE sub-buckets + section attribution.
+
+The acceptance artifact for the RTOS kernel subsystem: a seeded campaign
+on an ``rtos_*`` target under the canonical production config (rtos/
+Makefile: -TMR -countErrors + the rtos/kernel.config scope lists) that
+records injections classified ``due_stack_overflow`` (corrupted stack
+pointer / blown canary) and ``due_assert`` (tripped scheduler assert),
+both aggregating into the DUE bucket, with:
+
+  * the reference-style summary (three DUE sub-counts) as printed by
+    ``coast_tpu.analysis.json_parser``;
+  * per-section attribution rolled up into the kernel's stack / TCB /
+    task-data categories (region.meta["rtos_sections"]).
+
+Writes ``artifacts/rtos_campaign.json`` plus a columnar campaign log next
+to it, and exits nonzero if either sub-bucket is empty (the acceptance
+bar is a recorded fact, not a hope).
+
+Usage: python scripts/rtos_campaign.py [-n 2048] [--seed 42]
+       [--benchmark rtos_mm] [--out artifacts/rtos_campaign.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The rtos/Makefile CL half of the canonical config, per target.
+CL_LISTS = {
+    "rtos_mm": {"cloneFns": ["task_mm", "task_crc", "task_idle"],
+                "protectedLibFn": ["queue_send"],
+                "cloneGlbls": ["qbuf", "stacks"]},
+    "rtos_kUser": {"cloneFns": ["push_frame", "pop_frame", "pick_next",
+                                "task_prod", "task_cons", "task_wdg"],
+                   "protectedLibFn": ["queue_send"],
+                   "cloneGlbls": ["qbuf", "stacks"]},
+}
+
+
+def canonical_prog(benchmark: str, num_clones: int = 3):
+    from coast_tpu import DWC, TMR
+    from coast_tpu.interface.config import parse_config_file
+    from coast_tpu.models import REGISTRY
+    scope = parse_config_file(os.path.join(ROOT, "rtos", "kernel.config"),
+                              required=True)
+    scope.merge_cl({k: list(v) for k, v in CL_LISTS[benchmark].items()})
+    make = TMR if num_clones == 3 else DWC
+    return make(REGISTRY[benchmark](), count_errors=True,
+                **scope.protection_overrides())
+
+
+def category_table(res, mmap, categories):
+    """Per-section class counts rolled up into the stack/TCB/task-data
+    categories the kernel's meta declares."""
+    import numpy as np
+
+    from coast_tpu.inject import classify as cls
+    cat_of = {leaf: cat for cat, leaves in categories.items()
+              for leaf in leaves}
+    lid = np.asarray(res.schedule.leaf_id)
+    codes = np.asarray(res.codes)
+    out = {}
+    for s in mmap.sections:
+        cat = cat_of.get(s.name, "task_data")
+        row = out.setdefault(cat, {name: 0 for name in cls.CLASS_NAMES})
+        row.setdefault("injections", 0)
+        mask = lid == s.leaf_id
+        binc = np.bincount(codes[mask], minlength=cls.NUM_CLASSES)
+        row["injections"] += int(mask.sum())
+        for i, name in enumerate(cls.CLASS_NAMES):
+            row[name] += int(binc[i])
+    for row in out.values():
+        row["due"] = sum(row[k] for k in cls.DUE_CLASSES)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=2048)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--benchmark", default="rtos_mm",
+                    choices=sorted(CL_LISTS))
+    ap.add_argument("--out", default="artifacts/rtos_campaign.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.logs import write_columnar
+
+    prog = canonical_prog(args.benchmark)
+    # Preflight: a campaign over a kernel whose redundancy was compiled
+    # away would measure nothing (static rules only; the survival compile
+    # is the lint CLI's job).
+    runner = CampaignRunner(prog, strategy_name="TMR", preflight="static")
+    res = runner.run(args.n, seed=args.seed, batch_size=args.batch)
+
+    log_path = os.path.splitext(args.out)[0] + "_log.json"
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    write_columnar(res, runner.mmap, log_path)
+
+    summary = jp.summarize_path(log_path)
+    print(summary.format())
+    table = jp.section_stats([jp.read_json_file(log_path)])
+    print(jp.format_section_stats(table))
+
+    categories = prog.region.meta["rtos_sections"]
+    cats = category_table(res, runner.mmap, categories)
+
+    record = {
+        "metric": "rtos_campaign",
+        "benchmark": args.benchmark,
+        "strategy": "TMR -countErrors (canonical rtos/Makefile config)",
+        "backend": jax.default_backend(),
+        "seed": args.seed,
+        "injections": res.n,
+        "counts": res.counts,
+        "due_total": res.due,
+        "due_sub_buckets": {
+            "aborts": res.counts["due_abort"],
+            "stack_overflows": res.counts["due_stack_overflow"],
+            "assert_fails": res.counts["due_assert"],
+            "timeouts": res.counts["due_timeout"],
+        },
+        "injections_per_sec": round(res.injections_per_sec, 2),
+        "section_attribution": cats,
+        "per_symbol": table,
+        "log": os.path.basename(log_path),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(record, fh, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": args.out,
+                      "due_stack_overflow": res.counts["due_stack_overflow"],
+                      "due_assert": res.counts["due_assert"]}))
+
+    if not (res.counts["due_stack_overflow"] and res.counts["due_assert"]):
+        print("ERROR: campaign recorded no stack-overflow or no assert "
+              "DUEs; acceptance bar not met", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,8 +4,14 @@
 # stretches (probes block inside backend init) and has held windows as
 # short as ~10 minutes, so:
 #   * every stage runs under a hard timeout;
-#   * stages run in priority order (bench first -- the round record);
-#   * each stage commits its artifacts on success immediately;
+#   * bench.py runs on EVERY successful up-probe (not once): each window
+#     refreshes artifacts/bench_full.json + last_tpu_bench.json, so the
+#     next BENCH_*.json round record reads a fresh on-chip measurement
+#     instead of a stale CPU fallback.  bench.py itself supervises the
+#     claim (stale-own-worker kill + claim-timeout retry with backoff)
+#     and reports per-stage spawn/init/dispatch progress into the log;
+#   * the remaining stages run in priority order, each commits its
+#     artifacts on success immediately;
 #   * per-stage completion is tracked in a state dir, and unfinished
 #     stages are re-attempted on later tunnel windows until all pass.
 #
@@ -20,10 +26,15 @@ mkdir -p "$STATE"
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
-# run_stage <name> <timeout_s> <cmd...>
+# run_stage [-f] <name> <timeout_s> <cmd...>
+# -f (refresh): run even when the .done marker exists -- the stage
+# re-runs on every tunnel window and re-commits its artifacts whenever
+# they changed; the marker is still written so all_done() can terminate.
 run_stage() {
+  local refresh=0
+  if [ "$1" = "-f" ]; then refresh=1; shift; fi
   local name=$1 tmo=$2; shift 2
-  if [ -e "$STATE/$name.done" ]; then return 0; fi
+  if [ "$refresh" -eq 0 ] && [ -e "$STATE/$name.done" ]; then return 0; fi
   # Re-probe before each stage: a wedge in stage k must not burn the
   # remaining stages' timeouts against a dead tunnel.
   if ! timeout "$PROBE_S" python -c \
@@ -64,9 +75,11 @@ while true; do
       "import jax, jax.numpy as jnp; jnp.add(1,1).block_until_ready(); assert jax.default_backend() == 'tpu'" \
       >/dev/null 2>&1; then
     note "tunnel up -- running capture suite (pending stages)"
-    # bench.py supervises itself (420s init + retry + 900s run budgets);
-    # the outer bound only guards against a hang beyond its own design.
-    run_stage bench             2700 python bench.py
+    # bench.py supervises itself (420s init + claim-backoff retries +
+    # 900s run budgets, stale-worker cleanup); the outer bound only
+    # guards against a hang beyond its own design.  Refreshed EVERY
+    # window (-f) so the artifacts always hold the latest on-chip numbers.
+    run_stage -f bench          3600 python bench.py
     run_stage unroll_sweep      2700 python -u scripts/unroll_sweep.py
     run_stage mfu_sweep         2700 python -u scripts/mfu_sweep.py
     run_stage flagship_campaign 2400 python -u scripts/flagship_campaign.py

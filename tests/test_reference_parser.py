@@ -163,6 +163,35 @@ def test_reference_jsonparser_compare_mode(campaign, tmp_path):
     assert float(m.group(1)) > 0
 
 
+def test_reference_jsonparser_rtos_due_sub_buckets(tmp_path):
+    """DUE sub-bucket aggregation parity against the UNMODIFIED reference
+    consumer: a kernel campaign's stack-overflow / assert-fail results
+    must fold into the reference tool's Timeouts row exactly as its own
+    StackOverflowResult / AssertionFailResult do ("aborts also count as
+    timeouts", jsonParser.py:165-172)."""
+    if not os.path.isdir(REF_PLATFORM):
+        pytest.skip("reference checkout not present")
+    from coast_tpu.analysis import json_parser as jp
+    from scripts.rtos_campaign import canonical_prog
+
+    runner = CampaignRunner(canonical_prog("rtos_mm"), strategy_name="TMR")
+    res = runner.run(256, seed=42, batch_size=128)
+    assert res.counts["due_stack_overflow"] > 0
+    assert res.counts["due_assert"] > 0
+    ref_path = str(tmp_path / "rtos_mm_TMR_ref.json")
+    write_reference_json(res, runner.mmap, ref_path)
+    mine = jp.summarize_path(ref_path)
+    assert mine.counts["success"] > 0     # otherStats premise guard
+
+    proc = subprocess.run(
+        [sys.executable, "jsonParser.py", ref_path],
+        cwd=REF_PLATFORM, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    m = re.search(r"Timeouts:\s+(\d+) \(", proc.stdout)
+    assert m, proc.stdout
+    assert int(m.group(1)) == mine.due
+
+
 def test_ingested_source_campaign_reference_tool_roundtrip(tmp_path):
     """The strongest interop combination: ingest the reference's OWN
     crc16.c, campaign it through the supervisor CLI with the reference

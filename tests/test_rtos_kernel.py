@@ -1,0 +1,330 @@
+"""RTOS kernel subsystem tests: preemptive scheduler, DUE sub-buckets.
+
+Covers the coast_tpu.rtos kernel model end to end: canonical scope-config
+resolution (rtos/kernel.config + rtos/Makefile CL lists), golden-clean
+protected semantics, the stack-overflow / assert-fail guard classes
+through classify -> logs -> json_parser (the DUE sub-bucket taxonomy),
+seeded campaign regressions with per-category attribution, scheduler
+determinism, and lint cleanliness of the guard's sanctioned lane
+collapse.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import unprotected
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.logs import write_columnar, write_json, write_ndjson
+from coast_tpu.models import REGISTRY
+from coast_tpu.rtos.kernel import CANARY, SP_MAX, SP_MIN, STACK_WORDS
+# The canonical config builder is the campaign script's -- ONE spelling of
+# the rtos/Makefile CL lists (scripts/rtos_campaign.py CL_LISTS), so an
+# edit there cannot silently diverge from what these tests exercise.
+from scripts.rtos_campaign import canonical_prog as _canonical
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(ROOT, "rtos", "kernel.config")
+
+
+def _flip(prog, leaf, lane, word, bit, t):
+    return jax.jit(prog.run)(
+        {"leaf_id": jnp.int32(prog.leaf_order.index(leaf)),
+         "lane": jnp.int32(lane), "word": jnp.int32(word),
+         "bit": jnp.int32(bit), "t": jnp.int32(t)})
+
+
+@pytest.fixture(scope="module")
+def mm_prog():
+    return _canonical("rtos_mm")
+
+
+@pytest.fixture(scope="module")
+def campaign(mm_prog, tmp_path_factory):
+    runner = CampaignRunner(mm_prog, strategy_name="TMR")
+    res = runner.run(512, seed=42, batch_size=256)
+    d = tmp_path_factory.mktemp("rtoslogs")
+    return res, runner, d
+
+
+# -- scope resolution -------------------------------------------------------
+
+def test_canonical_scope_resolution(mm_prog):
+    assert mm_prog.fn_scope["clampi"] == "ignored"
+    assert mm_prog.fn_scope["uart_fmt"] == "ignored"
+    assert mm_prog.fn_scope["stack_mark"] == "ignored"
+    assert mm_prog.fn_scope["rng_next"] == "skip_lib"
+    assert mm_prog.fn_scope["queue_send"] == "protected_lib"
+    for fn in ("mix", "fold", "saturate", "task_mm", "task_crc",
+               "task_idle", "push_frame", "pop_frame", "pick_next"):
+        assert mm_prog.fn_scope[fn] == "replicated", fn
+    assert not mm_prog.replicated["uart"]        # -ignoreGlbls
+    assert mm_prog.replicated["stacks"]          # -cloneGlbls
+    assert mm_prog.replicated["qbuf"]
+
+
+def test_kuser_kernel_fns_in_scope():
+    prog = _canonical("rtos_kUser")
+    for fn in ("push_frame", "pop_frame", "pick_next",
+               "task_prod", "task_cons", "task_wdg"):
+        assert prog.fn_scope[fn] == "replicated", fn
+
+
+# -- golden-clean protected semantics ---------------------------------------
+
+def test_golden_clean_all_strategies():
+    for benchmark in ("rtos_mm", "rtos_kUser"):
+        region = REGISTRY[benchmark]()
+        for prog in (unprotected(region), _canonical(benchmark, 2),
+                     _canonical(benchmark, 3)):
+            rec = jax.jit(prog.run)(None)
+            assert int(rec["errors"]) == 0, benchmark
+            assert bool(rec["done"])
+            assert not bool(rec["stack_fault"])
+            assert not bool(rec["assert_fault"])
+            assert int(rec["steps"]) == region.nominal_steps
+
+
+# -- guard classes: targeted flips ------------------------------------------
+
+def test_canary_flip_is_stack_overflow(mm_prog):
+    """A blown canary (word 0 of any task's stack row) trips the kernel
+    stack check in that lane -- TMR cannot mask detection, exactly like
+    the reference's replicated kernel hook."""
+    rec = _flip(mm_prog, "stacks", 2, STACK_WORDS, 7, 11)  # task 1 canary
+    assert bool(rec["stack_fault"])
+    assert not bool(rec["done"])
+
+
+def test_sp_flip_is_stack_overflow(mm_prog):
+    """A corrupted saved stack pointer (high bit -> out of bounds)."""
+    rec = _flip(mm_prog, "tcb_sp", 0, 1, 20, 9)
+    assert bool(rec["stack_fault"])
+
+
+def test_ready_flip_is_assert(mm_prog):
+    """A non-boolean ready flag trips the scheduler's configASSERT."""
+    rec = _flip(mm_prog, "ready", 1, 0, 4, 5)
+    assert bool(rec["assert_fault"])
+    assert not bool(rec["stack_fault"])
+
+
+def test_unused_stack_fill_flip_is_benign(mm_prog):
+    """Corrupting watermark fill deep in a stack row (beyond any live
+    frame) must stay invisible: the reference's unused stack area."""
+    rec = _flip(mm_prog, "stacks", 1, 14, 3, 30)
+    assert int(rec["errors"]) == 0
+    assert bool(rec["done"])
+    assert not bool(rec["stack_fault"])
+
+
+def test_classify_precedence_guard_codes():
+    """Device-side classify: guard latches outrank abort/timeout/SDC."""
+    base = {"errors": jnp.int32(3), "corrected": jnp.int32(1),
+            "steps": jnp.int32(5), "done": jnp.bool_(False),
+            "dwc_fault": jnp.bool_(True), "cfc_fault": jnp.bool_(False),
+            "stack_fault": jnp.bool_(False),
+            "assert_fault": jnp.bool_(False)}
+    assert int(cls.classify(base, 100)) == cls.DUE_ABORT
+    assert int(cls.classify({**base, "assert_fault": jnp.bool_(True)},
+                            100)) == cls.DUE_ASSERT
+    assert int(cls.classify({**base, "assert_fault": jnp.bool_(True),
+                             "stack_fault": jnp.bool_(True)},
+                            100)) == cls.DUE_STACK_OVERFLOW
+    # INVALID still outranks everything.
+    assert int(cls.classify({**base, "stack_fault": jnp.bool_(True),
+                             "errors": jnp.int32(-1)}, 100)) == cls.INVALID
+
+
+# -- seeded campaign regressions --------------------------------------------
+
+def test_campaign_records_both_sub_buckets(campaign):
+    """The acceptance bar: a seeded canonical campaign records at least
+    one due_stack_overflow AND one due_assert, both in the DUE bucket."""
+    res, _, _ = campaign
+    assert res.counts["due_stack_overflow"] > 0
+    assert res.counts["due_assert"] > 0
+    assert res.due == (res.counts["due_abort"] + res.counts["due_timeout"]
+                       + res.counts["due_stack_overflow"]
+                       + res.counts["due_assert"])
+    assert res.counts["success"] > 0 and res.counts["corrected"] > 0
+
+
+def test_campaign_attribution_lands_on_kernel_structures(campaign):
+    """Stack-overflow DUEs attribute to stack/TCB leaves; assert DUEs to
+    scheduler structures -- the per-section story of the reference's
+    rtos campaigns."""
+    res, runner, _ = campaign
+    lid = np.asarray(res.schedule.leaf_id)
+    codes = np.asarray(res.codes)
+    leaf_names = dict(enumerate(runner.prog.leaf_order))
+    so_leaves = {leaf_names[int(l)]
+                 for l in lid[codes == cls.DUE_STACK_OVERFLOW]}
+    as_leaves = {leaf_names[int(l)] for l in lid[codes == cls.DUE_ASSERT]}
+    assert so_leaves and so_leaves <= {"stacks", "tcb_sp"}
+    assert as_leaves and as_leaves <= {"ready", "slices", "cur"}
+
+
+def test_campaign_log_roundtrip_all_writers(campaign):
+    """write_json / write_ndjson / write_columnar all carry the new
+    result classes; json_parser reproduces the device-side counts from
+    each container (including the native ndjson fast path when built)."""
+    from coast_tpu.analysis import json_parser as jp
+    res, runner, d = campaign
+    paths = {}
+    write_json(res, runner.mmap, str(d / "a.json"))
+    write_ndjson(res, runner.mmap, str(d / "b.ndjson.json"))
+    write_columnar(res, runner.mmap, str(d / "c.json"))
+    for fname in ("a.json", "b.ndjson.json", "c.json"):
+        s = jp.summarize_path(str(d / fname))
+        assert s.n == res.n, fname
+        for c in jp._CLASSES:
+            assert s.counts[c] == res.counts[c], (fname, c)
+        assert s.due == res.due
+
+
+def test_classify_run_roundtrip_new_classes(campaign):
+    """Per-run FromDict-style reclassification matches device codes for
+    the stackOverflow/assertion result dicts."""
+    from coast_tpu.analysis import json_parser as jp
+    res, runner, d = campaign
+    path = str(d / "roundtrip.json")
+    write_json(res, runner.mmap, path)
+    doc = jp.read_json_file(path)
+    seen = set()
+    for i, run in enumerate(doc["runs"]):
+        got = jp.classify_run(run)
+        assert got == cls.CLASS_NAMES[int(res.codes[i])]
+        seen.add(got)
+    assert {"due_stack_overflow", "due_assert"} <= seen
+
+
+def test_summary_prints_three_sub_counts(campaign):
+    from coast_tpu.analysis import json_parser as jp
+    res, runner, d = campaign
+    path = str(d / "fmt.json")
+    write_columnar(res, runner.mmap, path)
+    text = jp.summarize_path(path).format()
+    assert "due (total)" in text
+    assert "aborts" in text
+    # The printed sub-counts are the recorded ones.
+    for label, key in (("stack overflows", "due_stack_overflow"),
+                       ("assert fails", "due_assert")):
+        line = next(l for l in text.splitlines() if label in l)
+        assert int(line.split()[-1]) == res.counts[key]
+
+
+def test_native_python_ndjson_parity(campaign):
+    """The native ndjson classifier (when built) and the Python parser
+    agree on a log containing the new classes; ABI-gating keeps an old
+    .so from silently diverging."""
+    from coast_tpu import native
+    from coast_tpu.analysis import json_parser as jp
+    res, runner, d = campaign
+    path = str(d / "native.ndjson.json")
+    write_ndjson(res, runner.mmap, path)
+    fast = jp._summarize_ndjson_native(path)
+    if not native.native_available() or fast is None:
+        pytest.skip("native core not built")
+    slow = jp.summarize_runs("x", [jp.read_json_file(path)])
+    assert fast.counts == slow.counts
+
+
+# -- scheduler determinism ---------------------------------------------------
+
+def test_scheduler_determinism_across_lanes(mm_prog):
+    """Fault-free TMR: the voted scheduler trace equals the unprotected
+    run's trace -- all lanes interleave tasks identically."""
+    region = REGISTRY["rtos_mm"]()
+    unprot = region.run_unprotected()
+    rec = jax.jit(lambda: mm_prog.run(None, return_state=True))()
+    np.testing.assert_array_equal(
+        np.asarray(rec["final_state"]["sched_trace"]),
+        np.asarray(unprot["sched_trace"]))
+
+
+def test_campaign_replay_bit_identical(mm_prog):
+    """Same seed => same schedule => same codes, chunked or not."""
+    r1 = CampaignRunner(mm_prog, strategy_name="TMR")
+    a = r1.run(128, seed=7, batch_size=64)
+    b = r1.run(128, seed=7, batch_size=32)
+    np.testing.assert_array_equal(a.codes, b.codes)
+
+
+# -- lint: the guard's lane collapse is sanctioned ---------------------------
+
+def test_canonical_build_lint_clean(mm_prog):
+    """The static replication-integrity rules accept the kernel: the
+    guard's any()-over-lanes is tagged, voter coverage includes the
+    'stack' class for the stacks leaf."""
+    from coast_tpu.analysis import lint as lint_mod
+    report = lint_mod.lint_program(mm_prog, survival=False, strategy="TMR")
+    assert report.ok, report.format()
+
+
+def test_stack_kind_voter_coverage_expectation(mm_prog):
+    """expected_sync_classes derives a 'stack' vote for the written
+    KIND_STACK leaf independently of the engine tables."""
+    from coast_tpu.analysis.lint.provenance import expected_sync_classes
+    exp = expected_sync_classes(mm_prog.region, mm_prog.cfg)
+    assert "stack" in exp["stacks"]
+
+
+def test_canary_word_metadata():
+    region = REGISTRY["rtos_mm"]()
+    spec = region.spec["stacks"]
+    assert spec.kind == "stack"
+    assert spec.canary_word == 0
+    state = region.init()
+    assert int(state["stacks"][0, spec.canary_word]) == CANARY
+    assert SP_MIN >= 1 and SP_MAX + 4 <= STACK_WORDS
+
+
+def test_canary_word_requires_stack_kind():
+    from coast_tpu.ir.region import LeafSpec
+    with pytest.raises(ValueError, match="canary_word"):
+        LeafSpec("mem", canary_word=0)
+
+
+# -- opt CLI surface ---------------------------------------------------------
+
+def test_opt_cli_canonical_kernel_invocation(capsys):
+    from coast_tpu.opt import main as opt_main
+    rc = opt_main(["-TMR", "-countErrors",
+                   "-cloneFns=task_mm,task_crc,task_idle",
+                   "-protectedLibFn=queue_send", "-cloneGlbls=qbuf,stacks",
+                   f"-configFile={CONFIG}", "rtos_mm"])
+    assert rc == 0
+    assert "E: 0" in capsys.readouterr().out
+
+
+def test_opt_cli_stack_overflow_exit(capsys):
+    """A forced canary flip through the CLI reports the hook line."""
+    from coast_tpu.opt import main as opt_main
+    rc = opt_main(["-TMR", "-countErrors",
+                   f"-inject=stacks:2:{STACK_WORDS}:7:11", "rtos_mm"])
+    assert rc == 134
+    assert "stack overflow" in capsys.readouterr().err
+
+
+# -- pcStats satellite: sparkline + --hist-out -------------------------------
+
+def test_histogram_sparkline_and_json(campaign, tmp_path, capsys):
+    from coast_tpu.analysis import json_parser as jp
+    res, runner, d = campaign
+    path = str(d / "hist.json")
+    write_columnar(res, runner.mmap, path)
+    out_path = str(tmp_path / "hist_out.json")
+    assert jp.main([path, "-n", "-c", "--hist-out", out_path]) == 0
+    out = capsys.readouterr().out
+    assert "histogram" in out
+    assert "steps" in out and any(g in out for g in "▁▂▃▄▅▆▇█")
+    with open(out_path) as fh:
+        doc = json.load(fh)
+    assert doc["metric"] == "injection_step_histogram"
+    assert sum(b["count"] for b in doc["bins"]) == doc["total"] == res.n

@@ -9,7 +9,7 @@
 set -e
 cd "$(dirname "$0")/.."
 
-for tgt in rtos_app rtos_app_dwc; do
+for tgt in rtos_app rtos_app_dwc rtos_mm rtos_mm_dwc rtos_kUser rtos_kUser_dwc; do
     echo "== rtos smoke: $tgt"
     out=$(timeout 600 make -s -C rtos "$tgt")
     echo "$out" | tail -1
