@@ -4,13 +4,15 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
 
 # Fast pytest tier (<5 min): everything except the slow corpus matrices
 # (pytest.ini markers), the fast.yml/full.yml split of the reference CI.
+# Includes the crash-safety suite (tests/test_resilience.py): journal
+# resume parity, retry/degradation, collect watchdog.
 test:
 	$(CPU_ENV) $(PYTHON) -m pytest tests/ -x -q -m "not slow and not csrc"
 
@@ -63,6 +65,12 @@ fidelity:
 # artifacts/mfu_sweep.json on TPU (smoke file elsewhere).
 mfu_sweep:
 	$(PYTHON) scripts/mfu_sweep.py
+
+# Interrupt-and-resume smoke on its own (also a fast.yml driver row):
+# kill a journaled campaign after k batches, resume, require
+# bit-for-bit identical codes/counts.
+resume_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.resume_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

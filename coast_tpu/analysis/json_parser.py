@@ -126,6 +126,10 @@ class Summary:
     # each log's summary block; summed key-wise over a directory.  None
     # for logs written before the stages block existed.
     stages: Optional[Dict[str, float]] = None
+    # Fault-tolerant-dispatch accounting (retry_transient / retry_wedged /
+    # oom_degrade, coast_tpu.inject.resilience) from each log's summary
+    # block; None for campaigns run without a RetryPolicy.
+    resilience: Optional[Dict[str, int]] = None
 
     @property
     def due(self) -> int:
@@ -174,6 +178,13 @@ class Summary:
                                      key=lambda kv: -kv[1]):
                 lines.append(f"  {stage:<12} {sec:>10.4f}s "
                              f"({100.0 * sec / total:5.1f}%)")
+        if self.resilience and any(self.resilience.values()):
+            # Surface survived dispatch failures: a campaign that retried
+            # or degraded its way to completion should say so in the same
+            # place its rates are quoted.
+            lines.append("  --- resilience ---")
+            for key, count in sorted(self.resilience.items()):
+                lines.append(f"  {key:<16} {count:>6}")
         return "\n".join(lines)
 
 
@@ -247,6 +258,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     step_sum = 0
     step_n = 0
     stages: Dict[str, float] = {}
+    resilience: Dict[str, int] = {}
     for doc in docs:
         if "columns" in doc:                      # vectorised columnar path
             import numpy as np
@@ -274,9 +286,12 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         seconds += float(summary.get("seconds", 0.0))
         for stage, sec in (summary.get("stages") or {}).items():
             stages[stage] = stages.get(stage, 0.0) + float(sec)
+        for key, cnt in (summary.get("resilience") or {}).items():
+            resilience[key] = resilience.get(key, 0) + int(cnt)
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
                    mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
-                   stages=stages or None)
+                   stages=stages or None,
+                   resilience=resilience or None)
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -306,7 +321,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             counts={cls: int(counts[i]) for i, cls in enumerate(_CLASSES)},
             seconds=float(head["summary"].get("seconds", 0.0)),
             mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
-            stages=head["summary"].get("stages") or None)
+            stages=head["summary"].get("stages") or None,
+            resilience=head["summary"].get("resilience") or None)
     except OSError:
         return None
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,10 @@ import numpy as np
 
 from coast_tpu import obs
 from coast_tpu.inject import classify as cls
+from coast_tpu.inject import resilience as resilience_mod
+from coast_tpu.inject.journal import (CampaignJournal, JournalMismatchError,
+                                      config_fingerprint,
+                                      schedule_fingerprint)
 from coast_tpu.inject.mem import MemoryMap
 from coast_tpu.inject.schedule import FaultSchedule, generate
 from coast_tpu.passes.dataflow_protection import ProtectedProgram
@@ -67,6 +71,10 @@ class CampaignResult:
     # (CampaignRunner.run's resume offset); chunk records carry it so
     # replay_chunks can regenerate resumed chunks exactly.
     start_num: int = 0
+    # Fault-tolerant-dispatch accounting (retry_transient / retry_wedged /
+    # oom_degrade counts, coast_tpu.inject.resilience); populated -- with
+    # zeros -- whenever the runner had a RetryPolicy, {} otherwise.
+    resilience: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def injections_per_sec(self) -> float:
@@ -98,6 +106,8 @@ class CampaignResult:
         }
         if self.chunks is not None:
             out["chunks"] = self.chunks
+        if self.resilience:
+            out["resilience"] = dict(self.resilience)
         return out
 
 
@@ -109,7 +119,8 @@ class CampaignRunner:
                  strategy_name: Optional[str] = None,
                  unroll: int = 1,
                  telemetry: Optional[obs.Telemetry] = None,
-                 preflight: "bool | str" = False):
+                 preflight: "bool | str" = False,
+                 retry: "Optional[object]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -133,11 +144,19 @@ class CampaignRunner:
         would measure a protection that no longer exists).  ``True`` or
         ``"full"`` runs both the static lane-provenance rules and the
         post-XLA survival checks; ``"static"`` skips the survival
-        compile for quick iteration."""
+        compile for quick iteration.
+
+        ``retry`` is a :class:`coast_tpu.inject.resilience.RetryPolicy`:
+        transient XLA/device errors re-dispatch the batch with backoff,
+        OOM halves the batch geometry instead of aborting, and a
+        collect watchdog converts a hung ``device_get`` into a
+        re-dispatch.  None (the default) keeps dispatch failures fatal,
+        exactly as before."""
         if preflight:
             from coast_tpu.analysis import lint as lint_mod
             lint_mod.check(prog, survival=(preflight != "static"))
         self.prog = prog
+        self.retry = retry
         self.telemetry = telemetry if telemetry is not None \
             else obs.Telemetry()
         with self.telemetry.activate():
@@ -191,7 +210,9 @@ class CampaignRunner:
                      batch_size: int = 4096,
                      progress: Optional[
                          Callable[[int, Dict[str, int]], None]] = None,
-                     _telemetry_mark: Optional[int] = None
+                     _telemetry_mark: Optional[int] = None,
+                     journal: "Optional[object]" = None,
+                     journal_base: int = 0
                      ) -> CampaignResult:
         """Run every row of ``sched`` in edge-padded batches.
 
@@ -202,6 +223,23 @@ class CampaignRunner:
         recorded into ``self.telemetry`` and summed onto the result's
         ``stages``; ``_telemetry_mark`` lets ``run`` extend the stage
         window back over its schedule-generation span.
+
+        ``journal`` is an open :class:`coast_tpu.inject.journal
+        .CampaignJournal` (header already written/validated by the
+        caller): every collected batch is appended as one fsync'd record
+        before the loop moves on, and on entry the journal's contiguous
+        completed-batch prefix is replayed from disk so the loop
+        restarts at the first missing batch -- a resumed campaign's
+        ``codes`` is bit-for-bit the uninterrupted run's.
+        ``journal_base`` offsets this schedule's rows within a larger
+        journaled stream (scripts/campaign_1m.py's sliced chunks).
+
+        When ``self.retry`` is set, dispatch/collect failures are
+        classified (:mod:`coast_tpu.inject.resilience`): transient
+        errors and watchdog-wedged collects re-dispatch the batch with
+        exponential backoff; OOM halves ``batch_size``, recompiles,
+        re-pads, and journals the new geometry.  Everything else is
+        fatal and re-raised.
         """
         # Deliberately no clamp to len(sched) here: every batch is
         # edge-padded to batch_size so all chunks (including a caller's
@@ -210,6 +248,7 @@ class CampaignRunner:
         # site (advisor, supervisor) where a single smaller compile beats
         # padding waste.
         batch_size = self._round_batch(batch_size)
+        retry = self.retry
         tel = self.telemetry
         mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
         t0 = time.perf_counter()
@@ -217,31 +256,71 @@ class CampaignRunner:
         done = 0
         live_counts = np.zeros(cls.NUM_CLASSES, np.int64)
         live_invalid = 0
+        resilience: Dict[str, int] = (
+            {"retry_transient": 0, "retry_wedged": 0, "oom_degrade": 0}
+            if retry is not None else {})
+        sched_t = np.asarray(sched.t)
 
-        def _grab(pending, n_prev: int, part_t: np.ndarray) -> None:
-            """Block on one batch; update progress accounting."""
-            nonlocal done, live_invalid
-            with tel.span("collect", n=n_prev):
-                got = self._collect(pending)
-            outs.append({k: v[:n_prev] for k, v in got.items()})
-            done += n_prev
+        def _account(out: Dict[str, np.ndarray], lo: int) -> Dict[str, int]:
+            """Cumulative class histogram over the rows fetched so far
+            (progress heartbeats and journal batch records)."""
+            nonlocal live_invalid
+            n_out = len(out["code"])
+            fired = sched_t[lo:lo + n_out] >= 0
+            live_counts[:] += np.bincount(
+                out["code"][fired], minlength=cls.NUM_CLASSES)
+            live_invalid += int(n_out - fired.sum())
+            counts_so_far = {name: int(live_counts[i])
+                             for i, name in enumerate(cls.CLASS_NAMES)}
+            counts_so_far["cache_invalid"] = live_invalid
+            return counts_so_far
+
+        # Resume: replay the journal's contiguous completed-batch prefix
+        # (rows [journal_base, ...) in stream coordinates) from disk, so
+        # the dispatch loop below starts at the first missing batch.
+        if journal is not None:
+            for rec in journal.batch_prefix(journal_base, len(sched)):
+                out = {k: np.asarray(rec[src], dtype=np.int32)
+                       for k, src in (("code", "codes"), ("errors", "errors"),
+                                      ("corrected", "corrected"),
+                                      ("steps", "steps"))}
+                outs.append(out)
+                counts_so_far = _account(out, done)
+                done += len(out["code"])
+                if progress is not None:
+                    progress(done, counts_so_far)
+            if done:
+                tel.instant("journal_resume", rows=done)
+
+        def _collect_flight(flight: Dict[str, object]):
+            """Block on one batch, watchdog-guarded when armed.  This is
+            the only collect-side work inside the retry loop -- it is
+            idempotent (a re-dispatch replays the same seeded rows)."""
+            with tel.span("collect", n=flight["n"]):
+                if retry is not None and retry.collect_timeout:
+                    return resilience_mod.watchdog_collect(
+                        lambda: self._collect(flight["pending"]),
+                        retry.collect_timeout)
+                return self._collect(flight["pending"])
+
+        def _grab(flight: Dict[str, object], got) -> None:
+            """Post-collect accounting: journal the batch durably, update
+            progress.  NOT retried -- appending the same rows twice would
+            corrupt the campaign, so failures here are fatal."""
+            nonlocal done
+            n_part = flight["n"]
+            out = {k: v[:n_part] for k, v in got.items()}
+            outs.append(out)
+            counts_so_far = _account(out, done)
+            done += n_part
+            if journal is not None:
+                journal.append_batch(journal_base + flight["lo"], out,
+                                     counts_so_far,
+                                     tel.stage_totals(since=mark))
             if progress is not None:
-                fired = part_t[:n_prev] >= 0
-                live_counts[:] += np.bincount(
-                    outs[-1]["code"][fired], minlength=cls.NUM_CLASSES)
-                live_invalid += int(n_prev - fired.sum())
-                counts_so_far = {name: int(live_counts[i])
-                                 for i, name in enumerate(cls.CLASS_NAMES)}
-                counts_so_far["cache_invalid"] = live_invalid
                 progress(done, counts_so_far)
 
-        # Double-buffered: dispatch batch i+1 before collecting batch i, so
-        # the host-side fetch (one tunnel round-trip per batch) overlaps the
-        # device work -- jax dispatch is async, the device_get is the only
-        # blocking point.  The dispatch span therefore times the host-side
-        # enqueue; device execution time lands in the matching collect span.
-        in_flight: List[Tuple[object, int, np.ndarray]] = []
-        for lo in range(0, len(sched), batch_size):
+        def _dispatch_batch(lo: int) -> Dict[str, object]:
             with tel.span("pad", lo=lo):
                 part = sched.slice(lo, min(lo + batch_size, len(sched)))
                 fault, n_part = self._padded_fault(part, batch_size)
@@ -249,11 +328,97 @@ class CampaignRunner:
                 tel.count("pad_waste_rows", batch_size - n_part)
             with tel.span("dispatch", n=n_part):
                 pending = self._dispatch(fault)
-            in_flight.append((pending, n_part, part.t))
-            if len(in_flight) > 1:
-                _grab(*in_flight.pop(0))
-        for flight in in_flight:
-            _grab(*flight)
+            return {"pending": pending, "n": n_part, "fault": fault,
+                    "lo": lo, "attempts": 1}
+
+        def _note_retry(flight_lo: int, attempt: int,
+                        exc: BaseException, kind: str) -> None:
+            key = "retry_wedged" if kind == "wedged" else "retry_transient"
+            resilience[key] += 1
+            tel.count(f"resilience_{key}", lo=flight_lo,
+                      error=type(exc).__name__)
+            if journal is not None:
+                journal.append({"kind": "retry", "lo": journal_base
+                                + flight_lo, "attempt": attempt,
+                                "class": kind,
+                                "error": type(exc).__name__})
+
+        class _Degrade(Exception):
+            """Internal signal: OOM observed; unwind to the outer loop."""
+
+        def _handle(flight: Dict[str, object], exc: BaseException) -> None:
+            """Common failure path for dispatch and collect: classify,
+            then retry / degrade / re-raise.  Mutates ``flight`` so the
+            caller's loop re-dispatches."""
+            kind = retry.classify(exc) if retry is not None else "fatal"
+            if kind == "fatal":
+                raise exc
+            if kind == "oom":
+                raise _Degrade() from exc
+            attempts = int(flight["attempts"])
+            if attempts >= retry.max_attempts:
+                raise exc
+            _note_retry(int(flight["lo"]), attempts, exc, kind)
+            time.sleep(retry.backoff(attempts))
+            flight["attempts"] = attempts + 1
+            flight["pending"] = None           # re-dispatch before collect
+
+        # Double-buffered: dispatch batch i+1 before collecting batch i, so
+        # the host-side fetch (one tunnel round-trip per batch) overlaps the
+        # device work -- jax dispatch is async, the device_get is the only
+        # blocking point.  The dispatch span therefore times the host-side
+        # enqueue; device execution time lands in the matching collect span.
+        in_flight: List[Dict[str, object]] = []
+        next_lo = done
+        disp_attempts = 1
+        while done < len(sched):
+            try:
+                while next_lo < len(sched) and len(in_flight) < 2:
+                    try:
+                        in_flight.append(_dispatch_batch(next_lo))
+                    except Exception as e:     # noqa: BLE001 - classified
+                        probe = {"lo": next_lo, "attempts": disp_attempts}
+                        _handle(probe, e)
+                        disp_attempts = int(probe["attempts"])
+                        continue               # retry the same dispatch
+                    next_lo += batch_size
+                    disp_attempts = 1
+                flight = in_flight.pop(0)
+                while True:
+                    try:
+                        if flight["pending"] is None:
+                            with tel.span("dispatch", n=flight["n"],
+                                          retry=flight["attempts"]):
+                                flight["pending"] = self._dispatch(
+                                    flight["fault"])
+                        got = _collect_flight(flight)
+                        break
+                    except _Degrade:
+                        raise
+                    except Exception as e:     # noqa: BLE001 - classified
+                        _handle(flight, e)
+                _grab(flight, got)
+            except _Degrade as sig:
+                # OOM: the geometry was too ambitious for the live HBM
+                # headroom.  Halve the batch, drop the (uncollectable)
+                # in-flight work, and restart at the first uncollected
+                # row -- the compiled program re-specialises on the new
+                # shape at the next dispatch.
+                new_bs = retry.degraded_batch(batch_size)
+                if new_bs is None:
+                    raise sig.__cause__
+                new_bs = self._round_batch(new_bs)
+                if new_bs >= batch_size:
+                    raise sig.__cause__        # rounding floor reached
+                resilience["oom_degrade"] += 1
+                tel.count("resilience_oom_degrade", batch_size=new_bs)
+                batch_size = new_bs
+                in_flight.clear()
+                next_lo = done
+                if journal is not None:
+                    journal.append({"kind": "geometry",
+                                    "batch_size": batch_size,
+                                    "lo": journal_base + done})
         with tel.span("classify"):
             if outs:
                 merged = {k: np.concatenate([o[k] for o in outs])
@@ -287,33 +452,169 @@ class CampaignRunner:
             schedule=sched,
             seed=sched.seed,
             stages=tel.stage_totals(since=mark),
+            resilience=resilience,
         )
+
+    def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
+        """The identity block every journal header shares: resuming under
+        a different program, strategy, or protection config must refuse."""
+        return {"mode": mode,
+                "benchmark": self.prog.region.name,
+                "strategy": self.strategy_name,
+                "config_sha": config_fingerprint(self.prog.cfg),
+                **fields}
+
+    def _open_journal(self, journal, header: Dict[str, object]):
+        """``journal`` as accepted by the run methods: None, a path (opened
+        -- and resume-validated -- here), or an already-open
+        CampaignJournal (validated against this campaign's header)."""
+        if journal is None:
+            return None, False
+        if isinstance(journal, CampaignJournal):
+            CampaignJournal._validate(journal.header,
+                                      {**journal.header, **header},
+                                      journal.path)
+            return journal, False
+        return CampaignJournal.open(str(journal), header), True
 
     def run(self, n: int, seed: int = 0,
             batch_size: int = 4096, start_num: int = 0,
             progress: Optional[
-                Callable[[int, Dict[str, int]], None]] = None
+                Callable[[int, Dict[str, int]], None]] = None,
+            journal: "Optional[object]" = None
             ) -> CampaignResult:
         """``start_num`` resumes a seeded campaign at injection #start_num:
         the schedule stream for (seed, start_num+n) is generated and the
         first start_num rows skipped, so a resumed campaign injects exactly
         the faults the interrupted one would have (the --start-num counter
-        of gdbClient.py:401)."""
+        of gdbClient.py:401).
+
+        ``journal`` (a path or an open CampaignJournal) makes the campaign
+        crash-safe: every collected batch is fsync'd to the journal, and
+        rerunning the same call against the same path resumes at the
+        first missing batch after validating that the journal's header
+        -- including the regenerated schedule's fingerprint -- matches
+        this campaign exactly (JournalMismatchError otherwise)."""
         tel = self.telemetry
         mark = tel.mark()
         with tel.activate():        # generate() records its schedule span
             sched = generate(self.mmap, start_num + n, seed,
                              self.prog.region.nominal_steps)
-        res = self.run_schedule(sched.slice(start_num, start_num + n),
-                                batch_size, progress=progress,
-                                _telemetry_mark=mark)
+        part = sched.slice(start_num, start_num + n)
+        j, owned = (None, False)
+        if journal is not None:
+            header = self._journal_header(
+                "run", seed=int(seed), n=int(n), start_num=int(start_num),
+                batch_size=int(batch_size),
+                schedule_sha=schedule_fingerprint(part))
+            j, owned = self._open_journal(journal, header)
+        try:
+            res = self.run_schedule(part, batch_size, progress=progress,
+                                    _telemetry_mark=mark, journal=j)
+        finally:
+            if owned and j is not None:
+                j.close()
         res.start_num = start_num
         return res
+
+    def _result_from_chunk(self, rec: Dict[str, object]) -> CampaignResult:
+        """Rebuild one journaled chunk's CampaignResult without touching
+        the device: the seeded schedule regenerates deterministically,
+        the per-run columns come from the journal record."""
+        seed, n = int(rec["seed"]), int(rec["n"])
+        start_num = int(rec.get("start_num", 0))
+        with self.telemetry.activate():
+            sched = generate(self.mmap, start_num + n, seed,
+                             self.prog.region.nominal_steps
+                             ).slice(start_num, start_num + n)
+        return CampaignResult(
+            benchmark=self.prog.region.name,
+            strategy=self.strategy_name,
+            n=n,
+            counts={k: int(v) for k, v in rec["counts"].items()},
+            seconds=float(rec.get("seconds", 0.0)),
+            codes=np.asarray(rec["codes"], np.int32),
+            errors=np.asarray(rec["errors"], np.int32),
+            corrected=np.asarray(rec["corrected"], np.int32),
+            steps=np.asarray(rec["steps"], np.int32),
+            schedule=sched,
+            seed=seed,
+            stages={k: float(v)
+                    for k, v in (rec.get("stage_seconds") or {}).items()},
+            start_num=start_num,
+        )
+
+    def _chunk_runner(self, journal, header: Dict[str, object],
+                      batch_size: int,
+                      progress: Optional[
+                          Callable[[int, Dict[str, int]], None]]):
+        """Shared per-chunk machinery of ``run_until_errors`` and
+        ``replay_chunks``: a ``next_chunk(n, seed, start_num)`` closure
+        that replays completed chunks from the journal (validating the
+        identity of each against the deterministic loop's expectation),
+        runs + journals the rest, and threads the ``progress`` heartbeat
+        across chunk boundaries (cumulative done/counts, so
+        error-bounded flagship loops are no longer silent for minutes).
+        Returns (next_chunk, finish) -- call ``finish`` when done."""
+        j, owned = self._open_journal(journal, header)
+        replayed = j.chunk_records() if j is not None else []
+        replay_idx = 0
+        agg_counts: Dict[str, int] = {}
+        agg_done = 0
+
+        def next_chunk(n_req: int, seed: int,
+                       start_num: int = 0) -> CampaignResult:
+            nonlocal replay_idx, agg_done
+            from_journal = replay_idx < len(replayed)
+            if from_journal:
+                rec = replayed[replay_idx]
+                expect = (int(rec["seed"]), int(rec["n"]),
+                          int(rec.get("start_num", 0)))
+                if expect != (int(seed), int(n_req), int(start_num)):
+                    raise JournalMismatchError(
+                        f"journal chunk {replay_idx} records (seed, n, "
+                        f"start_num)={expect} but the campaign loop "
+                        f"expects {(int(seed), int(n_req), int(start_num))}"
+                        "; refusing to resume")
+                replay_idx += 1
+                res = self._result_from_chunk(rec)
+            else:
+                chunk_progress = None
+                if progress is not None:
+                    def chunk_progress(done, counts, _base=agg_done,
+                                       _agg=dict(agg_counts)):
+                        merged = dict(_agg)
+                        for k, v in counts.items():
+                            merged[k] = merged.get(k, 0) + v
+                        progress(_base + done, merged)
+                res = self.run(n_req, seed=seed, batch_size=batch_size,
+                               start_num=start_num,
+                               progress=chunk_progress)
+                if j is not None:
+                    j.append_chunk(res)
+            agg_done += res.n
+            for k, v in res.counts.items():
+                agg_counts[k] = agg_counts.get(k, 0) + v
+            if progress is not None and from_journal:
+                # journal-replayed chunks fire one heartbeat apiece so a
+                # resumed loop's progress is monotone from the start
+                progress(agg_done, dict(agg_counts))
+            return res
+
+        def finish() -> None:
+            if owned and j is not None:
+                j.close()
+
+        return next_chunk, finish
 
     def run_until_errors(self, min_errors: int, seed: int = 0,
                          batch_size: int = 4096,
                          round_to: int = 1000,
-                         max_n: int = 1_000_000) -> CampaignResult:
+                         max_n: int = 1_000_000,
+                         progress: Optional[
+                             Callable[[int, Dict[str, int]], None]] = None,
+                         journal: "Optional[object]" = None
+                         ) -> CampaignResult:
         """The reference's campaign-sizing convention: inject until N SDC
         errors are seen, then round the campaign up to the next ``round_to``
         (supervisor.py:339; threadFunctions.py:534-558).
@@ -321,30 +622,48 @@ class CampaignRunner:
         The result's ``chunks`` records every chunk's exact (seed, n), and
         ``replay_chunks(result.chunks)`` reproduces the campaign
         bit-for-bit -- the merged schedule spans several seed streams, so
-        the master seed alone cannot."""
-        results: List[CampaignResult] = []
-        total = 0
-        errors_seen = 0
-        chunk_seed = seed
-        while total < max_n:
-            res = self.run(batch_size, seed=chunk_seed, batch_size=batch_size)
-            results.append(res)
-            total += res.n
-            errors_seen += res.counts["sdc"]
-            chunk_seed += 1
-            if errors_seen >= min_errors:
-                break
-        target = ((total + round_to - 1) // round_to) * round_to
-        while total < target and total < max_n:
-            res = self.run(min(batch_size, target - total), seed=chunk_seed,
-                           batch_size=batch_size)
-            results.append(res)
-            total += res.n
-            chunk_seed += 1
+        the master seed alone cannot.
+
+        ``progress(done, counts_so_far)`` fires per collected batch with
+        done/counts cumulative *across* chunks.  ``journal`` (path or
+        open CampaignJournal) appends one fsync'd record per completed
+        chunk; resuming replays the completed-chunk prefix from disk --
+        the sizing loop is deterministic given the per-chunk results, so
+        the resumed campaign continues exactly where it stopped."""
+        next_chunk, finish = self._chunk_runner(
+            journal, self._journal_header(
+                "until_errors", seed=int(seed), min_errors=int(min_errors),
+                round_to=int(round_to), max_n=int(max_n),
+                batch_size=int(batch_size)),
+            batch_size, progress)
+        try:
+            results: List[CampaignResult] = []
+            total = 0
+            errors_seen = 0
+            chunk_seed = seed
+            while total < max_n:
+                res = next_chunk(batch_size, chunk_seed)
+                results.append(res)
+                total += res.n
+                errors_seen += res.counts["sdc"]
+                chunk_seed += 1
+                if errors_seen >= min_errors:
+                    break
+            target = ((total + round_to - 1) // round_to) * round_to
+            while total < target and total < max_n:
+                res = next_chunk(min(batch_size, target - total), chunk_seed)
+                results.append(res)
+                total += res.n
+                chunk_seed += 1
+        finally:
+            finish()
         return _merge_results(results, seed)
 
     def replay_chunks(self, chunks: Sequence[Dict[str, int]],
-                      batch_size: int = 4096) -> CampaignResult:
+                      batch_size: int = 4096,
+                      progress: Optional[
+                          Callable[[int, Dict[str, int]], None]] = None,
+                      journal: "Optional[object]" = None) -> CampaignResult:
         """Re-run a recorded multi-chunk campaign exactly.
 
         ``chunks`` is ``CampaignResult.chunks`` (each entry ``{"seed",
@@ -354,22 +673,47 @@ class CampaignRunner:
         ran); the replay regenerates each chunk's seeded schedule and
         merges in the same order, so ``codes`` matches the original
         bit-for-bit -- the campaign-resume guarantee of gdbClient.py:401
-        extended to the error-bounded sizing loop."""
-        results = [self.run(int(c["n"]), seed=int(c["seed"]),
-                            batch_size=batch_size,
-                            start_num=int(c.get("start_num", 0)))
-                   for c in chunks]
-        return _merge_results(results, int(chunks[0]["seed"]) if chunks
-                              else 0)
+        extended to the error-bounded sizing loop.
+
+        ``progress`` and ``journal`` behave as in ``run_until_errors``:
+        cross-chunk heartbeats, per-chunk durable records, resume from
+        the completed-chunk prefix."""
+        if not chunks:
+            raise ValueError(
+                "replay_chunks got an empty chunk list: the recorded "
+                "campaign produced no chunks (nothing to replay)")
+        next_chunk, finish = self._chunk_runner(
+            journal, self._journal_header(
+                "replay",
+                chunks=[{"seed": int(c["seed"]), "n": int(c["n"]),
+                         "start_num": int(c.get("start_num", 0))}
+                        for c in chunks],
+                batch_size=int(batch_size)),
+            batch_size, progress)
+        try:
+            results = [next_chunk(int(c["n"]), int(c["seed"]),
+                                  int(c.get("start_num", 0)))
+                       for c in chunks]
+        finally:
+            finish()
+        return _merge_results(results, int(chunks[0]["seed"]))
 
 
 def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
+    if not parts:
+        raise ValueError(
+            "campaign produced no chunks: _merge_results got an empty "
+            "parts list (the sizing loop never ran a batch -- check "
+            "min_errors/max_n/target arithmetic)")
     first = parts[0]
     counts = {k: sum(p.counts[k] for p in parts) for k in first.counts}
     stages: Dict[str, float] = {}
+    resilience: Dict[str, int] = {}
     for p in parts:
         for k, v in p.stages.items():
             stages[k] = stages.get(k, 0.0) + v
+        for k, v in p.resilience.items():
+            resilience[k] = resilience.get(k, 0) + v
     sched = FaultSchedule(
         *(np.concatenate([getattr(p.schedule, f) for p in parts])
           for f in ("leaf_id", "lane", "word", "bit", "t", "section_idx")),
@@ -389,4 +733,5 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
         chunks=[{"seed": p.seed, "n": p.n, "start_num": p.start_num}
                 for p in parts],
         stages=stages,
+        resilience=resilience,
     )

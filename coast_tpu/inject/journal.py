@@ -1,0 +1,285 @@
+"""Durable campaign journal: crash-safe record of every collected batch.
+
+The reference platform's single biggest engineering investment after
+injection itself is surviving its own failures: the supervisor detects
+wedged QEMU runs, restarts them, and resumes the seeded campaign at
+``--start-num`` (supervisor.py:400-509, gdbClient.py:401).  The batched
+engine kept the seeded-resume *math* (``start_num``, ``chunks``) but
+until this module not the *machinery*: a flagship campaign that hit a
+TPU preemption or a plain SIGKILL lost every completed batch.
+
+A journal is an append-only ndjson file.  Line 1 is the **header** --
+the campaign's identity (benchmark, strategy, protection-config
+fingerprint, seed, n, start_num, batch geometry, schedule fingerprint).
+Every subsequent line is one **record**, fsync'd as it is appended so a
+kill at any instant leaves at worst one truncated trailing line (which
+:meth:`CampaignJournal._load` tolerates and drops):
+
+  * ``batch``    -- one collected dispatch batch: its row range
+    (``lo``, ``n``) plus the per-run ``codes``/``errors``/``corrected``/
+    ``steps`` columns, the cumulative class counts, and the stage
+    seconds so far.
+  * ``chunk``    -- one completed chunk of a multi-chunk campaign
+    (``run_until_errors`` / ``replay_chunks``): its (seed, n,
+    start_num) identity plus the same per-run columns.
+  * ``geometry`` -- the runner degraded ``batch_size`` (OOM halving,
+    :mod:`coast_tpu.inject.resilience`); recorded so the artifact trail
+    explains the shape change.
+  * ``retry``    -- a transient dispatch/collect failure was retried
+    (forensics only; resume ignores it).
+
+Resume (``CampaignJournal.open`` on an existing file) validates the
+header against the current program/schedule and **refuses mismatches
+loudly** (:class:`JournalMismatchError`): a journal written for a
+different seed, program, or protection config must never silently seed
+another campaign's results.  ``batch_prefix`` then returns the
+contiguous completed-batch prefix so ``run_schedule`` restarts at the
+first missing batch -- the resumed campaign's ``codes`` is bit-for-bit
+identical to the uninterrupted run (tests/test_resilience.py pins it).
+
+FastFlip (arxiv 2403.13989) frames the same requirement
+compositionally: error-injection results should be durable,
+incrementally accumulated units that survive and compose across
+interrupted analyses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "JournalError", "JournalExistsError", "JournalMismatchError",
+    "CampaignJournal", "schedule_fingerprint", "config_fingerprint",
+]
+
+
+class JournalError(RuntimeError):
+    """Base class for journal failures (corrupt file, misuse)."""
+
+
+class JournalExistsError(JournalError):
+    """A non-empty journal exists and the caller did not ask to resume."""
+
+
+class JournalMismatchError(JournalError):
+    """The journal's header does not describe the current campaign."""
+
+
+def schedule_fingerprint(sched) -> str:
+    """sha256 over a FaultSchedule's columns + seed: the journal's proof
+    that a resumed campaign will inject exactly the recorded faults."""
+    h = hashlib.sha256()
+    h.update(str(int(sched.seed)).encode())
+    for field in ("leaf_id", "lane", "word", "bit", "t"):
+        col = np.ascontiguousarray(getattr(sched, field), dtype=np.int32)
+        h.update(col.tobytes())
+    return h.hexdigest()
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable fingerprint of a ProtectionConfig: resuming under different
+    protection flags would measure a different program."""
+    doc = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+    return hashlib.sha256(doc.encode()).hexdigest()[:16]
+
+
+#: Header keys that may legitimately differ between the original run and
+#: a resume (batch geometry is re-negotiable: OOM degradation changes it
+#: mid-campaign, and the resumed process may choose another size -- the
+#: per-row records make resume independent of batching).
+_VOLATILE_KEYS = frozenset({"batch_size", "created", "argv"})
+
+
+class CampaignJournal:
+    """Append-only fsync'd ndjson journal for one campaign."""
+
+    VERSION = 1
+
+    def __init__(self, path: str, header: Dict[str, object],
+                 records: Optional[List[Dict[str, object]]] = None,
+                 fsync: bool = True):
+        self.path = path
+        self.header = header
+        self.fsync = fsync
+        self._records: List[Dict[str, object]] = records or []
+        self.resumed = records is not None
+        self._fh = None
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def open(cls, path: str, header: Dict[str, object],
+             resume: bool = True, fsync: bool = True) -> "CampaignJournal":
+        """Create a fresh journal at ``path``, or resume the one already
+        there.
+
+        A fresh journal writes (and fsyncs) the header line immediately.
+        An existing non-empty journal is validated: every header key
+        except the volatile geometry ones must match ``header`` exactly,
+        else :class:`JournalMismatchError` names the differing keys.
+        ``resume=False`` refuses an existing non-empty journal outright
+        (:class:`JournalExistsError`) -- the CLI's no-``--resume``
+        safety."""
+        header = {"format": "coast-journal", "version": cls.VERSION,
+                  **header}
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            if not resume:
+                raise JournalExistsError(
+                    f"journal {path!r} already exists; pass --resume to "
+                    "continue it or delete the file to start fresh")
+            found_header, records, valid_bytes = cls._load(path)
+            cls._validate(found_header, header, path)
+            if valid_bytes < os.path.getsize(path):
+                # Torn trailing line (kill mid-append): cut it off NOW,
+                # before any new append would fuse onto the fragment and
+                # corrupt the journal for the *next* resume.
+                with open(path, "rb+") as fh:
+                    fh.truncate(valid_bytes)
+            j = cls(path, found_header, records, fsync=fsync)
+            return j
+        j = cls(path, header, fsync=fsync)
+        j.append({"kind": "header", **header})
+        return j
+
+    @staticmethod
+    def _load(path: str):
+        """Parse an existing journal, tolerating one truncated trailing
+        line (the crash-mid-append case); corruption anywhere else is a
+        hard error.  Returns (header, records, valid_bytes) where
+        valid_bytes is the file length up to the last complete record --
+        the caller truncates the torn tail before appending."""
+        records: List[Dict[str, object]] = []
+        valid_bytes = 0
+        with open(path, "rb") as fh:
+            lines = fh.readlines()
+        for i, raw in enumerate(lines):
+            line = raw.strip()
+            if not line:
+                valid_bytes += len(raw)
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                if i == len(lines) - 1:
+                    break           # torn tail: the record never landed
+                raise JournalError(
+                    f"journal {path!r} is corrupt at line {i + 1}: "
+                    f"{e}") from e
+            records.append(rec)
+            valid_bytes += len(raw)
+        if not records or records[0].get("kind") != "header":
+            raise JournalError(
+                f"journal {path!r} has no header record; not a campaign "
+                "journal (or its header line was torn)")
+        header = {k: v for k, v in records[0].items() if k != "kind"}
+        return header, records[1:], valid_bytes
+
+    @staticmethod
+    def _validate(found: Dict[str, object], expect: Dict[str, object],
+                  path: str) -> None:
+        keys = (set(found) | set(expect)) - _VOLATILE_KEYS
+        diffs = [k for k in sorted(keys) if found.get(k) != expect.get(k)]
+        if diffs:
+            detail = ", ".join(
+                f"{k}: journal={found.get(k)!r} vs current="
+                f"{expect.get(k)!r}" for k in diffs)
+            raise JournalMismatchError(
+                f"journal {path!r} records a different campaign; "
+                f"refusing to resume ({detail}).  Delete the journal or "
+                "rerun with the original program/seed/flags.")
+
+    # -- appending -----------------------------------------------------------
+    def append(self, record: Dict[str, object]) -> None:
+        """Append one record and make it durable (flush + fsync) before
+        returning, so a kill immediately after a batch is collected can
+        never lose that batch.
+
+        Appends are write-only: ``self._records`` holds what ``open``
+        loaded from disk (the resume queries' input), never live
+        appends -- a journaled 10^6-row campaign must not keep every
+        batch's columns resident for its whole lifetime."""
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    def append_batch(self, lo: int, out: Dict[str, np.ndarray],
+                     counts: Dict[str, int],
+                     stage_seconds: Dict[str, float]) -> None:
+        """One fsync'd record per collected batch: row range, per-run
+        columns, cumulative counts, stage seconds so far."""
+        self.append({
+            "kind": "batch", "lo": int(lo), "n": int(len(out["code"])),
+            "codes": out["code"].tolist(),
+            "errors": out["errors"].tolist(),
+            "corrected": out["corrected"].tolist(),
+            "steps": out["steps"].tolist(),
+            "counts": counts,
+            "stage_seconds": {k: round(v, 6)
+                              for k, v in stage_seconds.items()},
+        })
+
+    def append_chunk(self, res) -> None:
+        """One completed chunk of a multi-chunk campaign (the CampaignResult
+        of one ``run`` call inside ``run_until_errors``/``replay_chunks``)."""
+        self.append({
+            "kind": "chunk", "seed": int(res.seed), "n": int(res.n),
+            "start_num": int(res.start_num),
+            "codes": res.codes.tolist(),
+            "errors": res.errors.tolist(),
+            "corrected": res.corrected.tolist(),
+            "steps": res.steps.tolist(),
+            "counts": {k: int(v) for k, v in res.counts.items()},
+            "seconds": round(float(res.seconds), 6),
+            "stage_seconds": {k: round(v, 6)
+                              for k, v in res.stages.items()},
+        })
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "CampaignJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- resume queries (over the records loaded at open, not live
+    # appends -- see append) -------------------------------------------------
+    def records(self) -> List[Dict[str, object]]:
+        return list(self._records)
+
+    def batch_prefix(self, base: int, n_rows: int) -> List[Dict[str, object]]:
+        """The contiguous completed-batch prefix of rows
+        [``base``, ``base + n_rows``): batch records starting exactly at
+        ``base`` with no gap.  ``run_schedule`` restarts at the first
+        missing batch (``base + sum(n for rec in prefix)``).  Records
+        below ``base`` belong to earlier chunks sharing this journal;
+        a gap or out-of-range record ends the prefix (those rows were
+        dispatched but never collected)."""
+        out: List[Dict[str, object]] = []
+        expected = int(base)
+        for rec in self._records:
+            if rec.get("kind") != "batch":
+                continue
+            lo = int(rec["lo"])
+            if lo < base:
+                continue
+            if lo != expected or expected + int(rec["n"]) > base + n_rows:
+                break
+            out.append(rec)
+            expected += int(rec["n"])
+        return out
+
+    def chunk_records(self) -> List[Dict[str, object]]:
+        """Completed multi-chunk records, in append order."""
+        return [r for r in self._records if r.get("kind") == "chunk"]

@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import datetime
 import json
+import os
 import time
 from typing import Dict, List
 
@@ -41,6 +42,34 @@ from coast_tpu.inject.mem import MemoryMap
 
 def _timestamp() -> str:
     return datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")
+
+
+class _AbortWrite(Exception):
+    """Internal: discard the temp file without surfacing an error (the
+    native ndjson fast path bowing out mid-file)."""
+
+
+@contextlib.contextmanager
+def _atomic_write(path: str, mode: str = "w"):
+    """Crash-safe log writing: serialize into a same-directory temp file
+    and ``os.replace`` it into place only when complete, so a crash (or
+    SIGKILL) mid-serialize never leaves a truncated log that json_parser
+    chokes on -- readers see either the old file or the whole new one.
+    Any exception from the body discards the temp file and propagates
+    (:class:`_AbortWrite` included -- callers catch it)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
 
 
 @contextlib.contextmanager
@@ -166,12 +195,19 @@ def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
            "bit": sched.bit, "t": sched.t, "code": res.codes,
            "errors": res.errors, "corrected": res.corrected,
            "steps": res.steps}
-    with open(path, "wb") as f:
-        f.write((json.dumps({"summary": {**res.summary(),
-                                         "format": "ndjson"}})
-                 + "\n").encode())
-        return native.ndjson_stream_rows(0, res.n, col, kind_by_leaf,
-                                         name_by_leaf, ts, f.write)
+    try:
+        with _atomic_write(path, "wb") as f:
+            f.write((json.dumps({"summary": {**res.summary(),
+                                             "format": "ndjson"}})
+                     + "\n").encode())
+            if not native.ndjson_stream_rows(0, res.n, col, kind_by_leaf,
+                                             name_by_leaf, ts, f.write):
+                # Native core bowed out mid-file: discard the temp file
+                # (never a half-written log) and fall back to Python.
+                raise _AbortWrite
+    except _AbortWrite:
+        return False
+    return True
 
 
 def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
@@ -191,7 +227,6 @@ def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
     StatisticsError on a campaign with zero successes (e.g. a small TMR
     campaign where every injection was corrected); its own QEMU
     campaigns always contain clean runs, so the path was never guarded."""
-    import os
     if exec_path is None:
         from coast_tpu.models import model_source
         exec_path = model_source(res.benchmark)
@@ -201,7 +236,7 @@ def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
             f"exec_path {exec_path!r} does not exist; the reference's "
             "readJsonFile exits on logs whose line-1 path is missing")
     with _serialize_stage(res, "reference_json", path):
-        with open(path, "w") as f:
+        with _atomic_write(path) as f:
             f.write(exec_path + "\n")
             json.dump(to_injection_logs(res, mmap), f, indent=1)
 
@@ -210,7 +245,7 @@ def write_json(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
     """Reference-schema structured log (threadFunctions.py:195-198 flushes
     per injection; we flush per campaign)."""
     with _serialize_stage(res, "json", path):
-        with open(path, "w") as f:
+        with _atomic_write(path) as f:
             json.dump({
                 "summary": res.summary(),
                 "runs": to_injection_logs(res, mmap),
@@ -269,7 +304,7 @@ def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
         '"result": %%(result)s, "cacheInfo": null}' % ts)
     sec_kind = {lid: s.kind for lid, s in secs.items()}
     sec_name = {lid: s.name for lid, s in secs.items()}
-    with open(path, "w") as f:
+    with _atomic_write(path) as f:
         f.write(json.dumps({"summary": {**res.summary(),
                                         "format": "ndjson"}}) + "\n")
         write = f.write
@@ -303,7 +338,7 @@ def write_columnar(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
     directly without materialising per-run dicts."""
     with _serialize_stage(res, "columnar", path):
         col, secs = _columns(res, mmap)
-        with open(path, "w") as f:
+        with _atomic_write(path) as f:
             json.dump({
                 "summary": {**res.summary(), "format": "columnar"},
                 "sections": [{"leaf_id": s.leaf_id, "name": s.name,

@@ -1,0 +1,179 @@
+"""Fault-tolerant dispatch: retries, OOM degradation, collect watchdog.
+
+The reference supervisor's hardest-won machinery is surviving its own
+runtime: a wedged QEMU/GDB pair is detected by a watchdog timer,
+killed, restarted, and the campaign resumes where it stopped
+(supervisor.py:400-509, threadFunctions.py:315-953).  The batched
+engine's analogues of those failures are:
+
+  * **transient XLA/device errors** -- tunnel drops, preempted device
+    contexts, DATA_LOSS/UNAVAILABLE runtime errors: the batch is simply
+    re-dispatched (the schedule is seeded, a re-run is bit-identical);
+  * **OOM** (RESOURCE_EXHAUSTED): the batch geometry was too ambitious
+    for the live HBM headroom -- retrying the same shape would fail the
+    same way, so the runner *degrades*: halve ``batch_size``, recompile
+    at the new shape, re-pad, and journal the new geometry;
+  * **a wedged collect** -- the blocking ``device_get`` never returns
+    (the QEMU-wedge analogue).  A configurable watchdog raises a typed
+    :class:`CampaignWedgedError` that the retry loop converts into a
+    re-dispatch of the same batch.
+
+:class:`RetryPolicy` is the knob bundle (max attempts, exponential
+backoff + jitter, per-error-class handling, collect timeout, degradation
+floor).  The campaign loop (:mod:`coast_tpu.inject.campaign`) consults
+``classify`` on every failure; everything it cannot class as transient /
+oom / wedged is fatal and re-raised unchanged -- a typo'd benchmark or a
+real bug must never be retried into silence.  All retries, degradations,
+and watchdog fires land as obs counters and in
+``CampaignResult.summary()["resilience"]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["CampaignWedgedError", "RetryPolicy", "watchdog_collect"]
+
+
+class CampaignWedgedError(RuntimeError):
+    """The blocking collect (``jax.device_get``) exceeded the watchdog
+    timeout: the batch is considered wedged, like a QEMU run that stops
+    answering GDB.  The retry loop re-dispatches the batch."""
+
+
+#: Message substrings that identify an out-of-memory failure.  XLA's
+#: allocator raises RESOURCE_EXHAUSTED; some backends say "out of
+#: memory" or "OOM" in prose.
+OOM_PATTERNS: Tuple[str, ...] = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM",
+    "Attempting to allocate",
+)
+
+#: Message substrings that identify a transient runtime failure worth
+#: re-dispatching: device preemption, tunnel drops, transport errors.
+TRANSIENT_PATTERNS: Tuple[str, ...] = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "DATA_LOSS",
+    "INTERNAL", "CANCELLED", "Socket closed", "connection reset",
+    "Connection reset", "failed to connect", "preempted",
+)
+
+#: Exception class names (any class in the MRO) whose messages are
+#: eligible for pattern classification.  Arbitrary Python exceptions
+#: (KeyError from a bug, KeyboardInterrupt) stay fatal no matter what
+#: their message happens to contain.
+_RUNTIME_ERROR_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "RuntimeError", "OSError",
+    "ConnectionError", "InternalError", "ResourceExhaustedError",
+})
+
+
+def _is_runtime_error(exc: BaseException) -> bool:
+    return any(t.__name__ in _RUNTIME_ERROR_NAMES
+               for t in type(exc).__mro__)
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Retry/degradation knobs for one campaign runner.
+
+    ``max_attempts`` counts the first try: 3 means one dispatch plus up
+    to two retries per batch.  Backoff before retry *k* (1-based) is
+    ``min(max_delay, base_delay * 2**(k-1))`` scaled by up to
+    ``jitter`` of random spread, so a fleet of resumed campaigns does
+    not re-dispatch in lockstep.
+
+    ``collect_timeout`` (seconds) arms the collect watchdog: a blocking
+    ``device_get`` that exceeds it raises
+    :class:`CampaignWedgedError`, which this policy classes as a
+    re-dispatch.  ``None``/0 disables the watchdog (no extra thread).
+
+    ``oom_degrade``: on an OOM the runner halves ``batch_size`` (never
+    below ``min_batch_size``), recompiles, re-pads, and journals the
+    new geometry instead of retrying a shape that cannot fit.
+
+    ``transient_types`` / ``oom_types`` / ``fatal_types`` extend the
+    built-in classification with exact exception types (tests inject
+    fakes this way; ``fatal_types`` wins)."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.25
+    max_delay: float = 30.0
+    jitter: float = 0.25
+    collect_timeout: Optional[float] = None
+    oom_degrade: bool = True
+    min_batch_size: int = 1
+    transient_types: Tuple[type, ...] = ()
+    oom_types: Tuple[type, ...] = ()
+    fatal_types: Tuple[type, ...] = ()
+
+    # -- classification ------------------------------------------------------
+    def classify(self, exc: BaseException) -> str:
+        """'wedged' | 'oom' | 'transient' | 'fatal' for one failure."""
+        if isinstance(exc, CampaignWedgedError):
+            return "wedged"
+        if self.fatal_types and isinstance(exc, self.fatal_types):
+            return "fatal"
+        if self.oom_types and isinstance(exc, self.oom_types):
+            return "oom"
+        if self.transient_types and isinstance(exc, self.transient_types):
+            return "transient"
+        if _is_runtime_error(exc):
+            msg = str(exc)
+            if any(p in msg for p in OOM_PATTERNS):
+                return "oom"
+            if any(p in msg for p in TRANSIENT_PATTERNS):
+                return "transient"
+        return "fatal"
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before retry ``attempt`` (1-based): exponential with
+        jitter."""
+        base = min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
+        if self.jitter:
+            base *= 1.0 + self.jitter * random.random()
+        return base
+
+    def degraded_batch(self, batch_size: int) -> Optional[int]:
+        """The next batch size after an OOM, or None when degradation is
+        off / already at the floor (the OOM is then fatal)."""
+        if not self.oom_degrade:
+            return None
+        new = max(self.min_batch_size, batch_size // 2)
+        return new if new < batch_size else None
+
+
+def watchdog_collect(fn, timeout: Optional[float]):
+    """Run the blocking collect ``fn()`` under a watchdog.
+
+    Without a timeout this is a plain call (no thread).  With one, the
+    collect runs in a daemon thread; if it has not returned within
+    ``timeout`` seconds a :class:`CampaignWedgedError` is raised and the
+    wedged thread is abandoned (it holds no locks -- ``device_get``
+    releases the GIL -- and a daemon thread cannot keep the process
+    alive, exactly like the reference abandoning a wedged QEMU)."""
+    if not timeout or timeout <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _target():
+        try:
+            box["value"] = fn()
+        except BaseException as e:          # noqa: BLE001 - re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=_target, daemon=True,
+                          name="coast-collect-watchdog")
+    th.start()
+    if not done.wait(timeout):
+        raise CampaignWedgedError(
+            f"collect did not return within {timeout}s; batch presumed "
+            "wedged (device_get hung) -- re-dispatching")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

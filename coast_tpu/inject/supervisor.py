@@ -109,6 +109,29 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "10^6-run campaigns, reference = the reference "
                         "tool's own container (exec-path line + bare "
                         "array; readable by its jsonParser.py unmodified)")
+    parser.add_argument("--journal", type=str, default=None,
+                        help="append-only campaign journal: every "
+                        "collected batch (or chunk, with -e) is fsync'd "
+                        "here so a crash/SIGKILL loses nothing; relaunch "
+                        "with --resume to continue at the first missing "
+                        "batch with bit-identical results")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume the campaign recorded in --journal "
+                        "(header must match this invocation's program/"
+                        "seed/flags; refused loudly otherwise).  Without "
+                        "--resume an existing journal is an error, never "
+                        "silently overwritten")
+    parser.add_argument("--max-retries", type=int, default=0,
+                        help="retry transient XLA/device dispatch "
+                        "failures up to N times per batch (exponential "
+                        "backoff + jitter); OOM degrades batch size "
+                        "instead of retrying.  0 keeps failures fatal")
+    parser.add_argument("--collect-timeout", type=float, default=None,
+                        help="watchdog seconds on the blocking batch "
+                        "fetch (device_get): a wedged batch raises "
+                        "CampaignWedgedError and is re-dispatched (the "
+                        "supervisor's QEMU-wedge restart analogue); "
+                        "implies retries even if --max-retries is 0")
     # `-O -TMR` ergonomics: argparse eats a bare `-TMR` as an (unknown)
     # option, so the space-separated form the reference CLI uses routinely
     # would fail with "expected one argument".  Pre-join the pass flags
@@ -155,6 +178,21 @@ def parse_command_line(argv: Optional[List[str]] = None):
         sys.exit(-1)
     if args.log_dir and not os.path.isdir(args.log_dir):
         print(f"Error, directory {args.log_dir} does not exist!",
+              file=sys.stderr)
+        sys.exit(-1)
+    if args.resume and not args.journal:
+        print("Error, --resume requires --journal (there is nothing to "
+              "resume from)", file=sys.stderr)
+        sys.exit(-1)
+    if args.journal and (args.forceBreak or args.stratified
+                         or args.section in ("cache", "icache", "dcache",
+                                             "l2cache")):
+        # Forced injections are debug one-offs; cache/stratified schedules
+        # are journalable in principle but the header vocabulary (seed, n,
+        # start_num) does not describe them yet -- refuse loudly rather
+        # than journal something resume could misinterpret.
+        print("Error, --journal supports the seeded campaign paths (-t/"
+              "-e), not --forceBreak, --stratified, or cache sections",
               file=sys.stderr)
         sys.exit(-1)
     return args
@@ -236,16 +274,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                                             generate_cache_schedule)
 
     prog, strategy = build_program(args.filename, args.opt_passes)
+    retry = None
+    if args.max_retries > 0 or args.collect_timeout:
+        from coast_tpu.inject.resilience import RetryPolicy
+        retry = RetryPolicy(max_attempts=max(1, args.max_retries) + 1,
+                            collect_timeout=args.collect_timeout)
     try:
         runner = CampaignRunner(prog,
                                 sections=section_filter(prog, args.section),
                                 strategy_name=strategy,
-                                unroll=args.unroll)
+                                unroll=args.unroll,
+                                retry=retry)
     except ValueError:
         print(f"Error, {prog.region.name} has no injectable leaves in "
               f"section '{args.section}'!", file=sys.stderr)
         return 1
     mmap = runner.mmap
+
+    # Pre-flight CLI copy of CampaignJournal.open(resume=False)'s
+    # JournalExistsError: the library check only fires after schedule
+    # generation (the header embeds the schedule fingerprint), and the
+    # runner's path-argument journals auto-resume -- refuse up front so
+    # a forgotten --resume cannot touch an existing journal at all.
+    if args.journal and not args.resume and os.path.exists(args.journal) \
+            and os.path.getsize(args.journal) > 0:
+        print(f"Error, journal {args.journal} already exists; pass "
+              "--resume to continue it or delete the file to start "
+              "fresh", file=sys.stderr)
+        return 1
 
     if args.forceBreak:
         # Forced injection replay (--forceBreak, supervisor.py:357-359;
@@ -275,7 +331,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sched, batch_size=min(args.batch_size, len(sched)))
     elif args.errorCount:
         res = runner.run_until_errors(args.errorCount, seed=args.seed,
-                                      batch_size=args.batch_size)
+                                      batch_size=args.batch_size,
+                                      journal=args.journal)
     elif args.stratified:
         from coast_tpu.inject.schedule import generate_stratified_total
         sched = generate_stratified_total(mmap, args.t, args.seed,
@@ -284,7 +341,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             sched, batch_size=min(args.batch_size, len(sched)))
     else:
         res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size,
-                         start_num=args.start_num)
+                         start_num=args.start_num, journal=args.journal)
 
     print(res.summary())
     if not args.no_logging:

@@ -58,6 +58,16 @@ def main(argv=None) -> int:
                     "(0 disables)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (dev boxes)")
+    ap.add_argument("--journal", default=None,
+                    help="campaign journal path (default: <--out>"
+                    ".journal); every collected batch is fsync'd so a "
+                    "crash/SIGKILL mid-campaign loses at most one "
+                    "batch; relaunch with --resume to continue.  "
+                    "'none' disables")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume an interrupted campaign from the "
+                    "journal (validated against this invocation's "
+                    "seed/n/schedule; mismatches refused loudly)")
     args = ap.parse_args(argv)
 
     import jax
@@ -76,11 +86,22 @@ def main(argv=None) -> int:
     from coast_tpu.analysis import json_parser
     from coast_tpu.inject import logs
     from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.journal import (CampaignJournal,
+                                          config_fingerprint,
+                                          schedule_fingerprint)
     from coast_tpu.inject.schedule import generate
     from coast_tpu.models import REGISTRY
 
     def note(msg):
         print(f"# {msg}", file=sys.stderr, flush=True)
+
+    out = args.out
+    if (jax.default_backend() == "cpu"
+            and out == "artifacts/campaign_mm_1m.json"):
+        # Never let a CPU run clobber the on-chip record under the
+        # default path (same rule as flip_kernel_study / mfu_sweep).
+        # Resolved up front so the journal's default path rides along.
+        out = "artifacts/campaign_mm_1m_cpu.json"
 
     stages = {}
     t0 = time.perf_counter()
@@ -96,6 +117,30 @@ def main(argv=None) -> int:
         sched = generate(runner.mmap, args.n, args.seed,
                          prog.region.nominal_steps)
     stages["schedule_s"] = round(time.perf_counter() - t0, 3)
+
+    # Crash safety: the whole seed stream is one journal; each chunk's
+    # run_schedule appends its collected batches at journal_base=lo, so
+    # resume restarts at the first missing batch of the stream with
+    # bit-identical results (the header pins the schedule fingerprint).
+    journal = None
+    jpath = None
+    if args.journal != "none":
+        from coast_tpu.inject.journal import JournalExistsError
+        jpath = args.journal or out + ".journal"
+        os.makedirs(os.path.dirname(jpath) or ".", exist_ok=True)
+        try:
+            journal = CampaignJournal.open(jpath, {
+                "mode": "schedule", "benchmark": "matrixMultiply",
+                "strategy": "TMR",
+                "config_sha": config_fingerprint(prog.cfg),
+                "seed": args.seed, "n": args.n,
+                "schedule_sha": schedule_fingerprint(sched)},
+                resume=args.resume)
+        except JournalExistsError as e:
+            note(f"ERROR: {e}")
+            return 1
+        if args.resume:
+            note(f"resuming from journal {jpath}")
 
     # warm the compile outside the measured run; in the trace it shows
     # as one parent "warmup" span so the compile-dominated first
@@ -126,7 +171,8 @@ def main(argv=None) -> int:
                                    # accounting entirely off when the
                                    # heartbeat is disabled
                                    progress=(_progress if heartbeat
-                                             is not None else None))
+                                             is not None else None),
+                                   journal=journal, journal_base=lo)
         parts.append(part)
         for k, v in part.counts.items():
             agg_counts[k] = agg_counts.get(k, 0) + v
@@ -192,15 +238,14 @@ def main(argv=None) -> int:
         artifact["trace_out"] = args.trace_out
         note(f"trace -> {args.trace_out} "
              f"({len(telemetry.events)} events; open at ui.perfetto.dev)")
-    out = args.out
-    if (jax.default_backend() == "cpu"
-            and out == "artifacts/campaign_mm_1m.json"):
-        # Never let a CPU run clobber the on-chip record under the
-        # default path (same rule as flip_kernel_study / mfu_sweep).
-        out = "artifacts/campaign_mm_1m_cpu.json"
     os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
     with open(out, "w") as fh:
         json.dump(artifact, fh, indent=1, sort_keys=True)
+    if journal is not None:
+        # Campaign complete and the artifact + logs record it: drop the
+        # journal so the next fresh run does not refuse to start.
+        journal.close()
+        os.remove(jpath)
     print(json.dumps(artifact["campaign"]))
     print(f"stages: {stages}  -> {out}")
     return 0

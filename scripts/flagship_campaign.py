@@ -109,6 +109,8 @@ def main(argv=None):
 
     from coast_tpu import DWC, TMR, obs
     from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.journal import CampaignJournal, JournalExistsError
+    from coast_tpu.inject.resilience import RetryPolicy
     from coast_tpu.models import REGISTRY, mm256
 
     ap = argparse.ArgumentParser()
@@ -119,6 +121,20 @@ def main(argv=None):
                     help="progress heartbeat interval in seconds "
                     "(0 disables); flagship chunks run minutes, so the "
                     "heartbeat is the liveness signal")
+    ap.add_argument("--journal", default=None,
+                    help="campaign journal path stem (default: alongside "
+                    "the artifact); each strategy journals its completed "
+                    "chunks here so a crash/preemption/SIGKILL mid-"
+                    "campaign loses at most one chunk.  'none' disables")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the journals of an interrupted "
+                    "run; without it an existing journal is an error")
+    ap.add_argument("--max-retries", type=int, default=3,
+                    help="transient-dispatch retries per batch "
+                    "(exponential backoff); 0 disables the retry layer")
+    ap.add_argument("--collect-timeout", type=float, default=None,
+                    help="watchdog seconds on the blocking batch fetch; "
+                    "a wedged device_get is re-dispatched")
     args = ap.parse_args(argv)
 
     # One shared recorder across every runner of the session, so the
@@ -164,8 +180,14 @@ def main(argv=None):
     # fallback when the backend exposes no memory stats, and a single
     # warm-up run at the analytic batch is the assert that the arithmetic
     # actually fits.
+    # max(1, ...): --collect-timeout alone must still re-dispatch a
+    # wedged batch at least once (same convention as the supervisor CLI).
+    retry = (RetryPolicy(max_attempts=max(1, args.max_retries) + 1,
+                         collect_timeout=args.collect_timeout)
+             if (args.max_retries > 0 or args.collect_timeout) else None)
     tmr_runner = CampaignRunner(TMR(region, pallas_voters=True),
-                                strategy_name="TMR", telemetry=telemetry)
+                                strategy_name="TMR", telemetry=telemetry,
+                                retry=retry)
     out["batch_probe"] = []
     best_batch, best_rate = None, -1.0
     analytic, hbm_info = analytic_batch(region, lanes=3)
@@ -217,17 +239,71 @@ def main(argv=None):
     out["batch"] = best_batch
 
     # -- main campaigns, chunked + resumable --------------------------------
+    journal_paths = []
     for strat_name, runner, n_total in (
             ("TMR", tmr_runner, n_tmr),
             ("DWC", CampaignRunner(DWC(region, pallas_voters=True),
                                    strategy_name="DWC",
-                                   telemetry=telemetry), n_dwc)):
+                                   telemetry=telemetry, retry=retry),
+             n_dwc)):
         counts, done, secs = {}, 0, 0.0
         stages = {}
+        resil = {}
+        key = f"campaign_{strat_name}"
+        lanes = 3 if strat_name == "TMR" else 2
+        fl = lanes * region.meta["flops_per_run"]
+
+        def flush_key():
+            out[key] = {
+                "strategy": strat_name, "seed": 42,
+                "injections": done, "target": n_total,
+                "batch_size": best_batch,
+                "seconds": round(secs, 2),
+                "injections_per_sec": round(done / secs, 2) if secs else 0.0,
+                "gflops_per_sec": round(fl * done / max(secs, 1e-9) / 1e9, 2),
+                "fraction_of_peak": round(
+                    fl * done / max(secs, 1e-9) / 1e9 / PEAK_GFLOPS, 5),
+                "counts": counts,
+                "rates": rate_block(counts, done),
+                "stages": stages,
+                "resilience": resil,
+                "complete": done >= n_total,
+            }
+            save()
+
+        # Crash safety: every completed chunk is fsync'd to a per-strategy
+        # journal (default on), so a preemption/OOM-kill/SIGKILL mid-
+        # campaign loses at most the in-flight chunk; relaunching with
+        # --resume replays the completed prefix from disk.
+        journal = None
+        if args.journal != "none":
+            jpath = f"{args.journal or path}.{strat_name}.journal"
+            os.makedirs(os.path.dirname(jpath) or ".", exist_ok=True)
+            try:
+                journal = CampaignJournal.open(
+                    jpath, {"mode": "flagship", "benchmark": bench,
+                            "strategy": strat_name, "seed": 42,
+                            "n_total": n_total, "chunk": chunk},
+                    resume=args.resume)
+            except JournalExistsError as e:
+                print(json.dumps({"error": str(e)}))
+                return 1
+            journal_paths.append(jpath)
+            for rec in journal.chunk_records():
+                done += int(rec["n"])
+                secs += float(rec.get("seconds", 0.0))
+                for k, v in rec["counts"].items():
+                    counts[k] = counts.get(k, 0) + int(v)
+                for k, v in (rec.get("stage_seconds") or {}).items():
+                    stages[k] = round(stages.get(k, 0.0) + float(v), 6)
+            if done:
+                print(json.dumps({"strategy": strat_name,
+                                  "resumed_from_journal": done}))
+                flush_key()
+
         heartbeat = (obs.Heartbeat(n_total, interval_s=args.heartbeat,
                                    label=f"heartbeat {strat_name}")
                      if args.heartbeat > 0 else None)
-        key = f"campaign_{strat_name}"
         while done < n_total:
             n_chunk = min(chunk, n_total - done)
 
@@ -241,31 +317,21 @@ def main(argv=None):
                              start_num=done,
                              progress=(_progress if heartbeat is not None
                                        else None))
+            if journal is not None:
+                journal.append_chunk(res)
             done += res.n
             secs += res.seconds
             for k, v in res.counts.items():
                 counts[k] = counts.get(k, 0) + v
             for k, v in res.stages.items():
                 stages[k] = round(stages.get(k, 0.0) + v, 6)
-            lanes = 3 if strat_name == "TMR" else 2
-            fl = lanes * region.meta["flops_per_run"]
-            out[key] = {
-                "strategy": strat_name, "seed": 42,
-                "injections": done, "target": n_total,
-                "batch_size": best_batch,
-                "seconds": round(secs, 2),
-                "injections_per_sec": round(done / secs, 2),
-                "gflops_per_sec": round(fl * done / secs / 1e9, 2),
-                "fraction_of_peak": round(
-                    fl * done / secs / 1e9 / PEAK_GFLOPS, 5),
-                "counts": counts,
-                "rates": rate_block(counts, done),
-                "stages": stages,
-                "complete": done >= n_total,
-            }
-            save()
+            for k, v in res.resilience.items():
+                resil[k] = resil.get(k, 0) + v
+            flush_key()
             print(json.dumps({"strategy": strat_name, "done": done,
                               "inj_per_sec": out[key]["injections_per_sec"]}))
+        if journal is not None:
+            journal.close()
 
     # -- slice-vote vs whole-leaf-vote A/B (campaign inj/s) -----------------
     region_wl = mm256.make_region(side=1024, block=512, bf16_matmul=True)
@@ -296,6 +362,12 @@ def main(argv=None):
         save()
         print(json.dumps({"trace": args.trace_out,
                           "events": len(telemetry.events)}))
+    # Both campaigns completed and the artifact records them: the journals
+    # have served their purpose (keeping them would make the next fresh
+    # run refuse to start without --resume).
+    for jpath in journal_paths:
+        if os.path.exists(jpath):
+            os.remove(jpath)
     print(json.dumps({"wrote": path}))
     return 0
 

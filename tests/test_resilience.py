@@ -1,0 +1,550 @@
+"""Crash-safety and fault-tolerant-dispatch tests (ISSUE 4).
+
+The resume-parity suite: a campaign killed after k collected batches and
+relaunched against its journal must complete with ``codes``/``counts``
+(and the per-run log columns) bit-for-bit identical to the uninterrupted
+run -- the gdbClient.py:401 seeded-resume guarantee extended with the
+supervisor's restart *machinery*.  Plus: injected transient dispatch
+failures and a fake-OOM degradation path exercised on CPU, the collect
+watchdog, journal header-mismatch refusal, atomic log writes, and the
+progress-heartbeat threading through the multi-chunk loops.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR, unprotected
+from coast_tpu.inject.campaign import CampaignRunner, _merge_results
+from coast_tpu.inject.journal import (CampaignJournal, JournalError,
+                                      JournalExistsError,
+                                      JournalMismatchError,
+                                      schedule_fingerprint)
+from coast_tpu.inject.resilience import (CampaignWedgedError, RetryPolicy,
+                                         watchdog_collect)
+from coast_tpu.inject.schedule import generate
+from coast_tpu.models import mm
+
+
+class Kill(Exception):
+    """Stands in for SIGKILL: raised from a progress callback, it aborts
+    the campaign mid-flight with only the journal left behind (the
+    journal record of a batch is fsync'd *before* the progress beat, so
+    everything already collected is on disk, exactly as after a real
+    kill)."""
+
+
+class FakeTransient(Exception):
+    pass
+
+
+class FakeOOM(Exception):
+    pass
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def runner(region):
+    return CampaignRunner(TMR(region), strategy_name="TMR")
+
+
+@pytest.fixture(scope="module")
+def baseline(runner):
+    """The uninterrupted run every resume test must reproduce exactly."""
+    return runner.run(200, seed=9, batch_size=50)
+
+
+def _kill_after(n_beats):
+    state = {"n": 0}
+
+    def cb(done, counts):
+        state["n"] += 1
+        if state["n"] >= n_beats:
+            raise Kill
+    return cb
+
+
+# -- journal resume parity ---------------------------------------------------
+
+def test_resume_parity_after_kill(runner, baseline, tmp_path):
+    """Kill after k collected batches; resume from the journal; codes,
+    counts, and the per-run log columns are bit-for-bit the
+    uninterrupted run's."""
+    jpath = str(tmp_path / "c.journal")
+    with pytest.raises(Kill):
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(2))
+    # the journal holds exactly the collected prefix, fsync'd
+    recs = [json.loads(line) for line in open(jpath)]
+    assert recs[0]["kind"] == "header"
+    batches = [r for r in recs if r["kind"] == "batch"]
+    assert len(batches) == 2
+    res = runner.run(200, seed=9, batch_size=50, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert np.array_equal(res.errors, baseline.errors)
+    assert np.array_equal(res.steps, baseline.steps)
+    assert res.counts == baseline.counts
+    # log output parity: the per-run columns the writers serialize
+    from coast_tpu.inject import logs
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    logs.write_columnar(baseline, runner.mmap, p1)
+    logs.write_columnar(res, runner.mmap, p2)
+    d1, d2 = json.load(open(p1)), json.load(open(p2))
+    assert d1["columns"] == d2["columns"]
+    assert d1["sections"] == d2["sections"]
+
+
+def test_resume_tolerates_torn_tail(runner, baseline, tmp_path):
+    """A SIGKILL mid-append leaves a truncated trailing line; resume
+    drops it (that batch never completed) and redoes the batch."""
+    jpath = str(tmp_path / "torn.journal")
+    with pytest.raises(Kill):
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(3))
+    with open(jpath, "a") as f:
+        f.write('{"kind": "batch", "lo": 150, "n": 50, "codes": [1, 2')
+    res = runner.run(200, seed=9, batch_size=50, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert res.counts == baseline.counts
+
+
+def test_torn_tail_truncated_before_reappend(runner, baseline, tmp_path):
+    """Resume after a torn tail must truncate the fragment BEFORE
+    appending, else the next record fuses onto it and the journal is
+    corrupt for the *second* resume (kill -> torn tail -> resume ->
+    kill again -> resume)."""
+    jpath = str(tmp_path / "torn2.journal")
+    with pytest.raises(Kill):
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(1))
+    with open(jpath, "a") as f:
+        f.write('{"kind": "batch", "lo": 50, "n": 50, "codes": [1, 2')
+    with pytest.raises(Kill):           # resume, then die again later
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(3))
+    for line in open(jpath):            # every surviving line is valid
+        json.loads(line)
+    res = runner.run(200, seed=9, batch_size=50, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert res.counts == baseline.counts
+
+
+def test_corrupt_middle_is_hard_error(runner, tmp_path):
+    jpath = str(tmp_path / "corrupt.journal")
+    with pytest.raises(Kill):
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(2))
+    lines = open(jpath).readlines()
+    lines[1] = "NOT JSON\n"
+    with open(jpath, "w") as f:
+        f.writelines(lines)
+    with pytest.raises(JournalError):
+        runner.run(200, seed=9, batch_size=50, journal=jpath)
+
+
+def test_complete_journal_resumes_without_dispatch(runner, baseline,
+                                                   tmp_path):
+    jpath = str(tmp_path / "full.journal")
+    runner.run(200, seed=9, batch_size=50, journal=jpath)
+
+    def boom(fault):
+        raise AssertionError("resumed campaign should not dispatch")
+    fresh = CampaignRunner(runner.prog, strategy_name="TMR")
+    fresh._dispatch = boom
+    res = fresh.run(200, seed=9, batch_size=50, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+
+
+def test_header_mismatch_refused(runner, tmp_path):
+    """A journal written for a different campaign must never silently
+    seed another one: seed, n, start_num, and program identity are all
+    pinned."""
+    jpath = str(tmp_path / "m.journal")
+    runner.run(100, seed=9, batch_size=50, journal=jpath)
+    with pytest.raises(JournalMismatchError):
+        runner.run(100, seed=10, batch_size=50, journal=jpath)
+    with pytest.raises(JournalMismatchError):
+        runner.run(150, seed=9, batch_size=50, journal=jpath)
+    with pytest.raises(JournalMismatchError):
+        runner.run(100, seed=9, batch_size=50, start_num=7, journal=jpath)
+    other = CampaignRunner(unprotected(mm.make_region()),
+                           strategy_name="none")
+    with pytest.raises(JournalMismatchError):
+        other.run(100, seed=9, batch_size=50, journal=jpath)
+
+
+def test_journal_exists_refusal(tmp_path):
+    jpath = str(tmp_path / "exists.journal")
+    CampaignJournal.open(jpath, {"mode": "run", "seed": 1}).close()
+    with pytest.raises(JournalExistsError):
+        CampaignJournal.open(jpath, {"mode": "run", "seed": 1},
+                             resume=False)
+
+
+def test_resume_batch_size_independent(runner, baseline, tmp_path):
+    """Batch geometry is volatile: resuming with a different batch_size
+    still reproduces the run exactly (records are row-ranged, and the
+    journal prefix is chunking-agnostic)."""
+    jpath = str(tmp_path / "bs.journal")
+    with pytest.raises(Kill):
+        runner.run(200, seed=9, batch_size=50, journal=jpath,
+                   progress=_kill_after(2))
+    res = runner.run(200, seed=9, batch_size=30, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert res.counts == baseline.counts
+
+
+def test_run_schedule_journal_base_chunks(runner, tmp_path):
+    """The campaign_1m pattern: one seed stream sliced into chunks, all
+    journaled into one file at journal_base=lo; a kill inside chunk 2
+    resumes at the first missing batch of the stream."""
+    with runner.telemetry.activate():
+        sched = generate(runner.mmap, 300, 5,
+                         runner.prog.region.nominal_steps)
+    base_parts = [runner.run_schedule(sched.slice(lo, lo + 150),
+                                      batch_size=50)
+                  for lo in (0, 150)]
+    base = _merge_results(base_parts, 5)
+
+    jpath = str(tmp_path / "stream.journal")
+    header = {"mode": "schedule", "seed": 5, "n": 300,
+              "schedule_sha": schedule_fingerprint(sched)}
+    j = CampaignJournal.open(jpath, header)
+    runner.run_schedule(sched.slice(0, 150), batch_size=50, journal=j,
+                        journal_base=0)
+    with pytest.raises(Kill):
+        runner.run_schedule(sched.slice(150, 300), batch_size=50,
+                            journal=j, journal_base=150,
+                            progress=_kill_after(2))
+    j.close()
+
+    j2 = CampaignJournal.open(jpath, header)
+    parts = [runner.run_schedule(sched.slice(lo, lo + 150), batch_size=50,
+                                 journal=j2, journal_base=lo)
+             for lo in (0, 150)]
+    j2.close()
+    res = _merge_results(parts, 5)
+    assert np.array_equal(res.codes, base.codes)
+    assert res.counts == base.counts
+
+
+# -- multi-chunk journaling (run_until_errors / replay_chunks) ---------------
+
+@pytest.fixture(scope="module")
+def unprot_runner(region):
+    return CampaignRunner(unprotected(region), strategy_name="none")
+
+
+@pytest.fixture(scope="module")
+def until_baseline(unprot_runner):
+    return unprot_runner.run_until_errors(min_errors=5, seed=1,
+                                          batch_size=200, round_to=500)
+
+
+def test_until_errors_resume_parity(unprot_runner, until_baseline,
+                                    tmp_path):
+    jpath = str(tmp_path / "e.journal")
+    with pytest.raises(Kill):
+        unprot_runner.run_until_errors(
+            min_errors=5, seed=1, batch_size=200, round_to=500,
+            journal=jpath,
+            progress=_kill_after(2))   # dies inside the second chunk
+    res = unprot_runner.run_until_errors(min_errors=5, seed=1,
+                                         batch_size=200, round_to=500,
+                                         journal=jpath)
+    assert np.array_equal(res.codes, until_baseline.codes)
+    assert res.counts == until_baseline.counts
+    assert res.chunks == until_baseline.chunks
+
+
+def test_until_errors_journal_mismatch(unprot_runner, tmp_path):
+    jpath = str(tmp_path / "e2.journal")
+    unprot_runner.run_until_errors(min_errors=5, seed=1, batch_size=200,
+                                   round_to=500, journal=jpath)
+    with pytest.raises(JournalMismatchError):
+        unprot_runner.run_until_errors(min_errors=7, seed=1,
+                                       batch_size=200, round_to=500,
+                                       journal=jpath)
+
+
+def test_replay_chunks_journal(unprot_runner, until_baseline, tmp_path):
+    jpath = str(tmp_path / "r.journal")
+    rep = unprot_runner.replay_chunks(until_baseline.chunks,
+                                      batch_size=200, journal=jpath)
+    assert np.array_equal(rep.codes, until_baseline.codes)
+    # second invocation replays entirely from the journal
+    fresh = CampaignRunner(unprot_runner.prog, strategy_name="none")
+    fresh._dispatch = lambda fault: (_ for _ in ()).throw(
+        AssertionError("should replay from journal"))
+    rep2 = fresh.replay_chunks(until_baseline.chunks, batch_size=200,
+                               journal=jpath)
+    assert np.array_equal(rep2.codes, until_baseline.codes)
+
+
+# -- progress threading (satellite) ------------------------------------------
+
+def test_progress_through_run_until_errors(unprot_runner, until_baseline):
+    beats = []
+    unprot_runner.run_until_errors(
+        min_errors=5, seed=1, batch_size=200, round_to=500,
+        progress=lambda done, counts: beats.append((done, counts["sdc"])))
+    dones = [d for d, _ in beats]
+    assert dones[-1] == until_baseline.n
+    assert dones == sorted(dones)          # cumulative across chunks
+    sdcs = [s for _, s in beats]
+    assert sdcs == sorted(sdcs)
+    assert sdcs[-1] == until_baseline.counts["sdc"]
+
+
+def test_progress_through_replay_chunks(unprot_runner, until_baseline):
+    beats = []
+    unprot_runner.replay_chunks(
+        until_baseline.chunks, batch_size=200,
+        progress=lambda done, counts: beats.append(done))
+    assert beats[-1] == until_baseline.n
+    assert beats == sorted(beats)
+
+
+# -- empty-parts guard (satellite) -------------------------------------------
+
+def test_merge_empty_parts_guard():
+    with pytest.raises(ValueError, match="no chunks"):
+        _merge_results([], 0)
+
+
+def test_replay_empty_chunks_guard(unprot_runner):
+    with pytest.raises(ValueError, match="empty chunk list"):
+        unprot_runner.replay_chunks([])
+
+
+# -- fault-tolerant dispatch -------------------------------------------------
+
+def test_transient_collect_failure_retried(region, baseline):
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0,
+                      transient_types=(FakeTransient,))
+    r = CampaignRunner(TMR(region), strategy_name="TMR", retry=pol)
+    orig = CampaignRunner._collect
+    state = {"n": 0}
+
+    def flaky(pending):
+        state["n"] += 1
+        if state["n"] == 2:
+            raise FakeTransient("injected")
+        return orig(pending)
+    r._collect = flaky
+    res = r.run(200, seed=9, batch_size=50)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert res.resilience["retry_transient"] == 1
+    assert res.summary()["resilience"]["retry_transient"] == 1
+    assert r.telemetry.counters["resilience_retry_transient"] == 1
+
+
+def test_transient_retries_exhausted_raise(region):
+    pol = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0,
+                      transient_types=(FakeTransient,))
+    r = CampaignRunner(TMR(region), retry=pol)
+    r._collect = lambda pending: (_ for _ in ()).throw(
+        FakeTransient("always"))
+    with pytest.raises(FakeTransient):
+        r.run(100, seed=9, batch_size=50)
+
+
+def test_fatal_errors_not_retried(region):
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0,
+                      transient_types=(FakeTransient,))
+    r = CampaignRunner(TMR(region), retry=pol)
+    state = {"n": 0}
+
+    def fatal(pending):
+        state["n"] += 1
+        raise KeyError("a bug, not a device hiccup")
+    r._collect = fatal
+    with pytest.raises(KeyError):
+        r.run(100, seed=9, batch_size=50)
+    assert state["n"] == 1                  # exactly one attempt
+
+
+def test_oom_degrades_batch_size(region, baseline, tmp_path):
+    """Fake-OOM: any dispatch above 25 rows fails; the runner halves
+    100 -> 50 -> 25, journals the new geometry, and completes with
+    bit-identical results."""
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0, oom_types=(FakeOOM,))
+    r = CampaignRunner(TMR(region), strategy_name="TMR", retry=pol)
+    orig = CampaignRunner._dispatch
+
+    def oom_above_25(fault):
+        if len(np.asarray(fault["bit"])) > 25:
+            raise FakeOOM("RESOURCE_EXHAUSTED (fake)")
+        return orig(r, fault)
+    r._dispatch = oom_above_25
+    jpath = str(tmp_path / "oom.journal")
+    res = r.run(200, seed=9, batch_size=100, journal=jpath)
+    assert np.array_equal(res.codes, baseline.codes)
+    assert res.counts == baseline.counts
+    assert res.resilience["oom_degrade"] == 2
+    geoms = [json.loads(line) for line in open(jpath)
+             if '"geometry"' in line]
+    assert [g["batch_size"] for g in geoms] == [50, 25]
+
+
+def test_oom_at_floor_is_fatal(region):
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0, oom_types=(FakeOOM,),
+                      min_batch_size=50)
+    r = CampaignRunner(TMR(region), retry=pol)
+    r._dispatch = lambda fault: (_ for _ in ()).throw(
+        FakeOOM("RESOURCE_EXHAUSTED (fake)"))
+    with pytest.raises(FakeOOM):
+        r.run(100, seed=9, batch_size=50)
+
+
+def test_collect_watchdog_redispatches(region):
+    """A hung device_get (the QEMU-wedge analogue) trips the watchdog;
+    the batch is re-dispatched and the campaign completes."""
+    import time
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0, collect_timeout=0.2)
+    r = CampaignRunner(TMR(region), strategy_name="TMR", retry=pol)
+    base = CampaignRunner(TMR(region)).run(100, seed=9, batch_size=50)
+    orig = CampaignRunner._collect
+    state = {"n": 0}
+
+    def hang_once(pending):
+        state["n"] += 1
+        if state["n"] == 1:
+            time.sleep(2.0)
+        return orig(pending)
+    r._collect = hang_once
+    res = r.run(100, seed=9, batch_size=50)
+    assert np.array_equal(res.codes, base.codes)
+    assert res.resilience["retry_wedged"] == 1
+
+
+def test_watchdog_exhausted_raises_wedged():
+    import time
+    with pytest.raises(CampaignWedgedError):
+        watchdog_collect(lambda: time.sleep(5), timeout=0.1)
+    assert watchdog_collect(lambda: 42, timeout=1.0) == 42
+    assert watchdog_collect(lambda: 42, timeout=None) == 42
+
+
+def test_retry_policy_classification():
+    pol = RetryPolicy()
+    assert pol.classify(RuntimeError("RESOURCE_EXHAUSTED: boom")) == "oom"
+    assert pol.classify(RuntimeError("UNAVAILABLE: socket")) == "transient"
+    assert pol.classify(CampaignWedgedError("hung")) == "wedged"
+    assert pol.classify(ValueError("UNAVAILABLE")) == "fatal"  # not runtime
+    assert pol.classify(KeyError("x")) == "fatal"
+    # backoff is exponential and capped
+    flat = RetryPolicy(base_delay=1.0, max_delay=4.0, jitter=0.0)
+    assert [flat.backoff(k) for k in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 4.0]
+    assert RetryPolicy(oom_degrade=False).degraded_batch(100) is None
+    assert RetryPolicy().degraded_batch(100) == 50
+    assert RetryPolicy(min_batch_size=80).degraded_batch(100) == 80
+    assert RetryPolicy().degraded_batch(1) is None
+
+
+# -- atomic log writes (satellite) -------------------------------------------
+
+def test_atomic_writers_never_truncate(runner, baseline, tmp_path,
+                                       monkeypatch):
+    """A crash mid-serialize must leave the previous log intact and no
+    temp litter -- json_parser never sees a half-written file."""
+    from coast_tpu.inject import logs
+    path = str(tmp_path / "log.json")
+    logs.write_json(baseline, runner.mmap, path)
+    good = open(path).read()
+
+    def boom(res, mmap):
+        raise RuntimeError("crash mid-serialize")
+    monkeypatch.setattr(logs, "to_injection_logs", boom)
+    with pytest.raises(RuntimeError):
+        logs.write_json(baseline, runner.mmap, path)
+    assert open(path).read() == good
+    monkeypatch.setattr(logs, "_columns", boom)
+    with pytest.raises(RuntimeError):
+        logs.write_columnar(baseline, runner.mmap, path)
+    assert open(path).read() == good
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_ndjson_writer_is_atomic(runner, baseline, tmp_path, monkeypatch):
+    from coast_tpu.inject import logs
+    path = str(tmp_path / "log.ndjson")
+    logs.write_ndjson(baseline, runner.mmap, path)
+    good = open(path).read()
+
+    def boom(*a, **k):
+        raise RuntimeError("crash mid-serialize")
+    monkeypatch.setattr(logs, "_ndjson_try_native", lambda *a: False)
+    monkeypatch.setattr(logs, "_write_ndjson_py", boom)
+    with pytest.raises(RuntimeError):
+        logs.write_ndjson(baseline, runner.mmap, path)
+    assert open(path).read() == good
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_json_parser_surfaces_resilience(region, tmp_path):
+    """The analysis path completes the loop: a campaign that retried its
+    way to completion says so in the summarized log."""
+    from coast_tpu.analysis import json_parser
+    from coast_tpu.inject import logs
+    pol = RetryPolicy(base_delay=0.0, jitter=0.0,
+                      transient_types=(FakeTransient,))
+    r = CampaignRunner(TMR(region), strategy_name="TMR", retry=pol)
+    orig = CampaignRunner._collect
+    state = {"n": 0}
+
+    def flaky(pending):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise FakeTransient("injected")
+        return orig(pending)
+    r._collect = flaky
+    res = r.run(100, seed=9, batch_size=50)
+    path = str(tmp_path / "resil.json")
+    logs.write_json(res, r.mmap, path)
+    summ = json_parser.summarize_path(path)
+    assert summ.resilience == {"retry_transient": 1, "retry_wedged": 0,
+                               "oom_degrade": 0}
+    assert "retry_transient" in summ.format()
+
+
+# -- supervisor CLI ----------------------------------------------------------
+
+def test_supervisor_journal_flags(tmp_path, capsys):
+    from coast_tpu.inject import supervisor
+    jpath = str(tmp_path / "sup.journal")
+    argv = ["-f", "matrixMultiply", "-t", "40", "-d", "cpu", "-q",
+            "--batch-size", "20", "--journal", jpath]
+    assert supervisor.main(argv) == 0
+    out1 = [line for line in capsys.readouterr().out.splitlines()
+            if line.startswith("{")]
+    # an existing journal without --resume is refused
+    assert supervisor.main(argv) == 1
+    capsys.readouterr()
+    assert supervisor.main(argv + ["--resume"]) == 0
+    out2 = [line for line in capsys.readouterr().out.splitlines()
+            if line.startswith("{")]
+    c1, c2 = eval(out1[0]), eval(out2[0])   # summary dicts printed repr-style
+    for key in ("success", "corrected", "sdc", "due_abort", "injections"):
+        assert c1[key] == c2[key]
+
+
+def test_supervisor_resume_requires_journal():
+    from coast_tpu.inject import supervisor
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "-t", "1", "--resume"])
+
+
+def test_supervisor_journal_rejects_force_break(tmp_path):
+    from coast_tpu.inject import supervisor
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "-t", "1", "--journal",
+             str(tmp_path / "j"), "-b", "x:0:0:0:0"])
