@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -71,6 +71,13 @@ mfu_sweep:
 # bit-for-bit identical codes/counts.
 resume_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.resume_smoke
+
+# Streaming-serialization smoke (also a fast.yml driver row): interrupt
+# a journaled streaming campaign, resume, require the final log's rows
+# bit-for-bit identical to the uninterrupted streamed and one-shot
+# writers.
+stream_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.stream_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

@@ -298,6 +298,25 @@ def _claim_like(error: str) -> bool:
         "wedged in stage 'spawn'", "wedged in stage 'init'"))
 
 
+def _tail_line(text: str, limit: int = 240) -> str:
+    """Bounded one-line tail of a stderr blob.
+
+    Worker stderr can be multi-KB of XLA/JAX spew; embedding it raw in
+    the metric line's note/error fields made the recorded JSON metric
+    carry whole wedged-spawn logs (BENCH_r05).  Keep the last few
+    non-empty lines, collapsed to one ' / '-joined line, hard-capped at
+    ``limit`` characters from the TAIL (the newest text is the
+    diagnostic one)."""
+    lines = [ln.strip() for ln in text.strip().splitlines() if ln.strip()]
+    return _tail_cap(" / ".join(lines[-3:]), limit)
+
+
+def _tail_cap(text: str, limit: int) -> str:
+    """Hard cap keeping the TAIL: in both stderr blobs and multi-attempt
+    error joins, the newest text is the diagnostic one."""
+    return text if len(text) <= limit else "..." + text[-limit:]
+
+
 def _attempt(backend: str, timeout_s: int):
     """Run one worker; returns (records, error_note)."""
     env = dict(os.environ)
@@ -364,11 +383,10 @@ def _attempt(backend: str, timeout_s: int):
         sel.close()
     if proc.returncode not in (0, None) and error is None:
         error = (f"worker exited rc={proc.returncode} in stage '{stage}': "
-                 + stderr_tail.strip().splitlines()[-1] if stderr_tail.strip()
+                 + _tail_line(stderr_tail, 160) if stderr_tail.strip()
                  else f"worker exited rc={proc.returncode}")
     if error and stderr_tail.strip():
-        error += " | stderr: " + " / ".join(
-            stderr_tail.strip().splitlines()[-3:])
+        error += " | stderr: " + _tail_line(stderr_tail)
     return records, error
 
 
@@ -457,7 +475,11 @@ def main() -> int:
             "flagship": summary.get("flagship"),
         }
         if errors:
-            full["error"] = "; ".join(errors)
+            # Per-attempt notes are already one bounded line each; cap
+            # the join too (tail side: the newest attempt's failure is
+            # the one worth keeping) so the artifact's error field stays
+            # a summary, never a log dump.
+            full["error"] = _tail_cap("; ".join(errors), 900)
         # One predicate for "this ran on the host": the worker-REPORTED
         # backend, not the attempt label -- a "default" attempt on a
         # TPU-less box silently resolves to CPU and must carry the same
@@ -507,7 +529,7 @@ def main() -> int:
         if "note" in full:
             line["note"] = full["note"]
         if errors:
-            line["error"] = "; ".join(errors)[:300]
+            line["error"] = _tail_cap("; ".join(errors), 300)
         line["artifact"] = "artifacts/bench_full.json"
         print(json.dumps(line))
         for e in errors:
@@ -516,7 +538,8 @@ def main() -> int:
     line = {"metric": "mm_tmr_fault_injections_per_sec"}
     # No measurement anywhere: still one parseable JSON line, nonzero rc.
     line.update({"value": None, "unit": "injections/sec", "vs_baseline": None,
-                 "error": "; ".join(errors) or "no measurement produced",
+                 "error": (_tail_cap("; ".join(errors), 900)
+                           or "no measurement produced"),
                  "partial": summary or None})
     print(json.dumps(line))
     for e in errors:

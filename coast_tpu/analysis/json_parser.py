@@ -21,9 +21,14 @@ supportClasses.py:338-353) and reproduces the reference's analyses
   * pipeline stage breakdown -- the per-stage wall-clock block
     (schedule/pad/dispatch/collect/classify/serialize) the telemetry
     layer (coast_tpu.obs) records into every log's summary, printed
-    under the timing line and summed key-wise over directories.  This
-    has no reference analogue: at one injection every few seconds the
-    reference never needed stage attribution.
+    under the timing line and summed key-wise over directories (the
+    streaming writer's ``overlap`` entry is a fraction, rendered on its
+    own line and averaged over a directory).  This has no reference
+    analogue: at one injection every few seconds the reference never
+    needed stage attribution.
+
+``.gz`` logs (the writers' optional gzip container) are decompressed
+transparently everywhere a plain log is accepted.
 
 CLI (mirroring ``jsonParser.py logs/ -p | -k fileB | -d dirB``)::
 
@@ -173,11 +178,21 @@ class Summary:
                 f"injection ({self.n / self.seconds:.1f} injections/sec)")
         if self.stages:
             lines.append("  --- stage breakdown ---")
-            total = sum(self.stages.values()) or 1.0
-            for stage, sec in sorted(self.stages.items(),
+            # 'overlap' is a FRACTION (share of serialization work the
+            # streaming writer hid under dispatch), not a seconds
+            # bucket: keep it out of the percentage table and print it
+            # on its own line.
+            seconds = {k: v for k, v in self.stages.items()
+                       if k != "overlap"}
+            total = sum(seconds.values()) or 1.0
+            for stage, sec in sorted(seconds.items(),
                                      key=lambda kv: -kv[1]):
                 lines.append(f"  {stage:<12} {sec:>10.4f}s "
                              f"({100.0 * sec / total:5.1f}%)")
+            if "overlap" in self.stages:
+                lines.append(f"  serialize overlap: "
+                             f"{100.0 * self.stages['overlap']:.1f}% of "
+                             "serialization hidden under dispatch")
         if self.resilience and any(self.resilience.values()):
             # Surface survived dispatch failures: a campaign that retried
             # or degraded its way to completion should say so in the same
@@ -203,8 +218,19 @@ def _sniff_ndjson_head(first_line):
     return None
 
 
+def _open_log(path: str, mode: str = "r"):
+    """Open a campaign log, transparently decompressing ``.gz`` files
+    (the writers' optional gzip container: ``foo.ndjson.gz`` by
+    extension).  Text mode decodes as the writers encoded (ASCII-safe
+    JSON)."""
+    if path.endswith(".gz"):
+        import gzip
+        return gzip.open(path, mode if "b" in mode else "rt")
+    return open(path, mode)
+
+
 def read_json_file(path: str) -> Dict[str, object]:
-    with open(path) as f:
+    with _open_log(path) as f:
         first = f.readline()
         nd_head = _sniff_ndjson_head(first)
         if nd_head is not None:
@@ -241,7 +267,7 @@ def _iter_docs(path: str) -> Iterable[Tuple[str, Dict[str, object]]]:
     """
     if os.path.isdir(path):
         for fname in sorted(os.listdir(path)):
-            if not fname.endswith(".json"):
+            if not fname.endswith((".json", ".json.gz")):
                 continue
             try:
                 yield fname, read_json_file(os.path.join(path, fname))
@@ -258,6 +284,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     step_sum = 0
     step_n = 0
     stages: Dict[str, float] = {}
+    overlaps: List[float] = []
     resilience: Dict[str, int] = {}
     for doc in docs:
         if "columns" in doc:                      # vectorised columnar path
@@ -285,9 +312,16 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         summary = doc.get("summary") or {}
         seconds += float(summary.get("seconds", 0.0))
         for stage, sec in (summary.get("stages") or {}).items():
+            if stage == "overlap":
+                continue          # a fraction, not seconds: meaned below
             stages[stage] = stages.get(stage, 0.0) + float(sec)
+        ov = (summary.get("stages") or {}).get("overlap")
+        if ov is not None:
+            overlaps.append(float(ov))
         for key, cnt in (summary.get("resilience") or {}).items():
             resilience[key] = resilience.get(key, 0) + int(cnt)
+    if overlaps:
+        stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
                    mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
                    stages=stages or None,
@@ -303,7 +337,7 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
     if not native.native_available():
         return None
     try:
-        with open(path, "rb") as f:
+        with _open_log(path, "rb") as f:
             head = _sniff_ndjson_head(f.readline())
             if head is None:
                 return None

@@ -114,13 +114,27 @@ class CampaignResult:
 class CampaignRunner:
     """Runs seeded bit-flip campaigns against one protected program."""
 
+    def __new__(cls, prog: ProtectedProgram, *args, **kw):
+        # ``mesh=`` promotes the runner to the sharded backend
+        # (coast_tpu.parallel.mesh.ShardedCampaignRunner): campaign
+        # scale-out is a constructor argument, not a separate import --
+        # the batch axis shard_map'd over the mesh, classification
+        # seed-stable and identical to single-device at the same
+        # schedule.  Instantiating the subclass routes its __init__
+        # automatically (type(obj).__init__ is what Python calls).
+        if cls is CampaignRunner and kw.get("mesh") is not None:
+            from coast_tpu.parallel.mesh import ShardedCampaignRunner
+            return object.__new__(ShardedCampaignRunner)
+        return object.__new__(cls)
+
     def __init__(self, prog: ProtectedProgram,
                  sections: Optional[Sequence[str]] = None,
                  strategy_name: Optional[str] = None,
                  unroll: int = 1,
                  telemetry: Optional[obs.Telemetry] = None,
                  preflight: "bool | str" = False,
-                 retry: "Optional[object]" = None):
+                 retry: "Optional[object]" = None,
+                 mesh: "Optional[object]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -151,7 +165,19 @@ class CampaignRunner:
         OOM halves the batch geometry instead of aborting, and a
         collect watchdog converts a hung ``device_get`` into a
         re-dispatch.  None (the default) keeps dispatch failures fatal,
-        exactly as before."""
+        exactly as before.
+
+        ``mesh`` (a ``jax.sharding.Mesh``) selects the sharded backend:
+        ``CampaignRunner(prog, mesh=make_mesh(8))`` builds a
+        :class:`coast_tpu.parallel.mesh.ShardedCampaignRunner` whose
+        batch axis is shard_map'd over every mesh axis -- pass keyword
+        arguments alongside it (the subclass takes ``mesh`` as its
+        second parameter)."""
+        if mesh is not None:
+            raise TypeError(
+                "mesh= reached the base CampaignRunner constructor; pass "
+                "it as a keyword to CampaignRunner(prog, mesh=...) or use "
+                "coast_tpu.parallel.mesh.ShardedCampaignRunner directly")
         if preflight:
             from coast_tpu.analysis import lint as lint_mod
             lint_mod.check(prog, survival=(preflight != "static"))
@@ -212,7 +238,8 @@ class CampaignRunner:
                          Callable[[int, Dict[str, int]], None]] = None,
                      _telemetry_mark: Optional[int] = None,
                      journal: "Optional[object]" = None,
-                     journal_base: int = 0
+                     journal_base: int = 0,
+                     stream: "Optional[object]" = None
                      ) -> CampaignResult:
         """Run every row of ``sched`` in edge-padded batches.
 
@@ -240,6 +267,14 @@ class CampaignRunner:
         exponential backoff; OOM halves ``batch_size``, recompiles,
         re-pads, and journals the new geometry.  Everything else is
         fatal and re-raised.
+
+        ``stream`` is a :class:`coast_tpu.inject.logs.StreamLogWriter`:
+        every collected batch (journal-replayed ones included, so a
+        resumed campaign's stream file equals the uninterrupted run's)
+        is handed to its background serializer as it lands, row-numbered
+        ``journal_base + lo``.  The caller owns ``finish(res)`` /
+        ``abort()`` -- the stream may span several run_schedule calls
+        (scripts/campaign_1m.py's sliced chunks).
         """
         # Deliberately no clamp to len(sched) here: every batch is
         # edge-padded to batch_size so all chunks (including a caller's
@@ -286,6 +321,15 @@ class CampaignRunner:
                                       ("steps", "steps"))}
                 outs.append(out)
                 counts_so_far = _account(out, done)
+                if stream is not None:
+                    # A journaled batch is also a serialized batch: the
+                    # replayed columns flow through the stream writer
+                    # from disk, so the resumed stream file is the
+                    # uninterrupted run's -- no re-dispatch, and the
+                    # device loop below only serializes what it runs.
+                    stream.feed(journal_base + done,
+                                sched.slice(done, done + len(out["code"])),
+                                out)
                 done += len(out["code"])
                 if progress is not None:
                     progress(done, counts_so_far)
@@ -317,6 +361,15 @@ class CampaignRunner:
                 journal.append_batch(journal_base + flight["lo"], out,
                                      counts_so_far,
                                      tel.stage_totals(since=mark))
+            if stream is not None:
+                # Hand the batch to the background serializer right after
+                # it is durable: the encode overlaps the next dispatch,
+                # and a feed stall (writer behind) is billed as the
+                # stream's non-overlapped serialize cost, not dispatch.
+                stream.feed(journal_base + flight["lo"],
+                            sched.slice(flight["lo"],
+                                        flight["lo"] + n_part),
+                            out)
             if progress is not None:
                 progress(done, counts_so_far)
 
@@ -481,7 +534,8 @@ class CampaignRunner:
             batch_size: int = 4096, start_num: int = 0,
             progress: Optional[
                 Callable[[int, Dict[str, int]], None]] = None,
-            journal: "Optional[object]" = None
+            journal: "Optional[object]" = None,
+            stream: "Optional[object]" = None
             ) -> CampaignResult:
         """``start_num`` resumes a seeded campaign at injection #start_num:
         the schedule stream for (seed, start_num+n) is generated and the
@@ -494,7 +548,12 @@ class CampaignRunner:
         rerunning the same call against the same path resumes at the
         first missing batch after validating that the journal's header
         -- including the regenerated schedule's fingerprint -- matches
-        this campaign exactly (JournalMismatchError otherwise)."""
+        this campaign exactly (JournalMismatchError otherwise).
+
+        ``stream`` (a :class:`coast_tpu.inject.logs.StreamLogWriter`)
+        serializes each collected batch in the background as it lands;
+        the caller calls ``stream.finish(result)`` when done (and
+        ``stream.abort()`` on failure)."""
         tel = self.telemetry
         mark = tel.mark()
         with tel.activate():        # generate() records its schedule span
@@ -510,7 +569,8 @@ class CampaignRunner:
             j, owned = self._open_journal(journal, header)
         try:
             res = self.run_schedule(part, batch_size, progress=progress,
-                                    _telemetry_mark=mark, journal=j)
+                                    _telemetry_mark=mark, journal=j,
+                                    stream=stream)
         finally:
             if owned and j is not None:
                 j.close()

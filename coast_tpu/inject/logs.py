@@ -31,8 +31,11 @@ import contextlib
 import datetime
 import json
 import os
+import queue
+import shutil
+import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from coast_tpu import obs
 from coast_tpu.inject import classify as cls
@@ -49,6 +52,19 @@ class _AbortWrite(Exception):
     native ndjson fast path bowing out mid-file)."""
 
 
+def _gz_writer(raw, mode: str):
+    """Deterministic gzip layer over an open binary file: no filename, no
+    mtime in the member header, so the same campaign bytes compress to
+    the same .gz bytes (the streamed-vs-one-shot parity tests compare
+    compressed files directly).  Text modes get a TextIOWrapper whose
+    close() finalises the gzip trailer but leaves ``raw`` open -- the
+    caller still owns the fsync + rename."""
+    import gzip
+    import io
+    gz = gzip.GzipFile(filename="", mode="wb", fileobj=raw, mtime=0)
+    return gz if "b" in mode else io.TextIOWrapper(gz)
+
+
 @contextlib.contextmanager
 def _atomic_write(path: str, mode: str = "w"):
     """Crash-safe log writing: serialize into a same-directory temp file
@@ -56,17 +72,31 @@ def _atomic_write(path: str, mode: str = "w"):
     SIGKILL) mid-serialize never leaves a truncated log that json_parser
     chokes on -- readers see either the old file or the whole new one.
     Any exception from the body discards the temp file and propagates
-    (:class:`_AbortWrite` included -- callers catch it)."""
+    (:class:`_AbortWrite` included -- callers catch it).
+
+    A ``.gz`` path transparently gzip-compresses the body (deterministic
+    header; analysis/json_parser decompresses just as transparently) --
+    one extension flip turns a 347 MB campaign ndjson into its
+    compressed form with no call-site changes."""
     tmp = f"{path}.tmp.{os.getpid()}"
-    f = open(tmp, mode)
+    gzipped = path.endswith(".gz")
+    raw = open(tmp, "wb" if gzipped else mode)
+    f = _gz_writer(raw, mode) if gzipped else raw
     try:
         yield f
         f.flush()
-        os.fsync(f.fileno())
-        f.close()
+        if f is not raw:
+            f.close()          # gzip trailer; GzipFile leaves raw open
+        raw.flush()
+        os.fsync(raw.fileno())
+        raw.close()
         os.replace(tmp, path)
     except BaseException:
-        f.close()
+        with contextlib.suppress(OSError, ValueError):
+            if f is not raw:
+                f.close()
+        with contextlib.suppress(OSError):
+            raw.close()
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise
@@ -133,14 +163,32 @@ def _columns(res: CampaignResult, mmap: MemoryMap):
     }, secs
 
 
-def to_injection_logs(res: CampaignResult,
-                      mmap: MemoryMap) -> List[Dict[str, object]]:
-    ts = _timestamp()
-    col, secs = _columns(res, mmap)
-    sec_kind = {lid: s.kind for lid, s in secs.items()}
-    sec_name = {lid: s.name for lid, s in secs.items()}
+def _batch_columns(part, out: Dict[str, "np.ndarray"]):
+    """Per-run columns of ONE collected batch as plain Python lists: the
+    schedule slice supplies where/when, the collected ``out`` dict the
+    outcome columns.  The streaming writer's unit of work."""
+    return {
+        "leaf_id": part.leaf_id.tolist(),
+        "lane": part.lane.tolist(),
+        "word": part.word.tolist(),
+        "bit": part.bit.tolist(),
+        "t": part.t.tolist(),
+        "code": out["code"].tolist(),
+        "errors": out["errors"].tolist(),
+        "corrected": out["corrected"].tolist(),
+        "steps": out["steps"].tolist(),
+    }
+
+
+def _injection_log_rows(col, sec_kind: Dict[int, str],
+                        sec_name: Dict[int, str], ts: str,
+                        num0: int = 0) -> List[Dict[str, object]]:
+    """InjectionLog dicts for the rows of ``col`` (plain-list columns),
+    numbered ``num0``...: the one formatting loop behind the one-shot
+    ``to_injection_logs`` AND the streaming reference writer, so the two
+    cannot drift."""
     logs = []
-    for i in range(res.n):
+    for i in range(len(col["code"])):
         lid = col["leaf_id"][i]
         t_i = col["t"][i]
         if t_i < 0:
@@ -154,7 +202,7 @@ def to_injection_logs(res: CampaignResult,
             name = f"{sec_name[lid]}[lane {col['lane'][i]}]^bit{col['bit'][i]}"
         logs.append({
             "timestamp": ts,
-            "number": i,
+            "number": num0 + i,
             "section": section,
             "address": col["word"][i],
             "oldValue": None,              # values live on-device; the flip
@@ -172,6 +220,31 @@ def to_injection_logs(res: CampaignResult,
     return logs
 
 
+def to_injection_logs(res: CampaignResult,
+                      mmap: MemoryMap) -> List[Dict[str, object]]:
+    ts = _timestamp()
+    col, secs = _columns(res, mmap)
+    sec_kind = {lid: s.kind for lid, s in secs.items()}
+    sec_name = {lid: s.name for lid, s in secs.items()}
+    return _injection_log_rows(col, sec_kind, sec_name, ts)
+
+
+def _escaped_leaf_tables(mmap: MemoryMap):
+    """Per-leaf (kind, name) string tables, JSON-escaped once per campaign
+    for the native encoder (which only formats numbers).  None when the
+    map has no sections -- callers fall back to the Python formatter."""
+    secs = {s.leaf_id: s for s in mmap.sections}
+    if not secs:
+        return None
+    n_leaves = max(secs) + 1
+    kind_by_leaf = ["" for _ in range(n_leaves)]
+    name_by_leaf = ["" for _ in range(n_leaves)]
+    for lid, s in secs.items():
+        kind_by_leaf[lid] = json.dumps(s.kind)[1:-1]
+        name_by_leaf[lid] = json.dumps(s.name)[1:-1]
+    return kind_by_leaf, name_by_leaf
+
+
 def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
                        path: str) -> bool:
     """Write the whole ndjson log (summary line + streamed rows) via the
@@ -182,15 +255,10 @@ def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
     if not native.native_available():
         return False
     sched = res.schedule
-    secs = {s.leaf_id: s for s in mmap.sections}
-    if not secs:
+    tables = _escaped_leaf_tables(mmap)
+    if tables is None:
         return False
-    n_leaves = max(secs) + 1
-    kind_by_leaf = ["" for _ in range(n_leaves)]
-    name_by_leaf = ["" for _ in range(n_leaves)]
-    for lid, s in secs.items():
-        kind_by_leaf[lid] = json.dumps(s.kind)[1:-1]
-        name_by_leaf[lid] = json.dumps(s.name)[1:-1]
+    kind_by_leaf, name_by_leaf = tables
     col = {"leaf_id": sched.leaf_id, "lane": sched.lane, "word": sched.word,
            "bit": sched.bit, "t": sched.t, "code": res.codes,
            "errors": res.errors, "corrected": res.corrected,
@@ -272,9 +340,10 @@ def write_ndjson(res: CampaignResult, mmap: MemoryMap, path: str) -> None:
         _write_ndjson_py(res, mmap, ts, path)
 
 
-def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
-                     path: str) -> None:
-    col, secs = _columns(res, mmap)
+def _ndjson_templates(ts: str):
+    """(result templates by class code, line template) for the Python
+    ndjson formatter -- one compile per campaign, shared by the one-shot
+    writer and the streaming writer's fallback path."""
     # One result template per class, mirroring _result_dict (timestamps
     # identical across the campaign, as with write_json).
     run_tpl = ('{"timestamp": "%s", "core": 0, "runtime": %%(steps)d, '
@@ -302,33 +371,366 @@ def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
         '"sleepTime": 0, "cycles": %%(t)d, "PC": %%(t)d, '
         '"name": "%%(name)s", "symbol": "%%(symbol)s", '
         '"result": %%(result)s, "cacheInfo": null}' % ts)
+    return res_tpl, line_tpl
+
+
+def _ndjson_rows_py(col, sec_kind: Dict[int, str], sec_name: Dict[int, str],
+                    ts: str, num0: int, write) -> None:
+    """Python template formatter for ndjson rows of ``col`` (plain-list
+    columns), numbered ``num0``...; one ``write(str)`` per line.  Shared
+    by the one-shot writer (num0=0, full columns) and the streaming
+    writer (per-batch columns), byte-identical by construction."""
+    res_tpl, line_tpl = _ndjson_templates(ts)
+    for i in range(len(col["code"])):
+        lid = col["leaf_id"][i]
+        t_i = col["t"][i]
+        if t_i < 0:
+            section, symbol = "cache-invalid", "<invalid-line>"
+            name = f"<invalid-line>^bit{col['bit'][i]}"
+        else:
+            section, symbol = sec_kind[lid], sec_name[lid]
+            name = (f"{sec_name[lid]}[lane {col['lane'][i]}]"
+                    f"^bit{col['bit'][i]}")
+        result = res_tpl[col["code"][i]] % {
+            "errors": col["errors"][i], "faults": col["corrected"][i],
+            "steps": col["steps"][i]}
+        # json.dumps on the string fields: leaf names are arbitrary
+        # author-chosen strings and must be JSON-escaped.
+        write(line_tpl % {
+            "i": num0 + i, "section": json.dumps(section)[1:-1],
+            "word": col["word"][i], "t": t_i,
+            "name": json.dumps(name)[1:-1],
+            "symbol": json.dumps(symbol)[1:-1],
+            "result": result} + "\n")
+
+
+def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
+                     path: str) -> None:
+    col, secs = _columns(res, mmap)
     sec_kind = {lid: s.kind for lid, s in secs.items()}
     sec_name = {lid: s.name for lid, s in secs.items()}
     with _atomic_write(path) as f:
         f.write(json.dumps({"summary": {**res.summary(),
                                         "format": "ndjson"}}) + "\n")
-        write = f.write
-        for i in range(res.n):
-            lid = col["leaf_id"][i]
-            t_i = col["t"][i]
-            if t_i < 0:
-                section, symbol = "cache-invalid", "<invalid-line>"
-                name = f"<invalid-line>^bit{col['bit'][i]}"
-            else:
-                section, symbol = sec_kind[lid], sec_name[lid]
-                name = (f"{sec_name[lid]}[lane {col['lane'][i]}]"
-                        f"^bit{col['bit'][i]}")
-            result = res_tpl[col["code"][i]] % {
-                "errors": col["errors"][i], "faults": col["corrected"][i],
-                "steps": col["steps"][i]}
-            # json.dumps on the string fields: leaf names are arbitrary
-            # author-chosen strings and must be JSON-escaped.
-            write(line_tpl % {
-                "i": i, "section": json.dumps(section)[1:-1],
-                "word": col["word"][i], "t": t_i,
-                "name": json.dumps(name)[1:-1],
-                "symbol": json.dumps(symbol)[1:-1],
-                "result": result} + "\n")
+        _ndjson_rows_py(col, sec_kind, sec_name, ts, 0, f.write)
+
+
+#: Column order of _columns / write_columnar; the streaming columnar
+#: assembly must emit keys in exactly this order for byte-identity.
+_COLUMN_KEYS = ("leaf_id", "lane", "word", "bit", "t",
+                "code", "errors", "corrected", "steps")
+
+
+class StreamLogWriter:
+    """Overlapped campaign-log serialization: the one-shot writers' output,
+    produced incrementally while the campaign is still dispatching.
+
+    The 10^6-injection TPU rerun spent 6.9 s serializing 347 MB of ndjson
+    *after* 3.6 s of run time (docs/perf.md): host serialization was the
+    pipeline's standing bottleneck because it strictly followed the
+    device work.  This writer restructures the hot path to
+    ``max(device, host)``: ``CampaignRunner.run_schedule(stream=...)``
+    hands every collected batch to a background thread that serializes
+    it immediately -- rows via the native per-batch encoder
+    (``coast_ndjson_encode_rows``) when available -- so by the time the
+    last batch is collected, nearly the whole log is already on disk.
+
+    Guarantees:
+
+    * **Byte-identical output** to the one-shot writer of the same
+      format (``write_ndjson`` / ``write_columnar`` /
+      ``write_reference_json``) for the same campaign result -- pinned
+      by tests/test_stream_logs.py for the native and Python paths.
+    * **Journal composition**: a journal-resumed campaign feeds its
+      replayed batches from disk through the same path, so the resumed
+      stream file equals the uninterrupted run's (the batch columns come
+      from the journal; no re-dispatch).
+    * **Atomicity**: rows accumulate in a same-directory temp file; the
+      final file appears only via ``os.replace`` at :meth:`finish`
+      (``.gz`` paths compress at finish, trading that overlap for size).
+
+    Accounting: ``finish`` bills the campaign's ``stages`` block with
+    ``serialize`` = the *non-overlapped* wall clock (feed stalls + the
+    finish-side drain/assemble) and ``overlap`` = the fraction of total
+    serialization work that ran concurrently with dispatch.
+    """
+
+    FORMATS = ("ndjson", "columnar", "reference")
+
+    def __init__(self, path: str, mmap: MemoryMap, fmt: str = "ndjson",
+                 exec_path: Optional[str] = None, queue_batches: int = 8):
+        if fmt not in self.FORMATS:
+            raise ValueError(f"unknown stream log format {fmt!r}; "
+                             f"one of {self.FORMATS}")
+        self.path = path
+        self.fmt = fmt
+        self._secs = {s.leaf_id: s for s in mmap.sections}
+        self._sec_kind = {lid: s.kind for lid, s in self._secs.items()}
+        self._sec_name = {lid: s.name for lid, s in self._secs.items()}
+        self._tables = _escaped_leaf_tables(mmap)
+        self._exec_path = exec_path
+        if exec_path is not None and not os.path.exists(exec_path):
+            raise FileNotFoundError(
+                f"exec_path {exec_path!r} does not exist; the reference's "
+                "readJsonFile exits on logs whose line-1 path is missing")
+        # Bounded queue: feed() blocks when the writer falls this many
+        # batches behind -- that stall is the honest non-overlapped
+        # serialize cost, and it caps resident batch memory.
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_batches))
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self._use_native: Optional[bool] = None
+        self._ts: Optional[str] = None
+        self._rows_tmp = f"{path}.rows.{os.getpid()}"
+        self._rows_f = None
+        self._frags: Dict[str, List[str]] = {k: [] for k in _COLUMN_KEYS}
+        self._expected = 0          # next row number feed() must supply
+        self._bg_busy = 0.0         # background serialization seconds
+        self._blocked = 0.0         # main-thread seconds stalled on feed
+        self._finished = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def begin(self) -> None:
+        """Open the rows temp file and start the writer thread.  Idempotent;
+        ``feed`` calls it lazily on the first batch."""
+        if self._thread is not None:
+            return
+        if self._finished:
+            raise RuntimeError("StreamLogWriter already finished/aborted")
+        self._ts = _timestamp()
+        if self.fmt in ("ndjson", "reference"):
+            self._rows_f = open(self._rows_tmp, "wb")
+        self._thread = threading.Thread(target=self._worker,
+                                        name="coast-stream-log",
+                                        daemon=True)
+        self._thread.start()
+
+    def feed(self, num0: int, part, out: Dict[str, object]) -> None:
+        """Hand one collected batch to the writer: ``part`` is the batch's
+        FaultSchedule slice (where/when), ``out`` the trimmed outcome
+        columns, ``num0`` the batch's first global row number.  Batches
+        must arrive in order with no gaps -- exactly how
+        ``run_schedule`` collects them."""
+        if self._finished:
+            # Without this guard a feed after finish()/abort() would
+            # enqueue into the exited worker's queue -- the first
+            # queue_batches feeds silently vanish, the next blocks
+            # forever on the bounded put.
+            raise RuntimeError("StreamLogWriter already finished/aborted")
+        if self._exc is not None:
+            raise RuntimeError(
+                f"stream log writer for {self.path!r} failed"
+            ) from self._exc
+        self.begin()
+        n = len(out["code"])
+        if len(part) != n:
+            raise ValueError(f"schedule slice ({len(part)} rows) does not "
+                             f"match batch columns ({n} rows)")
+        if num0 != self._expected:
+            raise ValueError(
+                f"stream feed out of order: got rows [{num0}, {num0 + n}) "
+                f"but expected the stream to continue at {self._expected}")
+        self._expected += n
+        if n == 0:
+            return
+        t0 = time.perf_counter()
+        self._q.put((num0, part, out))
+        self._blocked += time.perf_counter() - t0
+
+    def finish(self, res: CampaignResult) -> None:
+        """Drain the writer, assemble the final file atomically, and bill
+        the campaign's stage block (``serialize`` non-overlapped seconds
+        + ``overlap`` fraction).  ``res`` is the completed campaign the
+        stream's batches came from -- its summary becomes the file
+        header, exactly as the one-shot writer would emit it."""
+        if self._finished:
+            raise RuntimeError("StreamLogWriter already finished/aborted")
+        self.begin()                # an empty campaign still gets a file
+        t_fin0 = time.perf_counter()
+        self._q.put(None)
+        self._thread.join()
+        self._finished = True
+        if self._exc is not None:
+            self._cleanup()
+            raise RuntimeError(
+                f"stream log writer for {self.path!r} failed"
+            ) from self._exc
+        if res.n != self._expected:
+            self._cleanup()
+            raise ValueError(
+                f"stream received {self._expected} rows but the campaign "
+                f"result records n={res.n}; refusing to write a log that "
+                "does not match its summary")
+        try:
+            with obs.span("serialize", writer=f"stream_{self.fmt}",
+                          path=self.path):
+                t_asm0 = time.perf_counter()
+                self._assemble(res)
+                asm = time.perf_counter() - t_asm0
+        finally:
+            self._cleanup()
+        fin = time.perf_counter() - t_fin0
+        blocking = self._blocked + fin
+        work = self._bg_busy + asm
+        if res.stages or obs.current().enabled:
+            res.record_stage("serialize", blocking)
+            res.stages["overlap"] = (
+                round(max(0.0, 1.0 - blocking / work), 4) if work > 0
+                else 0.0)
+
+    def abort(self) -> None:
+        """Discard the stream (campaign failed / interrupted): stop the
+        thread and remove the temp files.  The final path is never
+        touched.  Safe to call at any point, including twice."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join()
+        self._finished = True
+        self._cleanup()
+
+    def __enter__(self) -> "StreamLogWriter":
+        self.begin()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        # Context-manager convenience for error paths only: a normal exit
+        # still requires an explicit finish(res) (the writer cannot know
+        # the campaign result); an exceptional exit aborts.
+        if exc_type is not None and not self._finished:
+            self.abort()
+
+    def _cleanup(self) -> None:
+        if self._rows_f is not None:
+            with contextlib.suppress(OSError):
+                self._rows_f.close()
+            self._rows_f = None
+        with contextlib.suppress(OSError):
+            os.unlink(self._rows_tmp)
+        self._frags = {k: [] for k in _COLUMN_KEYS}
+
+    # -- background serialization --------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._exc is not None:
+                continue            # drain so feeders never deadlock
+            t0 = time.perf_counter()
+            try:
+                self._serialize_batch(*item)
+            except BaseException as e:  # noqa: BLE001 - surfaced at feed
+                self._exc = e
+            finally:
+                self._bg_busy += time.perf_counter() - t0
+
+    def _serialize_batch(self, num0: int, part, out) -> None:
+        if self.fmt == "ndjson":
+            if self._use_native is not False and self._tables is not None:
+                from coast_tpu import native
+                col = {"leaf_id": part.leaf_id, "lane": part.lane,
+                       "word": part.word, "bit": part.bit, "t": part.t,
+                       "code": out["code"], "errors": out["errors"],
+                       "corrected": out["corrected"],
+                       "steps": out["steps"]}
+                if native.ndjson_stream_batch(num0, col, self._tables[0],
+                                              self._tables[1], self._ts,
+                                              self._rows_f.write):
+                    self._use_native = True
+                    return
+            # Decided once: a campaign's rows all come from one formatter.
+            self._use_native = False
+            col = _batch_columns(part, out)
+            _ndjson_rows_py(col, self._sec_kind, self._sec_name, self._ts,
+                            num0, lambda s: self._rows_f.write(s.encode()))
+        elif self.fmt == "columnar":
+            col = _batch_columns(part, out)
+            for k in _COLUMN_KEYS:
+                self._frags[k].append(", ".join(map(str, col[k])))
+        else:                                   # reference
+            col = _batch_columns(part, out)
+            rows = _injection_log_rows(col, self._sec_kind, self._sec_name,
+                                       self._ts, num0)
+            text = json.dumps(rows, indent=1)
+            # json.dumps(rows, indent=1) == "[\n" + elements + "\n]";
+            # strip the brackets and join batches with ",\n" so the
+            # concatenation equals json.dump over the whole list.
+            inner = text[2:-2]
+            if num0 > 0:
+                self._rows_f.write(b",\n")
+            self._rows_f.write(inner.encode())
+
+    def _splice_rows(self, f) -> None:
+        """Copy the accumulated rows file into the final file at the
+        current position -- kernel-side (``os.sendfile``) for plain
+        binary targets, userspace for ``.gz`` (the bytes must pass
+        through the compressor)."""
+        self._rows_f.flush()
+        with open(self._rows_tmp, "rb") as rf:
+            if not self.path.endswith(".gz"):
+                f.flush()
+                size = os.fstat(rf.fileno()).st_size
+                off = 0
+                try:
+                    while off < size:
+                        sent = os.sendfile(f.fileno(), rf.fileno(), off,
+                                           size - off)
+                        if sent == 0:
+                            break
+                        off += sent
+                except OSError:
+                    pass              # cross-device/FS refusal: userspace
+                if off >= size:
+                    return
+                rf.seek(off)
+            shutil.copyfileobj(rf, f, 1 << 20)
+
+    # -- final assembly ------------------------------------------------------
+    def _assemble(self, res: CampaignResult) -> None:
+        if self.fmt == "ndjson":
+            with _atomic_write(self.path, "wb") as f:
+                f.write((json.dumps({"summary": {**res.summary(),
+                                                 "format": "ndjson"}})
+                         + "\n").encode())
+                self._splice_rows(f)
+        elif self.fmt == "columnar":
+            # Byte-for-byte the json.dump(...) of write_columnar: same
+            # top-level key order, default separators, list items joined
+            # ", " -- with the column bodies spliced from the per-batch
+            # fragments instead of materialised lists.
+            sections = [{"leaf_id": s.leaf_id, "name": s.name,
+                         "kind": s.kind, "lanes": s.lanes,
+                         "words": s.words} for s in self._secs.values()]
+            with _atomic_write(self.path) as f:
+                f.write('{"summary": ')
+                json.dump({**res.summary(), "format": "columnar"}, f)
+                f.write(', "sections": ')
+                json.dump(sections, f)
+                f.write(', "columns": {')
+                for j, k in enumerate(_COLUMN_KEYS):
+                    f.write(('' if j == 0 else ', ') + f'"{k}": [')
+                    f.write(", ".join(frag for frag in self._frags[k]))
+                    f.write(']')
+                f.write('}}')
+        else:                                   # reference
+            exec_path = self._exec_path
+            if exec_path is None:
+                from coast_tpu.models import model_source
+                exec_path = model_source(res.benchmark)
+            exec_path = os.path.realpath(exec_path)
+            if not os.path.exists(exec_path):
+                raise FileNotFoundError(
+                    f"exec_path {exec_path!r} does not exist; the "
+                    "reference's readJsonFile exits on logs whose line-1 "
+                    "path is missing")
+            with _atomic_write(self.path, "wb") as f:
+                f.write((exec_path + "\n").encode())
+                if self._expected == 0:
+                    f.write(b"[]")
+                else:
+                    f.write(b"[\n")
+                    self._splice_rows(f)
+                    f.write(b"\n]")
 
 
 def write_columnar(res: CampaignResult, mmap: MemoryMap, path: str) -> None:

@@ -109,6 +109,21 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "10^6-run campaigns, reference = the reference "
                         "tool's own container (exec-path line + bare "
                         "array; readable by its jsonParser.py unmodified)")
+    parser.add_argument("--stream-logs", action="store_true",
+                        help="serialize the campaign log incrementally in "
+                        "a background thread as each batch is collected "
+                        "(byte-identical file to the one-shot writer), so "
+                        "host serialization overlaps device dispatch "
+                        "instead of following it; supports ndjson/"
+                        "columnar/reference formats on the seeded -t, "
+                        "--stratified, and cache-section paths")
+    parser.add_argument("--mesh", type=int, default=None, metavar="N",
+                        help="shard the campaign batch over the first N "
+                        "devices (jax mesh + shard_map): the multi-chip "
+                        "replacement for the reference's side-by-side "
+                        "supervisors on disjoint port ranges; "
+                        "classification counts identical to single-"
+                        "device at the same seed/schedule")
     parser.add_argument("--journal", type=str, default=None,
                         help="append-only campaign journal: every "
                         "collected batch (or chunk, with -e) is fsync'd "
@@ -183,6 +198,17 @@ def parse_command_line(argv: Optional[List[str]] = None):
     if args.resume and not args.journal:
         print("Error, --resume requires --journal (there is nothing to "
               "resume from)", file=sys.stderr)
+        sys.exit(-1)
+    if args.stream_logs and (args.no_logging or args.errorCount
+                             or args.forceBreak
+                             or args.log_format == "json"):
+        # -e's sizing loop runs per-chunk campaigns whose row numbering
+        # restarts at 0 (the merged log is written once at the end);
+        # write_json's summary-wrapped container has no streaming form.
+        print("Error, --stream-logs needs a single-schedule campaign "
+              "with --log-format ndjson/columnar/reference (not -e/"
+              "--errorCount, --forceBreak, -q/--no-logging, or the "
+              "default json format)", file=sys.stderr)
         sys.exit(-1)
     if args.journal and (args.forceBreak or args.stratified
                          or args.section in ("cache", "icache", "dcache",
@@ -279,12 +305,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         from coast_tpu.inject.resilience import RetryPolicy
         retry = RetryPolicy(max_attempts=max(1, args.max_retries) + 1,
                             collect_timeout=args.collect_timeout)
+    mesh = None
+    if args.mesh:
+        import jax
+        from coast_tpu.parallel.mesh import make_mesh
+        if args.mesh > len(jax.devices()):
+            print(f"Error, --mesh {args.mesh} wants more devices than the "
+                  f"backend exposes ({len(jax.devices())})", file=sys.stderr)
+            return 1
+        mesh = make_mesh(args.mesh)
     try:
         runner = CampaignRunner(prog,
                                 sections=section_filter(prog, args.section),
                                 strategy_name=strategy,
                                 unroll=args.unroll,
-                                retry=retry)
+                                retry=retry,
+                                mesh=mesh)
     except ValueError:
         print(f"Error, {prog.region.name} has no injectable leaves in "
               f"section '{args.section}'!", file=sys.stderr)
@@ -321,45 +357,67 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"dwc={bool(rec['dwc_fault'])} cfc={bool(rec['cfc_fault'])}")
         return 0
 
-    if args.section in ("cache", "icache", "dcache", "l2cache"):
-        hierarchy = MemHierarchy("tpu")
-        cache_name = None if args.section == "cache" else args.section
-        sched = generate_cache_schedule(
-            mmap, hierarchy, args.t, args.seed,
-            prog.region.nominal_steps, cache_name)
-        res = runner.run_schedule(
-            sched, batch_size=min(args.batch_size, len(sched)))
-    elif args.errorCount:
-        res = runner.run_until_errors(args.errorCount, seed=args.seed,
-                                      batch_size=args.batch_size,
-                                      journal=args.journal)
-    elif args.stratified:
-        from coast_tpu.inject.schedule import generate_stratified_total
-        sched = generate_stratified_total(mmap, args.t, args.seed,
-                                          prog.region.nominal_steps)
-        res = runner.run_schedule(
-            sched, batch_size=min(args.batch_size, len(sched)))
-    else:
-        res = runner.run(args.t, seed=args.seed, batch_size=args.batch_size,
-                         start_num=args.start_num, journal=args.journal)
+    log_dir = args.log_dir or "."
+    log_path = os.path.join(
+        log_dir, f"{prog.region.name}_{strategy}_{args.section}.json")
+    src_paths = prog.region.meta.get("source_paths")
+    stream = None
+    if args.stream_logs:
+        # Overlapped serialization: the writer thread encodes each batch
+        # as it is collected, so the log is (nearly) on disk when the
+        # last batch lands -- byte-identical to the one-shot writer.
+        stream = logs.StreamLogWriter(
+            log_path, mmap, fmt=args.log_format,
+            exec_path=(src_paths[0] if args.log_format == "reference"
+                       and src_paths else None))
+
+    try:
+        if args.section in ("cache", "icache", "dcache", "l2cache"):
+            hierarchy = MemHierarchy("tpu")
+            cache_name = None if args.section == "cache" else args.section
+            sched = generate_cache_schedule(
+                mmap, hierarchy, args.t, args.seed,
+                prog.region.nominal_steps, cache_name)
+            res = runner.run_schedule(
+                sched, batch_size=min(args.batch_size, len(sched)),
+                stream=stream)
+        elif args.errorCount:
+            res = runner.run_until_errors(args.errorCount, seed=args.seed,
+                                          batch_size=args.batch_size,
+                                          journal=args.journal)
+        elif args.stratified:
+            from coast_tpu.inject.schedule import generate_stratified_total
+            sched = generate_stratified_total(mmap, args.t, args.seed,
+                                              prog.region.nominal_steps)
+            res = runner.run_schedule(
+                sched, batch_size=min(args.batch_size, len(sched)),
+                stream=stream)
+        else:
+            res = runner.run(args.t, seed=args.seed,
+                             batch_size=args.batch_size,
+                             start_num=args.start_num, journal=args.journal,
+                             stream=stream)
+    except BaseException:
+        if stream is not None:
+            stream.abort()       # never leave a half-written final log
+        raise
 
     print(res.summary())
     if not args.no_logging:
-        log_dir = args.log_dir or "."
-        path = os.path.join(
-            log_dir,
-            f"{prog.region.name}_{strategy}_{args.section}.json")
-        writer = {"json": logs.write_json, "ndjson": logs.write_ndjson,
-                  "columnar": logs.write_columnar,
-                  "reference": logs.write_reference_json}[args.log_format]
-        src_paths = prog.region.meta.get("source_paths")
-        if args.log_format == "reference" and src_paths:
-            # A lifted program's guest-executable line is its SOURCE file
-            # (the registry fallback would name the package).
-            writer(res, mmap, path, exec_path=src_paths[0])
+        if stream is not None:
+            stream.finish(res)
         else:
-            writer(res, mmap, path)
-        print(f"wrote {path}")
+            writer = {"json": logs.write_json, "ndjson": logs.write_ndjson,
+                      "columnar": logs.write_columnar,
+                      "reference": logs.write_reference_json
+                      }[args.log_format]
+            if args.log_format == "reference" and src_paths:
+                # A lifted program's guest-executable line is its SOURCE
+                # file (the registry fallback would name the package).
+                writer(res, mmap, log_path, exec_path=src_paths[0])
+            else:
+                writer(res, mmap, log_path)
+        print(f"wrote {log_path}")
     return 0
 
 

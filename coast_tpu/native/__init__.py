@@ -98,6 +98,26 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 lib.coast_ndjson_encode.restype = ctypes.c_int64
             except AttributeError:
                 pass
+            try:
+                # Per-batch streaming entry (coast_ndjson_encode_rows):
+                # formats one collected batch's columns with an explicit
+                # "number" base, so the streaming log writer serialises
+                # batches as they land instead of after the campaign.
+                # Own guard: an older .so degrades only the streaming
+                # fast path (Python formatter takes over), nothing else.
+                i32arr = np.ctypeslib.ndpointer(np.int32,
+                                                flags="C_CONTIGUOUS")
+                lib.coast_ndjson_encode_rows.argtypes = [
+                    ctypes.c_int64, ctypes.c_int64,
+                    i32arr, i32arr, i32arr, i32arr, i32arr,
+                    i32arr, i32arr, i32arr, i32arr,
+                    ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+                lib.coast_ndjson_encode_rows.restype = ctypes.c_int64
+            except AttributeError:
+                pass
             _lib = lib
         except (OSError, AttributeError):
             # Unloadable or built from an older source missing a symbol:
@@ -190,6 +210,16 @@ def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
                   + [len(s) for s in name_arr])
     line_bound = 320 + 2 * len(ts_b) + 3 * max_str + 9 * 20
     rows_per_chunk = max(1, chunk_bytes // line_bound)
+    _drain_encoded(encode, lo, hi, rows_per_chunk, buf, write)
+    return True
+
+
+def _drain_encoded(encode, lo: int, hi: int, rows_per_chunk: int,
+                   buf, write) -> None:
+    """Shared chunking loop of the native ndjson encoders: encode rows
+    [lo, hi) in advisory-sized chunks, halving a chunk that overflowed
+    the buffer (the C writer bounds-checks and returns -1), and hand each
+    encoded chunk to ``write``."""
     i = lo
     while i < hi:
         j = min(hi, i + rows_per_chunk)
@@ -203,6 +233,56 @@ def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,
                 f"[{i}, {j})")
         write(ctypes.string_at(buf, wrote))
         i = j
+
+
+def ndjson_stream_batch(number_base: int, col, sec_kind_by_leaf,
+                        sec_name_by_leaf, ts: str, write,
+                        chunk_bytes: int = 32 << 20) -> bool:
+    """Native serialisation of ONE collected batch's rows to
+    InjectionLog-schema ndjson lines, with ``number`` fields
+    number_base..number_base+n-1 -- byte-identical to the same rows of a
+    one-shot ``ndjson_stream_rows`` over the full campaign columns.  The
+    per-batch entry point of the streaming log writer
+    (inject/logs.StreamLogWriter): each batch is encoded as it is
+    collected, overlapping the next dispatch.  Returns False (before
+    writing anything) when the native core or the
+    ``coast_ndjson_encode_rows`` symbol is unavailable (older .so), so
+    the caller falls back to the Python formatter."""
+    lib = _ndjson_lib()
+    if lib is None or not hasattr(lib, "coast_ndjson_encode_rows"):
+        return False
+    n_leaves = len(sec_kind_by_leaf)
+    kind_arr = (ctypes.c_char_p * n_leaves)(
+        *(s.encode() for s in sec_kind_by_leaf))
+    name_arr = (ctypes.c_char_p * n_leaves)(
+        *(s.encode() for s in sec_name_by_leaf))
+    cols = {k: np.ascontiguousarray(col[k], np.int32)
+            for k in ("leaf_id", "lane", "word", "bit", "t",
+                      "code", "errors", "corrected", "steps")}
+    n = len(cols["code"])
+    ts_b = ts.encode()
+    max_str = max([len(ts_b)] + [len(s) for s in kind_arr]
+                  + [len(s) for s in name_arr])
+    line_bound = 320 + 2 * len(ts_b) + 3 * max_str + 9 * 20
+    # This entry runs once PER BATCH, so the buffer is sized to the batch
+    # (bounded by chunk_bytes), not allocated at the full chunk budget:
+    # ctypes.create_string_buffer zero-fills, and zeroing 32 MB per
+    # 2048-row batch would cost more than the encode itself.
+    buf_bytes = int(min(chunk_bytes, line_bound * max(n, 1) + 4096))
+    buf = ctypes.create_string_buffer(buf_bytes)
+
+    def encode(i, j):
+        # Sub-range [i, j) of the batch: shift the column base and the
+        # number base together so chunking is invisible in the output.
+        sub = {k: cols[k][i:j] for k in cols}
+        return lib.coast_ndjson_encode_rows(
+            j - i, number_base + i, sub["leaf_id"], sub["lane"],
+            sub["word"], sub["bit"], sub["t"], sub["code"], sub["errors"],
+            sub["corrected"], sub["steps"], np.int32(n_leaves), kind_arr,
+            name_arr, ts_b, buf, buf_bytes)
+
+    rows_per_chunk = max(1, buf_bytes // line_bound)
+    _drain_encoded(encode, 0, n, rows_per_chunk, buf, write)
     return True
 
 

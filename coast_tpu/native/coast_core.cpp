@@ -399,13 +399,20 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
 // Returns bytes written into out, or -1 when the rows do not fit out_cap
 // (every write is bounds-checked; the caller retries a smaller row range),
 // -2 on malformed input.
-int64_t coast_ndjson_encode(
-    int64_t lo, int64_t hi, const int32_t* leaf_id, const int32_t* lane,
-    const int32_t* word, const int32_t* bit, const int32_t* t,
-    const int32_t* code, const int32_t* errors, const int32_t* corrected,
-    const int32_t* steps, int32_t n_leaves, const char* const* sec_kind,
-    const char* const* sec_name, const char* ts, char* out,
-    int64_t out_cap) {
+//
+// Two entry points share the body below: coast_ndjson_encode formats rows
+// [lo, hi) of full-campaign columns (the one-shot writers), and
+// coast_ndjson_encode_rows formats rows [0, n) of a BATCH's columns with
+// an explicit "number" base -- the per-batch entry the streaming writer
+// feeds as each dispatch batch is collected, so serialization overlaps
+// the device work instead of following it.
+static int64_t ndjson_encode_body(
+    int64_t lo, int64_t hi, int64_t number_base, const int32_t* leaf_id,
+    const int32_t* lane, const int32_t* word, const int32_t* bit,
+    const int32_t* t, const int32_t* code, const int32_t* errors,
+    const int32_t* corrected, const int32_t* steps, int32_t n_leaves,
+    const char* const* sec_kind, const char* const* sec_name, const char* ts,
+    char* out, int64_t out_cap) {
   if (lo < 0 || hi < lo || n_leaves < 0) return -2;
   const size_t ts_len = std::strlen(ts);
   std::vector<size_t> kind_len(n_leaves), name_len(n_leaves);
@@ -418,7 +425,7 @@ int64_t coast_ndjson_encode(
     put_lit(w, "{\"timestamp\": \"");
     put_str(w, ts, ts_len);
     put_lit(w, "\", \"number\": ");
-    put_i64(w, i);
+    put_i64(w, number_base + i);
     put_lit(w, ", \"section\": \"");
     const int32_t lid = leaf_id[i];
     const bool invalid_line = t[i] < 0;
@@ -508,6 +515,37 @@ int64_t coast_ndjson_encode(
     if (w.overflow) return -1;
   }
   return w.p - out;
+}
+
+int64_t coast_ndjson_encode(
+    int64_t lo, int64_t hi, const int32_t* leaf_id, const int32_t* lane,
+    const int32_t* word, const int32_t* bit, const int32_t* t,
+    const int32_t* code, const int32_t* errors, const int32_t* corrected,
+    const int32_t* steps, int32_t n_leaves, const char* const* sec_kind,
+    const char* const* sec_name, const char* ts, char* out,
+    int64_t out_cap) {
+  // Full-campaign columns: row i carries number i.
+  return ndjson_encode_body(lo, hi, 0, leaf_id, lane, word, bit, t, code,
+                            errors, corrected, steps, n_leaves, sec_kind,
+                            sec_name, ts, out, out_cap);
+}
+
+// Per-batch entry point: columns hold ONE collected batch (rows [0, n)),
+// "number" fields run number_base..number_base+n-1 -- the global row
+// indices of the batch within its campaign stream.  Output is
+// byte-identical to coast_ndjson_encode over the same rows of the full
+// columns (tests/test_stream_logs.py pins it).
+int64_t coast_ndjson_encode_rows(
+    int64_t n, int64_t number_base, const int32_t* leaf_id,
+    const int32_t* lane, const int32_t* word, const int32_t* bit,
+    const int32_t* t, const int32_t* code, const int32_t* errors,
+    const int32_t* corrected, const int32_t* steps, int32_t n_leaves,
+    const char* const* sec_kind, const char* const* sec_name, const char* ts,
+    char* out, int64_t out_cap) {
+  if (n < 0 || number_base < 0) return -2;
+  return ndjson_encode_body(0, n, number_base, leaf_id, lane, word, bit, t,
+                            code, errors, corrected, steps, n_leaves,
+                            sec_kind, sec_name, ts, out, out_cap);
 }
 
 }  // extern "C"

@@ -7,6 +7,13 @@ side-by-side on disjoint localhost port ranges (supervisor.py:335, 386-391)
 program, so the *campaign batch* is the distributed axis.  We shard it over
 a ``jax.sharding.Mesh`` with ``shard_map``.
 
+The sharded runner is a first-class campaign backend: spell it
+``CampaignRunner(prog, mesh=make_mesh(8))`` and the whole campaign
+surface -- seeded runs, journals, retry policies, streaming log writers,
+the supervisor CLI's ``--mesh`` -- rides the sharded dispatch unchanged,
+with classification counts identical to single-device at the same
+seed/schedule.
+
 Two result paths:
   * ``run`` / ``run_schedule``: per-run records come back (codes, E, F, T)
     -- one device_get of 4xB int32 per batch.
@@ -71,11 +78,32 @@ _FAULT_KEYS = ("leaf_id", "lane", "word", "bit", "t")
 
 
 class ShardedCampaignRunner(CampaignRunner):
-    """CampaignRunner whose batch axis is sharded over a mesh."""
+    """CampaignRunner whose batch axis is sharded over a mesh.
 
-    def __init__(self, prog: ProtectedProgram, mesh: Mesh, **kw):
+    First-class campaign backend, reachable as ``CampaignRunner(prog,
+    mesh=...)``: every CampaignRunner surface -- ``run`` /
+    ``run_schedule`` / ``run_until_errors`` / journals / retry policies /
+    streaming log writers -- works unchanged on top of the sharded
+    dispatch, and classification is seed-stable: identical counts (and
+    codes) to the single-device runner at the same schedule
+    (tests/test_parallel.py, the multichip harness parity assert).
+    """
+
+    def __init__(self, prog: ProtectedProgram, mesh: Optional[Mesh] = None,
+                 **kw):
+        if not isinstance(mesh, Mesh):
+            raise TypeError(
+                f"ShardedCampaignRunner needs a jax.sharding.Mesh, got "
+                f"{type(mesh).__name__}; build one with make_mesh(n)")
         super().__init__(prog, **kw)
         self.mesh = mesh
+        # Geometry on the record: every campaign artifact's trace names
+        # the mesh it ran on and the per-device batch rounding in force.
+        self.telemetry.instant(
+            "mesh_geometry",
+            devices=int(np.prod(mesh.devices.shape)),
+            axes={name: int(n) for name, n
+                  in zip(mesh.axis_names, mesh.devices.shape)})
         axes = tuple(mesh.axis_names)
         batch_spec = P(axes)   # batch sharded over the product of all axes
         fault_specs = {k: batch_spec for k in _FAULT_KEYS}
@@ -110,7 +138,14 @@ class ShardedCampaignRunner(CampaignRunner):
     # -- hooks into the base batching loop ---------------------------------
     def _round_batch(self, batch_size: int) -> int:
         nd = self.n_devices
-        return max(nd, (batch_size // nd) * nd)
+        rounded = max(nd, (batch_size // nd) * nd)
+        if rounded != batch_size:
+            # Device-count rounding is a geometry decision worth a mark:
+            # the edge-padding it forces shows up in pad_waste_rows, and
+            # this instant explains where the shape came from.
+            self.telemetry.instant("batch_rounded", requested=batch_size,
+                                   rounded=rounded, devices=nd)
+        return rounded
 
     def _dispatch(self, fault: Dict[str, jax.Array]):
         return self._records_sharded(fault)

@@ -68,6 +68,17 @@ def main(argv=None) -> int:
                     help="resume an interrupted campaign from the "
                     "journal (validated against this invocation's "
                     "seed/n/schedule; mismatches refused loudly)")
+    ap.add_argument("--stream-logs", action="store_true",
+                    help="serialize the ndjson log incrementally in a "
+                    "background thread while batches are still "
+                    "dispatching (byte-identical file to the one-shot "
+                    "writer); the artifact records the overlapped vs "
+                    "blocking serialize split and the overlap fraction")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="shard the campaign batch over the first N "
+                    "devices (CampaignRunner(mesh=make_mesh(N))); "
+                    "classification counts are identical to single-"
+                    "device at the same seed/schedule")
     args = ap.parse_args(argv)
 
     import jax
@@ -107,7 +118,13 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     note("building protected program")
     prog = TMR(REGISTRY["matrixMultiply"]())
-    runner = CampaignRunner(prog, strategy_name="TMR")
+    mesh = None
+    if args.mesh:
+        from coast_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(min(args.mesh, len(jax.devices())))
+        note(f"mesh: {args.mesh} requested, "
+             f"{dict(zip(mesh.axis_names, mesh.devices.shape))} built")
+    runner = CampaignRunner(prog, strategy_name="TMR", mesh=mesh)
     telemetry = runner.telemetry
     stages["build_s"] = round(time.perf_counter() - t0, 3)
 
@@ -154,54 +171,76 @@ def main(argv=None) -> int:
                  if args.heartbeat > 0 else None)
     agg_counts = {}
 
+    log_path = os.path.join(args.logdir, f"mm_tmr_{args.n}.ndjson")
+    stream = None
+    if args.stream_logs:
+        # The writer thread serializes every collected batch while the
+        # next ones are still dispatching; rows are numbered
+        # journal_base + lo, so the chunked loop streams ONE file for
+        # the whole seed stream -- byte-identical to write_ndjson on
+        # the merged result.
+        stream = logs.StreamLogWriter(log_path, runner.mmap, fmt="ndjson")
+
     t0 = time.perf_counter()
     parts = []
     chunk = max(args.batch, 100_000 // args.batch * args.batch)
-    for lo in range(0, len(sched), chunk):
-        def _progress(done, counts, _lo=lo):
-            merged = dict(agg_counts)
-            for k, v in counts.items():
-                merged[k] = merged.get(k, 0) + v
+    try:
+        for lo in range(0, len(sched), chunk):
+            def _progress(done, counts, _lo=lo):
+                merged = dict(agg_counts)
+                for k, v in counts.items():
+                    merged[k] = merged.get(k, 0) + v
+                with telemetry.activate():
+                    heartbeat.update(_lo + done, merged)
+            part = runner.run_schedule(sched.slice(lo, min(lo + chunk,
+                                                           len(sched))),
+                                       batch_size=args.batch,
+                                       # None keeps the per-batch progress
+                                       # accounting entirely off when the
+                                       # heartbeat is disabled
+                                       progress=(_progress if heartbeat
+                                                 is not None else None),
+                                       journal=journal, journal_base=lo,
+                                       stream=stream)
+            parts.append(part)
+            for k, v in part.counts.items():
+                agg_counts[k] = agg_counts.get(k, 0) + v
+            done_n = min(lo + chunk, len(sched))
+            note(f"{done_n}/{len(sched)} at "
+                 f"{part.injections_per_sec:.0f} inj/s")
+        from coast_tpu.inject.campaign import _merge_results
+        res = _merge_results(parts, args.seed)
+        res.schedule = sched
+        # One seed stream sliced into chunks: (seed, n) regenerates it
+        # exactly, and per-chunk records would replay WRONG (each chunk
+        # record would regenerate the first `chunk` rows of the stream, not
+        # its slice) -- the single-seed case of CampaignResult.chunks' doc.
+        res.chunks = None
+        # The schedule was generated once up front (outside the per-chunk
+        # stage windows _merge_results summed), so bill it onto the merged
+        # result explicitly -- every campaign artifact carries the full
+        # schedule/pad/dispatch/collect/classify/serialize breakdown.
+        res.record_stage("schedule", stages["schedule_s"])
+        stages["run_s"] = round(time.perf_counter() - t0, 3)
+        if heartbeat is not None:
             with telemetry.activate():
-                heartbeat.update(_lo + done, merged)
-        part = runner.run_schedule(sched.slice(lo, min(lo + chunk,
-                                                       len(sched))),
-                                   batch_size=args.batch,
-                                   # None keeps the per-batch progress
-                                   # accounting entirely off when the
-                                   # heartbeat is disabled
-                                   progress=(_progress if heartbeat
-                                             is not None else None),
-                                   journal=journal, journal_base=lo)
-        parts.append(part)
-        for k, v in part.counts.items():
-            agg_counts[k] = agg_counts.get(k, 0) + v
-        done_n = min(lo + chunk, len(sched))
-        note(f"{done_n}/{len(sched)} at "
-             f"{part.injections_per_sec:.0f} inj/s")
-    from coast_tpu.inject.campaign import _merge_results
-    res = _merge_results(parts, args.seed)
-    res.schedule = sched
-    # One seed stream sliced into chunks: (seed, n) regenerates it
-    # exactly, and per-chunk records would replay WRONG (each chunk
-    # record would regenerate the first `chunk` rows of the stream, not
-    # its slice) -- the single-seed case of CampaignResult.chunks' doc.
-    res.chunks = None
-    # The schedule was generated once up front (outside the per-chunk
-    # stage windows _merge_results summed), so bill it onto the merged
-    # result explicitly -- every campaign artifact carries the full
-    # schedule/pad/dispatch/collect/classify/serialize breakdown.
-    res.record_stage("schedule", stages["schedule_s"])
-    stages["run_s"] = round(time.perf_counter() - t0, 3)
-    if heartbeat is not None:
-        with telemetry.activate():
-            heartbeat.update(res.n, agg_counts, force=True)
+                heartbeat.update(res.n, agg_counts, force=True)
 
-    log_path = os.path.join(args.logdir, f"mm_tmr_{args.n}.ndjson")
-    t0 = time.perf_counter()
-    with telemetry.activate():
-        logs.write_ndjson(res, runner.mmap, log_path)
-    stages["log_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        with telemetry.activate():
+            if stream is not None:
+                # Only the drain + header + splice remains: the rows were
+                # serialized while the device was still dispatching.
+                stream.finish(res)
+            else:
+                logs.write_ndjson(res, runner.mmap, log_path)
+        stages["log_s"] = round(time.perf_counter() - t0, 3)
+    except BaseException:
+        # An interrupted streamed run must not leave rows temp files in
+        # --logdir (the journal, not the stream, is the resume state).
+        if stream is not None:
+            stream.abort()
+        raise
 
     t0 = time.perf_counter()
     with telemetry.span("analysis"):
@@ -216,6 +255,7 @@ def main(argv=None) -> int:
     artifact = {
         "campaign": res.summary(),
         "stage_seconds": stages,
+        "streamed_logs": bool(stream is not None),
         "host_log_fraction": round(
             stages["log_s"] / max(stages["run_s"], 1e-9), 4),
         "log_bytes": os.path.getsize(log_path),

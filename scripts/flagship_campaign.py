@@ -135,6 +135,11 @@ def main(argv=None):
     ap.add_argument("--collect-timeout", type=float, default=None,
                     help="watchdog seconds on the blocking batch fetch; "
                     "a wedged device_get is re-dispatched")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="shard every campaign batch over the first N "
+                    "devices (CampaignRunner(mesh=make_mesh(N))); the "
+                    "HBM batch arithmetic then sizes PER-DEVICE rows, "
+                    "so an N-chip slice runs ~N x the single-chip batch")
     args = ap.parse_args(argv)
 
     # One shared recorder across every runner of the session, so the
@@ -185,12 +190,34 @@ def main(argv=None):
     retry = (RetryPolicy(max_attempts=max(1, args.max_retries) + 1,
                          collect_timeout=args.collect_timeout)
              if (args.max_retries > 0 or args.collect_timeout) else None)
+    mesh = None
+    if args.mesh:
+        from coast_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(min(args.mesh, len(jax.devices())))
+    # The ACTUAL mesh size, not the requested --mesh count: the min()
+    # above clamps to the devices the backend exposes (make_mesh itself
+    # would raise on a short device list), and the per-device batch
+    # scaling below must match the mesh the campaign really runs on.
+    n_dev = int(mesh.size) if mesh is not None else 1
+    if mesh is not None:
+        out["mesh"] = {"devices": n_dev,
+                       "axes": dict(zip(mesh.axis_names,
+                                        (int(s) for s in
+                                         mesh.devices.shape)))}
     tmr_runner = CampaignRunner(TMR(region, pallas_voters=True),
                                 strategy_name="TMR", telemetry=telemetry,
-                                retry=retry)
+                                retry=retry, mesh=mesh)
     out["batch_probe"] = []
     best_batch, best_rate = None, -1.0
     analytic, hbm_info = analytic_batch(region, lanes=3)
+    if analytic is not None and n_dev > 1:
+        # The HBM arithmetic bounds rows PER DEVICE; the sharded batch
+        # axis spreads rows 1/N per chip, so the dispatch batch scales
+        # with the mesh (rounding to the device count happens in the
+        # runner).
+        analytic *= n_dev
+        hbm_info["devices"] = n_dev
+        hbm_info["batch"] = analytic
     out["batch_analytic"] = hbm_info
     if analytic is not None:
         try:
@@ -244,7 +271,8 @@ def main(argv=None):
             ("TMR", tmr_runner, n_tmr),
             ("DWC", CampaignRunner(DWC(region, pallas_voters=True),
                                    strategy_name="DWC",
-                                   telemetry=telemetry, retry=retry),
+                                   telemetry=telemetry, retry=retry,
+                                   mesh=mesh),
              n_dwc)):
         counts, done, secs = {}, 0, 0.0
         stages = {}
@@ -340,7 +368,7 @@ def main(argv=None):
     ab = {}
     for name, reg in (("slice_vote", region), ("wholeleaf_vote", region_wl)):
         r = CampaignRunner(TMR(reg, pallas_voters=True), strategy_name="TMR",
-                           telemetry=telemetry)
+                           telemetry=telemetry, mesh=mesh)
         with telemetry.span("slice_vote_ab", cell=name):
             r.run(best_batch, seed=1, batch_size=best_batch)      # warm
             res = r.run(n_ab, seed=7, batch_size=best_batch)

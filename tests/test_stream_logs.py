@@ -1,0 +1,295 @@
+"""Streaming campaign-log serialization + sharded-backend promotion tests.
+
+The streaming pipeline's contract (coast_tpu/inject/logs.StreamLogWriter):
+byte-identical output to the one-shot writers for all three bulk formats,
+on both the native and Python formatter paths; journal-resume produces
+the same file as an uninterrupted run; the campaign's stage block gains
+the non-overlapped ``serialize`` seconds and the ``overlap`` fraction.
+The mesh promotion's contract (``CampaignRunner(mesh=...)``): identical
+classification to single-device at the same seed/schedule.
+"""
+
+import dataclasses
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.inject import logs
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.models import mm
+
+FIXED_TS = "2026-01-01 00:00:00.000000"
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return CampaignRunner(TMR(mm.make_region()), strategy_name="TMR")
+
+
+@pytest.fixture(scope="module")
+def res(runner):
+    return runner.run(120, seed=17, batch_size=40)
+
+
+def _copy(res, **over):
+    """Fresh stages dict per writer: writers bill res.stages in place, so
+    sharing one result object between two writers skews the second
+    file's summary line."""
+    return dataclasses.replace(res, stages=dict(res.stages), **over)
+
+
+def _feed_all(w, res, bs=40):
+    for lo in range(0, res.n, bs):
+        hi = min(lo + bs, res.n)
+        w.feed(lo, res.schedule.slice(lo, hi),
+               {"code": res.codes[lo:hi], "errors": res.errors[lo:hi],
+                "corrected": res.corrected[lo:hi],
+                "steps": res.steps[lo:hi]})
+
+
+ONESHOT = {"ndjson": logs.write_ndjson,
+           "columnar": logs.write_columnar,
+           "reference": logs.write_reference_json}
+
+
+@pytest.mark.parametrize("fmt", ["ndjson", "columnar", "reference"])
+def test_stream_byte_identical_to_oneshot(fmt, runner, res, tmp_path,
+                                          monkeypatch):
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    ONESHOT[fmt](_copy(res), runner.mmap, a)
+    w = logs.StreamLogWriter(b, runner.mmap, fmt=fmt)
+    _feed_all(w, res)
+    w.finish(_copy(res))
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+@pytest.mark.parametrize("fmt", ["ndjson", "columnar", "reference"])
+def test_stream_byte_identical_python_path(fmt, runner, res, tmp_path,
+                                           monkeypatch):
+    """Same parity with the native core forced off: the Python batch
+    formatter must match the Python one-shot formatter byte for byte."""
+    from coast_tpu import native
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    monkeypatch.setattr(native, "ndjson_stream_batch",
+                        lambda *a, **k: False)
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    ONESHOT[fmt](_copy(res), runner.mmap, a)
+    w = logs.StreamLogWriter(b, runner.mmap, fmt=fmt)
+    _feed_all(w, res)
+    w.finish(_copy(res))
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+def test_stream_uneven_batches_byte_identical(runner, res, tmp_path,
+                                              monkeypatch):
+    """Batch geometry must be invisible in the file: feeding ragged batch
+    sizes produces the same bytes as one batch of everything."""
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    w = logs.StreamLogWriter(a, runner.mmap, fmt="ndjson")
+    _feed_all(w, res, bs=7)
+    w.finish(_copy(res))
+    w2 = logs.StreamLogWriter(b, runner.mmap, fmt="ndjson")
+    _feed_all(w2, res, bs=res.n)
+    w2.finish(_copy(res))
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+@pytest.mark.parametrize("fmt", ["ndjson", "columnar", "reference"])
+def test_stream_empty_campaign(fmt, runner, tmp_path, monkeypatch):
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    empty = runner.run(0, seed=3)
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    ONESHOT[fmt](_copy(empty), runner.mmap, a)
+    w = logs.StreamLogWriter(b, runner.mmap, fmt=fmt)
+    w.finish(_copy(empty))
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+def test_stream_via_run_schedule(runner, res, tmp_path, monkeypatch):
+    """The wired path: run_schedule(stream=...) feeds every collected
+    batch; rows equal the one-shot writer's for the same campaign."""
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    logs.write_ndjson(_copy(res), runner.mmap, a)
+    w = logs.StreamLogWriter(b, runner.mmap, fmt="ndjson")
+    res2 = runner.run_schedule(res.schedule, batch_size=40, stream=w)
+    w.finish(res2)
+    rows_a = open(a, "rb").read().splitlines()[1:]
+    rows_b = open(b, "rb").read().splitlines()[1:]
+    assert rows_a == rows_b
+    # The stream's accounting landed on the campaign result.
+    assert "serialize" in res2.stages
+    assert 0.0 <= res2.stages["overlap"] <= 1.0
+
+
+def test_stream_resume_mid_campaign_same_file(runner, tmp_path, monkeypatch):
+    """A streaming campaign killed after k batches and resumed from its
+    journal produces the SAME file as an uninterrupted streaming run:
+    the journal-replayed prefix flows through the writer from disk."""
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+
+    def norm(r):
+        # seconds is wall clock (differs per run) and lands in the
+        # summary header: normalise it so file equality tests the rows
+        # and the deterministic summary fields.
+        return dataclasses.replace(r, seconds=1.0, stages={})
+
+    a, b = str(tmp_path / "full.json"), str(tmp_path / "resumed.json")
+    w = logs.StreamLogWriter(a, runner.mmap, fmt="ndjson")
+    full = runner.run(120, seed=17, batch_size=40, stream=w)
+    w.finish(norm(full))
+
+    class _Kill(Exception):
+        pass
+
+    beats = {"n": 0}
+
+    def kill_on_second(done, counts):
+        beats["n"] += 1
+        if beats["n"] >= 2:
+            raise _Kill
+
+    jpath = str(tmp_path / "j.journal")
+    w2 = logs.StreamLogWriter(b, runner.mmap, fmt="ndjson")
+    with pytest.raises(_Kill):
+        runner.run(120, seed=17, batch_size=40, journal=jpath,
+                   progress=kill_on_second, stream=w2)
+    w2.abort()
+    assert not os.path.exists(b)          # aborted stream left no file
+    w3 = logs.StreamLogWriter(b, runner.mmap, fmt="ndjson")
+    resumed = runner.run(120, seed=17, batch_size=40, journal=jpath,
+                         stream=w3)
+    w3.finish(norm(resumed))
+    assert open(a, "rb").read() == open(b, "rb").read()
+    assert np.array_equal(full.codes, resumed.codes)
+
+
+def test_stream_feed_misuse_refused(runner, res, tmp_path):
+    w = logs.StreamLogWriter(str(tmp_path / "x.json"), runner.mmap)
+    part = res.schedule.slice(0, 40)
+    out = {"code": res.codes[:40], "errors": res.errors[:40],
+           "corrected": res.corrected[:40], "steps": res.steps[:40]}
+    with pytest.raises(ValueError, match="out of order"):
+        w.feed(40, part, out)             # stream must start at row 0
+    w.feed(0, part, out)
+    with pytest.raises(ValueError, match="out of order"):
+        w.feed(80, part, out)             # gap
+    with pytest.raises(ValueError, match="does not match"):
+        w.finish(_copy(res))              # 40 rows fed, result says 120
+    w.abort()
+
+
+def test_stream_unknown_format_refused(runner):
+    with pytest.raises(ValueError, match="unknown stream log format"):
+        logs.StreamLogWriter("/tmp/x.json", runner.mmap, fmt="json")
+
+
+@pytest.mark.parametrize("fmt", ["ndjson", "columnar"])
+def test_gzip_writers_roundtrip(fmt, runner, res, tmp_path, monkeypatch):
+    """.gz by extension: one-shot and streamed writers compress
+    byte-identically (deterministic gzip header), and the analysis layer
+    decompresses transparently."""
+    from coast_tpu.analysis import json_parser as jp
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    plain = str(tmp_path / f"x.{fmt}.json")
+    gz = str(tmp_path / f"x.{fmt}.json.gz")
+    ONESHOT[fmt](_copy(res), runner.mmap, plain)
+    ONESHOT[fmt](_copy(res), runner.mmap, gz)
+    assert gzip.decompress(open(gz, "rb").read()) == open(plain, "rb").read()
+    w = logs.StreamLogWriter(str(tmp_path / f"y.{fmt}.json.gz"),
+                             runner.mmap, fmt=fmt)
+    _feed_all(w, res)
+    w.finish(_copy(res))
+    assert (open(gz, "rb").read()
+            == open(str(tmp_path / f"y.{fmt}.json.gz"), "rb").read())
+    # Transparent analysis: same summary from compressed and plain.
+    sp = jp.summarize_path(plain)
+    sg = jp.summarize_path(gz)
+    assert sg.n == sp.n == res.n
+    assert sg.counts == sp.counts
+    # Directory scans pick up .json.gz files too.
+    dir_sum = jp.summarize_runs(
+        "dir", (doc for _, doc in jp._iter_docs(str(tmp_path))))
+    assert dir_sum.n >= 2 * res.n
+
+
+def test_overlap_summary_rendering():
+    from coast_tpu.analysis import json_parser as jp
+    s = jp.Summary(name="x", n=10,
+                   counts={c: 0 for c in jp._CLASSES} | {"success": 10},
+                   seconds=1.0, mean_steps=5.0,
+                   stages={"serialize": 0.25, "dispatch": 1.0,
+                           "overlap": 0.9321})
+    text = s.format()
+    assert "serialize overlap: 93.2%" in text
+    # the fraction must not be billed into the seconds table
+    assert "overlap       " not in text
+
+
+def test_overlap_meaned_over_directory(tmp_path):
+    from coast_tpu.analysis import json_parser as jp
+    docs = [{"summary": {"seconds": 1.0,
+                         "stages": {"serialize": 0.1, "overlap": ov}},
+             "columns": {"code": [0], "steps": [3]}}
+            for ov in (0.5, 1.0)]
+    s = jp.summarize_runs("d", iter(docs))
+    assert s.stages["overlap"] == pytest.approx(0.75)
+    assert s.stages["serialize"] == pytest.approx(0.2)
+
+
+def test_campaign_runner_mesh_kwarg_promotes_to_sharded(res):
+    import jax
+    from coast_tpu.parallel.mesh import ShardedCampaignRunner, make_mesh
+    assert len(jax.devices()) == 8
+    prog = TMR(mm.make_region())
+    sharded = CampaignRunner(prog, strategy_name="TMR", mesh=make_mesh(8))
+    assert isinstance(sharded, ShardedCampaignRunner)
+    assert sharded.strategy_name == "TMR"
+    # Acceptance: identical classification to single-device at the same
+    # seed/schedule -- counts AND per-run codes.
+    got = sharded.run(120, seed=17, batch_size=40)
+    assert got.counts == res.counts
+    assert np.array_equal(got.codes, res.codes)
+    # No mesh keeps the plain runner; a positional mesh is refused.
+    assert not isinstance(CampaignRunner(prog), ShardedCampaignRunner)
+    with pytest.raises(TypeError):
+        ShardedCampaignRunner(prog, "not-a-mesh")
+
+
+def test_mesh_streamed_file_matches_single_device(runner, res, tmp_path,
+                                                  monkeypatch):
+    """Streaming composes with the sharded backend: the streamed log of a
+    mesh campaign is row-for-row the single-device streamed log."""
+    from coast_tpu.parallel.mesh import make_mesh
+    monkeypatch.setattr(logs, "_timestamp", lambda: FIXED_TS)
+    a, b = str(tmp_path / "single.json"), str(tmp_path / "mesh.json")
+    w = logs.StreamLogWriter(a, runner.mmap, fmt="ndjson")
+    single = runner.run(120, seed=17, batch_size=40, stream=w)
+    w.finish(single)
+    sharded = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR",
+                             mesh=make_mesh(8))
+    w2 = logs.StreamLogWriter(b, sharded.mmap, fmt="ndjson")
+    got = sharded.run(120, seed=17, batch_size=40, stream=w2)
+    w2.finish(got)
+    assert (open(a, "rb").read().splitlines()[1:]
+            == open(b, "rb").read().splitlines()[1:])
+
+
+def test_bench_error_fields_bounded():
+    """bench.py metric note/error fields must stay a bounded one-line
+    tail, never an embedded multi-KB stderr blob (BENCH_r05 regression)."""
+    import bench
+    blob = "\n".join(f"line {i}: " + "x" * 500 for i in range(40))
+    one = bench._tail_line(blob)
+    assert "\n" not in one
+    assert len(one) <= 243                # limit + ellipsis
+    assert one.endswith("x" * 100)        # the TAIL survives
+    short = bench._tail_line("a\nb\nc\nlast line")
+    assert short == "b / c / last line"
