@@ -135,6 +135,12 @@ class Summary:
     # oom_degrade, coast_tpu.inject.resilience) from each log's summary
     # block; None for campaigns run without a RetryPolicy.
     resilience: Optional[Dict[str, int]] = None
+    # Fault-model axis (inject/schedule.FaultModel.spec()) from the log
+    # summary: None for single-bit campaigns (whose logs deliberately
+    # omit the key, keeping pre-model byte parity), the spec string for
+    # multi-site campaigns, "mixed" when a directory aggregates several
+    # models -- rates aggregated across models are rarely meaningful.
+    fault_model: Optional[str] = None
 
     @property
     def due(self) -> int:
@@ -156,6 +162,8 @@ class Summary:
 
     def format(self) -> str:
         lines = [f"=== {self.name}: {self.n} injections ==="]
+        if self.fault_model:
+            lines.append(f"  fault model  {self.fault_model}")
         for cls in _CLASSES:
             if cls in ("due_stack_overflow", "due_assert"):
                 continue          # printed as DUE sub-counts below
@@ -286,6 +294,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     stages: Dict[str, float] = {}
     overlaps: List[float] = []
     resilience: Dict[str, int] = {}
+    models: set = set()
     for doc in docs:
         if "columns" in doc:                      # vectorised columnar path
             import numpy as np
@@ -320,12 +329,23 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             overlaps.append(float(ov))
         for key, cnt in (summary.get("resilience") or {}).items():
             resilience[key] = resilience.get(key, 0) + int(cnt)
+        models.add(summary.get("fault_model") or "single")
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
+    # The fault-model axis: absent key == the single-bit legacy model.
+    # A directory mixing models gets the explicit "mixed" marker rather
+    # than silently quoting one model's rates under another's name.
+    fault_model = None
+    if len(models) == 1:
+        only = models.pop()
+        fault_model = None if only == "single" else only
+    elif models:
+        fault_model = "mixed"
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
                    mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
                    stages=stages or None,
-                   resilience=resilience or None)
+                   resilience=resilience or None,
+                   fault_model=fault_model)
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -356,7 +376,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             seconds=float(head["summary"].get("seconds", 0.0)),
             mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
             stages=head["summary"].get("stages") or None,
-            resilience=head["summary"].get("resilience") or None)
+            resilience=head["summary"].get("resilience") or None,
+            fault_model=head["summary"].get("fault_model") or None)
     except OSError:
         return None
 

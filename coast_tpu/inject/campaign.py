@@ -33,7 +33,7 @@ from coast_tpu.inject.journal import (CampaignJournal, JournalMismatchError,
                                       config_fingerprint,
                                       schedule_fingerprint)
 from coast_tpu.inject.mem import MemoryMap
-from coast_tpu.inject.schedule import FaultSchedule, generate
+from coast_tpu.inject.schedule import FaultModel, FaultSchedule, generate
 from coast_tpu.passes.dataflow_protection import ProtectedProgram
 
 
@@ -92,6 +92,11 @@ class CampaignResult:
         (jsonParser.py:165-172)."""
         return sum(self.counts[k] for k in cls.DUE_CLASSES)
 
+    @property
+    def fault_model(self) -> FaultModel:
+        """The schedule's fault model (FaultModel.single legacy default)."""
+        return getattr(self.schedule, "model", None) or FaultModel()
+
     def summary(self) -> Dict[str, object]:
         out = {
             "benchmark": self.benchmark,
@@ -104,6 +109,12 @@ class CampaignResult:
             "seed": self.seed,
             "stages": {k: round(v, 6) for k, v in self.stages.items()},
         }
+        # The fault-model axis of the logs: only non-single models add the
+        # key, so single-bit campaign logs stay byte-identical to every
+        # log written before the model existed.
+        if self.fault_model.kind != "single":
+            out["fault_model"] = self.fault_model.spec()
+            out["fault_sites"] = self.fault_model.sites
         if self.chunks is not None:
             out["chunks"] = self.chunks
         if self.resilience:
@@ -134,7 +145,8 @@ class CampaignRunner:
                  telemetry: Optional[obs.Telemetry] = None,
                  preflight: "bool | str" = False,
                  retry: "Optional[object]" = None,
-                 mesh: "Optional[object]" = None):
+                 mesh: "Optional[object]" = None,
+                 fault_model: "Optional[FaultModel]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -172,7 +184,16 @@ class CampaignRunner:
         :class:`coast_tpu.parallel.mesh.ShardedCampaignRunner` whose
         batch axis is shard_map'd over every mesh axis -- pass keyword
         arguments alongside it (the subclass takes ``mesh`` as its
-        second parameter)."""
+        second parameter).
+
+        ``fault_model`` (:class:`coast_tpu.inject.schedule.FaultModel`)
+        selects what one injection IS for every seeded campaign this
+        runner draws: the default single-bit flip, or a multi-site model
+        (multibit / cluster / burst) whose schedules carry per-injection
+        flip groups.  It is part of the campaign's identity -- journaled
+        in the header (resume under a different model is refused with a
+        typed error) and recorded in the log summary's fault-model
+        axis."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -183,6 +204,8 @@ class CampaignRunner:
             lint_mod.check(prog, survival=(preflight != "static"))
         self.prog = prog
         self.retry = retry
+        self.fault_model = fault_model if fault_model is not None \
+            else FaultModel()
         self.telemetry = telemetry if telemetry is not None \
             else obs.Telemetry()
         with self.telemetry.activate():
@@ -215,10 +238,13 @@ class CampaignRunner:
     def _padded_fault(part: FaultSchedule, batch_size: int):
         """Device fault arrays for one batch, edge-padded to batch_size so
         every batch hits the same compiled program.  Returns (fault, n_valid);
-        callers drop or mask the padded tail."""
+        callers drop or mask the padded tail.  Multi-site schedules pad the
+        batch axis only -- the trailing sites axis is part of the compiled
+        shape, never padded."""
         n_part = len(part)
         pad = batch_size - n_part if n_part < batch_size else 0
-        fault = {k: jnp.asarray(np.pad(v, (0, pad), mode="edge"))
+        fault = {k: jnp.asarray(np.pad(
+                     v, [(0, pad)] + [(0, 0)] * (v.ndim - 1), mode="edge"))
                  for k, v in part.device_arrays().items()}
         return fault, n_part
 
@@ -283,6 +309,22 @@ class CampaignRunner:
         # site (advisor, supervisor) where a single smaller compile beats
         # padding waste.
         batch_size = self._round_batch(batch_size)
+        if journal is not None:
+            # Model = campaign identity, wherever the schedule came from:
+            # an externally-generated multi-site schedule journaled under
+            # a header that says "single" (or vice versa) would poison
+            # every later resume, so the open journal's header must name
+            # the schedule's own model.
+            from coast_tpu.inject.journal import FaultModelMismatchError
+            sched_model = getattr(sched, "model", None)
+            sched_spec = sched_model.spec() if sched_model else "single"
+            header_spec = journal.header.get("fault_model", "single")
+            if header_spec != sched_spec:
+                raise FaultModelMismatchError(
+                    f"journal {journal.path!r} header records fault model "
+                    f"{header_spec!r} but the schedule being run carries "
+                    f"{sched_spec!r}; open the journal with the "
+                    "schedule's model (CampaignRunner(fault_model=...))")
         retry = self.retry
         tel = self.telemetry
         mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
@@ -510,12 +552,17 @@ class CampaignRunner:
 
     def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
         """The identity block every journal header shares: resuming under
-        a different program, strategy, or protection config must refuse."""
-        return {"mode": mode,
-                "benchmark": self.prog.region.name,
-                "strategy": self.strategy_name,
-                "config_sha": config_fingerprint(self.prog.cfg),
-                **fields}
+        a different program, strategy, protection config, or fault model
+        must refuse.  Single-bit campaigns omit the fault-model key so
+        journals written before the model existed still resume."""
+        header = {"mode": mode,
+                  "benchmark": self.prog.region.name,
+                  "strategy": self.strategy_name,
+                  "config_sha": config_fingerprint(self.prog.cfg)}
+        if self.fault_model.kind != "single":
+            header["fault_model"] = self.fault_model.spec()
+        header.update(fields)
+        return header
 
     def _open_journal(self, journal, header: Dict[str, object]):
         """``journal`` as accepted by the run methods: None, a path (opened
@@ -558,7 +605,8 @@ class CampaignRunner:
         mark = tel.mark()
         with tel.activate():        # generate() records its schedule span
             sched = generate(self.mmap, start_num + n, seed,
-                             self.prog.region.nominal_steps)
+                             self.prog.region.nominal_steps,
+                             model=self.fault_model)
         part = sched.slice(start_num, start_num + n)
         j, owned = (None, False)
         if journal is not None:
@@ -585,7 +633,8 @@ class CampaignRunner:
         start_num = int(rec.get("start_num", 0))
         with self.telemetry.activate():
             sched = generate(self.mmap, start_num + n, seed,
-                             self.prog.region.nominal_steps
+                             self.prog.region.nominal_steps,
+                             model=self.fault_model
                              ).slice(start_num, start_num + n)
         return CampaignResult(
             benchmark=self.prog.region.name,
@@ -774,10 +823,22 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
             stages[k] = stages.get(k, 0.0) + v
         for k, v in p.resilience.items():
             resilience[k] = resilience.get(k, 0) + v
+    extra = None
+    first_sched = first.schedule
+    if first_sched.extra is not None:
+        # Flip-group rows concatenate like the base rows, but each part's
+        # group column indexes ITS OWN injections: rebase by the running
+        # injection offset so the merged group ids stay schedule-global.
+        offsets = np.cumsum([0] + [p.n for p in parts[:-1]])
+        extra = {k: np.concatenate([p.schedule.extra[k] for p in parts])
+                 for k in first_sched.extra if k != "group"}
+        extra["group"] = np.concatenate(
+            [p.schedule.extra["group"] + np.int32(off)
+             for p, off in zip(parts, offsets)]).astype(np.int32)
     sched = FaultSchedule(
         *(np.concatenate([getattr(p.schedule, f) for p in parts])
           for f in ("leaf_id", "lane", "word", "bit", "t", "section_idx")),
-        seed=seed)
+        seed=seed, extra=extra, model=first_sched.model)
     return CampaignResult(
         benchmark=first.benchmark,
         strategy=first.strategy,

@@ -55,7 +55,8 @@ import numpy as np
 
 __all__ = [
     "JournalError", "JournalExistsError", "JournalMismatchError",
-    "CampaignJournal", "schedule_fingerprint", "config_fingerprint",
+    "FaultModelMismatchError", "CampaignJournal", "schedule_fingerprint",
+    "config_fingerprint",
 ]
 
 
@@ -71,14 +72,31 @@ class JournalMismatchError(JournalError):
     """The journal's header does not describe the current campaign."""
 
 
+class FaultModelMismatchError(JournalMismatchError):
+    """The journal records a different FAULT MODEL than the resuming
+    campaign.  Raised before (and instead of) the generic header diff: a
+    model change also changes the schedule fingerprint, and "schedule-sha
+    mismatch" would bury the actual cause -- the operator changed what an
+    injection *is*, not the seed."""
+
+
 def schedule_fingerprint(sched) -> str:
     """sha256 over a FaultSchedule's columns + seed: the journal's proof
-    that a resumed campaign will inject exactly the recorded faults."""
+    that a resumed campaign will inject exactly the recorded faults.
+    Multi-site schedules also hash the fault model and every extra
+    flip-group row; single-site schedules hash exactly the historical
+    columns, so pre-model journals still validate."""
     h = hashlib.sha256()
     h.update(str(int(sched.seed)).encode())
     for field in ("leaf_id", "lane", "word", "bit", "t"):
         col = np.ascontiguousarray(getattr(sched, field), dtype=np.int32)
         h.update(col.tobytes())
+    extra = getattr(sched, "extra", None)
+    if extra is not None:
+        h.update(sched.model.spec().encode())
+        for key in sorted(extra):
+            h.update(np.ascontiguousarray(extra[key],
+                                          dtype=np.int32).tobytes())
     return h.hexdigest()
 
 
@@ -182,6 +200,18 @@ class CampaignJournal:
     @staticmethod
     def _validate(found: Dict[str, object], expect: Dict[str, object],
                   path: str) -> None:
+        # Fault-model mismatch first, as its own typed error: the model
+        # also perturbs the schedule fingerprint, and the generic diff
+        # below would report that derived symptom instead of the cause.
+        # Absent key == "single" (journals written before the model).
+        found_model = found.get("fault_model", "single")
+        expect_model = expect.get("fault_model", "single")
+        if found_model != expect_model:
+            raise FaultModelMismatchError(
+                f"journal {path!r} records fault model {found_model!r} but "
+                f"this campaign runs {expect_model!r}; a resumed campaign "
+                "must replay the recorded flip groups exactly.  Rerun with "
+                "the original --fault-model, or start a fresh journal.")
         keys = (set(found) | set(expect)) - _VOLATILE_KEYS
         diffs = [k for k in sorted(keys) if found.get(k) != expect.get(k)]
         if diffs:

@@ -71,6 +71,17 @@ class MemoryMap:
                 return s
         raise KeyError(name)
 
+    def section_tables(self):
+        """The flat section layout as the native core consumes it
+        (coast_fault_expand): ``(bits_end, leaf_id, lanes, words)`` --
+        cumulative bit edges (int64) plus the per-section int32 columns.
+        Single marshalling point shared by schedule expansion and its
+        parity tests, so they cannot drift from what production passes."""
+        return (np.cumsum([s.bits for s in self.sections]).astype(np.int64),
+                np.array([s.leaf_id for s in self.sections], np.int32),
+                np.array([s.lanes for s in self.sections], np.int32),
+                np.array([s.words for s in self.sections], np.int32))
+
     def decode(self, flat_bits: np.ndarray):
         """Map uniform draws over [0, total_bits) to (leaf_id, lane, word, bit).
 

@@ -1,4 +1,4 @@
-"""Seeded fault schedules: where/when each campaign run flips its bit.
+"""Seeded fault schedules: where/when each campaign run flips its bit(s).
 
 The reference draws a uniformly random sleep inside the benchmark's runtime
 window (threadFunctions.py:451-520) and a uniformly random address in a
@@ -9,6 +9,22 @@ arrays -- one row per injection: (leaf_id, lane, word, bit, t) -- generated
 up front from a seed, so a whole campaign is deterministic and replayable
 (the determinism-parity test of SURVEY.md §4 depends on this).
 
+COAST's original fault model is exactly one bit, one word, one step per
+run.  Real upsets are not: multi-bit upsets flip several bits of one word,
+spatially-correlated events span adjacent words (and, for replicated
+state, adjacent replicas -- cloned globals sit at consecutive addresses),
+and bursts deposit several upsets inside a short time window.  A
+:class:`FaultModel` generalizes the schedule to per-injection flip
+GROUPS: the base row keeps today's single-site layout (``FaultModel
+.single`` schedules are bit-identical to the historical ``generate``
+stream), and the extra sites live in a struct-of-arrays with a group
+index (``FaultSchedule.extra``), expanded from the campaign seed by the
+native core (coast_fault_expand) with a bit-identical numpy fallback.
+FastFlip (arXiv:2403.13989) is why the model is explicit schedule
+metadata rather than an injector knob: outcome-equivalence reasoning
+needs the fault model in the campaign's identity (journal header,
+config fingerprints), not just in its RNG.
+
 Generation is delegated to the native C++ core (coast_tpu.native:
 counter-mode splitmix64 bulk generator) with a numpy fallback producing
 bit-identical streams.
@@ -17,18 +33,152 @@ bit-identical streams.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+import re
+from typing import Dict, Optional
 
 import numpy as np
 
 from coast_tpu import obs
 from coast_tpu.inject.mem import MemoryMap
-from coast_tpu.native import splitmix_fill
+from coast_tpu.native import fault_expand, splitmix_fill
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultModel:
+    """How many bits one injection flips, and how they correlate.
+
+    ``kind``:
+
+    * ``single``           -- one bit, one word, one step (the historical
+      COAST model; the default, and the bit-identical legacy stream).
+    * ``multibit(k)``      -- k distinct bits of the SAME word at the same
+      step (an intra-word MBU).  k <= 32.
+    * ``cluster(span,k)``  -- k flips in adjacent words of the same leaf:
+      each extra site lands 1..span words past the base site in the
+      lane-major word space, so clusters can cross replica (lane)
+      boundaries exactly as physically-adjacent cloned globals do.
+    * ``burst(window,rate)`` -- temporally-bursty independent upsets:
+      ``round(window * rate)`` sites (min 1), each at a fresh uniform
+      location, fired at ``t0 + U[0, window)`` (clamped to the nominal
+      window).
+
+    The classifier taxonomy is deliberately untouched by the model: a
+    multi-site injection is still one run with one outcome code.
+
+    Site coincidence: ``multibit`` engineers k *distinct* bits (odd bit
+    stride over Z/32); ``cluster``/``burst`` draw their extra sites
+    independently, so two sites of one group may land on the same
+    (word, bit) and fire at the same step -- the XOR flips then cancel,
+    exactly as a physical double-upset of one cell restores it.  The
+    effective flip multiplicity is therefore <= sites (noticeably so
+    only for tiny spans/leaves, e.g. cluster(span=1): each extra site
+    has a 1/32 chance of restoring the previous one's bit).
+    """
+
+    kind: str = "single"
+    k: int = 1            # sites for multibit/cluster
+    span: int = 1         # max word offset of a cluster site
+    window: int = 1       # burst time window (steps)
+    rate: float = 1.0     # burst flips per step within the window
+
+    def __post_init__(self):
+        if self.kind not in ("single", "multibit", "cluster", "burst"):
+            raise ValueError(f"unknown fault-model kind {self.kind!r}")
+        if self.kind == "multibit" and not (2 <= self.k <= 32):
+            raise ValueError("multibit needs 2 <= k <= 32 (distinct bits "
+                             "of one 32-bit word)")
+        if self.kind == "cluster" and (self.k < 2 or self.span < 1):
+            raise ValueError("cluster needs k >= 2 sites and span >= 1")
+        if self.kind == "burst" and (self.window < 1 or self.rate <= 0):
+            raise ValueError("burst needs window >= 1 and rate > 0")
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def single(cls) -> "FaultModel":
+        return cls()
+
+    @classmethod
+    def multibit(cls, k: int = 2) -> "FaultModel":
+        return cls(kind="multibit", k=int(k))
+
+    @classmethod
+    def cluster(cls, span: int = 4, k: int = 2) -> "FaultModel":
+        return cls(kind="cluster", span=int(span), k=int(k))
+
+    @classmethod
+    def burst(cls, window: int = 8, rate: float = 0.25) -> "FaultModel":
+        return cls(kind="burst", window=int(window), rate=float(rate))
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def sites(self) -> int:
+        """Flip sites per injection (the group size; 1 == legacy path)."""
+        if self.kind in ("multibit", "cluster"):
+            return self.k
+        if self.kind == "burst":
+            return max(1, int(round(self.window * self.rate)))
+        return 1
+
+    def spec(self) -> str:
+        """Canonical string form -- the journal-header / CLI vocabulary."""
+        if self.kind == "multibit":
+            return f"multibit(k={self.k})"
+        if self.kind == "cluster":
+            return f"cluster(span={self.span},k={self.k})"
+        if self.kind == "burst":
+            return f"burst(window={self.window},rate={self.rate:g})"
+        return "single"
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultModel":
+        """Parse a CLI spec: ``single``, ``multibit(k=3)`` / ``multibit:k=3``
+        / bare ``multibit`` (defaults), and likewise for cluster/burst."""
+        text = text.strip()
+        m = re.fullmatch(r"(\w+)(?:[:(]([^()]*)\)?)?", text)
+        if not m:
+            raise ValueError(f"unparseable fault model {text!r}")
+        kind, argstr = m.group(1), (m.group(2) or "").strip()
+        args: Dict[str, float] = {}
+        if argstr:
+            for part in argstr.split(","):
+                key, _, val = part.partition("=")
+                if not _:
+                    raise ValueError(
+                        f"fault-model argument {part!r} is not key=value")
+                args[key.strip()] = float(val)
+        try:
+            if kind == "single":
+                if args:
+                    raise ValueError("single takes no arguments")
+                return cls.single()
+            if kind == "multibit":
+                return cls.multibit(k=int(args.pop("k", 2)), **args)
+            if kind == "cluster":
+                return cls.cluster(span=int(args.pop("span", 4)),
+                                   k=int(args.pop("k", 2)), **args)
+            if kind == "burst":
+                return cls.burst(window=int(args.pop("window", 8)),
+                                 rate=args.pop("rate", 0.25), **args)
+        except TypeError as e:
+            raise ValueError(f"bad fault-model arguments in {text!r}: {e}")
+        raise ValueError(f"unknown fault-model kind {kind!r} in {text!r}")
+
+
+#: Site-column names shared by the base rows and the extra-site arrays.
+SITE_KEYS = ("leaf_id", "lane", "word", "bit", "t")
 
 
 @dataclasses.dataclass
 class FaultSchedule:
-    """One campaign's worth of injection targets (host-side numpy)."""
+    """One campaign's worth of injection targets (host-side numpy).
+
+    The five site columns hold each injection's BASE site (site 0) -- for
+    ``FaultModel.single`` schedules that is the whole story and the
+    layout is bit-identical to the historical single-bit schedule.
+    Multi-site models add ``extra``: a struct-of-arrays of the remaining
+    ``sites - 1`` flips per injection, site-major, with a ``group``
+    column mapping each extra row back to its injection index within
+    this schedule."""
 
     leaf_id: np.ndarray   # int32 [n]
     lane: np.ndarray      # int32 [n]
@@ -37,35 +187,93 @@ class FaultSchedule:
     t: np.ndarray         # int32 [n] step index of the flip
     section_idx: np.ndarray  # int32 [n] index into MemoryMap.sections
     seed: int
+    # Extra flip-group sites (None for single-site schedules): int32
+    # arrays keyed "group" + SITE_KEYS, length n * (sites - 1), where
+    # extra row i*(sites-1)+(j-1) is injection i's site j.
+    extra: Optional[Dict[str, np.ndarray]] = None
+    model: FaultModel = FaultModel()
 
     def __len__(self) -> int:
         return len(self.leaf_id)
 
+    @property
+    def sites(self) -> int:
+        """Flip sites per injection (1 unless a multi-site model)."""
+        return 1 if self.extra is None else self.model.sites
+
     def device_arrays(self) -> Dict[str, np.ndarray]:
-        return {"leaf_id": self.leaf_id, "lane": self.lane,
-                "word": self.word, "bit": self.bit, "t": self.t}
+        """Per-injection fault columns for the device: 1-D [n] for the
+        single-site path (bit-identical to the legacy layout, so the
+        compiled program is unchanged), [n, sites] for flip groups
+        (column 0 is the base site)."""
+        if self.extra is None:
+            return {"leaf_id": self.leaf_id, "lane": self.lane,
+                    "word": self.word, "bit": self.bit, "t": self.t}
+        n, e = len(self), self.sites - 1
+        return {k: np.concatenate(
+                    [getattr(self, k)[:, None],
+                     self.extra[k].reshape(n, e)], axis=1)
+                for k in SITE_KEYS}
 
     def slice(self, lo: int, hi: int) -> "FaultSchedule":
+        extra = None
+        if self.extra is not None:
+            e = self.sites - 1
+            extra = {k: v[lo * e:hi * e] for k, v in self.extra.items()}
+            extra["group"] = (extra["group"] - np.int32(lo)).astype(np.int32)
         return FaultSchedule(
             self.leaf_id[lo:hi], self.lane[lo:hi], self.word[lo:hi],
-            self.bit[lo:hi], self.t[lo:hi], self.section_idx[lo:hi], self.seed)
+            self.bit[lo:hi], self.t[lo:hi], self.section_idx[lo:hi],
+            self.seed, extra=extra, model=self.model)
 
 
-def generate(mmap: MemoryMap, n: int, seed: int,
-             nominal_steps: int) -> FaultSchedule:
+def _expand(mmap: MemoryMap, sched: FaultSchedule, model: FaultModel,
+            seed: int, nominal_steps: int) -> FaultSchedule:
+    """Attach a multi-site model's extra flip-group rows to a base
+    schedule (native splitmix expansion; numpy fallback bit-identical)."""
+    if model.kind == "single":
+        return sched
+    sched.model = model
+    if model.sites == 1:          # e.g. burst(window*rate <= 1): base only
+        return sched
+    tables = mmap.section_tables()
+    base = {"leaf_id": sched.leaf_id, "lane": sched.lane,
+            "word": sched.word, "bit": sched.bit, "t": sched.t,
+            "section_idx": sched.section_idx}
+    group, leaf_id, lane, word, bit, t = fault_expand(
+        seed, model.kind, model.sites, model.span, model.window,
+        max(nominal_steps, 1), base, tables)
+    sched.extra = {"group": group, "leaf_id": leaf_id, "lane": lane,
+                   "word": word, "bit": bit, "t": t}
+    return sched
+
+
+def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
+             model: Optional[FaultModel] = None) -> FaultSchedule:
     """n seeded draws: uniform over all injectable bits x uniform over the
-    nominal runtime window (the injection window of threadFunctions.py:451)."""
+    nominal runtime window (the injection window of threadFunctions.py:451).
+
+    ``model`` generalizes each draw to a flip group (FaultModel); the
+    default single-bit stream is bit-identical to the historical one,
+    and a multi-site model's BASE sites are that same stream -- the
+    extra sites come from a derived expansion stream, so the single-bit
+    component of any model replays the legacy campaign exactly."""
     with obs.span("schedule", n=n, seed=seed):
         raw = splitmix_fill(seed, 2 * n)      # uint64 stream, native or numpy
         flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
         t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
         leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
-        return FaultSchedule(leaf_id, lane, word, bit, t,
-                             sec_idx.astype(np.int32), seed)
+        sched = FaultSchedule(leaf_id, lane, word, bit, t,
+                              sec_idx.astype(np.int32), seed)
+        if model is None or model.kind == "single":
+            return sched
+        with obs.span("schedule_expand", model=model.spec()):
+            return _expand(mmap, sched, model, seed, nominal_steps)
 
 
 def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
-                        nominal_steps: int) -> FaultSchedule:
+                        nominal_steps: int,
+                        model: Optional[FaultModel] = None) -> FaultSchedule:
     """n_per_section seeded draws into EACH section (equal-allocation
     stratified sampling).
 
@@ -79,10 +287,18 @@ def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
     section's sub-stream is keyed by a splitmix draw from the master seed
     (not seed+idx, which would make adjacent master seeds share stream
     bits shifted one section over), so campaigns replay per stratum and
-    different master seeds are decorrelated."""
+    different master seeds are decorrelated.
+
+    ``model`` expands the concatenated base rows into flip groups exactly
+    as in ``generate`` (the expansion is keyed by the master seed)."""
     with obs.span("schedule", n_per_section=n_per_section, seed=seed,
                   stratified=True):
-        return _generate_stratified(mmap, n_per_section, seed, nominal_steps)
+        sched = _generate_stratified(mmap, n_per_section, seed,
+                                     nominal_steps)
+        if model is None or model.kind == "single":
+            return sched
+        with obs.span("schedule_expand", model=model.spec()):
+            return _expand(mmap, sched, model, seed, nominal_steps)
 
 
 def _generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
@@ -106,11 +322,29 @@ def _generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
 
 
 def generate_stratified_total(mmap: MemoryMap, total: int, seed: int,
-                              nominal_steps: int) -> FaultSchedule:
+                              nominal_steps: int,
+                              model: Optional[FaultModel] = None
+                              ) -> FaultSchedule:
     """Stratified schedule sized by a total budget: ``total`` is divided
     equally across sections, floored at one draw per section, so the
     actual campaign size is ``max(1, total // n_sections) * n_sections``
     (callers report len(schedule), which may round away from ``total``).
-    Single allocation policy shared by the advisor and the supervisor."""
+    Single allocation policy shared by the advisor and the supervisor.
+
+    The flooring is usually a few rows of rounding, but a budget smaller
+    than (or barely above) the section count realizes a very different
+    campaign than requested -- that deviation is surfaced, not silent:
+    >10% drift from ``total`` emits a one-line warning and an obs
+    counter (``stratified_budget_drift_rows``)."""
     n_per = max(1, total // len(mmap.sections))
-    return generate_stratified(mmap, n_per, seed, nominal_steps)
+    realized = n_per * len(mmap.sections)
+    if total > 0 and abs(realized - total) > 0.10 * total:
+        import sys
+        obs.count("stratified_budget_drift_rows", abs(realized - total),
+                  requested=int(total), realized=int(realized),
+                  sections=len(mmap.sections))
+        print(f"warning: stratified budget {total} realized as {realized} "
+              f"rows ({len(mmap.sections)} sections x {n_per}/section, "
+              f"{100.0 * abs(realized - total) / total:.0f}% off the "
+              "requested budget)", file=sys.stderr)
+    return generate_stratified(mmap, n_per, seed, nominal_steps, model=model)

@@ -26,9 +26,13 @@ import os
 import sys
 from typing import List, Optional
 
+#: Geometry-overlay sections: these draw their own cache schedules
+#: (coast_tpu.inject.hierarchy), outside the seeded generate() paths --
+#: several CLI gates below refuse flags that only make sense there.
+CACHE_SECTIONS = ("cache", "icache", "dcache", "l2cache")
+
 SECTION_CHOICES = ["stack", "text", "rodata", "data", "bss", "heap", "init",
-                   "registers", "memory", "cache", "icache", "dcache",
-                   "l2cache"]
+                   "registers", "memory", *CACHE_SECTIONS]
 
 from coast_tpu.inject.hierarchy import DCACHE_KINDS, ICACHE_KINDS
 
@@ -96,6 +100,17 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "value, trades loop dispatch overhead against "
                         "masked overshoot work (sweep: scripts/"
                         "mfu_sweep.py)")
+    parser.add_argument("--fault-model", type=str, default="single",
+                        metavar="SPEC",
+                        help="what one injection IS: 'single' (default; "
+                        "the historical one-bit flip), 'multibit(k=K)' "
+                        "(K distinct bits of one word), 'cluster(span=S,"
+                        "k=K)' (K flips in adjacent words, lane-crossing), "
+                        "or 'burst(window=W,rate=R)' (round(W*R) upsets "
+                        "inside a W-step window).  Colon form works too "
+                        "(multibit:k=3).  Recorded in the log summary and "
+                        "the journal header; resume under a different "
+                        "model is refused with a typed error")
     parser.add_argument("--stratified", action="store_true",
                         help="equal-allocation sampling per section: -t "
                         "is divided across sections (floored at 1 each, "
@@ -177,8 +192,7 @@ def parse_command_line(argv: Optional[List[str]] = None):
         print("This board not yet supported in this version", file=sys.stderr)
         sys.exit(-1)
     if args.stratified and (args.errorCount or args.start_num
-                            or args.section in ("cache", "icache", "dcache",
-                                                "l2cache")):
+                            or args.section in CACHE_SECTIONS):
         print("Error, --stratified cannot be combined with -e/--errorCount, "
               "--start-num, or cache sections (those draw their own "
               "schedules; strata are separately seeded streams)",
@@ -199,6 +213,23 @@ def parse_command_line(argv: Optional[List[str]] = None):
         print("Error, --resume requires --journal (there is nothing to "
               "resume from)", file=sys.stderr)
         sys.exit(-1)
+    if args.fault_model != "single":
+        from coast_tpu.inject.schedule import FaultModel
+        try:
+            args.fault_model_parsed = FaultModel.parse(args.fault_model)
+        except ValueError as e:
+            print(f"Error, bad --fault-model: {e}", file=sys.stderr)
+            sys.exit(-1)
+        if args.forceBreak or args.section in CACHE_SECTIONS:
+            # Forced injections name ONE site by hand; cache schedules
+            # draw geometry-overlay sites outside the seeded generate()
+            # paths the expansion is defined over.
+            print("Error, --fault-model applies to the seeded campaign "
+                  "paths (-t/-e/--stratified), not --forceBreak or cache "
+                  "sections", file=sys.stderr)
+            sys.exit(-1)
+    else:
+        args.fault_model_parsed = None
     if args.stream_logs and (args.no_logging or args.errorCount
                              or args.forceBreak
                              or args.log_format == "json"):
@@ -211,8 +242,7 @@ def parse_command_line(argv: Optional[List[str]] = None):
               "default json format)", file=sys.stderr)
         sys.exit(-1)
     if args.journal and (args.forceBreak or args.stratified
-                         or args.section in ("cache", "icache", "dcache",
-                                             "l2cache")):
+                         or args.section in CACHE_SECTIONS):
         # Forced injections are debug one-offs; cache/stratified schedules
         # are journalable in principle but the header vocabulary (seed, n,
         # start_num) does not describe them yet -- refuse loudly rather
@@ -320,7 +350,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 strategy_name=strategy,
                                 unroll=args.unroll,
                                 retry=retry,
-                                mesh=mesh)
+                                mesh=mesh,
+                                fault_model=args.fault_model_parsed)
     except ValueError:
         print(f"Error, {prog.region.name} has no injectable leaves in "
               f"section '{args.section}'!", file=sys.stderr)
@@ -372,7 +403,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                        and src_paths else None))
 
     try:
-        if args.section in ("cache", "icache", "dcache", "l2cache"):
+        if args.section in CACHE_SECTIONS:
             hierarchy = MemHierarchy("tpu")
             cache_name = None if args.section == "cache" else args.section
             sched = generate_cache_schedule(
@@ -388,7 +419,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif args.stratified:
             from coast_tpu.inject.schedule import generate_stratified_total
             sched = generate_stratified_total(mmap, args.t, args.seed,
-                                              prog.region.nominal_steps)
+                                              prog.region.nominal_steps,
+                                              model=runner.fault_model)
             res = runner.run_schedule(
                 sched, batch_size=min(args.batch_size, len(sched)),
                 stream=stream)

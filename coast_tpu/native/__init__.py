@@ -72,6 +72,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")]
             lib.coast_cfcss_assign.restype = ctypes.c_int32
             try:
+                # Fault-model expansion (own guard: an older .so degrades
+                # only the expansion to the numpy fallback, nothing else).
+                i32a = np.ctypeslib.ndpointer(np.int32,
+                                              flags="C_CONTIGUOUS")
+                i64a = np.ctypeslib.ndpointer(np.int64,
+                                              flags="C_CONTIGUOUS")
+                lib.coast_fault_expand.argtypes = [
+                    ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_int64,
+                    i32a, i32a, i32a, i32a, i32a, i32a,
+                    ctypes.c_int32, i64a, i32a, i32a, i32a,
+                    i32a, i32a, i32a, i32a, i32a, i32a]
+                lib.coast_fault_expand.restype = ctypes.c_int32
+            except AttributeError:
+                pass
+            try:
                 lib.coast_ndjson_classify.argtypes = [
                     ctypes.c_char_p, ctypes.c_int64,
                     np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
@@ -165,6 +182,100 @@ def splitmix_fill(seed: int, n: int) -> np.ndarray:
         z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         return z ^ (z >> np.uint64(31))
+
+
+#: Derived-stream salt of the fault-model expansion: the expansion draws
+#: come from splitmix_at(seed, FAULT_EXPAND_SALT) so they never collide
+#: with the base schedule's own (seed, counter) stream.  Must match
+#: kExpandSalt in coast_core.cpp.
+FAULT_EXPAND_SALT = 0x5EEDFA11
+
+_FAULT_KINDS = {"multibit": 1, "cluster": 2, "burst": 3}
+
+
+def fault_expand(seed: int, kind: str, sites: int, span: int, window: int,
+                 steps: int, base, sec_tables,
+                 force_python: bool = False):
+    """Expand a base single-site schedule into its extra flip-group rows.
+
+    ``base`` is a dict of int32 arrays (leaf_id, lane, word, bit, t,
+    section_idx), one row per injection; ``sec_tables`` is
+    ``(bits_end, leaf, lanes, words)`` -- the MemoryMap's section layout
+    (cumulative bit edges int64, then per-section int32 columns).
+    Returns ``(group, leaf_id, lane, word, bit, t)`` int32 arrays of
+    length ``n * (sites - 1)``, site-major within injection.  Native
+    (coast_fault_expand) when available, else a bit-identical numpy
+    path; ``force_python`` pins the fallback (the parity tests)."""
+    n = len(base["leaf_id"])
+    m = n * (sites - 1)
+    kind_id = _FAULT_KINDS[kind]
+    cols = {k: np.ascontiguousarray(base[k], np.int32)
+            for k in ("leaf_id", "lane", "word", "bit", "t", "section_idx")}
+    bits_end = np.ascontiguousarray(sec_tables[0], np.int64)
+    sec_leaf, sec_lanes, sec_words = (
+        np.ascontiguousarray(a, np.int32) for a in sec_tables[1:])
+    lib = None if force_python else get_lib()
+    if lib is not None and hasattr(lib, "coast_fault_expand"):
+        group = np.empty(m, np.int32)
+        out = {k: np.empty(m, np.int32)
+               for k in ("leaf_id", "lane", "word", "bit", "t")}
+        rc = lib.coast_fault_expand(
+            np.uint64(seed & 0xFFFFFFFFFFFFFFFF), np.int32(kind_id),
+            np.int32(sites), np.int32(span), np.int32(window),
+            np.int32(steps), np.int64(n),
+            cols["leaf_id"], cols["lane"], cols["word"], cols["bit"],
+            cols["t"], cols["section_idx"],
+            np.int32(len(sec_leaf)), bits_end, sec_leaf, sec_lanes,
+            sec_words, group, out["leaf_id"], out["lane"], out["word"],
+            out["bit"], out["t"])
+        if rc != 0:
+            raise ValueError(f"coast_fault_expand failed (rc={rc})")
+        return (group, out["leaf_id"], out["lane"], out["word"],
+                out["bit"], out["t"])
+
+    # ---- numpy fallback (bit-identical: same derived stream + indexing) --
+    exp_seed = _splitmix_at(seed & 0xFFFFFFFFFFFFFFFF, FAULT_EXPAND_SALT)
+    extras = sites - 1
+    group = np.repeat(np.arange(n, dtype=np.int32), extras)
+    i = group.astype(np.int64)                    # base row per extra row
+    j = np.tile(np.arange(1, sites, dtype=np.int64), n)   # site index
+    if kind == "multibit":
+        u = splitmix_fill(exp_seed, n)
+        stride = (1 + 2 * (u % np.uint64(16)))[i]
+        bit = ((cols["bit"][i].astype(np.uint64)
+                + j.astype(np.uint64) * stride) % np.uint64(32))
+        return (group, cols["leaf_id"][i], cols["lane"][i],
+                cols["word"][i], bit.astype(np.int32), cols["t"][i])
+    # Extra row r (site-major: r = i*extras + (j-1) = 0..m-1 in order)
+    # consumes stream draws 2r and 2r+1, matching the C++ loop exactly.
+    u = splitmix_fill(exp_seed, 2 * m) if m else np.zeros(0, np.uint64)
+    u0, u1 = u[0::2], u[1::2]
+    if kind == "cluster":
+        s = cols["section_idx"][i]
+        words = sec_words[s].astype(np.uint64)
+        lw = sec_lanes[s].astype(np.uint64) * words
+        phys = (cols["lane"][i].astype(np.uint64) * words
+                + cols["word"][i].astype(np.uint64)
+                + np.uint64(1) + u0 % np.uint64(span)) % lw
+        return (group, cols["leaf_id"][i],
+                (phys // words).astype(np.int32),
+                (phys % words).astype(np.int32),
+                (u1 % np.uint64(32)).astype(np.int32), cols["t"][i])
+    # burst: fresh uniform location over the whole map + clustered time
+    total_bits = np.uint64(bits_end[-1])
+    flat = (u0 % total_bits).astype(np.int64)
+    s = np.searchsorted(bits_end, flat, side="right")
+    start = np.where(s == 0, 0, bits_end[np.maximum(s - 1, 0)])
+    off = flat - start
+    per_lane = sec_words[s].astype(np.int64) * 32
+    t0 = cols["t"][i].astype(np.int64)
+    tj = np.minimum(t0 + (u1 % np.uint64(window)).astype(np.int64),
+                    steps - 1)
+    return (group, sec_leaf[s].astype(np.int32),
+            (off // per_lane).astype(np.int32),
+            ((off % per_lane) // 32).astype(np.int32),
+            (off % 32).astype(np.int32),
+            np.where(t0 < 0, t0, tj).astype(np.int32))
 
 
 def ndjson_stream_rows(lo: int, hi: int, col, sec_kind_by_leaf,

@@ -25,6 +25,14 @@
 //                           the analysis-side mirror of the encoder
 //                           below.  A 10^6-row summary drops from ~40s
 //                           of per-line json.loads to under a second.
+//   * coast_fault_expand  - multi-draw splitmix expansion of a base fault
+//                           schedule into per-injection flip GROUPS for the
+//                           generalized fault models (multibit / cluster /
+//                           burst): one C pass over the base rows derives
+//                           every extra site's (leaf, lane, word, bit, t)
+//                           from the campaign seed, bit-identical to the
+//                           numpy fallback so schedules replay across
+//                           hosts with and without the compiled core.
 //   * coast_ndjson_encode - bulk campaign-log serialiser: formats a row
 //                           range of a campaign's columns into
 //                           InjectionLog-schema ndjson lines
@@ -200,6 +208,103 @@ int32_t coast_cfcss_assign(int32_t n, int32_t n_edges, const int32_t* edges,
     if (sound) return attempt + 1;
   }
   return -1;
+}
+
+// Multi-draw fault-model expansion (inject/schedule.FaultModel).
+//
+// Expands a base single-site schedule (one row per injection) into the
+// EXTRA sites of a multi-site fault model -- sites-1 rows per injection,
+// site-major within injection (extra row m = i*(sites-1) + (j-1) is
+// injection i's site j).  The base row is always site 0 and is not
+// rewritten here.  Draws come from a derived counter-mode splitmix64
+// stream (exp_seed = splitmix_at(seed, kExpandSalt)), indexed purely by
+// (injection, site) so the expansion is order-independent and the numpy
+// fallback in native/__init__.py is bit-identical by construction.
+//
+// Kinds (parameters are validated Python-side):
+//   1 multibit(k):      k distinct bits in the base word.  One draw per
+//                       injection picks an odd stride in [1,31]; site j
+//                       flips bit (bit0 + j*stride) mod 32 -- odd strides
+//                       generate Z/32, so all k <= 32 bits are distinct.
+//   2 cluster(span,k):  k spatially-correlated flips in ADJACENT words of
+//                       the base leaf.  The word space is the lane-major
+//                       flattening (lane*words + word), so a cluster that
+//                       runs off the end of one replica's words continues
+//                       into the next lane -- exactly how the reference's
+//                       cloned globals sit at consecutive addresses.  Site
+//                       j lands 1 + (u mod span) words past the base
+//                       (wrapping mod lanes*words) with its own bit draw.
+//   3 burst(window,r):  temporally-bursty independent upsets: each extra
+//                       site redraws a uniform location over the WHOLE
+//                       map (same decode as MemoryMap.decode) and fires
+//                       at t0 + (u mod window), clamped to steps-1.
+//
+// Outputs are n*(sites-1) int32 rows (group = injection index, then
+// leaf/lane/word/bit/t).  Returns 0, or -2 on malformed input.
+int32_t coast_fault_expand(
+    uint64_t seed, int32_t kind, int32_t sites, int32_t span, int32_t window,
+    int32_t steps, int64_t n, const int32_t* leaf0, const int32_t* lane0,
+    const int32_t* word0, const int32_t* bit0, const int32_t* t0,
+    const int32_t* sec0, int32_t n_sections, const int64_t* sec_bits_end,
+    const int32_t* sec_leaf, const int32_t* sec_lanes,
+    const int32_t* sec_words, int32_t* group, int32_t* leaf, int32_t* lane,
+    int32_t* word, int32_t* bit, int32_t* t) {
+  constexpr uint64_t kExpandSalt = 0x5EEDFA11ULL;
+  if (n < 0 || sites < 2 || kind < 1 || kind > 3 || n_sections <= 0)
+    return -2;
+  if ((kind == 2 && span < 1) || (kind == 3 && (window < 1 || steps < 1)))
+    return -2;
+  const uint64_t exp_seed = splitmix_at(seed, kExpandSalt);
+  const int64_t extras = sites - 1;
+  const uint64_t total_bits = (uint64_t)sec_bits_end[n_sections - 1];
+  for (int64_t i = 0; i < n; ++i) {
+    // multibit: one stride draw per injection, shared by its sites.
+    const uint64_t stride =
+        kind == 1 ? 1 + 2 * (splitmix_at(exp_seed, (uint64_t)i) % 16) : 0;
+    for (int64_t j = 1; j <= extras; ++j) {
+      const int64_t m = i * extras + (j - 1);
+      int32_t* const g = group + m;
+      *g = (int32_t)i;
+      if (kind == 1) {  // multibit: same word, distinct bits
+        leaf[m] = leaf0[i];
+        lane[m] = lane0[i];
+        word[m] = word0[i];
+        bit[m] = (int32_t)(((uint64_t)bit0[i] + (uint64_t)j * stride) % 32);
+        t[m] = t0[i];
+      } else if (kind == 2) {  // cluster: adjacent words, lane-crossing
+        const int32_t s = sec0[i];
+        if (s < 0 || s >= n_sections) return -2;
+        const uint64_t words = (uint64_t)sec_words[s];
+        const uint64_t lw = (uint64_t)sec_lanes[s] * words;
+        const uint64_t u_off = splitmix_at(exp_seed, (uint64_t)(2 * m));
+        const uint64_t u_bit = splitmix_at(exp_seed, (uint64_t)(2 * m + 1));
+        const uint64_t phys = ((uint64_t)lane0[i] * words + (uint64_t)word0[i]
+                               + 1 + (u_off % (uint64_t)span)) % lw;
+        leaf[m] = leaf0[i];
+        lane[m] = (int32_t)(phys / words);
+        word[m] = (int32_t)(phys % words);
+        bit[m] = (int32_t)(u_bit % 32);
+        t[m] = t0[i];
+      } else {  // burst: independent location, clustered time
+        const uint64_t u_loc = splitmix_at(exp_seed, (uint64_t)(2 * m));
+        const uint64_t u_dt = splitmix_at(exp_seed, (uint64_t)(2 * m + 1));
+        const uint64_t flat = u_loc % total_bits;
+        int32_t s = 0;  // searchsorted(side="right") over the bit edges
+        while (s < n_sections - 1 && flat >= (uint64_t)sec_bits_end[s]) ++s;
+        const uint64_t start = s == 0 ? 0 : (uint64_t)sec_bits_end[s - 1];
+        const uint64_t off = flat - start;
+        const uint64_t per_lane = (uint64_t)sec_words[s] * 32;
+        leaf[m] = sec_leaf[s];
+        lane[m] = (int32_t)(off / per_lane);
+        word[m] = (int32_t)((off % per_lane) / 32);
+        bit[m] = (int32_t)(off % 32);
+        const int64_t tj = (int64_t)t0[i] + (int64_t)(u_dt % (uint64_t)window);
+        t[m] = t0[i] < 0 ? t0[i]
+                         : (int32_t)(tj < steps ? tj : (int64_t)steps - 1);
+      }
+    }
+  }
+  return 0;
 }
 
 // Bulk ndjson campaign-log classifier (the analysis read path).

@@ -158,7 +158,8 @@ class ShardedCampaignRunner(CampaignRunner):
         tel = self.telemetry
         with tel.activate():        # generate() records its schedule span
             sched = generate(self.mmap, n, seed,
-                             self.prog.region.nominal_steps)
+                             self.prog.region.nominal_steps,
+                             model=self.fault_model)
         # One-shot campaign drawn here: clamp the batch to the schedule so
         # a small n does not pay for padding rows (the clamp happens
         # before device rounding, which floors at one row per device).

@@ -741,6 +741,14 @@ class ProtectedProgram:
         the run record mirroring the guest UART line ``C: E: F: T:``
         (resources/decoder.py:66) plus the DUE flags.
 
+        Multi-site fault models (inject/schedule.FaultModel) pass each key
+        as an int32 vector of shape [sites] instead -- one flip GROUP per
+        run.  Site g fires its own one-hot XOR when ``t == fault['t'][g]``
+        (sites may share a step -- a multibit word -- or spread over a
+        burst window), through the same hoisted per-site masks; the
+        scalar path is byte-for-byte the historical single-site program,
+        so FaultModel.single campaigns compile and classify identically.
+
         ``trace=True`` additionally records, per scan step, the block about
         to execute and whether the run was still live -- the raw material of
         the debugStatements/smallProfile instrumentation passes
@@ -756,19 +764,31 @@ class ProtectedProgram:
         sub-steps after the early exit.  The traced path is a fixed-length
         scan, so ``unroll`` does not apply there.
         """
+        n_sites = 0
         if fault is not None:
             # Accept plain Python ints (the CLI / README ergonomics).
             fault = {k: jnp.asarray(v, jnp.int32) for k, v in fault.items()}
+            # Vector entries are a flip group: sites is static (a shape),
+            # so the site loop unrolls into the traced program.
+            n_sites = (int(fault["t"].shape[0]) if fault["t"].ndim else 0)
         pstate, flags = self.init_pstate()
 
         # The flip's one-hot masks are step-invariant: build them ONCE
         # outside the loop (the in-loop iota-compare rebuild measured ~2/3
         # of small-benchmark campaign runtime), leaving one select+XOR per
-        # leaf per step.
-        masks = (None if fault is None else
-                 self._flip.build_masks(pstate, self.replicated,
-                                        fault["leaf_id"], fault["lane"],
-                                        fault["word"], fault["bit"]))
+        # leaf per step -- per SITE for a flip group, each with its own
+        # fire step.
+        if fault is None:
+            masks = None
+        elif n_sites:
+            masks = [self._flip.build_masks(
+                         pstate, self.replicated, fault["leaf_id"][g],
+                         fault["lane"][g], fault["word"][g], fault["bit"][g])
+                     for g in range(n_sites)]
+        else:
+            masks = self._flip.build_masks(pstate, self.replicated,
+                                           fault["leaf_id"], fault["lane"],
+                                           fault["word"], fault["bit"])
 
         def body(carry, t):
             pstate, flags = carry
@@ -778,9 +798,16 @@ class ProtectedProgram:
                 # bounded by the measured runtime, so flips always land in a
                 # live guest (threadFunctions.py:451-520); a flip into a
                 # finished/aborted run's frozen image would mis-classify it.
-                fire = jnp.logical_and(t == fault["t"],
-                                       jnp.logical_not(halted))
-                pstate = self._flip.apply_masks(pstate, masks, fire)
+                if n_sites:
+                    for g in range(n_sites):
+                        fire = jnp.logical_and(t == fault["t"][g],
+                                               jnp.logical_not(halted))
+                        pstate = self._flip.apply_masks(pstate, masks[g],
+                                                        fire)
+                else:
+                    fire = jnp.logical_and(t == fault["t"],
+                                           jnp.logical_not(halted))
+                    pstate = self._flip.apply_masks(pstate, masks, fire)
             ys = None
             if trace:
                 if self.region.graph is not None:

@@ -1,0 +1,120 @@
+"""Fault-model smoke driver (unittest/cfg/fast.yml row).
+
+Regression-checks the three fault-model guarantees every CI run, on CPU
+in a few seconds (prints ``Success!`` for the harness driver oracle,
+coast_tpu.testing.harness.run_drivers):
+
+  1. **Legacy parity** -- a ``FaultModel.single`` campaign classifies
+     bit-for-bit identically to the default (model-less) runner, and its
+     log summary carries no fault-model key.
+  2. **Expansion parity** -- the native ``coast_fault_expand`` and the
+     numpy fallback produce identical flip-group streams for every
+     model kind (skipped per-kind when the native core is unavailable;
+     the numpy path is then the only path, so parity is vacuous).
+  3. **Model identity** -- a journaled multi-site campaign interrupted
+     after k batches resumes bit-for-bit, and resume under a DIFFERENT
+     model is refused with the typed FaultModelMismatchError.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Kill(Exception):
+    """SIGKILL stand-in, raised from a progress beat after the preceding
+    batches' journal records are already fsync'd."""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    del argv
+    from coast_tpu import TMR, native
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.journal import FaultModelMismatchError
+    from coast_tpu.inject.schedule import FaultModel, generate
+    from coast_tpu.models import mm
+
+    region = mm.make_region()
+    prog = TMR(region)
+
+    # 1. legacy parity: explicit single == default, no summary key
+    default = CampaignRunner(prog, strategy_name="TMR")
+    single = CampaignRunner(prog, strategy_name="TMR",
+                            fault_model=FaultModel.single())
+    a = default.run(120, seed=17, batch_size=40)
+    b = single.run(120, seed=17, batch_size=40)
+    if not np.array_equal(a.codes, b.codes) or "fault_model" in b.summary():
+        print("single-model parity FAILED")
+        return 1
+    print("single-model campaign identical to the legacy path")
+
+    # 2. native/numpy expansion parity per kind
+    models = [FaultModel.multibit(k=4), FaultModel.cluster(span=4, k=3),
+              FaultModel.burst(window=8, rate=0.5)]
+    mmap = default.mmap
+    if native.native_available():
+        base_sched = generate(mmap, 200, 17, region.nominal_steps)
+        base = {k: getattr(base_sched, k)
+                for k in ("leaf_id", "lane", "word", "bit", "t",
+                          "section_idx")}
+        tables = mmap.section_tables()
+        for m in models:
+            args = (17, m.kind, m.sites, m.span, m.window,
+                    region.nominal_steps, base, tables)
+            nat = native.fault_expand(*args)
+            py = native.fault_expand(*args, force_python=True)
+            if not all(np.array_equal(x, y) for x, y in zip(nat, py)):
+                print(f"expansion parity FAILED for {m.spec()}")
+                return 1
+        print(f"native/numpy expansion parity over {len(models)} kinds")
+    else:
+        print("native core unavailable; numpy expansion is the only path")
+
+    # 3. journaled multi-site resume + typed model-mismatch refusal
+    model = FaultModel.cluster(span=4, k=3)
+    runner = CampaignRunner(prog, strategy_name="TMR", fault_model=model)
+    baseline = runner.run(120, seed=17, batch_size=40)
+    with tempfile.TemporaryDirectory() as d:
+        jpath = os.path.join(d, "fm.journal")
+        beats = {"n": 0}
+
+        def kill_on_second(done, counts):
+            beats["n"] += 1
+            if beats["n"] >= 2:
+                raise _Kill
+        try:
+            runner.run(120, seed=17, batch_size=40, journal=jpath,
+                       progress=kill_on_second)
+            print("campaign was not interrupted; smoke setup broken")
+            return 1
+        except _Kill:
+            pass
+        resumed = runner.run(120, seed=17, batch_size=40, journal=jpath)
+        if not np.array_equal(resumed.codes, baseline.codes):
+            print("multi-site resume parity FAILED: codes differ")
+            return 1
+        try:
+            CampaignRunner(prog, strategy_name="TMR",
+                           fault_model=FaultModel.multibit(k=4)).run(
+                120, seed=17, batch_size=40, journal=jpath)
+            print("model mismatch was NOT refused")
+            return 1
+        except FaultModelMismatchError:
+            pass
+    print(f"{model.spec()} campaign interrupted after {beats['n']} "
+          "batches, resumed bit-for-bit; mismatched model refused")
+    print("Success!")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())

@@ -79,6 +79,10 @@ def main(argv=None) -> int:
                     "devices (CampaignRunner(mesh=make_mesh(N))); "
                     "classification counts are identical to single-"
                     "device at the same seed/schedule")
+    ap.add_argument("--fault-model", default="single", metavar="SPEC",
+                    help="FaultModel spec (single / multibit(k=K) / "
+                    "cluster(span=S,k=K) / burst(window=W,rate=R)); "
+                    "recorded in the journal header and log summary")
     args = ap.parse_args(argv)
 
     import jax
@@ -98,7 +102,6 @@ def main(argv=None) -> int:
     from coast_tpu.inject import logs
     from coast_tpu.inject.campaign import CampaignRunner
     from coast_tpu.inject.journal import (CampaignJournal,
-                                          config_fingerprint,
                                           schedule_fingerprint)
     from coast_tpu.inject.schedule import generate
     from coast_tpu.models import REGISTRY
@@ -117,6 +120,8 @@ def main(argv=None) -> int:
     stages = {}
     t0 = time.perf_counter()
     note("building protected program")
+    from coast_tpu.inject.schedule import FaultModel
+    fault_model = FaultModel.parse(args.fault_model)
     prog = TMR(REGISTRY["matrixMultiply"]())
     mesh = None
     if args.mesh:
@@ -124,7 +129,11 @@ def main(argv=None) -> int:
         mesh = make_mesh(min(args.mesh, len(jax.devices())))
         note(f"mesh: {args.mesh} requested, "
              f"{dict(zip(mesh.axis_names, mesh.devices.shape))} built")
-    runner = CampaignRunner(prog, strategy_name="TMR", mesh=mesh)
+    # fault_model on the runner, not just the schedule: the warm-compile
+    # run below must trace the SAME [batch, sites] fault signature the
+    # measured chunks dispatch, or the first chunk absorbs the compile.
+    runner = CampaignRunner(prog, strategy_name="TMR", mesh=mesh,
+                            fault_model=fault_model)
     telemetry = runner.telemetry
     stages["build_s"] = round(time.perf_counter() - t0, 3)
 
@@ -132,7 +141,7 @@ def main(argv=None) -> int:
     note("generating schedule")
     with telemetry.activate():
         sched = generate(runner.mmap, args.n, args.seed,
-                         prog.region.nominal_steps)
+                         prog.region.nominal_steps, model=fault_model)
     stages["schedule_s"] = round(time.perf_counter() - t0, 3)
 
     # Crash safety: the whole seed stream is one journal; each chunk's
@@ -146,12 +155,14 @@ def main(argv=None) -> int:
         jpath = args.journal or out + ".journal"
         os.makedirs(os.path.dirname(jpath) or ".", exist_ok=True)
         try:
-            journal = CampaignJournal.open(jpath, {
-                "mode": "schedule", "benchmark": "matrixMultiply",
-                "strategy": "TMR",
-                "config_sha": config_fingerprint(prog.cfg),
-                "seed": args.seed, "n": args.n,
-                "schedule_sha": schedule_fingerprint(sched)},
+            # One header vocabulary: the runner's _journal_header applies
+            # the same omit-when-single fault-model rule the supervisor
+            # paths journal with, so resume validation cannot drift.
+            journal = CampaignJournal.open(
+                jpath,
+                runner._journal_header(
+                    "schedule", seed=args.seed, n=args.n,
+                    schedule_sha=schedule_fingerprint(sched)),
                 resume=args.resume)
         except JournalExistsError as e:
             note(f"ERROR: {e}")

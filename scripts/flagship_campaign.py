@@ -57,14 +57,16 @@ def wilson(k: int, n: int, z: float = 1.96):
             round(min(1.0, centre + half), 6))
 
 
-def analytic_batch(region, lanes, device=None, util=0.5):
+def analytic_batch(region, lanes, device=None, util=0.5, sites=1):
     """HBM-arithmetic batch sizing: rows = util x bytes_limit / bytes_per_row.
 
     One campaign row holds the whole replica state independently
-    (``state_bytes x lanes``) PLUS the flip masks of the same footprint
-    (ops/bitflip.build_masks materialises one uint32 mask per leaf, hoisted
-    out of the step loop), so bytes_per_row ~= 2 x state x lanes; ``util``
-    leaves headroom for XLA temporaries and the output columns.  Returns
+    (``state_bytes x lanes``) PLUS one flip mask of the same footprint PER
+    FLIP SITE (ops/bitflip.build_masks materialises one uint32 mask per
+    leaf per site, hoisted out of the step loop; a multi-site FaultModel
+    -- multibit/cluster/burst -- hoists ``sites`` of them), so
+    bytes_per_row ~= state x lanes x (1 + sites); ``util`` leaves
+    headroom for XLA temporaries and the output columns.  Returns
     ``(batch, info)`` from the device's queried memory stats, or ``(None,
     info)`` when the backend exposes none (CPU) -- callers fall back to
     the empirical probe, which otherwise only remains as the assert that
@@ -76,10 +78,12 @@ def analytic_batch(region, lanes, device=None, util=0.5):
     except Exception:  # noqa: BLE001 - backends without stats
         stats = {}
     limit = stats.get("bytes_limit")
-    per_row = 2 * region.meta["state_bytes"] * lanes
+    sites = max(1, int(sites))
+    per_row = region.meta["state_bytes"] * lanes * (1 + sites)
     info = {"bytes_limit": limit, "bytes_per_row": per_row,
-            "utilization": util,
-            "model": "state_bytes x lanes x 2 (replicas + flip masks)"}
+            "utilization": util, "fault_sites": sites,
+            "model": "state_bytes x lanes x (1 + sites) "
+                     "(replicas + per-site flip masks)"}
     if not limit:
         info["note"] = "backend exposes no memory_stats; probe sizing"
         return None, info
@@ -140,7 +144,16 @@ def main(argv=None):
                     "devices (CampaignRunner(mesh=make_mesh(N))); the "
                     "HBM batch arithmetic then sizes PER-DEVICE rows, "
                     "so an N-chip slice runs ~N x the single-chip batch")
+    ap.add_argument("--fault-model", default="single", metavar="SPEC",
+                    help="FaultModel spec for every campaign (single / "
+                    "multibit(k=K) / cluster(span=S,k=K) / burst(window=W,"
+                    "rate=R)).  Multi-site models hoist one flip mask per "
+                    "site, so the analytic HBM batch shrinks by "
+                    "(1+sites)/2 vs the single-bit arithmetic -- sized "
+                    "here, not discovered by OOM")
     args = ap.parse_args(argv)
+    from coast_tpu.inject.schedule import FaultModel
+    fault_model = FaultModel.parse(args.fault_model)
 
     # One shared recorder across every runner of the session, so the
     # exported trace shows probe, TMR, DWC, and A/B phases on one
@@ -206,10 +219,14 @@ def main(argv=None):
                                          mesh.devices.shape)))}
     tmr_runner = CampaignRunner(TMR(region, pallas_voters=True),
                                 strategy_name="TMR", telemetry=telemetry,
-                                retry=retry, mesh=mesh)
+                                retry=retry, mesh=mesh,
+                                fault_model=fault_model)
+    if fault_model.kind != "single":
+        out["fault_model"] = fault_model.spec()
     out["batch_probe"] = []
     best_batch, best_rate = None, -1.0
-    analytic, hbm_info = analytic_batch(region, lanes=3)
+    analytic, hbm_info = analytic_batch(region, lanes=3,
+                                        sites=fault_model.sites)
     if analytic is not None and n_dev > 1:
         # The HBM arithmetic bounds rows PER DEVICE; the sharded batch
         # axis spreads rows 1/N per chip, so the dispatch batch scales
@@ -272,7 +289,7 @@ def main(argv=None):
             ("DWC", CampaignRunner(DWC(region, pallas_voters=True),
                                    strategy_name="DWC",
                                    telemetry=telemetry, retry=retry,
-                                   mesh=mesh),
+                                   mesh=mesh, fault_model=fault_model),
              n_dwc)):
         counts, done, secs = {}, 0, 0.0
         stages = {}
@@ -368,7 +385,8 @@ def main(argv=None):
     ab = {}
     for name, reg in (("slice_vote", region), ("wholeleaf_vote", region_wl)):
         r = CampaignRunner(TMR(reg, pallas_voters=True), strategy_name="TMR",
-                           telemetry=telemetry, mesh=mesh)
+                           telemetry=telemetry, mesh=mesh,
+                           fault_model=fault_model)
         with telemetry.span("slice_vote_ab", cell=name):
             r.run(best_batch, seed=1, batch_size=best_batch)      # warm
             res = r.run(n_ab, seed=7, batch_size=best_batch)

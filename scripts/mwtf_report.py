@@ -13,6 +13,20 @@ stderr and kept in the artifact under ``benchmarks.<name>.stages`` so
 
 Usage: python scripts/mwtf_report.py [-n 20000] [--benchmarks mm,crc16]
        [--out artifacts/mwtf_report.json] [--cpu]
+
+Model-sweep mode (``--model-sweep``) is the fault-model degradation
+study: the same protected programs are re-measured under progressively
+harsher FaultModels (multibit k, cluster span/k, burst rate -- see
+coast_tpu.inject.schedule.FaultModel) and the artifact
+(artifacts/faultmodel_study.json) records how each strategy's
+SDC/DUE ("uncorrected") rate degrades as the model hardens, per family,
+with the classifier taxonomy unchanged.  This is the robustness
+measurement the QEMU-era reference could never afford: every cell is a
+fresh seeded campaign, minutes on CPU, seconds on-chip.
+
+Usage: python scripts/mwtf_report.py --model-sweep [--cpu] [-n 4096]
+       [--benchmarks mm] [--models single,multibit:k=2,...]
+       [--out artifacts/faultmodel_study.json]
 """
 
 from __future__ import annotations
@@ -44,19 +58,173 @@ def _runtime_s(prog, reps=20) -> float:
     return (time.perf_counter() - t0) / reps
 
 
+#: Default degradation grid: three families, each swept from mild to
+#: harsh, plus the single-bit baseline every series is anchored on.
+SWEEP_MODELS = ("single",
+                "multibit:k=2", "multibit:k=4", "multibit:k=8",
+                "cluster:span=4,k=2", "cluster:span=4,k=4",
+                "cluster:span=4,k=8",
+                "burst:window=8,rate=0.25", "burst:window=8,rate=0.5",
+                "burst:window=8,rate=1.0")
+
+#: Severity order within a family = more simultaneous upsets.  The
+#: monotonicity check runs over [single] + the family's models in this
+#: order.
+_FAMILY_SEVERITY = {"multibit": lambda m: m.k,
+                    "cluster": lambda m: m.k,
+                    "burst": lambda m: m.sites}
+
+
+def _wilson_half(p: float, n: int, z: float = 1.96) -> float:
+    """Wilson score half-interval for a binomial rate -- unlike the Wald
+    width it stays non-degenerate at p ~ 0, where the degradation series
+    actually lives (small uncorrected rates)."""
+    import math
+    if not n:
+        return 0.0
+    denom = 1 + z * z / n
+    return (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
+
+
+def model_sweep(args) -> int:
+    """--model-sweep: the strategy-degradation study."""
+    import jax
+
+    from coast_tpu import DWC, TMR, unprotected
+    from coast_tpu.inject import classify as cls
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.schedule import FaultModel
+    from coast_tpu.models import REGISTRY
+
+    bench = BENCH_ALIASES.get(args.benchmarks.split(",")[0].strip(),
+                              args.benchmarks.split(",")[0].strip())
+    region = REGISTRY[bench]()
+    # Specs contain commas (cluster:span=4,k=8), so the list separator is
+    # ';' or whitespace, never ','.
+    import re as _re
+    specs = ([s for s in _re.split(r"[;\s]+", args.models.strip()) if s]
+             if args.models else SWEEP_MODELS)
+    try:
+        models = [FaultModel.parse(s) for s in specs]
+    except ValueError as e:
+        print(f"ERROR: bad --models entry: {e}", file=sys.stderr)
+        return 2
+    progs = {"unprotected": unprotected(region), "DWC": DWC(region),
+             "TMR": TMR(region)}
+    report = {
+        "metric": "faultmodel_study",
+        "backend": jax.default_backend(),
+        "benchmark": bench,
+        "n_per_campaign": args.n,
+        "seed": args.seed,
+        # The taxonomy is pinned: a fault model changes what an injection
+        # IS, never what an outcome is called.
+        "classes": list(cls.CLASS_NAMES),
+        "models": [],
+    }
+    cells = {}
+    for model in models:
+        row = {"model": model.spec(), "kind": model.kind,
+               "sites": model.sites, "strategies": {}}
+        for strat, prog in progs.items():
+            runner = CampaignRunner(prog, strategy_name=strat,
+                                    fault_model=model)
+            res = runner.run(args.n, seed=args.seed, batch_size=args.batch)
+            unc = (res.counts["sdc"] + res.due) / res.n
+            cell = {
+                "counts": {k: v for k, v in res.counts.items()},
+                "rates": {
+                    "sdc": round(res.counts["sdc"] / res.n, 6),
+                    "due": round(res.due / res.n, 6),
+                    "corrected": round(res.counts["corrected"] / res.n, 6),
+                    "uncorrected": round(unc, 6),
+                },
+                "injections_per_sec": round(res.injections_per_sec, 2),
+            }
+            row["strategies"][strat] = cell
+            cells[(model.spec(), strat)] = cell
+            print(f"# {bench} {strat:<12} {model.spec():<26} "
+                  f"uncorrected={unc:.4f} sdc={cell['rates']['sdc']:.4f} "
+                  f"due={cell['rates']['due']:.4f}",
+                  file=sys.stderr, flush=True)
+        report["models"].append(row)
+
+    # Degradation series: per strategy x family, anchored on single.
+    single_spec = FaultModel.single().spec()
+    degradation = {}
+    for strat in progs:
+        strat_block = {}
+        for family, sev in _FAMILY_SEVERITY.items():
+            fam = sorted((m for m in models if m.kind == family), key=sev)
+            if not fam or (single_spec, strat) not in cells:
+                continue
+            series = [{"model": single_spec, "sites": 1,
+                       **cells[(single_spec, strat)]["rates"]}]
+            series += [{"model": m.spec(), "sites": m.sites,
+                        **cells[(m.spec(), strat)]["rates"]}
+                       for m in fam]
+            uncs = [s["uncorrected"] for s in series]
+            # Monotone within sampling noise: a step may dip by at most
+            # one Wilson half-interval of the larger neighbour.
+            tol = [_wilson_half(max(a, b), args.n)
+                   for a, b in zip(uncs, uncs[1:])]
+            strat_block[family] = {
+                "series": series,
+                "monotone_uncorrected": all(
+                    b >= a - t for a, b, t in zip(uncs, uncs[1:], tol)),
+                "strictly_nondecreasing": all(
+                    b >= a for a, b in zip(uncs, uncs[1:])),
+                "degradation_x": round(uncs[-1] / uncs[0], 3)
+                if uncs[0] > 0 else None,
+            }
+        degradation[strat] = strat_block
+    report["degradation"] = degradation
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+    print(json.dumps({s: {f: {"monotone": d["monotone_uncorrected"],
+                              "degradation_x": d["degradation_x"]}
+                          for f, d in fams.items()}
+                      for s, fams in degradation.items()}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("-n", type=int, default=20_000,
-                    help="injections per campaign")
+    ap.add_argument("-n", type=int, default=None,
+                    help="injections per campaign (default 20000; 4096 "
+                    "under --model-sweep)")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--benchmarks", default="mm,crc16,quicksort")
-    ap.add_argument("--out", default="artifacts/mwtf_report.json")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default artifacts/"
+                    "mwtf_report.json; artifacts/faultmodel_study.json "
+                    "under --model-sweep)")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--model-sweep", action="store_true",
+                    help="fault-model degradation study instead of the "
+                    "MWTF table: sweep --models over the FIRST benchmark "
+                    "of --benchmarks x {unprotected, DWC, TMR} and record "
+                    "artifacts/faultmodel_study.json")
+    ap.add_argument("--models", default=None,
+                    help="semicolon- or space-separated FaultModel specs "
+                    "for --model-sweep, e.g. 'single;cluster:span=4,k=8' "
+                    "(specs contain commas; default: the three-family "
+                    "grid)")
+    ap.add_argument("--seed", type=int, default=2026)
     args = ap.parse_args(argv)
 
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+
+    if args.model_sweep:
+        args.out = args.out or "artifacts/faultmodel_study.json"
+        args.n = args.n or 4096
+        return model_sweep(args)
+    args.out = args.out or "artifacts/mwtf_report.json"
+    args.n = args.n or 20_000
 
     from coast_tpu import DWC, TMR, unprotected
     from coast_tpu.analysis.json_parser import Summary, compare_runs

@@ -1,0 +1,478 @@
+"""Fault-model tests: multi-bit / cluster / burst flip groups.
+
+Pins the three guarantees the generalized injector makes:
+
+* **Legacy byte-parity** -- ``FaultModel.single`` schedules are
+  bit-identical to the historical ``generate``/``generate_stratified``
+  streams (sha-pinned against the pre-model tree), campaigns classify
+  identically, and the ndjson logs are byte-for-byte unchanged (no new
+  summary keys on the single path).
+* **Native/numpy expansion parity** -- the multi-draw splitmix expansion
+  (coast_fault_expand) and its numpy fallback produce identical extra-site
+  streams for every model kind (the FuzzyFlow differential-testing idiom,
+  arXiv:2306.16178, applied to the injector itself).
+* **Model is campaign identity** -- journal resume under a different
+  model is refused with the typed FaultModelMismatchError; resume under
+  the same model replays bit-for-bit.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import CampaignRunner, _merge_results
+from coast_tpu.inject.journal import (FaultModelMismatchError,
+                                      JournalMismatchError)
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import (FaultModel, FaultSchedule, generate,
+                                       generate_stratified,
+                                       generate_stratified_total)
+from coast_tpu.models import mm
+from coast_tpu.native import fault_expand
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def tmr_runner(region):
+    return CampaignRunner(TMR(region))
+
+
+def _sha(sched):
+    h = hashlib.sha256()
+    for f in ("leaf_id", "lane", "word", "bit", "t"):
+        h.update(np.ascontiguousarray(getattr(sched, f),
+                                      np.int32).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# FaultModel descriptor
+# ---------------------------------------------------------------------------
+
+def test_model_parse_spec_roundtrip():
+    for text, spec, sites in [
+            ("single", "single", 1),
+            ("multibit(k=4)", "multibit(k=4)", 4),
+            ("multibit:k=4", "multibit(k=4)", 4),
+            ("multibit", "multibit(k=2)", 2),
+            ("cluster(span=8,k=3)", "cluster(span=8,k=3)", 3),
+            ("burst(window=8,rate=0.5)", "burst(window=8,rate=0.5)", 4),
+            ("burst:window=4,rate=2", "burst(window=4,rate=2)", 8),
+    ]:
+        m = FaultModel.parse(text)
+        assert m.spec() == spec
+        assert m.sites == sites
+        assert FaultModel.parse(m.spec()).spec() == spec  # canonical fixpoint
+
+
+def test_model_validation():
+    with pytest.raises(ValueError):
+        FaultModel.parse("multibit(k=1)")      # < 2 bits is not an MBU
+    with pytest.raises(ValueError):
+        FaultModel.parse("multibit(k=40)")     # one 32-bit word
+    with pytest.raises(ValueError):
+        FaultModel.parse("burst(window=0,rate=1)")
+    with pytest.raises(ValueError):
+        FaultModel.parse("meteor(k=2)")
+    with pytest.raises(ValueError):
+        FaultModel.parse("single(k=2)")
+
+
+# ---------------------------------------------------------------------------
+# Legacy single-bit byte-parity (the differential regression)
+# ---------------------------------------------------------------------------
+
+# sha256 over the (leaf_id, lane, word, bit, t) int32 columns of the mm-TMR
+# map, verified IDENTICAL on the pre-fault-model tree (git stash): any drift
+# in the base splitmix stream or the decode breaks replayability of every
+# recorded campaign.
+_PINNED_GENERATE_SHA = \
+    "bcef718c261368c4b1637a549900a0263e45b4dbc5bbaf9a95991f4efff4865f"
+_PINNED_STRATIFIED_SHA = \
+    "c9e10e492fda47017be171c9cfd3803965a61824f979fb2e24be00a91d6e3e7a"
+
+
+def test_single_stream_pinned(region, tmr_runner):
+    mmap = tmr_runner.mmap
+    assert _sha(generate(mmap, 64, 0, region.nominal_steps)) \
+        == _PINNED_GENERATE_SHA
+    assert _sha(generate_stratified(mmap, 8, 0, region.nominal_steps)) \
+        == _PINNED_STRATIFIED_SHA
+    # The explicit single model is the same stream, same layout.
+    explicit = generate(mmap, 64, 0, region.nominal_steps,
+                        model=FaultModel.single())
+    assert _sha(explicit) == _PINNED_GENERATE_SHA
+    assert explicit.extra is None and explicit.sites == 1
+    assert all(v.ndim == 1 for v in explicit.device_arrays().values())
+
+
+def test_multi_model_base_sites_are_the_single_stream(region, tmr_runner):
+    """The base site of every flip group IS the legacy stream: the
+    single-bit component of any model replays the legacy campaign."""
+    mmap = tmr_runner.mmap
+    m = generate(mmap, 64, 0, region.nominal_steps,
+                 model=FaultModel.cluster(span=4, k=3))
+    assert _sha(m) == _PINNED_GENERATE_SHA
+    assert m.extra is not None and len(m.extra["group"]) == 64 * 2
+
+
+def test_single_campaign_codes_and_ndjson_bytes_identical(
+        region, tmr_runner, tmp_path, monkeypatch):
+    from coast_tpu.inject import logs
+    explicit = CampaignRunner(TMR(region),
+                              fault_model=FaultModel.single())
+    a = tmr_runner.run(128, seed=7, batch_size=64)
+    b = explicit.run(128, seed=7, batch_size=64)
+    assert np.array_equal(a.codes, b.codes)
+    assert "fault_model" not in a.summary()
+    assert "fault_model" not in b.summary()
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    logs.write_ndjson(a, tmr_runner.mmap, str(tmp_path / "a.json"))
+    logs.write_ndjson(b, explicit.mmap, str(tmp_path / "b.json"))
+    head_a, *rows_a = (tmp_path / "a.json").read_bytes().splitlines()
+    head_b, *rows_b = (tmp_path / "b.json").read_bytes().splitlines()
+    # Row bytes identical; the summary line identical up to wall clock.
+    assert rows_a == rows_b
+    volatile = ("seconds", "injections_per_sec", "stages")
+    strip = lambda h: {k: v for k, v in                    # noqa: E731
+                       json.loads(h)["summary"].items() if k not in volatile}
+    assert strip(head_a) == strip(head_b)
+    assert b"fault_model" not in head_a + head_b
+
+
+# ---------------------------------------------------------------------------
+# Native vs numpy expansion parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", [
+    FaultModel.multibit(k=4),
+    FaultModel.cluster(span=4, k=3),
+    FaultModel.cluster(span=64, k=8),
+    FaultModel.burst(window=8, rate=0.5),
+])
+def test_expand_native_numpy_parity(region, tmr_runner, model):
+    from coast_tpu import native
+    if not native.native_available():
+        pytest.skip("native core not built on this host")
+    mmap = tmr_runner.mmap
+    base_sched = generate(mmap, 333, 17, region.nominal_steps)
+    base = {k: getattr(base_sched, k)
+            for k in ("leaf_id", "lane", "word", "bit", "t", "section_idx")}
+    tables = mmap.section_tables()
+    args = (17, model.kind, model.sites, model.span, model.window,
+            region.nominal_steps, base, tables)
+    nat = fault_expand(*args)
+    py = fault_expand(*args, force_python=True)
+    for x, y, name in zip(nat, py,
+                          ("group", "leaf_id", "lane", "word", "bit", "t")):
+        assert np.array_equal(x, y), f"{model.spec()}: {name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# Expansion semantics per kind
+# ---------------------------------------------------------------------------
+
+def _by_site(sched):
+    """Device arrays reshaped to [n, sites] per key."""
+    return sched.device_arrays()
+
+
+def test_multibit_semantics(region, tmr_runner):
+    k = 4
+    s = generate(tmr_runner.mmap, 200, 5, region.nominal_steps,
+                 model=FaultModel.multibit(k=k))
+    da = _by_site(s)
+    assert da["bit"].shape == (200, k)
+    # same word/lane/leaf/step across the group; k DISTINCT bits
+    for key in ("leaf_id", "lane", "word", "t"):
+        assert (da[key] == da[key][:, :1]).all()
+    assert ((0 <= da["bit"]) & (da["bit"] < 32)).all()
+    for row in da["bit"]:
+        assert len(set(row.tolist())) == k
+
+
+def test_cluster_semantics(region, tmr_runner):
+    span, k = 4, 3
+    s = generate(tmr_runner.mmap, 300, 5, region.nominal_steps,
+                 model=FaultModel.cluster(span=span, k=k))
+    da = _by_site(s)
+    secs = {sec.leaf_id: sec for sec in tmr_runner.mmap.sections}
+    assert (da["leaf_id"] == da["leaf_id"][:, :1]).all()   # same leaf
+    assert (da["t"] == da["t"][:, :1]).all()               # same step
+    crossed = 0
+    for i in range(len(s)):
+        sec = secs[int(da["leaf_id"][i, 0])]
+        phys0 = int(da["lane"][i, 0]) * sec.words + int(da["word"][i, 0])
+        lw = sec.lanes * sec.words
+        for j in range(1, k):
+            assert 0 <= da["lane"][i, j] < sec.lanes
+            assert 0 <= da["word"][i, j] < sec.words
+            phys = int(da["lane"][i, j]) * sec.words + int(da["word"][i, j])
+            off = (phys - phys0) % lw
+            if lw > span:
+                assert 1 <= off <= span                    # adjacency
+            else:
+                assert off < lw     # tiny leaf: offsets wrap the whole leaf
+            crossed += int(da["lane"][i, j] != da["lane"][i, 0])
+    # the lane-crossing channel exists (physically-adjacent replicas)
+    assert crossed > 0
+
+
+def test_burst_semantics(region, tmr_runner):
+    window = 8
+    m = FaultModel.burst(window=window, rate=0.5)
+    s = generate(tmr_runner.mmap, 300, 5, region.nominal_steps, model=m)
+    da = _by_site(s)
+    assert da["t"].shape[1] == m.sites == 4
+    secs = {sec.leaf_id: sec for sec in tmr_runner.mmap.sections}
+    t0 = da["t"][:, 0]
+    for j in range(1, m.sites):
+        dt = da["t"][:, j] - t0
+        assert (dt >= 0).all()
+        assert (da["t"][:, j] <= min(region.nominal_steps - 1,
+                                     int(t0.max()) + window - 1)).all()
+        assert (dt < window).all() | (da["t"][:, j]
+                                      == region.nominal_steps - 1).all()
+        for i in range(len(s)):
+            sec = secs[int(da["leaf_id"][i, j])]
+            assert 0 <= da["lane"][i, j] < sec.lanes
+            assert 0 <= da["word"][i, j] < sec.words
+
+
+# ---------------------------------------------------------------------------
+# Engine semantics: flip groups through the protected step
+# ---------------------------------------------------------------------------
+
+def test_tmr_votes_away_intra_lane_group_but_not_cross_lane(region):
+    """Deterministic adversarial pair: k flips inside ONE replica are
+    voted away exactly like a single flip, but the SAME word corrupted
+    identically in TWO replicas outvotes the clean lane -- the failure
+    mode only a correlated multi-site model can measure."""
+    import jax
+    prog = TMR(region)
+    runner = CampaignRunner(prog)
+    sec = runner.mmap.by_name("second")   # input matrix: live all run
+    assert sec.lanes == 3
+
+    def run_group(lanes, bits):
+        n_sites = len(lanes)
+        fault = {"leaf_id": np.full(n_sites, sec.leaf_id, np.int32),
+                 "word": np.zeros(n_sites, np.int32),
+                 "t": np.ones(n_sites, np.int32),
+                 "lane": np.array(lanes, np.int32),
+                 "bit": np.array(bits, np.int32)}
+        rec = jax.jit(prog.run)(fault)
+        return cls.classify(rec, 10_000)
+
+    # two distinct bits of lane 0's word: repaired like a single flip
+    intra = int(run_group([0, 0], [3, 7]))
+    # identical corruption in lanes 0 and 1: majority is now wrong
+    cross = int(run_group([0, 1], [3, 3]))
+    assert intra in (cls.SUCCESS, cls.CORRECTED)
+    assert cross not in (cls.SUCCESS, cls.CORRECTED)
+
+
+@pytest.mark.parametrize("spec", ["multibit(k=4)", "cluster(span=4,k=3)",
+                                  "burst(window=8,rate=0.5)"])
+def test_campaign_taxonomy_unchanged(region, tmr_runner, spec):
+    runner = CampaignRunner(TMR(region),
+                            fault_model=FaultModel.parse(spec))
+    res = runner.run(128, seed=7, batch_size=64)
+    baseline = tmr_runner.run(128, seed=7, batch_size=64)
+    # same class vocabulary, same bucket keys -- the taxonomy is pinned
+    assert set(res.counts) == set(baseline.counts)
+    assert res.summary()["fault_model"] == spec
+    assert ((res.codes >= 0) & (res.codes < cls.NUM_CLASSES)).all()
+
+
+def test_schedule_slice_and_merge_rebase_groups(region, tmr_runner):
+    m = FaultModel.cluster(span=4, k=3)
+    s = generate(tmr_runner.mmap, 60, 3, region.nominal_steps, model=m)
+    sl = s.slice(20, 50)
+    assert len(sl) == 30 and len(sl.extra["group"]) == 60
+    assert sl.extra["group"].min() == 0 and sl.extra["group"].max() == 29
+    np.testing.assert_array_equal(sl.device_arrays()["word"],
+                                  s.device_arrays()["word"][20:50])
+
+
+def test_until_errors_replay_with_model(region):
+    runner = CampaignRunner(TMR(region),
+                            fault_model=FaultModel.burst(window=8, rate=0.5))
+    res = runner.run_until_errors(2, seed=11, batch_size=64, round_to=64,
+                                  max_n=512)
+    assert res.schedule.extra is not None
+    g = res.schedule.extra["group"]
+    assert len(g) == res.n * (res.schedule.sites - 1)
+    assert g.max() == res.n - 1                      # rebased group ids
+    replay = runner.replay_chunks(res.chunks, batch_size=64)
+    assert np.array_equal(replay.codes, res.codes)
+
+
+# ---------------------------------------------------------------------------
+# Journal: model identity + typed refusal + bit-for-bit resume
+# ---------------------------------------------------------------------------
+
+def _crash_after(runner, n_batches):
+    orig = runner._collect
+    state = {"n": 0}
+
+    def bomb(pending):
+        state["n"] += 1
+        if state["n"] > n_batches:
+            raise RuntimeError("simulated crash")
+        return orig(pending)
+    runner._collect = bomb
+
+
+def test_journal_resume_multibit_bit_for_bit(region, tmp_path):
+    m = FaultModel.multibit(k=4)
+    path = str(tmp_path / "j.ndjson")
+    full = CampaignRunner(TMR(region), fault_model=m).run(
+        192, seed=3, batch_size=64)
+    crasher = CampaignRunner(TMR(region), fault_model=m)
+    _crash_after(crasher, 2)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        crasher.run(192, seed=3, batch_size=64, journal=path)
+    resumed = CampaignRunner(TMR(region), fault_model=m).run(
+        192, seed=3, batch_size=64, journal=path)
+    assert np.array_equal(resumed.codes, full.codes)
+    assert resumed.counts == full.counts
+
+
+def test_journal_model_mismatch_typed(region, tmp_path):
+    path = str(tmp_path / "j.ndjson")
+    m = FaultModel.cluster(span=4, k=3)
+    CampaignRunner(TMR(region), fault_model=m).run(
+        64, seed=3, batch_size=64, journal=path)
+    # different model -> the TYPED error, naming both models
+    with pytest.raises(FaultModelMismatchError) as ei:
+        CampaignRunner(TMR(region),
+                       fault_model=FaultModel.multibit(k=4)).run(
+            64, seed=3, batch_size=64, journal=path)
+    assert "cluster(span=4,k=3)" in str(ei.value)
+    assert "multibit(k=4)" in str(ei.value)
+    # and single-model resume of a model journal is refused too
+    with pytest.raises(FaultModelMismatchError):
+        CampaignRunner(TMR(region)).run(64, seed=3, batch_size=64,
+                                        journal=path)
+    # FaultModelMismatchError IS a JournalMismatchError (existing
+    # except-clauses keep working)
+    assert issubclass(FaultModelMismatchError, JournalMismatchError)
+
+
+def test_run_schedule_refuses_journal_model_drift(region, tmp_path):
+    """The journal header must name the SCHEDULE's model even when the
+    schedule was generated externally: a single-model runner handed a
+    multi-site schedule plus a journal it opened itself would otherwise
+    record 'single' in the header and poison every later resume."""
+    from coast_tpu.inject.journal import CampaignJournal
+    runner = CampaignRunner(TMR(region))          # fault_model = single
+    sched = generate(runner.mmap, 64, 3, region.nominal_steps,
+                     model=FaultModel.cluster(span=4, k=3))
+    path = str(tmp_path / "drift.ndjson")
+    j = CampaignJournal.open(path, runner._journal_header("schedule"))
+    with pytest.raises(FaultModelMismatchError, match="cluster"):
+        runner.run_schedule(sched, batch_size=64, journal=j)
+    j.close()
+
+
+def test_journal_single_header_unchanged(region, tmp_path):
+    """Single-bit journals never carry the fault_model key, so journals
+    written before the model existed resume under the new code."""
+    path = str(tmp_path / "j.ndjson")
+    CampaignRunner(TMR(region)).run(64, seed=3, batch_size=64, journal=path)
+    with open(path) as fh:
+        header = json.loads(fh.readline())
+    assert "fault_model" not in header
+    res = CampaignRunner(TMR(region)).run(64, seed=3, batch_size=64,
+                                          journal=path)
+    assert res.n == 64
+
+
+# ---------------------------------------------------------------------------
+# Satellites
+# ---------------------------------------------------------------------------
+
+def test_stratified_total_drift_warning(region, tmr_runner, capsys):
+    mmap = tmr_runner.mmap
+    n_sec = len(mmap.sections)
+    # exact multiple: silent
+    generate_stratified_total(mmap, 4 * n_sec, 0, region.nominal_steps)
+    assert "warning" not in capsys.readouterr().err
+    # budget below the section floor: realized = n_sec >> 10% off
+    sched = generate_stratified_total(mmap, max(2, n_sec // 2), 0,
+                                      region.nominal_steps)
+    assert len(sched) == n_sec
+    err = capsys.readouterr().err
+    assert "stratified budget" in err and "off the" in err
+
+
+def test_parser_fault_model_axis(region, tmp_path):
+    from coast_tpu.analysis.json_parser import summarize_path
+    from coast_tpu.inject import logs
+    runner = CampaignRunner(TMR(region),
+                            fault_model=FaultModel.multibit(k=4))
+    res = runner.run(96, seed=7, batch_size=48)
+    path = str(tmp_path / "multi.json")
+    logs.write_ndjson(res, runner.mmap, path)
+    summ = summarize_path(path)
+    assert summ.fault_model == "multibit(k=4)"
+    assert "fault model" in summ.format()
+    assert summ.n == 96
+    # single campaigns parse with no model axis
+    base = CampaignRunner(TMR(region)).run(96, seed=7, batch_size=48)
+    path2 = str(tmp_path / "single.json")
+    logs.write_ndjson(base, runner.mmap, path2)
+    assert summarize_path(path2).fault_model is None
+
+
+def test_sharded_mesh_multi_site_parity(region):
+    """[n, sites] fault arrays through shard_map: the sharded backend
+    must classify a multi-site campaign identically to single-device
+    (the P(axes) spec shards the batch axis only; the sites axis rides
+    along replicated)."""
+    from coast_tpu.parallel.mesh import make_mesh
+    m = FaultModel.burst(window=8, rate=0.5)
+    single_dev = CampaignRunner(TMR(region), fault_model=m).run(
+        128, seed=7, batch_size=64)
+    sharded = CampaignRunner(TMR(region), fault_model=m,
+                             mesh=make_mesh(4)).run(
+        128, seed=7, batch_size=64)
+    assert np.array_equal(single_dev.codes, sharded.codes)
+    assert sharded.counts == single_dev.counts
+
+
+def test_supervisor_cli_fault_model_flag():
+    from coast_tpu.inject.supervisor import parse_command_line
+    args = parse_command_line(["-f", "matrixMultiply", "-t", "10",
+                               "--fault-model", "multibit:k=3"])
+    assert args.fault_model_parsed.spec() == "multibit(k=3)"
+    args = parse_command_line(["-f", "matrixMultiply", "-t", "10"])
+    assert args.fault_model_parsed is None
+    # bad spec and unsupported paths exit with an error, reference-style
+    with pytest.raises(SystemExit):
+        parse_command_line(["-f", "matrixMultiply", "-t", "10",
+                            "--fault-model", "meteor"])
+    with pytest.raises(SystemExit):
+        parse_command_line(["-f", "matrixMultiply", "-t", "10", "-s",
+                            "dcache", "--fault-model", "multibit:k=3"])
+
+
+def test_merge_results_concatenates_extras(region, tmr_runner):
+    m = FaultModel.multibit(k=2)
+    runner = CampaignRunner(TMR(region), fault_model=m)
+    a = runner.run(32, seed=1, batch_size=32)
+    b = runner.run(32, seed=2, batch_size=32)
+    merged = _merge_results([a, b], seed=1)
+    assert merged.n == 64
+    g = merged.schedule.extra["group"]
+    assert len(g) == 64 and g.max() == 63 and g[32] == 32

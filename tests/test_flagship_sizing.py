@@ -55,3 +55,18 @@ def test_scales_with_memory(region):
     b16, _ = analytic_batch(region, lanes=3, device=_Dev(16 * 2**30))
     b32, _ = analytic_batch(region, lanes=3, device=_Dev(32 * 2**30))
     assert b32 == 2 * b16
+
+
+def test_multi_site_models_shrink_the_batch(region):
+    """A multi-site FaultModel hoists one flip mask per SITE: the analytic
+    row cost grows from state x lanes x 2 to state x lanes x (1 + sites),
+    so a multibit/cluster campaign must not inherit the single-bit batch
+    and OOM past the estimate."""
+    b1, info1 = analytic_batch(region, lanes=3, device=_Dev(16 * 2**30))
+    b4, info4 = analytic_batch(region, lanes=3, device=_Dev(16 * 2**30),
+                               sites=4)
+    assert info1["bytes_per_row"] == 2 * 3 * region.meta["state_bytes"]
+    assert info4["bytes_per_row"] == 5 * 3 * region.meta["state_bytes"]
+    assert info4["fault_sites"] == 4
+    assert b4 < b1
+    assert b4 * info4["bytes_per_row"] <= 16 * 2**30
