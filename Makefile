@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -84,6 +84,12 @@ stream_smoke:
 # multi-site resume with typed model-mismatch refusal.
 faultmodel_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.faultmodel_smoke
+
+# Equivalence smoke (also a fast.yml driver row): reduced-vs-exhaustive
+# distribution parity on seeded TMR/DWC targets, journaled equiv resume
+# with typed partition-mismatch refusal, no-op delta re-injects zero.
+equiv_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.equiv_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

@@ -1,0 +1,37 @@
+"""Fault-site equivalence analysis: inject one representative per
+propagation class.
+
+FastFlip (arXiv:2403.13989) observes that fault-injection cost collapses
+when identical fault sites with identical downstream dataflow are proven
+equivalent statically and injected once.  This package extends the lint
+provenance machinery (analysis/lint/provenance.py) from *finding
+protection bugs* to *pruning the campaign space*:
+
+  * :mod:`partition` -- the static pass: walk the protected step's jaxpr
+    with the existing ``_Walker`` lattice, derive a per-section
+    propagation signature, and partition the fault-site space
+    (leaf x lane x word x bit x step) into equivalence classes whose
+    members provably classify identically.
+  * :mod:`delta` -- delta campaigns: per-section fingerprints persisted
+    in the campaign journal header let a later run re-inject only the
+    sections whose propagation changed, splicing prior results for the
+    rest.
+
+Validation contract (FuzzyFlow, arXiv:2306.16178): the equivalence-
+reduced campaign's classification distribution must equal the exhaustive
+one's exactly -- pinned by tests/test_equiv.py and recorded in
+``artifacts/equiv_study.json``.
+"""
+
+from __future__ import annotations
+
+from coast_tpu.analysis.equiv.partition import (EquivPartition,
+                                                SectionSignature,
+                                                analyze_equivalence,
+                                                section_fingerprints)
+from coast_tpu.analysis.equiv.delta import (DeltaMismatchError, DeltaPlan,
+                                            load_delta_base, plan_delta)
+
+__all__ = ["EquivPartition", "SectionSignature", "analyze_equivalence",
+           "section_fingerprints", "DeltaMismatchError", "DeltaPlan",
+           "load_delta_base", "plan_delta"]

@@ -1,0 +1,611 @@
+"""Propagation-equivalence partition over the fault-site space.
+
+A seeded campaign draws sites ``(leaf, lane, word, bit, t)`` uniformly
+over the injectable bits; most draws are redundant -- they land in state
+whose downstream dataflow provably carries any single-bit corruption to
+the same classification.  This pass walks the protected step's jaxpr
+with the lint provenance lattice (:class:`analysis.lint.provenance
+._Walker`) and derives, per memory-map section, a *merge mode*: which
+site coordinates provably cannot change the outcome class.
+
+The soundness arguments are the engine's own invariants
+(passes/dataflow_protection.py); each mode names the coordinates that
+remain in the class key:
+
+  * ``FREE`` (class = leaf) -- the flip cannot interact with the step's
+    trajectory at all.  Two shapes qualify: an unconsumed shared leaf
+    whose only use is an equality-compare cone in ``check()`` (the
+    ``golden`` matrix: any flipped bit turns exactly one compare, E
+    becomes 1, SDC regardless of lane/word/bit/t); and an unconsumed,
+    unwritten replicated leaf (divergence sits untouched until the
+    region-boundary sync detects it).
+  * ``LT`` (class = leaf x t) -- a replicated leaf that is either
+    pre-step voted before any consumption (the ``load_addr`` sync:
+    the flip is repaired/latched before the step reads it) or never
+    written by the step (the flipped lane survives verbatim in the leaf
+    itself, so the region-boundary sync is a guaranteed witness; which
+    *other* state the corruption reached on the way does not change the
+    class -- TMR corrects, DWC aborts).
+  * ``LTW`` (class = leaf x t x word) -- a written replicated leaf whose
+    value flows ONLY through structural primitives (selects, slices,
+    dynamic-update-slices, reshapes) between its flip and either a
+    sanctioned vote input or the leaf commit.  Words travel verbatim, so
+    the flip is either overwritten this step (masked -> the clean-run
+    outcome) or survives word-for-word to a voter/the boundary
+    (detected); which of the two is a deterministic function of
+    ``(t, word)`` because the structural routing follows the fault-free
+    trajectory.  Bit and lane cannot matter: compares see any bit, and
+    the routing is lane-uniform.
+  * ``EXH`` (class = the site itself) -- no merge.  Applied to every
+    value-fed leaf (its flipped value enters arithmetic that can mask
+    bits -- the crc shift-out case), to shared consumed leaves, to any
+    leaf implicated in a live single-lane extraction, and to every
+    replicated leaf when the region carries per-lane guards, CFCSS, or
+    single-lane function scopes (those read raw lane values, so
+    detection is value-dependent).
+
+Additionally every site whose ``t`` lies at or past the fault-free halt
+step joins one global ``dead`` class: the run is already halted when the
+flip would fire, so it provably never fires (SUCCESS).
+
+The partition is *validated differentially* (FuzzyFlow's idiom): the
+reduced campaign's weighted classification distribution must equal the
+exhaustive one's exactly -- tests/test_equiv.py pins it on seeded TMR
+and DWC targets, scripts/equiv_study.py records it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, FrozenSet, List, Optional, Set
+
+import jax
+import numpy as np
+
+from coast_tpu.analysis.lint.provenance import (_Val, _Walker, _live_eqns,
+                                                trace_step)
+from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
+
+# Merge modes, coarsest first.  The class key keeps only the coordinates
+# the mode names; everything else is proven outcome-irrelevant.
+MODE_FREE = 0      # class = (leaf,)
+MODE_LT = 1        # class = (leaf, t)
+MODE_LTW = 2       # class = (leaf, t, word)
+MODE_EXH = 3       # class = (leaf, t, word, bit, lane) -- no merge
+
+MODE_NAMES = ("free", "lt", "ltw", "exhaustive")
+
+# Primitives that move words verbatim: a flipped word passes through
+# them unchanged (or is dropped), never arithmetically transformed.
+# Operand positions listed in _VALUE_OPERANDS are *steering* inputs
+# (predicates, indices): a flipped value there changes WHICH words move,
+# which is value-dependent -- consuming a tainted steering operand marks
+# the leaf value-fed.
+_STRUCTURAL_PRIMS = frozenset({
+    "select_n", "dynamic_update_slice", "dynamic_slice", "slice",
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "concatenate",
+    "rev", "copy", "gather", "scatter", "pad", "stop_gradient",
+})
+
+_VALUE_OPERANDS = {
+    "select_n": lambda eqn: (0,),
+    "dynamic_slice": lambda eqn: tuple(range(1, len(eqn.invars))),
+    "dynamic_update_slice": lambda eqn: tuple(range(2, len(eqn.invars))),
+    "gather": lambda eqn: (1,),
+    "scatter": lambda eqn: (1,),
+    "pad": lambda eqn: (),
+}
+
+# Sync classes whose tag marks a *detector* on the tagged value: taint
+# entering one is guaranteed either masked (lanes equal) or latched/
+# repaired there, so it stops propagating.  'guard' is deliberately NOT
+# in this set -- kernel guards read raw per-lane values and trip
+# value-dependently, so their consumption must count as value-feeding.
+_DETECTOR_CLASSES = frozenset({
+    "load_addr", "store_data", "ctrl", "stack", "sor_crossing",
+    "boundary", "call_boundary", "cfcss",
+})
+
+
+def _detector_tag(tag: str) -> bool:
+    if tag.startswith(TAG_VOTER) and not tag.startswith(TAG_VIEW):
+        return True
+    if tag.startswith(TAG_SYNC):
+        klass = tag[len(TAG_SYNC):].partition(":")[0]
+        return klass in _DETECTOR_CLASSES
+    return False
+
+
+class _TaintWalk:
+    """Forward word-verbatim taint over a (nested) jaxpr.
+
+    ``env[var]`` is the frozenset of leaf names whose unmodified words
+    may be present in ``var``.  Taint passes through structural
+    primitives, dies at detector tags (sanctioned votes), and marks a
+    leaf ``value_fed`` wherever a live equation consumes its taint
+    non-structurally (arithmetic, reductions, steering operands, guard
+    inputs).
+    """
+
+    def __init__(self, live: Optional[Set[int]]):
+        self.env: Dict[object, FrozenSet[str]] = {}
+        self.value_fed: Set[str] = set()
+        self.live = live
+
+    def val(self, v) -> FrozenSet[str]:
+        from jax.extend.core import Literal
+        if isinstance(v, Literal):
+            return frozenset()
+        return self.env.get(v, frozenset())
+
+    def _set(self, v, taint: FrozenSet[str]) -> None:
+        old = self.env.get(v)
+        self.env[v] = taint if old is None else (old | taint)
+
+    def seed(self, inner_vars, taints) -> None:
+        for iv, t in zip(inner_vars, taints):
+            self._set(iv, t)
+
+    def _is_live(self, eqn) -> bool:
+        return self.live is None or id(eqn) in self.live
+
+    def _feed(self, eqn, taint: FrozenSet[str]) -> None:
+        if taint and self._is_live(eqn):
+            self.value_fed |= taint
+
+    def walk(self, jaxpr) -> List[FrozenSet[str]]:
+        for eqn in jaxpr.eqns:
+            ins = [self.val(v) for v in eqn.invars]
+            outs = self._eqn_outs(eqn, ins)
+            for v, t in zip(eqn.outvars, outs):
+                self._set(v, t)
+        return [self.val(v) for v in jaxpr.outvars]
+
+    def _eqn_outs(self, eqn, ins):
+        prim = eqn.primitive.name
+        params = eqn.params
+        union = frozenset().union(*ins) if ins else frozenset()
+
+        if prim == "name":
+            tag = str(params.get("name", ""))
+            if _detector_tag(tag):
+                return [frozenset()]
+            if tag.startswith(TAG_SPOF):
+                # Single-lane call boundary: the callee sees raw lane-0
+                # values -- value consumption by definition.
+                self._feed(eqn, union)
+                return [frozenset()]
+            return [ins[0] if ins else frozenset()]
+
+        if prim in _STRUCTURAL_PRIMS:
+            value_pos = _VALUE_OPERANDS.get(prim, lambda e: ())(eqn)
+            data = frozenset()
+            for i, t in enumerate(ins):
+                if i in value_pos:
+                    self._feed(eqn, t)
+                else:
+                    data |= t
+            return [data for _ in eqn.outvars]
+
+        # -- control flow / nested jaxprs --
+        if prim == "cond" and "branches" in params:
+            self._feed(eqn, ins[0])
+            per_branch = []
+            for br in params["branches"]:
+                self.seed(br.jaxpr.invars, ins[1:])
+                per_branch.append(self.walk(br.jaxpr))
+            outs = []
+            for i in range(len(eqn.outvars)):
+                o = frozenset()
+                for b in per_branch:
+                    o |= b[i]
+                outs.append(o)
+            return outs
+        if prim == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            cj, bj = params["cond_jaxpr"].jaxpr, params["body_jaxpr"].jaxpr
+            carry = list(ins[cn + bn:])
+            for _ in range(len(carry) + 2):
+                self.seed(cj.invars, ins[:cn] + carry)
+                cond_out = self.walk(cj)
+                self._feed(eqn, cond_out[0] if cond_out else frozenset())
+                self.seed(bj.invars, ins[cn:cn + bn] + carry)
+                new_carry = self.walk(bj)
+                joined = [c | nc for c, nc in zip(carry, new_carry)]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry
+        if prim == "scan":
+            sub = params["jaxpr"].jaxpr
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts, carry = list(ins[:nc]), list(ins[nc:nc + ncar])
+            xs = list(ins[nc + ncar:])
+            outs = None
+            for _ in range(max(ncar, 1) + 2):
+                self.seed(sub.invars, consts + carry + xs)
+                outs = self.walk(sub)
+                joined = [c | nc_ for c, nc_ in zip(carry, outs[:ncar])]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry + list(outs[ncar:])
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in params:
+                sub = params[key]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                self.seed(sub.invars, ins)
+                return self.walk(sub)
+
+        # Any other primitive transforms values: tainted inputs are
+        # value-fed, outputs carry no verbatim words.
+        self._feed(eqn, union)
+        return [frozenset() for _ in eqn.outvars]
+
+
+@dataclasses.dataclass(frozen=True)
+class SectionSignature:
+    """One memory-map section's propagation signature."""
+
+    name: str
+    kind: str
+    leaf_id: int
+    lanes: int
+    words: int
+    replicated: bool
+    written: bool
+    consumed: bool
+    value_fed: bool
+    pre_voted: bool
+    step_voted: bool
+    mode: int                  # MODE_* merge decision
+    fingerprint: str           # sha256 over signature + dataflow cone
+
+    @property
+    def mode_name(self) -> str:
+        return MODE_NAMES[self.mode]
+
+
+@dataclasses.dataclass
+class EquivPartition:
+    """The derived partition: per-section signatures + the site
+    classifier the injection stack consumes."""
+
+    benchmark: str
+    num_clones: int
+    clean_steps: int
+    signatures: Dict[str, SectionSignature]
+    fingerprint: str           # sha over all section fps + clean_steps
+
+    def _mode_table(self) -> np.ndarray:
+        n = max((s.leaf_id for s in self.signatures.values()),
+                default=-1) + 1
+        table = np.full(n + 1, MODE_EXH, np.int8)
+        for sig in self.signatures.values():
+            table[sig.leaf_id] = sig.mode
+        return table
+
+    def class_keys(self, sched) -> np.ndarray:
+        """int64 [n, 5] class-key rows for a FaultSchedule; equal rows
+        are provably outcome-equivalent sites."""
+        n = len(sched)
+        leaf = np.asarray(sched.leaf_id, np.int64)
+        lane = np.asarray(sched.lane, np.int64)
+        word = np.asarray(sched.word, np.int64)
+        bit = np.asarray(sched.bit, np.int64)
+        t = np.asarray(sched.t, np.int64)
+        modes = self._mode_table()[np.clip(leaf, 0, None)]
+        keys = np.stack([leaf, t, word, bit, lane], axis=1)
+        keys[modes == MODE_FREE, 1:] = -2
+        keys[modes == MODE_LT, 2:] = -3
+        keys[modes == MODE_LTW, 3:] = -4
+        # Sites firing at or past the fault-free halt step never fire at
+        # all (the run is already halted): one global dead class.
+        dead = t >= self.clean_steps
+        keys[dead] = -1
+        # Cache draws outside the footprint (t < 0, hierarchy overlays)
+        # keep their full site identity -- the runner buckets them as
+        # cache_invalid, so merging them into a fired class would skew
+        # the weighted counts.
+        neg = t < 0
+        if neg.any():
+            keys[neg] = np.stack([leaf, t, word, bit, lane], axis=1)[neg]
+        assert keys.shape == (n, 5)
+        return keys
+
+    def reduce(self, sched):
+        """One seeded representative per realized class: a FaultSchedule
+        of the first-drawn site of each class, carrying ``class_weight``
+        = how many physical draws that representative stands for.  Rows
+        keep schedule order, so batching/journaling/streaming see a
+        normal (just shorter) campaign."""
+        from coast_tpu.inject.schedule import FaultSchedule
+        keys = self.class_keys(sched)
+        _, first, inverse, counts = np.unique(
+            keys, axis=0, return_index=True, return_inverse=True,
+            return_counts=True)
+        order = np.argsort(first, kind="stable")
+        rep = first[order]
+        weights = counts[order].astype(np.int64)
+        return FaultSchedule(
+            np.ascontiguousarray(sched.leaf_id[rep]),
+            np.ascontiguousarray(sched.lane[rep]),
+            np.ascontiguousarray(sched.word[rep]),
+            np.ascontiguousarray(sched.bit[rep]),
+            np.ascontiguousarray(sched.t[rep]),
+            np.ascontiguousarray(sched.section_idx[rep]),
+            sched.seed, model=sched.model,
+            class_weight=weights, equiv_sha=self.fingerprint)
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "benchmark": self.benchmark,
+            "num_clones": self.num_clones,
+            "clean_steps": self.clean_steps,
+            "fingerprint": self.fingerprint,
+            "sections": {
+                name: {"mode": sig.mode_name,
+                       "fingerprint": sig.fingerprint}
+                for name, sig in sorted(self.signatures.items())},
+        }
+
+
+def _cone_entries(jaxpr, env, live, name: str, out: List[str]) -> None:
+    """Program-order ``prim(shape)`` entries of the live equations whose
+    output provenance includes ``name`` -- the leaf's dataflow cone, the
+    raw material of its fingerprint."""
+    for eqn in jaxpr.eqns:
+        if live is None or id(eqn) in live:
+            for ov in eqn.outvars:
+                val = env.get(ov)
+                if val is not None and name in val.deps:
+                    shape = tuple(getattr(ov.aval, "shape", ()))
+                    out.append(f"{eqn.primitive.name}{shape}")
+                    break
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                sub = v.jaxpr if hasattr(v.jaxpr, "eqns") else v
+                if hasattr(sub, "eqns"):
+                    _cone_entries(sub, env, live, name, out)
+            elif isinstance(v, (list, tuple)):
+                for b in v:
+                    if hasattr(b, "jaxpr"):
+                        _cone_entries(b.jaxpr, env, live, name, out)
+
+
+def _check_transparent(region, name: str) -> bool:
+    """True when ``check()``'s consumption of shared leaf ``name`` is an
+    equality-compare indicator cone: every path is leaf -> eq/ne against
+    an untainted operand -> {convert/reduce_sum/reduce_or/add/broadcast/
+    reshape} -> E.  Then a completed clean-trajectory run with one
+    flipped bit anywhere in the leaf yields E >= 1 (the fault-free check
+    passes with E = 0, so exactly the flipped word's compare turns),
+    i.e. SDC for every site -- or, if the leaf never reaches E at all,
+    SUCCESS for every site.  Anything fancier is reported opaque."""
+    import jax.numpy as jnp
+    state = jax.eval_shape(region.init)
+    try:
+        closed = jax.make_jaxpr(region.check)(state)
+    except Exception:       # noqa: BLE001 - analysis must not break builds
+        return False
+    RAW, IND = "raw", "ind"
+    env: Dict[object, str] = {}
+    from jax.extend.core import Literal
+
+    def val(v):
+        if isinstance(v, Literal):
+            return None
+        return env.get(v)
+
+    jaxpr = closed.jaxpr
+    state_names = sorted(state)
+    if len(jaxpr.invars) != len(state_names):
+        return False
+    for leaf_name, var in zip(state_names, jaxpr.invars):
+        if leaf_name == name:
+            env[var] = RAW
+
+    _IND_OK = {"convert_element_type", "reduce_sum", "reduce_or", "add",
+               "broadcast_in_dim", "reshape", "squeeze", "transpose"}
+
+    def walk(jx) -> bool:
+        for eqn in jx.eqns:
+            ins = [val(v) for v in eqn.invars]
+            tainted = [t for t in ins if t is not None]
+            prim = eqn.primitive.name
+            if not tainted:
+                continue
+            if prim in ("eq", "ne"):
+                if RAW in tainted and len(tainted) == 1:
+                    for ov in eqn.outvars:
+                        env[ov] = IND
+                    continue
+                return False
+            if RAW in tainted:
+                return False
+            if prim in _IND_OK:
+                for ov in eqn.outvars:
+                    env[ov] = IND
+                continue
+            for key in ("jaxpr", "call_jaxpr"):
+                if key in eqn.params:
+                    sub = eqn.params[key]
+                    sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                    for iv, t in zip(sub.invars, ins):
+                        if t is not None:
+                            env[iv] = t
+                    if not walk(sub):
+                        return False
+                    for ov, t in zip(eqn.outvars,
+                                     [val(v) for v in sub.outvars]):
+                        if t is not None:
+                            env[ov] = t
+                    break
+            else:
+                return False
+        return True
+
+    return walk(jaxpr)
+
+
+def _clean_steps(prog) -> int:
+    """First step index at which the fault-free run is halted: the flip
+    window's hard edge (a later flip provably never fires)."""
+    rec = jax.jit(lambda: prog.run(None))()
+    return int(rec["steps"])
+
+
+def analyze_equivalence(prog, closed=None) -> EquivPartition:
+    """Derive the propagation-equivalence partition of ``prog``'s
+    fault-site space.  ``closed`` forwards an already-traced step jaxpr
+    (scripts/lint_sweep.py traces once and shares it with the lint)."""
+    cfg = prog.cfg
+    region = prog.region
+    n = cfg.num_clones
+    if closed is None:
+        closed = trace_step(prog)
+    jaxpr = closed.jaxpr
+
+    pstate, flags = jax.eval_shape(prog.init_pstate)
+    state_names = sorted(pstate)
+    flag_names = sorted(flags)
+    assert len(jaxpr.invars) == len(state_names) + len(flag_names) + 1, (
+        len(jaxpr.invars), len(state_names), len(flag_names))
+
+    # -- lattice walk (shared machinery with lint_provenance) ------------
+    walker = _Walker(n)
+    taints: List[FrozenSet[str]] = []
+    for name, var in zip(state_names, jaxpr.invars):
+        status = "laned" if prog.replicated.get(name) else "shared"
+        walker.env[var] = _Val(status, 0, False, False, frozenset({name}))
+        taints.append(frozenset({name}))
+    out_vals = walker.walk(jaxpr)
+
+    live: Set[int] = set()
+    _live_eqns(jaxpr, list(jaxpr.outvars), live)
+
+    # -- value-feeding taint walk ----------------------------------------
+    taint = _TaintWalk(live)
+    for var, t in zip(jaxpr.invars, taints):
+        taint._set(var, t)
+    taint.walk(jaxpr)
+
+    # -- per-leaf facts ---------------------------------------------------
+    out_names = state_names + flag_names
+    consumed: Set[str] = set()
+    for out_name, val in zip(out_names, out_vals):
+        for dep in val.deps:
+            if dep != out_name:
+                consumed.add(dep)
+    # The write set comes from the REGION's dataflow roles (the same
+    # analysis the engine derives its store syncs from): in the
+    # protected step's jaxpr every leaf gets fresh outvars (vmap,
+    # freeze-select), so var identity cannot tell a semantic write from
+    # a passthrough.  Synthetic (CFCSS) leaves are not region leaves;
+    # they are EXH below regardless.
+    from coast_tpu.passes.verification import analyze
+    written = set(analyze(region).written)
+
+    # Live single-lane extractions / unsanctioned collapses implicate
+    # their provenance leaves: lane symmetry is not provable there.
+    lane_flagged: Set[str] = set()
+    for key, cand in walker.candidates.items():
+        if key in live:
+            lane_flagged |= set(cand["deps"])
+
+    guards = (region.stack_guard is not None
+              or region.assert_guard is not None)
+    cfcss = getattr(prog, "_cfcss_step", None) is not None
+    fn_unsafe = n > 1 and any(
+        scope not in ("replicated", "replicated_return")
+        for scope in getattr(prog, "fn_scope", {}).values())
+
+    clean_steps = _clean_steps(prog)
+
+    # check() cone for fingerprints + shared-leaf transparency.
+    check_walker = _Walker(n)
+    check_closed = None
+    try:
+        check_closed = jax.make_jaxpr(region.check)(
+            jax.eval_shape(region.init))
+        check_names = sorted(jax.eval_shape(region.init))
+        for name, var in zip(check_names, check_closed.jaxpr.invars):
+            check_walker.env[var] = _Val("shared", 0, False, False,
+                                         frozenset({name}))
+        check_walker.walk(check_closed.jaxpr)
+    except Exception:       # noqa: BLE001 - fingerprint falls back to spec
+        check_closed = None
+
+    signatures: Dict[str, SectionSignature] = {}
+    for leaf_id, (name, kind, lanes, words) in enumerate(
+            prog.injectable_sections()):
+        replicated = bool(prog.replicated.get(name, kind == "cfcss"))
+        is_written = name in written
+        is_consumed = name in consumed
+        value_fed = name in taint.value_fed
+        pre_voted = bool(getattr(prog, "pre_sync", {}).get(name, False))
+        step_voted = bool(getattr(prog, "step_sync", {}).get(name, False))
+
+        if replicated:
+            if (cfcss or guards or fn_unsafe or kind == "cfcss"
+                    or name in lane_flagged):
+                mode = MODE_EXH
+            elif pre_voted:
+                # Repaired (TMR) or latched (DWC) before any read.
+                mode = MODE_LT
+            elif not is_written:
+                mode = MODE_FREE if not is_consumed else MODE_LT
+            elif not value_fed:
+                mode = MODE_LTW
+            else:
+                mode = MODE_EXH
+        else:
+            if not is_consumed and not is_written \
+                    and _check_transparent(region, name):
+                mode = MODE_FREE
+            else:
+                mode = MODE_EXH
+
+        cone: List[str] = []
+        _cone_entries(jaxpr, walker.env, live, name, cone)
+        if check_closed is not None:
+            cone.append("|check|")
+            _cone_entries(check_closed.jaxpr, check_walker.env, None,
+                          name, cone)
+        h = hashlib.sha256()
+        h.update(repr((name, kind, lanes, words, replicated, is_written,
+                       is_consumed, value_fed, pre_voted, step_voted,
+                       MODE_NAMES[mode], n, clean_steps)).encode())
+        h.update("|".join(cone).encode())
+        signatures[name] = SectionSignature(
+            name=name, kind=kind, leaf_id=leaf_id, lanes=lanes,
+            words=words, replicated=replicated, written=is_written,
+            consumed=is_consumed, value_fed=value_fed,
+            pre_voted=pre_voted, step_voted=step_voted, mode=mode,
+            fingerprint=h.hexdigest())
+
+    overall = hashlib.sha256()
+    overall.update(str(clean_steps).encode())
+    for name in sorted(signatures):
+        overall.update(name.encode())
+        overall.update(signatures[name].fingerprint.encode())
+    return EquivPartition(
+        benchmark=region.name,
+        num_clones=n,
+        clean_steps=clean_steps,
+        signatures=signatures,
+        fingerprint=overall.hexdigest())
+
+
+def section_fingerprints(prog, partition: Optional[EquivPartition] = None
+                         ) -> Dict[str, str]:
+    """Per-section propagation fingerprints -- the delta-campaign
+    identity persisted in the journal header.  A section whose
+    fingerprint is unchanged across a rebuild has the identical
+    dataflow cone, sync coverage, and merge mode, so its recorded
+    outcomes remain valid."""
+    if partition is None:
+        partition = analyze_equivalence(prog)
+    return {name: sig.fingerprint
+            for name, sig in partition.signatures.items()}

@@ -141,6 +141,12 @@ class Summary:
     # multi-site campaigns, "mixed" when a directory aggregates several
     # models -- rates aggregated across models are rarely meaningful.
     fault_model: Optional[str] = None
+    # Equivalence-reduced campaigns (analysis/equiv): ``n``/``counts``
+    # are over EFFECTIVE injections (per-run class weights multiplied
+    # out); ``physical_n`` is how many representative runs were actually
+    # dispatched.  None for exhaustive campaigns (no weight keys in the
+    # log), so pre-equiv logs summarize exactly as before.
+    physical_n: Optional[int] = None
 
     @property
     def due(self) -> int:
@@ -157,11 +163,22 @@ class Summary:
         return 100.0 * self.counts[cls] / self.n if self.n else 0.0
 
     def seconds_per_injection(self) -> float:
-        # summarizeTiming (jsonParser.py:204-213).
-        return self.seconds / self.n if self.n else 0.0
+        # summarizeTiming (jsonParser.py:204-213).  Reduced campaigns
+        # time the runs that physically dispatched, not the effective
+        # injections they stand for.
+        denom = self.physical_n if self.physical_n is not None else self.n
+        return self.seconds / denom if denom else 0.0
 
     def format(self) -> str:
         lines = [f"=== {self.name}: {self.n} injections ==="]
+        if self.physical_n is not None:
+            # Effective vs physical as separate rows: the distribution
+            # above is over effective injections; only the class
+            # representatives physically ran.
+            lines.append(f"  {'effective':<12} {self.n:>8}  (class-weighted)")
+            red = self.n / self.physical_n if self.physical_n else 0.0
+            lines.append(f"  {'physical':<12} {self.physical_n:>8}  "
+                         f"({red:.1f}x equiv reduction)")
         if self.fault_model:
             lines.append(f"  fault model  {self.fault_model}")
         for cls in _CLASSES:
@@ -181,9 +198,10 @@ class Summary:
         lines.append(f"  error rate   {self.error_rate:.6f}")
         lines.append(f"  mean runtime {self.mean_steps:.1f} steps")
         if self.seconds:
+            phys = self.physical_n if self.physical_n is not None else self.n
             lines.append(
                 f"  {self.seconds_per_injection() * 1e6:.2f} usec per "
-                f"injection ({self.n / self.seconds:.1f} injections/sec)")
+                f"injection ({phys / self.seconds:.1f} injections/sec)")
         if self.stages:
             lines.append("  --- stage breakdown ---")
             # 'overlap' is a FRACTION (share of serialization work the
@@ -288,6 +306,8 @@ def _iter_docs(path: str) -> Iterable[Tuple[str, Dict[str, object]]]:
 def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     counts = {cls: 0 for cls in _CLASSES}
     n = 0
+    physical = 0
+    weighted = False
     seconds = 0.0
     step_sum = 0
     step_n = 0
@@ -301,23 +321,43 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             col = doc["columns"]  # type: ignore
             codes = np.asarray(col["code"])
             steps = np.asarray(col["steps"])
-            binc = np.bincount(codes, minlength=len(_CLASSES))
+            w = col.get("weight")
+            if w is not None:
+                # Equivalence-reduced log: each representative row is
+                # multiplied by its class weight (effective counts).
+                weighted = True
+                w = np.asarray(w, np.int64)
+                binc = np.round(np.bincount(
+                    codes, weights=w.astype(np.float64),
+                    minlength=len(_CLASSES))).astype(np.int64)
+                n += int(w.sum())
+                completed = codes <= _COMPLETED_MAX
+                step_sum += int((steps[completed]
+                                 * w[completed]).sum())
+                step_n += int(w[completed].sum())
+            else:
+                binc = np.bincount(codes, minlength=len(_CLASSES))
+                n += len(codes)
+                completed = codes <= _COMPLETED_MAX  # success/corrected/sdc
+                step_sum += int(steps[completed].sum())
+                step_n += int(completed.sum())
             for i, cls in enumerate(_CLASSES):
                 counts[cls] += int(binc[i])
-            n += len(codes)
-            completed = codes <= _COMPLETED_MAX   # success/corrected/sdc
-            step_sum += int(steps[completed].sum())
-            step_n += int(completed.sum())
+            physical += len(codes)
         else:
             runs: List[Dict[str, object]] = doc["runs"]  # type: ignore
             for run in runs:
                 cls = classify_run(run)
-                counts[cls] += 1
-                n += 1
+                w = int(run.get("weight", 1))
+                if "weight" in run:
+                    weighted = True
+                counts[cls] += w
+                n += w
+                physical += 1
                 res = run.get("result") or {}
                 if "core" in res:
-                    step_sum += int(res.get("runtime", 0))
-                    step_n += 1
+                    step_sum += int(res.get("runtime", 0)) * w
+                    step_n += w
         summary = doc.get("summary") or {}
         seconds += float(summary.get("seconds", 0.0))
         for stage, sec in (summary.get("stages") or {}).items():
@@ -345,7 +385,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                    mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
                    stages=stages or None,
                    resilience=resilience or None,
-                   fault_model=fault_model)
+                   fault_model=fault_model,
+                   physical_n=physical if weighted else None)
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -360,6 +401,10 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
         with _open_log(path, "rb") as f:
             head = _sniff_ndjson_head(f.readline())
             if head is None:
+                return None
+            if "physical_injections" in head["summary"]:
+                # Equivalence-reduced log: rows carry class weights the
+                # native classifier does not apply -- Python path.
                 return None
             try:
                 got = native.ndjson_classify_stream(f.read)

@@ -112,6 +112,15 @@ class LintReport:
         write_baseline_set([self], path)
 
     # -- serialization ---------------------------------------------------
+    def sorted_findings(self) -> List[Finding]:
+        """Findings in deterministic artifact order: stable sort by
+        ``rule:locus``, so exports diff cleanly in CI even when pass
+        internals reorder their emission (dict/walk order is an
+        implementation detail; the artifact's order must not be).
+        Ties (same rule+locus, different message) keep emission order --
+        the sort is stable."""
+        return sorted(self.findings, key=lambda f: (f.rule, f.locus))
+
     def to_dict(self) -> Dict[str, object]:
         return {
             "benchmark": self.benchmark,
@@ -119,7 +128,7 @@ class LintReport:
             "passes_run": list(self.passes_run),
             "counts": self.counts(),
             "ok": self.ok,
-            "findings": [f.to_dict() for f in self.findings],
+            "findings": [f.to_dict() for f in self.sorted_findings()],
         }
 
     def write_json(self, path: str) -> None:

@@ -75,10 +75,20 @@ class CampaignResult:
     # oom_degrade counts, coast_tpu.inject.resilience); populated -- with
     # zeros -- whenever the runner had a RetryPolicy, {} otherwise.
     resilience: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Equivalence-reduced campaigns (analysis/equiv): ``n`` and
+    # ``counts`` are over EFFECTIVE injections (each representative
+    # multiplied by its class weight); ``physical_n`` is how many
+    # representatives actually ran.  None for exhaustive campaigns.
+    physical_n: Optional[int] = None
+    # Delta-campaign accounting (run_delta): changed sections, reused vs
+    # re-injected row counts.  None for ordinary campaigns.
+    delta: Optional[Dict[str, object]] = None
 
     @property
     def injections_per_sec(self) -> float:
-        return self.n / self.seconds if self.seconds > 0 else float("inf")
+        """Device-honest rate: physically dispatched runs per second."""
+        phys = self.physical_n if self.physical_n is not None else self.n
+        return phys / self.seconds if self.seconds > 0 else float("inf")
 
     def record_stage(self, name: str, seconds: float) -> None:
         """Accumulate ``seconds`` into one stage bucket (log writers add
@@ -115,6 +125,14 @@ class CampaignResult:
         if self.fault_model.kind != "single":
             out["fault_model"] = self.fault_model.spec()
             out["fault_sites"] = self.fault_model.sites
+        # The equivalence axis follows the same absent-means-exhaustive
+        # rule: only reduced campaigns add the keys.
+        if self.physical_n is not None:
+            out["physical_injections"] = int(self.physical_n)
+            out["equiv_reduction"] = round(
+                self.n / self.physical_n, 2) if self.physical_n else 0.0
+        if self.delta is not None:
+            out["delta"] = dict(self.delta)
         if self.chunks is not None:
             out["chunks"] = self.chunks
         if self.resilience:
@@ -146,7 +164,8 @@ class CampaignRunner:
                  preflight: "bool | str" = False,
                  retry: "Optional[object]" = None,
                  mesh: "Optional[object]" = None,
-                 fault_model: "Optional[FaultModel]" = None):
+                 fault_model: "Optional[FaultModel]" = None,
+                 equiv: "bool | object" = False):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -193,7 +212,21 @@ class CampaignRunner:
         flip groups.  It is part of the campaign's identity -- journaled
         in the header (resume under a different model is refused with a
         typed error) and recorded in the log summary's fault-model
-        axis."""
+        axis.
+
+        ``equiv`` turns on fault-site equivalence reduction
+        (:mod:`coast_tpu.analysis.equiv`): ``True`` derives the
+        propagation partition from the protected step's jaxpr at
+        construction (one extra clean-run compile), or pass an
+        already-built :class:`EquivPartition`.  Every seeded ``run``
+        then injects ONE representative per realized class and
+        multiplies counts by the class weights, so the reported
+        distribution is over effective injections at a fraction of the
+        physical dispatches -- exactly matching the exhaustive
+        distribution (the FastFlip contract, pinned differentially in
+        tests).  Journals record the partition fingerprint and the
+        per-section fingerprints that power ``run_delta``.  Requires
+        the single-bit fault model."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -206,8 +239,22 @@ class CampaignRunner:
         self.retry = retry
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
+        if equiv and self.fault_model.kind != "single":
+            raise ValueError(
+                "equiv=True needs the single-bit fault model: a flip "
+                f"group ({self.fault_model.spec()}) has no per-site "
+                "propagation class to reduce over")
         self.telemetry = telemetry if telemetry is not None \
             else obs.Telemetry()
+        self.equiv_partition = None
+        if equiv:
+            from coast_tpu.analysis.equiv import (EquivPartition,
+                                                  analyze_equivalence)
+            with self.telemetry.activate(), \
+                    self.telemetry.span("equiv_analysis"):
+                self.equiv_partition = (
+                    equiv if isinstance(equiv, EquivPartition)
+                    else analyze_equivalence(prog))
         with self.telemetry.activate():
             self.mmap = MemoryMap(prog, sections)
         self.strategy_name = strategy_name or f"N={prog.cfg.num_clones}"
@@ -325,6 +372,18 @@ class CampaignRunner:
                     f"{header_spec!r} but the schedule being run carries "
                     f"{sched_spec!r}; open the journal with the "
                     "schedule's model (CampaignRunner(fault_model=...))")
+            # Same identity rule for the equivalence partition: batch
+            # records are per-representative, so replaying them under a
+            # different (or no) partition would weight them wrongly.
+            header_part = (journal.header.get("equiv") or {}).get(
+                "partition")
+            sched_part = getattr(sched, "equiv_sha", None)
+            if header_part != sched_part:
+                raise JournalMismatchError(
+                    f"journal {journal.path!r} records equivalence "
+                    f"partition {header_part!r} but the schedule being "
+                    f"run carries {sched_part!r}; refusing to mix "
+                    "reduced and exhaustive row records")
         retry = self.retry
         tel = self.telemetry
         mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
@@ -337,16 +396,25 @@ class CampaignRunner:
             {"retry_transient": 0, "retry_wedged": 0, "oom_degrade": 0}
             if retry is not None else {})
         sched_t = np.asarray(sched.t)
+        sched_w = getattr(sched, "class_weight", None)
 
         def _account(out: Dict[str, np.ndarray], lo: int) -> Dict[str, int]:
             """Cumulative class histogram over the rows fetched so far
-            (progress heartbeats and journal batch records)."""
+            (progress heartbeats and journal batch records).  Reduced
+            schedules multiply each representative by its class weight,
+            so the live counts are over effective injections."""
             nonlocal live_invalid
             n_out = len(out["code"])
             fired = sched_t[lo:lo + n_out] >= 0
-            live_counts[:] += np.bincount(
-                out["code"][fired], minlength=cls.NUM_CLASSES)
-            live_invalid += int(n_out - fired.sum())
+            if sched_w is None:
+                live_counts[:] += np.bincount(
+                    out["code"][fired], minlength=cls.NUM_CLASSES)
+                live_invalid += int(n_out - fired.sum())
+            else:
+                w = sched_w[lo:lo + n_out]
+                live_counts[:] += cls.weighted_histogram(
+                    out["code"][fired], w[fired])
+                live_invalid += int(w[~fired].sum())
             counts_so_far = {name: int(live_counts[i])
                              for i, name in enumerate(cls.CLASS_NAMES)}
             counts_so_far["cache_invalid"] = live_invalid
@@ -528,16 +596,23 @@ class CampaignRunner:
             # column (jsonParser.py summarizeRuns counts lines whose
             # cacheInfo says the chosen line was not dirty).
             invalid_draw = np.asarray(sched.t) < 0
-            binc = np.bincount(merged["code"][~invalid_draw],
-                               minlength=cls.NUM_CLASSES)
+            if sched_w is None:
+                binc = np.bincount(merged["code"][~invalid_draw],
+                                   minlength=cls.NUM_CLASSES)
+                invalid_total = int(invalid_draw.sum())
+            else:
+                binc = cls.weighted_histogram(merged["code"][~invalid_draw],
+                                              sched_w[~invalid_draw])
+                invalid_total = int(sched_w[invalid_draw].sum())
             counts = {name: int(binc[i])
                       for i, name in enumerate(cls.CLASS_NAMES)}
-            counts["cache_invalid"] = int(invalid_draw.sum())
+            counts["cache_invalid"] = invalid_total
         seconds = time.perf_counter() - t0
         return CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
-            n=len(sched),
+            n=sched.effective_n,
+            physical_n=(len(sched) if sched_w is not None else None),
             counts=counts,
             seconds=seconds,
             codes=merged["code"],
@@ -561,6 +636,20 @@ class CampaignRunner:
                   "config_sha": config_fingerprint(self.prog.cfg)}
         if self.fault_model.kind != "single":
             header["fault_model"] = self.fault_model.spec()
+        if self.equiv_partition is not None:
+            # Partition = campaign identity (the reduced rows are only
+            # meaningful under it); per-section fingerprints are the
+            # delta-campaign vocabulary and deliberately volatile --
+            # they may differ on resume of an unchanged campaign only
+            # if the program changed, which config_sha/schedule_sha
+            # already refuse.
+            header["equiv"] = {
+                "partition": self.equiv_partition.fingerprint,
+                "clean_steps": self.equiv_partition.clean_steps}
+            header["section_fingerprints"] = {
+                name: sig.fingerprint
+                for name, sig in sorted(
+                    self.equiv_partition.signatures.items())}
         header.update(fields)
         return header
 
@@ -576,6 +665,24 @@ class CampaignRunner:
                                       journal.path)
             return journal, False
         return CampaignJournal.open(str(journal), header), True
+
+    def _seeded_part(self, n: int, seed: int, start_num: int):
+        """generate -> start_num slice -> (optional) equivalence
+        reduction: the ONE schedule-preparation path shared by ``run``
+        and ``run_delta``, so the reduced rows a delta splices against
+        cannot drift from the rows a run journals.  Reduction happens
+        AFTER the slice: the representatives (and weights) describe
+        exactly the rows this campaign covers."""
+        with self.telemetry.activate():   # generate() records its span
+            sched = generate(self.mmap, start_num + n, seed,
+                             self.prog.region.nominal_steps,
+                             model=self.fault_model)
+        part = sched.slice(start_num, start_num + n)
+        if self.equiv_partition is not None:
+            with self.telemetry.activate(), \
+                    self.telemetry.span("schedule_equiv"):
+                part = self.equiv_partition.reduce(part)
+        return part
 
     def run(self, n: int, seed: int = 0,
             batch_size: int = 4096, start_num: int = 0,
@@ -603,11 +710,7 @@ class CampaignRunner:
         ``stream.abort()`` on failure)."""
         tel = self.telemetry
         mark = tel.mark()
-        with tel.activate():        # generate() records its schedule span
-            sched = generate(self.mmap, start_num + n, seed,
-                             self.prog.region.nominal_steps,
-                             model=self.fault_model)
-        part = sched.slice(start_num, start_num + n)
+        part = self._seeded_part(n, seed, start_num)
         j, owned = (None, False)
         if journal is not None:
             header = self._journal_header(
@@ -615,6 +718,16 @@ class CampaignRunner:
                 batch_size=int(batch_size),
                 schedule_sha=schedule_fingerprint(part))
             j, owned = self._open_journal(journal, header)
+            if self.equiv_partition is not None and not j.resumed:
+                # Persist the representatives: run_delta splices by site
+                # identity, which a reduced schedule cannot regenerate
+                # from the seed alone once the partition drifts.
+                j.append({
+                    "kind": "equiv_schedule",
+                    "class_weight": part.class_weight.tolist(),
+                    **{k: np.asarray(getattr(part, k)).tolist()
+                       for k in ("leaf_id", "lane", "word", "bit", "t")},
+                })
         try:
             res = self.run_schedule(part, batch_size, progress=progress,
                                     _telemetry_mark=mark, journal=j,
@@ -622,6 +735,95 @@ class CampaignRunner:
         finally:
             if owned and j is not None:
                 j.close()
+        res.start_num = start_num
+        return res
+
+    def run_delta(self, n: int, delta_from: str, seed: int = 0,
+                  batch_size: int = 4096, start_num: int = 0,
+                  progress: Optional[
+                      Callable[[int, Dict[str, int]], None]] = None
+                  ) -> CampaignResult:
+        """Delta campaign: rerun the seeded campaign recorded in the
+        journal at ``delta_from``, but physically re-inject ONLY the
+        sections whose propagation fingerprint changed since that
+        journal was written -- every other row's outcome is spliced
+        from the journal (its dataflow cone is provably unchanged, so
+        the recorded outcome still holds).  A no-op rebuild re-injects
+        zero rows; a one-section edit re-injects exactly that section.
+
+        Requires an equivalence-enabled runner (``equiv=True``): the
+        partition supplies the per-section fingerprints, and the base
+        journal must carry the fingerprint block (i.e. was itself
+        written by an equiv run).  Incompatible bases refuse with the
+        typed :class:`~coast_tpu.analysis.equiv.DeltaMismatchError`."""
+        from coast_tpu.analysis.equiv import load_delta_base, plan_delta
+        if self.equiv_partition is None:
+            raise ValueError(
+                "run_delta needs CampaignRunner(equiv=True): the "
+                "equivalence partition supplies the per-section "
+                "fingerprints a delta diffs")
+        tel = self.telemetry
+        mark = tel.mark()
+        base_header, base_sites, base_out, base_rows = load_delta_base(
+            delta_from)
+        part = self._seeded_part(n, seed, start_num)
+        current_header = self._journal_header(
+            "run", seed=int(seed), n=int(n), start_num=int(start_num))
+        section_names = {sig.leaf_id: name for name, sig in
+                         self.equiv_partition.signatures.items()}
+        plan = plan_delta(
+            base_header, base_sites, base_out, base_rows,
+            current_header,
+            {name: sig.fingerprint for name, sig in
+             self.equiv_partition.signatures.items()},
+            part, section_names, base_path=delta_from)
+        tel.instant("delta_plan", **plan.summary())
+
+        run_idx = np.flatnonzero(plan.run_mask)
+        cols = {k: v.copy() for k, v in plan.spliced.items()}
+        seconds = 0.0
+        stages: Dict[str, float] = {}
+        resilience: Dict[str, int] = {}
+        if len(run_idx):
+            sub = FaultSchedule(
+                *(np.ascontiguousarray(np.asarray(getattr(part, f))[run_idx])
+                  for f in ("leaf_id", "lane", "word", "bit", "t",
+                            "section_idx")),
+                seed=part.seed, model=part.model,
+                class_weight=part.class_weight[run_idx],
+                equiv_sha=part.equiv_sha)
+            sub_res = self.run_schedule(
+                sub, batch_size=min(batch_size, len(sub)),
+                progress=progress, _telemetry_mark=mark)
+            for out_key, res_key in (("codes", "codes"),
+                                     ("errors", "errors"),
+                                     ("corrected", "corrected"),
+                                     ("steps", "steps")):
+                cols[out_key][run_idx] = getattr(sub_res, res_key)
+            seconds = sub_res.seconds
+            stages = sub_res.stages
+            resilience = sub_res.resilience
+        binc = cls.weighted_histogram(cols["codes"], part.class_weight)
+        counts = {name: int(binc[i])
+                  for i, name in enumerate(cls.CLASS_NAMES)}
+        counts["cache_invalid"] = 0
+        res = CampaignResult(
+            benchmark=self.prog.region.name,
+            strategy=self.strategy_name,
+            n=part.effective_n,
+            physical_n=len(part),
+            counts=counts,
+            seconds=seconds,
+            codes=cols["codes"],
+            errors=cols["errors"],
+            corrected=cols["corrected"],
+            steps=cols["steps"],
+            schedule=part,
+            seed=part.seed,
+            stages=stages or tel.stage_totals(since=mark),
+            resilience=resilience,
+            delta={**plan.summary(), "base": delta_from},
+        )
         res.start_num = start_num
         return res
 
@@ -636,10 +838,14 @@ class CampaignRunner:
                              self.prog.region.nominal_steps,
                              model=self.fault_model
                              ).slice(start_num, start_num + n)
+            if self.equiv_partition is not None:
+                sched = self.equiv_partition.reduce(sched)
         return CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
-            n=n,
+            n=sched.effective_n,
+            physical_n=(len(sched) if sched.class_weight is not None
+                        else None),
             counts={k: int(v) for k, v in rec["counts"].items()},
             seconds=float(rec.get("seconds", 0.0)),
             codes=np.asarray(rec["codes"], np.int32),
@@ -835,14 +1041,24 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
         extra["group"] = np.concatenate(
             [p.schedule.extra["group"] + np.int32(off)
              for p, off in zip(parts, offsets)]).astype(np.int32)
+    weights = None
+    if first_sched.class_weight is not None:
+        weights = np.concatenate(
+            [p.schedule.class_weight for p in parts])
     sched = FaultSchedule(
         *(np.concatenate([getattr(p.schedule, f) for p in parts])
           for f in ("leaf_id", "lane", "word", "bit", "t", "section_idx")),
-        seed=seed, extra=extra, model=first_sched.model)
+        seed=seed, extra=extra, model=first_sched.model,
+        class_weight=weights, equiv_sha=first_sched.equiv_sha)
+    physical = None
+    if any(p.physical_n is not None for p in parts):
+        physical = sum(p.physical_n if p.physical_n is not None else p.n
+                       for p in parts)
     return CampaignResult(
         benchmark=first.benchmark,
         strategy=first.strategy,
         n=sum(p.n for p in parts),
+        physical_n=physical,
         counts=counts,
         seconds=sum(p.seconds for p in parts),
         codes=np.concatenate([p.codes for p in parts]),

@@ -82,3 +82,18 @@ def histogram(codes: jax.Array) -> jax.Array:
     """Per-class counts (int32 [NUM_CLASSES]); psum-able across shards."""
     return jnp.sum(
         jax.nn.one_hot(codes, NUM_CLASSES, dtype=jnp.int32), axis=0)
+
+
+def weighted_histogram(codes, weights=None):
+    """Host-side per-class counts (int64 [NUM_CLASSES]) with optional
+    per-run weights -- the single counting point for equivalence-reduced
+    campaigns (analysis/equiv): each representative's outcome is
+    multiplied by its ``class_weight``, so the reported distribution is
+    over *effective* injections while only the representatives ran."""
+    import numpy as np
+    codes = np.asarray(codes)
+    if weights is None:
+        return np.bincount(codes, minlength=NUM_CLASSES).astype(np.int64)
+    return np.round(np.bincount(
+        codes, weights=np.asarray(weights, np.float64),
+        minlength=NUM_CLASSES)).astype(np.int64)

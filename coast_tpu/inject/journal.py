@@ -97,6 +97,12 @@ def schedule_fingerprint(sched) -> str:
         for key in sorted(extra):
             h.update(np.ascontiguousarray(extra[key],
                                           dtype=np.int32).tobytes())
+    weights = getattr(sched, "class_weight", None)
+    if weights is not None:
+        # Equivalence-reduced schedule: the weights are part of what a
+        # resumed campaign must replay exactly (they multiply counts).
+        h.update(b"equiv")
+        h.update(np.ascontiguousarray(weights, dtype=np.int64).tobytes())
     return h.hexdigest()
 
 
@@ -111,7 +117,13 @@ def config_fingerprint(cfg) -> str:
 #: a resume (batch geometry is re-negotiable: OOM degradation changes it
 #: mid-campaign, and the resumed process may choose another size -- the
 #: per-row records make resume independent of batching).
-_VOLATILE_KEYS = frozenset({"batch_size", "created", "argv"})
+#: ``section_fingerprints`` is the DELTA-campaign vocabulary, not resume
+#: identity: journals written before the equivalence pass have no block
+#: at all and must still open/resume cleanly (the absent-means-legacy
+#: rule of the fault-model key), and any program change the fingerprints
+#: could flag is already refused by config_sha/schedule_sha.
+_VOLATILE_KEYS = frozenset({"batch_size", "created", "argv",
+                            "section_fingerprints"})
 
 
 class CampaignJournal:

@@ -150,7 +150,7 @@ def _columns(res: CampaignResult, mmap: MemoryMap):
     """Per-run columns as plain Python lists (one C-speed conversion each)."""
     secs = {s.leaf_id: s for s in mmap.sections}
     sched = res.schedule
-    return {
+    col = {
         "leaf_id": sched.leaf_id.tolist(),
         "lane": sched.lane.tolist(),
         "word": sched.word.tolist(),
@@ -160,14 +160,22 @@ def _columns(res: CampaignResult, mmap: MemoryMap):
         "errors": res.errors.tolist(),
         "corrected": res.corrected.tolist(),
         "steps": res.steps.tolist(),
-    }, secs
+    }
+    # Equivalence-reduced campaigns (analysis/equiv): each row is a
+    # class representative; the weight column lets json_parser multiply
+    # counts back out to effective injections.  Exhaustive campaigns
+    # omit the key, keeping their logs byte-identical to before the
+    # pass existed (the fault-model rule).
+    if getattr(sched, "class_weight", None) is not None:
+        col["weight"] = sched.class_weight.tolist()
+    return col, secs
 
 
 def _batch_columns(part, out: Dict[str, "np.ndarray"]):
     """Per-run columns of ONE collected batch as plain Python lists: the
     schedule slice supplies where/when, the collected ``out`` dict the
     outcome columns.  The streaming writer's unit of work."""
-    return {
+    col = {
         "leaf_id": part.leaf_id.tolist(),
         "lane": part.lane.tolist(),
         "word": part.word.tolist(),
@@ -178,6 +186,9 @@ def _batch_columns(part, out: Dict[str, "np.ndarray"]):
         "corrected": out["corrected"].tolist(),
         "steps": out["steps"].tolist(),
     }
+    if getattr(part, "class_weight", None) is not None:
+        col["weight"] = part.class_weight.tolist()
+    return col
 
 
 def _injection_log_rows(col, sec_kind: Dict[int, str],
@@ -188,6 +199,7 @@ def _injection_log_rows(col, sec_kind: Dict[int, str],
     ``to_injection_logs`` AND the streaming reference writer, so the two
     cannot drift."""
     logs = []
+    weights = col.get("weight")
     for i in range(len(col["code"])):
         lid = col["leaf_id"][i]
         t_i = col["t"][i]
@@ -200,7 +212,7 @@ def _injection_log_rows(col, sec_kind: Dict[int, str],
         else:
             section, symbol = sec_kind[lid], sec_name[lid]
             name = f"{sec_name[lid]}[lane {col['lane'][i]}]^bit{col['bit'][i]}"
-        logs.append({
+        row = {
             "timestamp": ts,
             "number": num0 + i,
             "section": section,
@@ -216,7 +228,12 @@ def _injection_log_rows(col, sec_kind: Dict[int, str],
             "result": _result_dict(col["code"][i], col["errors"][i],
                                    col["corrected"][i], col["steps"][i], ts),
             "cacheInfo": None,
-        })
+        }
+        if weights is not None:
+            # Class-representative row of an equivalence-reduced
+            # campaign: stands for this many physical draws.
+            row["weight"] = weights[i]
+        logs.append(row)
     return logs
 
 
@@ -255,6 +272,10 @@ def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
     if not native.native_available():
         return False
     sched = res.schedule
+    if getattr(sched, "class_weight", None) is not None:
+        # Equivalence-reduced rows carry a weight key the native encoder
+        # does not know; the Python formatter owns them.
+        return False
     tables = _escaped_leaf_tables(mmap)
     if tables is None:
         return False
@@ -381,6 +402,7 @@ def _ndjson_rows_py(col, sec_kind: Dict[int, str], sec_name: Dict[int, str],
     by the one-shot writer (num0=0, full columns) and the streaming
     writer (per-batch columns), byte-identical by construction."""
     res_tpl, line_tpl = _ndjson_templates(ts)
+    weights = col.get("weight")
     for i in range(len(col["code"])):
         lid = col["leaf_id"][i]
         t_i = col["t"][i]
@@ -396,12 +418,17 @@ def _ndjson_rows_py(col, sec_kind: Dict[int, str], sec_name: Dict[int, str],
             "steps": col["steps"][i]}
         # json.dumps on the string fields: leaf names are arbitrary
         # author-chosen strings and must be JSON-escaped.
-        write(line_tpl % {
+        line = line_tpl % {
             "i": num0 + i, "section": json.dumps(section)[1:-1],
             "word": col["word"][i], "t": t_i,
             "name": json.dumps(name)[1:-1],
             "symbol": json.dumps(symbol)[1:-1],
-            "result": result} + "\n")
+            "result": result}
+        if weights is not None:
+            # Reduced-campaign representative: splice the weight before
+            # the closing brace (exhaustive lines stay byte-identical).
+            line = f'{line[:-1]}, "weight": {weights[i]}}}'
+        write(line + "\n")
 
 
 def _write_ndjson_py(res: CampaignResult, mmap: MemoryMap, ts: str,
@@ -555,11 +582,12 @@ class StreamLogWriter:
             raise RuntimeError(
                 f"stream log writer for {self.path!r} failed"
             ) from self._exc
-        if res.n != self._expected:
+        rows = res.physical_n if res.physical_n is not None else res.n
+        if rows != self._expected:
             self._cleanup()
             raise ValueError(
                 f"stream received {self._expected} rows but the campaign "
-                f"result records n={res.n}; refusing to write a log that "
+                f"result records {rows}; refusing to write a log that "
                 "does not match its summary")
         try:
             with obs.span("serialize", writer=f"stream_{self.fmt}",
@@ -626,7 +654,8 @@ class StreamLogWriter:
 
     def _serialize_batch(self, num0: int, part, out) -> None:
         if self.fmt == "ndjson":
-            if self._use_native is not False and self._tables is not None:
+            if (self._use_native is not False and self._tables is not None
+                    and getattr(part, "class_weight", None) is None):
                 from coast_tpu import native
                 col = {"leaf_id": part.leaf_id, "lane": part.lane,
                        "word": part.word, "bit": part.bit, "t": part.t,
@@ -645,8 +674,9 @@ class StreamLogWriter:
                             num0, lambda s: self._rows_f.write(s.encode()))
         elif self.fmt == "columnar":
             col = _batch_columns(part, out)
-            for k in _COLUMN_KEYS:
-                self._frags[k].append(", ".join(map(str, col[k])))
+            for k in col:           # _COLUMN_KEYS (+ weight when reduced)
+                self._frags.setdefault(k, []).append(
+                    ", ".join(map(str, col[k])))
         else:                                   # reference
             col = _batch_columns(part, out)
             rows = _injection_log_rows(col, self._sec_kind, self._sec_name,
@@ -701,13 +731,16 @@ class StreamLogWriter:
             sections = [{"leaf_id": s.leaf_id, "name": s.name,
                          "kind": s.kind, "lanes": s.lanes,
                          "words": s.words} for s in self._secs.values()]
+            keys = list(_COLUMN_KEYS)
+            if "weight" in self._frags:
+                keys.append("weight")   # matches _columns' insertion order
             with _atomic_write(self.path) as f:
                 f.write('{"summary": ')
                 json.dump({**res.summary(), "format": "columnar"}, f)
                 f.write(', "sections": ')
                 json.dump(sections, f)
                 f.write(', "columns": {')
-                for j, k in enumerate(_COLUMN_KEYS):
+                for j, k in enumerate(keys):
                     f.write(('' if j == 0 else ', ') + f'"{k}": [')
                     f.write(", ".join(frag for frag in self._frags[k]))
                     f.write(']')

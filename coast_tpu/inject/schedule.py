@@ -192,9 +192,24 @@ class FaultSchedule:
     # extra row i*(sites-1)+(j-1) is injection i's site j.
     extra: Optional[Dict[str, np.ndarray]] = None
     model: FaultModel = FaultModel()
+    # Equivalence-reduced schedules (analysis/equiv): each row is one
+    # propagation-class representative standing for ``class_weight[i]``
+    # physically-drawn sites; ``equiv_sha`` is the partition fingerprint
+    # (part of the campaign identity -- journaled and resume-validated).
+    # None for ordinary exhaustive schedules.
+    class_weight: Optional[np.ndarray] = None   # int64 [n]
+    equiv_sha: Optional[str] = None
 
     def __len__(self) -> int:
         return len(self.leaf_id)
+
+    @property
+    def effective_n(self) -> int:
+        """Injections this schedule REPRESENTS: the physical row count,
+        or the summed class weights of a reduced schedule."""
+        if self.class_weight is None:
+            return len(self)
+        return int(self.class_weight.sum())
 
     @property
     def sites(self) -> int:
@@ -224,7 +239,10 @@ class FaultSchedule:
         return FaultSchedule(
             self.leaf_id[lo:hi], self.lane[lo:hi], self.word[lo:hi],
             self.bit[lo:hi], self.t[lo:hi], self.section_idx[lo:hi],
-            self.seed, extra=extra, model=self.model)
+            self.seed, extra=extra, model=self.model,
+            class_weight=(None if self.class_weight is None
+                          else self.class_weight[lo:hi]),
+            equiv_sha=self.equiv_sha)
 
 
 def _expand(mmap: MemoryMap, sched: FaultSchedule, model: FaultModel,
@@ -249,7 +267,8 @@ def _expand(mmap: MemoryMap, sched: FaultSchedule, model: FaultModel,
 
 
 def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
-             model: Optional[FaultModel] = None) -> FaultSchedule:
+             model: Optional[FaultModel] = None,
+             equiv: "Optional[object]" = None) -> FaultSchedule:
     """n seeded draws: uniform over all injectable bits x uniform over the
     nominal runtime window (the injection window of threadFunctions.py:451).
 
@@ -257,7 +276,15 @@ def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
     default single-bit stream is bit-identical to the historical one,
     and a multi-site model's BASE sites are that same stream -- the
     extra sites come from a derived expansion stream, so the single-bit
-    component of any model replays the legacy campaign exactly."""
+    component of any model replays the legacy campaign exactly.
+
+    ``equiv`` (a :class:`coast_tpu.analysis.equiv.EquivPartition`)
+    reduces the n-draw stream to one seeded representative per realized
+    propagation-equivalence class: the returned schedule's rows are a
+    subset of the exhaustive stream (first draw of each class, stream
+    order) and carry ``class_weight`` so classification counts multiply
+    back out to the full n.  Only defined for the single-bit model --
+    flip-group outcomes are not site-equivalence-reasoned."""
     with obs.span("schedule", n=n, seed=seed):
         raw = splitmix_fill(seed, 2 * n)      # uint64 stream, native or numpy
         flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
@@ -265,10 +292,22 @@ def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
         leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
         sched = FaultSchedule(leaf_id, lane, word, bit, t,
                               sec_idx.astype(np.int32), seed)
-        if model is None or model.kind == "single":
-            return sched
-        with obs.span("schedule_expand", model=model.spec()):
-            return _expand(mmap, sched, model, seed, nominal_steps)
+        if model is not None and model.kind != "single":
+            if equiv is not None:
+                raise ValueError(
+                    "equiv= reduction is defined for the single-bit "
+                    f"fault model, not {model.spec()!r}: a flip GROUP's "
+                    "outcome is not a function of one site's "
+                    "propagation class")
+            with obs.span("schedule_expand", model=model.spec()):
+                return _expand(mmap, sched, model, seed, nominal_steps)
+        if equiv is not None:
+            with obs.span("schedule_equiv"):
+                reduced = equiv.reduce(sched)
+                obs.count("equiv_reduced_rows", len(sched) - len(reduced),
+                          physical=len(reduced), effective=len(sched))
+                return reduced
+        return sched
 
 
 def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,

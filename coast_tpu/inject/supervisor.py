@@ -111,6 +111,26 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "(multibit:k=3).  Recorded in the log summary and "
                         "the journal header; resume under a different "
                         "model is refused with a typed error")
+    parser.add_argument("--equiv", action="store_true",
+                        help="fault-site equivalence reduction "
+                        "(analysis/equiv): statically partition the "
+                        "site space into propagation classes, inject "
+                        "ONE representative per class, and multiply "
+                        "counts by the class weights -- the reported "
+                        "distribution is over effective injections and "
+                        "exactly matches the exhaustive campaign at a "
+                        "fraction of the dispatches.  Seeded -t "
+                        "campaigns only; single-bit fault model only")
+    parser.add_argument("--delta-from", type=str, default=None,
+                        metavar="JOURNAL",
+                        help="delta campaign: re-inject only the "
+                        "sections whose propagation fingerprint changed "
+                        "since JOURNAL (a completed --equiv --journal "
+                        "run of the same campaign) was written, and "
+                        "splice the recorded outcomes for the rest.  A "
+                        "no-op rebuild re-injects zero rows.  Implies "
+                        "--equiv; incompatible journals are refused "
+                        "with a typed error")
     parser.add_argument("--stratified", action="store_true",
                         help="equal-allocation sampling per section: -t "
                         "is divided across sections (floored at 1 each, "
@@ -241,6 +261,27 @@ def parse_command_line(argv: Optional[List[str]] = None):
               "--errorCount, --forceBreak, -q/--no-logging, or the "
               "default json format)", file=sys.stderr)
         sys.exit(-1)
+    if args.delta_from:
+        args.equiv = True      # fingerprints come from the partition
+    if args.equiv and (args.forceBreak or args.stratified or args.errorCount
+                       or args.section in CACHE_SECTIONS):
+        # The partition reasons over the seeded generate() stream; the
+        # sizing loop, strata, cache overlays, and forced one-offs draw
+        # schedules it is not defined over.
+        print("Error, --equiv/--delta-from apply to the seeded -t "
+              "campaign path, not -e/--errorCount, --stratified, "
+              "--forceBreak, or cache sections", file=sys.stderr)
+        sys.exit(-1)
+    if args.equiv and args.fault_model != "single":
+        print("Error, --equiv needs the single-bit fault model (a flip "
+              "group has no per-site propagation class)", file=sys.stderr)
+        sys.exit(-1)
+    if args.delta_from and (args.journal or args.resume
+                            or args.stream_logs):
+        print("Error, --delta-from reads its journal as the splice base; "
+              "it cannot be combined with --journal/--resume/"
+              "--stream-logs", file=sys.stderr)
+        sys.exit(-1)
     if args.journal and (args.forceBreak or args.stratified
                          or args.section in CACHE_SECTIONS):
         # Forced injections are debug one-offs; cache/stratified schedules
@@ -351,8 +392,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 unroll=args.unroll,
                                 retry=retry,
                                 mesh=mesh,
-                                fault_model=args.fault_model_parsed)
-    except ValueError:
+                                fault_model=args.fault_model_parsed,
+                                equiv=args.equiv)
+    except ValueError as e:
+        if args.equiv:
+            print(f"Error, {e}", file=sys.stderr)
+            return 1
         print(f"Error, {prog.region.name} has no injectable leaves in "
               f"section '{args.section}'!", file=sys.stderr)
         return 1
@@ -424,6 +469,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             res = runner.run_schedule(
                 sched, batch_size=min(args.batch_size, len(sched)),
                 stream=stream)
+        elif args.delta_from:
+            from coast_tpu.analysis.equiv import DeltaMismatchError
+            try:
+                res = runner.run_delta(args.t, args.delta_from,
+                                       seed=args.seed,
+                                       batch_size=args.batch_size,
+                                       start_num=args.start_num)
+            except DeltaMismatchError as e:
+                print(f"Error, {e}", file=sys.stderr)
+                return 1
         else:
             res = runner.run(args.t, seed=args.seed,
                              batch_size=args.batch_size,

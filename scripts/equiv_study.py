@@ -1,0 +1,130 @@
+"""Equivalence-reduction parity study: exhaustive vs reduced campaigns.
+
+The FastFlip contract, measured and recorded: for each (target,
+strategy) cell, run the same seeded campaign twice -- exhaustively and
+equivalence-reduced (one representative per propagation class,
+class-weighted counts) -- and require the classification distributions
+to be IDENTICAL (FuzzyFlow's differential idiom: exhaustive and
+composed must agree).  The artifact records the measured physical-
+injection reduction per cell plus each partition's per-section merge
+modes; acceptance pins >= 5x on at least one target.
+
+Usage: python scripts/equiv_study.py [--out artifacts/equiv_study.json]
+       [--benchmarks mm,crc16] [--strategies TMR,DWC] [-n 16384]
+       [--seed 2026] [--cpu]
+
+Exit status 1 if any cell's distributions differ.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Registry names of the default study targets (small, fast to compile,
+#: and covering the merge-mode spectrum: mm has free/lt/ltw/exhaustive
+#: sections, crc16 a value-fed register that must stay exhaustive).
+DEFAULT_BENCHMARKS = ("matrixMultiply", "crc16")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/equiv_study.json")
+    ap.add_argument("--benchmarks",
+                    default=",".join(DEFAULT_BENCHMARKS))
+    ap.add_argument("--strategies", default="TMR,DWC")
+    ap.add_argument("-n", type=int, default=16384)
+    ap.add_argument("--seed", type=int, default=2026)
+    ap.add_argument("--batch-size", type=int, default=2048)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import DWC, TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import REGISTRY
+
+    makers = {"TMR": TMR, "DWC": DWC}
+    strategies = [s.strip() for s in args.strategies.split(",") if s.strip()]
+    benches = [b.strip() for b in args.benchmarks.split(",") if b.strip()]
+    for b in benches:
+        if b not in REGISTRY:
+            print(f"ERROR: unknown benchmark {b}", file=sys.stderr)
+            return 2
+
+    doc = {"backend": jax.default_backend(),
+           "n": args.n, "seed": args.seed,
+           "strategies": strategies,
+           "targets": {}}
+    all_match = True
+    best_reduction = 0.0
+    t_start = time.time()
+    for bench in benches:
+        row = {}
+        for strat in strategies:
+            prog = makers[strat](REGISTRY[bench]())
+            exhaustive = CampaignRunner(prog, strategy_name=strat)
+            t0 = time.time()
+            reduced = CampaignRunner(prog, strategy_name=strat, equiv=True)
+            analysis_s = time.time() - t0
+
+            t0 = time.time()
+            a = exhaustive.run(args.n, seed=args.seed,
+                               batch_size=args.batch_size)
+            exhaustive_s = time.time() - t0
+            t0 = time.time()
+            b = reduced.run(args.n, seed=args.seed,
+                            batch_size=min(args.batch_size,
+                                           args.n))
+            reduced_s = time.time() - t0
+
+            match = a.counts == b.counts
+            all_match &= match
+            reduction = (b.n / b.physical_n) if b.physical_n else 0.0
+            best_reduction = max(best_reduction, reduction)
+            part = reduced.equiv_partition
+            row[strat] = {
+                "distributions_match": match,
+                "counts": {k: v for k, v in a.counts.items() if v},
+                "counts_reduced": {k: v for k, v in b.counts.items() if v},
+                "physical_injections": b.physical_n,
+                "effective_injections": b.n,
+                "reduction_x": round(reduction, 2),
+                "clean_steps": part.clean_steps,
+                "section_modes": {
+                    name: sig.mode_name
+                    for name, sig in sorted(part.signatures.items())},
+                "seconds": {"analysis": round(analysis_s, 3),
+                            "exhaustive": round(exhaustive_s, 3),
+                            "reduced": round(reduced_s, 3)},
+            }
+            status = "MATCH" if match else "MISMATCH"
+            print(f"# {bench:<16} {strat:<4} {status}  "
+                  f"{b.physical_n}/{b.n} physical ({reduction:.1f}x)  "
+                  f"exhaustive {exhaustive_s:.1f}s -> reduced "
+                  f"{reduced_s:.1f}s", file=sys.stderr, flush=True)
+        doc["targets"][bench] = row
+    doc["seconds"] = round(time.time() - t_start, 3)
+    doc["all_distributions_match"] = all_match
+    doc["best_reduction_x"] = round(best_reduction, 2)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": all_match,
+                      "best_reduction_x": doc["best_reduction_x"],
+                      "targets": len(benches), "out": args.out}))
+    return 0 if all_match else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,15 @@ recorded proof that the default protected builds carry their redundancy
 through compilation (ISSUE acceptance: the default-TMR sweep must be
 finding-free).  Exit status 1 if any error finding survives.
 
+Since the equivalence pass (analysis/equiv) shares the provenance walk,
+the sweep also times it per target and records each section's merge
+mode -- one artifact shows both what the linter proved and how far the
+campaign space prunes.  Per-target wall clock (lint + equiv) is
+recorded so sweep-time regressions show up in the diff.
+
 Usage: python scripts/lint_sweep.py [--out artifacts/lint_sweep.json]
        [--strategies TMR,DWC] [--benchmarks a,b | --fast] [--no-survival]
-       [--cpu]
+       [--no-equiv] [--cpu]
 
 ``--fast`` sweeps the small tier-1 subset (the same one
 tests/test_lint.py::test_registry_subset_sweep_clean checks).
@@ -40,6 +46,8 @@ def main(argv=None) -> int:
     ap.add_argument("--fast", action="store_true",
                     help=f"sweep only {','.join(FAST_SUBSET)}")
     ap.add_argument("--no-survival", action="store_true")
+    ap.add_argument("--no-equiv", action="store_true",
+                    help="skip the equivalence-partition timing pass")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
 
@@ -70,20 +78,48 @@ def main(argv=None) -> int:
         return 2
 
     survival = not args.no_survival
+    equiv_on = not args.no_equiv
     t_start = time.time()
     doc = {"backend": jax.default_backend(),
            "survival": survival,
+           "equiv": equiv_on,
            "strategies": strategies,
-           "benchmarks": {}}
+           "benchmarks": {},
+           "target_seconds": {}}
     n_errors = 0
     for bench in benches:
         row = {}
+        t_bench = time.time()
         for strat in strategies:
             t0 = time.time()
             prog = makers[strat](REGISTRY[bench]())
-            rep = lint.lint_program(prog, survival=survival, strategy=strat)
+            # One trace shared by the lint passes AND the equivalence
+            # partition: the walk is the expensive part, time it once.
+            closed = lint.trace_step(prog)
+            rep = lint.lint_program(prog, survival=survival, strategy=strat,
+                                    closed=closed)
             row[strat] = {**rep.to_dict(),
                           "seconds": round(time.time() - t0, 3)}
+            if equiv_on:
+                from coast_tpu.analysis.equiv import analyze_equivalence
+                t_eq = time.time()
+                try:
+                    part = analyze_equivalence(prog, closed=closed)
+                    modes = {}
+                    for sig in part.signatures.values():
+                        modes[sig.mode_name] = modes.get(sig.mode_name,
+                                                         0) + 1
+                    row[strat]["equiv"] = {
+                        "seconds": round(time.time() - t_eq, 3),
+                        "clean_steps": part.clean_steps,
+                        "sections": len(part.signatures),
+                        "modes": modes,
+                        "partition_sha": part.fingerprint,
+                    }
+                except Exception as e:  # noqa: BLE001 - sweep keeps going
+                    row[strat]["equiv"] = {
+                        "seconds": round(time.time() - t_eq, 3),
+                        "error": f"{type(e).__name__}: {e}"}
             n_errors += len(rep.errors())
             status = "ok" if rep.ok else "FINDINGS"
             print(f"# {bench:<24} {strat:<4} {status:<9} "
@@ -93,6 +129,7 @@ def main(argv=None) -> int:
                 for f in rep.errors():
                     print("#   " + f.format(), file=sys.stderr, flush=True)
         doc["benchmarks"][bench] = row
+        doc["target_seconds"][bench] = round(time.time() - t_bench, 3)
     doc["seconds"] = round(time.time() - t_start, 3)
     doc["total_errors"] = n_errors
     doc["ok"] = n_errors == 0

@@ -1,0 +1,497 @@
+"""Fault-site equivalence analysis + delta campaigns (analysis/equiv).
+
+The FastFlip/FuzzyFlow acceptance contract, pinned:
+
+  * differential parity -- the equivalence-reduced campaign's weighted
+    classification distribution EXACTLY equals the exhaustive one on
+    seeded registry targets under both TMR and DWC;
+  * measured reduction -- the recorded parity study artifact shows
+    >= 5x physical-injection reduction on at least one target;
+  * delta campaigns -- a no-op rebuild re-injects zero sections, a
+    seeded one-section edit re-injects exactly that section, and
+    incompatible/pre-equiv journals refuse with typed errors;
+  * journal evolution -- journals written before the fingerprint block
+    existed still open and resume cleanly (absent-means-legacy, the
+    PR 6 fault-model rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR
+from coast_tpu.analysis.equiv import (DeltaMismatchError, analyze_equivalence,
+                                      section_fingerprints)
+from coast_tpu.analysis.equiv.partition import (MODE_EXH, MODE_FREE, MODE_LT,
+                                                MODE_LTW)
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.journal import JournalMismatchError
+from coast_tpu.inject.schedule import FaultModel, FaultSchedule, generate
+from coast_tpu.models import crc16, mm
+
+
+@pytest.fixture(scope="module")
+def mm_region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def mm_tmr(mm_region):
+    return TMR(mm_region)
+
+
+@pytest.fixture(scope="module")
+def mm_tmr_equiv(mm_tmr):
+    return CampaignRunner(mm_tmr, strategy_name="TMR", equiv=True)
+
+
+class _Kill(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# the static partition
+# ---------------------------------------------------------------------------
+
+def test_partition_modes_mm(mm_tmr_equiv):
+    """The derived merge modes match the engine's invariants: golden is
+    unconsumed + compare-transparent (free), the unwritten operand
+    matrices and the pre-voted index self-witness (lt), the structurally
+    written leaves merge per word (ltw), and phase -- whose flipped
+    value steers a predicate, the bit-maskable case -- stays
+    exhaustive."""
+    sigs = mm_tmr_equiv.equiv_partition.signatures
+    assert sigs["golden"].mode == MODE_FREE
+    assert sigs["first"].mode == MODE_LT
+    assert sigs["second"].mode == MODE_LT
+    assert sigs["i"].mode == MODE_LT and sigs["i"].pre_voted
+    assert sigs["acc"].mode == MODE_LTW
+    assert sigs["results"].mode == MODE_LTW
+    assert sigs["phase"].mode == MODE_EXH and sigs["phase"].value_fed
+
+
+def test_value_fed_register_stays_exhaustive():
+    """crc16's crc accumulator feeds shifts/xors of itself: a flipped
+    high bit can be shifted out before any compare (bit-dependent
+    masking), so the pass must refuse to merge it."""
+    part = analyze_equivalence(TMR(crc16.make_region()))
+    assert part.signatures["crc"].mode == MODE_EXH
+    assert part.signatures["crc"].value_fed
+
+
+def test_dead_window_is_one_class(mm_tmr_equiv):
+    part = mm_tmr_equiv.equiv_partition
+    n = 6
+    sched = FaultSchedule(
+        np.zeros(n, np.int32), np.arange(n, dtype=np.int32) % 3,
+        np.arange(n, dtype=np.int32), np.arange(n, dtype=np.int32),
+        np.full(n, part.clean_steps + 5, np.int32),
+        np.zeros(n, np.int32), seed=0)
+    keys = part.class_keys(sched)
+    assert (keys == -1).all()      # one global never-fires class
+    reduced = part.reduce(sched)
+    assert len(reduced) == 1 and reduced.class_weight.sum() == n
+
+
+def test_generate_equiv_api(mm_tmr_equiv):
+    runner = mm_tmr_equiv
+    part = runner.equiv_partition
+    full = generate(runner.mmap, 2048, 7, 18)
+    red = generate(runner.mmap, 2048, 7, 18, equiv=part)
+    assert red.class_weight is not None
+    assert red.effective_n == 2048 and len(red) < 2048
+    assert red.equiv_sha == part.fingerprint
+    # Representatives are actual rows of the exhaustive stream, in order.
+    full_keys = {(a, b, c, d, e) for a, b, c, d, e in zip(
+        full.leaf_id, full.lane, full.word, full.bit, full.t)}
+    for row in zip(red.leaf_id, red.lane, red.word, red.bit, red.t):
+        assert tuple(int(x) for x in row) in full_keys
+    with pytest.raises(ValueError, match="single-bit"):
+        generate(runner.mmap, 64, 7, 18, model=FaultModel.multibit(k=2),
+                 equiv=part)
+    with pytest.raises(ValueError, match="single"):
+        CampaignRunner(runner.prog, equiv=True,
+                       fault_model=FaultModel.cluster())
+
+
+# ---------------------------------------------------------------------------
+# differential parity (the acceptance pin): reduced == exhaustive
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("maker,strat", [(TMR, "TMR"), (DWC, "DWC")])
+def test_differential_parity_mm(mm_region, maker, strat):
+    prog = maker(mm_region)
+    a = CampaignRunner(prog, strategy_name=strat).run(
+        2048, seed=11, batch_size=512)
+    eq = CampaignRunner(prog, strategy_name=strat, equiv=True)
+    b = eq.run(2048, seed=11, batch_size=512)
+    assert a.counts == b.counts          # identical distribution, exactly
+    assert b.n == 2048 and b.physical_n < 2048
+    assert int(b.schedule.class_weight.sum()) == 2048
+
+
+@pytest.mark.parametrize("maker,strat", [(TMR, "TMR"), (DWC, "DWC")])
+def test_differential_parity_crc16(maker, strat):
+    prog = maker(crc16.make_region())
+    a = CampaignRunner(prog, strategy_name=strat).run(
+        2048, seed=13, batch_size=512)
+    b = CampaignRunner(prog, strategy_name=strat, equiv=True).run(
+        2048, seed=13, batch_size=512)
+    assert a.counts == b.counts
+    # >= 5x on this target at this size (the study artifact records the
+    # full-size numbers; this is the in-tree floor).
+    assert b.n / b.physical_n >= 5.0
+
+
+def test_equiv_study_artifact_recorded():
+    """The recorded parity study: every cell matches and at least one
+    target shows >= 5x physical-injection reduction."""
+    path = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                        "equiv_study.json")
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["all_distributions_match"] is True
+    assert doc["best_reduction_x"] >= 5.0
+    assert {"matrixMultiply", "crc16"} <= set(doc["targets"])
+    for bench, row in doc["targets"].items():
+        for strat, cell in row.items():
+            assert cell["distributions_match"], (bench, strat)
+            assert cell["counts"] == cell["counts_reduced"], (bench, strat)
+
+
+# ---------------------------------------------------------------------------
+# logs + parser: weight column, effective vs physical
+# ---------------------------------------------------------------------------
+
+def test_weighted_logs_roundtrip(mm_tmr_equiv, tmp_path):
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject import logs
+    runner = mm_tmr_equiv
+    res = runner.run(2048, seed=3, batch_size=512)
+    for fmt, writer in (("ndjson", logs.write_ndjson),
+                        ("columnar", logs.write_columnar),
+                        ("json", logs.write_json)):
+        path = str(tmp_path / f"eq_{fmt}.json")
+        writer(res, runner.mmap, path)
+        s = jp.summarize_path(path)
+        assert s.n == 2048
+        assert s.physical_n == res.physical_n
+        assert {k: s.counts[k] for k in s.counts if s.counts[k]} == \
+            {k: res.counts[k] for k in res.counts
+             if res.counts[k] and k != "cache_invalid"}
+        text = s.format()
+        assert "effective" in text and "physical" in text
+    summary = res.summary()
+    assert summary["physical_injections"] == res.physical_n
+    assert summary["equiv_reduction"] == round(2048 / res.physical_n, 2)
+
+
+def test_exhaustive_logs_unchanged(mm_tmr, tmp_path):
+    """No weight key anywhere for ordinary campaigns: pre-equiv byte
+    parity (the fault-model absent-key rule)."""
+    from coast_tpu.inject import logs
+    runner = CampaignRunner(mm_tmr, strategy_name="TMR")
+    res = runner.run(256, seed=3, batch_size=128)
+    assert res.physical_n is None
+    assert "physical_injections" not in res.summary()
+    path = str(tmp_path / "plain.ndjson")
+    logs.write_ndjson(res, runner.mmap, path)
+    with open(path) as fh:
+        assert "weight" not in fh.read()
+
+
+def test_compare_runs_weight_aware_nan_safe():
+    from coast_tpu.analysis.json_parser import Summary, compare_runs
+    counts_a = {"success": 0, "corrected": 0, "sdc": 10, "due_abort": 0,
+                "due_timeout": 90, "invalid": 0, "due_stack_overflow": 0,
+                "due_assert": 0}
+    base = Summary(name="a", n=100, counts=dict(counts_a), seconds=1.0,
+                   mean_steps=float("nan"))
+    new = Summary(name="b", n=100, counts=dict(counts_a), seconds=1.0,
+                  mean_steps=float("nan"), physical_n=10)
+    cmp = compare_runs(base, new)
+    assert cmp["error_rate_x"] == 1.0          # weighted rates compare
+    assert np.isnan(cmp["steps_x"])
+    # physical_n drives the timing denominator
+    assert new.seconds_per_injection() == 0.1
+    # without wall-clock, runtime falls back to the NaN step ratio and
+    # MWTF propagates NaN instead of crashing (the PR 2 guard)
+    base2 = dataclasses.replace(base, seconds=0.0)
+    new2 = dataclasses.replace(new, seconds=0.0)
+    cmp2 = compare_runs(base2, new2)
+    assert np.isnan(cmp2["mwtf"]) and np.isnan(cmp2["runtime_x"])
+
+
+# ---------------------------------------------------------------------------
+# journals: identity, resume, evolution
+# ---------------------------------------------------------------------------
+
+def test_equiv_journal_resume_bit_for_bit(mm_tmr_equiv, tmp_path):
+    runner = mm_tmr_equiv
+    baseline = runner.run(1024, seed=5, batch_size=256)
+    jpath = str(tmp_path / "eq.journal")
+    beats = {"n": 0}
+
+    def kill_on_second(done, counts):
+        beats["n"] += 1
+        if beats["n"] >= 2:
+            raise _Kill
+
+    with pytest.raises(_Kill):
+        runner.run(1024, seed=5, batch_size=256, journal=jpath,
+                   progress=kill_on_second)
+    resumed = runner.run(1024, seed=5, batch_size=256, journal=jpath)
+    assert np.array_equal(resumed.codes, baseline.codes)
+    assert resumed.counts == baseline.counts
+    assert resumed.physical_n == baseline.physical_n
+
+
+def test_partition_mismatch_refused(mm_tmr, mm_tmr_equiv, tmp_path):
+    """A journal written under the partition must not resume without it
+    (and vice versa): the row records are per-representative."""
+    jpath = str(tmp_path / "eq2.journal")
+    mm_tmr_equiv.run(512, seed=5, batch_size=256, journal=jpath)
+    plain = CampaignRunner(mm_tmr, strategy_name="TMR")
+    with pytest.raises(JournalMismatchError):
+        plain.run(512, seed=5, batch_size=256, journal=jpath)
+    jpath2 = str(tmp_path / "plain2.journal")
+    plain.run(512, seed=5, batch_size=256, journal=jpath2)
+    with pytest.raises(JournalMismatchError):
+        mm_tmr_equiv.run(512, seed=5, batch_size=256, journal=jpath2)
+
+
+def test_pre_fingerprint_journal_resumes(mm_tmr_equiv, tmp_path):
+    """Journal-header evolution: a journal whose header predates the
+    (volatile) section-fingerprint block still opens and resumes
+    cleanly -- mirroring the absent-means-single fault-model rule."""
+    runner = mm_tmr_equiv
+    jpath = str(tmp_path / "old.journal")
+    beats = {"n": 0}
+
+    def kill_on_second(done, counts):
+        beats["n"] += 1
+        if beats["n"] >= 2:
+            raise _Kill
+
+    with pytest.raises(_Kill):
+        runner.run(1024, seed=5, batch_size=256, journal=jpath,
+                   progress=kill_on_second)
+    # Strip the fingerprint block from the on-disk header, simulating a
+    # journal written before the block existed.
+    with open(jpath) as fh:
+        lines = fh.read().splitlines()
+    header = json.loads(lines[0])
+    assert header.pop("section_fingerprints")
+    with open(jpath, "w") as fh:
+        fh.write("\n".join([json.dumps(header, separators=(",", ":"))]
+                           + lines[1:]) + "\n")
+    baseline = runner.run(1024, seed=5, batch_size=256)
+    resumed = runner.run(1024, seed=5, batch_size=256, journal=jpath)
+    assert np.array_equal(resumed.codes, baseline.codes)
+
+
+# ---------------------------------------------------------------------------
+# delta campaigns
+# ---------------------------------------------------------------------------
+
+def _edited_region():
+    """A one-section edit: golden's check consumption gains an xor
+    BEFORE its compare, so only golden's cone (and fingerprint)
+    changes."""
+    region = mm.make_region()
+    old_check = region.check
+
+    def new_check(state):
+        state2 = dict(state)
+        state2["golden"] = state["golden"] ^ jnp.uint32(0)
+        return old_check(state2)
+
+    return dataclasses.replace(region, check=new_check)
+
+
+def test_delta_noop_rebuild_reinjects_zero(mm_tmr_equiv, tmp_path):
+    jpath = str(tmp_path / "base.journal")
+    base = mm_tmr_equiv.run(2048, seed=3, batch_size=512, journal=jpath)
+    rebuilt = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR",
+                             equiv=True)
+    res = rebuilt.run_delta(2048, jpath, seed=3, batch_size=512)
+    assert res.delta["changed_sections"] == []
+    assert res.delta["reinjected_rows"] == 0
+    assert res.delta["reused_rows"] == base.physical_n
+    assert res.counts == base.counts
+    assert np.array_equal(res.codes, base.codes)
+    assert "delta" in res.summary()
+
+
+def test_delta_one_section_edit_reinjects_exactly_it(mm_tmr_equiv,
+                                                     tmp_path):
+    jpath = str(tmp_path / "base2.journal")
+    base = mm_tmr_equiv.run(2048, seed=3, batch_size=512, journal=jpath)
+    edited = CampaignRunner(TMR(_edited_region()), strategy_name="TMR",
+                            equiv=True)
+    old_fp = section_fingerprints(mm_tmr_equiv.prog,
+                                  mm_tmr_equiv.equiv_partition)
+    new_fp = section_fingerprints(edited.prog, edited.equiv_partition)
+    assert {k for k in new_fp if new_fp[k] != old_fp[k]} == {"golden"}
+    res = edited.run_delta(2048, jpath, seed=3, batch_size=512)
+    assert res.delta["changed_sections"] == ["golden"]
+    # Every re-injected row targets golden; everything else spliced.
+    golden_id = edited.equiv_partition.signatures["golden"].leaf_id
+    reinjected = res.delta["reinjected_rows"]
+    assert reinjected == int(
+        (np.asarray(res.schedule.leaf_id) == golden_id).sum())
+    assert res.delta["reused_rows"] + reinjected == res.physical_n
+    # The edit is semantically a no-op, so the distribution is the
+    # base's distribution.
+    assert res.counts == base.counts
+
+
+def test_delta_positional_fallback_validates_schedule_sha(mm_tmr_equiv,
+                                                          tmp_path):
+    """A base journal with the fingerprint block but no equiv_schedule
+    record (journaled outside CampaignRunner.run) splices by position
+    ONLY when the regenerated schedule's fingerprint matches; a drifted
+    partition refuses instead of silently misaligning rows."""
+    jpath = str(tmp_path / "norec.journal")
+    base = mm_tmr_equiv.run(1024, seed=3, batch_size=256, journal=jpath)
+    with open(jpath) as fh:
+        lines = fh.read().splitlines()
+    kept = [ln for ln in lines
+            if json.loads(ln).get("kind") != "equiv_schedule"]
+    with open(jpath, "w") as fh:
+        fh.write("\n".join(kept) + "\n")
+    # Unchanged program: positional splice is sound and succeeds.
+    res = mm_tmr_equiv.run_delta(1024, jpath, seed=3, batch_size=256)
+    assert res.delta["reinjected_rows"] == 0
+    assert np.array_equal(res.codes, base.codes)
+    # Changed program (partition drift): refused -- here by the row
+    # count; when the counts coincide, by the schedule sha (below).
+    edited = CampaignRunner(TMR(_edited_region()), strategy_name="TMR",
+                            equiv=True)
+    with pytest.raises(DeltaMismatchError):
+        edited.run_delta(1024, jpath, seed=3, batch_size=256)
+    # Same row COUNT but different rows: the sha check alone must
+    # refuse the positional splice (unit-level, fabricated base).
+    from coast_tpu.analysis.equiv.delta import load_delta_base, plan_delta
+    header, _, base_out, base_rows = load_delta_base(jpath)
+    part = mm_tmr_equiv.equiv_partition
+    sched = part.reduce(generate(mm_tmr_equiv.mmap, 1024, 3, 18))
+    shifted = dataclasses.replace(
+        sched, bit=(np.asarray(sched.bit) + 1) % 32)   # same count, new sites
+    fps = {name: sig.fingerprint for name, sig in part.signatures.items()}
+    names = {sig.leaf_id: name for name, sig in part.signatures.items()}
+    current = {k: header.get(k) for k in
+               ("mode", "benchmark", "strategy", "seed", "n", "start_num")}
+    with pytest.raises(DeltaMismatchError, match="equiv_schedule"):
+        plan_delta(header, None, base_out, base_rows, current, fps,
+                   shifted, names, base_path=jpath)
+
+
+def test_delta_typed_refusals(mm_tmr, mm_tmr_equiv, tmp_path):
+    jpath = str(tmp_path / "base3.journal")
+    mm_tmr_equiv.run(512, seed=3, batch_size=256, journal=jpath)
+    # different seed: not the same campaign
+    with pytest.raises(DeltaMismatchError, match="seed"):
+        mm_tmr_equiv.run_delta(512, jpath, seed=4, batch_size=256)
+    # pre-equiv base: no fingerprint block
+    plain_j = str(tmp_path / "plain3.journal")
+    CampaignRunner(mm_tmr, strategy_name="TMR").run(
+        512, seed=3, batch_size=256, journal=plain_j)
+    with pytest.raises(DeltaMismatchError, match="fingerprint"):
+        mm_tmr_equiv.run_delta(512, plain_j, seed=3, batch_size=256)
+    # incomplete base: missing rows
+    torn = str(tmp_path / "torn.journal")
+    with open(jpath) as fh:
+        lines = fh.read().splitlines()
+    keep = [ln for ln in lines
+            if json.loads(ln).get("kind") != "batch"]
+    with open(torn, "w") as fh:
+        fh.write("\n".join(keep) + "\n")
+    with pytest.raises(DeltaMismatchError, match="rows"):
+        mm_tmr_equiv.run_delta(512, torn, seed=3, batch_size=256)
+    # a runner without the partition cannot delta at all
+    with pytest.raises(ValueError, match="equiv=True"):
+        CampaignRunner(mm_tmr, strategy_name="TMR").run_delta(
+            512, jpath, seed=3)
+
+
+def test_sharded_mesh_equiv_parity(mm_tmr, mm_tmr_equiv):
+    """The reduced schedule shards like any other: mesh backend counts
+    and codes identical to single-device at the same seed/partition."""
+    from coast_tpu.parallel.mesh import make_mesh
+    sharded = CampaignRunner(mm_tmr, strategy_name="TMR", equiv=True,
+                             mesh=make_mesh(8))
+    a = mm_tmr_equiv.run(1024, seed=9, batch_size=256)
+    b = sharded.run(1024, seed=9, batch_size=256)
+    assert a.counts == b.counts
+    assert a.physical_n == b.physical_n
+    assert np.array_equal(a.codes, b.codes)
+
+
+# ---------------------------------------------------------------------------
+# findings determinism (satellite)
+# ---------------------------------------------------------------------------
+
+def test_findings_json_deterministically_ordered(tmp_path):
+    from coast_tpu.analysis.lint.findings import LintReport
+    a = LintReport(benchmark="x", strategy="TMR")
+    b = LintReport(benchmark="x", strategy="TMR")
+    rows = [("spof", "error", "leaf:b", "m1"),
+            ("lane-collapse", "error", "eqn:z", "m2"),
+            ("spof", "note", "leaf:a", "m3"),
+            ("lane-collapse", "error", "eqn:a", "m4")]
+    for rule, sev, locus, msg in rows:
+        a.add(rule, sev, locus, msg)
+    for rule, sev, locus, msg in reversed(rows):
+        b.add(rule, sev, locus, msg)
+    keys_a = [(f["rule"], f["locus"]) for f in a.to_dict()["findings"]]
+    keys_b = [(f["rule"], f["locus"]) for f in b.to_dict()["findings"]]
+    assert keys_a == keys_b == sorted(keys_a)
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a.write_json(pa)
+    b.write_json(pb)
+    assert open(pa).read() == open(pb).read()
+    # baseline files were already sorted; pin that too
+    ba, bb = str(tmp_path / "ba.json"), str(tmp_path / "bb.json")
+    a.write_baseline(ba)
+    b.write_baseline(bb)
+    assert open(ba).read() == open(bb).read()
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+def test_supervisor_equiv_cli(tmp_path, capsys):
+    from coast_tpu.inject import supervisor
+    log_dir = str(tmp_path)
+    rc = supervisor.main(["-f", "matrixMultiply", "-O=-TMR", "-t", "256",
+                          "--equiv", "--board", "cpu", "--seed", "3",
+                          "--batch-size", "128", "-l", log_dir,
+                          "--log-format", "columnar"])
+    assert rc == 0
+    log = json.load(open(os.path.join(
+        log_dir, "matrixMultiply_TMR_memory.json")))
+    assert log["summary"]["physical_injections"] < 256
+    assert "weight" in log["columns"]
+
+
+def test_supervisor_equiv_flag_gates():
+    from coast_tpu.inject import supervisor
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "--equiv", "--fault-model",
+             "multibit(k=2)", "-t", "8"])
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "--equiv", "--stratified", "-t", "8"])
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "--delta-from", "x.journal",
+             "--journal", "y.journal", "-t", "8"])
