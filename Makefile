@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -90,6 +90,12 @@ faultmodel_smoke:
 # with typed partition-mismatch refusal, no-op delta re-injects zero.
 equiv_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.equiv_smoke
+
+# Live-observability smoke (also a fast.yml driver row): HTTP metrics +
+# atomic status file tracking a running campaign, Wilson-CI early stop
+# soundness vs the exhaustive run, journaled early-stop resume parity.
+obs_live_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.obs_live_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

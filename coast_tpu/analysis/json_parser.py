@@ -147,6 +147,13 @@ class Summary:
     # dispatched.  None for exhaustive campaigns (no weight keys in the
     # log), so pre-equiv logs summarize exactly as before.
     physical_n: Optional[int] = None
+    # Statistical-convergence block (coast_tpu.obs.convergence) from the
+    # log summary: the stop condition, whether it tripped (``stopped``),
+    # done-vs-planned effective injections, and the per-class Wilson
+    # intervals the campaign ended with.  None for campaigns run without
+    # ``stop_when`` and for directory aggregates mixing several logs
+    # (intervals do not aggregate across campaigns).
+    convergence: Optional[Dict[str, object]] = None
 
     @property
     def due(self) -> int:
@@ -226,6 +233,40 @@ class Summary:
             lines.append("  --- resilience ---")
             for key, count in sorted(self.resilience.items()):
                 lines.append(f"  {key:<16} {count:>6}")
+        if self.convergence:
+            conv = self.convergence
+            lines.append("  --- convergence ---")
+            state = ("STOPPED early" if conv.get("stopped")
+                     else "ran to completion")
+            lines.append(
+                f"  {state} at {conv.get('done_n', '?')}/"
+                f"{conv.get('planned_n', '?')} effective injections"
+                + (f"  (stop_when {conv['stop_when']})"
+                   if conv.get("stop_when") else ""))
+            intervals = conv.get("intervals") or {}
+            targets = set()
+            if conv.get("stop_when"):
+                # The spec grammar has ONE owner (StopWhen.parse); an
+                # unparseable spec (written by a future version) just
+                # loses the target marks, never the summary.
+                try:
+                    from coast_tpu.obs.convergence import StopWhen
+                    targets = set(
+                        StopWhen.parse(str(conv["stop_when"])).targets)
+                except Exception:      # noqa: BLE001 - cosmetic marks
+                    targets = set()
+            for cls_name, ci in intervals.items():
+                # Rates the reader cares about: every class that
+                # occurred, plus the stop targets (whose shrinking
+                # zero-count upper bound is the convergence story).
+                if not ci.get("count") and cls_name not in targets:
+                    continue
+                mark = "  <- target" if cls_name in targets else ""
+                lines.append(
+                    f"  {cls_name:<18} {100.0 * ci.get('rate', 0.0):7.3f}%"
+                    f" +-{100.0 * ci.get('half_width', 0.0):6.3f}%"
+                    f"  [{100.0 * ci.get('lo', 0.0):.3f}%,"
+                    f" {100.0 * ci.get('hi', 0.0):.3f}%]{mark}")
         return "\n".join(lines)
 
 
@@ -315,6 +356,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     overlaps: List[float] = []
     resilience: Dict[str, int] = {}
     models: set = set()
+    convergences: List[Dict[str, object]] = []
     for doc in docs:
         if "columns" in doc:                      # vectorised columnar path
             import numpy as np
@@ -370,6 +412,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         for key, cnt in (summary.get("resilience") or {}).items():
             resilience[key] = resilience.get(key, 0) + int(cnt)
         models.add(summary.get("fault_model") or "single")
+        if summary.get("convergence"):
+            convergences.append(summary["convergence"])
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     # The fault-model axis: absent key == the single-bit legacy model.
@@ -386,7 +430,12 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                    stages=stages or None,
                    resilience=resilience or None,
                    fault_model=fault_model,
-                   physical_n=physical if weighted else None)
+                   physical_n=physical if weighted else None,
+                   # Wilson intervals describe ONE campaign's sample;
+                   # a directory mixing several logs has no aggregate
+                   # interval, so only a lone convergence block is kept.
+                   convergence=(convergences[0]
+                                if len(convergences) == 1 else None))
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -422,7 +471,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
             stages=head["summary"].get("stages") or None,
             resilience=head["summary"].get("resilience") or None,
-            fault_model=head["summary"].get("fault_model") or None)
+            fault_model=head["summary"].get("fault_model") or None,
+            convergence=head["summary"].get("convergence") or None)
     except OSError:
         return None
 

@@ -83,6 +83,12 @@ class CampaignResult:
     # Delta-campaign accounting (run_delta): changed sections, reused vs
     # re-injected row counts.  None for ordinary campaigns.
     delta: Optional[Dict[str, object]] = None
+    # Statistical-convergence block (obs/convergence): per-class Wilson
+    # intervals at campaign end, the stop condition, and whether it
+    # tripped (``stopped`` True means the schedule was cut short at
+    # ``done_n`` of ``planned_n`` effective injections).  None unless
+    # the campaign ran with ``stop_when=``.
+    convergence: Optional[Dict[str, object]] = None
 
     @property
     def injections_per_sec(self) -> float:
@@ -108,6 +114,13 @@ class CampaignResult:
         return getattr(self.schedule, "model", None) or FaultModel()
 
     def summary(self) -> Dict[str, object]:
+        stages = {k: round(v, 6) for k, v in self.stages.items()}
+        # ``overlap`` is part of the stage vocabulary, not an optional
+        # extra: 0.0 simply means no serialization was hidden under
+        # dispatch (streaming off).  Always present, so every consumer
+        # (json_parser, mwtf_report, fleet scrapers) can read it without
+        # branching on absence.
+        stages.setdefault("overlap", 0.0)
         out = {
             "benchmark": self.benchmark,
             "strategy": self.strategy,
@@ -117,7 +130,7 @@ class CampaignResult:
             "seconds": round(self.seconds, 6),
             "injections_per_sec": round(self.injections_per_sec, 2),
             "seed": self.seed,
-            "stages": {k: round(v, 6) for k, v in self.stages.items()},
+            "stages": stages,
         }
         # The fault-model axis of the logs: only non-single models add the
         # key, so single-bit campaign logs stay byte-identical to every
@@ -133,6 +146,8 @@ class CampaignResult:
                 self.n / self.physical_n, 2) if self.physical_n else 0.0
         if self.delta is not None:
             out["delta"] = dict(self.delta)
+        if self.convergence is not None:
+            out["convergence"] = dict(self.convergence)
         if self.chunks is not None:
             out["chunks"] = self.chunks
         if self.resilience:
@@ -165,7 +180,8 @@ class CampaignRunner:
                  retry: "Optional[object]" = None,
                  mesh: "Optional[object]" = None,
                  fault_model: "Optional[FaultModel]" = None,
-                 equiv: "bool | object" = False):
+                 equiv: "bool | object" = False,
+                 metrics: "Optional[object]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -226,7 +242,15 @@ class CampaignRunner:
         distribution (the FastFlip contract, pinned differentially in
         tests).  Journals record the partition fingerprint and the
         per-section fingerprints that power ``run_delta``.  Requires
-        the single-bit fault model."""
+        the single-bit fault model.
+
+        ``metrics`` is a :class:`coast_tpu.obs.metrics.CampaignMetrics`
+        hub: every campaign this runner executes feeds it one sample
+        per collected batch (progress, inj/s, weighted class rates,
+        stage totals, resilience counters, device-memory watermark), so
+        a metrics server (:mod:`coast_tpu.obs.serve`), a status-file
+        export, or a live console can observe the campaign while it
+        runs.  None (the default) records nothing."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -237,6 +261,7 @@ class CampaignRunner:
             lint_mod.check(prog, survival=(preflight != "static"))
         self.prog = prog
         self.retry = retry
+        self.metrics = metrics
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
         if equiv and self.fault_model.kind != "single":
@@ -312,7 +337,8 @@ class CampaignRunner:
                      _telemetry_mark: Optional[int] = None,
                      journal: "Optional[object]" = None,
                      journal_base: int = 0,
-                     stream: "Optional[object]" = None
+                     stream: "Optional[object]" = None,
+                     stop_when: "Optional[object]" = None
                      ) -> CampaignResult:
         """Run every row of ``sched`` in edge-padded batches.
 
@@ -348,6 +374,19 @@ class CampaignRunner:
         ``journal_base + lo``.  The caller owns ``finish(res)`` /
         ``abort()`` -- the stream may span several run_schedule calls
         (scripts/campaign_1m.py's sliced chunks).
+
+        ``stop_when`` (:class:`coast_tpu.obs.convergence.StopWhen`)
+        arms statistical early stop: after every collected batch the
+        weighted class histogram's Wilson intervals are checked, and
+        once every target class's CI half-width is at or below its
+        threshold the campaign stops dispatching -- the remaining
+        schedule rows are dropped, the result covers exactly the rows
+        that ran, and ``CampaignResult.convergence`` records the
+        intervals.  With a journal the stop is a first-class terminal
+        record (``kind: "early_stop"``), the stop condition is part of
+        the header identity (resume under a different -- or no --
+        condition refuses), and a resumed campaign replays the prefix
+        and stops at the same batch, bit-for-bit.
         """
         # Deliberately no clamp to len(sched) here: every batch is
         # edge-padded to batch_size so all chunks (including a caller's
@@ -384,7 +423,33 @@ class CampaignRunner:
                     f"partition {header_part!r} but the schedule being "
                     f"run carries {sched_part!r}; refusing to mix "
                     "reduced and exhaustive row records")
+            # Stop condition = campaign identity too: an early-stopped
+            # journal's rows are a prefix chosen BY the condition, so
+            # resuming under a different (or no) condition would either
+            # silently extend a complete campaign or stop a full one
+            # short.
+            header_stop = journal.header.get("stop_when")
+            current_stop = stop_when.spec() if stop_when is not None \
+                else None
+            if header_stop != current_stop:
+                raise JournalMismatchError(
+                    f"journal {journal.path!r} records stop_when="
+                    f"{header_stop!r} but this campaign runs "
+                    f"stop_when={current_stop!r}; an early-stop "
+                    "condition is part of the campaign's identity -- "
+                    "rerun with the original --stop-when (or a fresh "
+                    "journal)")
         retry = self.retry
+        metrics = self.metrics
+        tracker = None
+        if stop_when is not None:
+            from coast_tpu.obs.convergence import ConvergenceTracker
+            tracker = ConvergenceTracker(stop_when)
+        planned_effective = sched.effective_n
+        if metrics is not None:
+            metrics.campaign_started(self.prog.region.name,
+                                     self.strategy_name,
+                                     len(sched), planned_effective)
         tel = self.telemetry
         mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
         t0 = time.perf_counter()
@@ -420,9 +485,27 @@ class CampaignRunner:
             counts_so_far["cache_invalid"] = live_invalid
             return counts_so_far
 
+        def _journal_early_stop(rows: int) -> None:
+            """The ONE builder of the terminal early_stop record (live
+            trip and crash-window backfill must write identical
+            shapes)."""
+            tel.instant("early_stop", rows=rows)
+            if journal is not None:
+                journal.append({
+                    "kind": "early_stop",
+                    "base": int(journal_base),
+                    "rows": int(rows),
+                    "lo": int(journal_base + rows),
+                    "stop_when": stop_when.spec(),
+                    "half_widths": {
+                        k: round(v["half_width"], 8)
+                        for k, v in tracker.intervals().items()},
+                })
+
         # Resume: replay the journal's contiguous completed-batch prefix
         # (rows [journal_base, ...) in stream coordinates) from disk, so
         # the dispatch loop below starts at the first missing batch.
+        stopped = False
         if journal is not None:
             for rec in journal.batch_prefix(journal_base, len(sched)):
                 out = {k: np.asarray(rec[src], dtype=np.int32)
@@ -431,6 +514,7 @@ class CampaignRunner:
                                       ("steps", "steps"))}
                 outs.append(out)
                 counts_so_far = _account(out, done)
+                n_batch = len(out["code"])
                 if stream is not None:
                     # A journaled batch is also a serialized batch: the
                     # replayed columns flow through the stream writer
@@ -438,29 +522,67 @@ class CampaignRunner:
                     # uninterrupted run's -- no re-dispatch, and the
                     # device loop below only serializes what it runs.
                     stream.feed(journal_base + done,
-                                sched.slice(done, done + len(out["code"])),
+                                sched.slice(done, done + n_batch),
                                 out)
-                done += len(out["code"])
+                done += n_batch
+                # Re-materialise the batch's recorded span timing
+                # (marked as replayed) at its original wall-clock
+                # offsets, so the resumed recorder exports ONE coherent
+                # Perfetto timeline covering the crashed run's batches
+                # too -- the export shifts time zero to the earliest
+                # event.
+                for name, t_abs, dur in rec.get("spans") or []:
+                    t0_local = tel.origin + (float(t_abs) - tel.epoch)
+                    tel.span_at(str(name), t0_local,
+                                t0_local + float(dur), replayed=True)
+                if tracker is not None:
+                    tracker.update(counts_so_far)
+                if metrics is not None:
+                    metrics.record_batch(done, n_batch, counts_so_far,
+                                         tel.stage_totals(since=mark),
+                                         resilience, replayed=True)
                 if progress is not None:
                     progress(done, counts_so_far)
             if done:
                 tel.instant("journal_resume", rows=done)
+            # An early_stop record is the campaign's terminal state: the
+            # replayed prefix IS the whole campaign, so the dispatch
+            # loop below must not extend it.  (The live tracker would
+            # reach the same verdict from the identical counts; honoring
+            # the record makes that termination first-class.)
+            early = next(
+                (r for r in journal.records()
+                 if r.get("kind") == "early_stop"
+                 and int(r.get("base", 0)) == int(journal_base)), None)
+            if early is not None and done >= int(early["rows"]):
+                stopped = True
+            elif tracker is not None and tracker.converged:
+                # Crash window: the final batch record fsync'd but the
+                # kill landed before the early_stop record did.  The
+                # replayed counts are the same data the crashed run
+                # stopped on, so the tracker reaches the same verdict
+                # here -- stop at the same batch (and backfill the
+                # terminal record the crash swallowed) instead of
+                # dispatching past the recorded stop point.
+                stopped = True
+                _journal_early_stop(done)
 
-        def _collect_flight(flight: Dict[str, object]):
-            """Block on one batch, watchdog-guarded when armed.  This is
-            the only collect-side work inside the retry loop -- it is
-            idempotent (a re-dispatch replays the same seeded rows)."""
-            with tel.span("collect", n=flight["n"]):
-                if retry is not None and retry.collect_timeout:
-                    return resilience_mod.watchdog_collect(
-                        lambda: self._collect(flight["pending"]),
-                        retry.collect_timeout)
-                return self._collect(flight["pending"])
+        def _last_span(store: List) -> None:
+            """Capture the just-exited span's (name, t0, t1) for the
+            journal's per-batch span-timing record.  Call immediately
+            after a ``with tel.span(...)`` block (events are appended at
+            exit); a disabled recorder captures nothing."""
+            if tel.enabled and tel.events \
+                    and tel.events[-1]["kind"] == "span":
+                e = tel.events[-1]
+                store.append((str(e["name"]), float(e["t0"]),
+                              float(e["t1"])))
 
-        def _grab(flight: Dict[str, object], got) -> None:
+        def _grab(flight: Dict[str, object], got) -> Dict[str, int]:
             """Post-collect accounting: journal the batch durably, update
             progress.  NOT retried -- appending the same rows twice would
-            corrupt the campaign, so failures here are fatal."""
+            corrupt the campaign, so failures here are fatal.  Returns
+            the cumulative counts (the convergence tracker's input)."""
             nonlocal done
             n_part = flight["n"]
             out = {k: v[:n_part] for k, v in got.items()}
@@ -468,9 +590,16 @@ class CampaignRunner:
             counts_so_far = _account(out, done)
             done += n_part
             if journal is not None:
-                journal.append_batch(journal_base + flight["lo"], out,
-                                     counts_so_far,
-                                     tel.stage_totals(since=mark))
+                # Batch records carry this batch's span timing as
+                # (name, unix_start, duration) triples, so a resumed
+                # campaign can re-materialise the crashed run's
+                # timeline into one coherent trace.
+                journal.append_batch(
+                    journal_base + flight["lo"], out, counts_so_far,
+                    tel.stage_totals(since=mark),
+                    spans=[(name, round(tel.epoch + (t0 - tel.origin), 6),
+                            round(t1 - t0, 6))
+                           for name, t0, t1 in flight.get("spans") or []])
             if stream is not None:
                 # Hand the batch to the background serializer right after
                 # it is durable: the encode overlaps the next dispatch,
@@ -480,19 +609,46 @@ class CampaignRunner:
                             sched.slice(flight["lo"],
                                         flight["lo"] + n_part),
                             out)
+            if metrics is not None:
+                metrics.record_batch(done, n_part, counts_so_far,
+                                     tel.stage_totals(since=mark),
+                                     resilience)
             if progress is not None:
                 progress(done, counts_so_far)
+            return counts_so_far
+
+        def _collect_flight(flight: Dict[str, object]):
+            """Block on one batch, watchdog-guarded when armed.  This is
+            the only collect-side work inside the retry loop -- it is
+            idempotent (a re-dispatch replays the same seeded rows)."""
+            with tel.span("collect", n=flight["n"]):
+                if retry is not None and retry.collect_timeout:
+                    # Ambient activation so the watchdog's own obs
+                    # counter (resilience.watchdog_collect fires
+                    # ``watchdog_fired`` on timeout) records into THIS
+                    # campaign's recorder, not the no-op default.
+                    with tel.activate():
+                        got = resilience_mod.watchdog_collect(
+                            lambda: self._collect(flight["pending"]),
+                            retry.collect_timeout)
+                else:
+                    got = self._collect(flight["pending"])
+            _last_span(flight.setdefault("spans", []))
+            return got
 
         def _dispatch_batch(lo: int) -> Dict[str, object]:
+            spans_rec: List = []
             with tel.span("pad", lo=lo):
                 part = sched.slice(lo, min(lo + batch_size, len(sched)))
                 fault, n_part = self._padded_fault(part, batch_size)
+            _last_span(spans_rec)
             if batch_size - n_part:
                 tel.count("pad_waste_rows", batch_size - n_part)
             with tel.span("dispatch", n=n_part):
                 pending = self._dispatch(fault)
+            _last_span(spans_rec)
             return {"pending": pending, "n": n_part, "fault": fault,
-                    "lo": lo, "attempts": 1}
+                    "lo": lo, "attempts": 1, "spans": spans_rec}
 
         def _note_retry(flight_lo: int, attempt: int,
                         exc: BaseException, kind: str) -> None:
@@ -534,54 +690,87 @@ class CampaignRunner:
         in_flight: List[Dict[str, object]] = []
         next_lo = done
         disp_attempts = 1
-        while done < len(sched):
-            try:
-                while next_lo < len(sched) and len(in_flight) < 2:
-                    try:
-                        in_flight.append(_dispatch_batch(next_lo))
-                    except Exception as e:     # noqa: BLE001 - classified
-                        probe = {"lo": next_lo, "attempts": disp_attempts}
-                        _handle(probe, e)
-                        disp_attempts = int(probe["attempts"])
-                        continue               # retry the same dispatch
-                    next_lo += batch_size
-                    disp_attempts = 1
-                flight = in_flight.pop(0)
-                while True:
-                    try:
-                        if flight["pending"] is None:
-                            with tel.span("dispatch", n=flight["n"],
-                                          retry=flight["attempts"]):
-                                flight["pending"] = self._dispatch(
-                                    flight["fault"])
-                        got = _collect_flight(flight)
-                        break
-                    except _Degrade:
-                        raise
-                    except Exception as e:     # noqa: BLE001 - classified
-                        _handle(flight, e)
-                _grab(flight, got)
-            except _Degrade as sig:
-                # OOM: the geometry was too ambitious for the live HBM
-                # headroom.  Halve the batch, drop the (uncollectable)
-                # in-flight work, and restart at the first uncollected
-                # row -- the compiled program re-specialises on the new
-                # shape at the next dispatch.
-                new_bs = retry.degraded_batch(batch_size)
-                if new_bs is None:
-                    raise sig.__cause__
-                new_bs = self._round_batch(new_bs)
-                if new_bs >= batch_size:
-                    raise sig.__cause__        # rounding floor reached
-                resilience["oom_degrade"] += 1
-                tel.count("resilience_oom_degrade", batch_size=new_bs)
-                batch_size = new_bs
-                in_flight.clear()
-                next_lo = done
-                if journal is not None:
-                    journal.append({"kind": "geometry",
-                                    "batch_size": batch_size,
-                                    "lo": journal_base + done})
+        try:
+            while done < len(sched) and not stopped:
+                try:
+                    while next_lo < len(sched) and len(in_flight) < 2:
+                        try:
+                            in_flight.append(_dispatch_batch(next_lo))
+                        except Exception as e:  # noqa: BLE001 - classified
+                            probe = {"lo": next_lo,
+                                     "attempts": disp_attempts}
+                            _handle(probe, e)
+                            disp_attempts = int(probe["attempts"])
+                            continue           # retry the same dispatch
+                        next_lo += batch_size
+                        disp_attempts = 1
+                    flight = in_flight.pop(0)
+                    while True:
+                        try:
+                            if flight["pending"] is None:
+                                with tel.span("dispatch", n=flight["n"],
+                                              retry=flight["attempts"]):
+                                    flight["pending"] = self._dispatch(
+                                        flight["fault"])
+                                _last_span(flight["spans"])
+                            got = _collect_flight(flight)
+                            break
+                        except _Degrade:
+                            raise
+                        except Exception as e:  # noqa: BLE001 - classified
+                            _handle(flight, e)
+                    counts_now = _grab(flight, got)
+                    if tracker is not None:
+                        tracker.update(counts_now)
+                        if tracker.converged:
+                            # Statistical early stop: every target
+                            # class's CI half-width is at (or below) its
+                            # threshold.  Drop the in-flight batches --
+                            # their rows were never collected, so the
+                            # campaign IS the prefix that ran -- and
+                            # journal the stop as a first-class terminal
+                            # record so resume replays to exactly here.
+                            stopped = True
+                            in_flight.clear()
+                            _journal_early_stop(done)
+                except _Degrade as sig:
+                    # OOM: the geometry was too ambitious for the live
+                    # HBM headroom.  Halve the batch, drop the
+                    # (uncollectable) in-flight work, and restart at the
+                    # first uncollected row -- the compiled program
+                    # re-specialises on the new shape at the next
+                    # dispatch.
+                    new_bs = retry.degraded_batch(batch_size)
+                    if new_bs is None:
+                        raise sig.__cause__
+                    new_bs = self._round_batch(new_bs)
+                    if new_bs >= batch_size:
+                        raise sig.__cause__    # rounding floor reached
+                    resilience["oom_degrade"] += 1
+                    tel.count("resilience_oom_degrade", batch_size=new_bs)
+                    batch_size = new_bs
+                    in_flight.clear()
+                    next_lo = done
+                    if journal is not None:
+                        journal.append({"kind": "geometry",
+                                        "batch_size": batch_size,
+                                        "lo": journal_base + done})
+        except BaseException as e:
+            # The campaign died (fatal dispatch error, retries
+            # exhausted, the caller's progress hook aborting): the live
+            # metrics surfaces must say so rather than show "running"
+            # forever.
+            if metrics is not None:
+                metrics.campaign_finished(
+                    error=f"{type(e).__name__}: {e}")
+            raise
+        if stopped and done < len(sched):
+            # Early stop cut the schedule short: the result describes
+            # exactly the rows that ran -- codes/weights/invalid-draw
+            # masks all line up with the truncated schedule, and
+            # ``convergence`` (below) records the planned size.
+            sched = sched.slice(0, done)
+            sched_w = getattr(sched, "class_weight", None)
         with tel.span("classify"):
             if outs:
                 merged = {k: np.concatenate([o[k] for o in outs])
@@ -608,7 +797,7 @@ class CampaignRunner:
                       for i, name in enumerate(cls.CLASS_NAMES)}
             counts["cache_invalid"] = invalid_total
         seconds = time.perf_counter() - t0
-        return CampaignResult(
+        res = CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
             n=sched.effective_n,
@@ -624,6 +813,14 @@ class CampaignRunner:
             stages=tel.stage_totals(since=mark),
             resilience=resilience,
         )
+        if tracker is not None:
+            res.convergence = tracker.report(
+                stopped, planned_n=planned_effective,
+                done_n=sched.effective_n)
+        if metrics is not None:
+            metrics.campaign_finished(res.summary(),
+                                      convergence=res.convergence)
+        return res
 
     def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
         """The identity block every journal header shares: resuming under
@@ -689,7 +886,8 @@ class CampaignRunner:
             progress: Optional[
                 Callable[[int, Dict[str, int]], None]] = None,
             journal: "Optional[object]" = None,
-            stream: "Optional[object]" = None
+            stream: "Optional[object]" = None,
+            stop_when: "Optional[object]" = None
             ) -> CampaignResult:
         """``start_num`` resumes a seeded campaign at injection #start_num:
         the schedule stream for (seed, start_num+n) is generated and the
@@ -707,7 +905,13 @@ class CampaignRunner:
         ``stream`` (a :class:`coast_tpu.inject.logs.StreamLogWriter`)
         serializes each collected batch in the background as it lands;
         the caller calls ``stream.finish(result)`` when done (and
-        ``stream.abort()`` on failure)."""
+        ``stream.abort()`` on failure).
+
+        ``stop_when`` (:class:`coast_tpu.obs.convergence.StopWhen`)
+        arms statistical early stop (see ``run_schedule``); the
+        condition joins the journal header, so resuming under a
+        different -- or no -- condition refuses exactly like a changed
+        seed."""
         tel = self.telemetry
         mark = tel.mark()
         part = self._seeded_part(n, seed, start_num)
@@ -717,6 +921,8 @@ class CampaignRunner:
                 "run", seed=int(seed), n=int(n), start_num=int(start_num),
                 batch_size=int(batch_size),
                 schedule_sha=schedule_fingerprint(part))
+            if stop_when is not None:
+                header["stop_when"] = stop_when.spec()
             j, owned = self._open_journal(journal, header)
             if self.equiv_partition is not None and not j.resumed:
                 # Persist the representatives: run_delta splices by site
@@ -731,7 +937,7 @@ class CampaignRunner:
         try:
             res = self.run_schedule(part, batch_size, progress=progress,
                                     _telemetry_mark=mark, journal=j,
-                                    stream=stream)
+                                    stream=stream, stop_when=stop_when)
         finally:
             if owned and j is not None:
                 j.close()
@@ -784,6 +990,22 @@ class CampaignRunner:
         seconds = 0.0
         stages: Dict[str, float] = {}
         resilience: Dict[str, int] = {}
+        # Progress covers the WHOLE delta campaign, spliced rows
+        # included: the splice is instant, so it lands as one opening
+        # beat (done = spliced rows, counts = their weighted histogram)
+        # and the re-injected rows then count up from that base -- a
+        # delta campaign's heartbeat is monotone to len(part) like any
+        # other campaign's.
+        splice_idx = np.flatnonzero(~plan.run_mask)
+        splice_counts: Dict[str, int] = {}
+        if progress is not None and len(splice_idx):
+            binc0 = cls.weighted_histogram(
+                cols["codes"][splice_idx],
+                part.class_weight[splice_idx])
+            splice_counts = {name: int(binc0[i])
+                             for i, name in enumerate(cls.CLASS_NAMES)}
+            splice_counts["cache_invalid"] = 0
+            progress(int(len(splice_idx)), dict(splice_counts))
         if len(run_idx):
             sub = FaultSchedule(
                 *(np.ascontiguousarray(np.asarray(getattr(part, f))[run_idx])
@@ -792,9 +1014,18 @@ class CampaignRunner:
                 seed=part.seed, model=part.model,
                 class_weight=part.class_weight[run_idx],
                 equiv_sha=part.equiv_sha)
+            chunk_progress = None
+            if progress is not None:
+                base_done = int(len(splice_idx))
+
+                def chunk_progress(done, counts):
+                    merged = dict(splice_counts)
+                    for k, v in counts.items():
+                        merged[k] = merged.get(k, 0) + v
+                    progress(base_done + done, merged)
             sub_res = self.run_schedule(
                 sub, batch_size=min(batch_size, len(sub)),
-                progress=progress, _telemetry_mark=mark)
+                progress=chunk_progress, _telemetry_mark=mark)
             for out_key, res_key in (("codes", "codes"),
                                      ("errors", "errors"),
                                      ("corrected", "corrected"),

@@ -27,6 +27,18 @@ kill at any instant leaves at worst one truncated trailing line (which
     explains the shape change.
   * ``retry``    -- a transient dispatch/collect failure was retried
     (forensics only; resume ignores it).
+  * ``early_stop`` -- the campaign's statistical stop condition
+    (``stop_when``, coast_tpu.obs.convergence) tripped after ``rows``
+    rows: the journal is COMPLETE at that prefix, and resume replays
+    to exactly there instead of extending it.  The condition itself
+    rides in the header (identity: resuming under a different one
+    refuses).
+
+Batch records additionally carry their own span timing (``spans``:
+``[name, unix_start_s, duration_s]`` triples), so a resumed campaign
+re-materialises the crashed run's batches into its telemetry and the
+exported Perfetto trace is ONE coherent timeline with replayed batches
+marked as such.
 
 Resume (``CampaignJournal.open`` on an existing file) validates the
 header against the current program/schedule and **refuses mismatches
@@ -254,10 +266,18 @@ class CampaignJournal:
 
     def append_batch(self, lo: int, out: Dict[str, np.ndarray],
                      counts: Dict[str, int],
-                     stage_seconds: Dict[str, float]) -> None:
+                     stage_seconds: Dict[str, float],
+                     spans: "Optional[list]" = None) -> None:
         """One fsync'd record per collected batch: row range, per-run
-        columns, cumulative counts, stage seconds so far."""
-        self.append({
+        columns, cumulative counts, stage seconds so far.  ``spans`` is
+        the batch's own span timing -- ``(name, unix_start_s,
+        duration_s)`` triples for its pad/dispatch/collect spans -- so a
+        resumed campaign can re-materialise the crashed run's batches
+        into one coherent exported trace (marked as replayed).  Optional
+        and absent-tolerant: journals written before the key (or with
+        telemetry disabled) replay without trace continuity, nothing
+        else changes."""
+        rec = {
             "kind": "batch", "lo": int(lo), "n": int(len(out["code"])),
             "codes": out["code"].tolist(),
             "errors": out["errors"].tolist(),
@@ -266,7 +286,11 @@ class CampaignJournal:
             "counts": counts,
             "stage_seconds": {k: round(v, 6)
                               for k, v in stage_seconds.items()},
-        })
+        }
+        if spans:
+            rec["spans"] = [[str(name), float(t), float(dur)]
+                            for name, t, dur in spans]
+        self.append(rec)
 
     def append_chunk(self, res) -> None:
         """One completed chunk of a multi-chunk campaign (the CampaignResult

@@ -171,6 +171,11 @@ def watchdog_collect(fn, timeout: Optional[float]):
                           name="coast-collect-watchdog")
     th.start()
     if not done.wait(timeout):
+        # Visible in the trace and on every live-metrics surface: a
+        # watchdog fire is exactly the event an operator watching a
+        # long campaign needs to see the moment it happens.
+        from coast_tpu.obs import spans as _spans
+        _spans.current().count("watchdog_fired", timeout_s=timeout)
         raise CampaignWedgedError(
             f"collect did not return within {timeout}s; batch presumed "
             "wedged (device_get hung) -- re-dispatching")

@@ -171,6 +171,50 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "seed/flags; refused loudly otherwise).  Without "
                         "--resume an existing journal is an error, never "
                         "silently overwritten")
+    parser.add_argument("--stop-when", type=str, default=None,
+                        metavar="SPEC",
+                        help="statistical early stop: comma-separated "
+                        "class:half_width targets with optional ;z=Q "
+                        "and ;min=N knobs (e.g. 'sdc:0.002;min=4096'). "
+                        "The campaign stops dispatching once every "
+                        "target class's Wilson CI half-width is at or "
+                        "below its threshold; with --journal the stop "
+                        "is a first-class terminal record and the "
+                        "condition joins the header identity (resume "
+                        "under a different condition is refused)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve live campaign metrics over HTTP on "
+                        "127.0.0.1:PORT while the campaign runs: "
+                        "/metrics is Prometheus text exposition, "
+                        "/status the full JSON document (rates with "
+                        "Wilson CIs, time-series rings, stage totals). "
+                        "0 picks an ephemeral port (printed)")
+    parser.add_argument("--status-json", type=str, default=None,
+                        metavar="PATH",
+                        help="mirror the live JSON status document to "
+                        "PATH, atomically replaced after every "
+                        "collected batch -- the headless-fleet "
+                        "observation surface (a scraper never sees a "
+                        "torn file)")
+    parser.add_argument("--heartbeat", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="rate-limited one-line progress heartbeat "
+                        "on stderr every SECONDS (0 disables); the "
+                        "final state is always flushed, even on a "
+                        "wedged campaign")
+    parser.add_argument("--console", action="store_true",
+                        help="live TTY dashboard on stderr (progress "
+                        "bar, per-class rates with Wilson CI bars, "
+                        "stage breakdown) repainted in place; replaces "
+                        "the bare --heartbeat line")
+    parser.add_argument("--trace-out", type=str, default=None,
+                        metavar="PATH",
+                        help="write the campaign's Chrome/Perfetto "
+                        "trace_event JSON here at the end (per-batch "
+                        "spans; on a resumed --journal campaign the "
+                        "crashed run's recorded batches are included, "
+                        "marked as replayed)")
     parser.add_argument("--max-retries", type=int, default=0,
                         help="retry transient XLA/device dispatch "
                         "failures up to N times per batch (exponential "
@@ -282,6 +326,24 @@ def parse_command_line(argv: Optional[List[str]] = None):
               "it cannot be combined with --journal/--resume/"
               "--stream-logs", file=sys.stderr)
         sys.exit(-1)
+    if args.stop_when:
+        from coast_tpu.obs.convergence import StopWhen, StopWhenError
+        if args.errorCount or args.forceBreak or args.delta_from:
+            # -e has its own stopping rule (error-bounded sizing);
+            # forced injections are debug one-offs; a delta campaign's
+            # row set is determined by the fingerprint diff, not by
+            # sampling precision.
+            print("Error, --stop-when applies to the seeded/stratified/"
+                  "cache campaign paths, not -e/--errorCount, "
+                  "--forceBreak, or --delta-from", file=sys.stderr)
+            sys.exit(-1)
+        try:
+            args.stop_when_parsed = StopWhen.parse(args.stop_when)
+        except StopWhenError as e:
+            print(f"Error, bad --stop-when: {e}", file=sys.stderr)
+            sys.exit(-1)
+    else:
+        args.stop_when_parsed = None
     if args.journal and (args.forceBreak or args.stratified
                          or args.section in CACHE_SECTIONS):
         # Forced injections are debug one-offs; cache/stratified schedules
@@ -385,6 +447,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"backend exposes ({len(jax.devices())})", file=sys.stderr)
             return 1
         mesh = make_mesh(args.mesh)
+    # Live observability surfaces: one metrics hub fed by the runner per
+    # collected batch; the HTTP endpoint and the status file both read
+    # from it.
+    metrics = None
+    server = None
+    # Multi-chunk paths (-e's sizing loop, --delta-from's splice+rerun)
+    # run SEVERAL run_schedule campaigns: the runner-level metrics hook
+    # would reset the live surfaces to zero (and flash "finished") at
+    # every chunk boundary, so those paths feed the hub through the
+    # cross-chunk progress callback instead (same pattern as
+    # scripts/campaign_1m.py).
+    chunked = bool(args.errorCount or args.delta_from)
+    if args.metrics_port is not None or args.status_json:
+        from coast_tpu.obs.metrics import CampaignMetrics
+        metrics = CampaignMetrics(status_path=args.status_json)
+    if args.metrics_port is not None:
+        from coast_tpu.obs.serve import MetricsServer
+        server = MetricsServer(metrics, port=args.metrics_port)
+        port = server.start()
+        print(f"# metrics: http://127.0.0.1:{port}/metrics  "
+              f"status: http://127.0.0.1:{port}/status",
+              file=sys.stderr, flush=True)
     try:
         runner = CampaignRunner(prog,
                                 sections=section_filter(prog, args.section),
@@ -393,7 +477,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 retry=retry,
                                 mesh=mesh,
                                 fault_model=args.fault_model_parsed,
-                                equiv=args.equiv)
+                                equiv=args.equiv,
+                                metrics=None if chunked else metrics)
     except ValueError as e:
         if args.equiv:
             print(f"Error, {e}", file=sys.stderr)
@@ -447,6 +532,50 @@ def main(argv: Optional[List[str]] = None) -> int:
             exec_path=(src_paths[0] if args.log_format == "reference"
                        and src_paths else None))
 
+    # Live progress surface: the TTY dashboard (--console) or the
+    # one-line heartbeat (--heartbeat).  The last beat is re-emitted
+    # unconditionally in the ``finally`` below -- the terminal-flush
+    # guarantee: a campaign's final state (completion, or the counts
+    # standing when a CampaignWedgedError killed it) always reaches the
+    # terminal, even when the rate limiter just suppressed a beat.
+    beat = None
+    progress = None
+    last_beat = {}
+    if args.console or args.heartbeat > 0:
+        # Unknown-size campaigns get no percent bar: -e sizes itself as
+        # it goes, and --equiv's progress counts PHYSICAL representative
+        # rows (unknown until the partition reduces the schedule) while
+        # -t names effective injections.
+        total = 0 if (args.errorCount or args.equiv) else args.t
+        if args.console:
+            from coast_tpu.obs.console import Console
+            beat = Console(total, interval_s=(args.heartbeat or 1.0),
+                           label=f"{prog.region.name}/{strategy}",
+                           metrics=metrics,
+                           stop_when=args.stop_when_parsed)
+        else:
+            from coast_tpu.obs.heartbeat import Heartbeat
+            beat = Heartbeat(total, interval_s=args.heartbeat)
+
+        def progress(done, counts):
+            last_beat["state"] = (done, counts)
+            # Ambient activation so the beat's instant/gauge marks land
+            # in the runner's recorder (and thus --trace-out).
+            with runner.telemetry.activate():
+                beat.update(done, counts)
+
+    if metrics is not None and chunked:
+        metrics.campaign_started(prog.region.name, strategy, 0, 0)
+        _mrows = {"done": 0}
+        _beat_progress = progress
+
+        def progress(done, counts, _inner=_beat_progress):
+            metrics.record_batch(done, max(0, done - _mrows["done"]),
+                                 counts, {}, {})
+            _mrows["done"] = done
+            if _inner is not None:
+                _inner(done, counts)
+
     try:
         if args.section in CACHE_SECTIONS:
             hierarchy = MemHierarchy("tpu")
@@ -456,10 +585,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 prog.region.nominal_steps, cache_name)
             res = runner.run_schedule(
                 sched, batch_size=min(args.batch_size, len(sched)),
-                stream=stream)
+                progress=progress, stream=stream,
+                stop_when=args.stop_when_parsed)
         elif args.errorCount:
             res = runner.run_until_errors(args.errorCount, seed=args.seed,
                                           batch_size=args.batch_size,
+                                          progress=progress,
                                           journal=args.journal)
         elif args.stratified:
             from coast_tpu.inject.schedule import generate_stratified_total
@@ -468,14 +599,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                                               model=runner.fault_model)
             res = runner.run_schedule(
                 sched, batch_size=min(args.batch_size, len(sched)),
-                stream=stream)
+                progress=progress, stream=stream,
+                stop_when=args.stop_when_parsed)
         elif args.delta_from:
             from coast_tpu.analysis.equiv import DeltaMismatchError
             try:
                 res = runner.run_delta(args.t, args.delta_from,
                                        seed=args.seed,
                                        batch_size=args.batch_size,
-                                       start_num=args.start_num)
+                                       start_num=args.start_num,
+                                       progress=progress)
             except DeltaMismatchError as e:
                 print(f"Error, {e}", file=sys.stderr)
                 return 1
@@ -483,11 +616,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             res = runner.run(args.t, seed=args.seed,
                              batch_size=args.batch_size,
                              start_num=args.start_num, journal=args.journal,
-                             stream=stream)
-    except BaseException:
+                             stream=stream, progress=progress,
+                             stop_when=args.stop_when_parsed)
+    except BaseException as e:
         if stream is not None:
             stream.abort()       # never leave a half-written final log
+        if metrics is not None and chunked:
+            # Single-schedule paths report failure from inside
+            # run_schedule; the progress-fed chunked paths do it here.
+            metrics.campaign_finished(error=f"{type(e).__name__}: {e}")
         raise
+    finally:
+        if beat is not None and "state" in last_beat:
+            with runner.telemetry.activate():
+                beat.final(*last_beat["state"])
+        if server is not None:
+            server.stop()
+
+    if metrics is not None and chunked:
+        metrics.campaign_finished(res.summary())
+
+    if args.trace_out:
+        from coast_tpu import obs as obs_mod
+        obs_mod.write_trace(
+            runner.telemetry, args.trace_out,
+            metadata={"benchmark": prog.region.name, "strategy": strategy,
+                      "section": args.section},
+            process_name=f"supervisor {prog.region.name}/{strategy}")
+        print(f"# trace -> {args.trace_out} (open at ui.perfetto.dev)",
+              file=sys.stderr, flush=True)
 
     print(res.summary())
     if not args.no_logging:

@@ -1,13 +1,23 @@
-"""coast_tpu.obs: campaign telemetry (spans, counters, trace export).
+"""coast_tpu.obs: campaign telemetry (spans, live metrics, trace export).
 
 The observability layer of the injection pipeline: nested wall-clock
 spans and counters (:mod:`coast_tpu.obs.spans`), Chrome/Perfetto
-``trace_event`` export (:mod:`coast_tpu.obs.trace_export`), and a
-rate-limited progress heartbeat (:mod:`coast_tpu.obs.heartbeat`).
-See docs/observability.md for the workflow.
+``trace_event`` export (:mod:`coast_tpu.obs.trace_export`), a
+rate-limited progress heartbeat (:mod:`coast_tpu.obs.heartbeat`), live
+per-batch time-series metrics (:mod:`coast_tpu.obs.metrics`) with a
+zero-dependency HTTP endpoint (:mod:`coast_tpu.obs.serve`), statistical
+convergence tracking with Wilson-interval early stop
+(:mod:`coast_tpu.obs.convergence`), and a live TTY dashboard
+(:mod:`coast_tpu.obs.console`).  See docs/observability.md for the
+workflow.
 """
 
+from coast_tpu.obs.console import Console
+from coast_tpu.obs.convergence import (ConvergenceTracker, StopWhen,
+                                       StopWhenError, wilson_interval)
 from coast_tpu.obs.heartbeat import Heartbeat
+from coast_tpu.obs.metrics import CampaignMetrics, Ring, atomic_write_json
+from coast_tpu.obs.serve import MetricsServer
 from coast_tpu.obs.spans import (NULL, Telemetry, count, current, instant,
                                  span)
 from coast_tpu.obs.trace_export import (to_trace_doc, to_trace_events,
@@ -16,5 +26,7 @@ from coast_tpu.obs.trace_export import (to_trace_doc, to_trace_events,
 __all__ = [
     "Telemetry", "NULL", "current", "span", "count", "instant",
     "to_trace_events", "to_trace_doc", "write_trace",
-    "Heartbeat",
+    "Heartbeat", "Console",
+    "CampaignMetrics", "Ring", "MetricsServer", "atomic_write_json",
+    "ConvergenceTracker", "StopWhen", "StopWhenError", "wilson_interval",
 ]

@@ -82,3 +82,11 @@ class Heartbeat:
         tel.instant("heartbeat", done=done, total=self.total)
         tel.gauge("inj_per_sec", round(rate, 2))
         return line
+
+    def final(self, done: int,
+              counts: Optional[Dict[str, int]] = None) -> str:
+        """Terminal flush: emit unconditionally, bypassing the rate
+        limiter.  A campaign's last state -- completion, or the counts
+        standing when a ``CampaignWedgedError`` killed it -- must reach
+        the terminal even if the previous beat was milliseconds ago."""
+        return self.update(done, counts, force=True)

@@ -1,0 +1,367 @@
+"""Live campaign metrics: per-batch time series behind a lock.
+
+The spans/trace layer (:mod:`coast_tpu.obs.spans`) answers "where did
+the time go" *after* a campaign ends; this module answers "what is the
+campaign doing *now*".  :class:`CampaignMetrics` is a small thread-safe
+hub the campaign loop feeds once per collected batch
+(``CampaignRunner(metrics=...)``); the HTTP endpoint
+(:mod:`coast_tpu.obs.serve`), the status-file export, and the TTY
+console (:mod:`coast_tpu.obs.console`) all read coherent snapshots from
+it.  The TPU CFD framework (arXiv:2108.11076) is the exemplar: keeping
+a long accelerator run efficient is a *host-side monitoring* problem --
+slice saturation, throughput, and failure counters have to be visible
+while the run is still spending money.
+
+Everything is stdlib + numpy-free; the one accelerator touch (device
+memory watermark) imports jax lazily and degrades to ``None`` on
+backends without ``memory_stats`` (CPU).
+
+Per batch the hub records into fixed-capacity ring buffers:
+
+  * instantaneous and cumulative injections/sec (physical dispatches);
+  * done / total progress (physical rows and weighted effective rows);
+  * weighted per-class rates with Wilson confidence intervals
+    (:mod:`coast_tpu.obs.convergence`);
+  * per-stage wall-clock totals and the streaming overlap fraction;
+  * retry / OOM-degrade / watchdog counters
+    (:mod:`coast_tpu.inject.resilience`);
+  * the device memory watermark (high-water ``bytes_in_use``).
+
+Ring capacity bounds memory for arbitrarily long campaigns: the status
+surfaces show the recent window, the scalar aggregates stay exact.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Mapping, Optional, Tuple
+
+from coast_tpu.obs.convergence import interval_table
+
+__all__ = ["Ring", "CampaignMetrics", "device_memory_bytes",
+           "atomic_write_json"]
+
+
+def device_memory_bytes() -> Optional[int]:
+    """Live ``bytes_in_use`` of device 0, or None when the backend does
+    not report memory stats (CPU) or jax is unavailable."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+    except Exception:            # noqa: BLE001 - any backend gap -> None
+        return None
+    if not stats:
+        return None
+    value = stats.get("bytes_in_use")
+    return int(value) if value is not None else None
+
+
+def atomic_write_json(path: str, doc: Dict[str, object]) -> None:
+    """Write ``doc`` to ``path`` atomically (tmp + rename): a reader --
+    a fleet scraper polling ``--status-json`` -- never sees a torn
+    file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, separators=(",", ":"), sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+class Ring:
+    """Fixed-capacity (t, value) time series; oldest samples drop."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._buf: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=self.capacity)
+
+    def append(self, t: float, value: float) -> None:
+        self._buf.append((float(t), float(value)))
+
+    def last(self) -> Optional[float]:
+        return self._buf[-1][1] if self._buf else None
+
+    def points(self) -> List[Tuple[float, float]]:
+        return list(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+
+#: The ring series every campaign records, in export order.
+_SERIES = ("inj_per_sec", "inj_per_sec_cumulative", "done_rows",
+           "effective_done", "sdc_rate", "device_memory_bytes")
+
+
+class CampaignMetrics:
+    """Thread-safe live-metrics hub for one campaign at a time.
+
+    The campaign loop (single writer) calls ``campaign_started`` /
+    ``record_batch`` / ``campaign_finished``; any number of reader
+    threads (HTTP handlers, the console) call ``snapshot`` /
+    ``prometheus``.  ``status_path`` additionally mirrors every sample
+    to an atomically-replaced JSON file for headless fleets (rate-
+    limited by ``status_interval_s``; the terminal states always
+    write).
+    """
+
+    def __init__(self, ring_capacity: int = 256,
+                 status_path: Optional[str] = None,
+                 status_interval_s: float = 0.0,
+                 z: float = 1.96,
+                 clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.status_path = status_path
+        self.status_interval_s = float(status_interval_s)
+        self.z = float(z)
+        self.rings: Dict[str, Ring] = {
+            name: Ring(ring_capacity) for name in _SERIES}
+        self.state = "idle"
+        self.benchmark = ""
+        self.strategy = ""
+        self.total_rows = 0
+        self.total_effective = 0
+        self.done_rows = 0
+        self.effective_done = 0
+        self.counts: Dict[str, float] = {}
+        self.stages: Dict[str, float] = {}
+        self.resilience: Dict[str, int] = {}
+        self.batches = 0
+        self.replayed_batches = 0
+        self.memory_watermark: Optional[int] = None
+        self.error: Optional[str] = None
+        self.convergence: Optional[Dict[str, object]] = None
+        self._t_start = 0.0
+        self._t_last_batch = 0.0
+        self._last_status_write = float("-inf")
+        self._updated_unix = time.time()
+
+    # -- writer side (the campaign loop) -------------------------------------
+    def campaign_started(self, benchmark: str, strategy: str,
+                         total_rows: int, total_effective: int) -> None:
+        with self._lock:
+            self.state = "running"
+            self.benchmark = benchmark
+            self.strategy = strategy
+            self.total_rows = int(total_rows)
+            self.total_effective = int(total_effective)
+            self.done_rows = 0
+            self.effective_done = 0
+            self.counts = {}
+            self.stages = {}
+            self.resilience = {}
+            self.batches = 0
+            self.replayed_batches = 0
+            self.error = None
+            self.convergence = None
+            now = self._clock()
+            self._t_start = now
+            self._t_last_batch = now
+        self._maybe_write_status(force=True)
+
+    def record_batch(self, done_rows: int, n_rows: int,
+                     counts: Mapping[str, float],
+                     stages: Mapping[str, float],
+                     resilience: Mapping[str, int],
+                     replayed: bool = False) -> None:
+        """One collected (or journal-replayed) batch: cumulative row
+        progress, the cumulative weighted class histogram, stage
+        totals, and resilience counters so far."""
+        now = self._clock()
+        with self._lock:
+            dt = max(now - self._t_last_batch, 1e-9)
+            elapsed = max(now - self._t_start, 1e-9)
+            self._t_last_batch = now
+            self.done_rows = int(done_rows)
+            self.counts = {k: float(v) for k, v in counts.items()}
+            self.effective_done = int(sum(self.counts.values()))
+            self.stages = {k: float(v) for k, v in stages.items()}
+            self.resilience = {k: int(v) for k, v in resilience.items()}
+            self.batches += 1
+            if replayed:
+                self.replayed_batches += 1
+            mem = device_memory_bytes()
+            if mem is not None:
+                self.memory_watermark = max(self.memory_watermark or 0,
+                                            mem)
+            inst = n_rows / dt
+            cum = self.done_rows / elapsed
+            total_eff = float(sum(self.counts.values()))
+            sdc_rate = (self.counts.get("sdc", 0.0) / total_eff
+                        if total_eff else 0.0)
+            self.rings["inj_per_sec"].append(now, inst)
+            self.rings["inj_per_sec_cumulative"].append(now, cum)
+            self.rings["done_rows"].append(now, self.done_rows)
+            self.rings["effective_done"].append(now, self.effective_done)
+            self.rings["sdc_rate"].append(now, sdc_rate)
+            if mem is not None:
+                self.rings["device_memory_bytes"].append(now, mem)
+            self._updated_unix = time.time()
+        self._maybe_write_status()
+
+    def campaign_finished(self, summary: Optional[Dict[str, object]] = None,
+                          error: Optional[str] = None,
+                          convergence: Optional[Dict[str, object]] = None
+                          ) -> None:
+        with self._lock:
+            self.state = "failed" if error else "finished"
+            self.error = error
+            if convergence is not None:
+                self.convergence = dict(convergence)
+            if summary:
+                stages = summary.get("stages")
+                if isinstance(stages, dict):
+                    self.stages = {k: float(v) for k, v in stages.items()}
+            self._updated_unix = time.time()
+        self._maybe_write_status(force=True)
+
+    # -- reader side ---------------------------------------------------------
+    def _rates(self) -> Dict[str, Dict[str, float]]:
+        """Per-class weighted rate + Wilson CI (caller holds the lock);
+        the shared interval-table shape of obs/convergence."""
+        return interval_table(self.counts, self.z)
+
+    def snapshot(self) -> Dict[str, object]:
+        """One coherent JSON-able status document (the /status body and
+        the --status-json file)."""
+        with self._lock:
+            elapsed = (max(self._t_last_batch - self._t_start, 0.0)
+                       if self.state != "idle" else 0.0)
+            doc: Dict[str, object] = {
+                "format": "coast-status",
+                "version": 1,
+                "state": self.state,
+                "benchmark": self.benchmark,
+                "strategy": self.strategy,
+                "total_rows": self.total_rows,
+                "total_effective": self.total_effective,
+                "done_rows": self.done_rows,
+                "effective_done": self.effective_done,
+                "batches": self.batches,
+                "replayed_batches": self.replayed_batches,
+                "elapsed_s": round(elapsed, 6),
+                "inj_per_sec": self.rings["inj_per_sec"].last() or 0.0,
+                "inj_per_sec_cumulative":
+                    self.rings["inj_per_sec_cumulative"].last() or 0.0,
+                "counts": dict(self.counts),
+                "rates": self._rates(),
+                "stages": dict(self.stages),
+                "resilience": dict(self.resilience),
+                "device_memory_watermark_bytes": self.memory_watermark,
+                "updated_unix_s": round(self._updated_unix, 6),
+                "series": {
+                    name: [[round(t, 4), v] for t, v in ring.points()]
+                    for name, ring in self.rings.items()},
+            }
+            if self.error:
+                doc["error"] = self.error
+            if self.convergence is not None:
+                doc["convergence"] = self.convergence
+            return doc
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the scalar
+        aggregates -- what a fleet scraper wants; the ring series stay
+        JSON-only."""
+        with self._lock:
+            labels = (f'benchmark="{_esc(self.benchmark)}",'
+                      f'strategy="{_esc(self.strategy)}"')
+            lines: List[str] = []
+
+            def metric(name: str, mtype: str, help_text: str,
+                       samples: List[Tuple[str, float]]) -> None:
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} {mtype}")
+                for label_str, value in samples:
+                    # :.17g round-trips any float exactly; :g's 6
+                    # significant digits would corrupt counters past
+                    # 10^6 (a one-million-row campaign is the NORMAL
+                    # case, scripts/campaign_1m.py).
+                    text = (f"{int(value)}" if float(value).is_integer()
+                            else f"{value:.17g}")
+                    lines.append(f"{name}{{{label_str}}} {text}")
+
+            state_samples = [
+                (f'{labels},state="{s}"',
+                 1.0 if s == self.state else 0.0)
+                for s in ("idle", "running", "finished", "failed")]
+            metric("coast_campaign_state", "gauge",
+                   "Campaign lifecycle state (one-hot).", state_samples)
+            metric("coast_campaign_rows_total", "gauge",
+                   "Physical schedule rows in this campaign.",
+                   [(labels, float(self.total_rows))])
+            metric("coast_campaign_rows_done", "gauge",
+                   "Physical rows collected so far.",
+                   [(labels, float(self.done_rows))])
+            metric("coast_campaign_effective_done", "gauge",
+                   "Weighted effective injections counted so far.",
+                   [(labels, float(self.effective_done))])
+            metric("coast_campaign_batches_total", "counter",
+                   "Collected batches (journal-replayed included).",
+                   [(labels, float(self.batches))])
+            metric("coast_campaign_replayed_batches_total", "counter",
+                   "Batches replayed from the journal on resume.",
+                   [(labels, float(self.replayed_batches))])
+            metric("coast_campaign_inj_per_sec", "gauge",
+                   "Instantaneous physical injections per second.",
+                   [(labels,
+                     self.rings["inj_per_sec"].last() or 0.0)])
+            metric("coast_campaign_class_total", "gauge",
+                   "Weighted cumulative count per classification class.",
+                   [(f'{labels},class="{_esc(k)}"', float(v))
+                    for k, v in sorted(self.counts.items())]
+                   or [(f'{labels},class="success"', 0.0)])
+            rates = self._rates()
+            if rates:
+                metric("coast_campaign_class_rate", "gauge",
+                       "Weighted per-class rate.",
+                       [(f'{labels},class="{_esc(k)}"', v["rate"])
+                        for k, v in rates.items()])
+                metric("coast_campaign_class_ci_half_width", "gauge",
+                       "Wilson CI half-width of the per-class rate.",
+                       [(f'{labels},class="{_esc(k)}"', v["half_width"])
+                        for k, v in rates.items()])
+            metric("coast_campaign_stage_seconds_total", "counter",
+                   "Wall-clock seconds per pipeline stage "
+                   "(overlap is a fraction, exported separately).",
+                   [(f'{labels},stage="{_esc(k)}"', float(v))
+                    for k, v in sorted(self.stages.items())
+                    if k != "overlap"]
+                   or [(f'{labels},stage="dispatch"', 0.0)])
+            metric("coast_campaign_serialize_overlap_ratio", "gauge",
+                   "Fraction of serialization hidden under dispatch.",
+                   [(labels, float(self.stages.get("overlap", 0.0)))])
+            metric("coast_campaign_resilience_total", "counter",
+                   "Retry / OOM-degrade / watchdog event counts.",
+                   [(f'{labels},kind="{_esc(k)}"', float(v))
+                    for k, v in sorted(self.resilience.items())]
+                   or [(f'{labels},kind="retry_transient"', 0.0)])
+            if self.memory_watermark is not None:
+                metric("coast_campaign_device_memory_watermark_bytes",
+                       "gauge",
+                       "High-water device bytes_in_use seen.",
+                       [(labels, float(self.memory_watermark))])
+            return "\n".join(lines) + "\n"
+
+    # -- status file ---------------------------------------------------------
+    def _maybe_write_status(self, force: bool = False) -> None:
+        if not self.status_path:
+            return
+        now = self._clock()
+        if not force and (now - self._last_status_write
+                          < self.status_interval_s):
+            return
+        self._last_status_write = now
+        atomic_write_json(self.status_path, self.snapshot())
+
+
+def _esc(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))

@@ -95,6 +95,21 @@ class Telemetry:
             if bracket is not None:
                 bracket.__exit__(None, None, None)
 
+    def span_at(self, name: str, t0: float, t1: float,
+                depth: int = 0, **args: object) -> None:
+        """Append a span with explicit ``perf_counter``-domain times.
+
+        The journal-replay path uses this to re-materialise a crashed
+        campaign's recorded batch spans into the resumed recorder, so
+        one exported trace covers the whole campaign; ``t0``/``t1`` may
+        precede ``origin`` (the export shifts to the earliest event).
+        """
+        if not self.enabled:
+            return
+        self.events.append({"kind": "span", "name": name,
+                            "t0": float(t0), "t1": float(t1),
+                            "depth": depth, "args": args or None})
+
     def _profiler_bracket(self, name: str):
         """Optional jax.profiler.TraceAnnotation so these host spans show
         up inside a captured device profile; None when off/unavailable."""
@@ -147,8 +162,14 @@ class Telemetry:
         Only *top-level* spans in the window count (minimum recorded
         depth), so a nested helper span never double-bills its parent
         stage.  Multiple same-name spans (one per batch) sum.
+
+        Journal-replayed spans (``span_at(..., replayed=True)``) are
+        excluded: they exist for trace continuity, but their seconds
+        belong to the crashed run -- counting them would make a resumed
+        campaign's stage totals exceed its own wall clock.
         """
-        spans = [e for e in self.events[since:] if e["kind"] == "span"]
+        spans = [e for e in self.events[since:] if e["kind"] == "span"
+                 and not (e.get("args") or {}).get("replayed")]
         if not spans:
             return {}
         top = min(e["depth"] for e in spans)     # type: ignore[type-var]

@@ -29,14 +29,30 @@ _PID = 1
 _TID = 1
 
 
-def _us(telemetry: Telemetry, t: float) -> float:
-    return round((t - telemetry.origin) * 1e6, 3)
+def _origin(telemetry: Telemetry) -> float:
+    """Export time zero: the recorder's origin, or the earliest event if
+    one precedes it.  Journal-replayed spans from a crashed campaign are
+    re-materialised at their original (earlier) wall-clock offsets
+    (``Telemetry.span_at``); shifting to the true minimum keeps every
+    exported ``ts`` non-negative and the resumed trace one coherent
+    timeline."""
+    origin = telemetry.origin
+    for e in telemetry.events:
+        t = float(e["t0"]) if e["kind"] == "span" else float(e["t"])
+        if t < origin:
+            origin = t
+    return origin
 
 
 def to_trace_events(telemetry: Telemetry,
                     process_name: str = "coast_tpu campaign"
                     ) -> List[Dict[str, object]]:
     """The recorder's events as trace_event dicts, exit-order preserved."""
+    origin = _origin(telemetry)
+
+    def _us(t: float) -> float:
+        return round((t - origin) * 1e6, 3)
+
     events: List[Dict[str, object]] = [{
         "name": "process_name", "ph": "M", "pid": _PID, "tid": _TID,
         "args": {"name": process_name},
@@ -46,9 +62,11 @@ def to_trace_events(telemetry: Telemetry,
         args = e.get("args") or {}
         if kind == "span":
             events.append({
-                "name": e["name"], "cat": "stage", "ph": "X",
+                "name": e["name"],
+                "cat": ("replay" if args.get("replayed") else "stage"),
+                "ph": "X",
                 "pid": _PID, "tid": _TID,
-                "ts": _us(telemetry, float(e["t0"])),       # type: ignore
+                "ts": _us(float(e["t0"])),                  # type: ignore
                 "dur": round((float(e["t1"]) - float(e["t0"]))  # type: ignore
                              * 1e6, 3),
                 "args": args,
@@ -57,14 +75,14 @@ def to_trace_events(telemetry: Telemetry,
             events.append({
                 "name": e["name"], "cat": kind, "ph": "C",
                 "pid": _PID, "tid": _TID,
-                "ts": _us(telemetry, float(e["t"])),        # type: ignore
+                "ts": _us(float(e["t"])),                   # type: ignore
                 "args": {str(e["name"]): e["value"]},
             })
         elif kind == "instant":
             events.append({
                 "name": e["name"], "cat": "mark", "ph": "i",
                 "pid": _PID, "tid": _TID, "s": "t",
-                "ts": _us(telemetry, float(e["t"])),        # type: ignore
+                "ts": _us(float(e["t"])),                   # type: ignore
                 "args": args,
             })
     return events

@@ -56,6 +56,16 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat", type=float, default=5.0,
                     help="progress heartbeat interval in seconds "
                     "(0 disables)")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve live campaign metrics over HTTP on "
+                    "127.0.0.1:PORT while the campaign runs (/metrics "
+                    "Prometheus text, /status JSON with Wilson-CI "
+                    "rates and time-series rings); 0 picks an "
+                    "ephemeral port (printed)")
+    ap.add_argument("--status-json", default=None, metavar="PATH",
+                    help="mirror the live JSON status document to PATH, "
+                    "atomically replaced after every collected batch "
+                    "(headless-fleet observation surface)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (dev boxes)")
     ap.add_argument("--journal", default=None,
@@ -181,6 +191,20 @@ def main(argv=None) -> int:
     heartbeat = (obs.Heartbeat(args.n, interval_s=args.heartbeat)
                  if args.heartbeat > 0 else None)
     agg_counts = {}
+    # Live metrics ride the cross-chunk progress callback (NOT the
+    # runner's own metrics hook, which would restart its progress every
+    # run_schedule chunk): the status/HTTP surfaces see one campaign
+    # counting monotonically to n.
+    metrics = None
+    server = None
+    if args.metrics_port is not None or args.status_json:
+        metrics = obs.CampaignMetrics(status_path=args.status_json)
+        metrics.campaign_started("matrixMultiply", "TMR",
+                                 len(sched), sched.effective_n)
+    if args.metrics_port is not None:
+        server = obs.MetricsServer(metrics, port=args.metrics_port)
+        note(f"metrics: http://127.0.0.1:{server.start()}/status")
+    last_beat = {"done": 0}
 
     log_path = os.path.join(args.logdir, f"mm_tmr_{args.n}.ndjson")
     stream = None
@@ -201,16 +225,26 @@ def main(argv=None) -> int:
                 merged = dict(agg_counts)
                 for k, v in counts.items():
                     merged[k] = merged.get(k, 0) + v
-                with telemetry.activate():
-                    heartbeat.update(_lo + done, merged)
+                total_done = _lo + done
+                if metrics is not None:
+                    metrics.record_batch(
+                        total_done, total_done - last_beat["done"],
+                        merged, telemetry.stage_totals(), {})
+                last_beat["done"] = total_done
+                last_beat["counts"] = merged
+                if heartbeat is not None:
+                    with telemetry.activate():
+                        heartbeat.update(total_done, merged)
             part = runner.run_schedule(sched.slice(lo, min(lo + chunk,
                                                            len(sched))),
                                        batch_size=args.batch,
                                        # None keeps the per-batch progress
-                                       # accounting entirely off when the
-                                       # heartbeat is disabled
-                                       progress=(_progress if heartbeat
-                                                 is not None else None),
+                                       # accounting entirely off when
+                                       # nothing observes it
+                                       progress=(_progress
+                                                 if heartbeat is not None
+                                                 or metrics is not None
+                                                 else None),
                                        journal=journal, journal_base=lo,
                                        stream=stream)
             parts.append(part)
@@ -235,7 +269,9 @@ def main(argv=None) -> int:
         stages["run_s"] = round(time.perf_counter() - t0, 3)
         if heartbeat is not None:
             with telemetry.activate():
-                heartbeat.update(res.n, agg_counts, force=True)
+                heartbeat.final(res.n, agg_counts)
+        if metrics is not None:
+            metrics.campaign_finished(res.summary())
 
         t0 = time.perf_counter()
         with telemetry.activate():
@@ -246,11 +282,19 @@ def main(argv=None) -> int:
             else:
                 logs.write_ndjson(res, runner.mmap, log_path)
         stages["log_s"] = round(time.perf_counter() - t0, 3)
-    except BaseException:
+    except BaseException as e:
         # An interrupted streamed run must not leave rows temp files in
         # --logdir (the journal, not the stream, is the resume state).
         if stream is not None:
             stream.abort()
+        # Terminal-flush guarantee: the last progress state reaches the
+        # terminal and the status surfaces even when the campaign dies
+        # between rate-limited beats.
+        if heartbeat is not None and "counts" in last_beat:
+            with telemetry.activate():
+                heartbeat.final(last_beat["done"], last_beat["counts"])
+        if metrics is not None:
+            metrics.campaign_finished(error=f"{type(e).__name__}: {e}")
         raise
 
     t0 = time.perf_counter()
@@ -297,6 +341,8 @@ def main(argv=None) -> int:
         # journal so the next fresh run does not refuse to start.
         journal.close()
         os.remove(jpath)
+    if server is not None:
+        server.stop()
     print(json.dumps(artifact["campaign"]))
     print(f"stages: {stages}  -> {out}")
     return 0

@@ -349,34 +349,48 @@ def main(argv=None):
         heartbeat = (obs.Heartbeat(n_total, interval_s=args.heartbeat,
                                    label=f"heartbeat {strat_name}")
                      if args.heartbeat > 0 else None)
-        while done < n_total:
-            n_chunk = min(chunk, n_total - done)
+        last_beat = {}
+        try:
+            while done < n_total:
+                n_chunk = min(chunk, n_total - done)
 
-            def _progress(chunk_done, chunk_counts, _base=done):
-                merged = dict(counts)
-                for k, v in chunk_counts.items():
-                    merged[k] = merged.get(k, 0) + v
+                def _progress(chunk_done, chunk_counts, _base=done):
+                    merged = dict(counts)
+                    for k, v in chunk_counts.items():
+                        merged[k] = merged.get(k, 0) + v
+                    last_beat["state"] = (_base + chunk_done, merged)
+                    with telemetry.activate():
+                        heartbeat.update(_base + chunk_done, merged)
+                res = runner.run(n_chunk, seed=42, batch_size=best_batch,
+                                 start_num=done,
+                                 progress=(_progress
+                                           if heartbeat is not None
+                                           else None))
+                if journal is not None:
+                    journal.append_chunk(res)
+                done += res.n
+                secs += res.seconds
+                for k, v in res.counts.items():
+                    counts[k] = counts.get(k, 0) + v
+                for k, v in res.stages.items():
+                    stages[k] = round(stages.get(k, 0.0) + v, 6)
+                for k, v in res.resilience.items():
+                    resil[k] = resil.get(k, 0) + v
+                flush_key()
+                print(json.dumps(
+                    {"strategy": strat_name, "done": done,
+                     "inj_per_sec": out[key]["injections_per_sec"]}))
+        finally:
+            # Terminal-flush guarantee: the liveness heartbeat is this
+            # script's whole observability story on a preemptible TPU
+            # (--heartbeat doc above), so the last known state must hit
+            # the terminal even when a chunk dies between rate-limited
+            # beats (CampaignWedgedError, preemption, plain crash).
+            if heartbeat is not None and "state" in last_beat:
                 with telemetry.activate():
-                    heartbeat.update(_base + chunk_done, merged)
-            res = runner.run(n_chunk, seed=42, batch_size=best_batch,
-                             start_num=done,
-                             progress=(_progress if heartbeat is not None
-                                       else None))
+                    heartbeat.final(*last_beat["state"])
             if journal is not None:
-                journal.append_chunk(res)
-            done += res.n
-            secs += res.seconds
-            for k, v in res.counts.items():
-                counts[k] = counts.get(k, 0) + v
-            for k, v in res.stages.items():
-                stages[k] = round(stages.get(k, 0.0) + v, 6)
-            for k, v in res.resilience.items():
-                resil[k] = resil.get(k, 0) + v
-            flush_key()
-            print(json.dumps({"strategy": strat_name, "done": done,
-                              "inj_per_sec": out[key]["injections_per_sec"]}))
-        if journal is not None:
-            journal.close()
+                journal.close()
 
     # -- slice-vote vs whole-leaf-vote A/B (campaign inj/s) -----------------
     region_wl = mm256.make_region(side=1024, block=512, bf16_matmul=True)

@@ -266,11 +266,15 @@ def main(argv=None) -> int:
                 seconds=runtimes[strat] * res.n,
                 mean_steps=mean_steps,
                 stages=res.stages or None)
-            dominant = max(res.stages, key=res.stages.get) \
-                if res.stages else "?"
+            # 'overlap' is a fraction, not a seconds bucket (always
+            # present in the stage vocabulary since the live-metrics
+            # layer): keep it out of the dominant-stage ranking.
+            stage_s = {k: v for k, v in res.stages.items()
+                       if k != "overlap"}
+            dominant = max(stage_s, key=stage_s.get) if stage_s else "?"
             print(f"#   {name}-{strat} stages: " + " ".join(
                 f"{k}={v:.3f}s" for k, v in sorted(
-                    res.stages.items(), key=lambda kv: -kv[1]))
+                    stage_s.items(), key=lambda kv: -kv[1]))
                 + f"  (dominant: {dominant})",
                 file=sys.stderr, flush=True)
         row = {"campaigns": {s: summaries[s].counts for s in summaries},
