@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -96,6 +96,13 @@ equiv_smoke:
 # soundness vs the exhaustive run, journaled early-stop resume parity.
 obs_live_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.obs_live_smoke
+
+# Campaign-fleet smoke (also a fast.yml driver row): 2 workers x 2
+# queued campaigns, one worker SIGKILL'd mid-campaign and replaced;
+# merged parity-checked result bit-identical to the sequential run,
+# compile-cache hit recorded, live fleet /metrics served.
+fleet_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.fleet_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

@@ -1,0 +1,35 @@
+"""coast_tpu.fleet: campaign fleet -- many campaigns x many workers.
+
+The scale-out layer above :class:`~coast_tpu.inject.campaign
+.CampaignRunner` (ROADMAP item 3).  One process per worker, one
+durable file-based queue per fleet, one journal per work item:
+
+  * :mod:`coast_tpu.fleet.queue` -- crash-safe campaign queue with
+    atomic claim / lease / requeue semantics (rename-based, lockless);
+  * :mod:`coast_tpu.fleet.worker` -- SIGKILL-surviving worker loop: a
+    restarted worker resumes the claimed item's journal bit-for-bit;
+  * :mod:`coast_tpu.fleet.compile_cache` -- persistent compile cache
+    keyed by the journal's config-sha + mesh geometry, so protected-
+    program tracing/lowering is paid once per config across the fleet;
+  * :mod:`coast_tpu.fleet.telemetry` -- merged fleet /metrics + /status
+    served through the stock :class:`coast_tpu.obs.serve.MetricsServer`;
+  * :mod:`coast_tpu.fleet.supervisor` -- the ``python -m coast_tpu.fleet``
+    CLI (enqueue / run / worker / status / merge) with the
+    parity-checked fleet merge.
+
+See docs/fleet.md for the queue format, lease semantics, cache key, and
+aggregation topology.
+"""
+
+from coast_tpu.fleet.compile_cache import CompileCache
+from coast_tpu.fleet.queue import (CampaignQueue, LostLeaseError,
+                                   QueueError, QueueItem, item_spec)
+from coast_tpu.fleet.supervisor import FleetParityError, merge_fleet
+from coast_tpu.fleet.telemetry import FleetTelemetry
+from coast_tpu.fleet.worker import Worker, codes_sha256
+
+__all__ = [
+    "CampaignQueue", "QueueItem", "QueueError", "LostLeaseError",
+    "item_spec", "Worker", "codes_sha256", "CompileCache",
+    "FleetTelemetry", "FleetParityError", "merge_fleet",
+]

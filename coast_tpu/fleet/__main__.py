@@ -1,0 +1,8 @@
+"""``python -m coast_tpu.fleet`` -- the fleet supervisor CLI."""
+
+import sys
+
+from coast_tpu.fleet.supervisor import main
+
+if __name__ == "__main__":
+    sys.exit(main())

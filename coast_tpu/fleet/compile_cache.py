@@ -1,0 +1,217 @@
+"""Persistent compile cache: pay the protected-program build once per
+config across the fleet.
+
+Per campaign.py's own accounting, the dominant cold-start cost of a
+campaign is not the injections -- it is tracing + lowering + XLA-
+compiling the protected step (and, for ``--equiv``, the partition
+analysis riding the same traced jaxpr).  A fleet runs thousands of
+campaigns over a *handful* of configs, so that cost must be paid once
+per config, not once per campaign.  The host-side discipline is the TPU
+CFD framework's (arXiv:2108.11076): keep the slices saturated by making
+sure the host never stalls re-preparing work it has already prepared.
+
+Three layers, cheapest first:
+
+  1. **Warm (in-process)**: one :class:`~coast_tpu.inject.campaign
+     .CampaignRunner` per cache key, memoized for the life of the worker
+     -- a worker draining ten same-config items traces/compiles once and
+     reuses the jitted batch program for the other nine (``warm_hit``).
+  2. **Persistent (cross-process)**: jax's compilation cache is pointed
+     at ``<root>/xla``, so a *different* worker process (or a restarted
+     one) compiling the same HLO gets the XLA binary from disk instead
+     of the compiler (best-effort: backends without persistent-cache
+     support degrade silently to a plain re-compile).
+  3. **Key ledger**: ``<root>/keys/<key>.json`` records which configs
+     some fleet process has already compiled.  The key is the journal's
+     identity vocabulary -- the protection ``config_sha`` (the same
+     fingerprint the journal header pins) + mesh geometry + section /
+     fault-model / equiv / unroll + jax version + backend -- so a cache
+     hit can never hand back a program compiled for a different
+     campaign identity.  A cold build under an existing key is counted
+     as a ``persistent_hit`` (layer 2 serves it); a key never seen
+     anywhere is a ``miss``.
+
+Hit/miss counters feed the ambient obs telemetry (``compile_cache_*``
+counts), the per-worker status doc, and the fleet-level /metrics
+aggregate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from coast_tpu.obs.metrics import atomic_write_json
+
+__all__ = ["CompileCache"]
+
+#: Cache event vocabulary, in "best outcome first" order.
+EVENTS = ("warm_hit", "persistent_hit", "miss")
+
+
+class CompileCache:
+    """Per-worker facade over the three cache layers, rooted at the
+    queue's shared ``cache/`` directory."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(os.path.join(self.root, "keys"), exist_ok=True)
+        self.counters: Dict[str, int] = {name: 0 for name in EVENTS}
+        self.last_event: Optional[str] = None
+        self._runners: Dict[str, Tuple[object, str]] = {}
+        self._programs: Dict[Tuple[str, str], Tuple[object, str]] = {}
+        self.persistent_enabled = self._enable_persistent()
+
+    def _enable_persistent(self) -> bool:
+        """Point jax's compilation cache at the shared directory -- but
+        only if the process has not already configured one (a test
+        harness or operator environment that set its own cache dir keeps
+        it; the XLA cache is shared-state either way, and the key ledger
+        and counters live in OUR root regardless).  Every knob is
+        best-effort: older jax versions miss some of them, and backends
+        without persistent-cache support simply recompile."""
+        try:
+            import jax
+            if getattr(jax.config, "jax_compilation_cache_dir", None):
+                return True
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(self.root, "xla"))
+        except Exception:                    # noqa: BLE001 - degrade
+            return False
+        for knob, value in (
+                ("jax_persistent_cache_min_entry_size_bytes", -1),
+                ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+            try:
+                jax.config.update(knob, value)
+            except Exception:                # noqa: BLE001 - older jax
+                pass
+        return True
+
+    # -- keys ----------------------------------------------------------------
+    @staticmethod
+    def _mesh_geometry(mesh) -> Optional[Dict[str, int]]:
+        if mesh is None:
+            return None
+        return {str(name): int(size)
+                for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+
+    def key(self, prog, spec: Dict[str, object], mesh=None) -> str:
+        """Cache key = journal config-sha + mesh geometry + the spec
+        fields that change what gets compiled."""
+        import jax
+        from coast_tpu.inject.journal import config_fingerprint
+        doc = {
+            "benchmark": prog.region.name,
+            "config_sha": config_fingerprint(prog.cfg),
+            "section": spec.get("section", "memory"),
+            "fault_model": spec.get("fault_model", "single"),
+            "equiv": bool(spec.get("equiv", False)),
+            "unroll": int(spec.get("unroll", 1)),
+            "mesh": self._mesh_geometry(mesh),
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+        }
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]
+
+    def _key_path(self, key: str) -> str:
+        return os.path.join(self.root, "keys", f"{key}.json")
+
+    # -- build paths ---------------------------------------------------------
+    def program(self, benchmark: str, opt_passes: str):
+        """Memoized protected-program build (region resolve + protection
+        transform), via the opt CLI's own flag parser so semantics
+        cannot drift from ``python -m coast_tpu.opt``."""
+        memo_key = (str(benchmark), str(opt_passes))
+        if memo_key not in self._programs:
+            from coast_tpu.inject.supervisor import build_program
+            try:
+                self._programs[memo_key] = build_program(benchmark,
+                                                         opt_passes)
+            except SystemExit as e:
+                # build_program is a CLI helper: it reports to stderr and
+                # exits.  A fleet worker must fail the ITEM, not itself.
+                raise RuntimeError(
+                    f"protected-program build failed for "
+                    f"benchmark={benchmark!r} opt_passes={opt_passes!r} "
+                    f"(exit {e.code}; see the worker's stderr)") from e
+        return self._programs[memo_key]
+
+    def runner(self, spec: Dict[str, object], mesh=None,
+               metrics=None, retry=None):
+        """The cached-runner entry point: returns ``(runner, strategy,
+        key, event)`` where ``event`` is this call's cache outcome.
+
+        The runner is fully constructed for the spec's campaign identity
+        (sections, fault model, equiv partition, mesh backend); a warm
+        hit returns the SAME object, jitted program and all.  ``metrics``
+        is re-pointed per call -- the live hub belongs to the worker,
+        not the cache entry."""
+        from coast_tpu import obs
+        from coast_tpu.inject.campaign import CampaignRunner
+        prog, strategy = self.program(spec["benchmark"],
+                                      spec.get("opt_passes", "-TMR"))
+        key = self.key(prog, spec, mesh)
+        if key in self._runners:
+            event = "warm_hit"
+            runner, strategy = self._runners[key]
+        else:
+            event = ("persistent_hit"
+                     if os.path.exists(self._key_path(key)) else "miss")
+            from coast_tpu.inject.supervisor import section_filter
+            try:
+                sections = section_filter(prog, spec.get("section",
+                                                         "memory"))
+            except SystemExit as e:
+                raise RuntimeError(
+                    f"section {spec.get('section')!r} has no injectable "
+                    f"leaves in {prog.region.name} (exit {e.code})") from e
+            fault_model = None
+            if spec.get("fault_model", "single") != "single":
+                from coast_tpu.inject.schedule import FaultModel
+                fault_model = FaultModel.parse(spec["fault_model"])
+            runner = CampaignRunner(
+                prog, sections=sections, strategy_name=strategy,
+                unroll=int(spec.get("unroll", 1)),
+                fault_model=fault_model,
+                equiv=bool(spec.get("equiv", False)),
+                mesh=mesh, retry=retry)
+            self._runners[key] = (runner, strategy)
+        runner.metrics = metrics
+        runner.retry = retry if retry is not None else runner.retry
+        self.counters[event] += 1
+        self.last_event = event
+        obs.count(f"compile_cache_{event}", key=key)
+        return runner, strategy, key, event
+
+    def mark_compiled(self, key: str, spec: Dict[str, object]) -> None:
+        """Record that ``key``'s program compiled (first collected batch
+        proves it): a later cold build under this key -- a restarted
+        worker, another process -- is a persistent hit, served by the
+        XLA disk cache rather than the compiler.  Idempotent."""
+        path = self._key_path(key)
+        if os.path.exists(path):
+            return
+        atomic_write_json(path, {
+            "format": "coast-fleet-compile-key", "version": 1,
+            "key": key,
+            "benchmark": spec.get("benchmark"),
+            "opt_passes": spec.get("opt_passes"),
+            "section": spec.get("section"),
+            "persistent_xla_cache": self.persistent_enabled,
+        })
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def hits(self) -> int:
+        return self.counters["warm_hit"] + self.counters["persistent_hit"]
+
+    @property
+    def misses(self) -> int:
+        return self.counters["miss"]
+
+    def snapshot(self) -> Dict[str, object]:
+        return {**self.counters, "hits": self.hits, "misses": self.misses,
+                "persistent_enabled": self.persistent_enabled}

@@ -1,0 +1,227 @@
+"""Merged fleet telemetry: one /metrics + /status over many workers.
+
+Per-campaign observability already exists (:mod:`coast_tpu.obs.metrics`
+feeds one hub per runner); a fleet needs the *sum*.  The aggregation
+topology is deliberately file-based, matching the queue: every worker
+mirrors an atomic worker-status doc into ``<queue>/status/`` on each
+batch, and completed items live as durable ``done`` records -- so the
+aggregator is a pure *reader* with no RPC fabric, no worker
+registration, and no extra failure mode.  A SIGKILL'd worker simply
+goes stale (its last doc's age exceeds the staleness window) and its
+completed work keeps counting, because completed work is counted from
+``done`` records, never from worker memory.
+
+:class:`FleetTelemetry` duck-types the hub interface
+(:meth:`snapshot` / :meth:`prometheus`), so the stock
+:class:`coast_tpu.obs.serve.MetricsServer` serves the fleet aggregate
+unchanged -- one ``/metrics`` endpoint a Prometheus scraper reads for
+the whole fleet, one ``/status`` JSON for dashboards.
+
+Double-count hygiene: fleet per-class totals = (sum of ``done`` record
+counts) + (live ``running`` workers' current-campaign counts).  Workers
+drop the campaign block from their status doc the moment an item's
+``done`` record lands, so an item is never in both terms (modulo one
+in-flight beat, which the next scrape corrects).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Tuple
+
+from coast_tpu.fleet.queue import CampaignQueue
+from coast_tpu.obs.convergence import interval_table
+from coast_tpu.obs.metrics import _esc
+
+__all__ = ["FleetTelemetry"]
+
+
+class FleetTelemetry:
+    """Read-side aggregate over one queue's workers + done records."""
+
+    def __init__(self, queue: "CampaignQueue | str",
+                 stale_s: float = 30.0, z: float = 1.96):
+        self.q = (queue if isinstance(queue, CampaignQueue)
+                  else CampaignQueue(queue))
+        self.stale_s = float(stale_s)
+        self.z = float(z)
+        self._done_cache: Dict[str, Tuple[int, Dict[str, object]]] = {}
+
+    # -- readers -------------------------------------------------------------
+    def _worker_docs(self) -> List[Dict[str, object]]:
+        status_dir = os.path.join(self.q.root, "status")
+        out: List[Dict[str, object]] = []
+        for name in sorted(os.listdir(status_dir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(status_dir, name)) as fh:
+                    out.append(json.load(fh))
+            except (OSError, ValueError):
+                continue                   # torn/unreadable: skip a beat
+        return out
+
+    def _done_docs(self) -> List[Dict[str, object]]:
+        """Done records, parsed once each.  They are immutable once
+        ``atomic_write_json`` lands them (an idempotent re-complete
+        rewrites the identical bytes but bumps mtime, which just
+        re-parses that one file), and the aggregate runs per /metrics
+        scrape, per /status hit, AND per supervisor poll -- a long
+        fleet accumulates thousands of done files, so re-reading all of
+        them every half-second is the one unbounded cost here."""
+        done_dir = os.path.join(self.q.root, "done")
+        out: List[Dict[str, object]] = []
+        for name in sorted(os.listdir(done_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(done_dir, name)
+            try:
+                mtime = os.stat(path).st_mtime_ns
+            except FileNotFoundError:
+                continue
+            hit = self._done_cache.get(path)
+            if hit is None or hit[0] != mtime:
+                try:
+                    with open(path) as fh:
+                        hit = (mtime, json.load(fh))
+                except (OSError, ValueError):
+                    continue               # torn/unreadable: skip a beat
+                self._done_cache[path] = hit
+            out.append(hit[1])
+        return out
+
+    def _aggregate(self) -> Dict[str, object]:
+        now = time.time()
+        queue_stats = self.q.stats()
+        done = self._done_docs()
+        counts: Dict[str, float] = {}
+        injections = 0
+        physical = 0
+        seconds = 0.0
+        cache: Dict[str, int] = {}
+        for rec in done:
+            result = rec.get("result") or {}
+            for k, v in (result.get("counts") or {}).items():
+                counts[k] = counts.get(k, 0.0) + float(v)
+            injections += int(result.get("injections", 0))
+            physical += int(result.get("physical_injections",
+                                       result.get("injections", 0)))
+            seconds += float(result.get("seconds", 0.0))
+            event = result.get("cache_event")
+            if event:
+                cache[event] = cache.get(event, 0) + 1
+        workers: List[Dict[str, object]] = []
+        live = 0
+        inj_per_sec = 0.0
+        for doc in self._worker_docs():
+            age = max(0.0, now - float(doc.get("updated_unix_s", 0.0)))
+            stale = age > self.stale_s or doc.get("state") == "exited"
+            if not stale:
+                live += 1
+            campaign = doc.get("campaign") if doc.get("state") == "running" \
+                else None
+            if campaign and not stale:
+                for k, v in (campaign.get("counts") or {}).items():
+                    counts[k] = counts.get(k, 0.0) + float(v)
+                inj_per_sec += float(campaign.get("inj_per_sec", 0.0))
+            for k, v in (doc.get("cache") or {}).items():
+                if k in ("warm_hit", "persistent_hit", "miss"):
+                    # Live view of in-flight workers' cache traffic;
+                    # the done-record sum above is the durable one, so
+                    # keep them in separate keys.
+                    cache[f"live_{k}"] = cache.get(f"live_{k}", 0) + int(v)
+            workers.append({
+                "worker": doc.get("worker"),
+                "pid": doc.get("pid"),
+                "state": "stale" if stale else doc.get("state"),
+                "item": doc.get("item"),
+                "items_done": doc.get("items_done", 0),
+                "items_failed": doc.get("items_failed", 0),
+                "age_s": round(age, 3),
+                "inj_per_sec": (float(campaign.get("inj_per_sec", 0.0))
+                                if campaign and not stale else 0.0),
+            })
+        return {
+            "now": now, "queue": queue_stats, "workers": workers,
+            "workers_live": live, "counts": counts,
+            "injections_done": injections, "physical_done": physical,
+            "seconds": seconds, "cache": cache,
+            "inj_per_sec": inj_per_sec,
+        }
+
+    # -- hub interface (MetricsServer duck-typing) ---------------------------
+    def snapshot(self) -> Dict[str, object]:
+        agg = self._aggregate()
+        return {
+            "format": "coast-fleet-status", "version": 1,
+            "queue": agg["queue"],
+            "workers": agg["workers"],
+            "workers_live": agg["workers_live"],
+            "counts": {k: v for k, v in sorted(agg["counts"].items())},
+            "rates": interval_table(agg["counts"], self.z),
+            "injections_done": agg["injections_done"],
+            "physical_done": agg["physical_done"],
+            "seconds": round(agg["seconds"], 6),
+            "inj_per_sec": round(agg["inj_per_sec"], 3),
+            "cache": agg["cache"],
+            "updated_unix_s": round(agg["now"], 6),
+        }
+
+    def prometheus(self) -> str:
+        """Prometheus 0.0.4 text of the fleet aggregate -- the names
+        docs/observability.md's fleet section pins."""
+        agg = self._aggregate()
+        lines: List[str] = []
+
+        def metric(name: str, mtype: str, help_text: str,
+                   samples: List[Tuple[str, float]]) -> None:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for label_str, value in samples:
+                text = (f"{int(value)}" if float(value).is_integer()
+                        else f"{value:.17g}")
+                body = f"{{{label_str}}}" if label_str else ""
+                lines.append(f"{name}{body} {text}")
+
+        metric("coast_fleet_queue_items", "gauge",
+               "Queue items per state.",
+               [(f'state="{s}"', float(n))
+                for s, n in sorted(agg["queue"].items())])
+        states: Dict[str, int] = {}
+        for w in agg["workers"]:
+            states[str(w["state"])] = states.get(str(w["state"]), 0) + 1
+        metric("coast_fleet_workers", "gauge",
+               "Workers per observed state (stale = no fresh status).",
+               [(f'state="{_esc(s)}"', float(n))
+                for s, n in sorted(states.items())] or [("", 0.0)])
+        metric("coast_fleet_class_total", "gauge",
+               "Fleet-wide weighted count per classification class "
+               "(done records + live campaigns).",
+               [(f'class="{_esc(k)}"', float(v))
+                for k, v in sorted(agg["counts"].items())]
+               or [('class="success"', 0.0)])
+        rates = interval_table(agg["counts"], self.z)
+        if rates:
+            metric("coast_fleet_class_rate", "gauge",
+                   "Fleet-wide weighted per-class rate.",
+                   [(f'class="{_esc(k)}"', v["rate"])
+                    for k, v in rates.items()])
+            metric("coast_fleet_class_ci_half_width", "gauge",
+                   "Wilson CI half-width of the fleet per-class rate.",
+                   [(f'class="{_esc(k)}"', v["half_width"])
+                    for k, v in rates.items()])
+        metric("coast_fleet_injections_done_total", "counter",
+               "Effective injections in completed items.",
+               [("", float(agg["injections_done"]))])
+        metric("coast_fleet_inj_per_sec", "gauge",
+               "Summed instantaneous inj/s over live running workers.",
+               [("", float(agg["inj_per_sec"]))])
+        metric("coast_fleet_compile_cache_events_total", "counter",
+               "Compile-cache outcomes (done records; live_* = in-flight "
+               "worker counters).",
+               [(f'kind="{_esc(k)}"', float(v))
+                for k, v in sorted(agg["cache"].items())]
+               or [('kind="miss"', 0.0)])
+        return "\n".join(lines) + "\n"

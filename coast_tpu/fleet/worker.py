@@ -1,0 +1,289 @@
+"""Fleet worker: claim queued campaigns, run them crash-safely, report.
+
+One worker is one process in the fleet.  Its loop is the reference
+supervisor's per-QEMU-worker thread (threadFunctions.py) rebuilt on the
+PR 4-8 primitives:
+
+  * it **claims** items from the :class:`~coast_tpu.fleet.queue
+    .CampaignQueue` (atomic rename; lease renewed from the campaign's
+    own progress heartbeat);
+  * it **runs** each item through a cached
+    :class:`~coast_tpu.inject.campaign.CampaignRunner`
+    (:mod:`coast_tpu.fleet.compile_cache`) with the item's journal --
+    every collected batch is fsync'd before the lease beat that
+    acknowledges it, so the journal is always at least as complete as
+    the queue believes;
+  * it **survives SIGKILL by construction**: the worker holds no state
+    the queue + journal do not.  A killed worker's lease expires (or the
+    fleet supervisor requeues it on observing the death), the next
+    claimant re-opens the same journal, and ``CampaignRunner.run``
+    resumes at the first missing batch bit-for-bit -- the journal's
+    exclusive flock guarantees the kill really is dead (a merely-slow
+    worker still holds the lock, and the duplicate claimant backs off
+    with :class:`~coast_tpu.inject.journal.JournalLockedError`).
+
+Per item the worker lands a ``done`` record carrying the campaign
+summary, the per-run ``codes`` sha256 (the fleet merge's parity pin),
+and the compile-cache outcome.  Throughout, it mirrors a worker-status
+doc (atomic JSON) into the queue's ``status/`` directory -- the fleet
+aggregator's scrape surface (:mod:`coast_tpu.fleet.telemetry`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+import numpy as np
+
+from coast_tpu.fleet.compile_cache import CompileCache
+from coast_tpu.fleet.queue import CampaignQueue, LostLeaseError, QueueItem
+from coast_tpu.inject.journal import JournalLockedError
+from coast_tpu.obs.metrics import CampaignMetrics, atomic_write_json
+
+__all__ = ["Worker", "codes_sha256"]
+
+
+def codes_sha256(codes: np.ndarray) -> str:
+    """Parity pin over a campaign's per-run class codes: bit-identical
+    campaigns -- and nothing else -- share it."""
+    return hashlib.sha256(
+        np.ascontiguousarray(codes, dtype=np.int32).tobytes()).hexdigest()
+
+
+class _LeaseKeeper:
+    """Renew an item's lease from a background thread while the worker
+    sits inside a long blocking phase with no progress beats -- the cold
+    program build (trace + lower + XLA compile), which compile_cache
+    documents as the dominant cold-start cost and which can easily
+    outlast the lease.  Without this, every cold config's first attempt
+    gets reaped mid-compile and the fleet pays N duplicate compiles.
+    A renewal that fails with :class:`LostLeaseError` is parked in
+    ``lost`` for the caller (raising on the keeper thread would vanish)."""
+
+    def __init__(self, q: CampaignQueue, item_id: str, worker: str,
+                 lease_s: float):
+        self.q, self.item_id, self.worker = q, item_id, worker
+        self.lease_s = float(lease_s)
+        self.lost: Optional[LostLeaseError] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"lease-{item_id}", daemon=True)
+
+    def __enter__(self) -> "_LeaseKeeper":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.lease_s / 3.0):
+            try:
+                self.q.renew(self.item_id, self.worker, self.lease_s)
+            except LostLeaseError as e:
+                self.lost = e
+                return
+
+
+class Worker:
+    """One fleet worker process (or an in-process drain loop in tests)."""
+
+    def __init__(self, queue: "CampaignQueue | str", worker_id: str,
+                 mesh_devices: Optional[int] = None,
+                 lease_s: float = 60.0, poll_s: float = 0.25,
+                 cache: Optional[CompileCache] = None,
+                 metrics: Optional[CampaignMetrics] = None,
+                 max_retries: int = 2, max_item_attempts: int = 3):
+        self.q = (queue if isinstance(queue, CampaignQueue)
+                  else CampaignQueue(queue))
+        self.worker_id = str(worker_id)
+        self.lease_s = float(lease_s)
+        self.poll_s = float(poll_s)
+        self.max_item_attempts = int(max_item_attempts)
+        self.cache = cache if cache is not None \
+            else CompileCache(self.q.cache_dir)
+        self.metrics = metrics if metrics is not None else CampaignMetrics()
+        self._mesh = None
+        if mesh_devices:
+            from coast_tpu.parallel.mesh import make_mesh
+            self._mesh = make_mesh(int(mesh_devices))
+        self._retry = None
+        if max_retries > 0:
+            from coast_tpu.inject.resilience import RetryPolicy
+            self._retry = RetryPolicy(max_attempts=int(max_retries) + 1)
+        self.items_done = 0
+        self.items_failed = 0
+        self.items_yielded = 0            # journal-locked backoffs
+        self._current_item: Optional[str] = None
+        self._write_status("idle")
+
+    # -- status doc ----------------------------------------------------------
+    def _write_status(self, state: str) -> None:
+        """Atomically mirror this worker's live state for the fleet
+        aggregator.  The campaign block is the standard CampaignMetrics
+        snapshot, included only while an item is actually running --
+        completed items are counted from their durable done records, so
+        the aggregate never double-counts a finished campaign."""
+        doc: Dict[str, object] = {
+            "format": "coast-fleet-worker", "version": 1,
+            "worker": self.worker_id, "pid": os.getpid(),
+            "state": state, "item": self._current_item,
+            "items_done": self.items_done,
+            "items_failed": self.items_failed,
+            "items_yielded": self.items_yielded,
+            "cache": self.cache.snapshot(),
+            "updated_unix_s": round(time.time(), 6),
+        }
+        if state == "running":
+            doc["campaign"] = self.metrics.snapshot()
+        atomic_write_json(self.q.worker_status_path(self.worker_id), doc)
+
+    # -- the drain loop ------------------------------------------------------
+    def drain(self, idle_exit: bool = True,
+              max_items: Optional[int] = None) -> int:
+        """Claim-and-run until the queue is drained (``idle_exit``) or
+        ``max_items`` items have been attempted.  Returns how many items
+        this worker completed."""
+        attempted = 0
+        while max_items is None or attempted < max_items:
+            self.q.requeue_expired()
+            item = self.q.claim(self.worker_id, self.lease_s)
+            if item is None:
+                if idle_exit and self.q.drained():
+                    break
+                self._write_status("idle")
+                time.sleep(self.poll_s)
+                continue
+            attempted += 1
+            self.run_item(item)
+        self._write_status("exited")
+        return self.items_done
+
+    # -- one item ------------------------------------------------------------
+    def run_item(self, item: QueueItem) -> bool:
+        """Run one claimed item to a terminal queue state.  Returns True
+        if it completed (False: failed terminally or yielded)."""
+        spec = item.spec
+        self._current_item = item.id
+        keeper = _LeaseKeeper(self.q, item.id, self.worker_id,
+                              self.lease_s)
+        try:
+            with keeper:
+                runner, strategy, cache_key, cache_event = \
+                    self.cache.runner(spec, mesh=self._mesh,
+                                      metrics=self.metrics,
+                                      retry=self._retry)
+        except (RuntimeError, ValueError) as e:
+            # Deterministic build failure: any worker would fail the
+            # same way, so the item is terminally failed, not requeued.
+            self.items_failed += 1
+            self._current_item = None
+            self.q.fail(item.id, self.worker_id, f"build: {e}")
+            self._write_status("idle")
+            return False
+        if keeper.lost is not None:
+            # Our claim moved while we compiled.  The compile itself is
+            # not wasted (the cache keeps it), but the item belongs to
+            # another worker now -- stop touching it.
+            self.items_yielded += 1
+            self._current_item = None
+            self._write_status("idle")
+            return False
+
+        state = {"last_renew": time.monotonic(), "marked": False}
+        throttle = float(spec.get("throttle_s", 0.0) or 0.0)
+
+        def progress(done: int, counts: Dict[str, int]) -> None:
+            # First collected batch proves the compile happened: record
+            # the key so a restarted worker's rebuild is a cache hit.
+            if not state["marked"]:
+                self.cache.mark_compiled(cache_key, spec)
+                state["marked"] = True
+            now = time.monotonic()
+            if now - state["last_renew"] >= self.lease_s / 3.0:
+                self.q.renew(item.id, self.worker_id, self.lease_s)
+                state["last_renew"] = now
+            self._write_status("running")
+            if throttle > 0:
+                time.sleep(throttle)
+
+        stop_when = None
+        if spec.get("stop_when"):
+            from coast_tpu.obs.convergence import StopWhen
+            stop_when = StopWhen.parse(spec["stop_when"])
+        try:
+            with runner.telemetry.activate():
+                res = runner.run(
+                    int(spec["n"]), seed=int(spec.get("seed", 0)),
+                    batch_size=int(spec.get("batch_size", 4096)),
+                    start_num=int(spec.get("start_num", 0)),
+                    journal=self.q.journal_path(item.id),
+                    progress=progress, stop_when=stop_when)
+        except JournalLockedError:
+            # The previous holder of this item is still alive and
+            # appending (our claim came from a wrongly-reaped lease).
+            # Yield: put the item back and let the journal's owner
+            # finish it -- complete() is idempotent either way.
+            self.items_yielded += 1
+            self._current_item = None
+            self.q.requeue_worker(self.worker_id)
+            self._write_status("idle")
+            time.sleep(self.poll_s)
+            return False
+        except LostLeaseError:
+            # Our lease was reaped mid-campaign and someone else owns
+            # the item now; the journal we already appended is theirs to
+            # resume.  Stop touching it.
+            self.items_yielded += 1
+            self._current_item = None
+            self._write_status("idle")
+            return False
+        except Exception as e:          # noqa: BLE001
+            self._current_item = None
+            if item.attempts < self.max_item_attempts:
+                # Possibly transient infrastructure beyond what the
+                # RetryPolicy absorbed on THIS worker (device hiccup,
+                # disk blip): the journal is intact and resumable, so
+                # requeue for another attempt before declaring the item
+                # poison -- fail() is for work that would fail
+                # identically anywhere.
+                self.items_yielded += 1
+                self.q.requeue_worker(self.worker_id)
+                self._write_status("idle")
+                return False
+            self.items_failed += 1
+            self.q.fail(item.id, self.worker_id,
+                        f"attempt {item.attempts}: "
+                        f"{type(e).__name__}: {e}\n"
+                        f"{traceback.format_exc(limit=3)}")
+            self._write_status("idle")
+            return False
+
+        # The done record's shape is what merge_fleet parity-checks and
+        # FleetTelemetry aggregates: counts as a dict (not the summary's
+        # flattened keys), the codes sha as the parity pin, the full
+        # summary alongside for humans and json_parser-style consumers.
+        result = {
+            "benchmark": res.benchmark,
+            "strategy": res.strategy,
+            "injections": int(res.n),
+            "seconds": round(float(res.seconds), 6),
+            "counts": {k: int(v) for k, v in res.counts.items()},
+            "codes_sha256": codes_sha256(res.codes),
+            "cache_event": cache_event,
+            "worker": self.worker_id,
+            "summary": res.summary(),
+        }
+        if res.physical_n is not None:
+            result["physical_injections"] = int(res.physical_n)
+        self.q.complete(item.id, self.worker_id, result)
+        self.items_done += 1
+        self._current_item = None
+        self._write_status("idle")
+        return True

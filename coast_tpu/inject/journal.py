@@ -58,6 +58,7 @@ interrupted analyses.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import json
 import os
@@ -65,10 +66,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+try:
+    import fcntl
+except ImportError:                     # pragma: no cover - non-POSIX
+    fcntl = None
+
 __all__ = [
     "JournalError", "JournalExistsError", "JournalMismatchError",
-    "FaultModelMismatchError", "CampaignJournal", "schedule_fingerprint",
-    "config_fingerprint",
+    "FaultModelMismatchError", "JournalLockedError", "CampaignJournal",
+    "schedule_fingerprint", "config_fingerprint",
 ]
 
 
@@ -82,6 +88,18 @@ class JournalExistsError(JournalError):
 
 class JournalMismatchError(JournalError):
     """The journal's header does not describe the current campaign."""
+
+
+class JournalLockedError(JournalError):
+    """Another process holds the journal's exclusive append lock.
+
+    Every journal takes a non-blocking ``flock`` on its append handle
+    for as long as it is open, so two fleet workers (or a worker and a
+    wrongly-requeued duplicate of itself) can never interleave appends
+    into one journal -- the loser fails fast with this typed error
+    instead of silently corrupting the batch stream.  The lock dies
+    with the process, so a SIGKILL'd worker's journal is immediately
+    claimable by its replacement."""
 
 
 class FaultModelMismatchError(JournalMismatchError):
@@ -174,19 +192,63 @@ class CampaignJournal:
                 raise JournalExistsError(
                     f"journal {path!r} already exists; pass --resume to "
                     "continue it or delete the file to start fresh")
-            found_header, records, valid_bytes = cls._load(path)
-            cls._validate(found_header, header, path)
+            # Lock BEFORE loading: two resuming processes must not both
+            # truncate a torn tail / replay the prefix and then race
+            # their appends.
+            fh = cls._locked_append_handle(path)
+            try:
+                found_header, records, valid_bytes = cls._load(path)
+                cls._validate(found_header, header, path)
+            except BaseException:
+                fh.close()
+                raise
             if valid_bytes < os.path.getsize(path):
                 # Torn trailing line (kill mid-append): cut it off NOW,
                 # before any new append would fuse onto the fragment and
-                # corrupt the journal for the *next* resume.
-                with open(path, "rb+") as fh:
-                    fh.truncate(valid_bytes)
+                # corrupt the journal for the *next* resume.  (The
+                # append handle is O_APPEND: it seeks to the new end on
+                # every write, so truncating under it is safe.)
+                with open(path, "rb+") as tfh:
+                    tfh.truncate(valid_bytes)
             j = cls(path, found_header, records, fsync=fsync)
+            j._fh = fh
             return j
         j = cls(path, header, fsync=fsync)
         j.append({"kind": "header", **header})
         return j
+
+    @staticmethod
+    def _locked_append_handle(path: str):
+        """Open ``path`` for append and take the exclusive non-blocking
+        ``flock`` every open journal holds until close: the single-writer
+        guarantee of the fleet (two workers can never interleave appends
+        into one journal).  Raises :class:`JournalLockedError` if another
+        process -- or another open handle in this one -- holds it."""
+        fh = open(path, "a")
+        if fcntl is not None:
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK,
+                               errno.EACCES):
+                    fh.close()
+                    raise JournalLockedError(
+                        f"journal {path!r} is locked by another process "
+                        "(its campaign is still appending); a second "
+                        "writer would interleave batch records.  Wait "
+                        "for the holder to finish or requeue the work "
+                        "item") from e
+                if e.errno in (errno.ENOLCK, errno.ENOTSUP,
+                               errno.EOPNOTSUPP, errno.EINVAL):
+                    # Filesystem without flock support (some NFS
+                    # mounts): degrade to unlocked, same as the
+                    # no-fcntl platform path -- a bogus "locked" error
+                    # here would make every fleet item ping-pong
+                    # between workers forever.
+                    return fh
+                fh.close()
+                raise
+        return fh
 
     @staticmethod
     def _load(path: str):
@@ -258,7 +320,7 @@ class CampaignJournal:
         appends -- a journaled 10^6-row campaign must not keep every
         batch's columns resident for its whole lifetime."""
         if self._fh is None:
-            self._fh = open(self.path, "a")
+            self._fh = self._locked_append_handle(self.path)
         self._fh.write(json.dumps(record, separators=(",", ":")) + "\n")
         self._fh.flush()
         if self.fsync:

@@ -54,10 +54,13 @@ def parse_command_line(argv: Optional[List[str]] = None):
     parser.add_argument("--filename", "-f", type=str, required=True,
                         help="program to run: a benchmark registry name "
                         "or a path to a restricted-C source (.c)")
+    # DEPRECATED (QEMU era): the reference supervisor parceled GDB/QEMU
+    # socket ports per worker (supervisor.py:335); the batched campaign
+    # has no sockets to parcel (scale-out is the mesh batch axis and the
+    # fleet queue, python -m coast_tpu.fleet).  Accept-and-warn so old
+    # scripts keep running; hidden from --help's primary group.
     parser.add_argument("--port-range", "-p", type=int, default=None,
-                        help="accepted for compatibility; the batched "
-                        "campaign needs no ports (scale-out is the mesh "
-                        "batch axis)")
+                        help=argparse.SUPPRESS)
     parser.add_argument("-t", metavar="N", type=int, default=1,
                         help="number of injections")
     parser.add_argument("-e", "--errorCount", metavar="N", type=int,
@@ -252,6 +255,11 @@ def parse_command_line(argv: Optional[List[str]] = None):
         i += 1
     args = parser.parse_args(joined)
 
+    if args.port_range is not None:
+        print("Warning, --port-range/-p is deprecated and ignored: the "
+              "GDB/QEMU port fabric it parceled out no longer exists "
+              "(scale-out is CampaignRunner(mesh=) and the campaign "
+              "fleet, python -m coast_tpu.fleet)", file=sys.stderr)
     if args.board in ("pynq", "hifive1"):
         print("This board not yet supported in this version", file=sys.stderr)
         sys.exit(-1)

@@ -23,8 +23,10 @@ response only.
 
 from __future__ import annotations
 
+import errno
 import http.server
 import json
+import sys
 import threading
 from typing import Optional
 
@@ -67,21 +69,42 @@ class MetricsServer:
     """Threaded HTTP server over one CampaignMetrics hub."""
 
     def __init__(self, metrics: CampaignMetrics, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", bind: Optional[str] = None):
+        """``bind`` is the listen address (default stays the loopback
+        ``host``); pass ``bind="0.0.0.0"`` for a fleet aggregator that
+        other hosts scrape.  ``metrics`` is duck-typed: anything with
+        ``prometheus()``/``snapshot()`` serves (a CampaignMetrics hub,
+        or a fleet aggregate, coast_tpu.fleet.telemetry)."""
         self.metrics = metrics
-        self.host = host
+        self.host = bind if bind is not None else host
         self.port = int(port)
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
-        """Bind and serve in a daemon thread; returns the bound port."""
+        """Bind and serve in a daemon thread; returns the bound port.
+
+        A requested port that is already taken falls back to an
+        ephemeral one (with a warning on stderr) instead of dying: on a
+        fleet host, per-worker servers and the aggregator coexist, and
+        "which port exactly" matters less than "the worker must not
+        crash because an operator reused a number"."""
         if self._httpd is not None:
             return self.port
         handler = type("BoundHandler", (_Handler,),
                        {"metrics": self.metrics})
-        self._httpd = http.server.ThreadingHTTPServer(
-            (self.host, self.port), handler)
+        try:
+            self._httpd = http.server.ThreadingHTTPServer(
+                (self.host, self.port), handler)
+        except OSError as e:
+            if self.port == 0 or e.errno not in (errno.EADDRINUSE,
+                                                 errno.EACCES):
+                raise
+            print(f"# warning: metrics port {self.port} on {self.host} "
+                  f"is taken ({e.strerror}); falling back to an "
+                  "ephemeral port", file=sys.stderr, flush=True)
+            self._httpd = http.server.ThreadingHTTPServer(
+                (self.host, 0), handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -1,0 +1,439 @@
+"""Campaign-fleet tests (ISSUE 9).
+
+The fleet contract: N queued campaigns drained by multiple workers --
+with one worker SIGKILL'd mid-campaign and replaced -- produce a merged,
+journal-parity-checked result whose per-item codes AND counts are
+bit-for-bit identical to the same campaigns run sequentially in one
+process, with the compile cache recording hits and the fleet /metrics
+endpoint serving aggregated per-class rates while workers are live.
+Plus: queue claim/lease/requeue atomicity under concurrent claimants,
+the journal's exclusive append lock, MetricsServer bind/port-fallback,
+and the CLI surface.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from coast_tpu.fleet import (CampaignQueue, CompileCache, FleetParityError,
+                             FleetTelemetry, LostLeaseError, QueueError,
+                             Worker, codes_sha256, item_spec, merge_fleet)
+from coast_tpu.inject.journal import CampaignJournal, JournalLockedError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mm_spec(n=200, seed=3, **kw):
+    kw.setdefault("batch_size", 50)
+    return item_spec("matrixMultiply", n, seed=seed, **kw)
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn_worker(queue_root, worker_id, lease="60"):
+    return subprocess.Popen(
+        [sys.executable, "-m", "coast_tpu.fleet", "worker",
+         "--queue", queue_root, "--worker-id", worker_id,
+         "--lease", lease],
+        env=_worker_env(), cwd=REPO_ROOT)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+# -- item specs --------------------------------------------------------------
+
+def test_item_spec_validation():
+    with pytest.raises(QueueError):
+        item_spec("mm", 0)                         # n must be positive
+    with pytest.raises(ValueError):
+        item_spec("mm", 10, fault_model="nonsense(k=2)")
+    with pytest.raises(QueueError):
+        item_spec("mm", 10, fault_model="multibit(k=2)", equiv=True)
+    from coast_tpu.obs.convergence import StopWhenError
+    with pytest.raises(StopWhenError):
+        item_spec("mm", 10, stop_when="not-a-spec")
+
+
+# -- queue semantics ---------------------------------------------------------
+
+def test_enqueue_claim_complete_roundtrip(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    iid = q.enqueue(_mm_spec())
+    assert q.stats() == {"pending": 1, "claimed": 0, "done": 0, "failed": 0}
+    item = q.claim("w0", lease_s=60)
+    assert item.id == iid and item.worker == "w0" and item.attempts == 1
+    assert q.stats()["claimed"] == 1
+    assert q.claim("w1") is None                   # nothing left
+    q.complete(iid, "w0", {"counts": {"success": 1}})
+    assert q.stats() == {"pending": 0, "claimed": 0, "done": 1, "failed": 0}
+    assert q.drained()
+    assert q.items("done")[0]["result"]["counts"] == {"success": 1}
+
+
+def test_claim_fifo_order(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    ids = [q.enqueue(_mm_spec(seed=s)) for s in range(5)]
+    claimed = [q.claim("w0").id for _ in range(5)]
+    assert claimed == ids
+
+
+def test_claim_atomicity_under_concurrent_claimants(tmp_path):
+    """Many claimants race over the same pending set: every item is
+    claimed exactly once (the rename arbitration), none vanish."""
+    q = CampaignQueue(str(tmp_path / "q"))
+    n_items, n_workers = 24, 8
+    ids = {q.enqueue(_mm_spec(seed=s)) for s in range(n_items)}
+    got = {w: [] for w in range(n_workers)}
+    barrier = threading.Barrier(n_workers)
+
+    def claimant(w):
+        barrier.wait()
+        while True:
+            item = q.claim(f"w{w}", lease_s=60)
+            if item is None:
+                return
+            got[w].append(item.id)
+
+    threads = [threading.Thread(target=claimant, args=(w,))
+               for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_claimed = [iid for claims in got.values() for iid in claims]
+    assert len(all_claimed) == n_items          # no double-claims
+    assert set(all_claimed) == ids              # no lost items
+
+
+def test_lease_expiry_requeues_with_journal_kept(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    iid = q.enqueue(_mm_spec())
+    q.claim("w0", lease_s=30)
+    with open(q.journal_path(iid), "w") as fh:
+        fh.write("{}\n")                        # the crashed run's journal
+    assert q.requeue_expired() == []            # lease still live
+    assert q.requeue_expired(now=time.time() + 60) == [iid]
+    assert q.stats()["pending"] == 1
+    item = q.claim("w1", lease_s=30)
+    assert item.attempts == 2                   # requeue preserved history
+    assert os.path.exists(q.journal_path(iid))  # resume material survives
+
+
+def test_requeue_worker_immediate(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    a = q.enqueue(_mm_spec(seed=1))
+    b = q.enqueue(_mm_spec(seed=2))
+    q.claim("dead", lease_s=3600)
+    q.claim("alive", lease_s=3600)
+    assert q.requeue_worker("dead") == [a]
+    assert q.stats() == {"pending": 1, "claimed": 1, "done": 0, "failed": 0}
+    assert q.claim("w2").id == a
+    assert b not in q.requeue_worker("dead")
+
+
+def test_renew_raises_lost_lease(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    iid = q.enqueue(_mm_spec())
+    q.claim("w0", lease_s=30)
+    q.renew(iid, "w0", lease_s=30)              # happy path
+    q.requeue_expired(now=time.time() + 60)
+    with pytest.raises(LostLeaseError):
+        q.renew(iid, "w0")                      # claim vanished
+    q.claim("w1", lease_s=30)
+    with pytest.raises(LostLeaseError):
+        q.renew(iid, "w0")                      # someone else owns it
+
+
+def test_complete_is_idempotent_after_requeue(tmp_path):
+    """A slow worker whose lease was wrongly reaped still lands its
+    journal-backed result; the stale pending requeue is swept on the
+    next claim instead of re-running finished work."""
+    q = CampaignQueue(str(tmp_path / "q"))
+    iid = q.enqueue(_mm_spec())
+    q.claim("slow", lease_s=30)
+    q.requeue_expired(now=time.time() + 60)     # wrongly reaped
+    q.complete(iid, "slow", {"counts": {"success": 2}})
+    assert q.stats()["done"] == 1
+    assert q.stats()["pending"] == 0            # stale requeue cleared
+    assert q.claim("w1") is None
+    assert q.drained()
+
+
+# -- journal append lock (satellite) -----------------------------------------
+
+def test_journal_lock_refused_while_held(tmp_path):
+    jpath = str(tmp_path / "locked.journal")
+    j = CampaignJournal.open(jpath, {"mode": "run", "seed": 1})
+    with pytest.raises(JournalLockedError):
+        CampaignJournal.open(jpath, {"mode": "run", "seed": 1})
+    j.append({"kind": "batch", "lo": 0, "n": 1, "codes": [0],
+              "counts": {}})
+    j.close()                                   # close releases the lock
+    j2 = CampaignJournal.open(jpath, {"mode": "run", "seed": 1})
+    with pytest.raises(JournalLockedError):
+        CampaignJournal.open(jpath, {"mode": "run", "seed": 1})
+    j2.close()
+
+
+# -- metrics server satellites -----------------------------------------------
+
+def test_metrics_server_bind_and_port_fallback(capsys):
+    from coast_tpu.obs.metrics import CampaignMetrics
+    from coast_tpu.obs.serve import MetricsServer
+    hub = CampaignMetrics()
+    first = MetricsServer(hub, port=0, bind="127.0.0.1")
+    port = first.start()
+    # Same explicit port again: must fall back to an ephemeral port with
+    # a warning instead of dying -- per-worker servers coexist.
+    second = MetricsServer(hub, port=port)
+    port2 = second.start()
+    try:
+        assert port2 != port and port2 > 0
+        assert "falling back" in capsys.readouterr().err
+        assert "coast_tpu campaign metrics" in _get(
+            f"http://127.0.0.1:{port2}/")
+    finally:
+        first.stop()
+        second.stop()
+
+
+def test_port_range_flag_deprecated(capsys):
+    from coast_tpu.inject.supervisor import parse_command_line
+    args = parse_command_line(["-f", "matrixMultiply", "-p", "10000"])
+    assert args.port_range == 10000             # accepted...
+    assert "deprecated" in capsys.readouterr().err  # ...with a warning
+    with pytest.raises(SystemExit):
+        parse_command_line(["--help"])
+    assert "--port-range" not in capsys.readouterr().out
+
+
+# -- compile cache -----------------------------------------------------------
+
+def test_compile_cache_hit_paths_equivalent(tmp_path):
+    """miss -> warm_hit -> persistent_hit, with identical classification
+    on every path (the cache must never change what a campaign measures)."""
+    root = str(tmp_path / "cache")
+    spec = _mm_spec(n=120, seed=5)
+    cache = CompileCache(root)
+    r1, _, key, ev1 = cache.runner(spec)
+    assert ev1 == "miss"
+    cold = r1.run(120, seed=5, batch_size=50)
+    cache.mark_compiled(key, spec)
+    r2, _, key2, ev2 = cache.runner(spec)
+    assert ev2 == "warm_hit" and key2 == key and r2 is r1
+    warm = r2.run(120, seed=5, batch_size=50)
+    # a fresh process over the same cache dir: the key ledger makes the
+    # rebuild a persistent hit (XLA binary served from disk, best-effort)
+    cache2 = CompileCache(root)
+    r3, _, _, ev3 = cache2.runner(spec)
+    assert ev3 == "persistent_hit"
+    persist = r3.run(120, seed=5, batch_size=50)
+    assert np.array_equal(cold.codes, warm.codes)
+    assert np.array_equal(cold.codes, persist.codes)
+    assert cold.counts == warm.counts == persist.counts
+    assert cache.snapshot()["hits"] == 1 and cache.snapshot()["misses"] == 1
+    assert cache2.snapshot() == {**cache2.snapshot(),
+                                 "persistent_hit": 1, "miss": 0}
+
+
+def test_compile_cache_key_separates_configs(tmp_path):
+    cache = CompileCache(str(tmp_path / "cache"))
+    spec_tmr = _mm_spec()
+    spec_dwc = _mm_spec(opt_passes="-DWC")
+    r1, s1, k1, _ = cache.runner(spec_tmr)
+    r2, s2, k2, _ = cache.runner(spec_dwc)
+    assert k1 != k2 and r1 is not r2
+    assert (s1, s2) == ("TMR", "DWC")
+
+
+# -- worker + merge ----------------------------------------------------------
+
+def test_worker_drains_queue_and_merge_parity(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    specs = [_mm_spec(n=150, seed=s) for s in (3, 4)]
+    for spec in specs:
+        q.enqueue(spec)
+    w = Worker(q, "w0", max_retries=0)
+    assert w.drain() == 2
+    assert q.drained() and q.stats()["done"] == 2
+    assert w.cache.counters["warm_hit"] == 1    # same config, built once
+    result = merge_fleet(q)
+    assert result["parity"] == "ok" and len(result["items"]) == 2
+    # sequential single-process reference through the same build path
+    ref_cache = CompileCache(str(tmp_path / "refcache"))
+    for item, spec in zip(result["items"], specs):
+        runner, _, _, _ = ref_cache.runner(spec)
+        ref = runner.run(spec["n"], seed=spec["seed"],
+                         batch_size=spec["batch_size"])
+        assert item["codes_sha256"] == codes_sha256(ref.codes)
+        assert item["counts"] == {k: int(v) for k, v in ref.counts.items()}
+
+
+def test_worker_fails_unbuildable_item_terminally(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    q.enqueue(item_spec("noSuchBenchmark", 10))
+    w = Worker(q, "w0", max_retries=0)
+    assert w.drain() == 0
+    assert q.stats()["failed"] == 1 and q.drained()
+    assert "build" in q.items("failed")[0]["error"]
+    result = merge_fleet(q)
+    assert result["items"] == [] and len(result["failed"]) == 1
+
+
+def test_merge_refuses_tampered_done_record(tmp_path):
+    q = CampaignQueue(str(tmp_path / "q"))
+    iid = q.enqueue(_mm_spec(n=100))
+    Worker(q, "w0", max_retries=0).drain()
+    path = os.path.join(q.root, "done", f"{iid}.json")
+    doc = json.load(open(path))
+    doc["result"]["codes_sha256"] = "0" * 64
+    json.dump(doc, open(path, "w"))
+    with pytest.raises(FleetParityError):
+        merge_fleet(q)
+
+
+def test_fleet_telemetry_aggregates_while_live(tmp_path):
+    """The fleet /metrics endpoint serves aggregated per-class rates
+    WHILE a worker is running (probed mid-campaign over HTTP)."""
+    from coast_tpu.obs.serve import MetricsServer
+    q = CampaignQueue(str(tmp_path / "q"))
+    for s in (3, 4):
+        q.enqueue(_mm_spec(n=200, seed=s, throttle_s=0.02))
+    server = MetricsServer(FleetTelemetry(q, stale_s=30.0), port=0)
+    port = server.start()
+    worker = Worker(q, "w0", max_retries=0)
+    thread = threading.Thread(target=worker.drain, daemon=True)
+    thread.start()
+    live_prom = None
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline:
+            prom = _get(f"http://127.0.0.1:{port}/metrics")
+            doc = json.loads(_get(f"http://127.0.0.1:{port}/status"))
+            if ("coast_fleet_class_rate" in prom
+                    and doc["workers_live"] >= 1
+                    and not q.drained()):
+                live_prom = prom
+                break
+            time.sleep(0.02)
+        thread.join(timeout=120)
+    finally:
+        server.stop()
+    assert live_prom is not None, "fleet rates never became visible live"
+    assert 'coast_fleet_queue_items{state="pending"}' in live_prom
+    assert "coast_fleet_compile_cache_events_total" in live_prom
+    final = FleetTelemetry(q).snapshot()
+    totals = merge_fleet(q)["totals"]
+    assert final["counts"] == {k: float(v) for k, v in totals.items()}
+
+
+# -- the acceptance pin: SIGKILL mid-campaign, fleet converges ---------------
+
+def test_fleet_kill_resume_parity(tmp_path):
+    """A worker process SIGKILL'd mid-campaign: the fleet requeues its
+    item, a replacement resumes the journal, and the merged result is
+    bit-identical (codes AND counts) to the sequential single-process
+    run -- with the compile cache recording the replacement's rebuild
+    as a hit."""
+    q = CampaignQueue(str(tmp_path / "q"))
+    spec_killed = _mm_spec(n=300, seed=7, throttle_s=0.25)
+    spec_other = _mm_spec(n=150, seed=8)
+    iid = q.enqueue(spec_killed)
+    other = q.enqueue(spec_other)
+    victim = _spawn_worker(q.root, "victim")
+    jpath = q.journal_path(iid)
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline:
+            if os.path.exists(jpath):
+                batches = sum(1 for line in open(jpath, "rb")
+                              if b'"kind":"batch"' in line)
+                if batches >= 2:
+                    break
+            time.sleep(0.05)
+        else:
+            pytest.fail("victim worker never journaled a batch")
+        victim.kill()
+    finally:
+        victim.wait(timeout=30)
+    assert q.requeue_worker("victim") == [iid]
+    size_at_kill = os.path.getsize(jpath)
+
+    rescuer = Worker(q, "rescuer", max_retries=0)
+    rescuer.drain()
+    assert q.drained() and q.stats()["done"] == 2
+    # the replacement's rebuild of the killed config is a cache hit
+    # (the victim recorded the key at its first collected batch)
+    assert rescuer.cache.hits >= 1
+    assert os.path.getsize(jpath) > size_at_kill   # resumed, not redone
+
+    result = merge_fleet(q)
+    by_id = {item["id"]: item for item in result["items"]}
+    assert by_id[iid]["attempts"] == 2
+    ref_cache = CompileCache(str(tmp_path / "refcache"))
+    for item_id, spec in ((iid, spec_killed), (other, spec_other)):
+        runner, _, _, _ = ref_cache.runner(spec)
+        ref = runner.run(spec["n"], seed=spec["seed"],
+                         batch_size=spec["batch_size"])
+        assert by_id[item_id]["codes_sha256"] == codes_sha256(ref.codes)
+        assert by_id[item_id]["counts"] == {
+            k: int(v) for k, v in ref.counts.items()}
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_fleet_cli_end_to_end(tmp_path):
+    """enqueue -> run -> status -> merge over subprocesses: the
+    zero-to-aha command path."""
+    qroot = str(tmp_path / "q")
+    env = _worker_env()
+    enq = subprocess.run(
+        [sys.executable, "-m", "coast_tpu.fleet", "enqueue",
+         "--queue", qroot, "-f", "matrixMultiply", "-O", "-TMR",
+         "-t", "120", "--seed", "2", "--batch-size", "50",
+         "--count", "2"],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=120)
+    assert enq.returncode == 0, enq.stderr
+    assert len(enq.stdout.split()) == 2          # two item ids
+    run = subprocess.run(
+        [sys.executable, "-m", "coast_tpu.fleet", "run",
+         "--queue", qroot, "--workers", "2", "--lease", "20",
+         "--poll", "0.2"],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=300)
+    assert run.returncode == 0, run.stderr + run.stdout
+    assert "parity ok" in run.stdout
+    artifact = json.load(open(os.path.join(qroot, "fleet_result.json")))
+    assert artifact["parity"] == "ok" and len(artifact["items"]) == 2
+    assert artifact["injections"] == 240
+    status = subprocess.run(
+        [sys.executable, "-m", "coast_tpu.fleet", "status",
+         "--queue", qroot],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=120)
+    assert status.returncode == 0
+    doc = json.loads(status.stdout)
+    assert doc["queue"]["done"] == 2 and doc["injections_done"] == 240
+
+
+def test_fleet_cli_run_refuses_empty_queue(tmp_path):
+    from coast_tpu.fleet.supervisor import main
+    qroot = str(tmp_path / "q")
+    CampaignQueue(qroot)
+    assert main(["run", "--queue", qroot, "--workers", "1"]) == 1
