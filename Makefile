@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -103,6 +103,13 @@ obs_live_smoke:
 # compile-cache hit recorded, live fleet /metrics served.
 fleet_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.fleet_smoke
+
+# Protected-training smoke (also a fast.yml driver row): fault-free
+# trajectory bit-identical across all 4 strategies (FuzzyFlow
+# differential pin), both silent-training-corruption buckets populated
+# by a tiny seeded campaign, selective-xMR commit votes repairing.
+train_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.train_smoke
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

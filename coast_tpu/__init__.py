@@ -33,8 +33,9 @@ if (not _os.environ.get("COAST_NO_COMPILE_CACHE")
         _jax.config.update("jax_persistent_cache_min_compile_time_secs",
                            0.5)
 
-from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_REG, KIND_RO,
-                                 KIND_STACK, LeafSpec, Region)
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_OPT_STATE,
+                                 KIND_PARAM, KIND_REG, KIND_RO, KIND_STACK,
+                                 LeafSpec, Region)
 from coast_tpu.passes.dataflow_protection import (ProtectedProgram,
                                                   ProtectionConfig, protect)
 from coast_tpu.passes.strategies import DWC, EDDI, TMR, unprotected
@@ -43,7 +44,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Region", "LeafSpec", "KIND_MEM", "KIND_REG", "KIND_CTRL", "KIND_RO",
-    "KIND_STACK",
+    "KIND_STACK", "KIND_PARAM", "KIND_OPT_STATE",
     "ProtectionConfig", "ProtectedProgram", "protect",
     "TMR", "DWC", "EDDI", "unprotected",
 ]

@@ -112,7 +112,8 @@ class Advice:
 
         def rate(s):
             n = s["injections"]
-            bad = (s["sdc"] + s["due_abort"] + s["due_timeout"]
+            sdc = sum(s.get(k, 0) for k in cls.SDC_CLASSES)
+            bad = (sdc + s["due_abort"] + s["due_timeout"]
                    + s["invalid"])
             return bad / n if n else 0.0
 
@@ -137,7 +138,7 @@ def _leaf_harms(res: CampaignResult, runner: CampaignRunner) -> List[LeafHarm]:
         harms.append(LeafHarm(
             name=sec.name,
             injections=int(len(sel)),
-            sdc=int(binc[cls.SDC]),
+            sdc=int(binc[cls.SDC] + binc[cls.TRAIN_SDC]),
             due=int(binc[cls.DUE_ABORT] + binc[cls.DUE_TIMEOUT]),
             invalid=int(binc[cls.INVALID]),
             words=int(sec.words * sec.lanes)))

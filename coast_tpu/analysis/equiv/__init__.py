@@ -25,7 +25,8 @@ one's exactly -- pinned by tests/test_equiv.py and recorded in
 
 from __future__ import annotations
 
-from coast_tpu.analysis.equiv.partition import (EquivPartition,
+from coast_tpu.analysis.equiv.partition import (TRAIN_FALLBACK,
+                                                EquivPartition,
                                                 SectionSignature,
                                                 analyze_equivalence,
                                                 section_fingerprints)
@@ -34,4 +35,4 @@ from coast_tpu.analysis.equiv.delta import (DeltaMismatchError, DeltaPlan,
 
 __all__ = ["EquivPartition", "SectionSignature", "analyze_equivalence",
            "section_fingerprints", "DeltaMismatchError", "DeltaPlan",
-           "load_delta_base", "plan_delta"]
+           "load_delta_base", "plan_delta", "TRAIN_FALLBACK"]

@@ -105,7 +105,24 @@ _VALUE_OPERANDS = {
 _DETECTOR_CLASSES = frozenset({
     "load_addr", "store_data", "ctrl", "stack", "sor_crossing",
     "boundary", "call_boundary", "cfcss",
+    # Training regions' weight-update commit votes (KIND_PARAM /
+    # KIND_OPT_STATE leaves).  Note these detectors never LICENSE a
+    # merge on a train region -- the train fallback below forces every
+    # section exhaustive first; the membership only keeps the taint walk
+    # honest about where votes kill verbatim-word flow.
+    "param", "opt_state",
 })
+
+#: EquivPartition.fallback_reason value for training regions: the
+#: outcome class of a train SDC is a function of the *numeric value* of
+#: the flip (a low-mantissa weight flip self-heals where the same
+#: word's exponent bit diverges persistently), so every bit/word/lane
+#: coordinate is outcome-relevant and no merge mode except the dead
+#: class is sound.  The pass degrades to exhaustive -- documented,
+#: typed, and pinned by a counterexample test -- rather than deriving
+#: weights that would silently misreport wrong-weight outcomes.
+TRAIN_FALLBACK = ("train_probe outcome semantics are bit-value-dependent; "
+                  "all sections forced exhaustive")
 
 
 def _detector_tag(tag: str) -> bool:
@@ -177,6 +194,11 @@ class _TaintWalk:
                 self._feed(eqn, union)
                 return [frozenset()]
             return [ins[0] if ins else frozenset()]
+
+        if prim == "optimization_barrier":
+            # n-ary identity fence: words pass through verbatim, per
+            # position -- neither consumed nor mixed.
+            return list(ins)
 
         if prim in _STRUCTURAL_PRIMS:
             value_pos = _VALUE_OPERANDS.get(prim, lambda e: ())(eqn)
@@ -277,6 +299,12 @@ class EquivPartition:
     clean_steps: int
     signatures: Dict[str, SectionSignature]
     fingerprint: str           # sha over all section fps + clean_steps
+    # Non-None when the pass refused to derive merge modes and degraded
+    # every section to exhaustive (TRAIN_FALLBACK for training regions):
+    # the typed, documented no-silent-wrong-weights marker.  The dead
+    # class (sites past the clean halt step) is still merged -- a flip
+    # that provably never fires is sound under any outcome semantics.
+    fallback_reason: Optional[str] = None
 
     def _mode_table(self) -> np.ndarray:
         n = max((s.leaf_id for s in self.signatures.values()),
@@ -344,6 +372,8 @@ class EquivPartition:
             "num_clones": self.num_clones,
             "clean_steps": self.clean_steps,
             "fingerprint": self.fingerprint,
+            **({"fallback_reason": self.fallback_reason}
+               if self.fallback_reason else {}),
             "sections": {
                 name: {"mode": sig.mode_name,
                        "fingerprint": sig.fingerprint}
@@ -516,6 +546,13 @@ def analyze_equivalence(prog, closed=None) -> EquivPartition:
 
     guards = (region.stack_guard is not None
               or region.assert_guard is not None)
+    # Training regions (Region.train_probe): the outcome class depends
+    # on the flip's numeric VALUE -- classify splits SDC by whether the
+    # loss re-converged, and a low bit of a weight heals where the same
+    # word's exponent bit diverges -- so the bit/word/lane-dropping
+    # merge arguments above are all unsound.  Typed, documented
+    # fallback: every section exhaustive (only the dead class merges).
+    train_fallback = getattr(region, "train_probe", None) is not None
     cfcss = getattr(prog, "_cfcss_step", None) is not None
     fn_unsafe = n > 1 and any(
         scope not in ("replicated", "replicated_return")
@@ -547,7 +584,9 @@ def analyze_equivalence(prog, closed=None) -> EquivPartition:
         pre_voted = bool(getattr(prog, "pre_sync", {}).get(name, False))
         step_voted = bool(getattr(prog, "step_sync", {}).get(name, False))
 
-        if replicated:
+        if train_fallback:
+            mode = MODE_EXH
+        elif replicated:
             if (cfcss or guards or fn_unsafe or kind == "cfcss"
                     or name in lane_flagged):
                 mode = MODE_EXH
@@ -595,7 +634,8 @@ def analyze_equivalence(prog, closed=None) -> EquivPartition:
         num_clones=n,
         clean_steps=clean_steps,
         signatures=signatures,
-        fingerprint=overall.hexdigest())
+        fingerprint=overall.hexdigest(),
+        fallback_reason=TRAIN_FALLBACK if train_fallback else None)
 
 
 def section_fingerprints(prog, partition: Optional[EquivPartition] = None

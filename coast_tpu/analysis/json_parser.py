@@ -54,15 +54,26 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 # Outcome classes, matching coast_tpu.inject.classify codes / CLASS_NAMES.
 _CLASSES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
-            "invalid", "due_stack_overflow", "due_assert")
+            "invalid", "due_stack_overflow", "due_assert",
+            "train_self_heal", "train_sdc")
 # DUE bucket membership (classify.DUE_CLASSES): aborts / stack overflows /
 # assert fails all count as timeouts in the reference's summary
 # (jsonParser.py:165-172; decoder classes decoder.py:67-69).
 _DUE_CLASSES = ("due_abort", "due_timeout", "due_stack_overflow",
                 "due_assert")
-# Codes <= _COMPLETED_MAX (success/corrected/sdc) ran to completion and
-# contribute to the mean-runtime statistic.
-_COMPLETED_MAX = 2
+# Uncorrected silent corruption (classify.SDC_CLASSES): the error-rate /
+# MWTF numerator.  train_self_heal is deliberately NOT an error -- the
+# workload's output (the converged loss) was not corrupted.
+_SDC_CLASSES = ("sdc", "train_sdc")
+# Codes that ran to completion (reached the result line) and contribute
+# to the mean-runtime statistic: success/corrected/sdc plus the train
+# refinements of sdc (classify.COMPLETED_CLASSES).
+_COMPLETED_CODES = (0, 1, 2, 8, 9)
+
+
+def _completed_mask(codes):
+    import numpy as np
+    return np.isin(codes, _COMPLETED_CODES)
 
 
 def mean_steps_or_nan(step_sum: float, step_n: int, n: int,
@@ -102,6 +113,14 @@ def classify_run(run: Dict[str, object]) -> str:
         return "due_stack_overflow"
     if "assertion" in res:
         return "due_assert"
+    if "trainSdc" in res:
+        # Training refinements of SDC (coast_tpu.train): the result dict
+        # carries the ordinary RunResult fields (core/runtime/errors)
+        # plus the discriminating key, so these branches must sit above
+        # the "core" dispatch.
+        return "train_sdc"
+    if "selfHeal" in res:
+        return "train_self_heal"
     if "timeout" in res:
         return "due_timeout"
     if "message" in res:
@@ -164,10 +183,14 @@ class Summary:
 
     @property
     def error_rate(self) -> float:
-        return self.counts["sdc"] / self.n if self.n else 0.0
+        # Persistent train SDCs count as errors (classify.SDC_CLASSES);
+        # for every non-train campaign the extra key is absent/zero, so
+        # the pre-training value is unchanged.
+        sdc = sum(self.counts.get(k, 0) for k in _SDC_CLASSES)
+        return sdc / self.n if self.n else 0.0
 
     def pct(self, cls: str) -> float:
-        return 100.0 * self.counts[cls] / self.n if self.n else 0.0
+        return 100.0 * self.counts.get(cls, 0) / self.n if self.n else 0.0
 
     def seconds_per_injection(self) -> float:
         # summarizeTiming (jsonParser.py:204-213).  Reduced campaigns
@@ -189,9 +212,10 @@ class Summary:
         if self.fault_model:
             lines.append(f"  fault model  {self.fault_model}")
         for cls in _CLASSES:
-            if cls in ("due_stack_overflow", "due_assert"):
-                continue          # printed as DUE sub-counts below
-            lines.append(f"  {cls:<12} {self.counts[cls]:>8}  "
+            if cls in ("due_stack_overflow", "due_assert",
+                       "train_self_heal", "train_sdc"):
+                continue          # printed as sub-count blocks below
+            lines.append(f"  {cls:<12} {self.counts.get(cls, 0):>8}  "
                          f"({self.pct(cls):6.2f}%)")
         lines.append(f"  {'due (total)':<12} {self.due:>8}  "
                      f"({100.0 * self.due / self.n if self.n else 0.0:6.2f}%)")
@@ -202,6 +226,17 @@ class Summary:
                            ("stack overflows", "due_stack_overflow"),
                            ("assert fails", "due_assert")):
             lines.append(f"    {label:<16} {self.counts.get(key, 0):>6}")
+        # Silent-training-corruption block (coast_tpu.train): only train
+        # campaigns ever populate these classes, so every other summary's
+        # text is unchanged.
+        heals = self.counts.get("train_self_heal", 0)
+        persists = self.counts.get("train_sdc", 0)
+        if heals or persists:
+            lines.append("  --- silent training corruption ---")
+            lines.append(f"    {'self-healed':<16} {heals:>6}  "
+                         "(loss re-converged)")
+            lines.append(f"    {'persistent SDC':<16} {persists:>6}  "
+                         "(weights + loss diverged)")
         lines.append(f"  error rate   {self.error_rate:.6f}")
         lines.append(f"  mean runtime {self.mean_steps:.1f} steps")
         if self.seconds:
@@ -373,14 +408,14 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                     codes, weights=w.astype(np.float64),
                     minlength=len(_CLASSES))).astype(np.int64)
                 n += int(w.sum())
-                completed = codes <= _COMPLETED_MAX
+                completed = _completed_mask(codes)
                 step_sum += int((steps[completed]
                                  * w[completed]).sum())
                 step_n += int(w[completed].sum())
             else:
                 binc = np.bincount(codes, minlength=len(_CLASSES))
                 n += len(codes)
-                completed = codes <= _COMPLETED_MAX  # success/corrected/sdc
+                completed = _completed_mask(codes)
                 step_sum += int(steps[completed].sum())
                 step_n += int(completed.sum())
             for i, cls in enumerate(_CLASSES):
@@ -625,14 +660,20 @@ def trap_counts(docs: Iterable[Dict[str, object]]) -> Tuple[int, int]:
 
 
 def format_section_stats(table: Dict[str, Dict[str, int]]) -> str:
+    # ``sdc`` column = _SDC_CLASSES: train campaigns refine the raw sdc
+    # bucket into train_sdc, which must still rank/print as corruption.
+    def _sdc(row):
+        return sum(row.get(k, 0) for k in _SDC_CLASSES)
+
     lines = ["--- per-section attribution ---",
              f"  {'symbol':<20} {'inj':>7} {'sdc':>6} {'corr':>6} "
              f"{'due':>6} {'inv':>5}  sdc%"]
-    for sym in sorted(table, key=lambda s: -table[s]["sdc"]):
+    for sym in sorted(table, key=lambda s: -_sdc(table[s])):
         row = table[sym]
         due = sum(row.get(k, 0) for k in _DUE_CLASSES)
-        pct = 100.0 * row["sdc"] / row["injections"] if row["injections"] else 0
-        lines.append(f"  {sym:<20} {row['injections']:>7} {row['sdc']:>6} "
+        sdc = _sdc(row)
+        pct = 100.0 * sdc / row["injections"] if row["injections"] else 0
+        lines.append(f"  {sym:<20} {row['injections']:>7} {sdc:>6} "
                      f"{row['corrected']:>6} {due:>6} {row['invalid']:>5}  "
                      f"{pct:5.1f}%")
     return "\n".join(lines)

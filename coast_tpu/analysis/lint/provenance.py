@@ -49,15 +49,21 @@ import jax
 import jax.numpy as jnp
 from jax.extend.core import Literal
 
-from coast_tpu.ir.region import KIND_CTRL, KIND_MEM, KIND_RO, KIND_STACK
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_OPT_STATE,
+                                 KIND_PARAM, KIND_RO, KIND_STACK)
 from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
 from coast_tpu.analysis.lint.findings import LintReport
 
 # Sync classes with an independently derivable expectation; other classes
 # (call_boundary, cfcss, boundary, view) are observed and reported but
-# carry no per-leaf expectation from the config alone.
+# carry no per-leaf expectation from the config alone.  'param' /
+# 'opt_state' are the training regions' weight-update commit votes
+# (KIND_PARAM / KIND_OPT_STATE leaves follow the store rule under their
+# own classes): the selective-xMR transform stands on exactly these
+# votes, so a build that loses one must fail coverage, not pass
+# vacuously.
 COVERAGE_CLASSES = ("load_addr", "store_data", "ctrl", "stack",
-                    "sor_crossing")
+                    "sor_crossing", "param", "opt_state")
 
 _SHARED, _LANED, _UNKNOWN = "shared", "laned", "unknown"
 
@@ -148,6 +154,14 @@ class _Walker:
                 self.tags[id(eqn)] = tag
                 v = dataclasses.replace(v, sanct=True, voted=True)
             return [v]
+
+        if prim == "optimization_barrier":
+            # An n-ary identity fence: provenance passes through per
+            # position.  The generic fallback below would misjudge it --
+            # it derives ONE lane axis from the first laned input, so a
+            # fence mixing laned and shared operands would degrade the
+            # shared ones to unknown and poison everything downstream.
+            return list(ins)
 
         if unknown:
             return [_Val(_UNKNOWN, 0, False, voted, deps)
@@ -434,6 +448,15 @@ def expected_sync_classes(region, cfg) -> Dict[str, Set[str]]:
                 # KIND_STACK leaves).
                 if not cfg.no_store_data_sync and name in flow.written:
                     expected[name].add("stack")
+            elif spec.kind in (KIND_PARAM, KIND_OPT_STATE):
+                # Training leaves: the weight-update commit vote (store
+                # rule under the leaf's own class).  The train regions
+                # gate it to the optimizer phase via a store_slice hint,
+                # which carries the same classified tag -- the
+                # expectation is phase-agnostic on purpose: the vote must
+                # EXIST in the live step, wherever it fires.
+                if not cfg.no_store_data_sync and name in flow.written:
+                    expected[name].add(spec.kind)
         else:
             if spec.kind != KIND_RO and name in flow.written:
                 expected[name].add("sor_crossing")

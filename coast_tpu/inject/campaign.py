@@ -109,6 +109,13 @@ class CampaignResult:
         return sum(self.counts[k] for k in cls.DUE_CLASSES)
 
     @property
+    def sdc_total(self) -> int:
+        """Uncorrected silent corruption: ``sdc`` plus the persistent
+        train refinement (classify.SDC_CLASSES; the self-heal bucket is
+        deliberately excluded -- the converged loss was not corrupted)."""
+        return sum(self.counts.get(k, 0) for k in cls.SDC_CLASSES)
+
+    @property
     def fault_model(self) -> FaultModel:
         """The schedule's fault model (FaultModel.single legacy default)."""
         return getattr(self.schedule, "model", None) or FaultModel()
@@ -264,6 +271,10 @@ class CampaignRunner:
         self.metrics = metrics
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
+        # Training regions (Region.train_probe) report the train outcome
+        # classes; every other region keeps the pre-training counts key
+        # set (classify.counts_dict absent-means-zero rule).
+        self._train = prog.region.train_probe is not None
         if equiv and self.fault_model.kind != "single":
             raise ValueError(
                 "equiv=True needs the single-bit fault model: a flip "
@@ -480,8 +491,7 @@ class CampaignRunner:
                 live_counts[:] += cls.weighted_histogram(
                     out["code"][fired], w[fired])
                 live_invalid += int(w[~fired].sum())
-            counts_so_far = {name: int(live_counts[i])
-                             for i, name in enumerate(cls.CLASS_NAMES)}
+            counts_so_far = cls.counts_dict(live_counts, self._train)
             counts_so_far["cache_invalid"] = live_invalid
             return counts_so_far
 
@@ -793,8 +803,7 @@ class CampaignRunner:
                 binc = cls.weighted_histogram(merged["code"][~invalid_draw],
                                               sched_w[~invalid_draw])
                 invalid_total = int(sched_w[invalid_draw].sum())
-            counts = {name: int(binc[i])
-                      for i, name in enumerate(cls.CLASS_NAMES)}
+            counts = cls.counts_dict(binc, self._train)
             counts["cache_invalid"] = invalid_total
         seconds = time.perf_counter() - t0
         res = CampaignResult(
@@ -1002,8 +1011,7 @@ class CampaignRunner:
             binc0 = cls.weighted_histogram(
                 cols["codes"][splice_idx],
                 part.class_weight[splice_idx])
-            splice_counts = {name: int(binc0[i])
-                             for i, name in enumerate(cls.CLASS_NAMES)}
+            splice_counts = cls.counts_dict(binc0, self._train)
             splice_counts["cache_invalid"] = 0
             progress(int(len(splice_idx)), dict(splice_counts))
         if len(run_idx):
@@ -1035,8 +1043,7 @@ class CampaignRunner:
             stages = sub_res.stages
             resilience = sub_res.resilience
         binc = cls.weighted_histogram(cols["codes"], part.class_weight)
-        counts = {name: int(binc[i])
-                  for i, name in enumerate(cls.CLASS_NAMES)}
+        counts = cls.counts_dict(binc, self._train)
         counts["cache_invalid"] = 0
         res = CampaignResult(
             benchmark=self.prog.region.name,
@@ -1191,7 +1198,7 @@ class CampaignRunner:
                 res = next_chunk(batch_size, chunk_seed)
                 results.append(res)
                 total += res.n
-                errors_seen += res.counts["sdc"]
+                errors_seen += res.sdc_total
                 chunk_seed += 1
                 if errors_seen >= min_errors:
                     break

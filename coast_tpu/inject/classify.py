@@ -51,16 +51,42 @@ DUE_TIMEOUT = 4  # watchdog bound hit (hang)
 INVALID = 5
 DUE_STACK_OVERFLOW = 6  # kernel stack check: blown canary / sp out of range
 DUE_ASSERT = 7          # kernel/task assertion tripped (configASSERT class)
+# Silent-training-corruption refinement of the SDC bucket (training
+# regions only, coast_tpu.train): a completed run whose final weights
+# differ bit-for-bit from the fault-free weights is still an SDC, but
+# training dynamics give it a second axis -- did the LOSS trajectory
+# re-converge to the golden trajectory within the heal window
+# (transient perturbation the optimizer absorbed) or stay diverged
+# (persistent weight corruption)?  Region.train_probe supplies the
+# verdict; non-training records never carry the probe, so these codes
+# are unreachable there and the pre-training taxonomy stays pinned.
+TRAIN_SELF_HEAL = 8     # weights differ, loss re-converged (transient)
+TRAIN_SDC = 9           # weights differ, loss diverged (persistent SDC)
 
-NUM_CLASSES = 8
+NUM_CLASSES = 10
 CLASS_NAMES = ("success", "corrected", "sdc", "due_abort", "due_timeout",
-               "invalid", "due_stack_overflow", "due_assert")
+               "invalid", "due_stack_overflow", "due_assert",
+               "train_self_heal", "train_sdc")
+# The taxonomy every pre-training campaign speaks: counts dicts for
+# regions without a train probe are built over exactly these keys, so
+# their logs/journals stay byte-identical to before the train classes
+# existed (the fault-model absent-means-single rule, applied to classes).
+BASE_CLASS_NAMES = CLASS_NAMES[:TRAIN_SELF_HEAL]
 
 # The DUE bucket's members (abort/timeout/stack-overflow/assert all count
 # as DUE, jsonParser.py:165-172 "aborts also count as timeouts"); single
 # source of truth for CampaignResult.due / Summary.due.
 DUE_CLASSES = ("due_abort", "due_timeout", "due_stack_overflow",
                "due_assert")
+# Uncorrected silent corruption: the classes an error rate / MWTF
+# comparison must count as "errors" (train_self_heal is deliberately
+# NOT here -- the output the workload cares about, the converged loss,
+# was not corrupted).
+SDC_CLASSES = ("sdc", "train_sdc")
+# Classes whose runs completed (reached the region's own result line)
+# and therefore contribute to the mean-runtime statistic.
+COMPLETED_CLASSES = ("success", "corrected", "sdc", "train_self_heal",
+                     "train_sdc")
 
 
 def classify(rec: Dict[str, jax.Array], output_words: int) -> jax.Array:
@@ -69,6 +95,15 @@ def classify(rec: Dict[str, jax.Array], output_words: int) -> jax.Array:
     invalid = jnp.logical_or(errors < 0, errors > output_words)
     code = jnp.where(rec["corrected"] > 0, CORRECTED, SUCCESS)
     code = jnp.where(errors > 0, SDC, code)
+    if "train_probe" in rec:
+        # Training regions only (Region.train_probe): split the SDC
+        # bucket by whether the loss trajectory re-converged.  Applied
+        # BEFORE the DUE/INVALID overrides so precedence is unchanged:
+        # a hung or aborted training step is a DUE, not a train SDC.
+        code = jnp.where(code == SDC,
+                         jnp.where(rec["train_probe"] >= 2,
+                                   TRAIN_SDC, TRAIN_SELF_HEAL),
+                         code)
     code = jnp.where(jnp.logical_not(rec["done"]), DUE_TIMEOUT, code)
     code = jnp.where(jnp.logical_or(rec["dwc_fault"], rec["cfc_fault"]),
                      DUE_ABORT, code)
@@ -82,6 +117,33 @@ def histogram(codes: jax.Array) -> jax.Array:
     """Per-class counts (int32 [NUM_CLASSES]); psum-able across shards."""
     return jnp.sum(
         jax.nn.one_hot(codes, NUM_CLASSES, dtype=jnp.int32), axis=0)
+
+
+def counts_dict(binc, train: bool = False):
+    """Class-histogram array -> the counts dict campaigns report.
+
+    ``train=False`` (any region without a train probe) emits exactly the
+    pre-training key set (BASE_CLASS_NAMES) -- the absent-means-zero
+    rule that keeps non-train log summaries and journal records
+    byte-identical to before the train classes existed; a nonzero tail
+    count is still emitted (it should be impossible there, and silently
+    dropping it would hide a classifier bug).  ``train=True`` always
+    carries the train keys, zero or not, so a train campaign's report
+    shape is stable."""
+    out = {}
+    for i, name in enumerate(CLASS_NAMES):
+        if train or i < len(BASE_CLASS_NAMES) or int(binc[i]):
+            out[name] = int(binc[i])
+    return out
+
+
+def completed_mask(codes):
+    """Boolean mask of runs that completed (reached the result line):
+    success/corrected/sdc plus the train refinements of sdc.  The single
+    membership rule behind every mean-runtime statistic."""
+    import numpy as np
+    codes = np.asarray(codes)
+    return (codes <= SDC) | (codes >= TRAIN_SELF_HEAL)
 
 
 def weighted_histogram(codes, weights=None):

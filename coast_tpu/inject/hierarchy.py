@@ -160,7 +160,11 @@ class RegisterFile:
 # scalar mapping, the vectorised scheduler, and the supervisor's 'text'
 # section alias.
 ICACHE_KINDS = ("ctrl", "cfcss")
-DCACHE_KINDS = ("mem", "ro")
+# Training regions' parameters and optimizer state (coast_tpu.train) are
+# data in HBM like any KIND_MEM image: the dcache overlays them, and the
+# supervisor's 'memory' section reaches them.  Regions without train
+# leaves match nothing extra, so pre-train footprints are unchanged.
+DCACHE_KINDS = ("mem", "ro", "param", "opt_state")
 
 
 def _overlay_rows(mmap: MemoryMap, cache_name: str):

@@ -142,6 +142,23 @@ def _result_dict(code: int, errors: int, corrected: int, steps: int,
         # AssertionFailResult class (decoder.py:67 configASSERT line).
         return {"assertion": f"kernel assert tripped at step {int(steps)}",
                 "timestamp": ts, "errors": 1}
+    if code == cls.TRAIN_SELF_HEAL:
+        # Completed training run whose weights differ bit-for-bit from
+        # the golden trajectory (errors > 0) but whose loss re-converged
+        # within the heal window: the discriminating "selfHeal" key
+        # rides alongside the ordinary RunResult fields so runtime/
+        # error accounting works unchanged.
+        return {"selfHeal": f"transient loss perturbation healed "
+                            f"(E={int(errors)})",
+                "timestamp": ts, "core": 0, "runtime": int(steps),
+                "errors": int(errors), "faults": int(corrected)}
+    if code == cls.TRAIN_SDC:
+        # Persistent silent training corruption: final weights AND loss
+        # diverged from the fault-free trajectory.
+        return {"trainSdc": f"persistent weight corruption "
+                            f"(E={int(errors)})",
+                "timestamp": ts, "core": 0, "runtime": int(steps),
+                "errors": int(errors), "faults": int(corrected)}
     return {"invalid": f"self-check out of domain (E={int(errors)})",
             "timestamp": ts}
 
@@ -385,6 +402,15 @@ def _ndjson_templates(ts: str):
         cls.DUE_ASSERT: (
             '{"assertion": "kernel assert tripped at step %%(steps)d", '
             '"timestamp": "%s", "errors": 1}' % ts),
+        cls.TRAIN_SELF_HEAL: (
+            '{"selfHeal": "transient loss perturbation healed '
+            '(E=%%(errors)d)", "timestamp": "%s", "core": 0, '
+            '"runtime": %%(steps)d, "errors": %%(errors)d, '
+            '"faults": %%(faults)d}' % ts),
+        cls.TRAIN_SDC: (
+            '{"trainSdc": "persistent weight corruption (E=%%(errors)d)", '
+            '"timestamp": "%s", "core": 0, "runtime": %%(steps)d, '
+            '"errors": %%(errors)d, "faults": %%(faults)d}' % ts),
     }
     line_tpl = (
         '{"timestamp": "%s", "number": %%(i)d, "section": "%%(section)s", '

@@ -32,7 +32,8 @@ from typing import List, Optional
 CACHE_SECTIONS = ("cache", "icache", "dcache", "l2cache")
 
 SECTION_CHOICES = ["stack", "text", "rodata", "data", "bss", "heap", "init",
-                   "registers", "memory", *CACHE_SECTIONS]
+                   "registers", "memory", "params", "opt_state",
+                   *CACHE_SECTIONS]
 
 from coast_tpu.inject.hierarchy import DCACHE_KINDS, ICACHE_KINDS
 
@@ -45,6 +46,11 @@ _KIND_SECTIONS = {
     "rodata": ("ro",),
     "registers": ("reg", "ctrl"),
     "text": ICACHE_KINDS,
+    # Training targets (coast_tpu.train): the persistent state classes
+    # by name, for campaigns over just the weights or just the
+    # optimizer moments (docs/training.md).
+    "params": ("param",),
+    "opt_state": ("opt_state",),
 }
 
 

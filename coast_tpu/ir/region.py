@@ -53,8 +53,18 @@ KIND_RO = "ro"      # read-only inputs (.rodata); never written by step()
 # (supervisor.py:340 section list).  Votes on these leaves are tagged
 # with the 'stack' sync class.
 KIND_STACK = "stack"
+# ML-training regions (coast_tpu.train): model parameters and optimizer
+# state (momentum buffers / Adam moments).  Both follow the KIND_MEM
+# store rule -- written leaves get a commit-boundary vote -- but carry
+# their own section kinds so campaign attribution separates weight hits
+# from optimizer-moment hits (the axes the training outcome semantics
+# distinguish), and their votes are tagged with the 'param' /
+# 'opt_state' sync classes the lint re-derives independently.
+KIND_PARAM = "param"
+KIND_OPT_STATE = "opt_state"
 
-_VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO, KIND_STACK)
+_VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO, KIND_STACK,
+                KIND_PARAM, KIND_OPT_STATE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,6 +185,19 @@ class Region:
     #     not hold; latches DUE_ASSERT (decoder.py:67 class).
     stack_guard: Optional[Callable[[State], jax.Array]] = None
     assert_guard: Optional[Callable[[State], jax.Array]] = None
+    # Training-workload regions (coast_tpu.train): outcome probe over the
+    # VOTED final state view, returning an int32 scalar --
+    #   0 = the loss trajectory never left tolerance of the fault-free
+    #       (golden) trajectory,
+    #   1 = it deviated but re-converged for the final heal window
+    #       (transient perturbation the training dynamics absorbed),
+    #   2 = it was still outside tolerance at the end (persistent
+    #       divergence).
+    # The classifier uses it to split the SDC bucket of a completed run
+    # into TRAIN_SELF_HEAL vs TRAIN_SDC; regions without a probe keep
+    # the pre-training taxonomy bit-for-bit (classify only reads the
+    # probe when the record carries it).
+    train_probe: Optional[Callable[[State], jax.Array]] = None
 
     def leaf_is_xmr(self, name: str) -> bool:
         """Resolve the replication scope of a leaf (annotation > default)."""

@@ -17,6 +17,17 @@ def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
     return make
 
 
+def _train_lazy(optimizer: str) -> Callable[[], Region]:
+    """Training regions live in coast_tpu.train (a subsystem, not a
+    models module); the lazy shim keeps registry import costs zero and
+    points model_source at the builder module."""
+    def make() -> Region:
+        from coast_tpu.train.mlp import make_train_region
+        return make_train_region(optimizer)
+    make.module = "coast_tpu.train.mlp"
+    return make
+
+
 def c_source_paths(arg: str):
     """Split a '+'-joined C-source argument (multi-translation-unit
     programs: the reference links aes.c with TI_aes_128.c) and validate
@@ -64,10 +75,17 @@ def model_source(name: str) -> str:
     import importlib.util
     import os
     make = REGISTRY.get(name)
+    modpath = None
     if make is not None and hasattr(make, "modname"):
+        modpath = f"coast_tpu.models.{make.modname}"
+    elif make is not None and hasattr(make, "module"):
+        # Builders living outside coast_tpu.models (the train subsystem)
+        # carry their full module path.
+        modpath = make.module
+    if modpath is not None:
         # find_spec resolves the file without executing the module: the
         # log writer only needs a path, not the model's import-time work.
-        spec = importlib.util.find_spec(f"coast_tpu.models.{make.modname}")
+        spec = importlib.util.find_spec(modpath)
         if spec is not None and spec.origin:
             return os.path.realpath(spec.origin)
     import coast_tpu
@@ -121,6 +139,13 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     # rtos/kernel.config.
     "rtos_mm": _lazy("rtos_kernel", "make_rtos_mm"),
     "rtos_kUser": _lazy("rtos_kernel", "make_rtos_kuser"),
+    # Protected ML-training step (coast_tpu.train): fwd/bwd/optimizer as
+    # region phases, params/optimizer state as KIND_PARAM/KIND_OPT_STATE
+    # leaves, selective-xMR votes gated to the update commit, and the
+    # silent-training-corruption outcome classes (train_self_heal /
+    # train_sdc).  Recorded campaign: artifacts/train_campaign.json.
+    "train_mlp": _train_lazy("sgd"),
+    "train_mlp_adam": _train_lazy("adam"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

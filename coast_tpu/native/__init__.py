@@ -150,11 +150,12 @@ def native_available() -> bool:
 # Class-taxonomy ABI this Python layer speaks: must match the NUM_CLASSES
 # result codes of inject/classify.py.  The ndjson entry points refuse an
 # older .so (missing or lower coast_abi_version): a pre-sub-bucket binary
-# would render DUE_STACK_OVERFLOW/DUE_ASSERT rows as malformed (-2) or
-# classify their result keys into 'invalid' -- silent divergence from the
-# Python paths, which is worse than falling back to them.
-NDJSON_ABI = 2
-NUM_CLASSES = 8
+# would render DUE_STACK_OVERFLOW/DUE_ASSERT (ABI 2) or TRAIN_SELF_HEAL/
+# TRAIN_SDC (ABI 3) rows as malformed (-2) or classify their result keys
+# into 'invalid' -- silent divergence from the Python paths, which is
+# worse than falling back to them.
+NDJSON_ABI = 3
+NUM_CLASSES = 10
 
 
 def _ndjson_lib() -> Optional[ctypes.CDLL]:

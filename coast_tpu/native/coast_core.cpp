@@ -107,12 +107,13 @@ extern "C" {
 
 // ABI version of the class taxonomy the ndjson entry points speak.
 // Version 2 added the DUE sub-bucket classes (DUE_STACK_OVERFLOW=6,
-// DUE_ASSERT=7): counts arrays are 8 slots and the encoder/classifier
-// know the stackOverflow/assertion result templates.  Python callers
-// check this BEFORE using the ndjson paths: an older .so (rebuild failed
-// on a compiler-less host) must degrade to the Python formatter/parser,
-// never silently misclassify the new codes.
-int32_t coast_abi_version(void) { return 2; }
+// DUE_ASSERT=7); version 3 adds the training refinements of SDC
+// (TRAIN_SELF_HEAL=8, TRAIN_SDC=9): counts arrays are 10 slots and the
+// encoder/classifier know the selfHeal/trainSdc result templates.
+// Python callers check this BEFORE using the ndjson paths: an older .so
+// (rebuild failed on a compiler-less host) must degrade to the Python
+// formatter/parser, never silently misclassify the new codes.
+int32_t coast_abi_version(void) { return 3; }
 
 void coast_rand64(uint64_t seed, int64_t n, uint64_t* out) {
   for (int64_t i = 0; i < n; ++i) out[i] = splitmix_at(seed, (uint64_t)i);
@@ -348,6 +349,7 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
                            // semantics, so the caller must fall back.
     bool invalid = false, timeout = false, message = false, core = false;
     bool stack_overflow = false, assertion = false;
+    bool self_heal = false, train_sdc = false;
     int64_t errors = 0, faults = 0, runtime = 0;
   };
   auto scan_result = [](const char* q, const char* end) -> ResultKeys {
@@ -394,6 +396,8 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
             if (is("invalid", 7)) r.invalid = true;
             else if (is("stackOverflow", 13)) r.stack_overflow = true;
             else if (is("assertion", 9)) r.assertion = true;
+            else if (is("trainSdc", 8)) r.train_sdc = true;
+            else if (is("selfHeal", 8)) r.self_heal = true;
             else if (is("timeout", 7)) r.timeout = true;
             else if (is("message", 7)) r.message = true;
             else if (is("core", 4)) r.core = true;
@@ -470,6 +474,18 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
       counts[6]++;
     } else if (rk.assertion) {
       counts[7]++;
+    } else if (rk.train_sdc) {
+      // Training refinements of SDC: completed runs (they carry the
+      // ordinary core/runtime fields next to the discriminating key),
+      // so they feed the mean-runtime statistic like classify_run's
+      // "core" accounting does for them.
+      counts[9]++;
+      *step_sum += rk.runtime;
+      (*step_n)++;
+    } else if (rk.self_heal) {
+      counts[8]++;
+      *step_sum += rk.runtime;
+      (*step_n)++;
     } else if (rk.timeout) {
       counts[4]++;
     } else if (rk.message) {
@@ -497,7 +513,7 @@ int64_t coast_ndjson_classify(const char* buf, int64_t len, int64_t* counts,
 // pre-JSON-escaped from Python -- per-campaign work, not per-row.  Class
 // codes match inject/classify.py (asserted at the call site):
 //   0 SUCCESS, 1 CORRECTED, 2 SDC, 3 DUE_ABORT, 4 DUE_TIMEOUT, 5 INVALID,
-//   6 DUE_STACK_OVERFLOW, 7 DUE_ASSERT.
+//   6 DUE_STACK_OVERFLOW, 7 DUE_ASSERT, 8 TRAIN_SELF_HEAL, 9 TRAIN_SDC.
 // Rows with t < 0 are cache draws outside the program footprint (never
 // fired) and attribute to the "cache-invalid" pseudo-section.
 //
@@ -612,6 +628,33 @@ static int64_t ndjson_encode_body(
         put_lit(w, "\", \"timestamp\": \"");
         put_str(w, ts, ts_len);
         put_lit(w, "\", \"errors\": 1}");
+        break;
+      case 8:  // TRAIN_SELF_HEAL
+        put_lit(w, "{\"selfHeal\": \"transient loss perturbation healed "
+                   "(E=");
+        put_i64(w, errors[i]);
+        put_lit(w, ")\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"core\": 0, \"runtime\": ");
+        put_i64(w, steps[i]);
+        put_lit(w, ", \"errors\": ");
+        put_i64(w, errors[i]);
+        put_lit(w, ", \"faults\": ");
+        put_i64(w, corrected[i]);
+        put_lit(w, "}");
+        break;
+      case 9:  // TRAIN_SDC
+        put_lit(w, "{\"trainSdc\": \"persistent weight corruption (E=");
+        put_i64(w, errors[i]);
+        put_lit(w, ")\", \"timestamp\": \"");
+        put_str(w, ts, ts_len);
+        put_lit(w, "\", \"core\": 0, \"runtime\": ");
+        put_i64(w, steps[i]);
+        put_lit(w, ", \"errors\": ");
+        put_i64(w, errors[i]);
+        put_lit(w, ", \"faults\": ");
+        put_i64(w, corrected[i]);
+        put_lit(w, "}");
         break;
       default:
         return -2;

@@ -36,7 +36,8 @@ __all__ = ["Console"]
 
 #: Classes in display order (the classifier taxonomy + the invalid-draw
 #: bucket); zero-count classes that are not stop targets are elided.
-_CLASS_ORDER = ("success", "corrected", "sdc", "due_abort", "due_timeout",
+_CLASS_ORDER = ("success", "corrected", "sdc", "train_self_heal",
+                "train_sdc", "due_abort", "due_timeout",
                 "due_stack_overflow", "due_assert", "invalid",
                 "cache_invalid")
 

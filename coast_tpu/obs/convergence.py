@@ -40,7 +40,8 @@ __all__ = ["wilson_interval", "interval_table", "StopWhen",
 #: cache_invalid bucket the campaign counts alongside it.
 _VALID_CLASSES = ("success", "corrected", "sdc", "due_abort",
                   "due_timeout", "invalid", "due_stack_overflow",
-                  "due_assert", "cache_invalid")
+                  "due_assert", "train_self_heal", "train_sdc",
+                  "cache_invalid")
 
 
 class StopWhenError(ValueError):

@@ -26,7 +26,8 @@ from coast_tpu.obs import spans as _spans
 
 # Classes worth a heartbeat column, in print order; zero-count classes
 # are elided to keep the line short.
-_COUNT_KEYS = ("success", "corrected", "sdc", "due_abort", "due_timeout",
+_COUNT_KEYS = ("success", "corrected", "sdc", "train_self_heal",
+               "train_sdc", "due_abort", "due_timeout",
                "due_stack_overflow", "due_assert", "invalid",
                "cache_invalid")
 

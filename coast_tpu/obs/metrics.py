@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Deque, Dict, List, Mapping, Optional, Tuple
 
+from coast_tpu.inject.classify import SDC_CLASSES as _SDC_CLASSES
 from coast_tpu.obs.convergence import interval_table
 
 __all__ = ["Ring", "CampaignMetrics", "device_memory_bytes",
@@ -193,8 +194,11 @@ class CampaignMetrics:
             inst = n_rows / dt
             cum = self.done_rows / elapsed
             total_eff = float(sum(self.counts.values()))
-            sdc_rate = (self.counts.get("sdc", 0.0) / total_eff
-                        if total_eff else 0.0)
+            # classify.SDC_CLASSES: train regions refine the raw ``sdc``
+            # bucket into ``train_sdc`` (persistent) + self-heal, so the
+            # live rate must sum the persistent classes, not just "sdc".
+            sdc = sum(self.counts.get(k, 0.0) for k in _SDC_CLASSES)
+            sdc_rate = sdc / total_eff if total_eff else 0.0
             self.rings["inj_per_sec"].append(now, inst)
             self.rings["inj_per_sec_cumulative"].append(now, cum)
             self.rings["done_rows"].append(now, self.done_rows)

@@ -178,7 +178,7 @@ class ShardedCampaignRunner(CampaignRunner):
             # only blocking point, so device execution bills here.
             with tel.span("collect", n=n_part):
                 total += np.asarray(jax.device_get(pending), np.int64)
-        counts = {name: int(total[i]) for i, name in enumerate(cls.CLASS_NAMES)}
+        counts = cls.counts_dict(total, self._train)
         # Parity with run_schedule's counts: never-fired draws (t < 0; none
         # from generate(), which only emits in-footprint faults, but the
         # key must match) are their own bucket, not success.  On-device

@@ -40,8 +40,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_RO, KIND_STACK,
-                                 Region, State)
+from coast_tpu.ir.region import (KIND_CTRL, KIND_MEM, KIND_OPT_STATE,
+                                 KIND_PARAM, KIND_RO, KIND_STACK, Region,
+                                 State)
 from coast_tpu.ops import voters
 from coast_tpu.ops.bitflip import make_flipper
 
@@ -159,7 +160,7 @@ class ProtectionConfig:
         if name in self.xmr_globals:
             return True
         if self.no_mem_replication and region.spec[name].kind in (
-                KIND_MEM, KIND_RO, KIND_STACK):
+                KIND_MEM, KIND_RO, KIND_STACK, KIND_PARAM, KIND_OPT_STATE):
             return False
         if region.spec[name].kind == KIND_RO:
             # Read-only inputs are never cloned: same rule as constants /
@@ -247,6 +248,15 @@ class ProtectedProgram:
                 # operand matrices are 2/3 of the per-step voter traffic.
                 # KIND_STACK (per-task kernel stacks) follows the same
                 # store rule; its votes carry the 'stack' sync class tag.
+                self.step_sync[name] = (not cfg.no_store_data_sync
+                                        and name in flow.written)
+            elif spec.kind in (KIND_PARAM, KIND_OPT_STATE):
+                # Training regions: parameters and optimizer state follow
+                # the store rule (written leaves get a commit-boundary
+                # vote) under their own sync classes.  The train regions
+                # additionally gate these votes to the optimizer-commit
+                # phase via a 3-tuple store_slice hint -- the selective
+                # "vote the applied update, not every micro-step" shape.
                 self.step_sync[name] = (not cfg.no_store_data_sync
                                         and name in flow.written)
             else:  # reg: registers are voted only where used by a sync point
@@ -387,6 +397,11 @@ class ProtectedProgram:
             return "store_data"
         if spec.kind == KIND_CTRL:
             return "ctrl"
+        if spec.kind in (KIND_PARAM, KIND_OPT_STATE):
+            # Training leaves vote under their own classes so the lint's
+            # independently re-derived coverage expectation can require
+            # the weight-update commit votes by name.
+            return spec.kind
         # KIND_STACK kernel stacks and -protectStack register copies both
         # vote under the 'stack' class.
         return "stack"
@@ -620,7 +635,12 @@ class ProtectedProgram:
                             sl = jax.vmap(
                                 lambda lane: jax.lax.dynamic_slice(
                                     lane, _starts, _sizes))(lanes)
-                            sl = voters.sync_tag(sl, "store_data", _name)
+                            # The hinted vote carries the leaf's own sync
+                            # class (store_data for KIND_MEM, param/
+                            # opt_state for training leaves) so coverage
+                            # expectations hold under slice hints too.
+                            sl = voters.sync_tag(
+                                sl, self._sync_class_of(_name), _name)
                             voted, m = self._vote(sl, cfg.num_clones)
                             if cfg.num_clones == 3:
                                 rep = jnp.broadcast_to(voted, sl.shape)
@@ -921,6 +941,14 @@ class ProtectedProgram:
             "assert_fault": flags["assert_fault"],
             "output": self.region.output(view),
         }
+        if self.region.train_probe is not None:
+            # Training-outcome verdict over the voted final view (0 =
+            # loss trajectory clean, 1 = deviated but re-converged, 2 =
+            # still diverged); classify() splits the SDC bucket on it.
+            # Only train records carry the key, so every other region's
+            # classification program is unchanged.
+            rec["train_probe"] = jnp.asarray(
+                self.region.train_probe(view), jnp.int32)
         if trace:
             rec["trace_block"], rec["trace_live"] = ys
         if return_state:

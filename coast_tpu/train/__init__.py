@@ -1,0 +1,54 @@
+"""coast_tpu.train: a fault-injectable ML-training workload.
+
+The workload class the TPU backend uniquely enables (ROADMAP item 5b):
+fault injection into a *training step*.  The reference's QEMU+GDB loop
+could never afford this scenario -- one SGD step under gdb costs seconds,
+a statistically meaningful campaign over a training run costs days --
+while here an entire seeded campaign over thousands of perturbed
+training trajectories batches as one XLA program.
+
+A protected training step is a first-class multi-phase
+:class:`~coast_tpu.ir.region.Region` (:mod:`coast_tpu.train.mlp`): a
+small MLP whose forward, backward (``jax.grad`` traced inside the
+replicated lane), and optimizer phases run as distinct protected
+micro-steps, with the parameters and optimizer state declared as the
+new ``KIND_PARAM`` / ``KIND_OPT_STATE`` leaf kinds.  Full-program
+ML-to-TPU compilation (arXiv:1810.09868) is the precedent for treating
+fwd/bwd/optimizer as ONE compiled protected region rather than three
+framework calls.
+
+**Selective xMR.**  Replicating the whole training dataflow (full TMR)
+triples the FLOPs; most of the *fault sites*, though, live in the
+persistent HBM state -- weights and optimizer moments -- not in the
+transient backward dataflow.  :func:`selective_xmr` therefore replicates
+the persistent state and votes it at the weight-update commit (the
+region's ``store_slice`` hints gate the param/opt-state votes to the
+optimizer phase), while the gradient computation runs ONCE via the
+``-skipLibCalls`` single-lane scope (an accepted, linted SPOF): a flip
+in any weight or moment replica is repaired at the next commit, and the
+unreplicated gradient's exposure is one transient update -- which the
+training dynamics themselves absorb (the self-heal outcome class).
+The recorded campaign (``artifacts/train_campaign.json``) measures how
+much of full TMR's coverage this recovers at a fraction of the FLOPs.
+
+**Outcome semantics.**  Training refines what "silent corruption"
+means: a completed run whose final weights differ bit-for-bit from the
+fault-free run may still have *converged* -- the loss trajectory
+returned to the golden trajectory within the heal window.  The region's
+``train_probe`` reports that verdict and the classifier splits the SDC
+bucket into ``TRAIN_SELF_HEAL`` (transient loss perturbation) vs
+``TRAIN_SDC`` (persistent weight SDC), carried end-to-end through
+classify -> logs -> json_parser -> mwtf_report.  FuzzyFlow
+(arXiv:2306.16178) supplies the validation idiom: the protected step's
+fault-free trajectory is pinned bit-identical to the unprotected
+baseline (the differential artifact), so every divergence a campaign
+observes is attributable to the injected fault, never to the transform.
+"""
+
+from __future__ import annotations
+
+from coast_tpu.train.mlp import (HEAL_WINDOW, ITERS, PHASES, flops_overhead,
+                                 make_train_region, selective_xmr)
+
+__all__ = ["make_train_region", "selective_xmr", "flops_overhead",
+           "ITERS", "PHASES", "HEAL_WINDOW"]

@@ -1,0 +1,467 @@
+"""train_mlp: a protected training step as a multi-phase region.
+
+A 2-layer f32 MLP (6 -> 8 -> 4, full-batch of 8 samples) trained for a
+fixed number of iterations; each training iteration is three protected
+micro-steps -- the region's *phases*:
+
+    phase 0 (fwd):    loss <- MSE(forward(params, x), y); the loss
+                      monitor compares it against the fault-free
+                      (golden) loss trajectory.
+    phase 1 (bwd):    grads <- jax.grad(loss_fn)(params, x, y), traced
+                      INSIDE the replicated lane -- under full TMR every
+                      replica differentiates its own parameter copy;
+                      under selective xMR the ``grad_step`` sub-function
+                      is ``-skipLibCalls``-scoped and runs once.
+    phase 2 (commit): optimizer update (SGD+momentum or Adam) applied to
+                      the parameter and optimizer-state leaves; the
+                      region's ``store_slice`` hints gate the
+                      param/opt-state votes to exactly this phase, so
+                      the protected build votes the APPLIED UPDATE once
+                      per training iteration, not every micro-step.
+
+Leaf kinds: parameters are ``KIND_PARAM``, optimizer state (momentum
+buffers / Adam moments) ``KIND_OPT_STATE`` -- both replicated and voted
+at the commit under their own sync classes (the lint re-derives the
+expectation independently).  Training data is ``KIND_RO``; the live loss
+and gradients are ``KIND_REG`` registers; iteration/phase counters and
+the loss-trajectory monitor are ``KIND_CTRL``.
+
+**Golden trajectory.**  ``make_train_region`` runs the training loop
+fault-free at build time (the same stepped program, single lane) and
+bakes the final parameters plus the per-iteration loss trajectory into
+read-only leaves.  ``check()`` compares final weights bit-for-bit
+against the golden weights (any surviving perturbation is an SDC);
+``train_probe`` reads the loss monitor to split that SDC into transient
+(self-healed: the loss re-converged to the golden trajectory for the
+final ``HEAL_WINDOW`` iterations) vs persistent (still diverged at the
+end).  As with the mm benchmarks' golden matrix, the golden leaves are
+themselves injectable (.rodata is a real target): a flip there
+perturbs the *reference*, not the computation.  A ``g_loss`` flip
+disturbs the monitor and rides the normal probe split; a golden-weight
+flip leaves the monitor untouched (``dev == 0``) so the run reports
+``errors > 0`` with probe 0 and classifies ``train_self_heal`` --
+i.e. unlike mm (where golden flips land in the counted ``sdc``
+bucket), the train taxonomy's fidelity envelope keeps reference
+corruption out of the error rate, attributed to the golden section in
+the per-kind table.
+
+The probe's verdict is only as fresh as the last fwd monitor sample: a
+fault landing in the FINAL iteration's bwd/commit micro-steps (2 of
+the 3*ITERS steps) corrupts the saved weights after the loss was last
+evaluated, so re-convergence was never observed yet the run classifies
+``train_self_heal`` (``dev == 0``).  This blind window is a documented
+residual of post-hoc trajectory monitoring, not a healing claim; see
+docs/training.md.
+
+The monitor tolerance is relative (``TOL_REL`` of the golden loss, plus
+``TOL_ABS`` floor): a clean run's loss equals the golden bitwise, a
+low-mantissa weight flip perturbs it within tolerance (self-heal), a
+sign/exponent flip blows past it (persistent unless the optimizer pulls
+the trajectory back within the heal window).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.models.common import lcg_words
+from coast_tpu.ir.region import (KIND_CTRL, KIND_OPT_STATE, KIND_PARAM,
+                                 KIND_REG, KIND_RO, LeafSpec, Region)
+
+# Model / data geometry (kept tiny: a campaign run is a whole training
+# trajectory, ITERS * PHASES micro-steps of it).
+B, IN, HID, OUT = 8, 6, 8, 4
+ITERS = 12
+PHASES = 3
+FWD, BWD, COMMIT = 0, 1, 2
+SEED = 7
+
+# Optimizer hyper-parameters.
+LR = 0.05
+MOMENTUM = 0.9
+ADAM_B1, ADAM_B2, ADAM_EPS = 0.9, 0.999, 1e-8
+
+# Adam's bias-correction powers B^(it+1), precomputed host-side as an
+# f32 table and indexed by iteration instead of calling jnp.power on
+# device: pow is the one APPROXIMATE transcendental in the update chain
+# (sqrt/divide/mul/add round exactly), and XLA's vectorized pow may
+# differ by an ulp between SIMD widths / lane counts.  A table lookup is
+# bit-identical in every build shape, which removes one whole class of
+# golden-check instability (see _golden_trajectory on the one that
+# remains).
+_ADAM_B1_POW = np.cumprod(np.full(64, ADAM_B1, np.float64)) \
+    .astype(np.float32)
+_ADAM_B2_POW = np.cumprod(np.full(64, ADAM_B2, np.float64)) \
+    .astype(np.float32)
+
+# Loss-trajectory monitor: "self-healed" means the loss stayed within
+# TOL of the golden trajectory for the final HEAL_WINDOW iterations.
+TOL_REL = 0.10
+TOL_ABS = 1e-3
+HEAL_WINDOW = 3
+
+_PARAM_NAMES = ("w1", "b1", "w2", "b2")
+_PARAM_SHAPES = {"w1": (IN, HID), "b1": (HID,),
+                 "w2": (HID, OUT), "b2": (OUT,)}
+
+
+def _f32_fill(seed: int, shape, scale: float) -> jnp.ndarray:
+    """Deterministic f32 values in [-scale, scale) from the shared LCG."""
+    n = int(np.prod(shape))
+    raw = lcg_words(seed, n).astype(np.float32)     # 15-bit ints
+    vals = (raw / 16384.0 - 1.0) * scale
+    return jnp.asarray(vals.reshape(shape), jnp.float32)
+
+
+def _forward_loss(w1, b1, w2, b2, x, y):
+    """MSE of the 2-layer relu MLP -- the one loss definition shared by
+    the fwd phase, the bwd phase's jax.grad, and the golden run."""
+    h = jnp.maximum(x @ w1 + b1, 0.0)
+    pred = h @ w2 + b2
+    d = pred - y
+    return jnp.mean(d * d)
+
+
+def _grad_step(w1, b1, w2, b2, x, y):
+    """Backward pass: gradients of the loss w.r.t. every parameter.
+    A named region sub-function so the protection engine can scope it --
+    replicated (full TMR differentiates per lane) or ``-skipLibCalls``
+    (selective xMR computes it once, an accepted single-lane call)."""
+    return jax.grad(_forward_loss, argnums=(0, 1, 2, 3))(
+        w1, b1, w2, b2, x, y)
+
+
+def _opt_leaf_names(optimizer: str):
+    if optimizer == "sgd":
+        return tuple(f"m_{p}" for p in _PARAM_NAMES)
+    return tuple(f"m_{p}" for p in _PARAM_NAMES) + \
+        tuple(f"v_{p}" for p in _PARAM_NAMES)
+
+
+def _build(optimizer: str, golden):
+    """Construct the region; ``golden`` is None (proto build used only to
+    capture the fault-free trajectory) or the ``{final params, losses}``
+    dict to bake into the golden leaves."""
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError(f"unknown optimizer {optimizer!r} "
+                         "(one of: sgd, adam)")
+    x = _f32_fill(SEED, (B, IN), 1.0)
+    y = _f32_fill(SEED + 1, (B, OUT), 1.0)
+    init_params = {
+        name: _f32_fill(SEED + 2 + i, shape,
+                        0.5 / float(np.sqrt(shape[0] if len(shape) > 1
+                                            else HID)))
+        for i, (name, shape) in enumerate(_PARAM_SHAPES.items())
+    }
+    g_params = {name: (jnp.asarray(golden["params"][name])
+                       if golden else jnp.zeros_like(init_params[name]))
+                for name in _PARAM_NAMES}
+    g_loss = (jnp.asarray(golden["losses"], jnp.float32)
+              if golden else jnp.zeros((ITERS,), jnp.float32))
+
+    adam = optimizer == "adam"
+
+    def init():
+        state = {
+            **init_params,
+            "x": x, "y": y,
+            **{f"g_{n}": g_params[n] for n in _PARAM_NAMES},
+            "g_loss": g_loss,
+            **{f"gr_{n}": jnp.zeros(_PARAM_SHAPES[n], jnp.float32)
+               for n in _PARAM_NAMES},
+            **{f"m_{n}": jnp.zeros(_PARAM_SHAPES[n], jnp.float32)
+               for n in _PARAM_NAMES},
+            "loss": jnp.float32(0),
+            "it": jnp.int32(0),
+            "phase": jnp.int32(0),
+            "heal": jnp.int32(0),
+            "dev": jnp.int32(0),
+        }
+        if adam:
+            state.update({f"v_{n}": jnp.zeros(_PARAM_SHAPES[n], jnp.float32)
+                          for n in _PARAM_NAMES})
+        return state
+
+    def step(state, t, fns):
+        phase, it = state["phase"], state["it"]
+        params = [state[n] for n in _PARAM_NAMES]
+
+        # -- phase 0: forward + loss-trajectory monitor ------------------
+        cur_loss = _forward_loss(*params, state["x"], state["y"])
+        in_fwd = phase == FWD
+        loss = jnp.where(in_fwd, cur_loss, state["loss"])
+        gl = jnp.take(state["g_loss"], jnp.clip(it, 0, ITERS - 1))
+        within = jnp.abs(loss - gl) <= TOL_ABS + TOL_REL * jnp.abs(gl)
+        heal = jnp.where(in_fwd,
+                         jnp.where(within, state["heal"] + 1, 0),
+                         state["heal"])
+        dev = jnp.where(in_fwd,
+                        jnp.maximum(state["dev"],
+                                    jnp.logical_not(within)
+                                    .astype(jnp.int32)),
+                        state["dev"])
+
+        # -- phase 1: backward (jax.grad inside the lane) ----------------
+        g = fns.grad_step(*params, state["x"], state["y"])
+        in_bwd = phase == BWD
+        grads = {n: jnp.where(in_bwd, gv, state[f"gr_{n}"])
+                 for n, gv in zip(_PARAM_NAMES, g)}
+
+        # -- phase 2: optimizer commit -----------------------------------
+        in_commit = phase == COMMIT
+        out = {}
+        for n in _PARAM_NAMES:
+            p, gr, m = state[n], grads[n], state[f"m_{n}"]
+            if adam:
+                v = state[f"v_{n}"]
+                idx = jnp.clip(it, 0, _ADAM_B1_POW.shape[0] - 1)
+                b1p = jnp.take(jnp.asarray(_ADAM_B1_POW), idx)
+                b2p = jnp.take(jnp.asarray(_ADAM_B2_POW), idx)
+                m_new = ADAM_B1 * m + (1.0 - ADAM_B1) * gr
+                v_new = ADAM_B2 * v + (1.0 - ADAM_B2) * gr * gr
+                mhat = m_new / (1.0 - b1p)
+                vhat = v_new / (1.0 - b2p)
+                p_new = p - LR * mhat / (jnp.sqrt(vhat) + ADAM_EPS)
+                out[f"v_{n}"] = jnp.where(in_commit, v_new, v)
+            else:
+                m_new = MOMENTUM * m + gr
+                p_new = p - LR * m_new
+            out[n] = jnp.where(in_commit, p_new, p)
+            out[f"m_{n}"] = jnp.where(in_commit, m_new, m)
+
+        return {
+            **state,
+            **out,
+            **{f"gr_{n}": grads[n] for n in _PARAM_NAMES},
+            "loss": loss,
+            "heal": heal,
+            "dev": dev,
+            "it": jnp.where(in_commit, it + 1, it),
+            "phase": jnp.where(phase >= COMMIT, 0, phase + 1),
+        }
+
+    def done(state):
+        return state["it"] >= ITERS
+
+    def check(state):
+        """Bit-exact final-weight compare against the golden weights
+        (uint32 views, so a NaN-poisoned weight still counts)."""
+        err = jnp.int32(0)
+        for n in _PARAM_NAMES:
+            a = jax.lax.bitcast_convert_type(state[n], jnp.uint32)
+            b = jax.lax.bitcast_convert_type(state[f"g_{n}"], jnp.uint32)
+            err = err + jnp.sum(a != b).astype(jnp.int32)
+        return err
+
+    def output(state):
+        return jnp.concatenate([
+            jax.lax.bitcast_convert_type(state[n], jnp.uint32).reshape(-1)
+            for n in _PARAM_NAMES])
+
+    def train_probe(state):
+        """0 = loss trajectory never left tolerance; 1 = deviated but
+        back within tolerance for the final HEAL_WINDOW iterations
+        (self-healed); 2 = still diverged at the end (persistent)."""
+        healed = state["heal"] >= HEAL_WINDOW
+        return jnp.where(state["dev"] == 0, jnp.int32(0),
+                         jnp.where(healed, jnp.int32(1), jnp.int32(2)))
+
+    opt_names = _opt_leaf_names(optimizer)
+    spec = {
+        **{n: LeafSpec(KIND_PARAM) for n in _PARAM_NAMES},
+        **{n: LeafSpec(KIND_OPT_STATE) for n in opt_names},
+        "x": LeafSpec(KIND_RO), "y": LeafSpec(KIND_RO),
+        **{f"g_{n}": LeafSpec(KIND_RO) for n in _PARAM_NAMES},
+        "g_loss": LeafSpec(KIND_RO),
+        **{f"gr_{n}": LeafSpec(KIND_REG) for n in _PARAM_NAMES},
+        "loss": LeafSpec(KIND_REG),
+        "it": LeafSpec(KIND_CTRL),
+        "phase": LeafSpec(KIND_CTRL),
+        "heal": LeafSpec(KIND_CTRL),
+        "dev": LeafSpec(KIND_CTRL),
+    }
+
+    # Selective votes: gate every param/opt-state commit vote to the
+    # optimizer phase -- one whole-leaf vote per training iteration at
+    # the weight-update commit, zero voter work in the fwd/bwd phases.
+    def _commit_hint(shape):
+        starts = (0,) * len(shape)
+        def hint(view, t, _starts=starts, _sizes=tuple(shape)):
+            return _starts, _sizes, view["phase"] == COMMIT
+        return hint
+
+    store_slice = {n: _commit_hint(_PARAM_SHAPES[n]) for n in _PARAM_NAMES}
+    store_slice.update({n: _commit_hint(_PARAM_SHAPES[n[2:]])
+                        for n in opt_names})
+
+    shapes = jax.eval_shape(init)
+    total_words = sum(int(np.prod(s.shape)) for s in shapes.values())
+    opt_words = sum(int(np.prod(shapes[n].shape)) for n in opt_names)
+    param_words = sum(int(np.prod(_PARAM_SHAPES[n])) for n in _PARAM_NAMES)
+
+    # Analytic FLOPs per training iteration (MACs x 2): the per-strategy
+    # overhead column of the MWTF report.  Every micro-step computes all
+    # three phases behind jnp.where selects, but that wash is
+    # strategy-independent and cancels in the overhead ratio.
+    fwd_flops = 2.0 * B * (IN * HID + HID * OUT)
+    bwd_flops = 2.0 * fwd_flops
+    update_flops = float((5 if adam else 3) * param_words)
+
+    name = "train_mlp" if optimizer == "sgd" else "train_mlp_adam"
+    return Region(
+        name=name,
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=PHASES * ITERS,
+        max_steps=2 * PHASES * ITERS,
+        spec=spec,
+        default_xmr=True,
+        functions={"grad_step": _grad_step},
+        train_probe=train_probe,
+        meta={
+            "oracle": "Number of errors: 0",
+            "store_slice": store_slice,
+            "state_bytes": 4 * total_words,
+            "opt_state_bytes": 4 * opt_words,
+            "param_bytes": 4 * param_words,
+            "train": {
+                "optimizer": optimizer,
+                "iters": ITERS,
+                "phases": PHASES,
+                "heal_window": HEAL_WINDOW,
+                "tol_rel": TOL_REL,
+                "tol_abs": TOL_ABS,
+                "selective_skip": ("grad_step",),
+                "flops": {"fwd": fwd_flops, "bwd": bwd_flops,
+                          "update": update_flops},
+                "golden_final_loss": (float(golden["losses"][-1])
+                                      if golden else None),
+                "golden_first_loss": (float(golden["losses"][0])
+                                      if golden else None),
+            },
+        },
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _golden_trajectory(optimizer: str):
+    """Fault-free training trajectory: per-iteration losses + final
+    params -- the FuzzyFlow differential baseline.  Cached per
+    optimizer: every make_train_region() call shares one compile.
+
+    The final params are captured through the ENGINE's own compiled
+    fault-free run of the proto region (``unprotected(proto).run``):
+    the bit-exact ``check()`` pin only holds if the golden weights come
+    out of the same XLA program shape the campaigns execute -- a plain
+    ``lax.scan`` over ``bound_step`` fuses Adam's rsqrt/divide chain
+    differently and drifts by an ulp (SGD's multiply-add chain happens
+    to agree; Adam's does not).  The per-iteration LOSS trajectory still
+    comes from the scan: the monitor compares losses under a relative
+    tolerance, which absorbs last-ulp capture skew.
+
+    Known residual (documented in docs/training.md, pinned in
+    tests/test_train.py): XLA compiles the Adam chain's float rounding
+    context-dependently, and on XLA:CPU the 2-lane DWC build of
+    ``train_mlp_adam`` lands ulps away from every other build (1-lane
+    capture, 3-lane TMR/selective all agree; fori/per-step compiles of
+    the DWC step itself also agree -- only its early-exit while-body
+    differs).  No graph-level construction pins it (optimization
+    barriers around the step, the commit chain, and grad_step, and
+    fixed-order explicit contractions were all tried; the decision is
+    made below the jaxpr, in instruction selection).  The taxonomy
+    absorbs it honestly: a clean DWC-adam run classifies
+    TRAIN_SELF_HEAL (ulp-different weights, converged loss), never
+    train_sdc/DUE, and DWC's detection latch is unaffected."""
+    proto = _build(optimizer, None)
+    step = proto.bound_step()
+
+    def body(carry, t):
+        state, halted = carry
+        new = step(state, t)
+        new = jax.tree.map(lambda o, n: jnp.where(halted, o, n), state, new)
+        halted = jnp.logical_or(halted, proto.done(new))
+        return (new, halted), new["loss"]
+
+    def run(state):
+        (final, _), losses = jax.lax.scan(
+            body, (state, jnp.bool_(False)),
+            jnp.arange(proto.nominal_steps, dtype=jnp.int32))
+        return losses
+
+    losses = np.asarray(jax.jit(run)(proto.init()))
+
+    from coast_tpu.ops.bitflip import noop_fault
+    from coast_tpu.passes.strategies import unprotected
+    rec = unprotected(proto).run(noop_fault(), return_state=True)
+    if not bool(rec["done"]):
+        raise AssertionError(
+            f"golden {optimizer} proto run did not halt in "
+            f"{proto.nominal_steps} steps")
+    final = rec["final_state"]
+    # The loss leaf is written at each iteration's fwd micro-step
+    # (t = PHASES*k) and held through the commit: that value IS the
+    # golden loss of iteration k.
+    per_iter = losses[::PHASES][:ITERS].copy()
+    if not per_iter[-1] < per_iter[0]:
+        raise AssertionError(
+            f"golden {optimizer} training did not reduce the loss "
+            f"({per_iter[0]} -> {per_iter[-1]}); the self-heal semantics "
+            "need a converging trajectory")
+    return {
+        "params": {n: np.asarray(final[n]) for n in _PARAM_NAMES},
+        "losses": per_iter,
+    }
+
+
+def make_train_region(optimizer: str = "sgd") -> Region:
+    """The registered builder: ``train_mlp`` (SGD+momentum) /
+    ``train_mlp_adam``."""
+    return _build(optimizer, _golden_trajectory(optimizer))
+
+
+def make_region() -> Region:
+    return make_train_region("sgd")
+
+
+def make_region_adam() -> Region:
+    return make_train_region("adam")
+
+
+def selective_xmr(region: Region, **overrides):
+    """Selective xMR: TMR over the persistent training state with the
+    backward dataflow computed once.
+
+    3 replica lanes carry the parameters and optimizer state; the
+    ``grad_step`` sub-function is ``-skipLibCalls``-scoped (single call
+    on lane 0's arguments -- the linted, allowlisted SPOF), and the
+    region's store_slice hints already gate the param/opt-state votes to
+    the update commit.  Coverage intuition: every fault site in the
+    weights or moments (the dominant share of the injectable bits) is
+    repaired at the next commit vote exactly as under full TMR; what is
+    given up is redundancy over one transient gradient computation,
+    whose corruption the training dynamics usually absorb (the
+    self-heal class).  FLOPs: ~1 backward instead of 3
+    (:func:`flops_overhead`)."""
+    from coast_tpu.passes.strategies import TMR
+    skip = tuple(region.meta["train"]["selective_skip"])
+    return TMR(region, skip_lib_calls=skip, **overrides)
+
+
+def flops_overhead(region: Region, num_clones: int,
+                   selective: bool = False) -> float:
+    """Per-training-iteration FLOPs of a strategy relative to the
+    unprotected step: lanes x (fwd + update) plus bwd computed either
+    per lane (full replication) or once (selective xMR's single-lane
+    ``grad_step``)."""
+    f = region.meta["train"]["flops"]
+    base = f["fwd"] + f["bwd"] + f["update"]
+    lanes = max(1, int(num_clones))
+    bwd_lanes = 1 if selective else lanes
+    return (lanes * (f["fwd"] + f["update"]) + bwd_lanes * f["bwd"]) / base

@@ -57,6 +57,21 @@ def wilson(k: int, n: int, z: float = 1.96):
             round(min(1.0, centre + half), 6))
 
 
+def region_state_bytes(region):
+    """Per-lane persistent state footprint derived from the region's own
+    ``init`` shapes -- the ground truth ``meta["state_bytes"]`` must not
+    understate.  Optimizer-state leaves (``KIND_OPT_STATE``: momentum
+    buffers, Adam first/second moments) ride in the same state pytree,
+    so train targets are sized by their full persistent state (params +
+    moments + golden leaves) automatically: ``train_mlp_adam`` rows cost
+    more than ``train_mlp`` rows exactly because the extra ``v_*``
+    moments are real HBM."""
+    import jax
+    shapes = jax.eval_shape(region.init)
+    return int(sum(int(math.prod(s.shape)) * s.dtype.itemsize
+                   for s in jax.tree.leaves(shapes)))
+
+
 def analytic_batch(region, lanes, device=None, util=0.5, sites=1):
     """HBM-arithmetic batch sizing: rows = util x bytes_limit / bytes_per_row.
 
@@ -79,11 +94,29 @@ def analytic_batch(region, lanes, device=None, util=0.5, sites=1):
         stats = {}
     limit = stats.get("bytes_limit")
     sites = max(1, int(sites))
-    per_row = region.meta["state_bytes"] * lanes * (1 + sites)
+    # Size by the LARGER of the declared meta["state_bytes"] and the
+    # footprint derived from the region's init shapes: a meta that
+    # forgot a state class (the optimizer moments are the easy one to
+    # drop -- Adam doubles them) must not under-size the batch and OOM
+    # past the estimate.
+    declared = int(region.meta.get("state_bytes") or 0)
+    derived = region_state_bytes(region)
+    state_bytes = max(declared, derived)
+    per_row = state_bytes * lanes * (1 + sites)
     info = {"bytes_limit": limit, "bytes_per_row": per_row,
+            "state_bytes": state_bytes,
             "utilization": util, "fault_sites": sites,
             "model": "state_bytes x lanes x (1 + sites) "
                      "(replicas + per-site flip masks)"}
+    if declared and declared < derived:
+        info["state_bytes_note"] = (
+            f"meta understates the init footprint "
+            f"({declared} < {derived}); sized by the derived bytes")
+    opt_bytes = region.meta.get("opt_state_bytes")
+    if opt_bytes:
+        # Train targets: record the optimizer-state share explicitly so
+        # the artifact shows the moments were counted.
+        info["opt_state_bytes"] = int(opt_bytes)
     if not limit:
         info["note"] = "backend exposes no memory_stats; probe sizing"
         return None, info

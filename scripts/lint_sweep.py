@@ -32,9 +32,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Small, quick-to-compile subset for tier-1 / --fast runs: covers mem
-# (matrixMultiply), reg/ctrl (crc16), function scopes (nestedCalls), and
-# a control-heavy region (towersOfHanoi).
-FAST_SUBSET = ("matrixMultiply", "crc16", "nestedCalls", "towersOfHanoi")
+# (matrixMultiply), reg/ctrl (crc16), function scopes (nestedCalls), a
+# control-heavy region (towersOfHanoi), and the training region's
+# param/opt_state leaf kinds + phase-gated commit votes (train_mlp).
+FAST_SUBSET = ("matrixMultiply", "crc16", "nestedCalls", "towersOfHanoi",
+               "train_mlp")
 
 
 def main(argv=None) -> int:

@@ -130,11 +130,11 @@ def model_sweep(args) -> int:
             runner = CampaignRunner(prog, strategy_name=strat,
                                     fault_model=model)
             res = runner.run(args.n, seed=args.seed, batch_size=args.batch)
-            unc = (res.counts["sdc"] + res.due) / res.n
+            unc = (res.sdc_total + res.due) / res.n
             cell = {
                 "counts": {k: v for k, v in res.counts.items()},
                 "rates": {
-                    "sdc": round(res.counts["sdc"] / res.n, 6),
+                    "sdc": round(res.sdc_total / res.n, 6),
                     "due": round(res.due / res.n, 6),
                     "corrected": round(res.counts["corrected"] / res.n, 6),
                     "uncorrected": round(unc, 6),
@@ -228,6 +228,7 @@ def main(argv=None) -> int:
 
     from coast_tpu import DWC, TMR, unprotected
     from coast_tpu.analysis.json_parser import Summary, compare_runs
+    from coast_tpu.inject import classify as cls
     from coast_tpu.inject.campaign import CampaignRunner
     from coast_tpu.models import REGISTRY
 
@@ -238,6 +239,21 @@ def main(argv=None) -> int:
         region = REGISTRY[name]()
         progs = {"unprotected": unprotected(region),
                  "DWC": DWC(region), "TMR": TMR(region)}
+        # Training rows (coast_tpu.train) add the selective-xMR strategy
+        # and an analytic per-iteration FLOPs-overhead column next to the
+        # measured runtime ratio: overhead is the cost axis the
+        # "selective protection of the update" claim is judged on.
+        train = region.train_probe is not None
+        flops_cols = {}
+        if train:
+            from coast_tpu.train import flops_overhead, selective_xmr
+            progs["selective-xMR"] = selective_xmr(region)
+            flops_cols = {
+                "unprotected": flops_overhead(region, 1),
+                "DWC": flops_overhead(region, 2),
+                "TMR": flops_overhead(region, 3),
+                "selective-xMR": flops_overhead(region, 3, selective=True),
+            }
         summaries, runtimes, stage_blocks = {}, {}, {}
         for strat, prog in progs.items():
             runtimes[strat] = _runtime_s(prog)
@@ -253,7 +269,7 @@ def main(argv=None) -> int:
             # reference's StatisticsError crash) lives in one place:
             # json_parser.mean_steps_or_nan.
             from coast_tpu.analysis.json_parser import mean_steps_or_nan
-            completed = res.codes <= 2
+            completed = cls.completed_mask(res.codes)
             mean_steps = mean_steps_or_nan(
                 float(res.steps[completed].sum()), int(completed.sum()),
                 res.n, f"{name}-{strat}")
@@ -282,6 +298,9 @@ def main(argv=None) -> int:
                                    for s in runtimes},
                "stages": stage_blocks,
                "injections_per_sec": {}}
+        if flops_cols:
+            row["flops_overhead"] = {s: round(v, 4)
+                                     for s, v in flops_cols.items()}
         def _j(v):
             # Strict-JSON-safe: infinities (zero protected SDCs) as
             # "inf", undefined ratios (no completed runs) as "nan".
@@ -292,7 +311,7 @@ def main(argv=None) -> int:
                 return round(v, 4) if math.isfinite(v) else "inf"
             return v
 
-        for strat in ("DWC", "TMR"):
+        for strat in [s for s in progs if s != "unprotected"]:
             cmp_ = compare_runs(summaries["unprotected"], summaries[strat])
             row[f"vs_unprotected_{strat}"] = {k: _j(v)
                                               for k, v in cmp_.items()}

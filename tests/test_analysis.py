@@ -51,7 +51,9 @@ def test_summarize_matches_counts(log_files):
         s = jp.summarize_path(path)
         assert s.n == N
         for c in jp._CLASSES:
-            assert s.counts[c] == res.counts[c]
+            # Non-train campaigns omit the train keys (the byte-parity
+            # rule); the parser's Summary still carries them as zeros.
+            assert s.counts[c] == res.counts.get(c, 0)
         assert s.due == res.counts["due_abort"] + res.counts["due_timeout"]
         assert s.seconds_per_injection() > 0
 

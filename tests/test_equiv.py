@@ -495,3 +495,98 @@ def test_supervisor_equiv_flag_gates():
         supervisor.parse_command_line(
             ["-f", "matrixMultiply", "--delta-from", "x.journal",
              "--journal", "y.journal", "-t", "8"])
+
+
+# ---------------------------------------------------------------------------
+# training regions: typed exhaustive fallback (no silent wrong weights)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def train_tmr():
+    from coast_tpu.train.mlp import make_train_region
+    return TMR(make_train_region("sgd"))
+
+
+def test_train_counterexample_outcome_is_bit_value_dependent():
+    """The empirical counterexample that forces the fallback (pinned like
+    mm's phase and crc16's crc): flips into the SAME (leaf, lane, word,
+    t) of a weight land in DIFFERENT outcome classes by BIT -- a
+    low-mantissa flip of w1[0] perturbs the loss within tolerance
+    (train_probe 0/1, the self-heal class) where the exponent bit of the
+    same word diverges persistently (train_probe 2, train_sdc).  The ltw
+    argument ("masked-vs-detected is a deterministic fn of (t, word)")
+    is therefore unsound on training regions: no merge mode may drop the
+    bit coordinate."""
+    import jax.numpy as jnp
+
+    from coast_tpu.inject.mem import MemoryMap
+    from coast_tpu.passes.strategies import unprotected
+    from coast_tpu.train.mlp import make_train_region
+
+    prog = unprotected(make_train_region("sgd"))
+    w1 = {s.name: s for s in MemoryMap(prog).sections}["w1"]
+
+    def probe_at(bit):
+        rec = prog.run(fault=dict(
+            leaf_id=jnp.int32(w1.leaf_id), lane=jnp.int32(0),
+            word=jnp.int32(0), bit=jnp.int32(bit), t=jnp.int32(4)))
+        assert int(rec["errors"]) > 0       # weights differ either way
+        return int(rec["train_probe"])
+
+    assert probe_at(1) < 2                  # mantissa flip self-heals
+    assert probe_at(30) == 2                # exponent flip persists
+
+
+def test_train_partition_typed_fallback(train_tmr):
+    """analyze_equivalence on a train region refuses to derive merge
+    modes: the typed, documented fallback_reason is set, every section
+    is exhaustive, and the verdict rides into summary() (and from there
+    the journal's equiv header block)."""
+    from coast_tpu.analysis.equiv import TRAIN_FALLBACK
+
+    part = analyze_equivalence(train_tmr)
+    assert part.fallback_reason == TRAIN_FALLBACK
+    assert all(sig.mode == MODE_EXH for sig in part.signatures.values())
+    assert part.summary()["fallback_reason"] == TRAIN_FALLBACK
+    # Non-train partitions keep the absent-means-none rule.
+    mm_part = analyze_equivalence(TMR(mm.make_region()))
+    assert mm_part.fallback_reason is None
+    assert "fallback_reason" not in mm_part.summary()
+
+
+def test_train_written_set_comes_from_analyze(train_tmr):
+    """The PR 7 soundness rule, re-pinned on the multi-phase region: the
+    written-set feeding the signatures comes from the region's
+    analyze() dataflow, so the params AND the optimizer moments (written
+    only in the commit phase, behind jnp.where selects) are written,
+    while the training data and golden leaves are not."""
+    from coast_tpu.passes.verification import analyze
+
+    part = analyze_equivalence(train_tmr)
+    flow = analyze(train_tmr.region)
+    for name in ("w1", "b1", "w2", "b2", "m_w1", "m_b2"):
+        assert name in flow.written
+        assert part.signatures[name].written
+    for name in ("x", "y", "g_w1", "g_loss"):
+        assert name not in flow.written
+        assert not part.signatures[name].written
+
+
+def test_train_dead_class_still_merges(train_tmr):
+    """The one merge that stays sound under any outcome semantics: sites
+    at or past the fault-free halt step never fire.  Everything live
+    keeps its full site identity (exhaustive)."""
+    part = analyze_equivalence(train_tmr)
+    n = 8
+    sched = FaultSchedule(
+        np.zeros(n, np.int32), np.arange(n, dtype=np.int32) % 3,
+        np.arange(n, dtype=np.int32), np.arange(n, dtype=np.int32),
+        np.concatenate([np.full(n // 2, part.clean_steps + 3, np.int32),
+                        np.arange(n // 2, dtype=np.int32)]),
+        np.zeros(n, np.int32), seed=0)
+    keys = part.class_keys(sched)
+    assert (keys[:n // 2] == -1).all()      # dead sites: one class
+    live = keys[n // 2:]
+    assert len(np.unique(live, axis=0)) == len(live)   # no live merging
+    red = part.reduce(sched)
+    assert len(red) == n // 2 + 1

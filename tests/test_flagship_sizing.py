@@ -10,7 +10,7 @@ import pytest
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
 
-from flagship_campaign import analytic_batch  # noqa: E402
+from flagship_campaign import analytic_batch, region_state_bytes  # noqa: E402
 
 from coast_tpu.models import REGISTRY  # noqa: E402
 
@@ -70,3 +70,41 @@ def test_multi_site_models_shrink_the_batch(region):
     assert info4["fault_sites"] == 4
     assert b4 < b1
     assert b4 * info4["bytes_per_row"] <= 16 * 2**30
+
+
+def test_train_rows_count_optimizer_state():
+    """Train targets carry optimizer-state leaves (KIND_OPT_STATE) in the
+    same state pytree: the momentum buffers and Adam moments are real
+    HBM per replica lane, so an Adam row must cost more than the SGD row
+    of the same model and the artifact must record the moments' share."""
+    sgd = REGISTRY["train_mlp"]()
+    adam = REGISTRY["train_mlp_adam"]()
+    _, i_sgd = analytic_batch(sgd, lanes=3, device=_Dev(16 * 2**30))
+    _, i_adam = analytic_batch(adam, lanes=3, device=_Dev(16 * 2**30))
+    assert i_sgd["opt_state_bytes"] > 0            # momentum buffers
+    assert i_adam["opt_state_bytes"] == 2 * i_sgd["opt_state_bytes"]
+    assert i_adam["bytes_per_row"] > i_sgd["bytes_per_row"]
+    # Declared meta already includes the moments (derived == declared).
+    assert i_sgd["bytes_per_row"] == 2 * 3 * sgd.meta["state_bytes"]
+    assert region_state_bytes(adam) == adam.meta["state_bytes"]
+
+
+def test_understated_meta_sized_by_derived_bytes():
+    """A region whose meta forgot a state class (the easy miss: Adam's
+    second moments) must be sized by the footprint derived from its init
+    shapes, not the understated declaration -- under-sizing OOMs past
+    the estimate on device."""
+    adam = REGISTRY["train_mlp_adam"]()
+
+    class _Understated:
+        init = staticmethod(adam.init)
+        meta = dict(adam.meta)
+
+    _Understated.meta["state_bytes"] = (
+        adam.meta["state_bytes"] - adam.meta["opt_state_bytes"])
+    b_true, i_true = analytic_batch(adam, lanes=3, device=_Dev(2**24))
+    b_lie, i_lie = analytic_batch(_Understated, lanes=3, device=_Dev(2**24))
+    assert i_lie["bytes_per_row"] == i_true["bytes_per_row"]
+    assert b_lie == b_true
+    assert "understates" in i_lie["state_bytes_note"]
+    assert "state_bytes_note" not in i_true

@@ -184,7 +184,9 @@ def test_campaign_log_roundtrip_all_writers(campaign):
         s = jp.summarize_path(str(d / fname))
         assert s.n == res.n, fname
         for c in jp._CLASSES:
-            assert s.counts[c] == res.counts[c], (fname, c)
+            # Non-train campaigns omit the train keys (the byte-parity
+            # rule); the parser's Summary still carries them as zeros.
+            assert s.counts[c] == res.counts.get(c, 0), (fname, c)
         assert s.due == res.due
 
 

@@ -1,0 +1,464 @@
+"""Protected training workload (coast_tpu.train): the silent-training-
+corruption taxonomy, end to end.
+
+* **FuzzyFlow differential pin** -- the protected training step's
+  fault-free trajectory (final weights, bit-for-bit) is identical to the
+  unprotected baseline under every shipped strategy, so every divergence
+  a campaign observes is attributable to the injected fault, never to
+  the replication transform (arXiv:2306.16178's validation idiom).
+* **Outcome semantics** -- seeded flips whose outcome class depends on
+  the bit's numeric weight: a low-mantissa weight flip self-heals
+  (TRAIN_SELF_HEAL) where the same word's exponent bit diverges
+  persistently (TRAIN_SDC); classify precedence keeps DUE/INVALID above
+  both.
+* **Taxonomy plumbing** -- the new classes flow classify -> logs (all
+  three writers + the native encoder/classifier) -> json_parser ->
+  summary text, while every NON-train campaign's counts dict, ndjson
+  bytes (sha-pinned against the pre-train tree), and journal records
+  stay byte-identical to before the train classes existed.
+* **Campaign machinery for free** -- journal resume bit-for-bit,
+  mesh-sharded parity, equiv-reduction refusal-to-merge (typed
+  exhaustive fallback, pinned in test_equiv.py), selective-xMR coverage.
+"""
+
+import hashlib
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR, unprotected
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject import logs
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.ops.bitflip import noop_fault
+from coast_tpu.train import (HEAL_WINDOW, ITERS, PHASES, flops_overhead,
+                             make_train_region, selective_xmr)
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def region():
+    return make_train_region("sgd")
+
+
+@pytest.fixture(scope="module")
+def strategies(region):
+    return {"unprotected": unprotected(region), "DWC": DWC(region),
+            "selective-xMR": selective_xmr(region), "TMR": TMR(region)}
+
+
+@pytest.fixture(scope="module")
+def campaign(region):
+    """One seeded unprotected campaign shared by the taxonomy tests:
+    unprotected because every weight hit survives there, so both train
+    classes are well populated."""
+    runner = CampaignRunner(unprotected(region),
+                            strategy_name="unprotected")
+    res = runner.run(256, seed=11, batch_size=128)
+    return res, runner
+
+
+def _section(prog, name):
+    return {s.name: s for s in MemoryMap(prog).sections}[name]
+
+
+def _fault(prog, name, *, bit, t, lane=0, word=0):
+    s = _section(prog, name)
+    return dict(leaf_id=jnp.int32(s.leaf_id), lane=jnp.int32(lane),
+                word=jnp.int32(word), bit=jnp.int32(bit), t=jnp.int32(t))
+
+
+# ---------------------------------------------------------------------------
+# FuzzyFlow differential pin: fault-free trajectory parity
+# ---------------------------------------------------------------------------
+
+def test_fault_free_trajectory_bit_identical(strategies):
+    """The differential artifact's core claim: the protected step's
+    fault-free final weights are BIT-identical (uint32 views) to the
+    unprotected baseline under DWC, selective xMR, and full TMR -- and
+    all equal the golden weights (errors == 0, probe == 0)."""
+    outs = {}
+    for name, prog in strategies.items():
+        rec = prog.run(noop_fault())
+        assert bool(rec["done"]), name
+        assert int(rec["errors"]) == 0, name
+        assert int(rec["train_probe"]) == 0, name
+        outs[name] = np.asarray(rec["output"])
+    base = outs["unprotected"]
+    for name, out in outs.items():
+        assert np.array_equal(out, base), f"{name} trajectory diverged"
+
+
+def test_adam_variant_fault_free_parity():
+    region = make_train_region("adam")
+    a = np.asarray(unprotected(region).run(noop_fault())["output"])
+    b = np.asarray(TMR(region).run(noop_fault())["output"])
+    s = np.asarray(selective_xmr(region).run(noop_fault())["output"])
+    assert np.array_equal(a, b) and np.array_equal(a, s)
+
+
+def test_adam_dwc_known_fp_divergence_degrades_to_self_heal():
+    """The documented residual (mlp._golden_trajectory, docs/training.md):
+    XLA compiles the Adam chain's rounding context-dependently, and the
+    2-lane DWC while-body may land ulps off the 1-lane golden capture
+    even fault-free.  The invariant that must hold on EVERY backend: a
+    clean DWC-adam run never false-alarms -- no detection latch, loss
+    trajectory clean (probe 0), classified success or, when the ulp
+    drift shows, train_self_heal (which is literally true: bit-different
+    weights, converged loss) -- never train_sdc or a DUE."""
+    region = make_train_region("adam")
+    rec = DWC(region).run(noop_fault())
+    assert bool(rec["done"])
+    assert not bool(rec["dwc_fault"])
+    assert int(rec["train_probe"]) == 0
+    code = int(cls.classify(
+        {k: rec[k] for k in ("errors", "corrected", "steps", "done",
+                             "dwc_fault", "cfc_fault", "stack_fault",
+                             "assert_fault", "train_probe")},
+        int(np.asarray(rec["output"]).size)))
+    assert code in (cls.SUCCESS, cls.TRAIN_SELF_HEAL)
+
+
+def test_golden_trajectory_converges(region):
+    tr = region.meta["train"]
+    assert tr["golden_final_loss"] < tr["golden_first_loss"]
+    assert region.nominal_steps == ITERS * PHASES
+
+
+# ---------------------------------------------------------------------------
+# outcome semantics: self-heal vs persistent SDC, seeded
+# ---------------------------------------------------------------------------
+
+def test_seeded_mantissa_flip_self_heals(region):
+    """Low-mantissa weight flip early in training: the weights end
+    bit-different from golden (an SDC by the old taxonomy) but the loss
+    trajectory re-converges within tolerance -- TRAIN_SELF_HEAL."""
+    prog = unprotected(region)
+    rec = prog.run(fault=_fault(prog, "w1", bit=1, t=4))
+    assert int(rec["errors"]) > 0
+    assert int(rec["train_probe"]) < 2
+    code = int(cls.classify(
+        {k: rec[k] for k in ("errors", "corrected", "steps", "done",
+                             "dwc_fault", "cfc_fault", "stack_fault",
+                             "assert_fault", "train_probe")},
+        int(np.asarray(rec["output"]).size)))
+    assert code == cls.TRAIN_SELF_HEAL
+
+
+def test_seeded_exponent_flip_persists(region):
+    """Exponent bit of the same word at the same step: the loss blows
+    past tolerance and never returns -- TRAIN_SDC."""
+    prog = unprotected(region)
+    rec = prog.run(fault=_fault(prog, "w1", bit=30, t=4))
+    assert int(rec["errors"]) > 0
+    assert int(rec["train_probe"]) == 2
+
+
+def test_tmr_repairs_both_seeds(region):
+    """Under full TMR the same two flips are voted away at the next
+    commit: corrected, not SDC of either flavour."""
+    prog = TMR(region)
+    for bit in (1, 30):
+        rec = prog.run(fault=_fault(prog, "w1", bit=bit, t=4))
+        assert int(rec["errors"]) == 0, bit
+        assert int(rec["train_probe"]) == 0, bit
+        assert int(rec["corrected"]) > 0, bit
+
+
+def test_selective_xmr_repairs_param_and_opt_state_hits(region):
+    """The selective transform's coverage claim, seeded: an exponent
+    flip in a weight at the commit phase, and in a momentum buffer at
+    ANY phase, is repaired at the next commit vote exactly as under full
+    TMR (the momentum only ever feeds the voted commit, so its replica
+    can never leak through the single-lane gradient)."""
+    prog = selective_xmr(region)
+    for leaf, t in (("w1", 5), ("m_w2", 3), ("m_w2", 4), ("m_w2", 5)):
+        rec = prog.run(fault=_fault(prog, leaf, bit=30, t=t))
+        assert int(rec["errors"]) == 0, (leaf, t)
+        assert int(rec["corrected"]) > 0, (leaf, t)
+
+
+def test_selective_xmr_transient_gradient_exposure(region):
+    """What selective xMR gives up, seeded: a weight flip in the
+    fwd/bwd window feeds the SINGLE grad_step before the commit vote
+    repairs the replica, so one corrupted update lands on all lanes.
+    An exponent bit there diverges the trajectory (the residual
+    train_sdc the campaign artifact measures); the low-mantissa
+    equivalent perturbs the gradient below f32 rounding and washes out
+    entirely."""
+    prog = selective_xmr(region)
+    rec = prog.run(fault=_fault(prog, "w1", bit=30, t=4))
+    assert int(rec["errors"]) > 0
+    assert int(rec["train_probe"]) == 2
+    rec2 = prog.run(fault=_fault(prog, "w1", bit=1, t=4))
+    assert int(rec2["errors"]) == 0
+    assert int(rec2["corrected"]) > 0
+
+
+def test_classify_precedence_due_over_train(region):
+    """A hung or aborted training step is a DUE, not a train SDC: the
+    probe only refines the SDC bucket of COMPLETED runs."""
+    base = {"errors": jnp.int32(3), "corrected": jnp.int32(0),
+            "steps": jnp.int32(5), "done": jnp.bool_(True),
+            "dwc_fault": jnp.bool_(False), "cfc_fault": jnp.bool_(False),
+            "stack_fault": jnp.bool_(False),
+            "assert_fault": jnp.bool_(False),
+            "train_probe": jnp.int32(2)}
+    assert int(cls.classify(base, 100)) == cls.TRAIN_SDC
+    assert int(cls.classify({**base, "train_probe": jnp.int32(1)},
+                            100)) == cls.TRAIN_SELF_HEAL
+    assert int(cls.classify({**base, "done": jnp.bool_(False)},
+                            100)) == cls.DUE_TIMEOUT
+    assert int(cls.classify({**base, "dwc_fault": jnp.bool_(True)},
+                            100)) == cls.DUE_ABORT
+    assert int(cls.classify({**base, "errors": jnp.int32(-1)},
+                            100)) == cls.INVALID
+    # Without the probe key the pre-train taxonomy is untouched.
+    no_probe = {k: v for k, v in base.items() if k != "train_probe"}
+    assert int(cls.classify(no_probe, 100)) == cls.SDC
+
+
+def test_campaign_populates_both_buckets(campaign):
+    """The acceptance bar, as a seeded regression: an unprotected train
+    campaign records self-heals AND persistent SDCs, with the raw 'sdc'
+    class fully refined away (every completed weight divergence gets a
+    verdict)."""
+    res, _ = campaign
+    assert res.counts["train_self_heal"] > 0
+    assert res.counts["train_sdc"] > 0
+    assert res.counts["sdc"] == 0
+    assert res.counts["success"] > 0
+    assert res.sdc_total == res.counts["train_sdc"]
+
+
+def test_selective_xmr_recovers_most_of_tmr_coverage(region, campaign):
+    """The artifact's headline, pinned directionally: selective xMR's
+    persistent-SDC count sits well under the unprotected one (most of
+    full TMR's coverage) at a fraction of full replication's FLOPs."""
+    unprot, _ = campaign
+    res = CampaignRunner(selective_xmr(region),
+                         strategy_name="selective-xMR").run(
+        256, seed=11, batch_size=128)
+    assert res.counts["corrected"] > 0          # commit votes repairing
+    assert res.counts["train_sdc"] * 2 < unprot.counts["train_sdc"]
+    assert flops_overhead(region, 3, selective=True) \
+        < 0.7 * flops_overhead(region, 3)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy plumbing: logs -> parser -> summary
+# ---------------------------------------------------------------------------
+
+def test_log_roundtrip_all_writers(campaign, tmp_path):
+    from coast_tpu.analysis import json_parser as jp
+    res, runner = campaign
+    logs.write_json(res, runner.mmap, str(tmp_path / "a.json"))
+    logs.write_ndjson(res, runner.mmap, str(tmp_path / "b.ndjson.json"))
+    logs.write_columnar(res, runner.mmap, str(tmp_path / "c.json"))
+    for fname in ("a.json", "b.ndjson.json", "c.json"):
+        s = jp.summarize_path(str(tmp_path / fname))
+        assert s.n == res.n, fname
+        for c in jp._CLASSES:
+            assert s.counts[c] == res.counts.get(c, 0), (fname, c)
+        # Persistent train SDCs are errors; self-heals are not.
+        assert s.error_rate == res.counts["train_sdc"] / res.n
+
+
+def test_classify_run_roundtrip_train_classes(campaign, tmp_path):
+    from coast_tpu.analysis import json_parser as jp
+    res, runner = campaign
+    path = str(tmp_path / "roundtrip.json")
+    logs.write_json(res, runner.mmap, path)
+    doc = jp.read_json_file(path)
+    seen = set()
+    for i, run in enumerate(doc["runs"]):
+        got = jp.classify_run(run)
+        assert got == cls.CLASS_NAMES[int(res.codes[i])]
+        seen.add(got)
+    assert {"train_self_heal", "train_sdc"} <= seen
+
+
+def test_native_python_ndjson_parity(campaign, tmp_path):
+    """Native classifier (ABI 3) and the Python parser agree on a log
+    containing the train classes -- including the mean-runtime
+    statistic, which both refinements feed (completed runs)."""
+    from coast_tpu import native
+    from coast_tpu.analysis import json_parser as jp
+    res, runner = campaign
+    path = str(tmp_path / "native.ndjson.json")
+    logs.write_ndjson(res, runner.mmap, path)
+    fast = jp._summarize_ndjson_native(path)
+    if not native.native_available() or fast is None:
+        pytest.skip("native core not built")
+    slow = jp.summarize_runs("x", [jp.read_json_file(path)])
+    assert fast.counts == slow.counts
+    assert fast.mean_steps == slow.mean_steps
+
+
+def test_summary_prints_training_block(campaign, tmp_path):
+    from coast_tpu.analysis import json_parser as jp
+    res, runner = campaign
+    path = str(tmp_path / "fmt.json")
+    logs.write_columnar(res, runner.mmap, path)
+    text = jp.summarize_path(path).format()
+    assert "silent training corruption" in text
+    for label, key in (("self-healed", "train_self_heal"),
+                       ("persistent SDC", "train_sdc")):
+        line = next(l for l in text.splitlines() if label in l)
+        assert int(line.split("(")[0].split()[-1]) == res.counts[key]
+
+
+def test_non_train_summary_text_unchanged(tmp_path):
+    """mm's summary never mentions the training block."""
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.models import mm
+    runner = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR")
+    res = runner.run(96, seed=5, batch_size=48)
+    path = str(tmp_path / "mm.json")
+    logs.write_columnar(res, runner.mmap, path)
+    text = jp.summarize_path(path).format()
+    assert "training" not in text
+    assert "train_self_heal" not in text
+
+
+# ---------------------------------------------------------------------------
+# non-train byte parity: pinned against the pre-train tree
+# ---------------------------------------------------------------------------
+
+#: sha256 of the ndjson ROW bytes (everything after the volatile summary
+#: head line) of the seeded campaigns below, computed on the pre-train
+#: tree (commit 6468d04, n=96 seed=5 batch=48, fixed timestamp).  The
+#: train taxonomy must not move a single byte of a non-train log.
+_PRE_TRAIN_NDJSON_SHA = {
+    "mm": "e554a14083c2eaf1bb3665b7272ccb6144ed04f441c828fe873e0da00b9ad42a",
+    "crc16":
+        "c9f16e5b2adb398ba3ffb00f238341291b757969723e5bf3dd97f5eecd2114c8",
+}
+
+
+@pytest.mark.parametrize("name", ["mm", "crc16"])
+def test_non_train_ndjson_bytes_pinned(name, tmp_path, monkeypatch):
+    from coast_tpu.models import crc16, mm
+    region = {"mm": mm, "crc16": crc16}[name].make_region()
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    runner = CampaignRunner(TMR(region), strategy_name="TMR")
+    res = runner.run(96, seed=5, batch_size=48)
+    path = str(tmp_path / "pin.ndjson.json")
+    logs.write_ndjson(res, runner.mmap, path)
+    _head, _, rows = open(path, "rb").read().partition(b"\n")
+    assert hashlib.sha256(rows).hexdigest() == _PRE_TRAIN_NDJSON_SHA[name]
+    # The counts dict carries exactly the pre-train key set (+ the
+    # cache_invalid pseudo-bucket).
+    assert set(res.counts) == set(cls.BASE_CLASS_NAMES) | {"cache_invalid"}
+
+
+def test_counts_dict_key_rules():
+    """train=False emits the pre-train key set (a nonzero train count is
+    still surfaced -- hiding it would mask a classifier bug); train=True
+    always carries the train keys, zero or not."""
+    binc = np.zeros(cls.NUM_CLASSES, np.int64)
+    binc[cls.SUCCESS] = 3
+    assert list(cls.counts_dict(binc)) == list(cls.BASE_CLASS_NAMES)
+    assert list(cls.counts_dict(binc, train=True)) == list(cls.CLASS_NAMES)
+    binc[cls.TRAIN_SDC] = 1
+    assert cls.counts_dict(binc)["train_sdc"] == 1
+
+
+# ---------------------------------------------------------------------------
+# campaign machinery rides along: journal resume, mesh parity
+# ---------------------------------------------------------------------------
+
+def _crash_after(runner, n_batches):
+    orig = runner._collect
+    state = {"n": 0}
+
+    def bomb(pending):
+        state["n"] += 1
+        if state["n"] > n_batches:
+            raise RuntimeError("simulated crash")
+        return orig(pending)
+    runner._collect = bomb
+
+
+def test_journal_resume_train_campaign_bit_for_bit(region, tmp_path):
+    path = str(tmp_path / "train.journal")
+    full = CampaignRunner(TMR(region), strategy_name="TMR").run(
+        192, seed=3, batch_size=64)
+    crasher = CampaignRunner(TMR(region), strategy_name="TMR")
+    _crash_after(crasher, 2)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        crasher.run(192, seed=3, batch_size=64, journal=path)
+    resumed = CampaignRunner(TMR(region), strategy_name="TMR").run(
+        192, seed=3, batch_size=64, journal=path)
+    assert np.array_equal(resumed.codes, full.codes)
+    assert resumed.counts == full.counts
+    # The journal's cumulative counts speak the train key set.
+    with open(path) as fh:
+        last_batch = [json.loads(l) for l in fh
+                      if '"batch"' in l][-1]
+    assert "train_self_heal" in last_batch["counts"]
+
+
+def test_mesh_sharded_train_parity(region):
+    """The sharded backend classifies a train campaign identically to
+    single-device (the train_probe scalar rides the record pytree
+    through shard_map unchanged)."""
+    from coast_tpu.parallel.mesh import make_mesh
+    single = CampaignRunner(TMR(region), strategy_name="TMR").run(
+        128, seed=7, batch_size=64)
+    sharded = CampaignRunner(TMR(region), strategy_name="TMR",
+                             mesh=make_mesh(4)).run(
+        128, seed=7, batch_size=64)
+    assert np.array_equal(single.codes, sharded.codes)
+    assert sharded.counts == single.counts
+    assert single.counts["train_self_heal"] + single.counts["train_sdc"] > 0
+
+
+def test_registry_and_model_source():
+    """Both train targets resolve through the registry with their
+    builder module as model_source (campaign logs record a real path)."""
+    from coast_tpu.models import REGISTRY, model_source
+    for name in ("train_mlp", "train_mlp_adam"):
+        region = REGISTRY[name]()
+        assert region.name == name
+        assert model_source(name).endswith("coast_tpu/train/mlp.py")
+    assert REGISTRY["train_mlp_adam"]().meta["train"]["optimizer"] == "adam"
+
+
+def test_supervisor_train_sections(region):
+    """The CLI section vocabulary reaches the training state: 'memory'
+    overlays params + moments (they are HBM data), and the targeted
+    'params'/'opt_state' sections select exactly those leaf kinds."""
+    from coast_tpu.inject.hierarchy import DCACHE_KINDS
+    from coast_tpu.inject.supervisor import (SECTION_CHOICES,
+                                             section_filter)
+    assert "param" in DCACHE_KINDS and "opt_state" in DCACHE_KINDS
+    assert "params" in SECTION_CHOICES and "opt_state" in SECTION_CHOICES
+    prog = TMR(region)
+    assert section_filter(prog, "params") == ("param",)
+    assert section_filter(prog, "opt_state") == ("opt_state",)
+    mmap = MemoryMap(prog, sections=section_filter(prog, "params"))
+    assert {s.name for s in mmap.sections} == {"w1", "b1", "w2", "b2"}
+    mem = MemoryMap(prog, sections=section_filter(prog, "memory"))
+    assert {"w1", "m_w1", "x", "g_loss"} <= {s.name for s in mem.sections}
+
+
+def test_flops_overhead_table(region):
+    """The MWTF report's overhead column: full replication scales every
+    phase, selective scales fwd+update only (one backward)."""
+    f = region.meta["train"]["flops"]
+    base = f["fwd"] + f["bwd"] + f["update"]
+    assert flops_overhead(region, 1) == pytest.approx(1.0)
+    assert flops_overhead(region, 3) == pytest.approx(3.0)
+    assert flops_overhead(region, 2) == pytest.approx(2.0)
+    expect = (3 * (f["fwd"] + f["update"]) + f["bwd"]) / base
+    assert flops_overhead(region, 3, selective=True) \
+        == pytest.approx(expect)
+    assert 1.0 < flops_overhead(region, 3, selective=True) < 2.0
