@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -110,6 +110,23 @@ fleet_smoke:
 # by a tiny seeded campaign, selective-xMR commit votes repairing.
 train_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.train_smoke
+
+# Protection-regression-CI smoke (also a fast.yml driver row): baseline
+# -> no-op check passes with 0 rows re-injected (and the refreshed
+# artifact checks clean) -> a seeded dropped-commit-vote build fails
+# with a per-class drift verdict.
+ci_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.ci_smoke
+
+# The repo gating itself (ROADMAP item 3's end-game): delta-check the
+# current tree against the committed baseline artifact.  Exit 0 = the
+# protection distributions are unchanged, 1 = drift (a protection
+# regression -- investigate before merging), 2 = infra failure (e.g.
+# the memory map changed: rebuild the baseline with
+# `python -m coast_tpu ci refresh`).
+ci_protection:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu ci check \
+	    --baseline artifacts/ci_baseline.json
 
 clean:
 	$(MAKE) -C coast_tpu/native clean

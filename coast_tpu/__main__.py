@@ -1,0 +1,46 @@
+"""``python -m coast_tpu <verb>``: the package's top-level entry point.
+
+One stable spelling for the user-facing verbs, so operators (and the
+repo's own Makefile) do not need to know the module layout:
+
+    python -m coast_tpu ci ...        # protection-regression CI
+    python -m coast_tpu fleet ...     # campaign fleet (alias)
+    python -m coast_tpu analysis ...  # log analysis (alias)
+    python -m coast_tpu opt ...       # protect + run one program (alias)
+
+``ci`` is the canonical home of the CI subcommand (ROADMAP item 3);
+the others forward to their module CLIs unchanged.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip(), file=sys.stderr)
+        return 0 if argv else 2
+    verb, rest = argv[0], argv[1:]
+    if verb == "ci":
+        from coast_tpu.ci.__main__ import main as ci_main
+        return ci_main(rest)
+    if verb == "fleet":
+        from coast_tpu.fleet.supervisor import main as fleet_main
+        return fleet_main(rest)
+    if verb == "analysis":
+        from coast_tpu.analysis.json_parser import main as an_main
+        return an_main(rest)
+    if verb == "opt":
+        from coast_tpu.opt import main as opt_main
+        return opt_main(rest)
+    print(f"Error, unknown verb {verb!r}; want one of: ci, fleet, "
+          "analysis, opt (see python -m coast_tpu --help)",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,14 +31,17 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from coast_tpu.inject.journal import CampaignJournal, JournalMismatchError
+from coast_tpu.inject.spec import CampaignSpec
 
-#: Header keys that must match between the delta base and the current
-#: campaign for the recorded outcomes to be reusable at all.  The
-#: protection-config fingerprint is deliberately NOT here: the config
-#: (and the program) changing is the whole point of a delta -- the
-#: per-section fingerprints decide what that change invalidated.
-_IDENTITY_KEYS = ("mode", "benchmark", "strategy", "seed", "n",
-                  "start_num", "fault_model")
+#: Header-level keys that must match between the delta base and the
+#: current campaign, beyond the shared spec vocabulary
+#: (:meth:`CampaignSpec.delta_identity` -- benchmark / seed / n /
+#: start_num / fault_model, with the absent-means-default rules decoded
+#: in one place).  The protection-config fingerprint is deliberately in
+#: NEITHER: the config (and the program) changing is the whole point of
+#: a delta -- the per-section fingerprints decide what that change
+#: invalidated.
+_HEADER_IDENTITY_KEYS = ("mode", "strategy")
 
 
 class DeltaMismatchError(JournalMismatchError):
@@ -132,11 +135,13 @@ def plan_delta(base_header: Dict[str, object],
     FaultSchedule; ``base_sites`` the base journal's recorded sites
     (None for non-reduced bases, whose sites are the regenerated
     ``sched`` itself, validated upstream by schedule sha)."""
-    for key in _IDENTITY_KEYS:
-        a, b = base_header.get(key), current_header.get(key)
-        # Absent fault_model == single (the PR 6 journal-evolution rule).
-        if key == "fault_model":
-            a, b = a or "single", b or "single"
+    base_id = {k: base_header.get(k) for k in _HEADER_IDENTITY_KEYS}
+    cur_id = {k: current_header.get(k) for k in _HEADER_IDENTITY_KEYS}
+    base_id.update(CampaignSpec.from_header(base_header).delta_identity())
+    cur_id.update(CampaignSpec.from_header(current_header)
+                  .delta_identity())
+    for key in base_id:
+        a, b = base_id[key], cur_id[key]
         if a != b:
             raise DeltaMismatchError(
                 f"delta base {base_path!r} records {key}={a!r} but this "

@@ -523,7 +523,71 @@ def summarize_path(path: str) -> Summary:
 
 # -- A-vs-B comparison (compareRuns, jsonParser.py:458-506) ------------------
 
-def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
+def class_comparison(base: Summary, new: Summary,
+                     z: float = 1.96) -> Dict[str, object]:
+    """Per-class Wilson-interval comparison of two summaries: the
+    distribution-drift half of :func:`compare_runs` and the verdict
+    kernel of the protection-regression CI (``coast_tpu.ci``).
+
+    Weight-aware by construction: a Summary's counts/n are over
+    EFFECTIVE injections (equivalence-reduced logs multiply class
+    weights out upstream), and the Wilson arithmetic takes the weighted
+    counts as-is -- the same convention as the live convergence tracker.
+
+    Returns ``classes`` ({cls: {base, new, overlap}} interval rows over
+    every class either summary populated), ``new_classes`` /
+    ``vanished_classes`` (outcome classes with a nonzero count on
+    exactly one side -- a protection regression often *creates* a class,
+    e.g. sdc under a weakened TMR, at rates far inside a Wilson interval
+    of zero), and ``distribution_drift`` (any non-overlapping class, or
+    any new/vanished class)."""
+    from coast_tpu.obs.convergence import interval_table, intervals_overlap
+    # One ensure= union keeps every row's denominator consistent: an
+    # absent class is observed-zero out of THAT summary's own trials.
+    names = tuple(sorted(set(base.counts) | set(new.counts)))
+    base_tab = interval_table(base.counts, z, ensure=names)
+    new_tab = interval_table(new.counts, z, ensure=names)
+    classes: Dict[str, object] = {}
+    new_classes: List[str] = []
+    vanished: List[str] = []
+    for cls_name in names:
+        b = base_tab[cls_name]
+        m = new_tab[cls_name]
+        if not b["count"] and m["count"]:
+            new_classes.append(cls_name)
+        if b["count"] and not m["count"]:
+            vanished.append(cls_name)
+        classes[cls_name] = {"base": b, "new": m,
+                             "overlap": intervals_overlap(b, m)}
+    drift = (bool(new_classes) or bool(vanished)
+             or any(not row["overlap"] for row in classes.values()))
+    return {"classes": classes, "new_classes": new_classes,
+            "vanished_classes": vanished, "distribution_drift": drift}
+
+
+def format_drift_lines(cmp: Dict[str, object]) -> List[str]:
+    """Render the drifting classes of a :func:`class_comparison` block,
+    one line per class -- the ONE spelling shared by
+    ``format_comparison`` and the CI's per-target report."""
+    drifting = sorted(
+        set(c for c, row in cmp["classes"].items() if not row["overlap"])
+        | set(cmp["new_classes"]) | set(cmp["vanished_classes"]))
+    out = []
+    for cls_name in drifting:
+        row = cmp["classes"][cls_name]
+        tag = (" (new class)" if cls_name in cmp["new_classes"] else
+               " (vanished class)" if cls_name in cmp["vanished_classes"]
+               else "")
+        out.append(
+            f"{cls_name}: base [{100 * row['base']['lo']:.3f}%,"
+            f" {100 * row['base']['hi']:.3f}%]  vs  "
+            f"[{100 * row['new']['lo']:.3f}%,"
+            f" {100 * row['new']['hi']:.3f}%]{tag}")
+    return out
+
+
+def compare_runs(base: Summary, new: Summary,
+                 z: float = 1.96) -> Dict[str, object]:
     """Protection-cost metrics of ``new`` relative to ``base``.
 
     ``mwtf`` is the Mean-Work-To-Failure *ratio* of jsonParser.py:473:
@@ -536,6 +600,12 @@ def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
     voters) lands in wall-clock per injection, so ``runtime_x`` prefers the
     seconds-per-injection ratio and falls back to the step ratio when a
     summary carries no timing.
+
+    Alongside the scalar ratios, the output carries the per-class
+    distribution comparison of :func:`class_comparison` -- Wilson
+    intervals (at quantile ``z``) for every outcome class on both
+    sides, an ``overlap`` verdict per class, and the aggregate
+    ``distribution_drift`` flag the protection-regression CI gates on.
     """
     import math
 
@@ -567,6 +637,7 @@ def compare_runs(base: Summary, new: Summary) -> Dict[str, float]:
         "error_rate_x": error_rate_x,
         "error_improvement_x": improvement,
         "mwtf": mwtf,
+        **class_comparison(base, new, z),
     }
 
 
@@ -579,6 +650,11 @@ def format_comparison(base: Summary, new: Summary) -> str:
     lines.append(f"  error rate x       {cmp['error_rate_x']:.4f}")
     lines.append(f"  error improvement  {cmp['error_improvement_x']:.2f}x")
     lines.append(f"  MWTF               {cmp['mwtf']:.2f}")
+    # Distribution verdict (the CI's drift kernel): only the classes
+    # that disagree are worth a line; agreement is the quiet default.
+    verdict = "DRIFT" if cmp["distribution_drift"] else "consistent"
+    lines.append(f"  distribution       {verdict}")
+    lines.extend(f"    {d}" for d in format_drift_lines(cmp))
     return "\n".join(lines)
 
 

@@ -55,11 +55,17 @@ class CompileCache:
     """Per-worker facade over the three cache layers, rooted at the
     queue's shared ``cache/`` directory."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, program_hook=None):
+        """``program_hook(prog)`` is applied (in place) to every freshly
+        built protected program before any runner/key is derived from
+        it -- the seam the protection-regression CI's tests and smoke
+        driver use to seed a weakened build (e.g. dropping a commit
+        vote) into an otherwise stock worker.  None in production."""
         self.root = str(root)
         os.makedirs(os.path.join(self.root, "keys"), exist_ok=True)
         self.counters: Dict[str, int] = {name: 0 for name in EVENTS}
         self.last_event: Optional[str] = None
+        self.program_hook = program_hook
         self._runners: Dict[str, Tuple[object, str]] = {}
         self._programs: Dict[Tuple[str, str], Tuple[object, str]] = {}
         self.persistent_enabled = self._enable_persistent()
@@ -98,17 +104,22 @@ class CompileCache:
                 for name, size in zip(mesh.axis_names, mesh.devices.shape)}
 
     def key(self, prog, spec: Dict[str, object], mesh=None) -> str:
-        """Cache key = journal config-sha + mesh geometry + the spec
-        fields that change what gets compiled."""
+        """Cache key = journal config-sha + mesh geometry + the
+        :class:`~coast_tpu.inject.spec.CampaignSpec` fields that change
+        what gets compiled.  ``delta_from``/``stop_when`` are
+        deliberately absent: a delta or convergence-bounded item runs
+        the same compiled program as its plain campaign."""
         import jax
         from coast_tpu.inject.journal import config_fingerprint
+        from coast_tpu.inject.spec import CampaignSpec
+        cs = CampaignSpec.from_item(spec)
         doc = {
             "benchmark": prog.region.name,
             "config_sha": config_fingerprint(prog.cfg),
-            "section": spec.get("section", "memory"),
-            "fault_model": spec.get("fault_model", "single"),
-            "equiv": bool(spec.get("equiv", False)),
-            "unroll": int(spec.get("unroll", 1)),
+            "section": cs.section,
+            "fault_model": cs.fault_model,
+            "equiv": cs.equiv,
+            "unroll": cs.unroll,
             "mesh": self._mesh_geometry(mesh),
             "jax": jax.__version__,
             "backend": jax.default_backend(),
@@ -128,8 +139,10 @@ class CompileCache:
         if memo_key not in self._programs:
             from coast_tpu.inject.supervisor import build_program
             try:
-                self._programs[memo_key] = build_program(benchmark,
-                                                         opt_passes)
+                prog, strategy = build_program(benchmark, opt_passes)
+                if self.program_hook is not None:
+                    self.program_hook(prog)
+                self._programs[memo_key] = (prog, strategy)
             except SystemExit as e:
                 # build_program is a CLI helper: it reports to stderr and
                 # exits.  A fleet worker must fail the ITEM, not itself.
@@ -151,8 +164,9 @@ class CompileCache:
         not the cache entry."""
         from coast_tpu import obs
         from coast_tpu.inject.campaign import CampaignRunner
-        prog, strategy = self.program(spec["benchmark"],
-                                      spec.get("opt_passes", "-TMR"))
+        from coast_tpu.inject.spec import CampaignSpec
+        cs = CampaignSpec.from_item(spec)
+        prog, strategy = self.program(cs.benchmark, cs.opt_passes)
         key = self.key(prog, spec, mesh)
         if key in self._runners:
             event = "warm_hit"
@@ -162,21 +176,16 @@ class CompileCache:
                      if os.path.exists(self._key_path(key)) else "miss")
             from coast_tpu.inject.supervisor import section_filter
             try:
-                sections = section_filter(prog, spec.get("section",
-                                                         "memory"))
+                sections = section_filter(prog, cs.section)
             except SystemExit as e:
                 raise RuntimeError(
-                    f"section {spec.get('section')!r} has no injectable "
+                    f"section {cs.section!r} has no injectable "
                     f"leaves in {prog.region.name} (exit {e.code})") from e
-            fault_model = None
-            if spec.get("fault_model", "single") != "single":
-                from coast_tpu.inject.schedule import FaultModel
-                fault_model = FaultModel.parse(spec["fault_model"])
             runner = CampaignRunner(
                 prog, sections=sections, strategy_name=strategy,
-                unroll=int(spec.get("unroll", 1)),
-                fault_model=fault_model,
-                equiv=bool(spec.get("equiv", False)),
+                unroll=cs.unroll,
+                fault_model=cs.fault_model_parsed(),
+                equiv=cs.equiv,
                 mesh=mesh, retry=retry)
             self._runners[key] = (runner, strategy)
         runner.metrics = metrics

@@ -15,11 +15,11 @@ happened or it did not, with no locks, no daemons, and no database:
     <root>/status/<worker>.json   per-worker live status (fleet telemetry)
     <root>/cache/               shared compile cache (fleet.compile_cache)
 
-**Item identity is the journal's identity.**  An item spec carries
-exactly the vocabulary a :class:`~coast_tpu.inject.journal
-.CampaignJournal` header records -- benchmark, opt flags (the
-protection-config source), section, seed/n/start_num, fault-model spec,
-equiv flag, stop-when spec -- so the worker that claims an item can
+**Item identity is the journal's identity.**  An item spec is the
+:class:`~coast_tpu.inject.spec.CampaignSpec` identity vocabulary in its
+queue-item encoding -- benchmark, opt flags (the protection-config
+source), section, seed/n/start_num, fault-model spec, equiv flag,
+stop-when spec -- so the worker that claims an item can
 regenerate the campaign and the journal header validates it, and a
 *different* worker resuming after a SIGKILL regenerates the *same*
 campaign bit-for-bit (the journal refuses anything else).
@@ -47,6 +47,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from coast_tpu.inject.spec import CampaignSpec, SpecError
 from coast_tpu.obs.metrics import atomic_write_json
 
 __all__ = ["QueueError", "LostLeaseError", "QueueItem", "CampaignQueue",
@@ -81,32 +82,35 @@ def item_spec(benchmark: str, n: int, seed: int = 0,
               batch_size: int = 4096, start_num: int = 0,
               fault_model: str = "single", equiv: bool = False,
               stop_when: Optional[str] = None, unroll: int = 1,
-              throttle_s: float = 0.0) -> Dict[str, object]:
-    """One queued campaign, in the journal header's identity vocabulary.
+              throttle_s: float = 0.0,
+              delta_from: Optional[str] = None) -> Dict[str, object]:
+    """One queued campaign, serialized through the shared
+    :class:`~coast_tpu.inject.spec.CampaignSpec` identity vocabulary
+    (``to_item`` is bit-compatible with this function's historical
+    output, so enqueue ids and pre-existing queue directories keep
+    their meaning).
 
     ``throttle_s`` sleeps that long after every collected batch -- an
     operator rate-limit knob (and what makes kill-mid-campaign tests
-    deterministic on a fast CPU backend).  Validation happens here, at
-    enqueue time, so a bad spec fails the *enqueuer*, not a worker an
-    hour later."""
-    if n <= 0:
-        raise QueueError(f"item wants n={n} injections; need > 0")
-    if fault_model != "single":
-        from coast_tpu.inject.schedule import FaultModel
-        FaultModel.parse(fault_model)        # raises ValueError on typos
-        if equiv:
-            raise QueueError("equiv=True needs the single-bit fault model")
-    if stop_when:
-        from coast_tpu.obs.convergence import StopWhen
-        StopWhen.parse(stop_when)            # raises StopWhenError
-    return {
-        "benchmark": str(benchmark), "opt_passes": str(opt_passes),
-        "section": str(section), "n": int(n), "seed": int(seed),
-        "start_num": int(start_num), "batch_size": int(batch_size),
-        "fault_model": str(fault_model), "equiv": bool(equiv),
-        "stop_when": stop_when if stop_when else None,
-        "unroll": int(unroll), "throttle_s": float(throttle_s),
-    }
+    deterministic on a fast CPU backend).  ``delta_from`` makes the item
+    a DELTA campaign: the worker re-injects only the sections whose
+    propagation fingerprint changed since that journal was written and
+    splices the rest (the protection-regression CI's work unit).
+    Validation happens here, at enqueue time, so a bad spec fails the
+    *enqueuer*, not a worker an hour later."""
+    spec = CampaignSpec(
+        benchmark=benchmark, n=n, seed=seed, opt_passes=opt_passes,
+        section=section, batch_size=batch_size, start_num=start_num,
+        fault_model=fault_model, equiv=equiv, stop_when=stop_when,
+        unroll=unroll, throttle_s=throttle_s, delta_from=delta_from)
+    try:
+        spec.validate()
+    except SpecError as e:
+        # Parser-typed errors (FaultModel's ValueError, StopWhenError)
+        # pass through untouched; the spec-level rules keep the queue's
+        # historical QueueError type.
+        raise QueueError(str(e)) from e
+    return spec.to_item()
 
 
 @dataclasses.dataclass

@@ -184,6 +184,14 @@ def parse_command_line(argv: Optional[List[str]] = None):
     p.add_argument("--fault-model", default="single")
     p.add_argument("--equiv", action="store_true")
     p.add_argument("--stop-when", default=None)
+    p.add_argument("--delta-from", default=None, metavar="JOURNAL",
+                   help="make the item a DELTA campaign: re-inject only "
+                   "the sections whose propagation fingerprint changed "
+                   "since JOURNAL (a completed --equiv run of the same "
+                   "campaign), splicing the rest; implies --equiv.  "
+                   "Combined with --stop-when, each re-injected section "
+                   "is convergence-bounded on its own (the CI work "
+                   "unit)")
     p.add_argument("--unroll", type=int, default=1)
     p.add_argument("--throttle", type=float, default=0.0, metavar="S",
                    help="sleep S seconds per collected batch (operator "
@@ -264,6 +272,15 @@ def parse_command_line(argv: Optional[List[str]] = None):
 
 def cmd_enqueue(args) -> int:
     q = CampaignQueue(args.queue)
+    if args.delta_from and args.count > 1:
+        # --count varies the seed per item, and a delta base journal
+        # records ONE seed: items 2..K would deterministically fail
+        # at claim time with DeltaMismatchError.  Refuse the enqueuer.
+        print("Error, --delta-from cannot be combined with --count > 1: "
+              "the delta base journal records one seed, and --count "
+              "enqueues seed-varied copies that can never splice from "
+              "it", file=sys.stderr)
+        return 1
     try:
         specs = [item_spec(args.filename, args.t,
                            seed=args.seed + i,
@@ -272,8 +289,10 @@ def cmd_enqueue(args) -> int:
                            batch_size=args.batch_size,
                            start_num=args.start_num,
                            fault_model=args.fault_model,
-                           equiv=args.equiv, stop_when=args.stop_when,
-                           unroll=args.unroll, throttle_s=args.throttle)
+                           equiv=args.equiv or bool(args.delta_from),
+                           stop_when=args.stop_when,
+                           unroll=args.unroll, throttle_s=args.throttle,
+                           delta_from=args.delta_from)
                  for i in range(max(1, args.count))]
     except (QueueError, ValueError) as e:
         print(f"Error, bad item spec: {e}", file=sys.stderr)

@@ -42,7 +42,7 @@ import numpy as np
 
 from coast_tpu.fleet.compile_cache import CompileCache
 from coast_tpu.fleet.queue import CampaignQueue, LostLeaseError, QueueItem
-from coast_tpu.inject.journal import JournalLockedError
+from coast_tpu.inject.journal import JournalError, JournalLockedError
 from coast_tpu.obs.metrics import CampaignMetrics, atomic_write_json
 
 __all__ = ["Worker", "codes_sha256"]
@@ -196,8 +196,10 @@ class Worker:
             self._write_status("idle")
             return False
 
+        from coast_tpu.inject.spec import CampaignSpec
+        cs = CampaignSpec.from_item(spec)
         state = {"last_renew": time.monotonic(), "marked": False}
-        throttle = float(spec.get("throttle_s", 0.0) or 0.0)
+        throttle = cs.throttle_s
 
         def progress(done: int, counts: Dict[str, int]) -> None:
             # First collected batch proves the compile happened: record
@@ -213,18 +215,36 @@ class Worker:
             if throttle > 0:
                 time.sleep(throttle)
 
-        stop_when = None
-        if spec.get("stop_when"):
-            from coast_tpu.obs.convergence import StopWhen
-            stop_when = StopWhen.parse(spec["stop_when"])
+        stop_when = cs.stop_when_parsed()
         try:
             with runner.telemetry.activate():
-                res = runner.run(
-                    int(spec["n"]), seed=int(spec.get("seed", 0)),
-                    batch_size=int(spec.get("batch_size", 4096)),
-                    start_num=int(spec.get("start_num", 0)),
-                    journal=self.q.journal_path(item.id),
-                    progress=progress, stop_when=stop_when)
+                if cs.delta_from:
+                    # Delta item (the protection-regression CI's work
+                    # unit): re-inject only fingerprint-changed
+                    # sections, splice the rest from the base journal,
+                    # each section convergence-bounded by stop_when.
+                    # The live campaign writes no journal (the spliced
+                    # rows never ran), so the result is materialized as
+                    # one afterwards -- the done record must still have
+                    # a journal to parity-check against, and the CI
+                    # refresh wants it as the next splice base.
+                    res = runner.run_delta(
+                        cs.n, cs.delta_from, seed=cs.seed,
+                        batch_size=cs.batch_size,
+                        start_num=cs.start_num,
+                        progress=progress, stop_when=stop_when)
+                    jpath = self.q.journal_path(item.id)
+                    if os.path.exists(jpath):
+                        os.unlink(jpath)       # a previous attempt's
+                    runner.journal_result(res, jpath, n=cs.n,
+                                          batch_size=cs.batch_size)
+                else:
+                    res = runner.run(
+                        cs.n, seed=cs.seed,
+                        batch_size=cs.batch_size,
+                        start_num=cs.start_num,
+                        journal=self.q.journal_path(item.id),
+                        progress=progress, stop_when=stop_when)
         except JournalLockedError:
             # The previous holder of this item is still alive and
             # appending (our claim came from a wrongly-reaped lease).
@@ -242,6 +262,18 @@ class Worker:
             # resume.  Stop touching it.
             self.items_yielded += 1
             self._current_item = None
+            self._write_status("idle")
+            return False
+        except JournalError as e:
+            # Deterministic journal failure -- a delta item's base does
+            # not describe this campaign (JournalMismatchError), a
+            # corrupt/poisoned journal, or a journal_result parity
+            # failure: every worker would fail the same way, so the
+            # item is terminally failed, not requeued.  (The LOCKED
+            # case is transient and already handled above.)
+            self.items_failed += 1
+            self._current_item = None
+            self.q.fail(item.id, self.worker_id, f"journal: {e}")
             self._write_status("idle")
             return False
         except Exception as e:          # noqa: BLE001
@@ -282,6 +314,8 @@ class Worker:
         }
         if res.physical_n is not None:
             result["physical_injections"] = int(res.physical_n)
+        if res.delta is not None:
+            result["delta"] = dict(res.delta)
         self.q.complete(item.id, self.worker_id, result)
         self.items_done += 1
         self._current_item = None

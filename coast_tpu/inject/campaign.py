@@ -34,6 +34,7 @@ from coast_tpu.inject.journal import (CampaignJournal, JournalMismatchError,
                                       schedule_fingerprint)
 from coast_tpu.inject.mem import MemoryMap
 from coast_tpu.inject.schedule import FaultModel, FaultSchedule, generate
+from coast_tpu.inject.spec import CampaignSpec
 from coast_tpu.passes.dataflow_protection import ProtectedProgram
 
 
@@ -831,6 +832,26 @@ class CampaignRunner:
                                       convergence=res.convergence)
         return res
 
+    def _campaign_spec(self, n: int, seed: int = 0,
+                       batch_size: int = 4096, start_num: int = 0,
+                       stop_when: "Optional[object]" = None
+                       ) -> CampaignSpec:
+        """This campaign's identity as the shared
+        :class:`~coast_tpu.inject.spec.CampaignSpec`.  The runner
+        supplies the program-derived axes (fault model, equivalence)
+        from its own state, so a header serialized from this spec can
+        never disagree with the schedule the runner generates.  The
+        build-vocabulary fields (opt flags, section) stay at their
+        defaults -- the runner knows the *built* program, and the
+        header pins it through config_sha instead."""
+        return CampaignSpec(
+            benchmark=self.prog.region.name, n=int(n), seed=int(seed),
+            batch_size=int(batch_size), start_num=int(start_num),
+            fault_model=self.fault_model.spec(),
+            equiv=self.equiv_partition is not None,
+            stop_when=(stop_when.spec() if stop_when is not None
+                       else None))
+
     def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
         """The identity block every journal header shares: resuming under
         a different program, strategy, protection config, or fault model
@@ -926,12 +947,19 @@ class CampaignRunner:
         part = self._seeded_part(n, seed, start_num)
         j, owned = (None, False)
         if journal is not None:
+            # The header's spec-owned fields serialize through the ONE
+            # identity vocabulary (CampaignSpec), built FROM the
+            # runner's own model/partition so header and schedule can
+            # never disagree.  Key order is run_header_fields' -- the
+            # header's historical byte order, pinned in tests.
+            spec = self._campaign_spec(n, seed=seed, batch_size=batch_size,
+                                       start_num=start_num,
+                                       stop_when=stop_when)
             header = self._journal_header(
-                "run", seed=int(seed), n=int(n), start_num=int(start_num),
-                batch_size=int(batch_size),
+                "run", **spec.run_header_fields(),
                 schedule_sha=schedule_fingerprint(part))
-            if stop_when is not None:
-                header["stop_when"] = stop_when.spec()
+            if spec.stop_when:
+                header["stop_when"] = spec.stop_when
             j, owned = self._open_journal(journal, header)
             if self.equiv_partition is not None and not j.resumed:
                 # Persist the representatives: run_delta splices by site
@@ -953,10 +981,24 @@ class CampaignRunner:
         res.start_num = start_num
         return res
 
+    @staticmethod
+    def _take_rows(part: FaultSchedule, idx: np.ndarray) -> FaultSchedule:
+        """Arbitrary-row subset of a single-site schedule (the delta
+        paths' working shape: equiv-reduced, no flip groups)."""
+        return FaultSchedule(
+            *(np.ascontiguousarray(np.asarray(getattr(part, f))[idx])
+              for f in ("leaf_id", "lane", "word", "bit", "t",
+                        "section_idx")),
+            seed=part.seed, model=part.model,
+            class_weight=(part.class_weight[idx]
+                          if part.class_weight is not None else None),
+            equiv_sha=part.equiv_sha)
+
     def run_delta(self, n: int, delta_from: str, seed: int = 0,
                   batch_size: int = 4096, start_num: int = 0,
                   progress: Optional[
-                      Callable[[int, Dict[str, int]], None]] = None
+                      Callable[[int, Dict[str, int]], None]] = None,
+                  stop_when: "Optional[object]" = None
                   ) -> CampaignResult:
         """Delta campaign: rerun the seeded campaign recorded in the
         journal at ``delta_from``, but physically re-inject ONLY the
@@ -965,6 +1007,19 @@ class CampaignRunner:
         from the journal (its dataflow cone is provably unchanged, so
         the recorded outcome still holds).  A no-op rebuild re-injects
         zero rows; a one-section edit re-injects exactly that section.
+
+        ``stop_when`` (:class:`coast_tpu.obs.convergence.StopWhen`)
+        arms statistical early stop PER RE-INJECTED SECTION: each
+        changed section's rows run as their own convergence-tracked
+        sub-campaign, so one section's quick convergence can neither
+        starve nor extend another's, and the spliced sections -- whose
+        outcomes are exact journal records, not samples -- never enter
+        any tracker's histogram (they keep their recorded counts
+        verbatim).  Rows a section's early stop dropped are excluded
+        from the result (codes/weights/counts all describe exactly the
+        spliced + collected rows); ``CampaignResult.convergence``
+        carries one report per section and ``delta["dropped_rows"]``
+        the cut total.
 
         Requires an equivalence-enabled runner (``equiv=True``): the
         partition supplies the per-section fingerprints, and the base
@@ -983,7 +1038,9 @@ class CampaignRunner:
             delta_from)
         part = self._seeded_part(n, seed, start_num)
         current_header = self._journal_header(
-            "run", seed=int(seed), n=int(n), start_num=int(start_num))
+            "run", **self._campaign_spec(
+                n, seed=seed, batch_size=batch_size,
+                start_num=start_num).run_header_fields())
         section_names = {sig.leaf_id: name for name, sig in
                          self.equiv_partition.signatures.items()}
         plan = plan_delta(
@@ -994,7 +1051,21 @@ class CampaignRunner:
             part, section_names, base_path=delta_from)
         tel.instant("delta_plan", **plan.summary())
 
+        # Base-side section attribution, captured BEFORE any filtering:
+        # the recorded sites when the journal carries them, else the
+        # positional rows the schedule sha proved identical.  Feeds the
+        # per-changed-section distributions below -- the CI verdict's
+        # unbiased comparison unit when early stop truncates sections.
+        base_leaf = (np.asarray(base_sites["leaf_id"])
+                     if base_sites is not None
+                     else np.asarray(part.leaf_id).copy())
+        base_w_col = (np.asarray(base_sites["class_weight"], np.int64)
+                      if base_sites is not None
+                      else np.asarray(part.class_weight, np.int64).copy())
+        base_codes_col = base_out["codes"]
+
         run_idx = np.flatnonzero(plan.run_mask)
+        part0_leaf = np.asarray(part.leaf_id).copy()   # pre-filter rows
         cols = {k: v.copy() for k, v in plan.spliced.items()}
         seconds = 0.0
         stages: Dict[str, float] = {}
@@ -1003,8 +1074,8 @@ class CampaignRunner:
         # included: the splice is instant, so it lands as one opening
         # beat (done = spliced rows, counts = their weighted histogram)
         # and the re-injected rows then count up from that base -- a
-        # delta campaign's heartbeat is monotone to len(part) like any
-        # other campaign's.
+        # delta campaign's heartbeat is monotone like any other
+        # campaign's.
         splice_idx = np.flatnonzero(~plan.run_mask)
         splice_counts: Dict[str, int] = {}
         if progress is not None and len(splice_idx):
@@ -1014,14 +1085,10 @@ class CampaignRunner:
             splice_counts = cls.counts_dict(binc0, self._train)
             splice_counts["cache_invalid"] = 0
             progress(int(len(splice_idx)), dict(splice_counts))
-        if len(run_idx):
-            sub = FaultSchedule(
-                *(np.ascontiguousarray(np.asarray(getattr(part, f))[run_idx])
-                  for f in ("leaf_id", "lane", "word", "bit", "t",
-                            "section_idx")),
-                seed=part.seed, model=part.model,
-                class_weight=part.class_weight[run_idx],
-                equiv_sha=part.equiv_sha)
+        keep = None
+        convergence: Optional[Dict[str, object]] = None
+        if len(run_idx) and stop_when is None:
+            sub = self._take_rows(part, run_idx)
             chunk_progress = None
             if progress is not None:
                 base_done = int(len(splice_idx))
@@ -1042,9 +1109,114 @@ class CampaignRunner:
             seconds = sub_res.seconds
             stages = sub_res.stages
             resilience = sub_res.resilience
-        binc = cls.weighted_histogram(cols["codes"], part.class_weight)
+        elif len(run_idx):
+            # Per-section convergence: one sub-campaign (and one
+            # tracker) per re-injected section, in sorted name order so
+            # the row layout is deterministic.
+            keep = ~plan.run_mask
+            leaf_names = np.array([section_names.get(int(l), "?")
+                                   for l in np.asarray(part.leaf_id)])
+            groups: Dict[str, List[int]] = {}
+            for i in run_idx:
+                groups.setdefault(str(leaf_names[i]), []).append(int(i))
+            per_section: Dict[str, object] = {}
+            agg_counts = dict(splice_counts)
+            agg_done = int(len(splice_idx))
+            for name in sorted(groups):
+                idx = np.asarray(groups[name], np.int64)
+                sub = self._take_rows(part, idx)
+                chunk_progress = None
+                if progress is not None:
+                    def chunk_progress(done, counts, _base=agg_done,
+                                       _agg=dict(agg_counts)):
+                        merged = dict(_agg)
+                        for k, v in counts.items():
+                            merged[k] = merged.get(k, 0) + v
+                        progress(_base + done, merged)
+                sub_res = self.run_schedule(
+                    sub, batch_size=min(batch_size, len(sub)),
+                    progress=chunk_progress, _telemetry_mark=mark,
+                    stop_when=stop_when)
+                ran = len(sub_res.codes)
+                sel = idx[:ran]
+                for out_key, res_key in (("codes", "codes"),
+                                         ("errors", "errors"),
+                                         ("corrected", "corrected"),
+                                         ("steps", "steps")):
+                    cols[out_key][sel] = getattr(sub_res, res_key)
+                keep[sel] = True
+                seconds += sub_res.seconds
+                # stage_totals is cumulative since ``mark``: the last
+                # sub-run's totals already cover every earlier one.
+                stages = sub_res.stages
+                for k, v in sub_res.resilience.items():
+                    resilience[k] = resilience.get(k, 0) + v
+                per_section[name] = sub_res.convergence
+                agg_done += ran
+                for k, v in sub_res.counts.items():
+                    agg_counts[k] = agg_counts.get(k, 0) + v
+            convergence = {
+                "stopped": any(bool((c or {}).get("stopped"))
+                               for c in per_section.values()),
+                "stop_when": stop_when.spec(),
+                "per_section": per_section,
+            }
+        dropped = 0
+        if keep is not None and not keep.all():
+            # Early stop cut some sections short: the result describes
+            # exactly the spliced + collected rows.
+            keep_idx = np.flatnonzero(keep)
+            dropped = int(len(part) - len(keep_idx))
+            part = self._take_rows(part, keep_idx)
+            cols = {k: v[keep_idx] for k, v in cols.items()}
+        # Same invalid-draw accounting as run(): a t<0 row never fired,
+        # so it buckets as cache_invalid, never an outcome class --
+        # keeping journal_result's re-derived counts (and the fleet
+        # merge parity they feed) definitionally consistent.  Seeded
+        # generate() streams have no such rows, so delta counts are
+        # unchanged in practice.
+        fired = np.asarray(part.t) >= 0
+        w_col = np.asarray(part.class_weight, np.int64)
+        binc = cls.weighted_histogram(cols["codes"][fired], w_col[fired])
         counts = cls.counts_dict(binc, self._train)
-        counts["cache_invalid"] = 0
+        counts["cache_invalid"] = int(w_col[~fired].sum())
+        delta_summary: Dict[str, object] = {**plan.summary(),
+                                            "base": delta_from}
+        if stop_when is not None:
+            delta_summary["dropped_rows"] = dropped
+        if len(run_idx):
+            # Per-section base-vs-candidate distributions for every
+            # section that re-injected ANYTHING -- fingerprint-changed
+            # sections plus conservative re-injects (unmatched sites /
+            # drifted weights) in unchanged ones.  The spliced rows are
+            # identical by construction, so drift can only originate
+            # here -- and when early stop truncated a section, the
+            # POOLED mix is biased (the section's share of the total
+            # shrank), so consumers comparing distributions must
+            # compare these per-section blocks instead.
+            run_names = np.array([section_names.get(int(l), "?") for l
+                                  in np.asarray(part0_leaf)[run_idx]])
+            final_names = np.array([section_names.get(int(l), "?")
+                                    for l in np.asarray(part.leaf_id)])
+            base_names_col = np.array([section_names.get(int(l), "?")
+                                       for l in base_leaf])
+            sections: Dict[str, object] = {}
+            for name in sorted(set(run_names)):
+                bsel = base_names_col == name
+                csel = final_names == name
+                sections[name] = {
+                    "base_n": int(base_w_col[bsel].sum()),
+                    "base_counts": cls.counts_dict(
+                        cls.weighted_histogram(base_codes_col[bsel],
+                                               base_w_col[bsel]),
+                        self._train),
+                    "n": int(w_col[csel].sum()),
+                    "counts": cls.counts_dict(
+                        cls.weighted_histogram(cols["codes"][csel],
+                                               w_col[csel]),
+                        self._train),
+                }
+            delta_summary["sections"] = sections
         res = CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
@@ -1060,10 +1232,83 @@ class CampaignRunner:
             seed=part.seed,
             stages=stages or tel.stage_totals(since=mark),
             resilience=resilience,
-            delta={**plan.summary(), "base": delta_from},
+            delta=delta_summary,
         )
+        res.convergence = convergence
         res.start_num = start_num
         return res
+
+    def journal_result(self, res: CampaignResult, path: str,
+                       n: Optional[int] = None,
+                       batch_size: int = 4096) -> None:
+        """Materialize a completed single-seed result as a ``mode:
+        "run"`` journal at ``path``: header, the equiv representatives
+        (for reduced schedules), and one batch record per ``batch_size``
+        rows with cumulative counts -- exactly the records
+        ``load_delta_base`` and ``merge_fleet`` read.
+
+        Two consumers: the fleet's DELTA items (whose spliced rows
+        never ran, so the live campaign writes no journal -- this gives
+        their done records a journal to parity-check against), and the
+        CI refresh path (the materialized journal is the next
+        baseline's splice base).  ``n`` is the header's nominal
+        campaign size (the spec's requested n; an early-stopped delta
+        result covers fewer rows), defaulting to ``res.n``.
+
+        Refuses an existing non-empty ``path``
+        (:class:`~coast_tpu.inject.journal.JournalExistsError`) and
+        raises ``JournalError`` if the re-derived cumulative counts do
+        not reproduce ``res.counts`` -- the journal must be able to
+        stand in for the result under the fleet merge's parity check."""
+        from coast_tpu.inject.journal import JournalError
+        part = res.schedule
+        spec = self._campaign_spec(
+            int(n) if n is not None else int(res.n), seed=res.seed,
+            batch_size=batch_size, start_num=res.start_num)
+        header = self._journal_header(
+            "run", **spec.run_header_fields(),
+            schedule_sha=schedule_fingerprint(part))
+        j = CampaignJournal.open(path, header, resume=False)
+        try:
+            if part.class_weight is not None:
+                j.append({
+                    "kind": "equiv_schedule",
+                    "class_weight": part.class_weight.tolist(),
+                    **{k: np.asarray(getattr(part, k)).tolist()
+                       for k in ("leaf_id", "lane", "word", "bit", "t")},
+                })
+            live = np.zeros(cls.NUM_CLASSES, np.int64)
+            live_invalid = 0
+            t_col = np.asarray(part.t)
+            w = part.class_weight
+            counts: Dict[str, int] = {}
+            for lo in range(0, len(part), batch_size):
+                hi = min(lo + batch_size, len(part))
+                out = {"code": res.codes[lo:hi],
+                       "errors": res.errors[lo:hi],
+                       "corrected": res.corrected[lo:hi],
+                       "steps": res.steps[lo:hi]}
+                fired = t_col[lo:hi] >= 0
+                if w is None:
+                    live += np.bincount(out["code"][fired],
+                                        minlength=cls.NUM_CLASSES)
+                    live_invalid += int((~fired).sum())
+                else:
+                    ww = w[lo:hi]
+                    live += cls.weighted_histogram(out["code"][fired],
+                                                   ww[fired])
+                    live_invalid += int(ww[~fired].sum())
+                counts = cls.counts_dict(live, self._train)
+                counts["cache_invalid"] = live_invalid
+                j.append_batch(lo, out, counts, {})
+            want = {k: int(v) for k, v in res.counts.items()}
+            if len(part) and counts != want:
+                raise JournalError(
+                    f"journal_result parity failure at {path!r}: "
+                    f"re-derived cumulative counts {counts} != result "
+                    f"counts {want}")
+        finally:
+            j.close()
 
     def _result_from_chunk(self, rec: Dict[str, object]) -> CampaignResult:
         """Rebuild one journaled chunk's CampaignResult without touching

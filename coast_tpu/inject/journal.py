@@ -11,6 +11,9 @@ TPU preemption or a plain SIGKILL lost every completed batch.
 A journal is an append-only ndjson file.  Line 1 is the **header** --
 the campaign's identity (benchmark, strategy, protection-config
 fingerprint, seed, n, start_num, batch geometry, schedule fingerprint).
+The spec-owned subset of that vocabulary is one shared type,
+:class:`coast_tpu.inject.spec.CampaignSpec`, which also serializes the
+fleet queue-item and delta-identity encodings of the same facts.
 Every subsequent line is one **record**, fsync'd as it is appended so a
 kill at any instant leaves at worst one truncated trailing line (which
 :meth:`CampaignJournal._load` tolerates and drops):
@@ -65,6 +68,8 @@ import os
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from coast_tpu.inject.spec import header_fault_model
 
 try:
     import fcntl
@@ -289,9 +294,11 @@ class CampaignJournal:
         # Fault-model mismatch first, as its own typed error: the model
         # also perturbs the schedule fingerprint, and the generic diff
         # below would report that derived symptom instead of the cause.
-        # Absent key == "single" (journals written before the model).
-        found_model = found.get("fault_model", "single")
-        expect_model = expect.get("fault_model", "single")
+        # Absent key == "single" (journals written before the model;
+        # the rule lives in coast_tpu.inject.spec with the rest of the
+        # identity vocabulary).
+        found_model = header_fault_model(found)
+        expect_model = header_fault_model(expect)
         if found_model != expect_model:
             raise FaultModelMismatchError(
                 f"journal {path!r} records fault model {found_model!r} but "

@@ -1,0 +1,253 @@
+"""CampaignSpec: the ONE campaign-identity vocabulary.
+
+Before this module, three subsystems each spelled the same identity
+tuple -- benchmark / opt flags / section / seed / n / start_num / batch
+geometry / fault model / equiv / stop-when -- in their own dialect:
+
+  * the **journal header** (:mod:`coast_tpu.inject.journal`): the resume
+    contract, written as loose kwargs by ``CampaignRunner.run``;
+  * the **fleet queue item spec** (:mod:`coast_tpu.fleet.queue`
+    ``item_spec``): the work-ledger contract, a hand-rolled dict with
+    its own defaulting and validation;
+  * the **delta/equiv identity** (:mod:`coast_tpu.analysis.equiv.delta`
+    ``_IDENTITY_KEYS``): the splice-soundness contract, a tuple of
+    header keys compared by hand.
+
+Three spellings of one fact is how vocabularies drift: a key added to
+the journal but not the queue makes a worker regenerate a campaign the
+journal refuses; a default that differs between the item spec and the
+delta identity silently re-injects (or worse, splices) the wrong rows.
+:class:`CampaignSpec` is the single type all three serialize through.
+
+**Evolution rules are part of the type.**  Two asymmetric encodings
+exist on disk and both must stay bit-for-bit stable:
+
+  * ``to_item()`` emits exactly the historical queue-item dict
+    (``fault_model`` always present, ``stop_when`` an explicit null,
+    ``delta_from`` only when set) so enqueue ids -- the sha over the
+    sorted item JSON -- and every pre-PR queue directory keep their
+    meaning.
+  * ``run_header_fields()`` emits the journal's absent-means-default
+    subset (``fault_model``/``stop_when`` omitted at their defaults) so
+    journals written before those keys existed still open, resume, and
+    delta exactly as :data:`~coast_tpu.inject.journal._VOLATILE_KEYS`
+    and the PR 6 absent-means-``single`` rule promise.
+
+``from_item`` / ``from_header`` invert the two encodings; round-trip
+bit parity against pre-PR journals and queue items is pinned in
+``tests/test_ci.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+__all__ = ["CampaignSpec", "SpecError", "FAULT_MODEL_DEFAULT"]
+
+#: The journal-evolution default: an absent ``fault_model`` key means
+#: the historical single-bit flip (journals and queue items written
+#: before PR 6 carry no key at all).
+FAULT_MODEL_DEFAULT = "single"
+
+
+class SpecError(ValueError):
+    """A malformed campaign spec (bad n, unknown fault model, equiv over
+    a flip-group model, unparseable stop condition, delta misuse)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignSpec:
+    """One campaign's identity, in canonical normalized form.
+
+    Field semantics (shared verbatim by the journal header, the queue
+    item, and the delta identity):
+
+    ``benchmark``
+        Registry name or restricted-C source path.
+    ``n``
+        Requested injections.  For equivalence-reduced campaigns this is
+        the EFFECTIVE count; the physical representative count is a
+        property of the partition, not of the identity.
+    ``opt_passes``
+        Protection flags (opt CLI string) -- the protection-config
+        source.  The journal header pins the derived ``config_sha``
+        instead; the queue item carries the flags so a worker can
+        rebuild the program.
+    ``section`` / ``seed`` / ``start_num`` / ``batch_size`` / ``unroll``
+        As everywhere else.  ``batch_size`` is volatile for resume
+        (journal ``_VOLATILE_KEYS``) but part of the queue item.
+    ``fault_model``
+        ``FaultModel.spec()`` string; ``"single"`` is the default and is
+        OMITTED from journal headers (absent-means-single rule).
+    ``equiv``
+        Equivalence reduction on/off.  The journal header carries the
+        derived partition fingerprint block instead of the flag; the
+        flag is what a worker needs to rebuild the runner.
+    ``stop_when``
+        Canonical ``StopWhen.spec()`` string or None.  Part of resume
+        identity (an early-stopped journal's rows are a prefix chosen BY
+        the condition).
+    ``throttle_s``
+        Operator rate limit; fleet-item-only, never identity.
+    ``delta_from``
+        Path to a completed equiv run journal to splice unchanged
+        sections from.  Fleet-item-only (the CI's delta items); never
+        part of the journal header (a delta campaign's output is a
+        plain run result).
+    """
+
+    benchmark: str
+    n: int
+    seed: int = 0
+    opt_passes: str = "-TMR"
+    section: str = "memory"
+    batch_size: int = 4096
+    start_num: int = 0
+    fault_model: str = FAULT_MODEL_DEFAULT
+    equiv: bool = False
+    stop_when: Optional[str] = None
+    unroll: int = 1
+    throttle_s: float = 0.0
+    delta_from: Optional[str] = None
+
+    # -- validation ----------------------------------------------------------
+    def validate(self) -> "CampaignSpec":
+        """Raise :class:`SpecError` (or the parser's own typed error) on
+        a spec no campaign could run.  Returns self so call sites can
+        chain.  Validation happens at the BOUNDARY (enqueue, CLI parse,
+        baseline load) so a bad spec fails its author, not a worker an
+        hour later."""
+        if self.n <= 0:
+            raise SpecError(f"campaign wants n={self.n} injections; "
+                            "need > 0")
+        if self.fault_model != FAULT_MODEL_DEFAULT:
+            from coast_tpu.inject.schedule import FaultModel
+            FaultModel.parse(self.fault_model)   # ValueError on typos
+            if self.equiv:
+                raise SpecError(
+                    "equiv=True needs the single-bit fault model")
+        if self.stop_when:
+            from coast_tpu.obs.convergence import StopWhen
+            StopWhen.parse(self.stop_when)       # StopWhenError on typos
+        if self.delta_from and not self.equiv:
+            raise SpecError(
+                "delta_from needs equiv=True: the equivalence partition "
+                "supplies the per-section fingerprints a delta diffs")
+        return self
+
+    # -- parsed accessors ----------------------------------------------------
+    def fault_model_parsed(self):
+        """FaultModel instance, or None for the single-bit default (the
+        shape CampaignRunner(fault_model=) takes)."""
+        if self.fault_model == FAULT_MODEL_DEFAULT:
+            return None
+        from coast_tpu.inject.schedule import FaultModel
+        return FaultModel.parse(self.fault_model)
+
+    def stop_when_parsed(self):
+        """StopWhen instance, or None."""
+        if not self.stop_when:
+            return None
+        from coast_tpu.obs.convergence import StopWhen
+        return StopWhen.parse(self.stop_when)
+
+    # -- queue-item encoding (fleet/queue.py) --------------------------------
+    def to_item(self) -> Dict[str, object]:
+        """The fleet queue item dict, bit-compatible with the historical
+        ``item_spec`` output: same keys, same order, same explicit-null
+        conventions -- enqueue ids sha the sorted JSON of this dict, so
+        its shape IS on-disk compatibility.  ``delta_from`` joins only
+        when set, keeping every pre-delta item byte-identical."""
+        doc: Dict[str, object] = {
+            "benchmark": str(self.benchmark),
+            "opt_passes": str(self.opt_passes),
+            "section": str(self.section), "n": int(self.n),
+            "seed": int(self.seed), "start_num": int(self.start_num),
+            "batch_size": int(self.batch_size),
+            "fault_model": str(self.fault_model),
+            "equiv": bool(self.equiv),
+            "stop_when": self.stop_when if self.stop_when else None,
+            "unroll": int(self.unroll),
+            "throttle_s": float(self.throttle_s),
+        }
+        if self.delta_from:
+            doc["delta_from"] = str(self.delta_from)
+        return doc
+
+    @classmethod
+    def from_item(cls, spec: Dict[str, object]) -> "CampaignSpec":
+        """Inverse of :meth:`to_item`, tolerant of absent keys (items
+        enqueued by older code lack the newer ones)."""
+        return cls(
+            benchmark=str(spec["benchmark"]),
+            n=int(spec["n"]),
+            seed=int(spec.get("seed", 0)),
+            opt_passes=str(spec.get("opt_passes", "-TMR")),
+            section=str(spec.get("section", "memory")),
+            batch_size=int(spec.get("batch_size", 4096)),
+            start_num=int(spec.get("start_num", 0)),
+            fault_model=str(spec.get("fault_model",
+                                     FAULT_MODEL_DEFAULT)),
+            equiv=bool(spec.get("equiv", False)),
+            stop_when=spec.get("stop_when") or None,
+            unroll=int(spec.get("unroll", 1)),
+            throttle_s=float(spec.get("throttle_s", 0.0) or 0.0),
+            delta_from=spec.get("delta_from") or None,
+        )
+
+    # -- journal-header encoding (inject/journal.py) -------------------------
+    def run_header_fields(self) -> Dict[str, object]:
+        """The spec-owned fields of a ``mode: "run"`` journal header, in
+        the header's historical key order (headers are serialized
+        without sort_keys, so order is byte parity): seed, n, start_num,
+        batch_size.  ``fault_model`` and ``stop_when`` are deliberately
+        NOT here -- the runner places them at their historical header
+        positions, and both follow absent-means-default evolution rules
+        (:func:`header_fault_model`)."""
+        return {"seed": int(self.seed), "n": int(self.n),
+                "start_num": int(self.start_num),
+                "batch_size": int(self.batch_size)}
+
+    @classmethod
+    def from_header(cls, header: Dict[str, object],
+                    opt_passes: str = "-TMR",
+                    section: str = "memory") -> "CampaignSpec":
+        """Extract the identity vocabulary from a ``mode: "run"``
+        journal header.  The header pins ``config_sha`` rather than the
+        opt flag string (and carries no section), so those two are
+        caller-supplied when known; everything else -- including the
+        absent-means-default rules for ``fault_model``/``stop_when`` and
+        equiv-block presence -- decodes here, the one place the rules
+        are spelled."""
+        return cls(
+            benchmark=str(header.get("benchmark")),
+            n=int(header.get("n", 0)),
+            seed=int(header.get("seed", 0)),
+            opt_passes=opt_passes,
+            section=section,
+            batch_size=int(header.get("batch_size", 4096)),
+            start_num=int(header.get("start_num", 0)),
+            fault_model=header_fault_model(header),
+            equiv=bool(header.get("equiv")),
+            stop_when=header.get("stop_when") or None,
+        )
+
+    # -- delta identity (analysis/equiv/delta.py) ----------------------------
+    def delta_identity(self) -> Dict[str, object]:
+        """The spec-owned half of delta-splice identity: the keys that
+        must match between a delta base journal and the current campaign
+        for the recorded outcomes to be reusable at all.  (``mode`` and
+        ``strategy`` are header-level facts outside the spec; the
+        protection config is deliberately absent -- the config changing
+        is the whole point of a delta.)"""
+        return {"benchmark": str(self.benchmark), "seed": int(self.seed),
+                "n": int(self.n), "start_num": int(self.start_num),
+                "fault_model": str(self.fault_model)}
+
+
+def header_fault_model(header: Dict[str, object]) -> str:
+    """The PR 6 journal-evolution rule, spelled once: an absent
+    ``fault_model`` header key means the historical single-bit model."""
+    return str(header.get("fault_model", FAULT_MODEL_DEFAULT)
+               or FAULT_MODEL_DEFAULT)

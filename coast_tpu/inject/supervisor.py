@@ -139,7 +139,10 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "splice the recorded outcomes for the rest.  A "
                         "no-op rebuild re-injects zero rows.  Implies "
                         "--equiv; incompatible journals are refused "
-                        "with a typed error")
+                        "with a typed error.  Combine with --stop-when "
+                        "to convergence-bound each re-injected section "
+                        "on its own (spliced sections keep their exact "
+                        "recorded counts)")
     parser.add_argument("--stratified", action="store_true",
                         help="equal-allocation sampling per section: -t "
                         "is divided across sections (floored at 1 each, "
@@ -342,14 +345,15 @@ def parse_command_line(argv: Optional[List[str]] = None):
         sys.exit(-1)
     if args.stop_when:
         from coast_tpu.obs.convergence import StopWhen, StopWhenError
-        if args.errorCount or args.forceBreak or args.delta_from:
+        if args.errorCount or args.forceBreak:
             # -e has its own stopping rule (error-bounded sizing);
-            # forced injections are debug one-offs; a delta campaign's
-            # row set is determined by the fingerprint diff, not by
-            # sampling precision.
+            # forced injections are debug one-offs.  --delta-from IS
+            # compatible: the early stop applies per re-injected
+            # section (the spliced sections keep their exact recorded
+            # counts and never enter a tracker).
             print("Error, --stop-when applies to the seeded/stratified/"
-                  "cache campaign paths, not -e/--errorCount, "
-                  "--forceBreak, or --delta-from", file=sys.stderr)
+                  "cache/delta campaign paths, not -e/--errorCount or "
+                  "--forceBreak", file=sys.stderr)
             sys.exit(-1)
         try:
             args.stop_when_parsed = StopWhen.parse(args.stop_when)
@@ -622,7 +626,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        seed=args.seed,
                                        batch_size=args.batch_size,
                                        start_num=args.start_num,
-                                       progress=progress)
+                                       progress=progress,
+                                       stop_when=args.stop_when_parsed)
             except DeltaMismatchError as e:
                 print(f"Error, {e}", file=sys.stderr)
                 return 1

@@ -33,8 +33,8 @@ import dataclasses
 import math
 from typing import Dict, Mapping, Optional, Tuple
 
-__all__ = ["wilson_interval", "interval_table", "StopWhen",
-           "ConvergenceTracker", "StopWhenError"]
+__all__ = ["wilson_interval", "interval_table", "intervals_overlap",
+           "StopWhen", "ConvergenceTracker", "StopWhenError"]
 
 #: Valid stop-condition target classes: the classifier taxonomy plus the
 #: cache_invalid bucket the campaign counts alongside it.
@@ -186,6 +186,18 @@ def interval_table(counts: Mapping[str, float], z: float = 1.96,
             "half_width": (hi - lo) / 2.0,
         }
     return out
+
+
+def intervals_overlap(a: Mapping[str, float],
+                      b: Mapping[str, float]) -> bool:
+    """Whether two ``{lo, hi}`` interval rows (the :func:`interval_table`
+    shape) intersect.  Closed-interval semantics: touching endpoints
+    count as overlap -- the two estimates are still mutually consistent.
+    The one overlap rule shared by the comparison surface
+    (``json_parser.compare_runs``) and the protection-regression CI's
+    drift verdict."""
+    return (float(a["lo"]) <= float(b["hi"])
+            and float(b["lo"]) <= float(a["hi"]))
 
 
 class ConvergenceTracker:

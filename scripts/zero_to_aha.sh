@@ -72,3 +72,11 @@ else
     [ -f "$TMR_LOG" ] && python -m coast_tpu.analysis "$TMR_LOG"
 fi
 echo "logs in: $LOGDIR"
+
+# 5. the merge gate: delta-check the tree against the committed
+# protection baseline (0 pass / 1 drift / 2 infra; docs/ci.md).
+if [ -f artifacts/ci_baseline.json ]; then
+    echo "== 5. protection-regression CI =="
+    python -m coast_tpu ci check --baseline artifacts/ci_baseline.json \
+        || echo "ci check exited $? (1=drift, 2=infra; see docs/ci.md)"
+fi

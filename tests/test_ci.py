@@ -1,0 +1,546 @@
+"""Protection-regression CI (coast_tpu/ci) + the unified CampaignSpec.
+
+Covers the PR's acceptance contract:
+
+  * CampaignSpec round-trip BIT parity: the queue-item dict is
+    byte-compatible with the pre-spec ``item_spec`` output (enqueue ids
+    sha its sorted JSON), and a journaled run's header line is byte-
+    identical to what the pre-spec header assembly wrote -- resume and
+    ``merge_fleet`` cannot tell the refactor happened.
+  * ``compare_runs`` per-class Wilson intervals and the overlap/drift
+    verdict, including the zero-count-class edge cases and the
+    weight-aware path.
+  * ``run_delta`` x ``stop_when``: convergence early-stop applies PER
+    re-injected section, spliced sections keep their recorded outcomes
+    verbatim.
+  * End-to-end verdict behavior: a no-op rebuild re-injects 0 rows and
+    exits 0; a seeded dropped-commit-vote build re-injects exactly the
+    changed sections' rows and exits 1 with a per-class drift report;
+    identity mismatches are infra (exit 2), not drift.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.journal import (config_fingerprint,
+                                      schedule_fingerprint)
+from coast_tpu.inject.spec import (CampaignSpec, SpecError,
+                                   header_fault_model)
+from coast_tpu.models import mm
+
+
+@pytest.fixture(scope="module")
+def mm_region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def mm_tmr_equiv(mm_region):
+    return CampaignRunner(TMR(mm_region), strategy_name="TMR",
+                          equiv=True)
+
+
+@pytest.fixture(scope="module")
+def baseline_doc():
+    """A one-target baseline built through the real fleet path."""
+    from coast_tpu.ci import engine
+    return engine.build_baseline(
+        [CampaignSpec("matrixMultiply", 512, seed=7, opt_passes="-TMR",
+                      batch_size=256, equiv=True)])
+
+
+def _weaken_mm(prog):
+    """The seeded protection-weakening edit: drop the TMR store-data
+    commit vote (the lint sweep's dropped-commit-vote regression seed,
+    test_lint.py test_seeded_dropped_voter_caught)."""
+    if prog.region.name == "matrixMultiply" \
+            and prog.step_sync.get("results"):
+        prog.step_sync["results"] = False
+
+
+# ---------------------------------------------------------------------------
+# CampaignSpec: round-trip bit parity with the pre-spec encodings
+# ---------------------------------------------------------------------------
+
+def test_item_spec_bit_parity_with_pre_spec_dict():
+    """The queue-item encoding is byte-for-byte the historical
+    item_spec output (literal copied from the pre-refactor function):
+    same keys, same order, same explicit-null conventions -- so the
+    enqueue id (sha over the sorted JSON) of every pre-PR spec is
+    unchanged."""
+    from coast_tpu.fleet.queue import item_spec
+    legacy = {
+        "benchmark": "matrixMultiply", "opt_passes": "-DWC",
+        "section": "registers", "n": 300, "seed": 5,
+        "start_num": 10, "batch_size": 128,
+        "fault_model": "multibit(k=2)", "equiv": False,
+        "stop_when": "sdc:0.01;min=64", "unroll": 2,
+        "throttle_s": 0.25,
+    }
+    now = item_spec("matrixMultiply", 300, seed=5, opt_passes="-DWC",
+                    section="registers", batch_size=128, start_num=10,
+                    fault_model="multibit(k=2)",
+                    stop_when="sdc:0.01;min=64", unroll=2,
+                    throttle_s=0.25)
+    assert now == legacy
+    assert list(now) == list(legacy)          # key order too
+    assert (hashlib.sha256(json.dumps(now, sort_keys=True).encode())
+            .hexdigest()
+            == hashlib.sha256(json.dumps(legacy,
+                                         sort_keys=True).encode())
+            .hexdigest())
+    # and the typed round trip is lossless
+    assert CampaignSpec.from_item(now).to_item() == legacy
+
+
+def test_item_spec_delta_key_absent_unless_set(tmp_path):
+    plain = CampaignSpec("mm", 10).to_item()
+    assert "delta_from" not in plain
+    d = CampaignSpec("mm", 10, equiv=True,
+                     delta_from=str(tmp_path / "b.journal")).to_item()
+    assert d["delta_from"] == str(tmp_path / "b.journal")
+    rt = CampaignSpec.from_item(d)
+    assert rt.delta_from == d["delta_from"] and rt.equiv
+
+
+def test_run_header_bit_parity_with_pre_spec_journal(mm_tmr_equiv,
+                                                     tmp_path):
+    """The header line a journaled run writes is byte-identical to the
+    pre-spec assembly (mode, benchmark, strategy, config_sha, equiv
+    block, section_fingerprints, seed, n, start_num, batch_size,
+    schedule_sha -- in that order, compact separators)."""
+    jpath = str(tmp_path / "hdr.journal")
+    mm_tmr_equiv.run(256, seed=3, batch_size=128, journal=jpath)
+    with open(jpath) as fh:
+        first = fh.readline().rstrip("\n")
+    part = mm_tmr_equiv._seeded_part(256, 3, 0)
+    p = mm_tmr_equiv.equiv_partition
+    expected = {
+        "kind": "header",
+        "format": "coast-journal", "version": 1,
+        "mode": "run",
+        "benchmark": "matrixMultiply",
+        "strategy": "TMR",
+        "config_sha": config_fingerprint(mm_tmr_equiv.prog.cfg),
+        "equiv": {"partition": p.fingerprint,
+                  "clean_steps": p.clean_steps},
+        "section_fingerprints": {
+            name: sig.fingerprint
+            for name, sig in sorted(p.signatures.items())},
+        "seed": 3, "n": 256, "start_num": 0, "batch_size": 128,
+        "schedule_sha": schedule_fingerprint(part),
+    }
+    assert first == json.dumps(expected, separators=(",", ":"))
+    # the journal resumes (appending nothing) under the same identity
+    res1 = mm_tmr_equiv.run(256, seed=3, batch_size=128, journal=jpath)
+    assert res1.n == 256
+
+
+def test_from_header_round_trip_and_defaults():
+    header = {"mode": "run", "benchmark": "crc16", "strategy": "DWC",
+              "config_sha": "abc", "seed": 4, "n": 100,
+              "start_num": 2, "batch_size": 64, "schedule_sha": "x"}
+    spec = CampaignSpec.from_header(header)
+    assert spec.run_header_fields() == {"seed": 4, "n": 100,
+                                        "start_num": 2,
+                                        "batch_size": 64}
+    # evolution rules decoded in one place
+    assert spec.fault_model == "single" and spec.stop_when is None
+    assert not spec.equiv
+    assert header_fault_model(header) == "single"
+    assert header_fault_model({"fault_model": "burst(window=4,rate=1)"}
+                              ) == "burst(window=4,rate=1)"
+    spec2 = CampaignSpec.from_header(
+        {**header, "fault_model": "multibit(k=2)",
+         "stop_when": "sdc:0.01", "equiv": {"partition": "p"}})
+    assert spec2.fault_model == "multibit(k=2)"
+    assert spec2.stop_when == "sdc:0.01" and spec2.equiv
+    assert spec2.delta_identity() == {
+        "benchmark": "crc16", "seed": 4, "n": 100, "start_num": 2,
+        "fault_model": "multibit(k=2)"}
+
+
+def test_spec_validation_rules():
+    with pytest.raises(SpecError):
+        CampaignSpec("mm", 0).validate()
+    with pytest.raises(SpecError):
+        CampaignSpec("mm", 10, fault_model="multibit(k=2)",
+                     equiv=True).validate()
+    with pytest.raises(ValueError):
+        CampaignSpec("mm", 10, fault_model="bogus(k=2)").validate()
+    with pytest.raises(SpecError):
+        CampaignSpec("mm", 10, delta_from="x.journal").validate()
+    CampaignSpec("mm", 10, equiv=True,
+                 delta_from="x.journal").validate()
+
+
+# ---------------------------------------------------------------------------
+# compare_runs: per-class Wilson intervals + overlap verdict
+# ---------------------------------------------------------------------------
+
+def _summary(name, n, **counts):
+    from coast_tpu.analysis.json_parser import Summary, _CLASSES
+    filled = {c: 0 for c in _CLASSES}
+    filled.update(counts)
+    filled["success"] = n - sum(counts.values())
+    return Summary(name=name, n=n, counts=filled, seconds=0.0,
+                   mean_steps=0.0)
+
+
+def test_compare_runs_identical_distributions_consistent():
+    from coast_tpu.analysis.json_parser import compare_runs
+    a = _summary("a", 1000, sdc=20, corrected=100)
+    b = _summary("b", 1000, sdc=20, corrected=100)
+    cmp_ = compare_runs(a, b)
+    assert cmp_["distribution_drift"] is False
+    assert cmp_["new_classes"] == [] and cmp_["vanished_classes"] == []
+    row = cmp_["classes"]["sdc"]
+    assert row["overlap"] is True
+    # interval values match the convergence module's arithmetic
+    from coast_tpu.obs.convergence import wilson_interval
+    lo, hi = wilson_interval(20, 1000)
+    assert row["base"]["lo"] == pytest.approx(lo)
+    assert row["base"]["hi"] == pytest.approx(hi)
+
+
+def test_compare_runs_rate_shift_is_drift():
+    from coast_tpu.analysis.json_parser import compare_runs
+    a = _summary("a", 1000, sdc=10)
+    b = _summary("b", 1000, sdc=300)
+    cmp_ = compare_runs(a, b)
+    assert cmp_["distribution_drift"] is True
+    assert cmp_["classes"]["sdc"]["overlap"] is False
+    assert cmp_["new_classes"] == []          # sdc existed in both
+
+
+def test_compare_runs_new_and_vanished_classes_are_drift():
+    from coast_tpu.analysis.json_parser import compare_runs
+    base = _summary("a", 2048)
+    cand = _summary("b", 2048, sdc=3)
+    cmp_ = compare_runs(base, cand)
+    # 3/2048 sits INSIDE a Wilson interval of 0/2048 -- the class rule,
+    # not the overlap rule, is what catches a protection regression
+    # that creates a rare class.
+    assert cmp_["classes"]["sdc"]["overlap"] is True
+    assert cmp_["new_classes"] == ["sdc"]
+    assert cmp_["distribution_drift"] is True
+    rev = compare_runs(cand, base)
+    assert rev["vanished_classes"] == ["sdc"]
+    assert rev["distribution_drift"] is True
+
+
+def test_compare_runs_zero_count_class_both_sides_not_drift():
+    """Zero in the baseline and ABSENT in the candidate (and vice
+    versa) is the same fact -- observed zero -- not drift."""
+    from coast_tpu.analysis.json_parser import Summary, compare_runs
+    base = _summary("a", 512)                 # all classes present, 0s
+    cand = Summary(name="b", n=512, counts={"success": 512},
+                   seconds=0.0, mean_steps=0.0)
+    cmp_ = compare_runs(base, cand)
+    assert cmp_["distribution_drift"] is False
+    assert cmp_["new_classes"] == [] and cmp_["vanished_classes"] == []
+    assert cmp_["classes"]["sdc"]["overlap"] is True
+    rev = compare_runs(cand, base)
+    assert rev["distribution_drift"] is False
+
+
+def test_compare_runs_weight_aware_intervals():
+    """Equivalence-reduced summaries compare over EFFECTIVE injections:
+    the interval arithmetic runs on weighted counts/n, exactly like the
+    live convergence tracker."""
+    from coast_tpu.analysis.json_parser import compare_runs
+    from coast_tpu.obs.convergence import wilson_interval
+    a = _summary("a", 4096, sdc=64)
+    b = dataclasses.replace(_summary("b", 4096, sdc=64),
+                            physical_n=200)
+    cmp_ = compare_runs(a, b)
+    assert cmp_["distribution_drift"] is False
+    lo, hi = wilson_interval(64, 4096)
+    assert cmp_["classes"]["sdc"]["new"]["lo"] == pytest.approx(lo)
+    assert cmp_["classes"]["sdc"]["new"]["hi"] == pytest.approx(hi)
+
+
+# ---------------------------------------------------------------------------
+# run_delta x stop_when: per-section early stop (the flag-interplay fix)
+# ---------------------------------------------------------------------------
+
+def test_delta_stop_when_per_section_and_splice_integrity(mm_tmr_equiv,
+                                                          tmp_path):
+    from coast_tpu.obs.convergence import StopWhen
+    jpath = str(tmp_path / "base.journal")
+    base = mm_tmr_equiv.run(1024, seed=7, batch_size=256, journal=jpath)
+
+    weak_prog = TMR(mm.make_region())
+    weak_prog.step_sync["results"] = False
+    weak = CampaignRunner(weak_prog, strategy_name="TMR", equiv=True)
+    sw = StopWhen.parse("sdc:0.08;min=16")
+    res = weak.run_delta(1024, jpath, seed=7, batch_size=64,
+                         stop_when=sw)
+
+    changed = set(res.delta["changed_sections"])
+    assert changed                             # the edit was seen
+    conv = res.convergence
+    assert conv is not None and conv["stop_when"] == sw.spec()
+    # one tracker per re-injected section, each over ONLY that
+    # section's rows: planned_n equals the section's own effective
+    # weight, which a union tracker could never report.
+    sig = weak.equiv_partition.signatures
+    names = {s.leaf_id: n for n, s in sig.items()}
+    part = weak._seeded_part(1024, 7, 0)
+    leaf_names = np.array([names[int(l)] for l in part.leaf_id])
+    weights = np.asarray(part.class_weight)
+    assert set(conv["per_section"]) == changed
+    for name, report in conv["per_section"].items():
+        planned = int(weights[leaf_names == name].sum())
+        assert report["planned_n"] == planned
+        assert report["done_n"] <= planned
+    assert conv["stopped"] == any(
+        r["stopped"] for r in conv["per_section"].values())
+    # per-changed-section distributions recorded (the CI verdict's
+    # unbiased comparison unit when rows were dropped)
+    assert set(res.delta["sections"]) == changed
+    for name, row in res.delta["sections"].items():
+        assert row["n"] <= row["base_n"]
+        assert sum(row["counts"].values()) == row["n"]
+        assert sum(row["base_counts"].values()) == row["base_n"]
+    # the loose threshold must actually cut rows, and the accounting
+    # must agree with the filtered result
+    assert res.delta["dropped_rows"] > 0
+    assert res.physical_n == len(res.codes)
+    assert res.n == int(np.asarray(res.schedule.class_weight).sum())
+    assert sum(res.counts.values()) == res.n
+
+    # spliced sections keep their journaled outcomes VERBATIM:
+    # site-keyed comparison against the base journal's rows.
+    with open(jpath) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    sites = next(r for r in recs if r.get("kind") == "equiv_schedule")
+    base_codes = base.codes
+    base_map = {}
+    for i in range(len(sites["t"])):
+        key = tuple(sites[k][i]
+                    for k in ("leaf_id", "lane", "word", "bit", "t"))
+        base_map[key] = int(base_codes[i])
+    sched = res.schedule
+    res_names = np.array([names[int(l)] for l in sched.leaf_id])
+    spliced = 0
+    for i in range(len(sched)):
+        if res_names[i] in changed:
+            continue
+        key = tuple(int(np.asarray(getattr(sched, k))[i])
+                    for k in ("leaf_id", "lane", "word", "bit", "t"))
+        assert int(res.codes[i]) == base_map[key]
+        spliced += 1
+    assert spliced == res.delta["reused_rows"]
+
+
+def test_delta_without_stop_when_unchanged(mm_tmr_equiv, tmp_path):
+    """The interplay fix must not perturb the plain delta path: no
+    convergence block, no dropped_rows key, bit-identical splice."""
+    jpath = str(tmp_path / "plainbase.journal")
+    base = mm_tmr_equiv.run(512, seed=3, batch_size=256, journal=jpath)
+    res = mm_tmr_equiv.run_delta(512, jpath, seed=3, batch_size=256)
+    assert res.convergence is None
+    assert "dropped_rows" not in res.delta
+    assert np.array_equal(res.codes, base.codes)
+
+
+def test_supervisor_accepts_delta_with_stop_when():
+    from coast_tpu.inject import supervisor
+    args = supervisor.parse_command_line(
+        ["-f", "matrixMultiply", "--delta-from", "x.journal",
+         "--stop-when", "sdc:0.01;min=32", "-t", "64"])
+    assert args.equiv                          # --delta-from implies it
+    assert args.stop_when_parsed is not None
+    # the other refusals stand
+    with pytest.raises(SystemExit):
+        supervisor.parse_command_line(
+            ["-f", "matrixMultiply", "-e", "5",
+             "--stop-when", "sdc:0.01"])
+
+
+# ---------------------------------------------------------------------------
+# journal_result: a materialized result IS a journal
+# ---------------------------------------------------------------------------
+
+def test_journal_result_round_trips_as_delta_base_and_merge_parity(
+        mm_tmr_equiv, tmp_path):
+    from coast_tpu.fleet.supervisor import _journal_columns
+    from coast_tpu.fleet.worker import codes_sha256
+    res = mm_tmr_equiv.run(512, seed=9, batch_size=256)
+    path = str(tmp_path / "mat.journal")
+    mm_tmr_equiv.journal_result(res, path, n=512, batch_size=100)
+    codes, last_counts = _journal_columns(path)
+    assert np.array_equal(codes, res.codes)
+    assert codes_sha256(codes) == codes_sha256(res.codes)
+    assert last_counts == {k: int(v) for k, v in res.counts.items()}
+    # and it seeds a delta: a no-op rebuild splices everything
+    rebuilt = CampaignRunner(TMR(mm.make_region()),
+                             strategy_name="TMR", equiv=True)
+    delta = rebuilt.run_delta(512, path, seed=9, batch_size=256)
+    assert delta.delta["reinjected_rows"] == 0
+    assert delta.counts == res.counts
+
+
+# ---------------------------------------------------------------------------
+# CI engine end-to-end
+# ---------------------------------------------------------------------------
+
+def test_ci_noop_check_reinjects_zero_and_passes(baseline_doc):
+    from coast_tpu.ci import engine
+    report = engine.check_baseline(baseline_doc)
+    assert report.exit_code == engine.EXIT_PASS
+    assert not report.drift
+    (t,) = report.targets
+    assert t.reinjected_rows == 0 and t.changed_sections == []
+    assert t.counts == t.base_counts
+    # the refreshed artifact is a valid baseline for the next commit
+    assert report.refreshed["format"] == "coast-ci-baseline"
+    assert set(report.refreshed["targets"]) == set(
+        baseline_doc["targets"])
+    for block in report.refreshed["targets"].values():
+        assert block["section_fingerprints"] and block["journal"]
+
+
+def test_ci_weakened_build_drifts_exit1(baseline_doc):
+    from coast_tpu.ci import engine
+    report = engine.check_baseline(baseline_doc,
+                                   program_hook=_weaken_mm)
+    assert report.exit_code == engine.EXIT_DRIFT
+    (t,) = report.targets
+    assert t.drift and t.changed_sections
+    # exactly the changed sections were re-injected: every reused row
+    # belongs to an unchanged section of the baseline schedule
+    tid = t.target
+    block = baseline_doc["targets"][tid]
+    sites = next(json.loads(ln) for ln in block["journal"]
+                 if json.loads(ln).get("kind") == "equiv_schedule")
+    # leaf ids of changed sections, via a fresh partition of the
+    # weakened build (same names the delta used)
+    prog = TMR(mm.make_region())
+    _weaken_mm(prog)
+    weak = CampaignRunner(prog, strategy_name="TMR", equiv=True)
+    names = {s.leaf_id: n
+             for n, s in weak.equiv_partition.signatures.items()}
+    changed_rows = sum(
+        1 for lid in sites["leaf_id"]
+        if names[int(lid)] in set(t.changed_sections))
+    assert t.reinjected_rows == changed_rows
+    assert t.reused_rows == len(sites["leaf_id"]) - changed_rows
+    # the drift report names at least one non-overlapping or new class
+    assert t.drift_lines()
+
+
+def test_target_verdict_per_section_when_rows_dropped():
+    """The pooled distribution is biased when early stop truncated a
+    section (its share of the mix shrank); the verdict must then come
+    from the per-section comparisons, not the pool.  Fabricated case:
+    section B converged at a quarter of its rows with an IDENTICAL
+    distribution -- pooled rates shift (spurious drift), per-section
+    says consistent."""
+    from coast_tpu.ci.engine import _target_verdict
+    block = {"n": 2048,
+             "counts": {"success": 1024, "sdc": 1024}}
+    # A (unchanged, spliced): 1024 rows, all sdc.  B (changed,
+    # truncated 1024 -> 256): all success, distribution unchanged.
+    result = {
+        "injections": 1280,
+        "counts": {"success": 256, "sdc": 1024},
+        "delta": {"dropped_rows": 768,
+                  "sections": {"b": {"base_n": 1024,
+                                     "base_counts": {"success": 1024},
+                                     "n": 256,
+                                     "counts": {"success": 256}}}},
+    }
+    drift, cmp_, sec = _target_verdict("t", block, result, 1.96)
+    assert cmp_["distribution_drift"] is True      # the pooled bias
+    assert sec["b"]["distribution_drift"] is False
+    assert drift is False                          # verdict is sound
+    # ... and a genuinely drifting section still fails
+    result2 = json.loads(json.dumps(result))
+    result2["delta"]["sections"]["b"]["counts"] = {"success": 200,
+                                                   "sdc": 56}
+    result2["counts"] = {"success": 1224, "sdc": 56}
+    drift2, _, sec2 = _target_verdict("t", block, result2, 1.96)
+    assert sec2["b"]["distribution_drift"] is True
+    assert drift2 is True
+
+
+def test_fleet_enqueue_refuses_delta_with_count(tmp_path):
+    from coast_tpu.fleet.supervisor import main as fleet_main
+    rc = fleet_main(["enqueue", "--queue", str(tmp_path / "q"),
+                     "-f", "matrixMultiply", "-t", "64", "--equiv",
+                     "--delta-from", "base.journal", "--count", "3"])
+    assert rc == 1
+
+
+def test_ci_identity_mismatch_is_infra_not_drift(baseline_doc):
+    from coast_tpu.ci import engine
+    doc = json.loads(json.dumps(baseline_doc))     # deep copy
+    (tid,) = doc["targets"]
+    doc["targets"][tid]["spec"]["seed"] = 99       # not the journal's
+    with pytest.raises(engine.CiInfraError):
+        engine.check_baseline(doc)
+
+
+def test_ci_cli_and_dispatcher(tmp_path):
+    from coast_tpu.__main__ import main as pkg_main
+    from coast_tpu.ci.__main__ import main as ci_main
+    assert pkg_main(["bogus-verb"]) == 2
+    # unreadable baseline -> typed infra exit
+    missing = str(tmp_path / "nope.json")
+    assert ci_main(["check", "--baseline", missing]) == 2
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        fh.write("{\"format\": \"something-else\"}")
+    assert ci_main(["check", "--baseline", bad]) == 2
+
+
+def test_ci_cli_baseline_check_refresh_cycle(tmp_path):
+    """The CLI surface end-to-end on one tiny target: baseline writes
+    the artifact, check exits 0 and drops the refreshed file, refresh
+    overwrites the baseline in place."""
+    from coast_tpu.ci.__main__ import main as ci_main
+    from coast_tpu.ci.baseline import load_baseline
+    bl = str(tmp_path / "bl.json")
+    rc = ci_main(["baseline", "--baseline", bl, "-t", "256",
+                  "--batch-size", "128",
+                  "--target", "matrixMultiply|-TMR"])
+    assert rc == 0
+    doc = load_baseline(bl)
+    assert list(doc["targets"]) == ["matrixMultiply|-TMR|memory|s7"]
+    out = str(tmp_path / "ref.json")
+    assert ci_main(["check", "--baseline", bl, "--out", out]) == 0
+    assert load_baseline(out)["targets"].keys() == doc["targets"].keys()
+    before = os.path.getmtime(bl)
+    assert ci_main(["refresh", "--baseline", bl]) == 0
+    assert os.path.getmtime(bl) >= before
+    load_baseline(bl)                          # still well-formed
+
+
+def test_committed_baseline_artifact_is_loadable():
+    """The repo's own artifact (artifacts/ci_baseline.json) stays
+    well-formed: the mm+crc16 x DWC/TMR target set with fingerprints
+    and journals -- `make ci_protection` runs out of the box."""
+    from coast_tpu.ci.baseline import load_baseline
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "artifacts", "ci_baseline.json")
+    doc = load_baseline(path)
+    assert set(doc["targets"]) == {
+        "matrixMultiply|-DWC|memory|s7", "matrixMultiply|-TMR|memory|s7",
+        "crc16|-DWC|memory|s7", "crc16|-TMR|memory|s7"}
+    for tid, block in doc["targets"].items():
+        spec = CampaignSpec.from_item(block["spec"]).validate()
+        assert spec.equiv
+        assert block["section_fingerprints"]
+        header = json.loads(block["journal"][0])
+        assert header["kind"] == "header" and header["mode"] == "run"
+        assert sum(1 for ln in block["journal"]
+                   if json.loads(ln).get("kind") == "batch") > 0
