@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -117,6 +117,12 @@ train_smoke:
 # with a per-class drift verdict.
 ci_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.ci_smoke
+
+# Sparse-collect smoke (also a fast.yml driver row): dense vs
+# device-resident sparse collection parity (counts + interesting-row
+# sets + fewer host bytes), sparse journal resume, overflow fallback.
+sparse_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.sparse_smoke
 
 # The repo gating itself (ROADMAP item 3's end-game): delta-check the
 # current tree against the committed baseline artifact.  Exit 0 = the

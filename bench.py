@@ -50,6 +50,26 @@ TPU_V5E_BF16_PEAK_GFLOPS = 197_000.0
 LAST_TPU_RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "artifacts", "last_tpu_bench.json")
 
+
+def _backend_record_path(backend: str) -> str:
+    """Per-backend last-known record: `artifacts/last_bench_<backend>`.
+    CPU-fallback rounds compare against (and refresh) the CPU record,
+    on-chip rounds the TPU one -- a fallback round can neither clobber
+    nor be judged against the hardware trajectory."""
+    safe = "".join(c if c.isalnum() else "_" for c in backend or "unknown")
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", f"last_bench_{safe}.json")
+
+
+def _load_backend_baseline(backend: str):
+    try:
+        with open(_backend_record_path(backend)) as f:
+            rec = json.load(f)
+        return {"value": rec.get("record", {}).get("value"),
+                "measured_at": rec.get("measured_at")}
+    except (OSError, ValueError):
+        return None
+
 # Stage timeouts (seconds), env-tunable for the driver.
 INIT_TIMEOUT = int(os.environ.get("COAST_BENCH_INIT_TIMEOUT", "420"))
 RETRY_TIMEOUT = int(os.environ.get("COAST_BENCH_RETRY_TIMEOUT", "180"))
@@ -58,9 +78,20 @@ RUN_TIMEOUT = int(os.environ.get("COAST_BENCH_RUN_TIMEOUT", "900"))
 # and a wedged earlier worker (or a neighbour process) holding it makes
 # every fresh attempt die in init.  A claim-like failure retries with
 # exponential backoff instead of instantly burning the remaining plan
-# entries against a device that may free up in seconds.
+# entries against a device that may free up in seconds.  The loop is
+# bounded BOTH by attempt count and by total wall clock
+# (COAST_BENCH_CLAIM_TOTAL_S): ROADMAP notes whole bench rounds lost to
+# spawn-wedge retry churn, so when the budget runs out the giving-up
+# reason is ONE explicit line, not a pile of per-attempt stderr.
 CLAIM_RETRIES = int(os.environ.get("COAST_BENCH_CLAIM_RETRIES", "2"))
 CLAIM_BACKOFF_S = float(os.environ.get("COAST_BENCH_CLAIM_BACKOFF_S", "45"))
+# Default budget fits the slowest claim-like failure (a full init-stage
+# wedge) PLUS at least one backoff+retry cycle: a wedge that takes
+# INIT_TIMEOUT to manifest must not exhaust the budget before the first
+# retry the backoff loop exists to give it.
+CLAIM_TOTAL_S = float(os.environ.get(
+    "COAST_BENCH_CLAIM_TOTAL_S",
+    str(INIT_TIMEOUT + RETRY_TIMEOUT + 2 * CLAIM_BACKOFF_S)))
 # The toy campaign's replica state is KiB-scale, so batch is bounded by
 # dispatch amortization, not HBM: the 2026-08-01 on-chip capture scaled
 # near-linearly 1024 -> 4096 (14k -> 54k inj/s), so the sweep extends
@@ -429,8 +460,10 @@ def main() -> int:
             [("default", INIT_TIMEOUT), ("default", RETRY_TIMEOUT),
              ("cpu", RETRY_TIMEOUT)])
     summary, used = {}, None
+    spawn_wedge = None
     for backend, budget in plan:
         claim_tries = 0
+        claim_t0 = time.monotonic()
         while True:
             t0 = time.time()
             records, error = _attempt(backend, budget)
@@ -444,9 +477,19 @@ def main() -> int:
             # Claim contention on a real-hardware attempt: back off and
             # retry the SAME backend before falling through the plan --
             # the holder (another poller window, a neighbour) typically
-            # releases within a minute.
-            if (backend != "cpu" and error and _claim_like(error)
-                    and claim_tries < CLAIM_RETRIES):
+            # releases within a minute.  Bounded by retries AND total
+            # wall clock; exhausting either yields one explicit
+            # spawn-wedge diagnosis instead of silent fallthrough.
+            if backend != "cpu" and error and _claim_like(error):
+                elapsed = time.monotonic() - claim_t0
+                if claim_tries >= CLAIM_RETRIES or elapsed > CLAIM_TOTAL_S:
+                    spawn_wedge = (
+                        f"{backend} spawn wedged: gave up after "
+                        f"{claim_tries + 1} attempt(s) / {elapsed:.0f}s "
+                        f"(budget {CLAIM_RETRIES + 1} x {CLAIM_TOTAL_S:.0f}s)"
+                        f"; last: {_tail_cap(error, 160)}")
+                    _note(spawn_wedge)
+                    break
                 delay = CLAIM_BACKOFF_S * (2 ** claim_tries)
                 claim_tries += 1
                 _note(f"[{backend}] claim-like failure; backoff {delay:.0f}s "
@@ -457,6 +500,12 @@ def main() -> int:
             break
         if "best" in summary:
             break
+    if spawn_wedge and summary.get("backend") not in (None, "cpu"):
+        # A later attempt DID measure on hardware: the give-up diagnosis
+        # belongs to a transient, not to this record.
+        _note(f"spawn-wedge cleared: a later attempt measured on "
+              f"{summary.get('backend')}")
+        spawn_wedge = None
 
     artifacts_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "artifacts")
@@ -480,6 +529,8 @@ def main() -> int:
             # the one worth keeping) so the artifact's error field stays
             # a summary, never a log dump.
             full["error"] = _tail_cap("; ".join(errors), 900)
+        if spawn_wedge:
+            full["spawn_wedge"] = spawn_wedge
         # One predicate for "this ran on the host": the worker-REPORTED
         # backend, not the attempt label -- a "default" attempt on a
         # TPU-less box silently resolves to CPU and must carry the same
@@ -488,6 +539,14 @@ def main() -> int:
         if on_cpu and not force:
             full["note"] = ("TPU backend unreachable; value measured on the "
                             "CPU fallback backend")
+        # Per-backend trajectory: this round's value is compared against
+        # (and then refreshes) ITS OWN backend's last record, so a
+        # CPU-fallback round never reads as a regression from -- or an
+        # improvement over -- an on-chip number.
+        prev = _load_backend_baseline(summary.get("backend"))
+        if prev and prev.get("value"):
+            full["backend_baseline"] = prev
+            full["vs_backend_baseline"] = round(value / prev["value"], 3)
         if on_cpu:
             # Never let a fallback record silently replace the hardware
             # story: embed the last on-chip measurement alongside it.
@@ -503,6 +562,14 @@ def main() -> int:
             try:
                 os.makedirs(os.path.dirname(LAST_TPU_RECORD), exist_ok=True)
                 with open(LAST_TPU_RECORD, "w") as f:
+                    json.dump({"measured_at": time.strftime("%Y-%m-%d %H:%M"),
+                               "record": full}, f, indent=1)
+            except OSError:
+                pass
+        if summary.get("backend"):
+            try:
+                with open(_backend_record_path(summary["backend"]),
+                          "w") as f:
                     json.dump({"measured_at": time.strftime("%Y-%m-%d %H:%M"),
                                "record": full}, f, indent=1)
             except OSError:
@@ -526,8 +593,12 @@ def main() -> int:
                     frac = c
         if frac is not None:
             line["flagship_fraction_of_peak"] = frac
+        if "vs_backend_baseline" in full:
+            line["vs_backend_baseline"] = full["vs_backend_baseline"]
         if "note" in full:
             line["note"] = full["note"]
+        if spawn_wedge:
+            line["spawn_wedge"] = spawn_wedge
         if errors:
             line["error"] = _tail_cap("; ".join(errors), 300)
         line["artifact"] = "artifacts/bench_full.json"
@@ -541,6 +612,8 @@ def main() -> int:
                  "error": (_tail_cap("; ".join(errors), 900)
                            or "no measurement produced"),
                  "partial": summary or None})
+    if spawn_wedge:
+        line["spawn_wedge"] = spawn_wedge
     print(json.dumps(line))
     for e in errors:
         print(f"# {e}", file=sys.stderr)

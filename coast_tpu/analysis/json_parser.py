@@ -173,6 +173,15 @@ class Summary:
     # ``stop_when`` and for directory aggregates mixing several logs
     # (intervals do not aggregate across campaigns).
     convergence: Optional[Dict[str, object]] = None
+    # Measured host<->device traffic ({"up", "down"} bytes) from the log
+    # summary's ``transfer_bytes`` block; summed over a directory.  None
+    # for logs written before the block existed.
+    transfer: Optional[Dict[str, int]] = None
+    # Collection mode of the underlying log(s): "sparse" when the rows
+    # cover only interesting outcomes (counts come from the summary's
+    # device histogram), None/"dense" otherwise, "mixed" for a directory
+    # aggregating both.
+    collect: Optional[str] = None
 
     @property
     def due(self) -> int:
@@ -261,6 +270,16 @@ class Summary:
                 lines.append(f"  serialize overlap: "
                              f"{100.0 * self.stages['overlap']:.1f}% of "
                              "serialization hidden under dispatch")
+        if self.transfer:
+            # Host<->device traffic alongside the stage seconds it
+            # explains -- the sparse-collect mode's headline number.
+            up = int(self.transfer.get("up", 0))
+            down = int(self.transfer.get("down", 0))
+            mode = f" ({self.collect} collect)" if self.collect else ""
+            lines.append("  --- host transfer ---")
+            lines.append(f"  up   {up:>12} bytes ({up / 1e6:8.2f} MB)"
+                         f"{mode}")
+            lines.append(f"  down {down:>12} bytes ({down / 1e6:8.2f} MB)")
         if self.resilience and any(self.resilience.values()):
             # Surface survived dispatch failures: a campaign that retried
             # or degraded its way to completion should say so in the same
@@ -391,9 +410,45 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     overlaps: List[float] = []
     resilience: Dict[str, int] = {}
     models: set = set()
+    collects: set = set()
+    transfer: Dict[str, int] = {}
     convergences: List[Dict[str, object]] = []
     for doc in docs:
-        if "columns" in doc:                      # vectorised columnar path
+        head = doc.get("summary") or {}
+        if head.get("collect") == "sparse":
+            # Sparse-collect log: the class totals live in the summary
+            # (the device histogram's counts; counts_histogram is the
+            # dict->array bridge); the rows cover ONLY the interesting
+            # outcomes, so they feed the runtime statistic (over
+            # interesting completed runs, class weights applied exactly
+            # as on the dense paths) and the per-section tables, never
+            # the counts.
+            import numpy as np
+            from coast_tpu.inject.classify import counts_histogram
+            binc = counts_histogram(head)
+            for i, cname in enumerate(_CLASSES):
+                counts[cname] += int(binc[i])
+            n += int(head.get("injections", 0))
+            physical += int(head.get("physical_injections",
+                                     head.get("injections", 0)))
+            weighted = weighted or ("physical_injections" in head)
+            if "columns" in doc:
+                codes = np.asarray(doc["columns"]["code"])
+                steps = np.asarray(doc["columns"]["steps"])
+                w = doc["columns"].get("weight")
+                w = (np.asarray(w, np.int64) if w is not None
+                     else np.ones(len(codes), np.int64))
+                completed = _completed_mask(codes)
+                step_sum += int((steps[completed] * w[completed]).sum())
+                step_n += int(w[completed].sum())
+            else:
+                for run in doc.get("runs") or []:
+                    res = run.get("result") or {}
+                    if "core" in res:
+                        rw = int(run.get("weight", 1))
+                        step_sum += int(res.get("runtime", 0)) * rw
+                        step_n += rw
+        elif "columns" in doc:                    # vectorised columnar path
             import numpy as np
             col = doc["columns"]  # type: ignore
             codes = np.asarray(col["code"])
@@ -447,6 +502,9 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         for key, cnt in (summary.get("resilience") or {}).items():
             resilience[key] = resilience.get(key, 0) + int(cnt)
         models.add(summary.get("fault_model") or "single")
+        collects.add(summary.get("collect") or "dense")
+        for key, b in (summary.get("transfer_bytes") or {}).items():
+            transfer[key] = transfer.get(key, 0) + int(b)
         if summary.get("convergence"):
             convergences.append(summary["convergence"])
     if overlaps:
@@ -460,11 +518,19 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
         fault_model = None if only == "single" else only
     elif models:
         fault_model = "mixed"
+    collect = None
+    if len(collects) == 1:
+        only_c = collects.pop()
+        collect = None if only_c == "dense" else only_c
+    elif collects:
+        collect = "mixed"
     return Summary(name=name, n=n, counts=counts, seconds=seconds,
                    mean_steps=mean_steps_or_nan(step_sum, step_n, n, name),
                    stages=stages or None,
                    resilience=resilience or None,
                    fault_model=fault_model,
+                   transfer=transfer or None,
+                   collect=collect,
                    physical_n=physical if weighted else None,
                    # Wilson intervals describe ONE campaign's sample;
                    # a directory mixing several logs has no aggregate
@@ -490,6 +556,10 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
                 # Equivalence-reduced log: rows carry class weights the
                 # native classifier does not apply -- Python path.
                 return None
+            if head["summary"].get("collect") == "sparse":
+                # Sparse log: the rows are only the interesting subset;
+                # counts come from the summary histogram (Python path).
+                return None
             try:
                 got = native.ndjson_classify_stream(f.read)
             except ValueError:
@@ -507,6 +577,7 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             stages=head["summary"].get("stages") or None,
             resilience=head["summary"].get("resilience") or None,
             fault_model=head["summary"].get("fault_model") or None,
+            transfer=head["summary"].get("transfer_bytes") or None,
             convergence=head["summary"].get("convergence") or None)
     except OSError:
         return None

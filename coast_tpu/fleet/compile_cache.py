@@ -120,6 +120,11 @@ class CompileCache:
             "fault_model": cs.fault_model,
             "equiv": cs.equiv,
             "unroll": cs.unroll,
+            # Collection mode compiles a different batch program (the
+            # sparse path's generation + compaction) AND fixes the
+            # runner's collect at construction: a warm hit must never
+            # serve a runner in the other mode.
+            "collect": cs.collect,
             "mesh": self._mesh_geometry(mesh),
             "jax": jax.__version__,
             "backend": jax.default_backend(),
@@ -186,6 +191,7 @@ class CompileCache:
                 unroll=cs.unroll,
                 fault_model=cs.fault_model_parsed(),
                 equiv=cs.equiv,
+                collect=cs.collect,
                 mesh=mesh, retry=retry)
             self._runners[key] = (runner, strategy)
         runner.metrics = metrics

@@ -83,7 +83,8 @@ def item_spec(benchmark: str, n: int, seed: int = 0,
               fault_model: str = "single", equiv: bool = False,
               stop_when: Optional[str] = None, unroll: int = 1,
               throttle_s: float = 0.0,
-              delta_from: Optional[str] = None) -> Dict[str, object]:
+              delta_from: Optional[str] = None,
+              collect: str = "dense") -> Dict[str, object]:
     """One queued campaign, serialized through the shared
     :class:`~coast_tpu.inject.spec.CampaignSpec` identity vocabulary
     (``to_item`` is bit-compatible with this function's historical
@@ -102,7 +103,8 @@ def item_spec(benchmark: str, n: int, seed: int = 0,
         benchmark=benchmark, n=n, seed=seed, opt_passes=opt_passes,
         section=section, batch_size=batch_size, start_num=start_num,
         fault_model=fault_model, equiv=equiv, stop_when=stop_when,
-        unroll=unroll, throttle_s=throttle_s, delta_from=delta_from)
+        unroll=unroll, throttle_s=throttle_s, delta_from=delta_from,
+        collect=collect)
     try:
         spec.validate()
     except SpecError as e:

@@ -193,6 +193,13 @@ def parse_command_line(argv: Optional[List[str]] = None):
                    "is convergence-bounded on its own (the CI work "
                    "unit)")
     p.add_argument("--unroll", type=int, default=1)
+    p.add_argument("--collect", default="dense",
+                   choices=["dense", "sparse"],
+                   help="result-collection mode for the item's worker: "
+                   "'sparse' keeps the campaign loop device-resident "
+                   "(on-device flip generation + histogram accounting, "
+                   "only interesting rows fetched); counts identical, "
+                   "journal records sparse-shaped")
     p.add_argument("--throttle", type=float, default=0.0, metavar="S",
                    help="sleep S seconds per collected batch (operator "
                    "rate limit)")
@@ -292,7 +299,8 @@ def cmd_enqueue(args) -> int:
                            equiv=args.equiv or bool(args.delta_from),
                            stop_when=args.stop_when,
                            unroll=args.unroll, throttle_s=args.throttle,
-                           delta_from=args.delta_from)
+                           delta_from=args.delta_from,
+                           collect=args.collect)
                  for i in range(max(1, args.count))]
     except (QueueError, ValueError) as e:
         print(f"Error, bad item spec: {e}", file=sys.stderr)

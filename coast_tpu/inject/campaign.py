@@ -90,6 +90,20 @@ class CampaignResult:
     # ``done_n`` of ``planned_n`` effective injections).  None unless
     # the campaign ran with ``stop_when=``.
     convergence: Optional[Dict[str, object]] = None
+    # Collection mode (CampaignRunner(collect=)): "dense" fetches every
+    # row's outcome columns (the historical behavior; codes/errors/
+    # corrected/steps cover all n rows), "sparse" keeps the loop
+    # device-resident -- counts come from per-batch histograms and the
+    # per-run columns cover only the INTERESTING rows (class outside
+    # success/corrected), indexed by ``interesting_rows``.
+    collect: str = "dense"
+    # Sparse campaigns: schedule-local row index (int64) of each entry
+    # of codes/errors/corrected/steps.  None in dense mode.
+    interesting_rows: Optional[np.ndarray] = None
+    # Measured host<->device traffic in bytes ({"up", "down"}), recorded
+    # on every campaign the runner executes -- the quantity the sparse
+    # mode exists to shrink.  Empty for results rebuilt from journals.
+    transfer: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def injections_per_sec(self) -> float:
@@ -140,6 +154,17 @@ class CampaignResult:
             "seed": self.seed,
             "stages": stages,
         }
+        if self.transfer:
+            # Host<->device traffic, alongside the stage seconds it
+            # explains.  Volatile-classed like ``stages`` (a telemetry
+            # block, not campaign identity).
+            out["transfer_bytes"] = {
+                "up": int(self.transfer.get("up", 0)),
+                "down": int(self.transfer.get("down", 0))}
+        if self.collect != "dense":
+            # Absent-means-dense: dense log summaries stay byte-stable.
+            out["collect"] = self.collect
+            out["interesting_rows"] = int(len(self.codes))
         # The fault-model axis of the logs: only non-single models add the
         # key, so single-bit campaign logs stay byte-identical to every
         # log written before the model existed.
@@ -161,6 +186,131 @@ class CampaignResult:
         if self.resilience:
             out["resilience"] = dict(self.resilience)
         return out
+
+
+def _pack_layout(out_words: int, max_steps: int) -> tuple:
+    """Bit layout of the sparse interesting-row packed word: code(4) |
+    errors(e) | corrected(f) | steps(t), summing to exactly 32.
+
+    ``steps`` is hard-bounded by the watchdog (<= max_steps) and
+    ``errors`` by the output size for non-invalid runs, so both fields
+    are sized to always fit; ``corrected`` takes the remainder with its
+    all-ones value reserved as the NOT-PACKABLE sentinel (garbage E on
+    an invalid run, an overflowing correction count) -- sentinel rows
+    ride the exact int32 side buffer instead.  Returns (e_bits, f_bits,
+    t_bits)."""
+    t_bits = min(max(int(max_steps).bit_length(), 1), 20)
+    e_bits = min(max(int(out_words + 1).bit_length(), 1), 27 - t_bits)
+    f_bits = 28 - e_bits - t_bits
+    return e_bits, f_bits, t_bits
+
+
+def _sparse_device_outputs(out: Dict[str, jax.Array], count_w: jax.Array,
+                           valid: jax.Array, cap: int, pack: tuple
+                           ) -> Dict[str, jax.Array]:
+    """Device-side sparse accounting over one (shard of a) batch: the
+    weighted class histogram, the interesting-row bitmask, and the
+    fixed-capacity compaction buffers.  Shared by the single-device
+    runner and the shard_map body of the sharded backend (the histogram
+    is psum-able; everything else is shard-local).
+
+    Returns hist[NUM_CLASSES] i32, n_int/n_exact i32 scalars,
+    mask u32[ceil(B/32)], packed u32[cap+1], exact i32[cap+1, 3].
+    Buffer slot ``cap`` is the shared overflow sink (dropped on fetch);
+    correctness under overflow comes from the caller's dense fallback.
+    """
+    e_bits, f_bits, t_bits = pack
+    sentinel = (1 << f_bits) - 1
+    code = out["code"]
+    err, cor, steps = out["errors"], out["corrected"], out["steps"]
+    hist = jnp.sum(jax.nn.one_hot(code, cls.NUM_CLASSES, dtype=jnp.int32)
+                   * count_w[:, None], axis=0)
+    interesting = jnp.logical_and(valid, code > cls.CORRECTED)
+    n_int = jnp.sum(interesting.astype(jnp.int32))
+    packable = ((err >= 0) & (err < (1 << e_bits))
+                & (cor >= 0) & (cor < sentinel)
+                & (steps >= 0) & (steps < (1 << t_bits)))
+    cu = code.astype(jnp.uint32) & jnp.uint32(15)
+    word = (cu
+            | ((err.astype(jnp.uint32) & jnp.uint32((1 << e_bits) - 1))
+               << 4)
+            | ((cor.astype(jnp.uint32) & jnp.uint32(sentinel))
+               << (4 + e_bits))
+            | ((steps.astype(jnp.uint32) & jnp.uint32((1 << t_bits) - 1))
+               << (4 + e_bits + f_bits)))
+    packed_word = jnp.where(
+        packable, word, cu | jnp.uint32(sentinel << (4 + e_bits)))
+    exact_sel = jnp.logical_and(interesting, jnp.logical_not(packable))
+    n_exact = jnp.sum(exact_sel.astype(jnp.int32))
+    # Bitmask: bit k of word w marks row w*32+k interesting -- the
+    # host derives row numbers from it, so no index column crosses the
+    # link.
+    n = code.shape[0]
+    n_words = (n + 31) // 32
+    bits = jnp.pad(interesting, (0, n_words * 32 - n)).reshape(n_words, 32)
+    mask = jnp.sum(bits.astype(jnp.uint32)
+                   << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1)
+    # Stream compaction into the fixed buffers: position = running
+    # count of interesting rows so far, clamped to the overflow sink.
+    idx = jnp.cumsum(interesting.astype(jnp.int32)) - 1
+    pos = jnp.where(jnp.logical_and(interesting, idx < cap), idx, cap)
+    packed = jnp.zeros(cap + 1, jnp.uint32).at[pos].set(packed_word)
+    eidx = jnp.cumsum(exact_sel.astype(jnp.int32)) - 1
+    epos = jnp.where(jnp.logical_and(exact_sel, eidx < cap), eidx, cap)
+    exact = jnp.zeros((cap + 1, 3), jnp.int32).at[epos].set(
+        jnp.stack([err, cor, steps], axis=1))
+    return {"hist": hist, "n_int": n_int, "n_exact": n_exact,
+            "mask": mask, "packed": packed, "exact": exact}
+
+
+def _mask_rows(mask: np.ndarray, limit: int) -> np.ndarray:
+    """Interesting-row positions encoded in a device bitmask (host
+    side): bit k of word w -> row w*32+k, clipped to ``limit``."""
+    bits = ((mask[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+            ).astype(bool).ravel()
+    rows = np.flatnonzero(bits[:limit])
+    return rows
+
+
+def _unpack_rows(packed: np.ndarray, exact: np.ndarray, pack: tuple):
+    """Packed interesting-row words -> exact (code, E, F, T) int32
+    columns; sentinel rows (corrected-field all ones) take their E/F/T
+    from the exact side buffer, in order."""
+    e_bits, f_bits, t_bits = pack
+    sentinel = (1 << f_bits) - 1
+    code = (packed & 15).astype(np.int32)
+    err = ((packed >> 4) & ((1 << e_bits) - 1)).astype(np.int32)
+    cor = ((packed >> (4 + e_bits)) & sentinel).astype(np.int32)
+    steps = (packed >> (4 + e_bits + f_bits)).astype(np.int32)
+    is_sent = cor == sentinel
+    n_sent = int(is_sent.sum())
+    if n_sent:
+        if len(exact) < n_sent:
+            raise RuntimeError(
+                "sparse collect: sentinel rows exceed the exact "
+                "buffer prefix (device/host accounting diverged)")
+        err[is_sent] = exact[:n_sent, 0]
+        cor[is_sent] = exact[:n_sent, 1]
+        steps[is_sent] = exact[:n_sent, 2]
+    return code, err, cor, steps
+
+
+def _rows_subset(sched: FaultSchedule, rows: np.ndarray) -> FaultSchedule:
+    """Arbitrary-row BASE-SITE subset of ``sched`` (model preserved,
+    extra flip-group rows dropped -- per-row serialization only ever
+    records the base site, exactly as in dense logs).  The one subset
+    builder behind the delta paths' working shape
+    (:meth:`CampaignRunner._take_rows`) and the sparse log writers'
+    interesting-row slices."""
+    idx = np.asarray(rows, np.int64)
+    return FaultSchedule(
+        *(np.ascontiguousarray(np.asarray(getattr(sched, f))[idx])
+          for f in ("leaf_id", "lane", "word", "bit", "t",
+                    "section_idx")),
+        seed=sched.seed, model=sched.model,
+        class_weight=(sched.class_weight[idx]
+                      if sched.class_weight is not None else None),
+        equiv_sha=sched.equiv_sha)
 
 
 class CampaignRunner:
@@ -189,7 +339,9 @@ class CampaignRunner:
                  mesh: "Optional[object]" = None,
                  fault_model: "Optional[FaultModel]" = None,
                  equiv: "bool | object" = False,
-                 metrics: "Optional[object]" = None):
+                 metrics: "Optional[object]" = None,
+                 collect: str = "dense",
+                 sparse_capacity: "Optional[int]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -258,7 +410,30 @@ class CampaignRunner:
         stage totals, resilience counters, device-memory watermark), so
         a metrics server (:mod:`coast_tpu.obs.serve`), a status-file
         export, or a live console can observe the campaign while it
-        runs.  None (the default) records nothing."""
+        runs.  None (the default) records nothing.
+
+        ``collect`` selects the result-collection mode.  ``"dense"``
+        (default, byte-identical to the historical behavior) uploads
+        per-batch fault arrays and fetches every row's outcome columns.
+        ``"sparse"`` keeps the inner loop device-resident: seeded
+        schedules regenerate their flip sites inside the compiled step
+        (:mod:`coast_tpu.inject.device_gen`; bit-parity with the host
+        schedule pinned per fault-model kind), per-batch accounting is
+        a 10-int class histogram computed on device, and only the
+        compacted INTERESTING rows (class outside success/corrected)
+        cross the host boundary -- host traffic becomes O(interesting
+        outcomes) in both directions.  Classification counts and the
+        interesting-row set are identical to dense at the same
+        schedule; ``CampaignResult.codes`` then covers only those rows
+        (``interesting_rows`` carries their schedule-local indices).
+        Collection mode is campaign identity: it joins the journal
+        header (absent-means-dense) and resuming a sparse journal under
+        dense -- or vice versa -- refuses.
+
+        ``sparse_capacity`` bounds the on-device interesting-row buffer
+        per batch (default ``max(256, batch_size // 4)``).  Correctness
+        never depends on it: a batch whose interesting rows overflow
+        the buffer falls back to a dense fetch for that batch."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -272,6 +447,16 @@ class CampaignRunner:
         self.metrics = metrics
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
+        if collect not in ("dense", "sparse"):
+            raise ValueError(
+                f"unknown collect mode {collect!r}; one of 'dense', "
+                "'sparse'")
+        self.collect = collect
+        self._sparse_capacity = (int(sparse_capacity)
+                                 if sparse_capacity else None)
+        self._sparse_jits: Dict[object, object] = {}
+        self._device_gen = None
+        self._pack_bits: Optional[tuple] = None
         # Training regions (Region.train_probe) report the train outcome
         # classes; every other region keeps the pre-training counts key
         # set (classify.counts_dict absent-means-zero rule).
@@ -298,6 +483,7 @@ class CampaignRunner:
         self.unroll = max(1, int(unroll))
         out_words = int(np.prod(jax.eval_shape(
             prog.region.output, jax.eval_shape(prog.region.init)).shape))
+        self._out_words = out_words
 
         def run_one(fault: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
             rec = prog.run(fault, unroll=self.unroll)
@@ -340,6 +526,229 @@ class CampaignRunner:
     def _collect(pending) -> Dict[str, np.ndarray]:
         """Block on a dispatched batch and fetch it to the host."""
         return jax.device_get(pending)
+
+    # -- sparse (device-resident) collection ---------------------------------
+    def _sparse_shards(self) -> int:
+        """Leading buffer axis of the sparse outputs: 1 here; the
+        sharded backend returns its device count (per-shard buffers)."""
+        return 1
+
+    def _sparse_pack(self) -> tuple:
+        if self._pack_bits is None:
+            self._pack_bits = _pack_layout(self._out_words,
+                                           self.prog.region.max_steps)
+        return self._pack_bits
+
+    def _sparse_cap(self, batch_size: int) -> int:
+        """Per-shard interesting-row buffer capacity (ceil-divided over
+        shards, clamped to the per-shard row count)."""
+        shards = self._sparse_shards()
+        per = max(1, batch_size // shards)
+        cap = int(self._sparse_capacity or max(256, batch_size // 4))
+        return max(1, min(-(-cap // shards), per))
+
+    def _make_sparse_fn(self, batch_size: int, mode: str, cap: int,
+                        gen) -> "Callable":
+        """Compile the sparse batch program.  ``mode`` is ``"gen"``
+        (flip sites regenerated on device from scalar inputs) or
+        ``"resident"`` (fault columns arrive as device arrays -- the
+        already-uploaded resident schedule's slices).  Outputs carry a
+        leading per-shard axis (length 1 here) so the host extraction
+        is shared with the sharded backend."""
+        pack = self._sparse_pack()
+        run_one = self._run_one
+
+        def _wrap(o, out):
+            o = {k: (v if k == "hist" else v[None])
+                 for k, v in o.items()}
+            o["full"] = out
+            return o
+
+        if mode == "gen":
+            def fn(seed_hi, seed_lo, stream_n, offset, n_valid):
+                rows = offset + jnp.arange(batch_size, dtype=jnp.uint32)
+                fault = gen.columns((seed_hi, seed_lo), stream_n, rows)
+                out = jax.vmap(run_one)(fault)
+                valid = jnp.arange(batch_size, dtype=jnp.int32) < n_valid
+                o = _sparse_device_outputs(out, valid.astype(jnp.int32),
+                                           valid, cap, pack)
+                return _wrap(o, out)
+        else:
+            def fn(fault, count_w, n_valid):
+                out = jax.vmap(run_one)(fault)
+                valid = jnp.arange(batch_size, dtype=jnp.int32) < n_valid
+                o = _sparse_device_outputs(out, count_w, valid, cap, pack)
+                return _wrap(o, out)
+        return jax.jit(fn)
+
+    def _sparse_setup(self, sched: FaultSchedule, batch_size: int,
+                      transfer: Dict[str, int]) -> Dict[str, object]:
+        """Per-run_schedule sparse state: the compiled batch program and
+        its per-batch inputs.  Seeded single-stream schedules take the
+        GENERATED path (zero per-batch upload; the device regenerates
+        the host schedule bit for bit); everything else -- equivalence
+        reductions, strata, cache overlays, merged chunks -- uploads the
+        schedule to the device ONCE and slices it there (the
+        device-RESIDENT path)."""
+        from coast_tpu.inject.device_gen import (DeviceGenError,
+                                                 DeviceScheduleGen)
+        shards = self._sparse_shards()
+        cap = self._sparse_cap(batch_size)
+        state: Dict[str, object] = {
+            "cap": cap, "shards": shards,
+            "per_shard": max(1, batch_size // shards),
+            "batch_size": batch_size,
+        }
+        # The gen path regenerates (site, t) from (seed, stream length,
+        # step modulus): all three must come from the SCHEDULE's own
+        # recorded generation metadata -- a schedule generated with a
+        # different step window than the region's nominal one must not
+        # be silently regenerated mod the wrong value.
+        gen_ok = (sched.gen_stream_n is not None
+                  and sched.gen_steps is not None
+                  and sched.class_weight is None)
+        if gen_ok:
+            try:
+                key = ("gen", batch_size, cap, sched.model.spec(),
+                       int(sched.gen_steps))
+                if key not in self._sparse_jits:
+                    gen = DeviceScheduleGen(
+                        self.mmap, sched.gen_steps, sched.model)
+                    self._sparse_jits[key] = self._make_sparse_fn(
+                        batch_size, "gen", cap, gen)
+                seed = int(sched.seed) & 0xFFFFFFFFFFFFFFFF
+                state.update({
+                    "mode": "gen", "fn": self._sparse_jits[key],
+                    "seed_hi": np.uint32(seed >> 32),
+                    "seed_lo": np.uint32(seed & 0xFFFFFFFF),
+                    "stream_n": np.uint32(sched.gen_stream_n),
+                    "gen_lo": int(sched.gen_lo),
+                })
+                return state
+            except DeviceGenError:
+                pass            # address space too large: resident path
+        key = ("resident", batch_size, cap,
+               sched.sites if sched.extra is not None else 1)
+        if key not in self._sparse_jits:
+            self._sparse_jits[key] = self._make_sparse_fn(
+                batch_size, "resident", cap, None)
+        n = len(sched)
+        # Headroom for ANY batch start < n, not just 0-aligned ones: an
+        # OOM degrade mid-campaign restarts at the first uncollected
+        # row, which need not be a multiple of the new batch size, and
+        # the compiled program's shapes are fixed at batch_size.
+        padded = n + batch_size
+        pad = padded - n
+        arrays = {}
+        for k, v in sched.device_arrays().items():
+            v = np.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1),
+                       mode="edge") if pad else np.asarray(v)
+            arrays[k] = jnp.asarray(v)
+            transfer["up"] += int(arrays[k].nbytes)
+        if sched.class_weight is not None:
+            w = sched.class_weight.astype(np.int64)
+            # The device histogram accumulates these weights in int32
+            # (and psums int32 across shards): bound the worst-case
+            # PER-BATCH weight sum -- alignment-independently (an OOM
+            # degrade can restart batches at any offset), so bound the
+            # sum of the batch_size LARGEST weights.  Two 2^30 weights
+            # of one class in one batch would wrap negative where the
+            # dense path's float64 bincount stays exact.
+            top = (np.partition(w, n - batch_size)[n - batch_size:]
+                   if n > batch_size else w)
+            if n and int(top.sum()) >= 2 ** 31:
+                raise ValueError(
+                    "sparse collect: a batch's summed class weights "
+                    f"(worst case {int(top.sum())}) could exceed the "
+                    "device histogram's int32 range; run this campaign "
+                    "dense (or with a smaller batch_size)")
+        else:
+            w = np.ones(n, np.int64)
+        w = np.where(np.asarray(sched.t) < 0, 0, w).astype(np.int32)
+        count_w = jnp.asarray(np.pad(w, (0, pad)))
+        transfer["up"] += int(count_w.nbytes)
+        state.update({"mode": "resident", "fn": self._sparse_jits[key],
+                      "arrays": arrays, "count_w": count_w})
+        return state
+
+    @staticmethod
+    def _sparse_args(state: Dict[str, object], lo: int, n_part: int,
+                     transfer: Dict[str, int]) -> tuple:
+        """Per-batch inputs for the sparse program -- the whole up-link
+        payload (scalars on the generated path; the resident path's
+        slices are device-side views, no transfer)."""
+        if state["mode"] == "gen":
+            transfer["up"] += 20        # 4 u32/i32 scalars + offset
+            return (state["seed_hi"], state["seed_lo"],
+                    state["stream_n"],
+                    np.uint32(int(state["gen_lo"]) + lo),
+                    np.int32(n_part))
+        transfer["up"] += 4             # n_valid scalar
+        b = int(state["batch_size"])
+        fault = {k: v[lo:lo + b] for k, v in state["arrays"].items()}
+        return (fault, state["count_w"][lo:lo + b], np.int32(n_part))
+
+    def _sparse_extract(self, state: Dict[str, object], pending,
+                        head: Dict[str, np.ndarray], n_part: int,
+                        transfer: Dict[str, int]) -> Dict[str, object]:
+        """Host-side merge of one sparse batch: rows from the bitmask,
+        columns from the packed words (+ exact sentinel side-buffer);
+        a shard whose interesting rows overflowed its buffer falls the
+        whole batch back to a dense fetch.  Returns the sparse batch
+        out dict (hist int64[10], batch-local rows, int32 columns)."""
+        pack = self._sparse_pack()
+        cap, per = int(state["cap"]), int(state["per_shard"])
+        hist = np.asarray(head["hist"], np.int64)
+        n_int = np.atleast_1d(np.asarray(head["n_int"]))
+        n_exact = np.atleast_1d(np.asarray(head["n_exact"]))
+        # Device-side sizes: the histogram is int32 on the wire.
+        transfer["down"] += cls.NUM_CLASSES * 4 + int(len(n_int) * 8)
+        if (n_int > cap).any() or (n_exact > cap).any():
+            # Capacity overflow: correctness never depends on the cap.
+            self.telemetry.count("sparse_overflow_fallback",
+                                 rows=int(n_int.sum()))
+            full = jax.device_get(pending["full"])
+            transfer["down"] += sum(int(v.nbytes) for v in full.values())
+            code = np.asarray(full["code"])
+            valid = np.arange(len(code)) < n_part
+            rows = np.flatnonzero(valid & (code > cls.CORRECTED))
+            return {"hist": hist, "rows": rows.astype(np.int64),
+                    "code": code[rows].astype(np.int32),
+                    "errors": np.asarray(full["errors"])[rows],
+                    "corrected": np.asarray(full["corrected"])[rows],
+                    "steps": np.asarray(full["steps"])[rows]}
+        rows_parts, col_parts = [], {"code": [], "errors": [],
+                                     "corrected": [], "steps": []}
+        for s in range(len(n_int)):
+            mask_np = np.asarray(pending["mask"][s])
+            transfer["down"] += int(mask_np.nbytes)
+            k, ke = int(n_int[s]), int(n_exact[s])
+            if not k:
+                continue
+            packed = np.asarray(pending["packed"][s, :k])
+            exact = (np.asarray(pending["exact"][s, :ke])
+                     if ke else np.zeros((0, 3), np.int32))
+            transfer["down"] += 4 * k + 12 * ke
+            code, err, cor, steps = _unpack_rows(packed, exact, pack)
+            rows_s = _mask_rows(mask_np, per)
+            if len(rows_s) != k:
+                raise RuntimeError(
+                    f"sparse collect: bitmask names {len(rows_s)} "
+                    f"interesting rows but the device counted {k}")
+            rows_parts.append(rows_s.astype(np.int64) + s * per)
+            col_parts["code"].append(code)
+            col_parts["errors"].append(err)
+            col_parts["corrected"].append(cor)
+            col_parts["steps"].append(steps)
+        if rows_parts:
+            out = {"rows": np.concatenate(rows_parts),
+                   **{k: np.concatenate(v)
+                      for k, v in col_parts.items()}}
+        else:
+            out = {"rows": np.zeros(0, np.int64),
+                   **{k: np.zeros(0, np.int32) for k in col_parts}}
+        out["hist"] = hist
+        return out
 
     # -- execution ----------------------------------------------------------
     def run_schedule(self, sched: FaultSchedule,
@@ -451,6 +860,18 @@ class CampaignRunner:
                     "condition is part of the campaign's identity -- "
                     "rerun with the original --stop-when (or a fresh "
                     "journal)")
+            # Collection mode = campaign identity too (absent-means-
+            # dense): a sparse journal's batch records carry histograms
+            # + interesting rows, which a dense replay cannot expand,
+            # and vice versa.
+            from coast_tpu.inject.spec import header_collect
+            header_mode = header_collect(journal.header)
+            if header_mode != self.collect:
+                raise JournalMismatchError(
+                    f"journal {journal.path!r} records collect="
+                    f"{header_mode!r} but this runner collects "
+                    f"{self.collect!r}; rerun with the original "
+                    "--collect (or a fresh journal)")
         retry = self.retry
         metrics = self.metrics
         tracker = None
@@ -474,6 +895,24 @@ class CampaignRunner:
             if retry is not None else {})
         sched_t = np.asarray(sched.t)
         sched_w = getattr(sched, "class_weight", None)
+        # Host<->device traffic ledger ({"up","down"} bytes), recorded on
+        # every campaign -- the quantity sparse collection shrinks.
+        transfer: Dict[str, int] = {"up": 0, "down": 0}
+        sparse_state: Optional[Dict[str, object]] = None
+        if self.collect == "sparse":
+            with tel.span("sparse_setup"):
+                sparse_state = self._sparse_setup(sched, batch_size,
+                                                  transfer)
+
+        def _batch_invalid(lo: int, n: int) -> int:
+            """Weighted never-fired (t < 0) draws of batch rows
+            [lo, lo+n): host-side, from the schedule -- the sparse
+            path's cache_invalid source (on device those rows classify
+            success and carry zero count weight)."""
+            inv = sched_t[lo:lo + n] < 0
+            if sched_w is None:
+                return int(inv.sum())
+            return int(sched_w[lo:lo + n][inv].sum())
 
         def _account(out: Dict[str, np.ndarray], lo: int) -> Dict[str, int]:
             """Cumulative class histogram over the rows fetched so far
@@ -492,6 +931,17 @@ class CampaignRunner:
                 live_counts[:] += cls.weighted_histogram(
                     out["code"][fired], w[fired])
                 live_invalid += int(w[~fired].sum())
+            counts_so_far = cls.counts_dict(live_counts, self._train)
+            counts_so_far["cache_invalid"] = live_invalid
+            return counts_so_far
+
+        def _account_sparse(out: Dict[str, object]) -> Dict[str, int]:
+            """Sparse counterpart of _account: the device already
+            histogrammed the batch (weighted, never-fired rows at zero
+            weight); the host just accumulates 10 ints."""
+            nonlocal live_invalid
+            live_counts[:] += np.asarray(out["hist"], np.int64)
+            live_invalid += int(out["invalid"])
             counts_so_far = cls.counts_dict(live_counts, self._train)
             counts_so_far["cache_invalid"] = live_invalid
             return counts_so_far
@@ -519,22 +969,46 @@ class CampaignRunner:
         stopped = False
         if journal is not None:
             for rec in journal.batch_prefix(journal_base, len(sched)):
-                out = {k: np.asarray(rec[src], dtype=np.int32)
-                       for k, src in (("code", "codes"), ("errors", "errors"),
-                                      ("corrected", "corrected"),
-                                      ("steps", "steps"))}
-                outs.append(out)
-                counts_so_far = _account(out, done)
-                n_batch = len(out["code"])
-                if stream is not None:
-                    # A journaled batch is also a serialized batch: the
-                    # replayed columns flow through the stream writer
-                    # from disk, so the resumed stream file is the
-                    # uninterrupted run's -- no re-dispatch, and the
-                    # device loop below only serializes what it runs.
-                    stream.feed(journal_base + done,
-                                sched.slice(done, done + n_batch),
-                                out)
+                if rec.get("sparse"):
+                    # Sparse batch record: histogram + interesting rows
+                    # (absolute numbers -> schedule-local).
+                    out = {
+                        "hist": np.asarray(rec["hist"], np.int64),
+                        "invalid": int(rec.get("invalid", 0)),
+                        "rows": (np.asarray(rec["rows"], np.int64)
+                                 - journal_base),
+                        **{k: np.asarray(rec[src], np.int32)
+                           for k, src in (("code", "codes"),
+                                          ("errors", "errors"),
+                                          ("corrected", "corrected"),
+                                          ("steps", "steps"))}}
+                    outs.append(out)
+                    counts_so_far = _account_sparse(out)
+                    n_batch = int(rec["n"])
+                    if stream is not None:
+                        stream.feed_sparse(
+                            journal_base + out["rows"],
+                            _rows_subset(sched, out["rows"]),
+                            out)
+                else:
+                    out = {k: np.asarray(rec[src], dtype=np.int32)
+                           for k, src in (("code", "codes"),
+                                          ("errors", "errors"),
+                                          ("corrected", "corrected"),
+                                          ("steps", "steps"))}
+                    outs.append(out)
+                    counts_so_far = _account(out, done)
+                    n_batch = len(out["code"])
+                    if stream is not None:
+                        # A journaled batch is also a serialized batch:
+                        # the replayed columns flow through the stream
+                        # writer from disk, so the resumed stream file
+                        # is the uninterrupted run's -- no re-dispatch,
+                        # and the device loop below only serializes
+                        # what it runs.
+                        stream.feed(journal_base + done,
+                                    sched.slice(done, done + n_batch),
+                                    out)
                 done += n_batch
                 # Re-materialise the batch's recorded span timing
                 # (marked as replayed) at its original wall-clock
@@ -551,7 +1025,8 @@ class CampaignRunner:
                 if metrics is not None:
                     metrics.record_batch(done, n_batch, counts_so_far,
                                          tel.stage_totals(since=mark),
-                                         resilience, replayed=True)
+                                         resilience, replayed=True,
+                                         transfer=transfer)
                 if progress is not None:
                     progress(done, counts_so_far)
             if done:
@@ -596,34 +1071,57 @@ class CampaignRunner:
             the cumulative counts (the convergence tracker's input)."""
             nonlocal done
             n_part = flight["n"]
-            out = {k: v[:n_part] for k, v in got.items()}
+            spans = [(name, round(tel.epoch + (t0 - tel.origin), 6),
+                      round(t1 - t0, 6))
+                     for name, t0, t1 in flight.get("spans") or []]
+            if sparse_state is not None:
+                out = got
+                out["invalid"] = _batch_invalid(flight["lo"], n_part)
+                # Batch-local -> schedule-local row numbers.
+                out["rows"] = out["rows"] + int(flight["lo"])
+                counts_so_far = _account_sparse(out)
+                done += n_part
+                if journal is not None:
+                    journal.append_batch_sparse(
+                        journal_base + flight["lo"], n_part,
+                        out["hist"], out["invalid"],
+                        journal_base + out["rows"],
+                        {"code": out["code"], "errors": out["errors"],
+                         "corrected": out["corrected"],
+                         "steps": out["steps"]},
+                        counts_so_far, tel.stage_totals(since=mark),
+                        spans=spans)
+                if stream is not None:
+                    stream.feed_sparse(journal_base + out["rows"],
+                                       _rows_subset(sched, out["rows"]),
+                                       out)
+            else:
+                out = {k: v[:n_part] for k, v in got.items()}
+                counts_so_far = _account(out, done)
+                done += n_part
+                if journal is not None:
+                    # Batch records carry this batch's span timing as
+                    # (name, unix_start, duration) triples, so a resumed
+                    # campaign can re-materialise the crashed run's
+                    # timeline into one coherent trace.
+                    journal.append_batch(
+                        journal_base + flight["lo"], out, counts_so_far,
+                        tel.stage_totals(since=mark), spans=spans)
+                if stream is not None:
+                    # Hand the batch to the background serializer right
+                    # after it is durable: the encode overlaps the next
+                    # dispatch, and a feed stall (writer behind) is
+                    # billed as the stream's non-overlapped serialize
+                    # cost, not dispatch.
+                    stream.feed(journal_base + flight["lo"],
+                                sched.slice(flight["lo"],
+                                            flight["lo"] + n_part),
+                                out)
             outs.append(out)
-            counts_so_far = _account(out, done)
-            done += n_part
-            if journal is not None:
-                # Batch records carry this batch's span timing as
-                # (name, unix_start, duration) triples, so a resumed
-                # campaign can re-materialise the crashed run's
-                # timeline into one coherent trace.
-                journal.append_batch(
-                    journal_base + flight["lo"], out, counts_so_far,
-                    tel.stage_totals(since=mark),
-                    spans=[(name, round(tel.epoch + (t0 - tel.origin), 6),
-                            round(t1 - t0, 6))
-                           for name, t0, t1 in flight.get("spans") or []])
-            if stream is not None:
-                # Hand the batch to the background serializer right after
-                # it is durable: the encode overlaps the next dispatch,
-                # and a feed stall (writer behind) is billed as the
-                # stream's non-overlapped serialize cost, not dispatch.
-                stream.feed(journal_base + flight["lo"],
-                            sched.slice(flight["lo"],
-                                        flight["lo"] + n_part),
-                            out)
             if metrics is not None:
                 metrics.record_batch(done, n_part, counts_so_far,
                                      tel.stage_totals(since=mark),
-                                     resilience)
+                                     resilience, transfer=transfer)
             if progress is not None:
                 progress(done, counts_so_far)
             return counts_so_far
@@ -631,7 +1129,32 @@ class CampaignRunner:
         def _collect_flight(flight: Dict[str, object]):
             """Block on one batch, watchdog-guarded when armed.  This is
             the only collect-side work inside the retry loop -- it is
-            idempotent (a re-dispatch replays the same seeded rows)."""
+            idempotent (a re-dispatch replays the same seeded rows).
+
+            Sparse mode blocks on the batch's accounting head (the
+            10-int histogram + buffer fill counts) and then fetches
+            only the interesting-row buffers -- or, on capacity
+            overflow, that batch's dense columns."""
+            if sparse_state is not None:
+                pending = flight["pending"]
+
+                def fetch():
+                    # The WHOLE sparse fetch -- head, buffers, and the
+                    # overflow fallback's dense columns -- runs under
+                    # the watchdog: a link that wedges after the head
+                    # must still trip the re-dispatch path, exactly as
+                    # a dense fetch would.  (A retried fetch re-counts
+                    # its transfer bytes: the traffic really was
+                    # re-attempted.)
+                    head = jax.device_get(
+                        {k: pending[k]
+                         for k in ("hist", "n_int", "n_exact")})
+                    return self._sparse_extract(
+                        sparse_state, pending, head, flight["n"],
+                        transfer)
+            else:
+                def fetch():
+                    return self._collect(flight["pending"])
             with tel.span("collect", n=flight["n"]):
                 if retry is not None and retry.collect_timeout:
                     # Ambient activation so the watchdog's own obs
@@ -640,26 +1163,47 @@ class CampaignRunner:
                     # campaign's recorder, not the no-op default.
                     with tel.activate():
                         got = resilience_mod.watchdog_collect(
-                            lambda: self._collect(flight["pending"]),
-                            retry.collect_timeout)
+                            fetch, retry.collect_timeout)
                 else:
-                    got = self._collect(flight["pending"])
+                    got = fetch()
+                if sparse_state is None:
+                    transfer["down"] += sum(int(v.nbytes)
+                                            for v in got.values())
             _last_span(flight.setdefault("spans", []))
             return got
 
+        def _redispatch(flight: Dict[str, object]):
+            """Launch (or re-launch) a flight's device work from its
+            recorded inputs -- the one dispatch point shared by the
+            first attempt and the retry path."""
+            if sparse_state is not None:
+                return sparse_state["fn"](*flight["fault"])
+            return self._dispatch(flight["fault"])
+
         def _dispatch_batch(lo: int) -> Dict[str, object]:
             spans_rec: List = []
+            n_part = min(lo + batch_size, len(sched)) - lo
             with tel.span("pad", lo=lo):
-                part = sched.slice(lo, min(lo + batch_size, len(sched)))
-                fault, n_part = self._padded_fault(part, batch_size)
+                if sparse_state is not None:
+                    # The whole up-link payload: scalars (generated
+                    # path) or device-side slices of the resident
+                    # schedule -- never per-batch fault arrays.
+                    fault = self._sparse_args(sparse_state, lo, n_part,
+                                              transfer)
+                else:
+                    part = sched.slice(lo, lo + n_part)
+                    fault, n_part = self._padded_fault(part, batch_size)
+                    transfer["up"] += sum(int(v.nbytes)
+                                          for v in fault.values())
             _last_span(spans_rec)
             if batch_size - n_part:
                 tel.count("pad_waste_rows", batch_size - n_part)
+            flight = {"pending": None, "n": n_part, "fault": fault,
+                      "lo": lo, "attempts": 1, "spans": spans_rec}
             with tel.span("dispatch", n=n_part):
-                pending = self._dispatch(fault)
+                flight["pending"] = _redispatch(flight)
             _last_span(spans_rec)
-            return {"pending": pending, "n": n_part, "fault": fault,
-                    "lo": lo, "attempts": 1, "spans": spans_rec}
+            return flight
 
         def _note_retry(flight_lo: int, attempt: int,
                         exc: BaseException, kind: str) -> None:
@@ -721,8 +1265,8 @@ class CampaignRunner:
                             if flight["pending"] is None:
                                 with tel.span("dispatch", n=flight["n"],
                                               retry=flight["attempts"]):
-                                    flight["pending"] = self._dispatch(
-                                        flight["fault"])
+                                    flight["pending"] = _redispatch(
+                                        flight)
                                 _last_span(flight["spans"])
                             got = _collect_flight(flight)
                             break
@@ -762,6 +1306,12 @@ class CampaignRunner:
                     batch_size = new_bs
                     in_flight.clear()
                     next_lo = done
+                    if sparse_state is not None:
+                        # The sparse program (and the resident padded
+                        # arrays) are shaped by the batch geometry:
+                        # rebuild for the degraded size.
+                        sparse_state = self._sparse_setup(
+                            sched, batch_size, transfer)
                     if journal is not None:
                         journal.append({"kind": "geometry",
                                         "batch_size": batch_size,
@@ -782,30 +1332,55 @@ class CampaignRunner:
             # ``convergence`` (below) records the planned size.
             sched = sched.slice(0, done)
             sched_w = getattr(sched, "class_weight", None)
+        interesting_rows = None
         with tel.span("classify"):
-            if outs:
-                merged = {k: np.concatenate([o[k] for o in outs])
-                          for k in outs[0]}
+            if sparse_state is not None:
+                # The device histogrammed every batch already; the
+                # campaign totals are their sum (identical to dense's
+                # end-of-run bincount over all rows), and the per-run
+                # columns cover exactly the interesting rows.
+                cols = ("code", "errors", "corrected", "steps")
+                if outs:
+                    merged = {k: np.concatenate([o[k] for o in outs])
+                              for k in cols}
+                    interesting_rows = np.concatenate(
+                        [o["rows"] for o in outs])
+                    binc = np.sum([o["hist"] for o in outs], axis=0)
+                    invalid_total = int(sum(o["invalid"] for o in outs))
+                else:
+                    merged = {k: np.zeros(0, np.int32) for k in cols}
+                    interesting_rows = np.zeros(0, np.int64)
+                    binc = np.zeros(cls.NUM_CLASSES, np.int64)
+                    invalid_total = 0
+                counts = cls.counts_dict(binc, self._train)
+                counts["cache_invalid"] = invalid_total
             else:
-                merged = {k: np.zeros(0, np.int32)
-                          for k in ("code", "errors", "corrected", "steps")}
-            # Cache draws outside the program footprint (t < 0) never fire
-            # a flip: a clean run that injected nothing is not a "survived
-            # injection", so they get their own bucket instead of inflating
-            # success -- the analogue of the reference summary's cacheValids
-            # column (jsonParser.py summarizeRuns counts lines whose
-            # cacheInfo says the chosen line was not dirty).
-            invalid_draw = np.asarray(sched.t) < 0
-            if sched_w is None:
-                binc = np.bincount(merged["code"][~invalid_draw],
-                                   minlength=cls.NUM_CLASSES)
-                invalid_total = int(invalid_draw.sum())
-            else:
-                binc = cls.weighted_histogram(merged["code"][~invalid_draw],
-                                              sched_w[~invalid_draw])
-                invalid_total = int(sched_w[invalid_draw].sum())
-            counts = cls.counts_dict(binc, self._train)
-            counts["cache_invalid"] = invalid_total
+                if outs:
+                    merged = {k: np.concatenate([o[k] for o in outs])
+                              for k in outs[0]}
+                else:
+                    merged = {k: np.zeros(0, np.int32)
+                              for k in ("code", "errors", "corrected",
+                                        "steps")}
+                # Cache draws outside the program footprint (t < 0)
+                # never fire a flip: a clean run that injected nothing
+                # is not a "survived injection", so they get their own
+                # bucket instead of inflating success -- the analogue of
+                # the reference summary's cacheValids column
+                # (jsonParser.py summarizeRuns counts lines whose
+                # cacheInfo says the chosen line was not dirty).
+                invalid_draw = np.asarray(sched.t) < 0
+                if sched_w is None:
+                    binc = np.bincount(merged["code"][~invalid_draw],
+                                       minlength=cls.NUM_CLASSES)
+                    invalid_total = int(invalid_draw.sum())
+                else:
+                    binc = cls.weighted_histogram(
+                        merged["code"][~invalid_draw],
+                        sched_w[~invalid_draw])
+                    invalid_total = int(sched_w[invalid_draw].sum())
+                counts = cls.counts_dict(binc, self._train)
+                counts["cache_invalid"] = invalid_total
         seconds = time.perf_counter() - t0
         res = CampaignResult(
             benchmark=self.prog.region.name,
@@ -822,6 +1397,10 @@ class CampaignRunner:
             seed=sched.seed,
             stages=tel.stage_totals(since=mark),
             resilience=resilience,
+            collect=self.collect,
+            interesting_rows=interesting_rows,
+            transfer={"up": int(transfer["up"]),
+                      "down": int(transfer["down"])},
         )
         if tracker is not None:
             res.convergence = tracker.report(
@@ -850,7 +1429,8 @@ class CampaignRunner:
             fault_model=self.fault_model.spec(),
             equiv=self.equiv_partition is not None,
             stop_when=(stop_when.spec() if stop_when is not None
-                       else None))
+                       else None),
+            collect=self.collect)
 
     def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
         """The identity block every journal header shares: resuming under
@@ -863,6 +1443,10 @@ class CampaignRunner:
                   "config_sha": config_fingerprint(self.prog.cfg)}
         if self.fault_model.kind != "single":
             header["fault_model"] = self.fault_model.spec()
+        if self.collect != "dense":
+            # Absent-means-dense: every journal written before sparse
+            # collection existed keeps resuming unchanged.
+            header["collect"] = self.collect
         if self.equiv_partition is not None:
             # Partition = campaign identity (the reduced rows are only
             # meaningful under it); per-section fingerprints are the
@@ -985,14 +1569,7 @@ class CampaignRunner:
     def _take_rows(part: FaultSchedule, idx: np.ndarray) -> FaultSchedule:
         """Arbitrary-row subset of a single-site schedule (the delta
         paths' working shape: equiv-reduced, no flip groups)."""
-        return FaultSchedule(
-            *(np.ascontiguousarray(np.asarray(getattr(part, f))[idx])
-              for f in ("leaf_id", "lane", "word", "bit", "t",
-                        "section_idx")),
-            seed=part.seed, model=part.model,
-            class_weight=(part.class_weight[idx]
-                          if part.class_weight is not None else None),
-            equiv_sha=part.equiv_sha)
+        return _rows_subset(part, idx)
 
     def run_delta(self, n: int, delta_from: str, seed: int = 0,
                   batch_size: int = 4096, start_num: int = 0,
@@ -1032,6 +1609,11 @@ class CampaignRunner:
                 "run_delta needs CampaignRunner(equiv=True): the "
                 "equivalence partition supplies the per-section "
                 "fingerprints a delta diffs")
+        if self.collect != "dense":
+            raise ValueError(
+                "run_delta is dense by construction: the spliced rows "
+                "are exact per-row journal records; build the runner "
+                "with collect='dense'")
         tel = self.telemetry
         mark = tel.mark()
         base_header, base_sites, base_out, base_rows = load_delta_base(
@@ -1261,6 +1843,10 @@ class CampaignRunner:
         not reproduce ``res.counts`` -- the journal must be able to
         stand in for the result under the fleet merge's parity check."""
         from coast_tpu.inject.journal import JournalError
+        if res.collect != "dense":
+            raise ValueError(
+                "journal_result materializes dense per-row batch "
+                "records; a sparse result has no full columns to write")
         part = res.schedule
         spec = self._campaign_spec(
             int(n) if n is not None else int(res.n), seed=res.seed,
@@ -1354,6 +1940,12 @@ class CampaignRunner:
         across chunk boundaries (cumulative done/counts, so
         error-bounded flagship loops are no longer silent for minutes).
         Returns (next_chunk, finish) -- call ``finish`` when done."""
+        if self.collect != "dense":
+            raise ValueError(
+                "multi-chunk campaigns (run_until_errors / "
+                "replay_chunks) record full per-chunk columns; run "
+                "them with collect='dense' (sparse campaigns use "
+                "run/run_schedule)")
         j, owned = self._open_journal(journal, header)
         replayed = j.chunk_records() if j is not None else []
         replay_idx = 0
@@ -1504,14 +2096,30 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
             "parts list (the sizing loop never ran a batch -- check "
             "min_errors/max_n/target arithmetic)")
     first = parts[0]
+    if len({p.collect for p in parts}) > 1:
+        raise ValueError(
+            "cannot merge campaigns with mixed collect modes "
+            f"({sorted({p.collect for p in parts})})")
     counts = {k: sum(p.counts[k] for p in parts) for k in first.counts}
     stages: Dict[str, float] = {}
     resilience: Dict[str, int] = {}
+    transfer: Dict[str, int] = {}
     for p in parts:
         for k, v in p.stages.items():
             stages[k] = stages.get(k, 0.0) + v
         for k, v in p.resilience.items():
             resilience[k] = resilience.get(k, 0) + v
+        for k, v in p.transfer.items():
+            transfer[k] = transfer.get(k, 0) + int(v)
+    interesting = None
+    if first.collect != "dense":
+        # Sparse chunks: per-part rows are schedule-local; rebase each
+        # by its part's physical offset so the merged indices stay
+        # schedule-global (exactly the codes-concatenation order).
+        offsets = np.cumsum([0] + [len(p.schedule) for p in parts[:-1]])
+        interesting = np.concatenate(
+            [p.interesting_rows + int(off)
+             for p, off in zip(parts, offsets)])
     extra = None
     first_sched = first.schedule
     if first_sched.extra is not None:
@@ -1554,4 +2162,7 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
                 for p in parts],
         stages=stages,
         resilience=resilience,
+        collect=first.collect,
+        interesting_rows=interesting,
+        transfer=transfer,
     )

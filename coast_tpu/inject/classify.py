@@ -42,6 +42,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 SUCCESS = 0
 CORRECTED = 1   # "faults" column: TMR voted away a miscompare, output clean
@@ -141,7 +142,6 @@ def completed_mask(codes):
     """Boolean mask of runs that completed (reached the result line):
     success/corrected/sdc plus the train refinements of sdc.  The single
     membership rule behind every mean-runtime statistic."""
-    import numpy as np
     codes = np.asarray(codes)
     return (codes <= SDC) | (codes >= TRAIN_SELF_HEAL)
 
@@ -152,10 +152,22 @@ def weighted_histogram(codes, weights=None):
     campaigns (analysis/equiv): each representative's outcome is
     multiplied by its ``class_weight``, so the reported distribution is
     over *effective* injections while only the representatives ran."""
-    import numpy as np
     codes = np.asarray(codes)
     if weights is None:
         return np.bincount(codes, minlength=NUM_CLASSES).astype(np.int64)
     return np.round(np.bincount(
         codes, weights=np.asarray(weights, np.float64),
         minlength=NUM_CLASSES)).astype(np.int64)
+
+
+def counts_histogram(counts) -> np.ndarray:
+    """The histogram-only inverse of :func:`counts_dict`: a counts
+    mapping (class name -> count; extra keys like ``cache_invalid``
+    ignored) back to the int64 [NUM_CLASSES] histogram array.  Sparse
+    consumers live on this shape -- sparse journal records, sparse log
+    summaries, and resume replay all carry histograms rather than
+    per-row code columns."""
+    out = np.zeros(NUM_CLASSES, np.int64)
+    for i, name in enumerate(CLASS_NAMES):
+        out[i] = int(counts.get(name, 0))
+    return out

@@ -1,0 +1,263 @@
+"""On-device fault-schedule generation: the host-upload eliminator.
+
+Every seeded schedule this repo runs is counter-mode splitmix64
+(``native.splitmix_fill``: value i = finalizer(seed + (i+1)*golden)), so
+a schedule row is a pure function of (seed, row index) -- there is no
+reason to expand it on the host and ship O(n * sites) int32 fault
+arrays down the PCIe link per batch.  This module re-implements the
+exact splitmix64 stream -- and the fault-model expansion streams of
+``native.fault_expand`` -- as jax-traceable 32-bit arithmetic (XLA on
+TPU has no 64-bit integer path without the global x64 flag, so u64 is
+emulated as (hi, lo) uint32 pairs), letting the compiled campaign step
+regenerate its own flip sites from a scalar row offset.
+
+Bit parity with the host path is a hard contract, pinned per fault-model
+kind in tests/test_sparse.py the same way native-vs-numpy expansion
+parity is pinned: the host-side ``FaultSchedule`` remains the campaign's
+source of truth (journal fingerprints, log site columns), and the device
+must provably inject exactly those sites.
+
+The HBM-resident-state discipline follows the TPU CFD framework
+(arXiv:2108.11076); the scale motivation (10^7-10^8 injection campaigns
+cheap enough to gate merges) is FastFlip's (arXiv:2403.13989).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import FaultModel
+from coast_tpu.native import FAULT_EXPAND_SALT
+
+__all__ = ["DeviceGenError", "DeviceScheduleGen"]
+
+_MASK32 = 0xFFFFFFFF
+
+# splitmix64 constants, split into (hi, lo) uint32 halves.
+_GOLDEN = (0x9E3779B9, 0x7F4A7C15)
+_MIX1 = (0xBF58476D, 0x1CE4E5B9)
+_MIX2 = (0x94D049BB, 0x133111EB)
+
+
+class DeviceGenError(ValueError):
+    """The schedule cannot be regenerated on device (address space too
+    large for the 32-bit emulation, unsupported model)."""
+
+
+# -- u64 as (hi, lo) uint32 pairs -------------------------------------------
+
+def _u32(x) -> jax.Array:
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _const64(value: int) -> Tuple[jax.Array, jax.Array]:
+    value &= 0xFFFFFFFFFFFFFFFF
+    return _u32(value >> 32), _u32(value & _MASK32)
+
+
+def _add64(x, y):
+    lo = x[1] + y[1]
+    carry = (lo < x[1]).astype(jnp.uint32)
+    return x[0] + y[0] + carry, lo
+
+
+def _mul32(a, b):
+    """Full 32x32 -> 64 product as (hi, lo) uint32."""
+    a0 = a & _u32(0xFFFF)
+    a1 = a >> 16
+    b0 = b & _u32(0xFFFF)
+    b1 = b >> 16
+    ll = a0 * b0
+    m1 = a1 * b0
+    m2 = a0 * b1
+    hh = a1 * b1
+    carry = ((ll >> 16) + (m1 & _u32(0xFFFF)) + (m2 & _u32(0xFFFF))) >> 16
+    lo = ll + (m1 << 16) + (m2 << 16)
+    hi = hh + (m1 >> 16) + (m2 >> 16) + carry
+    return hi, lo
+
+
+def _mul64(x, y):
+    """Low 64 bits of the u64 product (exactly numpy's wrapping *)."""
+    hi, lo = _mul32(x[1], y[1])
+    return hi + x[1] * y[0] + x[0] * y[1], lo
+
+
+def _xor64(x, y):
+    return x[0] ^ y[0], x[1] ^ y[1]
+
+
+def _shr64(z, k: int):
+    """z >> k for constant 1 <= k <= 31."""
+    hi, lo = z
+    return hi >> k, (lo >> k) | (hi << (32 - k))
+
+
+def _splitmix64(seed, counter):
+    """finalizer(seed + counter * golden): counter-mode splitmix64, the
+    exact stream of native.splitmix_fill (value i uses counter i+1)."""
+    z = _add64(seed, _mul64(counter, _const64(0x9E3779B97F4A7C15)))
+    z = _mul64(_xor64(z, _shr64(z, 30)), _const64(0xBF58476D1CE4E5B9))
+    z = _mul64(_xor64(z, _shr64(z, 27)), _const64(0x94D049BB133111EB))
+    return _xor64(z, _shr64(z, 31))
+
+
+def _mod64(z, m: int) -> jax.Array:
+    """(hi, lo) u64 modulo a host-constant m (1 <= m < 2^32) -> uint32.
+
+    lo reduces natively; each set bit k of hi contributes the host
+    constant 2^(32+k) mod m, folded in with an overflow-safe conditional
+    subtract (both operands stay < m < 2^32 at every step)."""
+    if not 1 <= m < (1 << 32):
+        raise DeviceGenError(f"modulus {m} outside the u32 emulation range")
+    hi, lo = z
+    if m & (m - 1) == 0:
+        # Power of two: the low bits are the remainder.
+        return lo & _u32(m - 1)
+    m32 = _u32(m)
+    r = lo % m32
+    for k in range(32):
+        c = (1 << (32 + k)) % m
+        if c == 0:
+            continue
+        term = ((hi >> k) & _u32(1)) * _u32(c)
+        r = jnp.where(r >= m32 - term, r - (m32 - term), r + term)
+    return r
+
+
+# -- the generator -----------------------------------------------------------
+
+class DeviceScheduleGen:
+    """Regenerates a seeded ``generate()`` stream (any FaultModel kind)
+    inside a compiled program, from (seed, stream length, row index).
+
+    Seed and stream length arrive as *traced* scalars, so one compiled
+    campaign step serves every seed -- the per-batch host upload is the
+    scalar row offset, nothing else.  The section layout, nominal step
+    window, and fault-model geometry are trace-time constants (they are
+    campaign identity anyway)."""
+
+    def __init__(self, mmap: MemoryMap, nominal_steps: int,
+                 model: Optional[FaultModel] = None):
+        self.model = model if model is not None else FaultModel()
+        bits_end, sec_leaf, sec_lanes, sec_words = mmap.section_tables()
+        self.total_bits = int(bits_end[-1])
+        if self.total_bits >= (1 << 32):
+            raise DeviceGenError(
+                f"injectable address space is {self.total_bits} bits; "
+                "the on-device generator's 32-bit address emulation "
+                "covers < 2^32 bits -- run this campaign with "
+                "collect='dense'")
+        self.steps = max(int(nominal_steps), 1)
+        starts = bits_end - np.asarray([s.bits for s in mmap.sections],
+                                       np.int64)
+        # Trace-time constant tables (uint32 is safe: total_bits < 2^32).
+        self._edges = jnp.asarray(bits_end.astype(np.uint32))
+        self._starts = jnp.asarray(starts.astype(np.uint32))
+        self._leaf = jnp.asarray(sec_leaf.astype(np.int32))
+        self._lanes = jnp.asarray(sec_lanes.astype(np.uint32))
+        self._words = jnp.asarray(sec_words.astype(np.uint32))
+
+    # -- decode (MemoryMap.decode, on device) --------------------------------
+    def _decode(self, flat: jax.Array):
+        sec = jnp.searchsorted(self._edges, flat, side="right")
+        off = flat - self._starts[sec]
+        wpl = self._words[sec] * _u32(32)
+        lane = off // wpl
+        rem = off % wpl
+        return (self._leaf[sec], lane.astype(jnp.int32),
+                (rem >> 5).astype(jnp.int32),
+                (off & _u32(31)).astype(jnp.int32), sec)
+
+    # -- the stream ----------------------------------------------------------
+    def columns(self, seed: Tuple[jax.Array, jax.Array],
+                stream_n: jax.Array,
+                rows: jax.Array) -> Dict[str, jax.Array]:
+        """Fault columns for global stream rows ``rows`` (uint32 [B]):
+        int32 [B] per key for the single model, [B, sites] (column 0 the
+        base site) for flip groups -- exactly
+        ``generate(mmap, stream_n, seed, steps, model).device_arrays()``
+        at those rows, bit for bit.
+
+        ``seed`` is a (hi, lo) uint32 scalar pair; ``stream_n`` the full
+        stream length (generate()'s n: the t column's draws start at
+        stream index n, so the layout depends on it)."""
+        rows = rows.astype(jnp.uint32)
+        zero = jnp.zeros_like(rows)
+        c_site = (zero, rows + _u32(1))
+        c_t = _add64(c_site, (jnp.uint32(0), stream_n.astype(jnp.uint32)))
+        flat = _mod64(_splitmix64(seed, c_site), self.total_bits)
+        leaf, lane, word, bit, sec = self._decode(flat)
+        t = _mod64(_splitmix64(seed, c_t), self.steps).astype(jnp.int32)
+        model = self.model
+        if model.kind == "single" or model.sites == 1:
+            return {"leaf_id": leaf, "lane": lane, "word": word,
+                    "bit": bit, "t": t}
+        # Derived expansion stream: exp_seed = splitmix_at(seed, SALT),
+        # computed in-trace so the seed stays a runtime scalar.
+        exp_seed = _splitmix64(seed, _const64(FAULT_EXPAND_SALT + 1))
+        base = {"leaf_id": leaf, "lane": lane, "word": word,
+                "bit": bit, "t": t}
+        cols = {k: [v] for k, v in base.items()}
+        extras = model.sites - 1
+        for j in range(1, model.sites):
+            site = self._extra_site(model, exp_seed, rows, extras, j,
+                                    base, sec)
+            for k in cols:
+                cols[k].append(site[k])
+        return {k: jnp.stack(v, axis=1) for k, v in cols.items()}
+
+    def _extra_site(self, model: FaultModel, exp_seed, rows, extras: int,
+                    j: int, base: Dict[str, jax.Array], sec: jax.Array
+                    ) -> Dict[str, jax.Array]:
+        """Site ``j`` (1-based) of each row's flip group: the numpy
+        fallback of ``native.fault_expand``, re-spelled in u32 pairs."""
+        zero = jnp.zeros_like(rows)
+        if model.kind == "multibit":
+            u = _splitmix64(exp_seed, (zero, rows + _u32(1)))
+            stride = _u32(1) + _u32(2) * (u[1] & _u32(15))
+            bit = ((base["bit"].astype(jnp.uint32) + _u32(j) * stride)
+                   & _u32(31)).astype(jnp.int32)
+            return {**base, "bit": bit}
+        # cluster/burst: extra row r = i*extras + (j-1) consumes the
+        # derived stream's draws 2r and 2r+1 (counters 2r+1, 2r+2).
+        r = _add64(_mul64((zero, rows), _const64(extras)),
+                   _const64(j - 1))
+        c0 = _add64(_mul64(r, _const64(2)), _const64(1))
+        u0 = _splitmix64(exp_seed, c0)
+        u1 = _splitmix64(exp_seed, _add64(c0, _const64(1)))
+        if model.kind == "cluster":
+            words = self._words[sec]
+            lw = self._lanes[sec] * words
+            phys = (base["lane"].astype(jnp.uint32) * words
+                    + base["word"].astype(jnp.uint32) + _u32(1)
+                    + _mod64(u0, model.span)) % lw
+            return {"leaf_id": base["leaf_id"],
+                    "lane": (phys // words).astype(jnp.int32),
+                    "word": (phys % words).astype(jnp.int32),
+                    "bit": (u1[1] & _u32(31)).astype(jnp.int32),
+                    "t": base["t"]}
+        # burst: fresh uniform location + clustered time.
+        flat = _mod64(u0, self.total_bits)
+        leaf, lane, word, bit, _sec = self._decode(flat)
+        tj = jnp.minimum(
+            base["t"] + _mod64(u1, model.window).astype(jnp.int32),
+            self.steps - 1)
+        return {"leaf_id": leaf, "lane": lane, "word": word, "bit": bit,
+                "t": jnp.where(base["t"] < 0, base["t"], tj)}
+
+    # -- host-side convenience (tests, debugging) ----------------------------
+    def rows_np(self, seed: int, stream_n: int,
+                rows: np.ndarray) -> Dict[str, np.ndarray]:
+        """Host entry point: run the traced generator over ``rows`` and
+        fetch the columns -- the parity tests' subject."""
+        seed &= 0xFFFFFFFFFFFFFFFF
+        fn = jax.jit(lambda sh, sl, n, r: self.columns((sh, sl), n, r))
+        out = fn(np.uint32(seed >> 32), np.uint32(seed & _MASK32),
+                 np.uint32(stream_n), np.asarray(rows, np.uint32))
+        return {k: np.asarray(v) for k, v in out.items()}

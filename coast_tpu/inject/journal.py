@@ -21,7 +21,14 @@ kill at any instant leaves at worst one truncated trailing line (which
   * ``batch``    -- one collected dispatch batch: its row range
     (``lo``, ``n``) plus the per-run ``codes``/``errors``/``corrected``/
     ``steps`` columns, the cumulative class counts, and the stage
-    seconds so far.
+    seconds so far.  Sparse-collect campaigns (``collect: "sparse"`` in
+    the header -- identity, so dense and sparse journals refuse each
+    other) write the same record kind with ``"sparse": true``: the
+    batch's 10-int class histogram + weighted invalid-draw count stand
+    in for the full columns, and the per-row columns cover only the
+    batch's *interesting* rows (class outside success/corrected), keyed
+    by their absolute ``rows``.  ``batch_prefix`` treats both shapes
+    identically (``lo``/``n`` carry the physical row range either way).
   * ``chunk``    -- one completed chunk of a multi-chunk campaign
     (``run_until_errors`` / ``replay_chunks``): its (seed, n,
     start_num) identity plus the same per-run columns.
@@ -348,6 +355,39 @@ class CampaignJournal:
         else changes."""
         rec = {
             "kind": "batch", "lo": int(lo), "n": int(len(out["code"])),
+            "codes": out["code"].tolist(),
+            "errors": out["errors"].tolist(),
+            "corrected": out["corrected"].tolist(),
+            "steps": out["steps"].tolist(),
+            "counts": counts,
+            "stage_seconds": {k: round(v, 6)
+                              for k, v in stage_seconds.items()},
+        }
+        if spans:
+            rec["spans"] = [[str(name), float(t), float(dur)]
+                            for name, t, dur in spans]
+        self.append(rec)
+
+    def append_batch_sparse(self, lo: int, n: int,
+                            hist, invalid: int, rows,
+                            out: Dict[str, np.ndarray],
+                            counts: Dict[str, int],
+                            stage_seconds: Dict[str, float],
+                            spans: "Optional[list]" = None) -> None:
+        """Sparse-collect batch record: the batch's class histogram +
+        weighted invalid-draw count, and the per-row columns for only
+        its interesting rows (absolute row numbers in ``rows``).  Same
+        ``lo``/``n`` contract as the dense record, so ``batch_prefix``
+        and the fleet merge's contiguity check read both shapes; the
+        concatenated ``codes`` of a sparse journal are exactly the
+        campaign's interesting-row codes (the fleet parity pin's
+        subject in sparse mode)."""
+        rec = {
+            "kind": "batch", "sparse": True,
+            "lo": int(lo), "n": int(n),
+            "hist": [int(v) for v in hist],
+            "invalid": int(invalid),
+            "rows": [int(r) for r in rows],
             "codes": out["code"].tolist(),
             "errors": out["errors"].tolist(),
             "corrected": out["corrected"].tolist(),

@@ -164,9 +164,19 @@ def _result_dict(code: int, errors: int, corrected: int, steps: int,
 
 
 def _columns(res: CampaignResult, mmap: MemoryMap):
-    """Per-run columns as plain Python lists (one C-speed conversion each)."""
+    """Per-run columns as plain Python lists (one C-speed conversion each).
+
+    Sparse-collect campaigns (``res.collect == "sparse"``) have per-run
+    columns only for their INTERESTING rows: the site columns come from
+    the host schedule at ``res.interesting_rows`` and an explicit
+    ``number`` column carries each row's absolute injection number --
+    the class totals live in the summary's histogram-derived counts, not
+    in the rows."""
     secs = {s.leaf_id: s for s in mmap.sections}
     sched = res.schedule
+    if res.collect != "dense":
+        from coast_tpu.inject.campaign import _rows_subset
+        sched = _rows_subset(sched, res.interesting_rows)
     col = {
         "leaf_id": sched.leaf_id.tolist(),
         "lane": sched.lane.tolist(),
@@ -185,6 +195,8 @@ def _columns(res: CampaignResult, mmap: MemoryMap):
     # pass existed (the fault-model rule).
     if getattr(sched, "class_weight", None) is not None:
         col["weight"] = sched.class_weight.tolist()
+    if res.collect != "dense":
+        col["number"] = [int(r) for r in res.interesting_rows]
     return col, secs
 
 
@@ -217,6 +229,7 @@ def _injection_log_rows(col, sec_kind: Dict[int, str],
     cannot drift."""
     logs = []
     weights = col.get("weight")
+    numbers = col.get("number")
     for i in range(len(col["code"])):
         lid = col["leaf_id"][i]
         t_i = col["t"][i]
@@ -231,7 +244,7 @@ def _injection_log_rows(col, sec_kind: Dict[int, str],
             name = f"{sec_name[lid]}[lane {col['lane'][i]}]^bit{col['bit'][i]}"
         row = {
             "timestamp": ts,
-            "number": num0 + i,
+            "number": numbers[i] if numbers is not None else num0 + i,
             "section": section,
             "address": col["word"][i],
             "oldValue": None,              # values live on-device; the flip
@@ -293,6 +306,11 @@ def _ndjson_try_native(res: CampaignResult, mmap: MemoryMap, ts: str,
         # Equivalence-reduced rows carry a weight key the native encoder
         # does not know; the Python formatter owns them.
         return False
+    if res.collect != "dense":
+        # Sparse rows carry non-consecutive injection numbers the native
+        # encoder cannot produce; the (small) interesting-row set is the
+        # Python formatter's.
+        return False
     tables = _escaped_leaf_tables(mmap)
     if tables is None:
         return False
@@ -333,6 +351,13 @@ def write_reference_json(res: CampaignResult, mmap: MemoryMap, path: str,
     StatisticsError on a campaign with zero successes (e.g. a small TMR
     campaign where every injection was corrected); its own QEMU
     campaigns always contain clean runs, so the path was never guarded."""
+    if res.collect != "dense":
+        raise ValueError(
+            "write_reference_json needs a dense result: the reference "
+            "container is a bare InjectionLog array with no summary "
+            "block, so a sparse campaign's histogram counts would be "
+            "silently lost (readers would summarize only the "
+            "interesting rows)")
     if exec_path is None:
         from coast_tpu.models import model_source
         exec_path = model_source(res.benchmark)
@@ -429,6 +454,7 @@ def _ndjson_rows_py(col, sec_kind: Dict[int, str], sec_name: Dict[int, str],
     writer (per-batch columns), byte-identical by construction."""
     res_tpl, line_tpl = _ndjson_templates(ts)
     weights = col.get("weight")
+    numbers = col.get("number")
     for i in range(len(col["code"])):
         lid = col["leaf_id"][i]
         t_i = col["t"][i]
@@ -445,7 +471,8 @@ def _ndjson_rows_py(col, sec_kind: Dict[int, str], sec_name: Dict[int, str],
         # json.dumps on the string fields: leaf names are arbitrary
         # author-chosen strings and must be JSON-escaped.
         line = line_tpl % {
-            "i": num0 + i, "section": json.dumps(section)[1:-1],
+            "i": numbers[i] if numbers is not None else num0 + i,
+            "section": json.dumps(section)[1:-1],
             "word": col["word"][i], "t": t_i,
             "name": json.dumps(name)[1:-1],
             "symbol": json.dumps(symbol)[1:-1],
@@ -541,6 +568,7 @@ class StreamLogWriter:
         self._bg_busy = 0.0         # background serialization seconds
         self._blocked = 0.0         # main-thread seconds stalled on feed
         self._finished = False
+        self._sparse = False        # armed by the first feed_sparse()
 
     # -- lifecycle -----------------------------------------------------------
     def begin(self) -> None:
@@ -590,6 +618,37 @@ class StreamLogWriter:
         self._q.put((num0, part, out))
         self._blocked += time.perf_counter() - t0
 
+    def feed_sparse(self, numbers, part, out: Dict[str, object]) -> None:
+        """Hand one sparse-collect batch's INTERESTING rows to the
+        writer: ``numbers`` are the rows' absolute injection numbers
+        (non-contiguous by construction), ``part`` the schedule subset
+        at those rows, ``out`` their outcome columns.  ndjson only --
+        the columnar/reference containers have no sparse row form."""
+        if self.fmt != "ndjson":
+            raise ValueError(
+                "sparse streams support the ndjson format only (got "
+                f"{self.fmt!r}); columnar/reference sparse logs are "
+                "one-shot writers")
+        if self._finished:
+            raise RuntimeError("StreamLogWriter already finished/aborted")
+        if self._exc is not None:
+            raise RuntimeError(
+                f"stream log writer for {self.path!r} failed"
+            ) from self._exc
+        self._sparse = True
+        self.begin()
+        n = len(out["code"])
+        if len(part) != n or len(numbers) != n:
+            raise ValueError(
+                f"sparse feed shape mismatch: {len(numbers)} numbers, "
+                f"{len(part)} schedule rows, {n} outcome rows")
+        self._expected += n
+        if n == 0:
+            return
+        t0 = time.perf_counter()
+        self._q.put(([int(r) for r in numbers], part, out))
+        self._blocked += time.perf_counter() - t0
+
     def finish(self, res: CampaignResult) -> None:
         """Drain the writer, assemble the final file atomically, and bill
         the campaign's stage block (``serialize`` non-overlapped seconds
@@ -608,7 +667,11 @@ class StreamLogWriter:
             raise RuntimeError(
                 f"stream log writer for {self.path!r} failed"
             ) from self._exc
-        rows = res.physical_n if res.physical_n is not None else res.n
+        if res.collect != "dense":
+            # Sparse streams carry exactly the interesting rows.
+            rows = len(res.codes)
+        else:
+            rows = res.physical_n if res.physical_n is not None else res.n
         if rows != self._expected:
             self._cleanup()
             raise ValueError(
@@ -680,6 +743,16 @@ class StreamLogWriter:
 
     def _serialize_batch(self, num0: int, part, out) -> None:
         if self.fmt == "ndjson":
+            if self._sparse:
+                # Non-contiguous injection numbers: the Python formatter
+                # with an explicit number column (interesting rows are
+                # few by construction).
+                col = _batch_columns(part, out)
+                col["number"] = num0     # the feed's numbers list
+                _ndjson_rows_py(col, self._sec_kind, self._sec_name,
+                                self._ts, 0,
+                                lambda s: self._rows_f.write(s.encode()))
+                return
             if (self._use_native is not False and self._tables is not None
                     and getattr(part, "class_weight", None) is None):
                 from coast_tpu import native

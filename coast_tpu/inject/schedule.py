@@ -199,6 +199,20 @@ class FaultSchedule:
     # None for ordinary exhaustive schedules.
     class_weight: Optional[np.ndarray] = None   # int64 [n]
     equiv_sha: Optional[str] = None
+    # Device-regeneration metadata (inject/device_gen): a schedule whose
+    # rows are a contiguous window of one ``generate()`` stream records
+    # the stream's full length, this window's offset into it, and the
+    # step-window modulus the t column was drawn with, so a
+    # sparse-collect campaign can regenerate every row's flip sites
+    # inside the compiled step from (seed, stream_n, row index) alone --
+    # no per-batch fault upload.  ``gen_steps`` is part of the identity:
+    # regenerating with any other modulus would inject at different
+    # timesteps than the host schedule records.  None for schedules the
+    # stream cannot reproduce row-by-row (stratified strata,
+    # equivalence-reduced subsets, cache overlays, merged chunks).
+    gen_stream_n: Optional[int] = None
+    gen_lo: int = 0
+    gen_steps: Optional[int] = None
 
     def __len__(self) -> int:
         return len(self.leaf_id)
@@ -242,7 +256,10 @@ class FaultSchedule:
             self.seed, extra=extra, model=self.model,
             class_weight=(None if self.class_weight is None
                           else self.class_weight[lo:hi]),
-            equiv_sha=self.equiv_sha)
+            equiv_sha=self.equiv_sha,
+            gen_stream_n=self.gen_stream_n,
+            gen_lo=self.gen_lo + lo,
+            gen_steps=self.gen_steps)
 
 
 def _expand(mmap: MemoryMap, sched: FaultSchedule, model: FaultModel,
@@ -291,7 +308,9 @@ def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
         t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
         leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
         sched = FaultSchedule(leaf_id, lane, word, bit, t,
-                              sec_idx.astype(np.int32), seed)
+                              sec_idx.astype(np.int32), seed,
+                              gen_stream_n=n,
+                              gen_steps=max(nominal_steps, 1))
         if model is not None and model.kind != "single":
             if equiv is not None:
                 raise ValueError(

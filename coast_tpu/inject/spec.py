@@ -43,12 +43,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional
 
-__all__ = ["CampaignSpec", "SpecError", "FAULT_MODEL_DEFAULT"]
+__all__ = ["CampaignSpec", "SpecError", "FAULT_MODEL_DEFAULT",
+           "COLLECT_DEFAULT", "header_collect"]
 
 #: The journal-evolution default: an absent ``fault_model`` key means
 #: the historical single-bit flip (journals and queue items written
 #: before PR 6 carry no key at all).
 FAULT_MODEL_DEFAULT = "single"
+
+#: Same evolution rule for the collection mode: an absent ``collect``
+#: key means the historical dense per-row fetch.
+COLLECT_DEFAULT = "dense"
 
 
 class SpecError(ValueError):
@@ -95,6 +100,16 @@ class CampaignSpec:
         sections from.  Fleet-item-only (the CI's delta items); never
         part of the journal header (a delta campaign's output is a
         plain run result).
+    ``collect``
+        Result-collection mode: ``"dense"`` (default; every row's
+        outcome columns cross the host boundary, the historical
+        behavior) or ``"sparse"`` (device-resident loop: flip sites
+        regenerate on device, only per-batch histograms plus the
+        compacted interesting rows come back).  Campaign identity: a
+        sparse journal's batch records are histogram + interesting-row
+        records, so resuming one under dense (or vice versa) must
+        refuse.  Absent-means-dense everywhere (journals, queue items,
+        and logs written before the mode existed stay byte-identical).
     """
 
     benchmark: str
@@ -110,6 +125,7 @@ class CampaignSpec:
     unroll: int = 1
     throttle_s: float = 0.0
     delta_from: Optional[str] = None
+    collect: str = COLLECT_DEFAULT
 
     # -- validation ----------------------------------------------------------
     def validate(self) -> "CampaignSpec":
@@ -134,6 +150,15 @@ class CampaignSpec:
             raise SpecError(
                 "delta_from needs equiv=True: the equivalence partition "
                 "supplies the per-section fingerprints a delta diffs")
+        if self.collect not in ("dense", "sparse"):
+            raise SpecError(
+                f"unknown collect mode {self.collect!r}; one of "
+                "'dense', 'sparse'")
+        if self.delta_from and self.collect != COLLECT_DEFAULT:
+            raise SpecError(
+                "delta_from campaigns are dense by construction (the "
+                "spliced rows are exact per-row journal records); drop "
+                "collect='sparse'")
         return self
 
     # -- parsed accessors ----------------------------------------------------
@@ -173,6 +198,11 @@ class CampaignSpec:
         }
         if self.delta_from:
             doc["delta_from"] = str(self.delta_from)
+        if self.collect != COLLECT_DEFAULT:
+            # Joins only when sparse (like delta_from): enqueue ids sha
+            # the item dict, so every pre-sparse item stays byte-
+            # identical.
+            doc["collect"] = str(self.collect)
         return doc
 
     @classmethod
@@ -194,6 +224,8 @@ class CampaignSpec:
             unroll=int(spec.get("unroll", 1)),
             throttle_s=float(spec.get("throttle_s", 0.0) or 0.0),
             delta_from=spec.get("delta_from") or None,
+            collect=str(spec.get("collect", COLLECT_DEFAULT)
+                        or COLLECT_DEFAULT),
         )
 
     # -- journal-header encoding (inject/journal.py) -------------------------
@@ -231,6 +263,7 @@ class CampaignSpec:
             fault_model=header_fault_model(header),
             equiv=bool(header.get("equiv")),
             stop_when=header.get("stop_when") or None,
+            collect=header_collect(header),
         )
 
     # -- delta identity (analysis/equiv/delta.py) ----------------------------
@@ -251,3 +284,9 @@ def header_fault_model(header: Dict[str, object]) -> str:
     ``fault_model`` header key means the historical single-bit model."""
     return str(header.get("fault_model", FAULT_MODEL_DEFAULT)
                or FAULT_MODEL_DEFAULT)
+
+
+def header_collect(header: Dict[str, object]) -> str:
+    """The collection-mode evolution rule, spelled once: an absent
+    ``collect`` header key means the historical dense per-row fetch."""
+    return str(header.get("collect", COLLECT_DEFAULT) or COLLECT_DEFAULT)

@@ -156,6 +156,21 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "10^6-run campaigns, reference = the reference "
                         "tool's own container (exec-path line + bare "
                         "array; readable by its jsonParser.py unmodified)")
+    parser.add_argument("--collect", type=str, default="dense",
+                        choices=["dense", "sparse"],
+                        help="result-collection mode: 'dense' (default) "
+                        "uploads per-batch fault arrays and fetches "
+                        "every row's outcome columns; 'sparse' keeps "
+                        "the loop device-resident -- flip sites "
+                        "regenerate on device from the schedule seed, "
+                        "per-batch accounting is a 10-int histogram, "
+                        "and only the compacted interesting rows "
+                        "(class outside success/corrected) cross the "
+                        "host boundary.  Counts are identical at the "
+                        "same seed; logs/journals record histograms + "
+                        "interesting rows.  Collection mode is campaign "
+                        "identity (journaled; resume under the other "
+                        "mode is refused)")
     parser.add_argument("--stream-logs", action="store_true",
                         help="serialize the campaign log incrementally in "
                         "a background thread as each batch is collected "
@@ -343,6 +358,32 @@ def parse_command_line(argv: Optional[List[str]] = None):
               "it cannot be combined with --journal/--resume/"
               "--stream-logs", file=sys.stderr)
         sys.exit(-1)
+    if args.collect == "sparse":
+        if args.errorCount or args.forceBreak or args.delta_from:
+            # -e's sizing loop journals full per-chunk columns; forced
+            # injections are one-offs; delta splices exact per-row
+            # records -- all inherently dense.
+            print("Error, --collect sparse applies to the seeded -t/"
+                  "--stratified/cache campaign paths, not -e/"
+                  "--errorCount, --forceBreak, or --delta-from",
+                  file=sys.stderr)
+            sys.exit(-1)
+        if args.stream_logs and args.log_format != "ndjson":
+            print("Error, --collect sparse with --stream-logs supports "
+                  "--log-format ndjson only (sparse rows have no "
+                  "streaming columnar/reference form)", file=sys.stderr)
+            sys.exit(-1)
+        if args.log_format == "reference" and not args.no_logging:
+            # The reference container is a bare InjectionLog array with
+            # no summary block: a sparse log's counts live ONLY in the
+            # summary, so both this repo's parser and the unmodified
+            # reference jsonParser would silently summarize just the
+            # interesting rows as if they were the whole campaign.
+            print("Error, --collect sparse needs a summary-carrying "
+                  "--log-format (json/ndjson/columnar): the reference "
+                  "container has no summary block to hold the sparse "
+                  "histogram counts", file=sys.stderr)
+            sys.exit(-1)
     if args.stop_when:
         from coast_tpu.obs.convergence import StopWhen, StopWhenError
         if args.errorCount or args.forceBreak:
@@ -496,7 +537,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 mesh=mesh,
                                 fault_model=args.fault_model_parsed,
                                 equiv=args.equiv,
-                                metrics=None if chunked else metrics)
+                                metrics=None if chunked else metrics,
+                                collect=args.collect)
     except ValueError as e:
         if args.equiv:
             print(f"Error, {e}", file=sys.stderr)

@@ -133,6 +133,11 @@ class CampaignMetrics:
         self.counts: Dict[str, float] = {}
         self.stages: Dict[str, float] = {}
         self.resilience: Dict[str, int] = {}
+        # Host<->device traffic bytes ({"up", "down"}), cumulative; the
+        # sparse-collect campaign loop's headline counter.  Stage
+        # attribution: up-bytes accrue in the pad/dispatch stages,
+        # down-bytes in collect.
+        self.transfer: Dict[str, int] = {}
         self.batches = 0
         self.replayed_batches = 0
         self.memory_watermark: Optional[int] = None
@@ -157,6 +162,7 @@ class CampaignMetrics:
             self.counts = {}
             self.stages = {}
             self.resilience = {}
+            self.transfer = {}
             self.batches = 0
             self.replayed_batches = 0
             self.error = None
@@ -170,10 +176,13 @@ class CampaignMetrics:
                      counts: Mapping[str, float],
                      stages: Mapping[str, float],
                      resilience: Mapping[str, int],
-                     replayed: bool = False) -> None:
+                     replayed: bool = False,
+                     transfer: Optional[Mapping[str, int]] = None
+                     ) -> None:
         """One collected (or journal-replayed) batch: cumulative row
         progress, the cumulative weighted class histogram, stage
-        totals, and resilience counters so far."""
+        totals, resilience counters, and (when the loop measures it)
+        cumulative host<->device transfer bytes so far."""
         now = self._clock()
         with self._lock:
             dt = max(now - self._t_last_batch, 1e-9)
@@ -184,6 +193,8 @@ class CampaignMetrics:
             self.effective_done = int(sum(self.counts.values()))
             self.stages = {k: float(v) for k, v in stages.items()}
             self.resilience = {k: int(v) for k, v in resilience.items()}
+            if transfer is not None:
+                self.transfer = {k: int(v) for k, v in transfer.items()}
             self.batches += 1
             if replayed:
                 self.replayed_batches += 1
@@ -257,6 +268,7 @@ class CampaignMetrics:
                 "rates": self._rates(),
                 "stages": dict(self.stages),
                 "resilience": dict(self.resilience),
+                "transfer_bytes": dict(self.transfer),
                 "device_memory_watermark_bytes": self.memory_watermark,
                 "updated_unix_s": round(self._updated_unix, 6),
                 "series": {
@@ -346,6 +358,13 @@ class CampaignMetrics:
                    [(f'{labels},kind="{_esc(k)}"', float(v))
                     for k, v in sorted(self.resilience.items())]
                    or [(f'{labels},kind="retry_transient"', 0.0)])
+            metric("coast_campaign_transfer_bytes_total", "counter",
+                   "Measured host<->device traffic (up: schedule/fault "
+                   "upload, billed under pad/dispatch; down: collected "
+                   "results, billed under collect).",
+                   [(f'{labels},direction="{_esc(k)}"', float(v))
+                    for k, v in sorted(self.transfer.items())]
+                   or [(f'{labels},direction="up"', 0.0)])
             if self.memory_watermark is not None:
                 metric("coast_campaign_device_memory_watermark_bytes",
                        "gauge",

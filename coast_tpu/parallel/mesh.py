@@ -39,7 +39,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from coast_tpu.inject import classify as cls
-from coast_tpu.inject.campaign import CampaignResult, CampaignRunner
+from coast_tpu.inject.campaign import (CampaignResult, CampaignRunner,
+                                       _sparse_device_outputs)
 from coast_tpu.inject.schedule import generate
 from coast_tpu.passes.dataflow_protection import ProtectedProgram
 
@@ -149,6 +150,79 @@ class ShardedCampaignRunner(CampaignRunner):
 
     def _dispatch(self, fault: Dict[str, jax.Array]):
         return self._records_sharded(fault)
+
+    # -- sparse (device-resident) collection, sharded -----------------------
+    def _sparse_shards(self) -> int:
+        return self.n_devices
+
+    def _make_sparse_fn(self, batch_size: int, mode: str, cap: int,
+                        gen):
+        """Sharded sparse batch program: each shard regenerates (or
+        slices) its contiguous block of the batch, classifies it, and
+        compacts its own interesting rows into per-shard buffers; the
+        class histogram is the one cross-shard collective (psum).  The
+        host extraction is the base runner's -- per-shard buffer
+        segments are exactly the [shards, ...] leading-axis layout it
+        already consumes."""
+        pack = self._sparse_pack()
+        axes = tuple(self.mesh.axis_names)
+        sizes = tuple(int(n) for n in self.mesh.devices.shape)
+        nd = self.n_devices
+        per = max(1, batch_size // nd)
+        run_one = self._run_one
+        batch_spec = P(axes)
+        out_specs = {"hist": P(), "n_int": batch_spec,
+                     "n_exact": batch_spec, "mask": batch_spec,
+                     "packed": batch_spec, "exact": batch_spec,
+                     "full": {k: batch_spec for k in
+                              ("code", "errors", "corrected", "steps")}}
+
+        def shard_base():
+            idx = jnp.int32(0)
+            for ax, size in zip(axes, sizes):
+                idx = idx * size + jax.lax.axis_index(ax)
+            return idx * per
+
+        def finish(out, base, n_valid):
+            pos = base + jnp.arange(per, dtype=jnp.int32)
+            valid = pos < n_valid
+            return out, valid
+
+        def wrap(out, count_w, valid):
+            o = _sparse_device_outputs(out, count_w, valid, cap, pack)
+            hist = o["hist"]
+            for ax in axes:
+                hist = jax.lax.psum(hist, ax)
+            wrapped = {k: v[None] for k, v in o.items() if k != "hist"}
+            wrapped["hist"] = hist
+            wrapped["full"] = out
+            return wrapped
+
+        if mode == "gen":
+            def body(seed_hi, seed_lo, stream_n, offset, n_valid):
+                base = shard_base()
+                rows = (offset + base.astype(jnp.uint32)
+                        + jnp.arange(per, dtype=jnp.uint32))
+                fault = gen.columns((seed_hi, seed_lo), stream_n, rows)
+                out = jax.vmap(run_one)(fault)
+                out, valid = finish(out, base, n_valid)
+                return wrap(out, valid.astype(jnp.int32), valid)
+
+            fn = _shard_mapped(body, self.mesh,
+                               in_specs=(P(), P(), P(), P(), P()),
+                               out_specs=out_specs)
+        else:
+            def body(fault, count_w, n_valid):
+                out = jax.vmap(run_one)(fault)
+                out, valid = finish(out, shard_base(), n_valid)
+                return wrap(out, count_w, valid)
+
+            fn = _shard_mapped(
+                body, self.mesh,
+                in_specs=({k: batch_spec for k in _FAULT_KEYS},
+                          batch_spec, P()),
+                out_specs=out_specs)
+        return jax.jit(fn)
 
     # -- counts-only campaign mode ------------------------------------------
     def run_histogram(self, n: int, seed: int = 0,

@@ -1,0 +1,110 @@
+"""Sparse-collect smoke driver (unittest/cfg/fast.yml row).
+
+The device-resident campaign loop's contract, regression-checked every
+CI run on CPU in a few seconds:
+
+  * dense and sparse collection at the same seed produce IDENTICAL
+    classification counts and the identical interesting-row set (rows
+    whose class is outside success/corrected), with the on-device flip
+    generation bit-parity-checked against the host schedule;
+  * the measured host<->device transfer bytes shrink (the mode's whole
+    point);
+  * a journaled sparse campaign killed mid-run resumes bit-for-bit,
+    and a dense runner refuses the sparse journal (collection mode is
+    campaign identity);
+  * a tiny interesting-row buffer capacity falls back to dense fetch
+    for overflowing batches without changing any result.
+
+Prints ``Success!`` for the harness driver oracle
+(coast_tpu.testing.harness.run_drivers).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Kill(Exception):
+    """SIGKILL stand-in: aborts the campaign from a progress beat, after
+    the preceding batches' journal records are already fsync'd."""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    del argv
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.journal import JournalMismatchError
+    from coast_tpu.models import mm
+
+    region = mm.make_region()
+    dense = CampaignRunner(TMR(region), strategy_name="TMR")
+    sparse = CampaignRunner(TMR(region), strategy_name="TMR",
+                            collect="sparse")
+
+    a = dense.run(240, seed=17, batch_size=48)
+    b = sparse.run(240, seed=17, batch_size=48)
+    assert a.counts == b.counts, (a.counts, b.counts)
+    interesting = np.flatnonzero(a.codes > 1)
+    assert np.array_equal(interesting, b.interesting_rows), \
+        "sparse interesting-row set diverged from dense"
+    for col in ("codes", "errors", "corrected", "steps"):
+        assert np.array_equal(getattr(a, col)[interesting],
+                              getattr(b, col)), col
+    dense_bytes = a.transfer["up"] + a.transfer["down"]
+    sparse_bytes = b.transfer["up"] + b.transfer["down"]
+    assert sparse_bytes < dense_bytes, (dense_bytes, sparse_bytes)
+    print(f"# host bytes: dense {dense_bytes} -> sparse {sparse_bytes} "
+          f"({dense_bytes / max(sparse_bytes, 1):.1f}x)")
+
+    # Overflow fallback: a 2-row buffer cannot hold the interesting rows
+    # of any batch here, so every batch takes the dense-fetch fallback --
+    # and nothing about the result may change.
+    tiny = CampaignRunner(TMR(region), collect="sparse",
+                          sparse_capacity=2)
+    c = tiny.run(240, seed=17, batch_size=48)
+    assert c.counts == a.counts
+    assert np.array_equal(c.interesting_rows, interesting)
+
+    # Kill + resume, bit-for-bit; dense refuses the sparse journal.
+    with tempfile.TemporaryDirectory() as d:
+        jpath = os.path.join(d, "sparse.journal")
+        beats = {"n": 0}
+
+        def kill_on_second(done, counts):
+            beats["n"] += 1
+            if beats["n"] == 2:
+                raise _Kill()
+
+        try:
+            CampaignRunner(TMR(region), collect="sparse").run(
+                240, seed=17, batch_size=48, journal=jpath,
+                progress=kill_on_second)
+            raise AssertionError("kill hook never fired")
+        except _Kill:
+            pass
+        resumed = CampaignRunner(TMR(region), collect="sparse").run(
+            240, seed=17, batch_size=48, journal=jpath)
+        assert resumed.counts == b.counts
+        assert np.array_equal(resumed.interesting_rows,
+                              b.interesting_rows)
+        for col in ("codes", "errors", "corrected", "steps"):
+            assert np.array_equal(getattr(resumed, col),
+                                  getattr(b, col)), col
+        try:
+            CampaignRunner(TMR(region)).run(240, seed=17, batch_size=48,
+                                            journal=jpath)
+            raise AssertionError("dense resume of a sparse journal "
+                                 "must refuse")
+        except JournalMismatchError:
+            pass
+
+    print("Success!")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -93,6 +93,18 @@ def main(argv=None) -> int:
                     help="FaultModel spec (single / multibit(k=K) / "
                     "cluster(span=S,k=K) / burst(window=W,rate=R)); "
                     "recorded in the journal header and log summary")
+    ap.add_argument("--collect", default="dense",
+                    choices=["dense", "sparse"],
+                    help="result-collection mode for the main campaign: "
+                    "'sparse' keeps the loop device-resident (on-device "
+                    "flip generation + histogram accounting; only "
+                    "interesting rows cross the host boundary)")
+    ap.add_argument("--ab", action="store_true",
+                    help="dense-vs-sparse A/B: after the main campaign, "
+                    "rerun the same schedule with the OTHER collection "
+                    "mode and record both sides' measured host transfer "
+                    "bytes (+ a counts-equal check) in the artifact's "
+                    "collect_ab block")
     args = ap.parse_args(argv)
 
     import jax
@@ -143,7 +155,8 @@ def main(argv=None) -> int:
     # run below must trace the SAME [batch, sites] fault signature the
     # measured chunks dispatch, or the first chunk absorbs the compile.
     runner = CampaignRunner(prog, strategy_name="TMR", mesh=mesh,
-                            fault_model=fault_model)
+                            fault_model=fault_model,
+                            collect=args.collect)
     telemetry = runner.telemetry
     stages["build_s"] = round(time.perf_counter() - t0, 3)
 
@@ -307,6 +320,54 @@ def main(argv=None) -> int:
     assert summary.counts["sdc"] == res.counts["sdc"], (
         summary.counts, res.counts)
 
+    ab_block = None
+    if args.ab:
+        # Dense-vs-sparse A/B over the SAME schedule: identical counts
+        # (and interesting-row sets) are the correctness half, the
+        # measured host-transfer-byte ratio the perf half.
+        other = "sparse" if args.collect == "dense" else "dense"
+        note(f"A/B: rerunning with collect={other}")
+        ab_runner = CampaignRunner(prog, strategy_name="TMR", mesh=mesh,
+                                   fault_model=fault_model, collect=other)
+        with telemetry.span("warmup_ab"):
+            ab_runner.run(args.batch, seed=1, batch_size=args.batch)
+        t0 = time.perf_counter()
+        ab_parts = [ab_runner.run_schedule(
+                        sched.slice(lo, min(lo + chunk, len(sched))),
+                        batch_size=args.batch)
+                    for lo in range(0, len(sched), chunk)]
+        from coast_tpu.inject.campaign import _merge_results as _mr
+        ab_res = _mr(ab_parts, args.seed)
+        ab_seconds = round(time.perf_counter() - t0, 3)
+        sides = {args.collect: res, other: ab_res}
+        d, s = sides["dense"], sides["sparse"]
+        dense_bytes = d.transfer["up"] + d.transfer["down"]
+        sparse_bytes = s.transfer["up"] + s.transfer["down"]
+        if d.counts != s.counts:
+            raise AssertionError(
+                f"A/B counts diverged: dense {d.counts} vs sparse "
+                f"{s.counts}")
+        ab_block = {
+            "n": res.n, "seed": args.seed, "batch": args.batch,
+            "counts_equal": True,
+            "dense": {"transfer_bytes": dict(d.transfer),
+                      "seconds": round(float(d.seconds), 3),
+                      "injections_per_sec":
+                          round(d.injections_per_sec, 1)},
+            "sparse": {"transfer_bytes": dict(s.transfer),
+                       "seconds": round(float(s.seconds), 3),
+                       "injections_per_sec":
+                           round(s.injections_per_sec, 1),
+                       "interesting_rows": int(len(s.codes))},
+            "host_bytes": {"dense": dense_bytes, "sparse": sparse_bytes},
+            "host_bytes_reduction_x": round(
+                dense_bytes / max(sparse_bytes, 1), 1),
+            "ab_seconds": ab_seconds,
+        }
+        note(f"A/B: host bytes dense {dense_bytes} -> sparse "
+             f"{sparse_bytes} "
+             f"({ab_block['host_bytes_reduction_x']}x), counts equal")
+
     artifact = {
         "campaign": res.summary(),
         "stage_seconds": stages,
@@ -322,6 +383,8 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
     }
+    if ab_block is not None:
+        artifact["collect_ab"] = ab_block
     if args.trace_out:
         os.makedirs(os.path.dirname(args.trace_out) or ".", exist_ok=True)
         obs.write_trace(telemetry, args.trace_out,

@@ -1,0 +1,604 @@
+"""Sparse (device-resident) collection tests: the dense-vs-sparse
+parity matrix plus the dense byte pins.
+
+Pins the four guarantees the device-resident loop makes:
+
+* **On-device flip generation is bit-exact** -- the u32-pair splitmix64
+  generator (inject/device_gen) reproduces the host ``generate()``
+  stream (and every fault-model expansion stream) bit for bit, the same
+  differential contract as the native-vs-numpy expansion parity.
+* **Dense == sparse** -- same seed implies identical classification
+  counts AND an identical interesting-row set, across all four fault
+  models, equivalence-weighted campaigns, and mesh sharding; overflow
+  of the interesting-row buffer falls back to dense fetch with no
+  result change.
+* **Collection mode is campaign identity** -- sparse journals resume
+  bit-for-bit and refuse dense resume (and vice versa).
+* **Dense stays byte-identical to pre-PR** -- the dense ndjson row
+  bytes and (normalized) journal batch records are sha-pinned against
+  the tree before sparse collection existed; no new keys appear on the
+  dense path's journal header or queue item dict.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR
+from coast_tpu.inject import classify as cls
+from coast_tpu.inject.campaign import (CampaignRunner, _merge_results,
+                                       _pack_layout, _unpack_rows)
+from coast_tpu.inject.journal import JournalMismatchError
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import FaultModel, generate
+from coast_tpu.inject.spec import CampaignSpec, SpecError
+from coast_tpu.models import mm
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def prog(region):
+    return TMR(region)
+
+
+def _interesting(res):
+    return np.flatnonzero(res.codes > cls.CORRECTED)
+
+
+def _assert_parity(dense_res, sparse_res):
+    assert dense_res.counts == sparse_res.counts
+    rows = _interesting(dense_res)
+    assert np.array_equal(rows, sparse_res.interesting_rows)
+    for col in ("codes", "errors", "corrected", "steps"):
+        assert np.array_equal(getattr(dense_res, col)[rows],
+                              getattr(sparse_res, col)), col
+
+
+# ---------------------------------------------------------------------------
+# On-device generation bit parity (per fault-model kind)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", [
+    FaultModel.single(),
+    FaultModel.multibit(k=4),
+    FaultModel.cluster(span=4, k=3),
+    FaultModel.burst(window=8, rate=0.5),
+], ids=lambda m: m.spec())
+def test_device_gen_bit_parity(region, prog, model):
+    from coast_tpu.inject.device_gen import DeviceScheduleGen
+    mmap = MemoryMap(prog)
+    steps = region.nominal_steps
+    sched = generate(mmap, 257, 11, steps, model=model)
+    want = sched.device_arrays()
+    gen = DeviceScheduleGen(mmap, steps, model)
+    got = gen.rows_np(11, 257, np.arange(257))
+    for key in ("leaf_id", "lane", "word", "bit", "t"):
+        assert np.array_equal(np.asarray(want[key]), got[key]), key
+    # Arbitrary row subsets regenerate too (the per-batch offset path).
+    sub = np.array([3, 77, 256, 9])
+    got2 = gen.rows_np(11, 257, sub)
+    for key in want:
+        assert np.array_equal(np.asarray(want[key])[sub], got2[key]), key
+
+
+def test_device_gen_refuses_oversized_map(region, prog):
+    from coast_tpu.inject.device_gen import (DeviceGenError,
+                                             DeviceScheduleGen)
+    mmap = MemoryMap(prog)
+    gen = DeviceScheduleGen(mmap, region.nominal_steps)
+    gen.total_bits = 1 << 32         # simulate an over-large map
+    with pytest.raises(DeviceGenError):
+        from coast_tpu.inject.device_gen import _mod64
+        _mod64((np.uint32(0), np.uint32(1)), gen.total_bits)
+
+
+# ---------------------------------------------------------------------------
+# Dense-vs-sparse parity matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", [
+    FaultModel.single(),
+    FaultModel.multibit(k=3),
+    FaultModel.cluster(span=4, k=3),
+    FaultModel.burst(window=8, rate=0.5),
+], ids=lambda m: m.spec())
+def test_dense_sparse_parity_models(region, model):
+    dense = CampaignRunner(TMR(region), fault_model=model)
+    sparse = CampaignRunner(TMR(region), fault_model=model,
+                            collect="sparse")
+    a = dense.run(220, seed=7, batch_size=64, start_num=30)
+    b = sparse.run(220, seed=7, batch_size=64, start_num=30)
+    _assert_parity(a, b)
+    assert b.collect == "sparse"
+    assert (b.transfer["up"] + b.transfer["down"]
+            < a.transfer["up"] + a.transfer["down"])
+
+
+def test_dense_sparse_parity_equiv(region):
+    """Equivalence weights ride the device-resident path: the weighted
+    histogram computed on device equals the host weighted bincount."""
+    dense = CampaignRunner(TMR(region), equiv=True)
+    sparse = CampaignRunner(TMR(region), equiv=True, collect="sparse")
+    a = dense.run(400, seed=5, batch_size=64)
+    b = sparse.run(400, seed=5, batch_size=64)
+    _assert_parity(a, b)
+    assert b.physical_n == a.physical_n
+    assert b.n == a.n
+
+
+def test_dense_sparse_parity_mesh(region):
+    from coast_tpu.parallel.mesh import make_mesh
+    dense = CampaignRunner(TMR(region))
+    a = dense.run(300, seed=7, batch_size=64)
+    for mesh in (make_mesh(8),
+                 make_mesh(8, axis_names=("host", "chip"), shape=(4, 2))):
+        sparse = CampaignRunner(TMR(region), mesh=mesh, collect="sparse")
+        b = sparse.run(300, seed=7, batch_size=64)
+        _assert_parity(a, b)
+
+
+def test_mesh_equiv_sparse_parity(region):
+    from coast_tpu.parallel.mesh import make_mesh
+    dense = CampaignRunner(TMR(region), equiv=True)
+    sparse = CampaignRunner(TMR(region), mesh=make_mesh(8), equiv=True,
+                            collect="sparse")
+    a = dense.run(400, seed=5, batch_size=64)
+    b = sparse.run(400, seed=5, batch_size=64)
+    _assert_parity(a, b)
+
+
+def test_overflow_fallback_batch_correctness(region):
+    """A 2-row buffer overflows on every batch here; the per-batch
+    dense-fetch fallback must leave counts AND rows identical."""
+    dense = CampaignRunner(TMR(region)).run(300, seed=7, batch_size=64)
+    tiny = CampaignRunner(TMR(region), collect="sparse",
+                          sparse_capacity=2)
+    b = tiny.run(300, seed=7, batch_size=64)
+    _assert_parity(dense, b)
+    # The fallback fetched dense columns, so down-bytes exceed a
+    # comfortable sparse budget -- but never the result.
+    assert b.transfer["down"] > 300 * 4
+
+
+def test_custom_steps_window_schedule_sparse(region):
+    """A schedule generated with a NON-nominal step window must still
+    match dense under sparse collection: the t-column modulus rides the
+    schedule's own gen metadata, never the region's nominal_steps."""
+    dense = CampaignRunner(TMR(region))
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    steps = region.nominal_steps * 2 + 3
+    a = dense.run_schedule(
+        generate(dense.mmap, 200, 3, steps), batch_size=64)
+    b = sparse.run_schedule(
+        generate(sparse.mmap, 200, 3, steps), batch_size=64)
+    _assert_parity(a, b)
+    assert b.transfer["up"] < 200        # gen path, not resident upload
+
+
+def test_sparse_refuses_overflowing_batch_weights(region):
+    """Per-batch class-weight sums past int32 would wrap the device
+    histogram: refused up front, never silently corrupted."""
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    sched = generate(sparse.mmap, 8, 3, region.nominal_steps)
+    sched.class_weight = np.full(8, 2 ** 30, np.int64)
+    sched.gen_stream_n = None            # weights force the resident path
+    with pytest.raises(ValueError, match="int32"):
+        sparse.run_schedule(sched, batch_size=8)
+
+
+def test_resident_arrays_cover_misaligned_batch_starts(region):
+    """An OOM degrade restarts batches at the first uncollected row --
+    any offset, not a batch multiple.  The resident arrays must have
+    headroom for a full batch_size slice from EVERY start < n."""
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    sched = generate(sparse.mmap, 100, 3, region.nominal_steps)
+    sched.gen_stream_n = None            # force the resident path
+    state = sparse._sparse_setup(sched, 64, {"up": 0, "down": 0})
+    lo = len(sched) - 1                  # worst-case misaligned start
+    for key, arr in state["arrays"].items():
+        assert arr[lo:lo + 64].shape[0] == 64, key
+    assert state["count_w"][lo:lo + 64].shape[0] == 64
+
+
+def test_counts_histogram_roundtrip():
+    binc = np.arange(cls.NUM_CLASSES, dtype=np.int64) * 3
+    counts = cls.counts_dict(binc, train=True)
+    counts["cache_invalid"] = 99         # extra keys ignored
+    assert np.array_equal(cls.counts_histogram(counts), binc)
+    # Absent keys read as zero (the absent-means-zero rule, inverted).
+    assert cls.counts_histogram({"sdc": 4})[cls.SDC] == 4
+    assert cls.counts_histogram({"sdc": 4}).sum() == 4
+
+
+def test_sparse_parser_weighted_runtime(region, tmp_path, monkeypatch):
+    """An equivalence-reduced sparse log's mean-runtime statistic
+    applies the class weights, exactly as the dense paths do."""
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject import logs
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    eq = CampaignRunner(TMR(region), equiv=True, collect="sparse")
+    res = eq.run(400, seed=5, batch_size=64)
+    path = str(tmp_path / "eqsparse.ndjson.json")
+    logs.write_ndjson(res, eq.mmap, path)
+    summary = jp.summarize_path(path)
+    w = res.schedule.class_weight[res.interesting_rows]
+    completed = cls.completed_mask(res.codes)
+    expected = ((res.steps[completed] * w[completed]).sum()
+                / w[completed].sum())
+    assert summary.mean_steps == pytest.approx(expected)
+
+
+def test_stratified_schedule_sparse(region):
+    """Stratified schedules are not stream-regenerable: they take the
+    device-RESIDENT path (one upload) and still match dense."""
+    from coast_tpu.inject.schedule import generate_stratified
+    dense = CampaignRunner(TMR(region))
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    sched = generate_stratified(dense.mmap, 40, 3,
+                                region.nominal_steps)
+    a = dense.run_schedule(sched, batch_size=64)
+    sched2 = generate_stratified(sparse.mmap, 40, 3,
+                                 region.nominal_steps)
+    b = sparse.run_schedule(sched2, batch_size=64)
+    _assert_parity(a, b)
+    assert b.transfer["up"] > 100       # the one-shot resident upload
+
+
+# ---------------------------------------------------------------------------
+# Journal: identity + bit-for-bit resume in both modes
+# ---------------------------------------------------------------------------
+
+class _Kill(Exception):
+    pass
+
+
+def _run_killed(runner, jpath, at_beat=2, **kw):
+    beats = {"n": 0}
+
+    def killer(done, counts):
+        beats["n"] += 1
+        if beats["n"] == at_beat:
+            raise _Kill()
+
+    with pytest.raises(_Kill):
+        runner.run(journal=jpath, progress=killer, **kw)
+
+
+@pytest.mark.parametrize("collect", ["dense", "sparse"])
+def test_journal_resume_bit_for_bit(region, tmp_path, collect):
+    full = CampaignRunner(TMR(region), collect=collect).run(
+        240, seed=17, batch_size=48)
+    jpath = str(tmp_path / "c.journal")
+    _run_killed(CampaignRunner(TMR(region), collect=collect), jpath,
+                n=240, seed=17, batch_size=48)
+    resumed = CampaignRunner(TMR(region), collect=collect).run(
+        240, seed=17, batch_size=48, journal=jpath)
+    assert resumed.counts == full.counts
+    assert np.array_equal(resumed.codes, full.codes)
+    if collect == "sparse":
+        assert np.array_equal(resumed.interesting_rows,
+                              full.interesting_rows)
+
+
+def test_collect_mode_is_identity(region, tmp_path):
+    jpath = str(tmp_path / "s.journal")
+    _run_killed(CampaignRunner(TMR(region), collect="sparse"), jpath,
+                n=240, seed=17, batch_size=48)
+    with pytest.raises(JournalMismatchError):
+        CampaignRunner(TMR(region)).run(240, seed=17, batch_size=48,
+                                        journal=jpath)
+    jpath2 = str(tmp_path / "d.journal")
+    _run_killed(CampaignRunner(TMR(region)), jpath2,
+                n=240, seed=17, batch_size=48)
+    with pytest.raises(JournalMismatchError):
+        CampaignRunner(TMR(region), collect="sparse").run(
+            240, seed=17, batch_size=48, journal=jpath2)
+
+
+def test_sparse_journal_record_shape(region, tmp_path):
+    jpath = str(tmp_path / "rec.journal")
+    CampaignRunner(TMR(region), collect="sparse").run(
+        120, seed=17, batch_size=48, journal=jpath)
+    recs = [json.loads(line) for line in open(jpath)]
+    assert recs[0]["collect"] == "sparse"
+    batches = [r for r in recs if r.get("kind") == "batch"]
+    assert batches and all(r.get("sparse") for r in batches)
+    for r in batches:
+        assert len(r["hist"]) == cls.NUM_CLASSES
+        assert len(r["rows"]) == len(r["codes"])
+        # hist sums to the batch's counted rows (no invalid draws here)
+        assert sum(r["hist"]) == r["n"]
+
+
+# ---------------------------------------------------------------------------
+# Dense byte pins (pre-PR parity)
+# ---------------------------------------------------------------------------
+
+#: sha256 of the dense mm-TMR seed-7 n-128 ndjson ROW bytes and of the
+#: normalized journal batch records (spans/stage_seconds stripped),
+#: captured on the pre-sparse tree: the dense path must stay
+#: byte-identical.
+_DENSE_NDJSON_ROWS_SHA = \
+    "47e4c985909f18661dd98d4a149a090bf815215ac8f458a8aecf722d0a497ee6"
+_DENSE_JOURNAL_BATCH_SHA = \
+    "4dd44f4112ff86954abb4c7073f8340d566ed28ca22cc289ec59853a01d027e4"
+
+
+def test_dense_bytes_pinned_pre_pr(region, tmp_path, monkeypatch):
+    from coast_tpu.inject import logs
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    runner = CampaignRunner(TMR(region), strategy_name="TMR")
+    res = runner.run(128, seed=7, batch_size=64)
+    path = str(tmp_path / "pin.ndjson.json")
+    logs.write_ndjson(res, runner.mmap, path)
+    head, *rows = open(path, "rb").read().splitlines()
+    assert hashlib.sha256(b"\n".join(rows)).hexdigest() \
+        == _DENSE_NDJSON_ROWS_SHA
+    summary = json.loads(head)["summary"]
+    assert "collect" not in summary
+    assert "interesting_rows" not in summary
+    # transfer_bytes is a volatile telemetry block (like stages), but
+    # its VALUES are deterministic for a fixed geometry.
+    assert summary["transfer_bytes"] == {"up": 128 * 5 * 4,
+                                         "down": 128 * 4 * 4}
+
+    jpath = str(tmp_path / "pin.journal")
+    runner.run(128, seed=7, batch_size=64, journal=jpath)
+    recs = [json.loads(line) for line in open(jpath)]
+    assert "collect" not in recs[0]
+    norm = []
+    for r in recs[1:]:
+        r = dict(r)
+        r.pop("spans", None)
+        r.pop("stage_seconds", None)
+        norm.append(json.dumps(r, separators=(",", ":"), sort_keys=True))
+    assert hashlib.sha256("\n".join(norm).encode()).hexdigest() \
+        == _DENSE_JOURNAL_BATCH_SHA
+
+
+def test_queue_item_dict_unchanged_for_dense():
+    """Enqueue ids sha the item dict: the dense item must not grow a
+    key, and the sparse key joins only when set."""
+    dense = CampaignSpec(benchmark="matrixMultiply", n=64).to_item()
+    assert "collect" not in dense
+    sparse = CampaignSpec(benchmark="matrixMultiply", n=64,
+                          collect="sparse").to_item()
+    assert sparse["collect"] == "sparse"
+    assert CampaignSpec.from_item(sparse).collect == "sparse"
+    assert CampaignSpec.from_item(dense).collect == "dense"
+
+
+def test_spec_validation():
+    with pytest.raises(SpecError):
+        CampaignSpec(benchmark="mm", n=4, collect="weird").validate()
+    with pytest.raises(SpecError):
+        CampaignSpec(benchmark="mm", n=4, collect="sparse", equiv=True,
+                     delta_from="x.journal").validate()
+    CampaignSpec(benchmark="mm", n=4, collect="sparse").validate()
+
+
+def test_header_collect_rule():
+    from coast_tpu.inject.spec import header_collect
+    assert header_collect({}) == "dense"
+    assert header_collect({"collect": "sparse"}) == "sparse"
+    assert CampaignSpec.from_header(
+        {"benchmark": "mm", "n": 4, "collect": "sparse"}).collect \
+        == "sparse"
+
+
+# ---------------------------------------------------------------------------
+# Packed-word layout
+# ---------------------------------------------------------------------------
+
+def test_pack_layout_and_sentinel_roundtrip():
+    e, f, t = _pack_layout(out_words=81, max_steps=200)
+    assert 4 + e + f + t == 32 and f >= 1
+    sentinel = (1 << f) - 1
+    # In-range row packs exactly; sentinel row defers to the exact
+    # buffer.
+    code, E, F, T = 2, 81, 3, 199
+    word = (np.uint32(code) | np.uint32(E << 4)
+            | np.uint32(F << (4 + e)) | np.uint32(T << (4 + e + f)))
+    packed = np.array([word,
+                       np.uint32(4 | (sentinel << (4 + e)))], np.uint32)
+    exact = np.array([[123456, -7, 99999]], np.int32)
+    c, ee, ff, tt = _unpack_rows(packed, exact, (e, f, t))
+    assert list(c) == [2, 4]
+    assert list(ee) == [81, 123456]
+    assert list(ff) == [3, -7]
+    assert list(tt) == [199, 99999]
+
+
+# ---------------------------------------------------------------------------
+# Logs / analysis / stream
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sparse_pair(region):
+    dense = CampaignRunner(TMR(region), strategy_name="TMR")
+    sparse = CampaignRunner(TMR(region), strategy_name="TMR",
+                            collect="sparse")
+    return (dense.run(240, seed=17, batch_size=48),
+            sparse.run(240, seed=17, batch_size=48), sparse)
+
+
+def test_sparse_ndjson_and_parser(sparse_pair, tmp_path, monkeypatch):
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject import logs
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    a, b, runner = sparse_pair
+    path = str(tmp_path / "sparse.ndjson.json")
+    logs.write_ndjson(b, runner.mmap, path)
+    head, *rows = open(path).read().splitlines()
+    assert len(rows) == len(b.codes)
+    numbers = [json.loads(r)["number"] for r in rows]
+    assert numbers == [int(r) for r in b.interesting_rows]
+    summary = jp.summarize_path(path)
+    assert summary.n == a.n
+    assert {k: summary.counts[k] for k, v in a.counts.items()
+            if k in summary.counts} == {
+                k: v for k, v in a.counts.items() if k != "cache_invalid"}
+    assert summary.collect == "sparse"
+    assert summary.transfer and summary.transfer["down"] > 0
+    assert "host transfer" in summary.format()
+
+
+def test_sparse_columnar_and_json(sparse_pair, tmp_path, monkeypatch):
+    from coast_tpu.analysis import json_parser as jp
+    from coast_tpu.inject import logs
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    a, b, runner = sparse_pair
+    cpath = str(tmp_path / "sparse.columnar.json")
+    logs.write_columnar(b, runner.mmap, cpath)
+    doc = json.load(open(cpath))
+    assert doc["columns"]["number"] == [int(r) for r in b.interesting_rows]
+    summary = jp.summarize_path(cpath)
+    assert summary.n == a.n
+    assert summary.counts["sdc"] == a.counts["sdc"]
+    jpath = str(tmp_path / "sparse.json")
+    logs.write_json(b, runner.mmap, jpath)
+    summary2 = jp.summarize_path(jpath)
+    assert summary2.counts["sdc"] == a.counts["sdc"]
+
+
+def test_sparse_stream_matches_oneshot(region, tmp_path, monkeypatch):
+    from coast_tpu.inject import logs
+    monkeypatch.setattr(logs, "_timestamp",
+                        lambda: "2026-01-01 00:00:00.000000")
+    runner = CampaignRunner(TMR(region), strategy_name="TMR",
+                            collect="sparse")
+    spath = str(tmp_path / "stream.ndjson.json")
+    w = logs.StreamLogWriter(spath, runner.mmap, fmt="ndjson")
+    res = runner.run(240, seed=17, batch_size=48, stream=w)
+    w.finish(res)
+    opath = str(tmp_path / "oneshot.ndjson.json")
+    logs.write_ndjson(res, runner.mmap, opath)
+    s_rows = open(spath, "rb").read().splitlines()[1:]
+    o_rows = open(opath, "rb").read().splitlines()[1:]
+    assert s_rows == o_rows
+
+
+def test_sparse_refuses_reference_writer(sparse_pair, tmp_path):
+    """The reference container has no summary block to carry the sparse
+    histogram: refused at the library level (and CLI-gated)."""
+    from coast_tpu.inject import logs
+    _a, b, runner = sparse_pair
+    with pytest.raises(ValueError, match="dense"):
+        logs.write_reference_json(b, runner.mmap,
+                                  str(tmp_path / "ref.json"))
+
+
+def test_compile_cache_key_separates_collect(region, tmp_path):
+    """A warm cache hit must never serve a runner in the other
+    collection mode: collect joins the cache key."""
+    from coast_tpu.fleet.compile_cache import CompileCache
+    from coast_tpu.fleet.queue import item_spec
+    cache = CompileCache(str(tmp_path / "cache"))
+    dense_item = item_spec("matrixMultiply", 64, seed=1)
+    sparse_item = item_spec("matrixMultiply", 64, seed=1,
+                            collect="sparse")
+    r1, _, k1, _ = cache.runner(dense_item)
+    r2, _, k2, _ = cache.runner(sparse_item)
+    assert k1 != k2
+    assert r1.collect == "dense" and r2.collect == "sparse"
+    assert r1 is not r2
+
+
+def test_sparse_stream_refuses_columnar(region, tmp_path):
+    from coast_tpu.inject import logs
+    runner = CampaignRunner(TMR(region), collect="sparse")
+    w = logs.StreamLogWriter(str(tmp_path / "x.json"), runner.mmap,
+                             fmt="columnar")
+    with pytest.raises(ValueError):
+        runner.run(96, seed=17, batch_size=48, stream=w)
+    w.abort()
+
+
+# ---------------------------------------------------------------------------
+# Misc surfaces
+# ---------------------------------------------------------------------------
+
+def test_sparse_merge_results(region):
+    """campaign_1m's chunked pattern: run_schedule slices merged with
+    schedule-global interesting rows."""
+    dense = CampaignRunner(TMR(region))
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    a = dense.run(256, seed=9, batch_size=64)
+    sched = generate(sparse.mmap, 256, 9, region.nominal_steps)
+    parts = [sparse.run_schedule(sched.slice(lo, lo + 128), batch_size=64)
+             for lo in (0, 128)]
+    merged = _merge_results(parts, 9)
+    assert merged.counts == a.counts
+    assert np.array_equal(merged.interesting_rows, _interesting(a))
+    assert merged.transfer["down"] == sum(
+        p.transfer["down"] for p in parts)
+
+
+def test_sparse_refuses_chunk_and_delta_paths(region):
+    sparse = CampaignRunner(TMR(region), collect="sparse")
+    with pytest.raises(ValueError):
+        sparse.run_until_errors(1, seed=0, batch_size=32)
+    eq = CampaignRunner(TMR(region), equiv=True, collect="sparse")
+    with pytest.raises(ValueError):
+        eq.run_delta(64, "/nonexistent.journal")
+
+
+def test_metrics_transfer_counters(region):
+    from coast_tpu.obs.metrics import CampaignMetrics
+    hub = CampaignMetrics()
+    runner = CampaignRunner(TMR(region), collect="sparse", metrics=hub)
+    runner.run(120, seed=17, batch_size=48)
+    snap = hub.snapshot()
+    assert snap["transfer_bytes"]["up"] > 0
+    assert snap["transfer_bytes"]["down"] > 0
+    text = hub.prometheus()
+    assert "coast_campaign_transfer_bytes_total" in text
+    assert 'direction="up"' in text
+
+
+def test_supervisor_collect_sparse(region, tmp_path, monkeypatch):
+    from coast_tpu.inject import supervisor
+    rc = supervisor.main([
+        "-f", "matrixMultiply", "-t", "96", "--batch-size", "48",
+        "--seed", "17", "--collect", "sparse",
+        "--log-format", "ndjson", "-l", str(tmp_path)])
+    assert rc == 0
+    from coast_tpu.analysis import json_parser as jp
+    logp = tmp_path / "matrixMultiply_TMR_memory.json"
+    summary = jp.summarize_path(str(logp))
+    assert summary.n == 96
+    assert summary.collect == "sparse"
+
+
+def test_fleet_sparse_item_parity(region, tmp_path):
+    """A sparse queue item drains through a stock worker and passes the
+    fleet merge's journal parity check (sparse batch records' codes
+    concat IS the interesting-row codes the done record sha's)."""
+    from coast_tpu.fleet.queue import CampaignQueue, item_spec
+    from coast_tpu.fleet.supervisor import merge_fleet
+    from coast_tpu.fleet.worker import Worker
+    q = CampaignQueue(str(tmp_path / "q"))
+    q.enqueue(item_spec("matrixMultiply", 96, seed=17, batch_size=48,
+                        collect="sparse"))
+    w = Worker(q, "w0", lease_s=30.0)
+    assert w.drain() == 1
+    merged = merge_fleet(q)
+    assert merged["parity"] == "ok"
+    # Same section vocabulary as the worker builds (the item's default
+    # "memory" filter), so the counts comparison is apples-to-apples.
+    from coast_tpu.inject.supervisor import section_filter
+    prog2 = TMR(region)
+    dense = CampaignRunner(
+        prog2, sections=section_filter(prog2, "memory")).run(
+            96, seed=17, batch_size=48)
+    assert merged["totals"] == {k: int(v) for k, v in dense.counts.items()}

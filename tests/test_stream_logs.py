@@ -138,8 +138,12 @@ def test_stream_resume_mid_campaign_same_file(runner, tmp_path, monkeypatch):
     def norm(r):
         # seconds is wall clock (differs per run) and lands in the
         # summary header: normalise it so file equality tests the rows
-        # and the deterministic summary fields.
-        return dataclasses.replace(r, seconds=1.0, stages={})
+        # and the deterministic summary fields.  transfer is the same
+        # volatile-telemetry class: the resumed process honestly moved
+        # fewer bytes (its replayed prefix came from disk, not the
+        # device).
+        return dataclasses.replace(r, seconds=1.0, stages={},
+                                   transfer={})
 
     a, b = str(tmp_path / "full.json"), str(tmp_path / "resumed.json")
     w = logs.StreamLogWriter(a, runner.mmap, fmt="ndjson")
