@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -124,12 +124,25 @@ ci_smoke:
 sparse_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.sparse_smoke
 
+# Static fault-propagation smoke (also a fast.yml driver row):
+# vulnerability-map verdicts cross-validated against a live seeded
+# campaign, the lane-isolation noninterference proof on clean builds,
+# the seeded voter-bypass refutation with counterexample paths, and the
+# static-budget delta allocator.
+propagation_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.propagation_smoke
+
 # The repo gating itself (ROADMAP item 3's end-game): delta-check the
 # current tree against the committed baseline artifact.  Exit 0 = the
 # protection distributions are unchanged, 1 = drift (a protection
 # regression -- investigate before merging), 2 = infra failure (e.g.
 # the memory map changed: rebuild the baseline with
-# `python -m coast_tpu ci refresh`).
+# `python -m coast_tpu ci refresh`).  The check opens with the static
+# lane-isolation pre-gate: every target's current build must carry a
+# noninterference proof BEFORE any delta campaign is enqueued (a
+# refuted proof is an immediate drift verdict with counterexample
+# paths), and re-injection budget is allocated by the static
+# vulnerability map (sdc-possible sections first).
 ci_protection:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu ci check \
 	    --baseline artifacts/ci_baseline.json

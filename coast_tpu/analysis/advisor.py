@@ -86,6 +86,9 @@ class Advice:
     protected_words: int = 0
     total_words: int = 0
     baseline_rate: float = 0.0          # post-stratified population estimate
+    # static_seed=True: the per-leaf static vulnerability verdicts the
+    # probe campaign was seeded with (analysis/propagation).
+    static_verdicts: Optional[Dict[str, str]] = None
 
     @property
     def config_text(self) -> str:
@@ -185,7 +188,8 @@ def advise(region: Region,
            batch_size: int = 2048,
            validate: bool = True,
            stratified: bool = True,
-           cost_aware: bool = False) -> Advice:
+           cost_aware: bool = False,
+           static_seed: bool = False) -> Advice:
     """Recommend a selective xMR scope for ``region``.
 
     ``budget`` faults are injected into the unprotected program
@@ -198,15 +202,48 @@ def advise(region: Region,
     which can reach the same target with a smaller replication footprint.
     ``validate=True`` re-runs the campaign against the recommended
     selective TMR and full TMR for the achieved rates.
+
+    ``static_seed=True`` seeds the loop with the static vulnerability
+    prior (:mod:`coast_tpu.analysis.propagation`): leaves the map proves
+    ``masked`` are dropped from the probe schedule (their strata are
+    reallocated to leaves that can actually harm -- a flip the analysis
+    proves dead needs no samples), and the recommended protect list is
+    ordered by the static ranking -- verdict tier first (``sdc-possible``
+    before statically-covered leaves), measured population harm
+    contribution within a tier.  The contribution ordering is what makes
+    a quarter-budget probe reproduce the full-budget ranking: per-leaf
+    conditional rates of similar-harm leaves swap under sampling noise,
+    their size-weighted contributions do not (pinned on mm in tests).
     """
     runner = CampaignRunner(unprotected(region), strategy_name="none")
+    static_verdicts: Optional[Dict[str, str]] = None
+    masked_names: FrozenSet[str] = frozenset()
+    if static_seed:
+        from coast_tpu.analysis.propagation import (VERDICT_MASKED,
+                                                    analyze_propagation)
+        vmap = analyze_propagation(runner.prog)
+        static_verdicts = vmap.section_verdicts()
+        masked_names = frozenset(n for n, v in static_verdicts.items()
+                                 if v == VERDICT_MASKED)
     if stratified:
         # Equal-allocation stratified attribution: every leaf measured at
         # the same resolution (size-weighted sampling starves 1-word ctrl
         # leaves next to KiB buffers); population rates recovered below by
         # size-reweighting (post-stratification).
-        sched = generate_stratified_total(runner.mmap, budget, seed,
+        n_sections = len(runner.mmap.sections)
+        n_live = max(1, n_sections - len(masked_names))
+        # Static seeding reallocates the provably-masked strata: same
+        # total budget, more probes per leaf that can actually harm.
+        probe_total = budget * n_sections // n_live if masked_names \
+            else budget
+        sched = generate_stratified_total(runner.mmap, probe_total, seed,
                                           region.nominal_steps)
+        if masked_names:
+            lid_of = {s.leaf_id: s.name for s in runner.mmap.sections}
+            keep = np.flatnonzero(np.array(
+                [lid_of.get(int(l), "?") not in masked_names
+                 for l in np.asarray(sched.leaf_id)]))
+            sched = runner._take_rows(sched, keep)
         # One-shot campaign: clamp the batch to the schedule (run_schedule
         # edge-pads every batch, and a small stratified budget would
         # otherwise pay for padding rows -- 4x waste at the defaults).
@@ -279,6 +316,22 @@ def advise(region: Region,
             protect_set = _sor_closure(region, flow, protect_set | {h.name})
 
     annotations = _selective_region(region, protect_set).spec
+    if static_verdicts is not None:
+        # The static ranking: verdict tier first (sdc-possible leaves
+        # lead), size-weighted harm CONTRIBUTION within a tier -- the
+        # statistic that stays stable at a quarter of the probe budget
+        # where per-leaf conditional rates of neighbouring leaves swap
+        # under noise.
+        tier = {"sdc-possible": 0, "detected-bounded": 1, "masked": 2}
+        protect_list = sorted(
+            (h.name for h in harms if h.name in protect_set),
+            key=lambda nm: (tier.get(static_verdicts.get(nm, ""), 0),
+                            -(weight.get(nm, 0.0)
+                              * by_name[nm].harm_rate),
+                            nm)) + sorted(protect_set - set(by_name))
+    else:
+        protect_list = ([h.name for h in harms if h.name in protect_set]
+                        + sorted(protect_set - set(by_name)))
     advice = Advice(
         region_name=region.name,
         target_harm=target_harm,
@@ -286,14 +339,14 @@ def advise(region: Region,
         # protect lists the full closed set (harm-table order first, then
         # any closure members outside it, e.g. non-injectable leaves), so
         # config_text round-trips to exactly the validated scope.
-        protect=([h.name for h in harms if h.name in protect_set]
-                 + sorted(protect_set - set(by_name))),
+        protect=protect_list,
         annotations=annotations,
         baseline=base.summary(),
         protected_words=sum(by_name[n].words for n in protect_set
                             if n in by_name),
         total_words=sum(h.words for h in harms),
         baseline_rate=pop_rate(frozenset()),
+        static_verdicts=static_verdicts,
     )
 
     if validate and protect_set:
@@ -334,6 +387,12 @@ def main(argv=None) -> int:
     ap.add_argument("--cost-aware", action="store_true",
                     help="greedy by harm removed per replicated word "
                          "(smaller footprint for the same target)")
+    ap.add_argument("--static-seed", action="store_true",
+                    help="seed the loop with the static vulnerability "
+                         "prior (analysis/propagation): masked leaves "
+                         "are not probed, and the protect ranking is "
+                         "verdict tier + harm contribution (stable at a "
+                         "fraction of the probe budget)")
     ap.add_argument("-o", metavar="PATH",
                     help="write the functions.config snippet here")
     args = ap.parse_args(argv)
@@ -361,7 +420,8 @@ def main(argv=None) -> int:
     adv = advise(region, budget=args.e,
                  target_harm=args.t, seed=args.seed,
                  validate=not args.no_validate,
-                 cost_aware=args.cost_aware)
+                 cost_aware=args.cost_aware,
+                 static_seed=args.static_seed)
     print(adv.format())
     if args.o:
         with open(args.o, "w") as f:

@@ -63,9 +63,17 @@ from typing import Dict, FrozenSet, List, Optional, Set
 import jax
 import numpy as np
 
-from coast_tpu.analysis.lint.provenance import (_Val, _Walker, _live_eqns,
-                                                trace_step)
-from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
+# The walk machinery lives in the shared fault-propagation walker
+# (analysis/propagation/walker.py) since the static vulnerability map
+# joined: one abstract interpretation feeds the partition, the map, and
+# the isolation prover.  Re-exported names keep this module the
+# historical import point.
+from coast_tpu.analysis.propagation.walker import (_DETECTOR_CLASSES,
+                                                   _STRUCTURAL_PRIMS,
+                                                   _VALUE_OPERANDS,
+                                                   _TaintWalk,
+                                                   _detector_tag,
+                                                   analyze_step)
 
 # Merge modes, coarsest first.  The class key keeps only the coordinates
 # the mode names; everything else is proven outcome-irrelevant.
@@ -75,43 +83,6 @@ MODE_LTW = 2       # class = (leaf, t, word)
 MODE_EXH = 3       # class = (leaf, t, word, bit, lane) -- no merge
 
 MODE_NAMES = ("free", "lt", "ltw", "exhaustive")
-
-# Primitives that move words verbatim: a flipped word passes through
-# them unchanged (or is dropped), never arithmetically transformed.
-# Operand positions listed in _VALUE_OPERANDS are *steering* inputs
-# (predicates, indices): a flipped value there changes WHICH words move,
-# which is value-dependent -- consuming a tainted steering operand marks
-# the leaf value-fed.
-_STRUCTURAL_PRIMS = frozenset({
-    "select_n", "dynamic_update_slice", "dynamic_slice", "slice",
-    "reshape", "transpose", "broadcast_in_dim", "squeeze", "concatenate",
-    "rev", "copy", "gather", "scatter", "pad", "stop_gradient",
-})
-
-_VALUE_OPERANDS = {
-    "select_n": lambda eqn: (0,),
-    "dynamic_slice": lambda eqn: tuple(range(1, len(eqn.invars))),
-    "dynamic_update_slice": lambda eqn: tuple(range(2, len(eqn.invars))),
-    "gather": lambda eqn: (1,),
-    "scatter": lambda eqn: (1,),
-    "pad": lambda eqn: (),
-}
-
-# Sync classes whose tag marks a *detector* on the tagged value: taint
-# entering one is guaranteed either masked (lanes equal) or latched/
-# repaired there, so it stops propagating.  'guard' is deliberately NOT
-# in this set -- kernel guards read raw per-lane values and trip
-# value-dependently, so their consumption must count as value-feeding.
-_DETECTOR_CLASSES = frozenset({
-    "load_addr", "store_data", "ctrl", "stack", "sor_crossing",
-    "boundary", "call_boundary", "cfcss",
-    # Training regions' weight-update commit votes (KIND_PARAM /
-    # KIND_OPT_STATE leaves).  Note these detectors never LICENSE a
-    # merge on a train region -- the train fallback below forces every
-    # section exhaustive first; the membership only keeps the taint walk
-    # honest about where votes kill verbatim-word flow.
-    "param", "opt_state",
-})
 
 #: EquivPartition.fallback_reason value for training regions: the
 #: outcome class of a train SDC is a function of the *numeric value* of
@@ -123,147 +94,6 @@ _DETECTOR_CLASSES = frozenset({
 #: weights that would silently misreport wrong-weight outcomes.
 TRAIN_FALLBACK = ("train_probe outcome semantics are bit-value-dependent; "
                   "all sections forced exhaustive")
-
-
-def _detector_tag(tag: str) -> bool:
-    if tag.startswith(TAG_VOTER) and not tag.startswith(TAG_VIEW):
-        return True
-    if tag.startswith(TAG_SYNC):
-        klass = tag[len(TAG_SYNC):].partition(":")[0]
-        return klass in _DETECTOR_CLASSES
-    return False
-
-
-class _TaintWalk:
-    """Forward word-verbatim taint over a (nested) jaxpr.
-
-    ``env[var]`` is the frozenset of leaf names whose unmodified words
-    may be present in ``var``.  Taint passes through structural
-    primitives, dies at detector tags (sanctioned votes), and marks a
-    leaf ``value_fed`` wherever a live equation consumes its taint
-    non-structurally (arithmetic, reductions, steering operands, guard
-    inputs).
-    """
-
-    def __init__(self, live: Optional[Set[int]]):
-        self.env: Dict[object, FrozenSet[str]] = {}
-        self.value_fed: Set[str] = set()
-        self.live = live
-
-    def val(self, v) -> FrozenSet[str]:
-        from jax.extend.core import Literal
-        if isinstance(v, Literal):
-            return frozenset()
-        return self.env.get(v, frozenset())
-
-    def _set(self, v, taint: FrozenSet[str]) -> None:
-        old = self.env.get(v)
-        self.env[v] = taint if old is None else (old | taint)
-
-    def seed(self, inner_vars, taints) -> None:
-        for iv, t in zip(inner_vars, taints):
-            self._set(iv, t)
-
-    def _is_live(self, eqn) -> bool:
-        return self.live is None or id(eqn) in self.live
-
-    def _feed(self, eqn, taint: FrozenSet[str]) -> None:
-        if taint and self._is_live(eqn):
-            self.value_fed |= taint
-
-    def walk(self, jaxpr) -> List[FrozenSet[str]]:
-        for eqn in jaxpr.eqns:
-            ins = [self.val(v) for v in eqn.invars]
-            outs = self._eqn_outs(eqn, ins)
-            for v, t in zip(eqn.outvars, outs):
-                self._set(v, t)
-        return [self.val(v) for v in jaxpr.outvars]
-
-    def _eqn_outs(self, eqn, ins):
-        prim = eqn.primitive.name
-        params = eqn.params
-        union = frozenset().union(*ins) if ins else frozenset()
-
-        if prim == "name":
-            tag = str(params.get("name", ""))
-            if _detector_tag(tag):
-                return [frozenset()]
-            if tag.startswith(TAG_SPOF):
-                # Single-lane call boundary: the callee sees raw lane-0
-                # values -- value consumption by definition.
-                self._feed(eqn, union)
-                return [frozenset()]
-            return [ins[0] if ins else frozenset()]
-
-        if prim == "optimization_barrier":
-            # n-ary identity fence: words pass through verbatim, per
-            # position -- neither consumed nor mixed.
-            return list(ins)
-
-        if prim in _STRUCTURAL_PRIMS:
-            value_pos = _VALUE_OPERANDS.get(prim, lambda e: ())(eqn)
-            data = frozenset()
-            for i, t in enumerate(ins):
-                if i in value_pos:
-                    self._feed(eqn, t)
-                else:
-                    data |= t
-            return [data for _ in eqn.outvars]
-
-        # -- control flow / nested jaxprs --
-        if prim == "cond" and "branches" in params:
-            self._feed(eqn, ins[0])
-            per_branch = []
-            for br in params["branches"]:
-                self.seed(br.jaxpr.invars, ins[1:])
-                per_branch.append(self.walk(br.jaxpr))
-            outs = []
-            for i in range(len(eqn.outvars)):
-                o = frozenset()
-                for b in per_branch:
-                    o |= b[i]
-                outs.append(o)
-            return outs
-        if prim == "while":
-            cn, bn = params["cond_nconsts"], params["body_nconsts"]
-            cj, bj = params["cond_jaxpr"].jaxpr, params["body_jaxpr"].jaxpr
-            carry = list(ins[cn + bn:])
-            for _ in range(len(carry) + 2):
-                self.seed(cj.invars, ins[:cn] + carry)
-                cond_out = self.walk(cj)
-                self._feed(eqn, cond_out[0] if cond_out else frozenset())
-                self.seed(bj.invars, ins[cn:cn + bn] + carry)
-                new_carry = self.walk(bj)
-                joined = [c | nc for c, nc in zip(carry, new_carry)]
-                if joined == carry:
-                    break
-                carry = joined
-            return carry
-        if prim == "scan":
-            sub = params["jaxpr"].jaxpr
-            nc, ncar = params["num_consts"], params["num_carry"]
-            consts, carry = list(ins[:nc]), list(ins[nc:nc + ncar])
-            xs = list(ins[nc + ncar:])
-            outs = None
-            for _ in range(max(ncar, 1) + 2):
-                self.seed(sub.invars, consts + carry + xs)
-                outs = self.walk(sub)
-                joined = [c | nc_ for c, nc_ in zip(carry, outs[:ncar])]
-                if joined == carry:
-                    break
-                carry = joined
-            return carry + list(outs[ncar:])
-        for key in ("jaxpr", "call_jaxpr"):
-            if key in params:
-                sub = params[key]
-                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
-                self.seed(sub.invars, ins)
-                return self.walk(sub)
-
-        # Any other primitive transforms values: tainted inputs are
-        # value-fed, outputs carry no verbatim words.
-        self._feed(eqn, union)
-        return [frozenset() for _ in eqn.outvars]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -486,93 +316,36 @@ def _clean_steps(prog) -> int:
     return int(rec["steps"])
 
 
-def analyze_equivalence(prog, closed=None) -> EquivPartition:
+def analyze_equivalence(prog, closed=None, facts=None) -> EquivPartition:
     """Derive the propagation-equivalence partition of ``prog``'s
-    fault-site space.  ``closed`` forwards an already-traced step jaxpr
-    (scripts/lint_sweep.py traces once and shares it with the lint)."""
+    fault-site space.  ``closed`` forwards an already-traced step jaxpr;
+    ``facts`` forwards a full shared-walk result
+    (:func:`coast_tpu.analysis.propagation.walker.analyze_step` -- one
+    walk feeds this partition, the static vulnerability map, and the
+    isolation prover; scripts/lint_sweep.py shares all three)."""
     cfg = prog.cfg
     region = prog.region
     n = cfg.num_clones
-    if closed is None:
-        closed = trace_step(prog)
-    jaxpr = closed.jaxpr
-
-    pstate, flags = jax.eval_shape(prog.init_pstate)
-    state_names = sorted(pstate)
-    flag_names = sorted(flags)
-    assert len(jaxpr.invars) == len(state_names) + len(flag_names) + 1, (
-        len(jaxpr.invars), len(state_names), len(flag_names))
-
-    # -- lattice walk (shared machinery with lint_provenance) ------------
-    walker = _Walker(n)
-    taints: List[FrozenSet[str]] = []
-    for name, var in zip(state_names, jaxpr.invars):
-        status = "laned" if prog.replicated.get(name) else "shared"
-        walker.env[var] = _Val(status, 0, False, False, frozenset({name}))
-        taints.append(frozenset({name}))
-    out_vals = walker.walk(jaxpr)
-
-    live: Set[int] = set()
-    _live_eqns(jaxpr, list(jaxpr.outvars), live)
-
-    # -- value-feeding taint walk ----------------------------------------
-    taint = _TaintWalk(live)
-    for var, t in zip(jaxpr.invars, taints):
-        taint._set(var, t)
-    taint.walk(jaxpr)
-
-    # -- per-leaf facts ---------------------------------------------------
-    out_names = state_names + flag_names
-    consumed: Set[str] = set()
-    for out_name, val in zip(out_names, out_vals):
-        for dep in val.deps:
-            if dep != out_name:
-                consumed.add(dep)
-    # The write set comes from the REGION's dataflow roles (the same
-    # analysis the engine derives its store syncs from): in the
-    # protected step's jaxpr every leaf gets fresh outvars (vmap,
-    # freeze-select), so var identity cannot tell a semantic write from
-    # a passthrough.  Synthetic (CFCSS) leaves are not region leaves;
-    # they are EXH below regardless.
-    from coast_tpu.passes.verification import analyze
-    written = set(analyze(region).written)
-
-    # Live single-lane extractions / unsanctioned collapses implicate
-    # their provenance leaves: lane symmetry is not provable there.
-    lane_flagged: Set[str] = set()
-    for key, cand in walker.candidates.items():
-        if key in live:
-            lane_flagged |= set(cand["deps"])
-
-    guards = (region.stack_guard is not None
-              or region.assert_guard is not None)
+    if facts is None:
+        # The partition reads only the boolean taint facts; skip the
+        # witness-path bookkeeping the vulnerability map would want.
+        facts = analyze_step(prog, closed=closed, track_paths=False)
+    jaxpr = facts.jaxpr
+    walker, live, taint = facts.walker, facts.live, facts.taint
+    written, consumed = facts.written, facts.consumed
+    lane_flagged = facts.lane_flagged
+    guards, cfcss = facts.guards, facts.cfcss
+    fn_unsafe = facts.fn_unsafe
     # Training regions (Region.train_probe): the outcome class depends
     # on the flip's numeric VALUE -- classify splits SDC by whether the
     # loss re-converged, and a low bit of a weight heals where the same
     # word's exponent bit diverges -- so the bit/word/lane-dropping
     # merge arguments above are all unsound.  Typed, documented
     # fallback: every section exhaustive (only the dead class merges).
-    train_fallback = getattr(region, "train_probe", None) is not None
-    cfcss = getattr(prog, "_cfcss_step", None) is not None
-    fn_unsafe = n > 1 and any(
-        scope not in ("replicated", "replicated_return")
-        for scope in getattr(prog, "fn_scope", {}).values())
+    train_fallback = facts.train_fallback
+    check_walker, check_closed = facts.check_walker, facts.check_closed
 
     clean_steps = _clean_steps(prog)
-
-    # check() cone for fingerprints + shared-leaf transparency.
-    check_walker = _Walker(n)
-    check_closed = None
-    try:
-        check_closed = jax.make_jaxpr(region.check)(
-            jax.eval_shape(region.init))
-        check_names = sorted(jax.eval_shape(region.init))
-        for name, var in zip(check_names, check_closed.jaxpr.invars):
-            check_walker.env[var] = _Val("shared", 0, False, False,
-                                         frozenset({name}))
-        check_walker.walk(check_closed.jaxpr)
-    except Exception:       # noqa: BLE001 - fingerprint falls back to spec
-        check_closed = None
 
     signatures: Dict[str, SectionSignature] = {}
     for leaf_id, (name, kind, lanes, words) in enumerate(

@@ -41,31 +41,59 @@ __all__ = ["Finding", "LintReport", "ReplicationLintError", "check",
 def lint_program(prog, provenance: bool = True, survival: bool = True,
                  strategy: Optional[str] = None,
                  baseline: Optional[Set[str]] = None,
-                 closed=None) -> LintReport:
+                 closed=None, propagation: bool = False,
+                 facts=None) -> LintReport:
     """Run the requested lint levels over a ProtectedProgram.
     ``closed`` forwards an already-traced step jaxpr (callers that also
-    dump the jaxpr, e.g. opt, trace once and share)."""
+    dump the jaxpr, e.g. opt, trace once and share).
+
+    ``propagation`` adds the third static pass: the lane-isolation
+    noninterference prover (:mod:`coast_tpu.analysis.propagation`).
+    Each refuted leak lands as an ``isolation-leak`` error finding
+    carrying its counterexample dataflow path, so the standard gates
+    (``opt``'s refuse-to-run, ``CampaignRunner(preflight=)``) cover it
+    with no new plumbing.  Pure jaxpr analysis -- no extra compile;
+    ``facts`` forwards an already-built shared walk
+    (:func:`~coast_tpu.analysis.propagation.walker.analyze_step`) so
+    callers that also build the vulnerability map walk once."""
     name = strategy or f"N={prog.cfg.num_clones}"
     report = LintReport(benchmark=prog.region.name, strategy=name)
-    # One trace shared by both passes (flagship steps take seconds to
+    # One trace shared by all passes (flagship steps take seconds to
     # trace; the survival pass only needs the jaxpr for vote counting).
-    if closed is None and (provenance or survival):
-        closed = trace_step(prog)
+    if closed is None and (provenance or survival or propagation):
+        closed = facts.closed if facts is not None else trace_step(prog)
     if provenance:
         lint_provenance(prog, report, closed=closed)
     if survival:
         lint_survival(prog, report, closed=closed)
+    if propagation:
+        from coast_tpu.analysis.propagation import prove_isolation
+        report.passes_run.append("propagation")
+        proof = prove_isolation(prog, closed=closed, facts=facts,
+                                strategy=name)
+        for leak in proof.leaks:
+            report.add(
+                "isolation-leak", "error", f"output:{leak.output}",
+                f"noninterference refuted: {leak.source} reaches step "
+                f"output '{leak.output}' without a sanctioned vote "
+                "(counterexample: " + " -> ".join(leak.path) + ")")
+        if proof.total_leak_paths > len(proof.leaks):
+            report.add(
+                "isolation-leak", "error", "output:<more>",
+                f"{proof.total_leak_paths - len(proof.leaks)} further "
+                "leak path(s) suppressed from the report")
     if baseline:
         report.apply_baseline(baseline)
     return report
 
 
 def check(prog, provenance: bool = True, survival: bool = True,
-          baseline: Optional[Set[str]] = None) -> LintReport:
+          baseline: Optional[Set[str]] = None,
+          propagation: bool = False) -> LintReport:
     """Gate: lint and raise :class:`ReplicationLintError` on any
     unsuppressed error finding (the refuse-to-emit analogue)."""
     report = lint_program(prog, provenance=provenance, survival=survival,
-                          baseline=baseline)
+                          baseline=baseline, propagation=propagation)
     if not report.ok:
         raise ReplicationLintError(report)
     return report

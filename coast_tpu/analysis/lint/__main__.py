@@ -10,6 +10,16 @@ options::
     python -m coast_tpu.analysis.lint -TMR crc16 --no-survival
     python -m coast_tpu.analysis.lint -TMR crc16 --baseline lint_baseline.json
     python -m coast_tpu.analysis.lint -TMR crc16 --write-baseline b.json
+    python -m coast_tpu.analysis.lint -TMR crc16 --propagation
+
+``--propagation`` adds the third static pass: the lane-isolation
+noninterference prover gates alongside the other rules (leaks land as
+``isolation-leak`` error findings with counterexample paths), and the
+static vulnerability map -- per-section ``masked`` /
+``detected-bounded`` / ``sdc-possible`` verdicts with ACE-bit counts --
+is printed per target (and recorded under a ``propagation`` key in the
+``--json`` export).  The map needs one compiled fault-free run per
+target to bound the live flip window.
 
 Exit status: 0 when every report is error-free (after baseline
 suppression), 1 otherwise, 2 on usage errors.
@@ -30,6 +40,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = None
     write_baseline = None
     survival = True
+    propagation = False
     sweep_all = False
     rest: List[str] = []
     i = 0
@@ -48,6 +59,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 write_baseline = argv[i]
         elif arg == "--no-survival":
             survival = False
+        elif arg == "--propagation":
+            propagation = True
         elif arg == "--all":
             sweep_all = True
         elif arg.startswith("--"):
@@ -106,6 +119,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     reports = []
+    prop_maps = {}
     for bench in benches:
         try:
             region = resolve_region(bench)
@@ -113,10 +127,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         except SoRViolation as e:
             print(str(e), file=sys.stderr)
             return 1
+        closed = lint.trace_step(prog)
+        facts = None
+        if propagation:
+            from coast_tpu.analysis.propagation import analyze_step
+            facts = analyze_step(prog, closed=closed)
         rep = lint.lint_program(prog, survival=survival,
-                                strategy=strategy, baseline=base)
+                                strategy=strategy, baseline=base,
+                                closed=closed, propagation=propagation,
+                                facts=facts)
         reports.append(rep)
         print(rep.format())
+        if propagation:
+            from coast_tpu.analysis.propagation import analyze_propagation
+            vmap = analyze_propagation(prog, facts=facts)
+            prop_maps[f"{bench}:{strategy}"] = vmap.summary()
+            print(vmap.format())
 
     if write_baseline is not None:
         from coast_tpu.analysis.lint.findings import write_baseline_set
@@ -126,6 +152,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         doc = {"strategy": strategy,
                "survival": survival,
                "reports": [r.to_dict() for r in reports]}
+        if propagation:
+            doc["propagation"] = prop_maps
         os.makedirs(os.path.dirname(json_out) or ".", exist_ok=True)
         with open(json_out, "w") as fh:
             json.dump(doc, fh, indent=1)

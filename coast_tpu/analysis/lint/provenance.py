@@ -521,37 +521,29 @@ def lint_provenance(prog, report: Optional[LintReport] = None,
     _live_eqns(jaxpr, list(jaxpr.outvars), live)
 
     # -- lane-collapse / spof findings ----------------------------------
-    live_cands = [c for k, c in walker.candidates.items() if k in live]
-    by_src: Dict[int, List[Dict[str, object]]] = {}
-    for c in live_cands:
-        by_src.setdefault(id(c["src"]), []).append(c)
-    for cands in by_src.values():
-        lanes_seen = {c["lane"] for c in cands}
-        if (all(c["kind"] == "spof" for c in cands)
-                and None not in lanes_seen
-                and lanes_seen == set(range(n))):
-            # Every lane extracted from this source: the segmented
-            # scheduler's fan-out, each replica consumed exactly once.
-            continue
-        for c in cands:
-            leaves = "+".join(sorted(c["deps"])) or "?"
-            if c["kind"] == "spof":
-                lane = c["lane"]
-                where = f"lane {lane}" if lane is not None \
-                    else "a traced lane index"
-                report.add(
-                    "spof", "error", f"eqn:{c['prim']}:{leaves}",
-                    f"single lane ({where}) extracted from live "
-                    f"replicated dataflow of {leaves} outside a "
-                    "sanctioned voter: one corruptible copy now stands "
-                    "for all replicas")
-            else:
-                report.add(
-                    "lane-collapse", "error",
-                    f"eqn:{c['prim']}:{leaves}",
-                    f"{c['prim']} merges the lane axis of {leaves} "
-                    "outside a sanctioned voter: replicas are combined "
-                    "without majority voting")
+    # The surviving candidate set (all-lane fan-out filtered as the
+    # segmented scheduler's sanctioned pattern) is shared with the
+    # isolation prover: ONE acceptance rule, spelled once.
+    from coast_tpu.analysis.propagation.walker import cross_lane_sites
+    for c in cross_lane_sites(walker, live, n):
+        leaves = "+".join(sorted(c["deps"])) or "?"
+        if c["kind"] == "spof":
+            lane = c["lane"]
+            where = f"lane {lane}" if lane is not None \
+                else "a traced lane index"
+            report.add(
+                "spof", "error", f"eqn:{c['prim']}:{leaves}",
+                f"single lane ({where}) extracted from live "
+                f"replicated dataflow of {leaves} outside a "
+                "sanctioned voter: one corruptible copy now stands "
+                "for all replicas")
+        else:
+            report.add(
+                "lane-collapse", "error",
+                f"eqn:{c['prim']}:{leaves}",
+                f"{c['prim']} merges the lane axis of {leaves} "
+                "outside a sanctioned voter: replicas are combined "
+                "without majority voting")
 
     # -- observed tags (live only) --------------------------------------
     live_tags = [t for k, t in walker.tags.items() if k in live]

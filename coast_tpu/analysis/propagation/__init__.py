@@ -1,0 +1,47 @@
+"""Static fault-propagation analysis: know the outcome before injecting.
+
+The third static pass (after the replication-integrity lint and the
+fault-site equivalence partition), built on the same shared
+fault-propagation walker (:mod:`walker` -- one abstract interpretation
+of the protected step feeds all three):
+
+  * :mod:`vulnmap` -- the ACE-style **static vulnerability map**: each
+    (memory-map section, bit class) gets a provable verdict --
+    ``masked`` (dead, un-ACE), ``detected-bounded`` (every escape path
+    crosses a sanctioned voter/guard/boundary sync), or ``sdc-possible``
+    (an unvoted escape path exists, reported with its witness dataflow
+    path) -- cross-validated against recorded campaign distributions.
+  * :mod:`isolation` -- the **lane-isolation noninterference prover**:
+    flips in replica lanes cannot flow into other lanes, shared state,
+    or step flags except through sanctioned voted commits; refutations
+    carry counterexample paths, and :func:`seeded_voter_bypass` is the
+    generic seeded regression.
+
+Wired as: the ``opt`` build gate + ``-propOut=`` JSON, ``python -m
+coast_tpu.analysis.lint --propagation``, ``CampaignRunner(preflight=
+"propagation")``, the ``coast_tpu ci`` isolation pre-gate, and the
+delta-campaign budget allocator (``run_delta(static_budget=True)``
+spends convergence budget on ``sdc-possible`` sections first).
+"""
+
+from __future__ import annotations
+
+from coast_tpu.analysis.propagation.walker import (StepFacts, TraceTaint,
+                                                   analyze_step,
+                                                   cross_lane_sites)
+from coast_tpu.analysis.propagation.vulnmap import (VERDICT_DETECTED,
+                                                    VERDICT_MASKED,
+                                                    VERDICT_SDC, VERDICTS,
+                                                    VulnRow,
+                                                    VulnerabilityMap,
+                                                    analyze_propagation,
+                                                    crossvalidate_counts)
+from coast_tpu.analysis.propagation.isolation import (IsolationProof, Leak,
+                                                      prove_isolation,
+                                                      seeded_voter_bypass)
+
+__all__ = ["StepFacts", "TraceTaint", "analyze_step", "cross_lane_sites",
+           "VERDICT_MASKED", "VERDICT_DETECTED", "VERDICT_SDC", "VERDICTS",
+           "VulnRow", "VulnerabilityMap", "analyze_propagation",
+           "crossvalidate_counts", "IsolationProof", "Leak",
+           "prove_isolation", "seeded_voter_bypass"]

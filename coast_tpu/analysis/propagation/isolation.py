@@ -1,0 +1,362 @@
+"""Lane-isolation noninterference prover.
+
+The continuous-protection serving scenario (ROADMAP item 5) runs fault
+injection on spare replica lanes while live lanes serve traffic -- which
+is only safe if a flipped lane's value provably cannot reach anything
+outside its own lane except through a sanctioned, voted commit.  This
+module proves exactly that property over the protected step's jaxpr:
+
+**Theorem (lane noninterference).**  For a protected program whose step
+contains no *live unsanctioned cross-lane dataflow site* -- no lane-axis
+collapse and no single-lane extraction outside a ``coast:voter`` /
+``coast:sync:*`` / ``coast:view:*`` tag (modulo the configured
+single-lane call allowlist, reported as explicit assumptions) -- a fault
+injected into one replica lane can influence another lane, a shared
+leaf, or a step flag only through a sanctioned voted commit.  Combined
+with the engine's unconditional region-boundary sync, any surviving
+divergence is detected (DWC) or corrected (TMR) before the served view.
+
+The proof is constructive both ways:
+
+  * when it HOLDS, the prover reports the discharged obligations -- the
+    live sanctioned vote tags (every cross-lane commit the program
+    makes) and the configured single-lane-call assumptions;
+  * when it FAILS, every leak carries a **counterexample path**: the
+    dataflow chain from the unsanctioned cross-lane site to the step
+    output it reaches.  Leak taint deliberately does NOT die at later
+    voter tags -- once a single lane's value has fanned out to every
+    replica, all lanes agree on the corrupt value and no majority can
+    witness it (that is precisely why the bypass is a bug).
+
+The seeded regression (:func:`seeded_voter_bypass`) builds exactly that
+bug generically for any registry target: every vote returns lane 0's
+value with no sanction tag and no miscompare, i.e. an injected-lane
+value routed around the voter.  ``scripts/lint_sweep.py`` proves the
+clean build AND catches the seeded bypass for every registry target
+under TMR and DWC; tests pin the subset live.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from coast_tpu.analysis.propagation.walker import (StepFacts, _detector_tag,
+                                                   analyze_step,
+                                                   cross_lane_sites,
+                                                   eqn_entry)
+from coast_tpu.ops.voters import TAG_SPOF
+
+__all__ = ["Leak", "IsolationProof", "prove_isolation",
+           "seeded_voter_bypass"]
+
+#: Cap the reported leaks (every output a pervasive leak reaches would
+#: otherwise repeat the same counterexample dozens of times).
+_MAX_LEAKS = 16
+_PATH_MAX = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class Leak:
+    """One noninterference counterexample."""
+
+    rule: str                 # "spof" | "lane-collapse"
+    source: str               # the cross-lane site (prim + leaves)
+    output: str               # step output (leaf or flag) reached
+    path: Tuple[str, ...]     # dataflow chain site -> output
+
+    def format(self) -> str:
+        return (f"[{self.rule}] {self.source} -> output '{self.output}' "
+                f"via " + " -> ".join(self.path))
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "source": self.source,
+                "output": self.output, "path": list(self.path)}
+
+
+@dataclasses.dataclass
+class IsolationProof:
+    """The prover's verdict for one protected program."""
+
+    benchmark: str
+    strategy: str
+    num_clones: int
+    holds: bool
+    vacuous: bool                       # nothing replicated: no lanes
+    leaks: List[Leak]
+    total_leak_paths: int               # before the report cap
+    voted_commits: List[str]            # live sanctioned tags (obligations
+    #                                     discharged by the engine)
+    assumptions: List[str]              # accepted single-lane calls
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "benchmark": self.benchmark,
+            "strategy": self.strategy,
+            "num_clones": self.num_clones,
+            "holds": self.holds,
+            "vacuous": self.vacuous,
+            "leaks": [l.to_dict() for l in self.leaks],
+            "total_leak_paths": self.total_leak_paths,
+            "voted_commits": list(self.voted_commits),
+            "assumptions": list(self.assumptions),
+        }
+
+    def format(self) -> str:
+        if self.vacuous:
+            return (f"isolation {self.benchmark} [{self.strategy}]: "
+                    "vacuously holds (nothing replicated)")
+        if self.holds:
+            return (f"isolation {self.benchmark} [{self.strategy}]: "
+                    f"HOLDS ({len(self.voted_commits)} voted commit(s), "
+                    f"{len(self.assumptions)} single-lane-call "
+                    "assumption(s))")
+        lines = [f"isolation {self.benchmark} [{self.strategy}]: "
+                 f"LEAK ({self.total_leak_paths} path(s))"]
+        for l in self.leaks:
+            lines.append("  " + l.format())
+        return "\n".join(lines)
+
+
+class _LeakFlow:
+    """Forward leak-reachability with counterexample paths.
+
+    Taint elements are integer leak ids injected at the unsanctioned
+    cross-lane sites; they propagate through EVERYTHING (arithmetic,
+    steering, control flow, even later voters -- an already-fanned-out
+    corruption is lane-identical and invisible to any majority) and are
+    collected at the jaxpr outputs."""
+
+    def __init__(self, inject: Dict[int, int],
+                 roots: Dict[int, Tuple[str, ...]]):
+        self.inject = inject              # id(eqn) -> leak id
+        self.roots = roots                # leak id -> root path
+        self.env: Dict[object, FrozenSet[int]] = {}
+        self.path: Dict[object, Dict[int, Tuple[str, ...]]] = {}
+
+    def val(self, v) -> FrozenSet[int]:
+        from jax.extend.core import Literal
+        if isinstance(v, Literal):
+            return frozenset()
+        return self.env.get(v, frozenset())
+
+    def _set(self, v, taint: FrozenSet[int]) -> None:
+        old = self.env.get(v)
+        self.env[v] = taint if old is None else (old | taint)
+
+    def seed(self, inner_vars, taints) -> None:
+        for iv, t in zip(inner_vars, taints):
+            self._set(iv, t)
+
+    def _in_path(self, eqn, lid: int) -> Tuple[str, ...]:
+        from jax.extend.core import Literal
+        for iv in eqn.invars:
+            if isinstance(iv, Literal):
+                continue
+            d = self.path.get(iv)
+            if d is not None and lid in d:
+                return d[lid]
+        return self.roots.get(lid, ())
+
+    def walk(self, jaxpr) -> List[FrozenSet[int]]:
+        for eqn in jaxpr.eqns:
+            ins = [self.val(v) for v in eqn.invars]
+            outs = self._eqn_outs(eqn, ins)
+            inj = self.inject.get(id(eqn))
+            entry = eqn_entry(eqn)
+            for v, t in zip(eqn.outvars, outs):
+                if inj is not None:
+                    t = t | frozenset({inj})
+                self._set(v, t)
+                if t:
+                    d = self.path.setdefault(v, {})
+                    for lid in t:
+                        if lid not in d:
+                            p = (self.roots[lid] if lid == inj
+                                 and lid not in d else
+                                 self._in_path(eqn, lid))
+                            d[lid] = (p + (entry,) if len(p) < _PATH_MAX
+                                      else p)
+        return [self.val(v) for v in jaxpr.outvars]
+
+    def _eqn_outs(self, eqn, ins):
+        prim = eqn.primitive.name
+        params = eqn.params
+        union = frozenset().union(*ins) if ins else frozenset()
+
+        if prim == "optimization_barrier":
+            return list(ins)
+        if prim == "cond" and "branches" in params:
+            per_branch = []
+            for br in params["branches"]:
+                self.seed(br.jaxpr.invars, ins[1:])
+                per_branch.append(self.walk(br.jaxpr))
+            outs = []
+            for i in range(len(eqn.outvars)):
+                o = frozenset(ins[0])       # a leaked predicate steers
+                for b in per_branch:
+                    o |= b[i]
+                outs.append(o)
+            return outs
+        if prim == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            cj, bj = params["cond_jaxpr"].jaxpr, params["body_jaxpr"].jaxpr
+            carry = list(ins[cn + bn:])
+            for _ in range(len(carry) + 2):
+                self.seed(cj.invars, ins[:cn] + carry)
+                cond_out = self.walk(cj)
+                steer = cond_out[0] if cond_out else frozenset()
+                self.seed(bj.invars, ins[cn:cn + bn] + carry)
+                new_carry = self.walk(bj)
+                joined = [c | nc | steer
+                          for c, nc in zip(carry, new_carry)]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry
+        if prim == "scan":
+            sub = params["jaxpr"].jaxpr
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts, carry = list(ins[:nc]), list(ins[nc:nc + ncar])
+            xs = list(ins[nc + ncar:])
+            outs = None
+            for _ in range(max(ncar, 1) + 2):
+                self.seed(sub.invars, consts + carry + xs)
+                outs = self.walk(sub)
+                joined = [c | nc_ for c, nc_ in zip(carry, outs[:ncar])]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry + list(outs[ncar:])
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in params:
+                sub = params[key]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                self.seed(sub.invars, ins)
+                return self.walk(sub)
+
+        # Everything else -- arithmetic, compares, name tags, structural
+        # moves: leak influence propagates.  (A sanctioned voter cannot
+        # un-leak a value that already fanned out lane-identically.)
+        return [union for _ in eqn.outvars]
+
+
+def prove_isolation(prog, closed=None,
+                    facts: Optional[StepFacts] = None,
+                    strategy: Optional[str] = None) -> IsolationProof:
+    """Prove (or refute, with counterexample paths) lane noninterference
+    for ``prog``'s protected step.  Pure static analysis -- no compile,
+    no clean run; safe as a pre-gate on every build."""
+    if facts is None:
+        facts = analyze_step(prog, closed=closed, track_paths=False)
+    n = facts.num_clones
+    strategy = strategy or f"N={n}"
+    benchmark = prog.region.name
+
+    if n <= 1 or not any(prog.replicated.get(k)
+                         for k in prog.region.spec):
+        return IsolationProof(
+            benchmark=benchmark, strategy=strategy, num_clones=n,
+            holds=True, vacuous=True, leaks=[], total_leak_paths=0,
+            voted_commits=[], assumptions=[])
+
+    # Discharged obligations + configured assumptions, from the live tags.
+    voted: Set[str] = set()
+    assumptions: Set[str] = set()
+    for key, tag in facts.walker.tags.items():
+        if key not in facts.live:
+            continue
+        if _detector_tag(tag):
+            voted.add(tag)
+        elif tag.startswith(TAG_SPOF):
+            assumptions.add(tag[len(TAG_SPOF):])
+
+    # The interference sources: live unsanctioned cross-lane sites.
+    sites = cross_lane_sites(facts.walker, facts.live, n)
+    inject: Dict[int, int] = {}
+    roots: Dict[int, Tuple[str, ...]] = {}
+    site_desc: Dict[int, Tuple[str, str]] = {}
+    for lid, cand in enumerate(sites):
+        eqn = cand["eqn"]
+        leaves = "+".join(sorted(cand["deps"])) or "?"
+        desc = f"{cand['prim']} over {leaves}"
+        if cand["kind"] == "spof" and cand.get("lane") is not None:
+            desc += f" (lane {cand['lane']})"
+        inject[id(eqn)] = lid
+        roots[lid] = (desc,)
+        site_desc[lid] = (str(cand["kind"]), desc)
+
+    leaks: List[Leak] = []
+    total = 0
+    if inject:
+        flow = _LeakFlow(inject, roots)
+        out_taints = flow.walk(facts.jaxpr)
+        for out_name, outvar, taint in zip(facts.out_names,
+                                           facts.jaxpr.outvars,
+                                           out_taints):
+            for lid in sorted(taint):
+                total += 1
+                if len(leaks) >= _MAX_LEAKS:
+                    continue
+                kind, desc = site_desc[lid]
+                path = flow.path.get(outvar, {}).get(lid, roots[lid])
+                leaks.append(Leak(rule=kind, source=desc,
+                                  output=out_name, path=path))
+
+    return IsolationProof(
+        benchmark=benchmark, strategy=strategy, num_clones=n,
+        holds=total == 0, vacuous=False, leaks=leaks,
+        total_leak_paths=total, voted_commits=sorted(voted),
+        assumptions=sorted(assumptions))
+
+
+@contextlib.contextmanager
+def seeded_voter_bypass():
+    """Regression seam: build protected programs whose votes route lane
+    0's value around the voter -- no majority, no miscompare, no
+    sanction tag.  The generic "injected-lane value reaches the served
+    state" bug the isolation prover must catch on every target.
+
+    Must wrap BOTH the program construction and the analysis trace (the
+    engine binds ``voters.vote`` at construction and applies
+    ``voters.sync_tag`` at trace time)::
+
+        with seeded_voter_bypass():
+            prog = TMR(region)
+            proof = prove_isolation(prog)
+        assert not proof.holds and proof.leaks[0].path
+    """
+    from coast_tpu.ops import voters
+
+    orig_vote = voters.vote
+    orig_sync = voters.sync_tag
+    orig_view = voters.lane_view
+
+    def bypass_sync(lanes, klass, leaf):
+        return lanes                     # the sanction tag is dropped
+
+    def bypass_vote(lanes, num_clones):
+        import jax.numpy as jnp
+        del num_clones
+        # Lane 0 verbatim, and the miscompare that would have latched
+        # the divergence is constant-false: the voter is fully bypassed.
+        return lanes[0], jnp.array(False)
+
+    def bypass_view(lanes):
+        # The DWC boundary read without its coast:view sanction: the
+        # served view consumes a raw injected lane.  (DWC's voters are
+        # detect-only -- the voted value is discarded, so the committed
+        # state carries no cross-lane flow to leak; the boundary view
+        # is where lane 0 reaches the response.)
+        return lanes[0]
+
+    voters.vote = bypass_vote
+    voters.sync_tag = bypass_sync
+    voters.lane_view = bypass_view
+    try:
+        yield
+    finally:
+        voters.vote = orig_vote
+        voters.sync_tag = orig_sync
+        voters.lane_view = orig_view

@@ -1,0 +1,361 @@
+"""Static fault-propagation vulnerability map: ACE-style verdicts per
+(section, bit-class), before any campaign runs.
+
+FastFlip (arXiv:2403.13989) shows SDC-propagation analysis can be
+computed statically from program structure; COAST's engine invariants
+(unconditional region-boundary sync, sanctioned vote tags, structural
+word routing -- the same soundness arguments the equivalence partition
+stands on) make three verdicts provable per memory-map section from the
+shared fault-propagation walk alone:
+
+  * ``masked`` -- a flip provably never changes the outcome: the leaf is
+    dead state (never influences another leaf, a flag, or the check()
+    verdict), so every injected bit is un-ACE.
+  * ``detected-bounded`` -- every path a corrupted word can take to a
+    step output crosses a sanctioned voter/guard/boundary sync: TMR
+    corrects it, DWC latches it, the boundary sync witnesses it.  No
+    silent escape exists; ACE bits are covered bits.
+  * ``sdc-possible`` -- an unvoted escape path exists (value-fed
+    arithmetic, a shared leaf visible to every lane identically, a
+    check()-read oracle leaf, per-lane guards/CFCSS, single-lane
+    scopes), reported with the WITNESS dataflow path the taint walk
+    recorded.  This is where injection budget belongs.
+
+Soundness contract (cross-validated, pinned in tests/test_propagation.py
+against the recorded ``artifacts/equiv_study.json`` per-section
+distributions and ``artifacts/train_campaign.json`` kind attribution):
+a section this pass calls ``masked`` or ``detected-bounded`` must show
+ZERO silent-data-corruption outcomes in the recorded campaigns.
+Training regions inherit the equivalence pass's typed fallback
+(:data:`~coast_tpu.analysis.equiv.partition.TRAIN_FALLBACK`): their
+outcome classes are bit-VALUE-dependent (a low-mantissa weight flip
+self-heals where the same word's exponent bit diverges persistently --
+the PR 10 counterexample), so every section is ``sdc-possible`` and
+never ``masked``.
+
+Bit classes refine the map along the axis that matters for f32 training
+state (sign / exponent / mantissa -- the self-heal-vs-persist split);
+integer state gets one ``word`` class (no static bit distinction is
+sound there -- mm's ``phase`` and crc16's ``crc`` are the pinned
+counterexamples).
+
+ACE accounting (Mukherjee's architectural-correct-execution bits): each
+row carries ``bits`` (lanes x words x class width) and ``ace_bits``
+(bits that can affect the outcome, scaled by the live-time fraction --
+sites firing at or past the fault-free halt step are dead by the
+equivalence pass's argument).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+
+from coast_tpu.analysis.propagation.walker import StepFacts, analyze_step
+
+__all__ = ["VERDICT_MASKED", "VERDICT_DETECTED", "VERDICT_SDC",
+           "VERDICTS", "VulnRow", "VulnerabilityMap",
+           "analyze_propagation", "crossvalidate_counts"]
+
+VERDICT_MASKED = "masked"
+VERDICT_DETECTED = "detected-bounded"
+VERDICT_SDC = "sdc-possible"
+#: Worst-last ordering: the section verdict is the max over bit classes,
+#: and the CI budget allocator sorts sdc-possible first.
+VERDICTS = (VERDICT_MASKED, VERDICT_DETECTED, VERDICT_SDC)
+
+_CLASS_BITS = {"word": 32, "sign": 1, "exponent": 8, "mantissa": 23}
+_F32_CLASSES = ("sign", "exponent", "mantissa")
+_WORD_CLASSES = ("word",)
+
+
+@dataclasses.dataclass(frozen=True)
+class VulnRow:
+    """One (section, bit-class) cell of the static vulnerability map."""
+
+    section: str
+    kind: str
+    bit_class: str            # word | sign | exponent | mantissa
+    verdict: str              # masked | detected-bounded | sdc-possible
+    reason: str
+    witness: Tuple[str, ...]  # dataflow path for sdc-possible, else ()
+    bits: int                 # lanes x words x class width
+    ace_bits: int             # bits that can affect the outcome
+
+    def to_dict(self) -> Dict[str, object]:
+        doc: Dict[str, object] = {
+            "section": self.section, "kind": self.kind,
+            "bit_class": self.bit_class, "verdict": self.verdict,
+            "reason": self.reason, "bits": self.bits,
+            "ace_bits": self.ace_bits,
+        }
+        if self.witness:
+            doc["witness"] = list(self.witness)
+        return doc
+
+
+@dataclasses.dataclass
+class VulnerabilityMap:
+    """Per-section x per-bit-class static verdicts for one protected
+    program, plus the ACE accounting the CI budget allocator reads."""
+
+    benchmark: str
+    num_clones: int
+    clean_steps: int
+    nominal_steps: int
+    live_fraction: float
+    rows: Dict[str, List[VulnRow]]       # section -> bit-class rows
+    fallback_reason: Optional[str] = None
+
+    def section_verdicts(self) -> Dict[str, str]:
+        """Worst verdict per section (the CI budget unit)."""
+        rank = {v: i for i, v in enumerate(VERDICTS)}
+        return {name: max((r.verdict for r in rows), key=rank.get)
+                for name, rows in self.rows.items()}
+
+    def verdict(self, section: str) -> str:
+        return self.section_verdicts()[section]
+
+    def counts(self) -> Dict[str, int]:
+        out = {v: 0 for v in VERDICTS}
+        for v in self.section_verdicts().values():
+            out[v] += 1
+        return out
+
+    def ace_summary(self) -> Dict[str, int]:
+        total = ace = covered = exposed = 0
+        for rows in self.rows.values():
+            for r in rows:
+                total += r.bits
+                ace += r.ace_bits
+                if r.verdict == VERDICT_DETECTED:
+                    covered += r.ace_bits
+                elif r.verdict == VERDICT_SDC:
+                    exposed += r.ace_bits
+        return {"total_bits": total, "ace_bits": ace,
+                "detected_bounded_ace_bits": covered,
+                "sdc_possible_ace_bits": exposed}
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "benchmark": self.benchmark,
+            "num_clones": self.num_clones,
+            "clean_steps": self.clean_steps,
+            "nominal_steps": self.nominal_steps,
+            "live_fraction": round(self.live_fraction, 6),
+            **({"fallback_reason": self.fallback_reason}
+               if self.fallback_reason else {}),
+            "verdict_counts": self.counts(),
+            "ace": self.ace_summary(),
+            "sections": {
+                name: {"verdict": self.section_verdicts()[name],
+                       "kind": rows[0].kind if rows else "?",
+                       "bit_classes": [r.to_dict() for r in rows]}
+                for name, rows in sorted(self.rows.items())},
+        }
+
+    def format(self) -> str:
+        lines = [f"--- static vulnerability map: {self.benchmark} "
+                 f"(N={self.num_clones}, live "
+                 f"{100 * self.live_fraction:.0f}% of the flip window) ---"]
+        verdicts = self.section_verdicts()
+        for name in sorted(self.rows):
+            rows = self.rows[name]
+            ace = sum(r.ace_bits for r in rows)
+            bits = sum(r.bits for r in rows)
+            lines.append(f"  {name:<18} {verdicts[name]:<17} "
+                         f"ace {ace}/{bits} bits  [{rows[0].kind}]")
+            for r in rows:
+                if r.verdict == VERDICT_SDC and r.witness:
+                    lines.append(f"      {r.bit_class}: "
+                                 + " -> ".join(r.witness))
+        c = self.counts()
+        lines.append(f"  verdicts: {c[VERDICT_SDC]} sdc-possible, "
+                     f"{c[VERDICT_DETECTED]} detected-bounded, "
+                     f"{c[VERDICT_MASKED]} masked")
+        return "\n".join(lines)
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.summary(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+
+def _bit_classes(dtype) -> Sequence[str]:
+    try:
+        import numpy as np
+        if np.dtype(dtype) == np.float32:
+            return _F32_CLASSES
+    except Exception:       # noqa: BLE001 - unknown dtype: one word class
+        pass
+    return _WORD_CLASSES
+
+
+def analyze_propagation(prog, closed=None, facts: Optional[StepFacts] = None,
+                        partition=None) -> VulnerabilityMap:
+    """Derive the static vulnerability map of ``prog``.
+
+    ``closed``/``facts`` forward an already-traced step jaxpr / shared
+    walk (one walk serves lint + equivalence + propagation);
+    ``partition`` forwards an already-built
+    :class:`~coast_tpu.analysis.equiv.EquivPartition` so the fault-free
+    halt step (one compiled clean run) is measured once per program, not
+    once per pass."""
+    from coast_tpu.analysis.equiv.partition import (TRAIN_FALLBACK,
+                                                    _clean_steps,
+                                                    _cone_entries)
+    region = prog.region
+    if facts is None:
+        facts = analyze_step(prog, closed=closed)
+    clean_steps = (partition.clean_steps if partition is not None
+                   else _clean_steps(prog))
+    nominal = max(int(getattr(region, "nominal_steps", 1)), 1)
+    live_fraction = max(0.0, min(1.0, clean_steps / nominal))
+
+    state_shapes = jax.eval_shape(region.init)
+    witnesses = getattr(facts.taint, "witness", {})
+
+    rows: Dict[str, List[VulnRow]] = {}
+    for name, kind, lanes, words in prog.injectable_sections():
+        replicated = bool(prog.replicated.get(name, kind == "cfcss"))
+        is_written = name in facts.written
+        is_consumed = name in facts.consumed
+        value_fed = name in facts.taint.value_fed
+        is_pre_voted = bool(getattr(prog, "pre_sync", {}).get(name, False))
+        check_read = name in facts.check_reads
+
+        def cone_witness() -> Tuple[str, ...]:
+            cone: List[str] = []
+            _cone_entries(facts.jaxpr, facts.walker.env, facts.live,
+                          name, cone)
+            if not cone and facts.check_closed is not None \
+                    and facts.check_walker is not None:
+                cone.append("|check|")
+                _cone_entries(facts.check_closed.jaxpr,
+                              facts.check_walker.env, None, name, cone)
+            return tuple(cone[:8])
+
+        witness: Tuple[str, ...] = ()
+        if facts.train_fallback:
+            # The typed train fallback (PR 10 counterexample): outcome
+            # classes are bit-VALUE-dependent, so no static masking or
+            # detection bound is sound -- and in particular no section
+            # may ever be called masked.
+            verdict, reason = VERDICT_SDC, TRAIN_FALLBACK
+            witness = tuple(witnesses.get(name, ())) or cone_witness()
+        elif replicated:
+            if facts.cfcss or kind == "cfcss":
+                verdict = VERDICT_SDC
+                reason = ("CFCSS signature dataflow reads raw lane "
+                          "values; detection is value-dependent")
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+            elif facts.guards:
+                verdict = VERDICT_SDC
+                reason = ("per-lane guards read raw replica values and "
+                          "trip value-dependently")
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+            elif facts.fn_unsafe:
+                verdict = VERDICT_SDC
+                reason = ("single-lane function scope consumes raw lane "
+                          "values (skipLibCalls/cloneAfterCall SPOF)")
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+            elif name in facts.lane_flagged:
+                verdict = VERDICT_SDC
+                reason = ("a live single-lane extraction consumes this "
+                          "leaf's replicas outside a sanctioned voter")
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+            elif is_pre_voted:
+                verdict = VERDICT_DETECTED
+                reason = ("pre-step vote repairs (TMR) or latches (DWC) "
+                          "the flip before any read")
+            elif not is_written:
+                verdict = VERDICT_DETECTED
+                reason = ("unwritten replica: the flipped lane survives "
+                          "verbatim, so the region-boundary sync "
+                          "witnesses any divergence")
+            elif not value_fed:
+                verdict = VERDICT_DETECTED
+                reason = ("structural routing only: every surviving word "
+                          "reaches a sanctioned vote verbatim; "
+                          "overwritten words are masked to the clean "
+                          "outcome")
+            else:
+                verdict = VERDICT_SDC
+                reason = ("value-fed: the flipped value enters arithmetic "
+                          "that can mask or transform bits before any "
+                          "voter (the crc shift-out / phase "
+                          "predicate-steering class)")
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+        else:
+            if not is_consumed and not check_read:
+                verdict = VERDICT_MASKED
+                reason = ("dead state: never influences another leaf, a "
+                          "flag, or the check() verdict -- every bit is "
+                          "un-ACE")
+            else:
+                verdict = VERDICT_SDC
+                reason = ("shared state: corruption enters every lane "
+                          "identically, so no replica disagreement "
+                          "exists to vote on"
+                          + ("; read by check() (oracle corruption "
+                             "classifies as SDC)" if check_read else ""))
+                witness = tuple(witnesses.get(name, ())) or cone_witness()
+
+        dtype = (state_shapes[name].dtype
+                 if name in state_shapes else None)
+        section_rows: List[VulnRow] = []
+        for bc in _bit_classes(dtype):
+            bits = int(lanes) * int(words) * _CLASS_BITS[bc]
+            ace = 0 if verdict == VERDICT_MASKED \
+                else int(round(bits * live_fraction))
+            note = reason
+            if facts.train_fallback and bc == "mantissa":
+                note = (reason + "; low-mantissa flips may re-converge "
+                        "(train_self_heal) where the same word's "
+                        "exponent bit persists -- the pinned "
+                        "counterexample")
+            section_rows.append(VulnRow(
+                section=name, kind=kind, bit_class=bc, verdict=verdict,
+                reason=note, witness=witness, bits=bits, ace_bits=ace))
+        rows[name] = section_rows
+
+    return VulnerabilityMap(
+        benchmark=region.name,
+        num_clones=facts.num_clones,
+        clean_steps=clean_steps,
+        nominal_steps=nominal,
+        live_fraction=live_fraction,
+        rows=rows,
+        fallback_reason=(TRAIN_FALLBACK if facts.train_fallback
+                         else None))
+
+
+def crossvalidate_counts(vmap: VulnerabilityMap,
+                         section_counts: Mapping[str, Mapping[str, int]],
+                         sdc_keys: Sequence[str] = ("sdc", "train_sdc"),
+                         ) -> List[str]:
+    """Soundness cross-validation against a recorded campaign's
+    per-section outcome distributions (the FuzzyFlow idiom: static
+    claims checked against differential ground truth).
+
+    ``section_counts`` maps section name -> {class name: count}.
+    Returns one violation string per section whose static verdict rules
+    out silent corruption (``masked`` or ``detected-bounded``) but whose
+    recorded distribution shows any -- an empty list is the proof
+    obligation tests pin."""
+    verdicts = vmap.section_verdicts()
+    violations: List[str] = []
+    for name, counts in sorted(section_counts.items()):
+        verdict = verdicts.get(name)
+        if verdict is None or verdict == VERDICT_SDC:
+            continue
+        recorded = sum(int(counts.get(k, 0)) for k in sdc_keys)
+        if recorded:
+            violations.append(
+                f"{vmap.benchmark}:{name}: static verdict {verdict!r} "
+                f"but the recorded campaign shows {recorded} "
+                "silent-corruption outcome(s)")
+    return violations

@@ -1,0 +1,454 @@
+"""The shared fault-propagation walker: one abstract interpretation of
+the protected step, consumed by three analyses.
+
+Before this module, two passes each re-derived the same facts about the
+protected step's jaxpr: the equivalence partition
+(:mod:`coast_tpu.analysis.equiv.partition`) ran the lint lane-provenance
+lattice (:class:`~coast_tpu.analysis.lint.provenance._Walker`) plus its
+own structural-taint walk, and the linter ran the lattice again with its
+own finding rules.  The static vulnerability map and the isolation
+prover (this package) need exactly the same facts a third and fourth
+time -- so the walk lives here once, as :func:`analyze_step` returning a
+:class:`StepFacts` bundle:
+
+  * the **lane-provenance lattice** walk (replicated/shared/unknown per
+    var, sanctioned-tag tracking, cross-lane collapse candidates);
+  * the **structural-taint walk** (verbatim-word flow through selects/
+    slices/DUS, killed at sanctioned vote tags, ``value_fed`` where a
+    live equation consumes taint non-structurally) -- with optional
+    **witness-path tracking** (:class:`TraceTaint`): the first dataflow
+    chain that carries a leaf's words to each value-feeding consumer,
+    the raw material of the vulnerability map's SDC witnesses;
+  * backward **liveness** over the step outputs;
+  * per-leaf roles (consumed / written from the region's own dataflow
+    analysis / lane-flagged / pre- and step-voted) and region-level
+    hazards (guards, CFCSS, single-lane function scopes, the training
+    fallback);
+  * the **check() cone** (which leaves the self-check reads -- a flip
+    invisible to both the step and the check provably cannot change the
+    outcome).
+
+One trace, one walk, N consumers: ``scripts/lint_sweep.py`` passes one
+``closed`` jaxpr and one ``StepFacts`` through lint + equivalence +
+propagation, so adding the third pass did not add a third trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import jax
+
+from coast_tpu.analysis.lint.provenance import (_Val, _Walker, _live_eqns,
+                                                trace_step)
+from coast_tpu.ops.voters import TAG_SPOF, TAG_SYNC, TAG_VIEW, TAG_VOTER
+
+__all__ = ["StepFacts", "TraceTaint", "analyze_step", "cross_lane_sites",
+           "eqn_entry"]
+
+# Primitives that move words verbatim: a flipped word passes through
+# them unchanged (or is dropped), never arithmetically transformed.
+# Operand positions listed in _VALUE_OPERANDS are *steering* inputs
+# (predicates, indices): a flipped value there changes WHICH words move,
+# which is value-dependent -- consuming a tainted steering operand marks
+# the leaf value-fed.
+_STRUCTURAL_PRIMS = frozenset({
+    "select_n", "dynamic_update_slice", "dynamic_slice", "slice",
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "concatenate",
+    "rev", "copy", "gather", "scatter", "pad", "stop_gradient",
+})
+
+_VALUE_OPERANDS = {
+    "select_n": lambda eqn: (0,),
+    "dynamic_slice": lambda eqn: tuple(range(1, len(eqn.invars))),
+    "dynamic_update_slice": lambda eqn: tuple(range(2, len(eqn.invars))),
+    "gather": lambda eqn: (1,),
+    "scatter": lambda eqn: (1,),
+    "pad": lambda eqn: (),
+}
+
+# Sync classes whose tag marks a *detector* on the tagged value: taint
+# entering one is guaranteed either masked (lanes equal) or latched/
+# repaired there, so it stops propagating.  'guard' is deliberately NOT
+# in this set -- kernel guards read raw per-lane values and trip
+# value-dependently, so their consumption must count as value-feeding.
+_DETECTOR_CLASSES = frozenset({
+    "load_addr", "store_data", "ctrl", "stack", "sor_crossing",
+    "boundary", "call_boundary", "cfcss",
+    # Training regions' weight-update commit votes (KIND_PARAM /
+    # KIND_OPT_STATE leaves).  Note these detectors never LICENSE a
+    # merge on a train region -- the train fallback forces every
+    # section exhaustive first; the membership only keeps the taint walk
+    # honest about where votes kill verbatim-word flow.
+    "param", "opt_state",
+})
+
+
+def _detector_tag(tag: str) -> bool:
+    if tag.startswith(TAG_VOTER) and not tag.startswith(TAG_VIEW):
+        return True
+    if tag.startswith(TAG_SYNC):
+        klass = tag[len(TAG_SYNC):].partition(":")[0]
+        return klass in _DETECTOR_CLASSES
+    return False
+
+
+def eqn_entry(eqn) -> str:
+    """``prim(shape)`` display entry for one equation -- the witness
+    paths' vocabulary (same shape the fingerprint cones use)."""
+    shape = ()
+    if eqn.outvars:
+        shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+    return f"{eqn.primitive.name}{shape}"
+
+
+class _TaintWalk:
+    """Forward word-verbatim taint over a (nested) jaxpr.
+
+    ``env[var]`` is the frozenset of leaf names whose unmodified words
+    may be present in ``var``.  Taint passes through structural
+    primitives, dies at detector tags (sanctioned votes), and marks a
+    leaf ``value_fed`` wherever a live equation consumes its taint
+    non-structurally (arithmetic, reductions, steering operands, guard
+    inputs).
+    """
+
+    def __init__(self, live: Optional[Set[int]]):
+        self.env: Dict[object, FrozenSet[str]] = {}
+        self.value_fed: Set[str] = set()
+        self.live = live
+
+    def val(self, v) -> FrozenSet[str]:
+        from jax.extend.core import Literal
+        if isinstance(v, Literal):
+            return frozenset()
+        return self.env.get(v, frozenset())
+
+    def _set(self, v, taint: FrozenSet[str]) -> None:
+        old = self.env.get(v)
+        self.env[v] = taint if old is None else (old | taint)
+
+    def seed(self, inner_vars, taints) -> None:
+        for iv, t in zip(inner_vars, taints):
+            self._set(iv, t)
+
+    def _is_live(self, eqn) -> bool:
+        return self.live is None or id(eqn) in self.live
+
+    def _feed(self, eqn, taint: FrozenSet[str]) -> None:
+        if taint and self._is_live(eqn):
+            self.value_fed |= taint
+
+    def walk(self, jaxpr) -> List[FrozenSet[str]]:
+        for eqn in jaxpr.eqns:
+            ins = [self.val(v) for v in eqn.invars]
+            outs = self._eqn_outs(eqn, ins)
+            for v, t in zip(eqn.outvars, outs):
+                self._set(v, t)
+        return [self.val(v) for v in jaxpr.outvars]
+
+    def _eqn_outs(self, eqn, ins):
+        prim = eqn.primitive.name
+        params = eqn.params
+        union = frozenset().union(*ins) if ins else frozenset()
+
+        if prim == "name":
+            tag = str(params.get("name", ""))
+            if _detector_tag(tag):
+                return [frozenset()]
+            if tag.startswith(TAG_SPOF):
+                # Single-lane call boundary: the callee sees raw lane-0
+                # values -- value consumption by definition.
+                self._feed(eqn, union)
+                return [frozenset()]
+            return [ins[0] if ins else frozenset()]
+
+        if prim == "optimization_barrier":
+            # n-ary identity fence: words pass through verbatim, per
+            # position -- neither consumed nor mixed.
+            return list(ins)
+
+        if prim in _STRUCTURAL_PRIMS:
+            value_pos = _VALUE_OPERANDS.get(prim, lambda e: ())(eqn)
+            data = frozenset()
+            for i, t in enumerate(ins):
+                if i in value_pos:
+                    self._feed(eqn, t)
+                else:
+                    data |= t
+            return [data for _ in eqn.outvars]
+
+        # -- control flow / nested jaxprs --
+        if prim == "cond" and "branches" in params:
+            self._feed(eqn, ins[0])
+            per_branch = []
+            for br in params["branches"]:
+                self.seed(br.jaxpr.invars, ins[1:])
+                per_branch.append(self.walk(br.jaxpr))
+            outs = []
+            for i in range(len(eqn.outvars)):
+                o = frozenset()
+                for b in per_branch:
+                    o |= b[i]
+                outs.append(o)
+            return outs
+        if prim == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            cj, bj = params["cond_jaxpr"].jaxpr, params["body_jaxpr"].jaxpr
+            carry = list(ins[cn + bn:])
+            for _ in range(len(carry) + 2):
+                self.seed(cj.invars, ins[:cn] + carry)
+                cond_out = self.walk(cj)
+                self._feed(eqn, cond_out[0] if cond_out else frozenset())
+                self.seed(bj.invars, ins[cn:cn + bn] + carry)
+                new_carry = self.walk(bj)
+                joined = [c | nc for c, nc in zip(carry, new_carry)]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry
+        if prim == "scan":
+            sub = params["jaxpr"].jaxpr
+            nc, ncar = params["num_consts"], params["num_carry"]
+            consts, carry = list(ins[:nc]), list(ins[nc:nc + ncar])
+            xs = list(ins[nc + ncar:])
+            outs = None
+            for _ in range(max(ncar, 1) + 2):
+                self.seed(sub.invars, consts + carry + xs)
+                outs = self.walk(sub)
+                joined = [c | nc_ for c, nc_ in zip(carry, outs[:ncar])]
+                if joined == carry:
+                    break
+                carry = joined
+            return carry + list(outs[ncar:])
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in params:
+                sub = params[key]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                self.seed(sub.invars, ins)
+                return self.walk(sub)
+
+        # Any other primitive transforms values: tainted inputs are
+        # value-fed, outputs carry no verbatim words.
+        self._feed(eqn, union)
+        return [frozenset() for _ in eqn.outvars]
+
+
+#: Witness paths are display artifacts, not proofs: cap their length so
+#: a deep loop nest cannot balloon the report.
+_PATH_MAX = 12
+
+
+class TraceTaint(_TaintWalk):
+    """:class:`_TaintWalk` plus witness-path tracking.
+
+    ``witness[leaf]`` is the first dataflow chain (program order,
+    ``prim(shape)`` entries, last entry suffixed ``!`` for the
+    value-feeding consumer) observed carrying ``leaf``'s verbatim words
+    to a live non-structural consumer -- the concrete escape path the
+    vulnerability map reports for an ``sdc-possible`` verdict.  Taint
+    semantics are bit-identical to the base walk; the paths are a
+    side-channel.
+    """
+
+    def __init__(self, live: Optional[Set[int]]):
+        super().__init__(live)
+        # var -> {leaf: path tuple}; first path wins (program order).
+        self.path: Dict[object, Dict[str, Tuple[str, ...]]] = {}
+        self.witness: Dict[str, Tuple[str, ...]] = {}
+
+    def _in_path(self, eqn, leaf: str) -> Tuple[str, ...]:
+        from jax.extend.core import Literal
+        for iv in eqn.invars:
+            if isinstance(iv, Literal):
+                continue
+            d = self.path.get(iv)
+            if d is not None and leaf in d:
+                return d[leaf]
+            if leaf in self.val(iv):
+                return (leaf,)        # the seeded leaf input itself
+        return (leaf,)
+
+    def _feed(self, eqn, taint: FrozenSet[str]) -> None:
+        if taint and self._is_live(eqn):
+            for leaf in taint:
+                if leaf not in self.witness:
+                    self.witness[leaf] = (self._in_path(eqn, leaf)
+                                          + (eqn_entry(eqn) + "!",))
+        super()._feed(eqn, taint)
+
+    def walk(self, jaxpr) -> List[FrozenSet[str]]:
+        for eqn in jaxpr.eqns:
+            ins = [self.val(v) for v in eqn.invars]
+            outs = self._eqn_outs(eqn, ins)
+            entry = eqn_entry(eqn)
+            for v, t in zip(eqn.outvars, outs):
+                self._set(v, t)
+                if t:
+                    d = self.path.setdefault(v, {})
+                    for leaf in t:
+                        if leaf not in d:
+                            p = self._in_path(eqn, leaf)
+                            d[leaf] = (p + (entry,) if len(p) < _PATH_MAX
+                                       else p)
+        return [self.val(v) for v in jaxpr.outvars]
+
+
+def cross_lane_sites(walker: _Walker, live: Set[int],
+                     n: int) -> List[Dict[str, object]]:
+    """The live unsanctioned cross-lane dataflow sites: collapse and
+    single-lane-extraction candidates from the lattice walk, with the
+    segmented scheduler's all-lane fan-out pattern (every lane of a
+    source extracted exactly once) filtered out as sanctioned -- the
+    same acceptance rule :func:`~coast_tpu.analysis.lint.provenance.
+    lint_provenance` applies before reporting.  These sites are the
+    isolation prover's interference sources: each one moves one lane's
+    (possibly corrupted) value across the lane boundary without a
+    sanctioned voter."""
+    live_cands = [c for k, c in walker.candidates.items() if k in live]
+    by_src: Dict[int, List[Dict[str, object]]] = {}
+    for c in live_cands:
+        by_src.setdefault(id(c["src"]), []).append(c)
+    out: List[Dict[str, object]] = []
+    for cands in by_src.values():
+        lanes_seen = {c["lane"] for c in cands}
+        if (all(c["kind"] == "spof" for c in cands)
+                and None not in lanes_seen
+                and lanes_seen == set(range(n))):
+            continue
+        out.extend(cands)
+    return out
+
+
+@dataclasses.dataclass
+class StepFacts:
+    """Everything the static passes know about one protected step, from
+    one trace and one walk.  Consumed by the equivalence partition, the
+    vulnerability map, and the isolation prover."""
+
+    closed: object                      # the step's ClosedJaxpr
+    state_names: List[str]
+    flag_names: List[str]
+    walker: _Walker                     # lattice walk (env/candidates/tags)
+    out_vals: List[_Val]                # lattice values of the step outputs
+    live: Set[int]                      # id(eqn) liveness set
+    taint: _TaintWalk                   # value_fed (+ witness when traced)
+    consumed: Set[str]                  # leaves feeding OTHER outputs/flags
+    written: Set[str]                   # region dataflow write set
+    lane_flagged: Set[str]              # leaves behind live unsanctioned
+    #                                     cross-lane candidates
+    check_reads: Set[str]               # leaves check()'s verdict reads
+    check_walker: Optional[_Walker]     # check() cone (fingerprints)
+    check_closed: Optional[object]
+    guards: bool
+    cfcss: bool
+    fn_unsafe: bool
+    train_fallback: bool
+    num_clones: int
+
+    @property
+    def jaxpr(self):
+        return self.closed.jaxpr
+
+    @property
+    def out_names(self) -> List[str]:
+        return self.state_names + self.flag_names
+
+
+def analyze_step(prog, closed=None, track_paths: bool = True) -> StepFacts:
+    """Run the shared fault-propagation walk over ``prog``'s protected
+    step.  ``closed`` forwards an already-traced step jaxpr (callers
+    that lint, partition, and map in one session trace once);
+    ``track_paths=False`` skips witness-path bookkeeping for consumers
+    that only need the boolean facts."""
+    cfg = prog.cfg
+    region = prog.region
+    n = cfg.num_clones
+    if closed is None:
+        closed = trace_step(prog)
+    jaxpr = closed.jaxpr
+
+    pstate, flags = jax.eval_shape(prog.init_pstate)
+    state_names = sorted(pstate)
+    flag_names = sorted(flags)
+    assert len(jaxpr.invars) == len(state_names) + len(flag_names) + 1, (
+        len(jaxpr.invars), len(state_names), len(flag_names))
+
+    # -- lattice walk ----------------------------------------------------
+    walker = _Walker(n)
+    taints: List[FrozenSet[str]] = []
+    for name, var in zip(state_names, jaxpr.invars):
+        status = "laned" if prog.replicated.get(name) else "shared"
+        walker.env[var] = _Val(status, 0, False, False, frozenset({name}))
+        taints.append(frozenset({name}))
+    out_vals = walker.walk(jaxpr)
+
+    live: Set[int] = set()
+    _live_eqns(jaxpr, list(jaxpr.outvars), live)
+
+    # -- value-feeding taint walk ----------------------------------------
+    taint = TraceTaint(live) if track_paths else _TaintWalk(live)
+    for var, t in zip(jaxpr.invars, taints):
+        taint._set(var, t)
+    taint.walk(jaxpr)
+
+    # -- per-leaf facts ---------------------------------------------------
+    out_names = state_names + flag_names
+    consumed: Set[str] = set()
+    for out_name, val in zip(out_names, out_vals):
+        for dep in val.deps:
+            if dep != out_name:
+                consumed.add(dep)
+    # The write set comes from the REGION's dataflow roles (the same
+    # analysis the engine derives its store syncs from): in the
+    # protected step's jaxpr every leaf gets fresh outvars (vmap,
+    # freeze-select), so var identity cannot tell a semantic write from
+    # a passthrough.  Synthetic (CFCSS) leaves are not region leaves.
+    from coast_tpu.passes.verification import analyze
+    written = set(analyze(region).written)
+
+    # Live single-lane extractions / unsanctioned collapses implicate
+    # their provenance leaves: lane symmetry is not provable there.
+    # (Unfiltered, matching the equivalence pass's conservatism; the
+    # isolation prover applies the fan-out filter via cross_lane_sites.)
+    lane_flagged: Set[str] = set()
+    for key, cand in walker.candidates.items():
+        if key in live:
+            lane_flagged |= set(cand["deps"])
+
+    guards = (region.stack_guard is not None
+              or region.assert_guard is not None)
+    train_fallback = getattr(region, "train_probe", None) is not None
+    cfcss = getattr(prog, "_cfcss_step", None) is not None
+    fn_unsafe = n > 1 and any(
+        scope not in ("replicated", "replicated_return")
+        for scope in getattr(prog, "fn_scope", {}).values())
+
+    # -- check() cone: which leaves the self-check verdict reads ---------
+    check_walker: Optional[_Walker] = _Walker(n)
+    check_closed = None
+    check_reads: Set[str] = set()
+    try:
+        init_shape = jax.eval_shape(region.init)
+        check_closed = jax.make_jaxpr(region.check)(init_shape)
+        check_names = sorted(init_shape)
+        for name, var in zip(check_names, check_closed.jaxpr.invars):
+            check_walker.env[var] = _Val("shared", 0, False, False,
+                                         frozenset({name}))
+        for val in check_walker.walk(check_closed.jaxpr):
+            check_reads |= set(val.deps)
+    except Exception:       # noqa: BLE001 - analysis must not break builds
+        check_closed = None
+        check_walker = None
+        # Unanalyzable check: conservatively assume it reads everything
+        # (nothing may claim "invisible to check" below).
+        check_reads = set(region.spec)
+
+    return StepFacts(
+        closed=closed, state_names=state_names, flag_names=flag_names,
+        walker=walker, out_vals=out_vals, live=live, taint=taint,
+        consumed=consumed, written=written, lane_flagged=lane_flagged,
+        check_reads=check_reads, check_walker=check_walker,
+        check_closed=check_closed, guards=guards, cfcss=cfcss,
+        fn_unsafe=fn_unsafe, train_fallback=train_fallback, num_clones=n)

@@ -74,6 +74,19 @@ def parse_command_line(argv: Optional[List[str]] = None):
                            "re-injected section (StopWhen grammar; "
                            "'none' disables; default "
                            f"{engine.DEFAULT_STOP_WHEN!r})")
+            p.add_argument("--no-isolation-gate", action="store_true",
+                           help="skip the static lane-isolation "
+                           "noninterference pre-gate that runs over "
+                           "every target's current build before any "
+                           "delta campaign is enqueued (a refuted "
+                           "proof is an immediate drift verdict with "
+                           "counterexample paths)")
+            p.add_argument("--no-static-budget", action="store_true",
+                           help="do not allocate the per-section "
+                           "convergence budget by the static "
+                           "vulnerability map (sdc-possible sections "
+                           "first, relaxed min floor on statically-"
+                           "proven sections)")
             p.add_argument("--z", type=float, default=1.96,
                            help="Wilson quantile for the drift verdict")
             p.add_argument("--report-json", default=None, metavar="PATH",
@@ -136,6 +149,8 @@ def _run_check(args):
     report = engine.check_baseline(
         doc, workdir=args.queue, stop_when=stop,
         workers=args.workers, z=args.z,
+        static_budget=not args.no_static_budget,
+        isolation_gate=not args.no_isolation_gate,
         log=lambda s: print(s, file=sys.stderr, flush=True))
     print(report.format())
     if args.report_json:

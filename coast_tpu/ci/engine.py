@@ -206,9 +206,19 @@ class TargetReport:
     section_comparisons: Dict[str, Dict[str, object]] = \
         dataclasses.field(default_factory=dict)
     cache_event: Optional[str] = None
+    # Static isolation pre-gate refutations (counterexample paths); a
+    # non-empty list IS drift -- the tree's protection is broken before
+    # any injection runs, so no campaign was enqueued for this target.
+    isolation_leaks: List[str] = dataclasses.field(default_factory=list)
 
     def drift_lines(self) -> List[str]:
         from coast_tpu.analysis.json_parser import format_drift_lines
+        if self.isolation_leaks:
+            return [f"isolation: {l}" for l in self.isolation_leaks]
+        if self.comparison.get("skipped"):
+            return [str(self.comparison["skipped"])]
+        if not self.comparison:
+            return []
         if self.section_comparisons and self.dropped_rows:
             return [f"section {name}: {d}"
                     for name, cmp_ in sorted(
@@ -256,7 +266,8 @@ class CiReport:
     def format(self) -> str:
         lines = []
         for t in self.targets:
-            state = "DRIFT" if t.drift else "ok"
+            state = "DRIFT" if t.drift else (
+                "skip" if t.comparison.get("skipped") else "ok")
             changed = (",".join(t.changed_sections)
                        if t.changed_sections else "none")
             lines.append(
@@ -319,23 +330,89 @@ def _target_verdict(tid: str, block: Dict[str, object],
     return drift, cmp_, section_cmps
 
 
+def _isolation_pregate(targets: Dict[str, object],
+                       program_hook: Optional[Callable],
+                       log: Callable[[str], None]
+                       ) -> Dict[str, List[str]]:
+    """The fast static pre-gate: prove lane-isolation noninterference
+    for every target's CURRENT build before any delta campaign is
+    enqueued.  A refuted target returns its counterexample paths -- a
+    statically-broken protection is a regression no campaign needs to
+    measure (and a campaign against it would burn the whole convergence
+    budget discovering what the prover shows in milliseconds).  Build
+    failures raise :class:`CiInfraError` (any worker would fail the same
+    way)."""
+    from coast_tpu.analysis.propagation import prove_isolation
+    from coast_tpu.inject.supervisor import build_program
+    leaks: Dict[str, List[str]] = {}
+    for tid in sorted(targets):
+        spec = CampaignSpec.from_item(targets[tid]["spec"])
+        try:
+            prog, strategy = build_program(spec.benchmark,
+                                           spec.opt_passes)
+        except SystemExit as e:
+            raise CiInfraError(
+                f"{tid}: protected-program build failed "
+                f"(exit {e.code})") from e
+        if program_hook is not None:
+            program_hook(prog)
+        proof = prove_isolation(prog, strategy=strategy or "unprotected")
+        log(f"# isolation pre-gate: {tid}: "
+            f"{'HOLDS' if proof.holds else 'LEAK'}")
+        if not proof.holds:
+            leaks[tid] = [l.format() for l in proof.leaks]
+    return leaks
+
+
 def check_baseline(doc: Dict[str, object],
                    workdir: Optional[str] = None,
                    stop_when: Optional[str] = DEFAULT_STOP_WHEN,
                    workers: int = 1,
                    z: float = 1.96,
                    program_hook: Optional[Callable] = None,
+                   static_budget: bool = True,
+                   isolation_gate: bool = True,
                    log: Callable[[str], None] = lambda s: None
                    ) -> CiReport:
     """Check the current tree against a baseline document.
 
-    Per target: materialize the baseline journal, enqueue a DELTA item
-    (``stop_when`` bounding each re-injected section; None disables),
-    drain through fleet workers, and compare distributions
+    First the static isolation pre-gate runs over every target's
+    current build (``isolation_gate=False`` disables): a refuted
+    noninterference proof is an immediate DRIFT verdict carrying the
+    counterexample paths, and no campaign is enqueued.  Then, per
+    target: materialize the baseline journal, enqueue a DELTA item
+    (``stop_when`` bounding each re-injected section; None disables;
+    ``static_budget`` points the convergence budget at the sections the
+    static vulnerability map calls ``sdc-possible`` first), drain
+    through fleet workers, and compare distributions
     (:func:`_target_verdict`).  Raises :class:`CiInfraError` when any
     target cannot reach a verdict."""
     from coast_tpu.fleet.queue import CampaignQueue, QueueError
     targets = doc["targets"]
+    if isolation_gate:
+        leaking = _isolation_pregate(targets, program_hook, log)
+        if leaking:
+            # The report covers EVERY target: leaking ones drift with
+            # their counterexample paths, the rest are explicitly
+            # "skipped" (the gate aborts before any campaign, so no
+            # distribution verdict exists for them either).
+            reports = [
+                TargetReport(
+                    target=tid, drift=tid in leaking,
+                    changed_sections=[],
+                    reused_rows=0, reinjected_rows=0, dropped_rows=0,
+                    base_n=int(targets[tid]["n"]),
+                    n=0, base_counts=dict(targets[tid]["counts"]),
+                    counts={},
+                    comparison=({} if tid in leaking else
+                                {"skipped": "isolation pre-gate failed "
+                                 "on another target; no campaign ran"}),
+                    isolation_leaks=leaking.get(tid, []))
+                for tid in sorted(targets)]
+            return CiReport(targets=reports,
+                            refreshed=base_mod.assemble(
+                                {tid: json.loads(json.dumps(targets[tid]))
+                                 for tid in sorted(targets)}))
     with tempfile.TemporaryDirectory(prefix="coast_ci_") as tmp:
         root = workdir or tmp
         q = CampaignQueue(os.path.join(root, "queue"))
@@ -350,7 +427,8 @@ def check_baseline(doc: Dict[str, object],
                 os.path.join(root, "base", f"{_safe_name(tid)}.journal"))
             item = dataclasses.replace(
                 spec, delta_from=base_path, equiv=True,
-                stop_when=(stop_when or None))
+                stop_when=(stop_when or None),
+                static_budget=bool(static_budget and stop_when))
             try:
                 item.validate()
             except (ValueError, QueueError) as e:

@@ -232,7 +232,8 @@ class Worker:
                         cs.n, cs.delta_from, seed=cs.seed,
                         batch_size=cs.batch_size,
                         start_num=cs.start_num,
-                        progress=progress, stop_when=stop_when)
+                        progress=progress, stop_when=stop_when,
+                        static_budget=cs.static_budget)
                     jpath = self.q.journal_path(item.id)
                     if os.path.exists(jpath):
                         os.unlink(jpath)       # a previous attempt's

@@ -363,9 +363,12 @@ class CampaignRunner:
         finding -- a multi-hour campaign must refuse to start on a
         program whose redundancy was compiled away (every injection
         would measure a protection that no longer exists).  ``True`` or
-        ``"full"`` runs both the static lane-provenance rules and the
-        post-XLA survival checks; ``"static"`` skips the survival
-        compile for quick iteration.
+        ``"full"`` runs the static lane-provenance rules, the
+        lane-isolation noninterference prover
+        (:mod:`coast_tpu.analysis.propagation`), and the post-XLA
+        survival checks; ``"static"`` runs the provenance rules only
+        (quick iteration); ``"propagation"`` runs provenance plus the
+        isolation prover without the survival compile.
 
         ``retry`` is a :class:`coast_tpu.inject.resilience.RetryPolicy`:
         transient XLA/device errors re-dispatch the batch with backoff,
@@ -441,7 +444,10 @@ class CampaignRunner:
                 "coast_tpu.parallel.mesh.ShardedCampaignRunner directly")
         if preflight:
             from coast_tpu.analysis import lint as lint_mod
-            lint_mod.check(prog, survival=(preflight != "static"))
+            lint_mod.check(
+                prog,
+                survival=preflight not in ("static", "propagation"),
+                propagation=preflight in (True, "full", "propagation"))
         self.prog = prog
         self.retry = retry
         self.metrics = metrics
@@ -1575,7 +1581,8 @@ class CampaignRunner:
                   batch_size: int = 4096, start_num: int = 0,
                   progress: Optional[
                       Callable[[int, Dict[str, int]], None]] = None,
-                  stop_when: "Optional[object]" = None
+                  stop_when: "Optional[object]" = None,
+                  static_budget: "bool | object" = False
                   ) -> CampaignResult:
         """Delta campaign: rerun the seeded campaign recorded in the
         journal at ``delta_from``, but physically re-inject ONLY the
@@ -1597,6 +1604,23 @@ class CampaignRunner:
         spliced + collected rows); ``CampaignResult.convergence``
         carries one report per section and ``delta["dropped_rows"]``
         the cut total.
+
+        ``static_budget`` feeds the static vulnerability map
+        (:mod:`coast_tpu.analysis.propagation`) into the re-injection
+        loop: sections verdicted ``sdc-possible`` run FIRST (the
+        uncertain sections get their convergence budget before anything
+        else), and sections the analysis proves ``masked`` or
+        ``detected-bounded`` run under a relaxed ``min_done`` floor
+        (quartered, floored at 32) -- the floor exists so rare classes
+        get a chance to appear, and for those sections the static proof
+        already rules the silent classes out, so the same ``stop_when``
+        confidence is reached with fewer physical injections.  Pass
+        ``True`` to derive the map from this runner's partition, or an
+        already-built :class:`~coast_tpu.analysis.propagation.
+        VulnerabilityMap`.  Per-class thresholds are untouched -- the
+        verdict statistics are identical, only the floor spend moves.
+        ``delta["static_budget"]`` records the verdicts, order, and
+        relaxed floors.
 
         Requires an equivalence-enabled runner (``equiv=True``): the
         partition supplies the per-section fingerprints, and the base
@@ -1669,6 +1693,24 @@ class CampaignRunner:
             progress(int(len(splice_idx)), dict(splice_counts))
         keep = None
         convergence: Optional[Dict[str, object]] = None
+        static_info: Optional[Dict[str, object]] = None
+        static_verdicts: Dict[str, str] = {}
+        if static_budget:
+            from coast_tpu.analysis.propagation import (VERDICT_SDC,
+                                                        VulnerabilityMap,
+                                                        analyze_propagation)
+            vmap = (static_budget
+                    if isinstance(static_budget, VulnerabilityMap)
+                    else analyze_propagation(
+                        self.prog, partition=self.equiv_partition))
+            static_verdicts = vmap.section_verdicts()
+            static_info = {"verdicts": dict(sorted(
+                static_verdicts.items()))}
+            tel.instant("delta_static_budget",
+                        sections=len(static_verdicts),
+                        sdc_possible=sum(
+                            1 for v in static_verdicts.values()
+                            if v == "sdc-possible"))
         if len(run_idx) and stop_when is None:
             sub = self._take_rows(part, run_idx)
             chunk_progress = None
@@ -1704,7 +1746,21 @@ class CampaignRunner:
             per_section: Dict[str, object] = {}
             agg_counts = dict(splice_counts)
             agg_done = int(len(splice_idx))
-            for name in sorted(groups):
+            ordered = sorted(groups)
+            relaxed: Dict[str, int] = {}
+            if static_info is not None:
+                # Static-prior budget allocation: uncertain
+                # (sdc-possible) sections first, and the min_done floor
+                # -- whose whole purpose is letting rare classes appear
+                # -- quartered on sections the map proves cannot
+                # silently corrupt.
+                from coast_tpu.analysis.propagation import VERDICT_SDC
+                _rank = {VERDICT_SDC: 0}
+                ordered = sorted(
+                    groups, key=lambda nm: (
+                        _rank.get(static_verdicts.get(nm), 1), nm))
+                static_info["order"] = list(ordered)
+            for name in ordered:
                 idx = np.asarray(groups[name], np.int64)
                 sub = self._take_rows(part, idx)
                 chunk_progress = None
@@ -1715,10 +1771,20 @@ class CampaignRunner:
                         for k, v in counts.items():
                             merged[k] = merged.get(k, 0) + v
                         progress(_base + done, merged)
+                sub_stop = stop_when
+                if static_info is not None and stop_when is not None \
+                        and getattr(stop_when, "min_done", 0) \
+                        and static_verdicts.get(name) is not None \
+                        and static_verdicts[name] != VERDICT_SDC:
+                    import dataclasses as _dc
+                    floor = max(32, int(stop_when.min_done) // 4)
+                    if floor < int(stop_when.min_done):
+                        sub_stop = _dc.replace(stop_when, min_done=floor)
+                        relaxed[name] = floor
                 sub_res = self.run_schedule(
                     sub, batch_size=min(batch_size, len(sub)),
                     progress=chunk_progress, _telemetry_mark=mark,
-                    stop_when=stop_when)
+                    stop_when=sub_stop)
                 ran = len(sub_res.codes)
                 sel = idx[:ran]
                 for out_key, res_key in (("codes", "codes"),
@@ -1743,6 +1809,8 @@ class CampaignRunner:
                 "stop_when": stop_when.spec(),
                 "per_section": per_section,
             }
+            if static_info is not None and relaxed:
+                static_info["relaxed_min"] = dict(sorted(relaxed.items()))
         dropped = 0
         if keep is not None and not keep.all():
             # Early stop cut some sections short: the result describes
@@ -1764,6 +1832,8 @@ class CampaignRunner:
         counts["cache_invalid"] = int(w_col[~fired].sum())
         delta_summary: Dict[str, object] = {**plan.summary(),
                                             "base": delta_from}
+        if static_info is not None:
+            delta_summary["static_budget"] = static_info
         if stop_when is not None:
             delta_summary["dropped_rows"] = dropped
         if len(run_idx):

@@ -100,6 +100,15 @@ class CampaignSpec:
         sections from.  Fleet-item-only (the CI's delta items); never
         part of the journal header (a delta campaign's output is a
         plain run result).
+    ``static_budget``
+        Delta campaigns only: allocate the per-section convergence
+        budget by the static vulnerability map
+        (:mod:`coast_tpu.analysis.propagation`) -- ``sdc-possible``
+        sections re-inject first and statically-proven sections run
+        under a relaxed ``min_done`` floor.  Fleet-item-only like
+        ``delta_from`` (it shapes HOW the delta spends budget, not what
+        the result means); joins the item dict only when set, so every
+        pre-existing item stays byte-identical.
     ``collect``
         Result-collection mode: ``"dense"`` (default; every row's
         outcome columns cross the host boundary, the historical
@@ -126,6 +135,7 @@ class CampaignSpec:
     throttle_s: float = 0.0
     delta_from: Optional[str] = None
     collect: str = COLLECT_DEFAULT
+    static_budget: bool = False
 
     # -- validation ----------------------------------------------------------
     def validate(self) -> "CampaignSpec":
@@ -159,6 +169,10 @@ class CampaignSpec:
                 "delta_from campaigns are dense by construction (the "
                 "spliced rows are exact per-row journal records); drop "
                 "collect='sparse'")
+        if self.static_budget and not (self.delta_from and self.stop_when):
+            raise SpecError(
+                "static_budget allocates a DELTA campaign's per-section "
+                "convergence budget; it needs delta_from AND stop_when")
         return self
 
     # -- parsed accessors ----------------------------------------------------
@@ -198,6 +212,10 @@ class CampaignSpec:
         }
         if self.delta_from:
             doc["delta_from"] = str(self.delta_from)
+        if self.static_budget:
+            # Joins only when set (like delta_from): pre-existing item
+            # dicts -- and their sha'd enqueue ids -- stay byte-identical.
+            doc["static_budget"] = True
         if self.collect != COLLECT_DEFAULT:
             # Joins only when sparse (like delta_from): enqueue ids sha
             # the item dict, so every pre-sparse item stays byte-
@@ -226,6 +244,7 @@ class CampaignSpec:
             delta_from=spec.get("delta_from") or None,
             collect=str(spec.get("collect", COLLECT_DEFAULT)
                         or COLLECT_DEFAULT),
+            static_budget=bool(spec.get("static_budget", False)),
         )
 
     # -- journal-header encoding (inject/journal.py) -------------------------

@@ -143,6 +143,15 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "to convergence-bound each re-injected section "
                         "on its own (spliced sections keep their exact "
                         "recorded counts)")
+    parser.add_argument("--static-budget", action="store_true",
+                        help="delta campaigns: allocate the per-section "
+                        "convergence budget by the static vulnerability "
+                        "map (analysis/propagation) -- sdc-possible "
+                        "sections re-inject first, and sections the map "
+                        "proves masked/detected-bounded run under a "
+                        "quartered --stop-when min floor (same per-class "
+                        "thresholds, fewer physical injections).  Needs "
+                        "--delta-from")
     parser.add_argument("--stratified", action="store_true",
                         help="equal-allocation sampling per section: -t "
                         "is divided across sections (floored at 1 each, "
@@ -357,6 +366,14 @@ def parse_command_line(argv: Optional[List[str]] = None):
         print("Error, --delta-from reads its journal as the splice base; "
               "it cannot be combined with --journal/--resume/"
               "--stream-logs", file=sys.stderr)
+        sys.exit(-1)
+    if args.static_budget and not (args.delta_from and args.stop_when):
+        # Without a stop condition there is no per-section budget to
+        # allocate -- accepting the flag would record a static_budget
+        # block for a run the allocator never shaped.
+        print("Error, --static-budget allocates a delta campaign's "
+              "per-section convergence budget; it needs --delta-from "
+              "AND --stop-when", file=sys.stderr)
         sys.exit(-1)
     if args.collect == "sparse":
         if args.errorCount or args.forceBreak or args.delta_from:
@@ -669,7 +686,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        batch_size=args.batch_size,
                                        start_num=args.start_num,
                                        progress=progress,
-                                       stop_when=args.stop_when_parsed)
+                                       stop_when=args.stop_when_parsed,
+                                       static_budget=args.static_budget)
             except DeltaMismatchError as e:
                 print(f"Error, {e}", file=sys.stderr)
                 return 1

@@ -56,7 +56,7 @@ _LIST_FLAGS = {
 # List flags that feed the scope config (ScopeConfig.merge_cl); fnPrintList
 # is instrumentation-only.
 _SCOPE_LIST_FLAGS = _LIST_FLAGS - {"fnPrintList"}
-_STR_FLAGS = {"configFile", "inject", "printFnName", "lintOut"}
+_STR_FLAGS = {"configFile", "inject", "printFnName", "lintOut", "propOut"}
 # Flags accepted bare (-dumpModule, today's jaxpr behavior) or with a
 # value (-dumpModule=jaxpr|hlo).
 _OPT_VALUE_FLAGS = {"dumpModule"}
@@ -256,24 +256,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"replicated={prog.replicated[name]}", file=sys.stderr)
 
     # Replication-integrity check (verifyCloningSuccess analogue): the
-    # static lane-provenance/coverage rules run on every protected build
-    # and refuse to run the program on an error, exactly as the reference
-    # refuses to emit; -noCloneOpsCheck disables the gate (its reference
-    # meaning), -lintOut=<path> writes the JSON findings either way.  The
-    # heavier post-XLA survival pass stays with the lint CLI / campaign
-    # pre-flight (python -m coast_tpu.analysis.lint).
+    # static lane-provenance/coverage rules AND the lane-isolation
+    # noninterference prover (analysis/propagation) run on every
+    # protected build and refuse to run the program on an error, exactly
+    # as the reference refuses to emit; -noCloneOpsCheck disables the
+    # gate (its reference meaning), -lintOut=<path> writes the JSON
+    # findings either way.  The heavier post-XLA survival pass stays
+    # with the lint CLI / campaign pre-flight (python -m
+    # coast_tpu.analysis.lint).
     step_jaxpr = None          # shared: lint trace doubles as the dump
-    if "lintOut" in flags or (strategy in ("TMR", "DWC")
-                              and not flags.get("noCloneOpsCheck")):
+    if "lintOut" in flags or "propOut" in flags \
+            or (strategy in ("TMR", "DWC")
+                and not flags.get("noCloneOpsCheck")):
         from coast_tpu.analysis import lint as lint_mod
+        from coast_tpu.analysis.propagation import analyze_step
         step_jaxpr = lint_mod.trace_step(prog)
+        # ONE shared walk feeds the gate's isolation prover and (when
+        # requested) the vulnerability map -- witness paths only when
+        # the map will report them.
+        step_facts = analyze_step(prog, closed=step_jaxpr,
+                                  track_paths="propOut" in flags)
         lint_report = lint_mod.lint_program(
             prog, survival=False, strategy=strategy or "unprotected",
-            closed=step_jaxpr)
+            closed=step_jaxpr, propagation=True, facts=step_facts)
         if "lintOut" in flags:
             # Honored for every build (an unprotected report is trivially
             # clean, but the requested file must exist).
             lint_report.write_json(flags["lintOut"])    # type: ignore
+        if "propOut" in flags:
+            # The full static fault-propagation artifact: the
+            # per-section x bit-class vulnerability map (one compiled
+            # fault-free run bounds the live flip window) plus the
+            # isolation proof.  Honored for every build, like -lintOut.
+            import json as _json
+            from coast_tpu.analysis.propagation import (
+                analyze_propagation, prove_isolation)
+            vmap = analyze_propagation(prog, facts=step_facts)
+            proof = prove_isolation(prog, facts=step_facts,
+                                    strategy=strategy or "unprotected")
+            with open(flags["propOut"], "w") as fh:   # type: ignore
+                _json.dump({"vulnerability_map": vmap.summary(),
+                            "isolation": proof.summary()},
+                           fh, indent=1, sort_keys=True)
+                fh.write("\n")
         if (strategy in ("TMR", "DWC")
                 and not flags.get("noCloneOpsCheck")
                 and not lint_report.ok):

@@ -91,6 +91,25 @@ def main(argv=None) -> int:
             reduction = (b.n / b.physical_n) if b.physical_n else 0.0
             best_reduction = max(best_reduction, reduction)
             part = reduced.equiv_partition
+
+            # Per-section exhaustive outcome distributions: the recorded
+            # ground truth the static vulnerability map's soundness is
+            # cross-validated against (tests/test_propagation.py pins
+            # that no section the map calls masked/detected-bounded
+            # shows SDC here), plus the map's own verdicts for the diff.
+            import numpy as np
+            from coast_tpu.analysis.propagation import analyze_propagation
+            from coast_tpu.inject import classify as cls
+            lids = np.asarray(a.schedule.leaf_id)
+            section_counts = {}
+            for sec in exhaustive.mmap.sections:
+                binc = np.bincount(a.codes[lids == sec.leaf_id],
+                                   minlength=cls.NUM_CLASSES)
+                section_counts[sec.name] = {
+                    name: int(c) for name, c in zip(cls.CLASS_NAMES, binc)
+                    if c}
+            vmap = analyze_propagation(prog, partition=part)
+
             row[strat] = {
                 "distributions_match": match,
                 "counts": {k: v for k, v in a.counts.items() if v},
@@ -102,6 +121,8 @@ def main(argv=None) -> int:
                 "section_modes": {
                     name: sig.mode_name
                     for name, sig in sorted(part.signatures.items())},
+                "section_counts": section_counts,
+                "propagation_verdicts": vmap.section_verdicts(),
                 "seconds": {"analysis": round(analysis_s, 3),
                             "exhaustive": round(exhaustive_s, 3),
                             "reduced": round(reduced_s, 3)},

@@ -7,15 +7,22 @@ recorded proof that the default protected builds carry their redundancy
 through compilation (ISSUE acceptance: the default-TMR sweep must be
 finding-free).  Exit status 1 if any error finding survives.
 
-Since the equivalence pass (analysis/equiv) shares the provenance walk,
-the sweep also times it per target and records each section's merge
-mode -- one artifact shows both what the linter proved and how far the
-campaign space prunes.  Per-target wall clock (lint + equiv) is
-recorded so sweep-time regressions show up in the diff.
+Since the equivalence pass (analysis/equiv) and the fault-propagation
+pass (analysis/propagation) share the provenance walk, the sweep runs
+all THREE static passes over ONE traced jaxpr and ONE shared
+:class:`~coast_tpu.analysis.propagation.walker.StepFacts` per cell --
+adding the third pass added no third trace -- and records per target:
+the lint findings, each section's merge mode, each section's static
+vulnerability verdict (masked / detected-bounded / sdc-possible with
+ACE-bit totals), the lane-isolation noninterference proof, AND that the
+seeded voter-bypass regression (an injected-lane value routed around
+the voter) is caught with a counterexample path.  Per-target wall clock
+(lint + equiv + propagation) is recorded so sweep-time regressions show
+up in the diff.
 
 Usage: python scripts/lint_sweep.py [--out artifacts/lint_sweep.json]
        [--strategies TMR,DWC] [--benchmarks a,b | --fast] [--no-survival]
-       [--no-equiv] [--cpu]
+       [--no-equiv] [--no-propagation] [--cpu]
 
 ``--fast`` sweeps the small tier-1 subset (the same one
 tests/test_lint.py::test_registry_subset_sweep_clean checks).
@@ -50,6 +57,8 @@ def main(argv=None) -> int:
     ap.add_argument("--no-survival", action="store_true")
     ap.add_argument("--no-equiv", action="store_true",
                     help="skip the equivalence-partition timing pass")
+    ap.add_argument("--no-propagation", action="store_true",
+                    help="skip the vulnerability-map / isolation pass")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
 
@@ -81,10 +90,12 @@ def main(argv=None) -> int:
 
     survival = not args.no_survival
     equiv_on = not args.no_equiv
+    prop_on = not args.no_propagation
     t_start = time.time()
     doc = {"backend": jax.default_backend(),
            "survival": survival,
            "equiv": equiv_on,
+           "propagation": prop_on,
            "strategies": strategies,
            "benchmarks": {},
            "target_seconds": {}}
@@ -95,18 +106,25 @@ def main(argv=None) -> int:
         for strat in strategies:
             t0 = time.time()
             prog = makers[strat](REGISTRY[bench]())
-            # One trace shared by the lint passes AND the equivalence
-            # partition: the walk is the expensive part, time it once.
+            # ONE trace and ONE shared walk feed the lint passes, the
+            # equivalence partition, AND the propagation pass: the
+            # trace+walk are the expensive parts, paid once per cell.
             closed = lint.trace_step(prog)
+            facts = None
+            if equiv_on or prop_on:
+                from coast_tpu.analysis.propagation import analyze_step
+                facts = analyze_step(prog, closed=closed)
             rep = lint.lint_program(prog, survival=survival, strategy=strat,
-                                    closed=closed)
+                                    closed=closed, propagation=prop_on,
+                                    facts=facts)
             row[strat] = {**rep.to_dict(),
                           "seconds": round(time.time() - t0, 3)}
+            part = None
             if equiv_on:
                 from coast_tpu.analysis.equiv import analyze_equivalence
                 t_eq = time.time()
                 try:
-                    part = analyze_equivalence(prog, closed=closed)
+                    part = analyze_equivalence(prog, facts=facts)
                     modes = {}
                     for sig in part.signatures.values():
                         modes[sig.mode_name] = modes.get(sig.mode_name,
@@ -121,6 +139,47 @@ def main(argv=None) -> int:
                 except Exception as e:  # noqa: BLE001 - sweep keeps going
                     row[strat]["equiv"] = {
                         "seconds": round(time.time() - t_eq, 3),
+                        "error": f"{type(e).__name__}: {e}"}
+            if prop_on:
+                from coast_tpu.analysis.propagation import (
+                    analyze_propagation, prove_isolation,
+                    seeded_voter_bypass)
+                t_pr = time.time()
+                try:
+                    vmap = analyze_propagation(prog, facts=facts,
+                                               partition=part)
+                    proof = prove_isolation(prog, facts=facts,
+                                            strategy=strat)
+                    # The acceptance regression, per target: the seeded
+                    # voter bypass (lane 0 routed around every vote)
+                    # must be refuted with a counterexample path.
+                    with seeded_voter_bypass():
+                        leak_prog = makers[strat](REGISTRY[bench]())
+                        leak_proof = prove_isolation(leak_prog,
+                                                     strategy=strat)
+                    caught = (not leak_proof.holds
+                              and all(l.path for l in leak_proof.leaks)
+                              and bool(leak_proof.leaks))
+                    row[strat]["propagation"] = {
+                        "seconds": round(time.time() - t_pr, 3),
+                        "verdicts": vmap.section_verdicts(),
+                        "verdict_counts": vmap.counts(),
+                        "ace": vmap.ace_summary(),
+                        "isolation": {
+                            "holds": proof.holds,
+                            "vacuous": proof.vacuous,
+                            "voted_commits": len(proof.voted_commits),
+                            "assumptions": proof.assumptions,
+                        },
+                        "seeded_leak_caught": caught,
+                        "seeded_leak_paths": leak_proof.total_leak_paths,
+                    }
+                    if not proof.holds or not caught:
+                        n_errors += 1
+                except Exception as e:  # noqa: BLE001 - sweep keeps going
+                    n_errors += 1
+                    row[strat]["propagation"] = {
+                        "seconds": round(time.time() - t_pr, 3),
                         "error": f"{type(e).__name__}: {e}"}
             n_errors += len(rep.errors())
             status = "ok" if rep.ok else "FINDINGS"
