@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke profile ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -131,6 +131,18 @@ sparse_smoke:
 # static-budget delta allocator.
 propagation_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.propagation_smoke
+
+# Campaign-profiler smoke (also a fast.yml driver row): attribution
+# sums to wall clock, outputs unchanged by profiling, profile verb +
+# federated fleet trace end-to-end.
+profile_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.profile_smoke
+
+# The campaign attribution report itself: refresh the recorded
+# artifacts/profile_mm.json baseline (on CPU, MFU pinned against the
+# v5e target ceiling; on TPU the backend table resolves the peak).
+profile:
+	$(PYTHON) -m coast_tpu profile --out artifacts/profile_mm.json
 
 # The repo gating itself (ROADMAP item 3's end-game): delta-check the
 # current tree against the committed baseline artifact.  Exit 0 = the

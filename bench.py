@@ -178,16 +178,25 @@ def worker(backend: str) -> None:
     # (classification-identical; the on-chip sweep in scripts/mfu_sweep.py
     # prices the trade for dispatch-bound tiny-benchmark campaigns).
     unroll = max(1, int(os.environ.get("COAST_BENCH_UNROLL", "1")))
-    runner = CampaignRunner(TMR(region), strategy_name="TMR", unroll=unroll)
+    # profile=True: every round artifact records the measured device-busy
+    # fraction AND the resolved backend per throughput row, so a
+    # CPU-fallback round (the PR 6-10 unmeasured-on-chip gap) is
+    # self-identifying instead of silently comparable to on-chip rows.
+    runner = CampaignRunner(TMR(region), strategy_name="TMR",
+                            unroll=unroll, profile=True)
     best = None
     for batch in BATCHES:
         runner.run(batch, seed=1, batch_size=batch)          # compile+warm
         res = runner.run(4 * batch, seed=42, batch_size=batch)
+        prof = res.profile or {}
         rec = {"stage": "result", "kind": "throughput",
                "benchmark": "matrixMultiply", "strategy": "TMR",
+               "backend": jax.default_backend(),
                "batch_size": batch, "injections": res.n,
                "seconds": round(res.seconds, 4),
                "injections_per_sec": round(res.injections_per_sec, 2),
+               "device_busy_fraction": prof.get("device_busy_fraction"),
+               "dispatch_gap_fraction": prof.get("dispatch_gap_fraction"),
                "counts": res.counts}
         _emit(rec)
         if best is None or res.injections_per_sec > best:
@@ -235,19 +244,26 @@ def worker(backend: str) -> None:
                   "fraction_of_peak": round(
                       gflops / TPU_V5E_BF16_PEAK_GFLOPS, 5),
                   "peak_ref": "v5e bf16 197 TFLOP/s"}
-        fl_runner = CampaignRunner(fl_prog, strategy_name="TMR")
+        fl_runner = CampaignRunner(fl_prog, strategy_name="TMR",
+                                   profile=True)
         fl_batches = []
         for batch in batches:
             fl_runner.run(batch, seed=1, batch_size=batch)   # compile+warm
             res = fl_runner.run(2 * batch, seed=42, batch_size=batch)
             camp_gflops = lanes_flops * res.n / res.seconds / 1e9
+            fl_prof = res.profile or {}
             fl_batches.append({
                 "batch_size": batch, "injections": res.n,
+                "backend": jax.default_backend(),
                 "seconds": round(res.seconds, 4),
                 "injections_per_sec": round(res.injections_per_sec, 2),
                 "gflops_per_sec": round(camp_gflops, 2),
                 "fraction_of_peak": round(
                     camp_gflops / TPU_V5E_BF16_PEAK_GFLOPS, 5),
+                "device_busy_fraction":
+                    fl_prof.get("device_busy_fraction"),
+                "dispatch_gap_fraction":
+                    fl_prof.get("dispatch_gap_fraction"),
                 "counts": res.counts})
         fl_rec["campaign"] = fl_batches
         _emit(fl_rec)

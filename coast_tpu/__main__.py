@@ -4,11 +4,13 @@ One stable spelling for the user-facing verbs, so operators (and the
 repo's own Makefile) do not need to know the module layout:
 
     python -m coast_tpu ci ...        # protection-regression CI
+    python -m coast_tpu profile ...   # campaign attribution report
     python -m coast_tpu fleet ...     # campaign fleet (alias)
     python -m coast_tpu analysis ...  # log analysis (alias)
     python -m coast_tpu opt ...       # protect + run one program (alias)
 
-``ci`` is the canonical home of the CI subcommand (ROADMAP item 3);
+``ci`` is the canonical home of the CI subcommand (ROADMAP item 3) and
+``profile`` of the device-time attribution report (obs/profile_cli);
 the others forward to their module CLIs unchanged.
 """
 
@@ -27,6 +29,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if verb == "ci":
         from coast_tpu.ci.__main__ import main as ci_main
         return ci_main(rest)
+    if verb == "profile":
+        from coast_tpu.obs.profile_cli import main as profile_main
+        return profile_main(rest)
     if verb == "fleet":
         from coast_tpu.fleet.supervisor import main as fleet_main
         return fleet_main(rest)
@@ -36,8 +41,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if verb == "opt":
         from coast_tpu.opt import main as opt_main
         return opt_main(rest)
-    print(f"Error, unknown verb {verb!r}; want one of: ci, fleet, "
-          "analysis, opt (see python -m coast_tpu --help)",
+    print(f"Error, unknown verb {verb!r}; want one of: ci, profile, "
+          "fleet, analysis, opt (see python -m coast_tpu --help)",
           file=sys.stderr)
     return 2
 

@@ -182,6 +182,15 @@ class Summary:
     # device histogram), None/"dense" otherwise, "mixed" for a directory
     # aggregating both.
     collect: Optional[str] = None
+    # Device-time attribution + roofline accounting (the
+    # CampaignRunner(profile=True) summary blocks): device-busy /
+    # host-gap / host-other seconds summing to the campaign wall clock,
+    # per-phase device seconds, and the mfu block (achieved vs
+    # roofline-predicted MFU, dispatch-gap fraction).  None for
+    # unprofiled logs and for directory aggregates (attribution
+    # fractions do not aggregate across campaigns).
+    profile: Optional[Dict[str, object]] = None
+    mfu: Optional[Dict[str, object]] = None
 
     @property
     def due(self) -> int:
@@ -280,6 +289,36 @@ class Summary:
             lines.append(f"  up   {up:>12} bytes ({up / 1e6:8.2f} MB)"
                          f"{mode}")
             lines.append(f"  down {down:>12} bytes ({down / 1e6:8.2f} MB)")
+        if self.profile:
+            prof = self.profile
+            lines.append("  --- device attribution ---")
+            wall = float(prof.get("wall_s") or 0.0) or 1.0
+
+            def _frac(key):
+                return 100.0 * float(prof.get(key) or 0.0) / wall
+
+            lines.append(
+                f"  device busy  {float(prof.get('device_busy_s', 0)):.4f}s"
+                f" ({_frac('device_busy_s'):5.1f}%)   host gap "
+                f"{float(prof.get('host_gap_s', 0)):.4f}s "
+                f"({_frac('host_gap_s'):5.1f}%)   other "
+                f"{float(prof.get('host_other_s', 0)):.4f}s")
+            phases = prof.get("per_phase_device_s") or {}
+            if phases:
+                lines.append("  per-phase device: " + "  ".join(
+                    f"{k} {float(v):.4f}s" for k, v in phases.items()))
+        if self.mfu:
+            mfu = self.mfu
+
+            def _pct(v):
+                return f"{100.0 * v:.4g}%" if v is not None else "-"
+
+            lines.append(
+                f"  MFU: achieved {_pct(mfu.get('achieved_mfu'))} "
+                f"(roofline ceiling {_pct(mfu.get('roofline_mfu'))}, "
+                f"dispatch-gap "
+                f"{_pct(mfu.get('dispatch_gap_fraction') or 0.0)}, "
+                f"flops overhead {mfu.get('flops_overhead')}x)")
         if self.resilience and any(self.resilience.values()):
             # Surface survived dispatch failures: a campaign that retried
             # or degraded its way to completion should say so in the same
@@ -413,6 +452,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     collects: set = set()
     transfer: Dict[str, int] = {}
     convergences: List[Dict[str, object]] = []
+    profiles: List[Dict[str, object]] = []
+    mfus: List[Dict[str, object]] = []
     for doc in docs:
         head = doc.get("summary") or {}
         if head.get("collect") == "sparse":
@@ -507,6 +548,10 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             transfer[key] = transfer.get(key, 0) + int(b)
         if summary.get("convergence"):
             convergences.append(summary["convergence"])
+        if summary.get("profile"):
+            profiles.append(summary["profile"])
+        if summary.get("mfu"):
+            mfus.append(summary["mfu"])
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     # The fault-model axis: absent key == the single-bit legacy model.
@@ -535,8 +580,11 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                    # Wilson intervals describe ONE campaign's sample;
                    # a directory mixing several logs has no aggregate
                    # interval, so only a lone convergence block is kept.
+                   # Same rule for the device-attribution blocks.
                    convergence=(convergences[0]
-                                if len(convergences) == 1 else None))
+                                if len(convergences) == 1 else None),
+                   profile=(profiles[0] if len(profiles) == 1 else None),
+                   mfu=(mfus[0] if len(mfus) == 1 else None))
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -578,7 +626,9 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             resilience=head["summary"].get("resilience") or None,
             fault_model=head["summary"].get("fault_model") or None,
             transfer=head["summary"].get("transfer_bytes") or None,
-            convergence=head["summary"].get("convergence") or None)
+            convergence=head["summary"].get("convergence") or None,
+            profile=head["summary"].get("profile") or None,
+            mfu=head["summary"].get("mfu") or None)
     except OSError:
         return None
 

@@ -210,6 +210,14 @@ class TargetReport:
     # non-empty list IS drift -- the tree's protection is broken before
     # any injection runs, so no campaign was enqueued for this target.
     isolation_leaks: List[str] = dataclasses.field(default_factory=list)
+    # Per-target campaign cost: wall seconds plus the stage breakdown
+    # (schedule/pad/dispatch/collect/... seconds) from the worker's done
+    # record, so a protection-CI cost regression -- a target whose delta
+    # suddenly re-injects everything, a compile that stopped caching --
+    # is visible in the verdict artifact, not just in CI latency graphs.
+    seconds: float = 0.0
+    stage_seconds: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     def drift_lines(self) -> List[str]:
         from coast_tpu.analysis.json_parser import format_drift_lines
@@ -275,13 +283,25 @@ class CiReport:
                 f"reinjected={t.reinjected_rows}/"
                 f"{t.reinjected_rows + t.reused_rows} rows"
                 + (f" (early-stop cut {t.dropped_rows})"
-                   if t.dropped_rows else ""))
+                   if t.dropped_rows else "")
+                + (f"  [{t.seconds:.2f}s campaign]"
+                   if t.seconds else ""))
             for d in t.drift_lines():
                 lines.append(f"         {d}")
         verdict = ("protection-regression DRIFT"
                    if self.drift else "protection unchanged: PASS")
         lines.append(f"ci: {len(self.targets)} target(s); {verdict}")
         return "\n".join(lines)
+
+
+def _stage_seconds(result: Dict[str, object]) -> Dict[str, float]:
+    """The done record's campaign stage breakdown (the worker's
+    ``res.summary()["stages"]``), seconds only -- the ``overlap``
+    fraction is a ratio, not a cost, and stays out of a seconds
+    table."""
+    stages = (result.get("summary") or {}).get("stages") or {}
+    return {str(k): round(float(v), 6) for k, v in sorted(stages.items())
+            if k != "overlap"}
 
 
 def _verdict_summary(name: str, n: int, counts: Dict[str, int]):
@@ -465,6 +485,8 @@ def check_baseline(doc: Dict[str, object],
                 comparison=cmp_,
                 section_comparisons=section_cmps,
                 cache_event=result.get("cache_event"),
+                seconds=round(float(result.get("seconds", 0.0)), 6),
+                stage_seconds=_stage_seconds(result),
             )
             reports.append(report)
             log(f"# check: {tid}: "

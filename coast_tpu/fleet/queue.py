@@ -332,6 +332,14 @@ class CampaignQueue:
             claim = self._read(self._item_path("claimed", item_id))
             doc["spec"] = claim["spec"]
             doc["attempts"] = claim.get("attempts", 1)
+            # Queue lifecycle timestamps ride into the done record so
+            # the fleet trace federation (obs/federate.py) can plot the
+            # claim->complete lease window without the claim doc, which
+            # is unlinked below.
+            for key in ("enqueued_unix", "claimed_unix",
+                        "lease_expires_unix"):
+                if claim.get(key) is not None:
+                    doc[key] = claim[key]
         except FileNotFoundError:
             pass
         atomic_write_json(self._item_path("done", item_id), doc)

@@ -228,6 +228,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
     p.add_argument("--status-json", default=None, metavar="PATH",
                    help="mirror the fleet status JSON here (atomic "
                    "replace) every poll")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write ONE federated Perfetto trace here after "
+                   "the drain: every item's journal span timeline "
+                   "(clock-skew corrected, SIGKILL'd+resumed workers' "
+                   "batches exactly once) plus the queue's "
+                   "claim/lease/complete events (obs/federate.py)")
 
     p = sub.add_parser("worker", help="run ONE worker process (what "
                        "`run` spawns)")
@@ -250,6 +256,9 @@ def parse_command_line(argv: Optional[List[str]] = None):
     _add_queue(p)
     p.add_argument("--out", default=None, metavar="PATH",
                    help="artifact path (default <queue>/fleet_result.json)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="also write the federated Perfetto trace of "
+                   "every item's journal timeline + queue events")
 
     # `-O -TMR` ergonomics, exactly as the inject supervisor CLI: argparse
     # eats a bare `-TMR` as an unknown option, so pre-join the pass flags
@@ -411,6 +420,10 @@ def cmd_run(args) -> int:
         return 1
     out = os.path.join(q.root, "fleet_result.json")
     atomic_write_json(out, result)
+    if args.trace_out:
+        from coast_tpu.obs.federate import write_merged_trace
+        write_merged_trace(q, args.trace_out)
+        print(f"wrote federated trace {args.trace_out}")
     totals = ", ".join(f"{k}={v}" for k, v in sorted(
         result["totals"].items()) if v)
     print(f"fleet: {len(result['items'])} campaigns merged "
@@ -471,6 +484,10 @@ def cmd_merge(args) -> int:
     atomic_write_json(out, result)
     print(f"wrote {out} ({len(result['items'])} items, "
           f"{result['injections']} injections, parity ok)")
+    if args.trace_out:
+        from coast_tpu.obs.federate import write_merged_trace
+        write_merged_trace(q, args.trace_out)
+        print(f"wrote federated trace {args.trace_out}")
     return 1 if result["failed"] else 0
 
 

@@ -104,6 +104,14 @@ class CampaignResult:
     # on every campaign the runner executes -- the quantity the sparse
     # mode exists to shrink.  Empty for results rebuilt from journals.
     transfer: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Device-time attribution (CampaignRunner(profile=True)): the
+    # per-dispatch blocking-marker timeline -- device-busy / host-gap /
+    # host-other seconds summing exactly to the campaign wall clock,
+    # per-phase device seconds, dispatch-latency histograms, and the
+    # roofline "mfu" sub-block (coast_tpu.obs.profiler / roofline).
+    # None for unprofiled campaigns (the default), so every existing
+    # summary stays byte-identical.
+    profile: Optional[Dict[str, object]] = None
 
     @property
     def injections_per_sec(self) -> float:
@@ -179,6 +187,16 @@ class CampaignResult:
                 self.n / self.physical_n, 2) if self.physical_n else 0.0
         if self.delta is not None:
             out["delta"] = dict(self.delta)
+        if self.profile is not None:
+            # Telemetry-classed blocks like ``stages``/``transfer_bytes``
+            # (volatile, never campaign identity): the device-time
+            # attribution, with the roofline accounting split out as its
+            # own ``mfu`` key for json_parser / mwtf_report consumers.
+            prof = dict(self.profile)
+            mfu = prof.pop("mfu", None)
+            out["profile"] = prof
+            if mfu is not None:
+                out["mfu"] = mfu
         if self.convergence is not None:
             out["convergence"] = dict(self.convergence)
         if self.chunks is not None:
@@ -341,7 +359,8 @@ class CampaignRunner:
                  equiv: "bool | object" = False,
                  metrics: "Optional[object]" = None,
                  collect: str = "dense",
-                 sparse_capacity: "Optional[int]" = None):
+                 sparse_capacity: "Optional[int]" = None,
+                 profile: "bool | object" = False):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -436,7 +455,19 @@ class CampaignRunner:
         ``sparse_capacity`` bounds the on-device interesting-row buffer
         per batch (default ``max(256, batch_size // 4)``).  Correctness
         never depends on it: a batch whose interesting rows overflow
-        the buffer falls back to a dense fetch for that batch."""
+        the buffer falls back to a dense fetch for that batch.
+
+        ``profile`` arms per-dispatch device-time attribution
+        (:class:`coast_tpu.obs.profiler.CampaignProfiler`, or ``True``
+        to build one from this program): every compiled invocation gets
+        a measured device-busy duration and host-side gap (blocking-
+        marker timing, backend-independent), split per protected-region
+        phase, summed so ``device_busy + host_gap + host_other`` equals
+        the campaign wall clock exactly, and combined with the analytic
+        roofline model into ``summary()["profile"]``/``["mfu"]``.
+        Campaign OUTPUTS (codes/counts/logs/journals) are byte-identical
+        with the profiler on or off -- it only observes timing; the
+        disabled default adds one attribute test per batch."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -474,6 +505,14 @@ class CampaignRunner:
                 "propagation class to reduce over")
         self.telemetry = telemetry if telemetry is not None \
             else obs.Telemetry()
+        self.profiler = None
+        if profile:
+            from coast_tpu.obs.profiler import CampaignProfiler
+            self.profiler = (profile
+                             if isinstance(profile, CampaignProfiler)
+                             else CampaignProfiler(prog))
+            if self.profiler.telemetry is None:
+                self.profiler.telemetry = self.telemetry
         self.equiv_partition = None
         if equiv:
             from coast_tpu.analysis.equiv import (EquivPartition,
@@ -892,6 +931,9 @@ class CampaignRunner:
         tel = self.telemetry
         mark = tel.mark() if _telemetry_mark is None else _telemetry_mark
         t0 = time.perf_counter()
+        prof = self.profiler
+        if prof is not None:
+            prof.begin(t0)
         outs: List[Dict[str, np.ndarray]] = []
         done = 0
         live_counts = np.zeros(cls.NUM_CLASSES, np.int64)
@@ -1127,7 +1169,10 @@ class CampaignRunner:
             if metrics is not None:
                 metrics.record_batch(done, n_part, counts_so_far,
                                      tel.stage_totals(since=mark),
-                                     resilience, transfer=transfer)
+                                     resilience, transfer=transfer,
+                                     profile=(prof.batch_sample()
+                                              if prof is not None
+                                              else None))
             if progress is not None:
                 progress(done, counts_so_far)
             return counts_so_far
@@ -1161,6 +1206,24 @@ class CampaignRunner:
             else:
                 def fetch():
                     return self._collect(flight["pending"])
+            if prof is not None:
+                # Blocking-marker device timing: wait for the batch to
+                # finish ON DEVICE (no transfer) under timing, then run
+                # the ordinary fetch.  Inside the fetch closure so the
+                # watchdog (below) guards the marker exactly like the
+                # fetch it precedes.  ``_p`` pins the dispatched result
+                # THIS attempt blocks on: an abandoned watchdog thread
+                # that wakes after the flight was re-dispatched sees a
+                # different pending object and must not report a ready
+                # for work the live attempt re-timed (the profiler's
+                # lock guards the remaining tiny window).
+                def fetch(_inner=fetch, _fl=flight,
+                          _p=flight["pending"]):
+                    jax.block_until_ready(_p)
+                    if _fl["pending"] is _p:
+                        prof.ready(_fl["lo"], _fl["n"],
+                                   time.perf_counter())
+                    return _inner()
             with tel.span("collect", n=flight["n"]):
                 if retry is not None and retry.collect_timeout:
                     # Ambient activation so the watchdog's own obs
@@ -1206,9 +1269,12 @@ class CampaignRunner:
                 tel.count("pad_waste_rows", batch_size - n_part)
             flight = {"pending": None, "n": n_part, "fault": fault,
                       "lo": lo, "attempts": 1, "spans": spans_rec}
+            _td0 = time.perf_counter() if prof is not None else 0.0
             with tel.span("dispatch", n=n_part):
                 flight["pending"] = _redispatch(flight)
             _last_span(spans_rec)
+            if prof is not None:
+                prof.dispatched(lo, n_part, _td0, time.perf_counter())
             return flight
 
         def _note_retry(flight_lo: int, attempt: int,
@@ -1269,11 +1335,18 @@ class CampaignRunner:
                     while True:
                         try:
                             if flight["pending"] is None:
+                                _tr0 = (time.perf_counter()
+                                        if prof is not None else 0.0)
                                 with tel.span("dispatch", n=flight["n"],
                                               retry=flight["attempts"]):
                                     flight["pending"] = _redispatch(
                                         flight)
                                 _last_span(flight["spans"])
+                                if prof is not None:
+                                    prof.dispatched(
+                                        int(flight["lo"]),
+                                        int(flight["n"]), _tr0,
+                                        time.perf_counter())
                             got = _collect_flight(flight)
                             break
                         except _Degrade:
@@ -1388,6 +1461,12 @@ class CampaignRunner:
                 counts = cls.counts_dict(binc, self._train)
                 counts["cache_invalid"] = invalid_total
         seconds = time.perf_counter() - t0
+        profile = None
+        if prof is not None:
+            # The attribution identity: device_busy + host_gap +
+            # host_other == seconds (this campaign's wall clock), exact
+            # by construction -- the profile_mm.json acceptance check.
+            profile = prof.finish(time.perf_counter(), wall_s=seconds)
         res = CampaignResult(
             benchmark=self.prog.region.name,
             strategy=self.strategy_name,
@@ -1407,6 +1486,7 @@ class CampaignRunner:
             interesting_rows=interesting_rows,
             transfer={"up": int(transfer["up"]),
                       "down": int(transfer["down"])},
+            profile=profile,
         )
         if tracker is not None:
             res.convergence = tracker.report(
@@ -2159,6 +2239,65 @@ class CampaignRunner:
         return _merge_results(results, int(chunks[0]["seed"]))
 
 
+def _merge_profiles(parts: List[CampaignResult]
+                    ) -> Optional[Dict[str, object]]:
+    """Merged device-time attribution for a multi-chunk campaign: sums
+    of the per-chunk buckets (each chunk's identity holds, so the sums'
+    does too), bucket-wise histogram merge, fractions recomputed over
+    the summed wall, and the mfu block re-derived from the summed
+    runs/device seconds (the analytic inputs are per-run constants of
+    the one shared program, so the first chunk's carry over)."""
+    profs = [p.profile for p in parts if p.profile]
+    if not profs:
+        return None
+    out: Dict[str, object] = {
+        "dispatches": sum(int(p["dispatches"]) for p in profs),
+        "rows": sum(int(p["rows"]) for p in profs),
+    }
+    for key in ("wall_s", "device_busy_s", "host_gap_s", "host_other_s"):
+        out[key] = round(sum(float(p[key]) for p in profs), 6)
+    wall = float(out["wall_s"]) or 1.0
+    out["device_busy_fraction"] = round(
+        float(out["device_busy_s"]) / wall, 6)
+    out["dispatch_gap_fraction"] = round(
+        float(out["host_gap_s"]) / wall, 6)
+    per_phase: Dict[str, float] = {}
+    for p in profs:
+        for name, s in (p.get("per_phase_device_s") or {}).items():
+            per_phase[name] = per_phase.get(name, 0.0) + float(s)
+    out["per_phase_device_s"] = {k: round(v, 6)
+                                 for k, v in per_phase.items()}
+    for key in ("device_seconds_histogram", "host_gap_seconds_histogram"):
+        hists = [p.get(key) for p in profs if p.get(key)]
+        if hists and all(h["le"] == hists[0]["le"] for h in hists):
+            out[key] = {
+                "le": list(hists[0]["le"]),
+                "counts": [sum(h["counts"][i] for h in hists)
+                           for i in range(len(hists[0]["le"]))],
+                "count": sum(int(h["count"]) for h in hists),
+                "sum": round(sum(float(h["sum"]) for h in hists), 6)}
+    out["backend"] = profs[0].get("backend")
+    mfus = [p.get("mfu") for p in profs if p.get("mfu")]
+    if mfus:
+        mfu = dict(mfus[0])            # per-run analytic constants
+        mfu["runs"] = int(out["rows"])
+        mfu["device_busy_s"] = out["device_busy_s"]
+        mfu["dispatch_gap_fraction"] = out["dispatch_gap_fraction"]
+        useful = float(mfu.get("useful_ops_per_run") or 0.0)
+        busy = float(out["device_busy_s"])
+        achieved = useful * mfu["runs"] / busy if busy > 0 else 0.0
+        mfu["achieved_ops_per_s"] = round(achieved, 1)
+        mfu["achieved_ops_per_s_wall"] = round(
+            useful * mfu["runs"] / wall, 1)
+        peak = mfu.get("peak_gflops")
+        if peak:
+            mfu["achieved_mfu"] = round(achieved / (peak * 1e9), 8)
+            mfu["achieved_mfu_wall"] = round(
+                useful * mfu["runs"] / wall / (peak * 1e9), 8)
+        out["mfu"] = mfu
+    return out
+
+
 def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
     if not parts:
         raise ValueError(
@@ -2235,4 +2374,5 @@ def _merge_results(parts: List[CampaignResult], seed: int) -> CampaignResult:
         collect=first.collect,
         interesting_rows=interesting,
         transfer=transfer,
+        profile=_merge_profiles(parts),
     )

@@ -251,6 +251,15 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "spans; on a resumed --journal campaign the "
                         "crashed run's recorded batches are included, "
                         "marked as replayed)")
+    parser.add_argument("--profile", action="store_true",
+                        help="per-dispatch device-time attribution: "
+                        "measure each compiled batch's device-busy "
+                        "duration and host-side gap (blocking-marker "
+                        "timing), record the summary profile/mfu "
+                        "blocks (roofline accounting), feed the "
+                        "dispatch-latency histograms to --metrics-port, "
+                        "and put device spans on their own --trace-out "
+                        "track.  Outputs are byte-identical either way")
     parser.add_argument("--max-retries", type=int, default=0,
                         help="retry transient XLA/device dispatch "
                         "failures up to N times per batch (exponential "
@@ -555,7 +564,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 fault_model=args.fault_model_parsed,
                                 equiv=args.equiv,
                                 metrics=None if chunked else metrics,
-                                collect=args.collect)
+                                collect=args.collect,
+                                profile=args.profile)
     except ValueError as e:
         if args.equiv:
             print(f"Error, {e}", file=sys.stderr)
@@ -632,7 +642,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                            stop_when=args.stop_when_parsed)
         else:
             from coast_tpu.obs.heartbeat import Heartbeat
-            beat = Heartbeat(total, interval_s=args.heartbeat)
+            # The hub (when armed) gives the beat the live
+            # transfer-bytes counters, so the link rate is visible
+            # DURING the campaign, not just in the summary.
+            beat = Heartbeat(total, interval_s=args.heartbeat,
+                             metrics=metrics)
 
         def progress(done, counts):
             last_beat["state"] = (done, counts)

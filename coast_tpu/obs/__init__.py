@@ -7,16 +7,22 @@ rate-limited progress heartbeat (:mod:`coast_tpu.obs.heartbeat`), live
 per-batch time-series metrics (:mod:`coast_tpu.obs.metrics`) with a
 zero-dependency HTTP endpoint (:mod:`coast_tpu.obs.serve`), statistical
 convergence tracking with Wilson-interval early stop
-(:mod:`coast_tpu.obs.convergence`), and a live TTY dashboard
-(:mod:`coast_tpu.obs.console`).  See docs/observability.md for the
+(:mod:`coast_tpu.obs.convergence`), a live TTY dashboard
+(:mod:`coast_tpu.obs.console`), per-dispatch device-time attribution
+(:mod:`coast_tpu.obs.profiler`) with roofline/MFU accounting
+(:mod:`coast_tpu.obs.roofline`), and fleet trace federation
+(:mod:`coast_tpu.obs.federate`).  See docs/observability.md for the
 workflow.
 """
 
 from coast_tpu.obs.console import Console
 from coast_tpu.obs.convergence import (ConvergenceTracker, StopWhen,
                                        StopWhenError, wilson_interval)
+from coast_tpu.obs.federate import merge_traces, write_merged_trace
 from coast_tpu.obs.heartbeat import Heartbeat
-from coast_tpu.obs.metrics import CampaignMetrics, Ring, atomic_write_json
+from coast_tpu.obs.metrics import (CampaignMetrics, Histogram, Ring,
+                                   atomic_write_json)
+from coast_tpu.obs.profiler import CampaignProfiler
 from coast_tpu.obs.serve import MetricsServer
 from coast_tpu.obs.spans import (NULL, Telemetry, count, current, instant,
                                  span)
@@ -27,6 +33,8 @@ __all__ = [
     "Telemetry", "NULL", "current", "span", "count", "instant",
     "to_trace_events", "to_trace_doc", "write_trace",
     "Heartbeat", "Console",
-    "CampaignMetrics", "Ring", "MetricsServer", "atomic_write_json",
+    "CampaignMetrics", "Histogram", "Ring", "MetricsServer",
+    "atomic_write_json",
+    "CampaignProfiler", "merge_traces", "write_merged_trace",
     "ConvergenceTracker", "StopWhen", "StopWhenError", "wilson_interval",
 ]

@@ -69,6 +69,8 @@ class Console:
         self._t0 = clock()
         self._last = self._t0 - self.interval_s   # first update eligible
         self._painted_lines = 0
+        from coast_tpu.obs.heartbeat import TransferRateWindow
+        self._transfer_window = TransferRateWindow(self._t0)
 
     # -- painting ------------------------------------------------------------
     def _tty(self) -> bool:
@@ -134,6 +136,9 @@ class Console:
         stage_line = self._stage_line()
         if stage_line:
             lines.append(stage_line)
+        transfer_line = self._transfer_line(now)
+        if transfer_line:
+            lines.append(transfer_line)
         res_line = self._resilience_line()
         if res_line:
             lines.append(res_line)
@@ -161,6 +166,32 @@ class Console:
         if mem:
             line += f"  mem {mem / 2**20:.0f}MiB"
         return line
+
+    def _transfer_line(self, now: float) -> Optional[str]:
+        """Live host<->device link rates from the hub's cumulative
+        transfer counters (the PR 12 block, previously summary-only),
+        plus the profiler's device-busy fraction when one is armed."""
+        if self.metrics is None:
+            return None
+        profile = dict(getattr(self.metrics, "profile", None) or {})
+        from coast_tpu.obs.heartbeat import format_rate
+        parts = []
+        got = self._transfer_window.rates(
+            now, getattr(self.metrics, "transfer", None))
+        if got is not None:
+            up_rate, down_rate, up, down = got
+            parts.append(f"link up {format_rate(up_rate)}"
+                         f" / down {format_rate(down_rate)}"
+                         f"  (total {up + down} B)")
+        busy = profile.get("device_busy_s")
+        if busy is not None:
+            # Same definition as every recorded surface
+            # (device_busy_fraction = busy / wall): busy over the
+            # campaign elapsed time, not over busy+gap.
+            elapsed = max(now - self._t0, 1e-9)
+            parts.append(
+                f"device busy {100.0 * float(busy) / elapsed:.0f}%")
+        return "  " + "  ".join(parts) if parts else None
 
     def _resilience_line(self) -> Optional[str]:
         if self.metrics is None:

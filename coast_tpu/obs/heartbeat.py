@@ -36,23 +36,78 @@ def _stderr(line: str) -> None:
     print(line, file=sys.stderr, flush=True)
 
 
+def format_rate(bytes_per_s: float) -> str:
+    """Human bytes/s ('1.2 MB/s'), shared with the console dashboard."""
+    v = float(bytes_per_s)
+    for unit, scale in (("GB", 1e9), ("MB", 1e6), ("kB", 1e3)):
+        if v >= scale:
+            return f"{v / scale:.1f} {unit}/s"
+    return f"{v:.0f} B/s"
+
+
+class TransferRateWindow:
+    """Cumulative up/down byte counters -> per-window rates.  The one
+    windowing implementation behind both the heartbeat's ``up=/down=``
+    fields and the console's link line (same ``_prev`` state shape,
+    same dt clamp)."""
+
+    def __init__(self, t0: float):
+        self._prev = (float(t0), 0, 0)
+
+    def rates(self, now: float, transfer) -> "Optional[tuple]":
+        """(up_bytes_per_s, down_bytes_per_s, up_total, down_total) over
+        the window since the previous call, or None before the first
+        measured byte."""
+        transfer = dict(transfer or {})
+        if not transfer:
+            return None
+        up = int(transfer.get("up", 0))
+        down = int(transfer.get("down", 0))
+        t_prev, up_prev, down_prev = self._prev
+        self._prev = (float(now), up, down)
+        dt = max(float(now) - t_prev, 1e-9)
+        return ((up - up_prev) / dt, (down - down_prev) / dt, up, down)
+
+
 class Heartbeat:
-    """Rate-limited progress reporter for a campaign of ``total`` runs."""
+    """Rate-limited progress reporter for a campaign of ``total`` runs.
+
+    ``metrics`` (a :class:`coast_tpu.obs.metrics.CampaignMetrics` hub
+    the same campaign feeds) adds a live host<->device transfer rate to
+    each beat -- the PR 12 ``transfer_bytes`` block was summary-only,
+    invisible while the campaign it describes is still running."""
 
     def __init__(self, total: int, interval_s: float = 5.0,
                  label: str = "heartbeat",
                  emit: Optional[Callable[[str], None]] = None,
+                 metrics=None,
                  clock: Callable[[], float] = time.monotonic):
         self.total = int(total)
         self.interval_s = float(interval_s)
         self.label = label
+        self.metrics = metrics
         self.emitted = 0
         self._emit = emit or _stderr
         self._clock = clock
         self._t0 = clock()
+        self._transfer_window = TransferRateWindow(self._t0)
         # First update is eligible immediately: a long first batch should
         # not run silent for interval_s before the first report.
         self._last = self._t0 - self.interval_s
+
+    def _transfer_parts(self, now: float) -> list:
+        """Up/down rates over the window since the previous beat, from
+        the hub's cumulative transfer counters; empty before the first
+        measured byte."""
+        if self.metrics is None:
+            return []
+        got = self._transfer_window.rates(
+            now, getattr(self.metrics, "transfer", None))
+        if got is None:
+            return []
+        up_rate, down_rate, _up, _down = got
+        return [f"up={format_rate(up_rate)}",
+                f"down={format_rate(down_rate)}"]
 
     def update(self, done: int, counts: Optional[Dict[str, int]] = None,
                force: bool = False) -> Optional[str]:
@@ -76,6 +131,7 @@ class Heartbeat:
         if counts:
             parts.extend(f"{k}={counts[k]}" for k in _COUNT_KEYS
                          if counts.get(k))
+        parts.extend(self._transfer_parts(now))
         line = " ".join(parts)
         self.emitted += 1
         self._emit(line)

@@ -43,7 +43,7 @@ from typing import Deque, Dict, List, Mapping, Optional, Tuple
 from coast_tpu.inject.classify import SDC_CLASSES as _SDC_CLASSES
 from coast_tpu.obs.convergence import interval_table
 
-__all__ = ["Ring", "CampaignMetrics", "device_memory_bytes",
+__all__ = ["Ring", "Histogram", "CampaignMetrics", "device_memory_bytes",
            "atomic_write_json"]
 
 
@@ -99,6 +99,51 @@ _SERIES = ("inj_per_sec", "inj_per_sec_cumulative", "done_rows",
            "effective_done", "sdc_rate", "device_memory_bytes")
 
 
+class Histogram:
+    """Prometheus-style cumulative-bucket histogram (fixed bounds).
+
+    The campaign profiler's per-dispatch device-seconds distribution
+    needs more than a gauge: the fused-kernel A/B cares whether the
+    dispatch population *shifted*, not just its mean.  This is the one
+    histogram implementation behind both the profiler's recorded
+    snapshots and the ``/metrics`` exposition -- the first histogram-
+    typed exporter in the hub (everything before PR 15 was a
+    gauge/counter).
+
+    ``le`` bounds are upper-inclusive seconds; observations above the
+    last bound land only in the implicit ``+Inf`` bucket (``count``).
+    """
+
+    #: Log-spaced per-dispatch latency bounds: 0.5 ms (a warm tiny-batch
+    #: CPU dispatch) through 30 s (a flagship batch behind a tunnel).
+    DEFAULT_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                      0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+    def __init__(self, bounds: Optional[Tuple[float, ...]] = None):
+        self.bounds = tuple(float(b) for b in (bounds or
+                                               self.DEFAULT_BOUNDS))
+        self.bucket_counts = [0] * len(self.bounds)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        for i, bound in enumerate(self.bounds):
+            if v <= bound:
+                self.bucket_counts[i] += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-able form: CUMULATIVE per-bucket counts (Prometheus
+        ``le`` semantics -- bucket i counts observations <= bounds[i])
+        plus the scalar sum/count."""
+        return {"le": list(self.bounds),
+                "counts": list(self.bucket_counts),
+                "count": int(self.count),
+                "sum": round(self.sum, 6)}
+
+
 class CampaignMetrics:
     """Thread-safe live-metrics hub for one campaign at a time.
 
@@ -138,6 +183,13 @@ class CampaignMetrics:
         # attribution: up-bytes accrue in the pad/dispatch stages,
         # down-bytes in collect.
         self.transfer: Dict[str, int] = {}
+        # Device-time attribution (CampaignRunner(profile=True)):
+        # cumulative device-busy / host-gap seconds plus per-dispatch
+        # latency histograms -- the hub's first histogram-typed
+        # exporters.  Empty for unprofiled campaigns, so every existing
+        # surface is unchanged.
+        self.profile: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
         self.batches = 0
         self.replayed_batches = 0
         self.memory_watermark: Optional[int] = None
@@ -163,6 +215,8 @@ class CampaignMetrics:
             self.stages = {}
             self.resilience = {}
             self.transfer = {}
+            self.profile = {}
+            self.histograms = {}
             self.batches = 0
             self.replayed_batches = 0
             self.error = None
@@ -177,14 +231,35 @@ class CampaignMetrics:
                      stages: Mapping[str, float],
                      resilience: Mapping[str, int],
                      replayed: bool = False,
-                     transfer: Optional[Mapping[str, int]] = None
+                     transfer: Optional[Mapping[str, int]] = None,
+                     profile: Optional[Mapping[str, float]] = None
                      ) -> None:
         """One collected (or journal-replayed) batch: cumulative row
         progress, the cumulative weighted class histogram, stage
         totals, resilience counters, and (when the loop measures it)
-        cumulative host<->device transfer bytes so far."""
+        cumulative host<->device transfer bytes so far.  ``profile`` is
+        the profiler's per-batch sample ({device_s, gap_s}) -- observed
+        into the dispatch-latency histograms and summed into the
+        cumulative attribution block."""
         now = self._clock()
         with self._lock:
+            if profile is not None:
+                self.profile["device_busy_s"] = (
+                    self.profile.get("device_busy_s", 0.0)
+                    + float(profile.get("device_s", 0.0)))
+                self.profile["host_gap_s"] = (
+                    self.profile.get("host_gap_s", 0.0)
+                    + float(profile.get("gap_s", 0.0)))
+                self.profile["dispatches"] = (
+                    self.profile.get("dispatches", 0) + 1)
+                for key, sample in (("dispatch_device_seconds",
+                                     "device_s"),
+                                    ("dispatch_host_gap_seconds",
+                                     "gap_s")):
+                    hist = self.histograms.get(key)
+                    if hist is None:
+                        hist = self.histograms[key] = Histogram()
+                    hist.observe(float(profile.get(sample, 0.0)))
             dt = max(now - self._t_last_batch, 1e-9)
             elapsed = max(now - self._t_start, 1e-9)
             self._t_last_batch = now
@@ -275,6 +350,13 @@ class CampaignMetrics:
                     name: [[round(t, 4), v] for t, v in ring.points()]
                     for name, ring in self.rings.items()},
             }
+            if self.profile:
+                doc["profile"] = {
+                    **{k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in self.profile.items()},
+                    "histograms": {k: h.snapshot()
+                                   for k, h in self.histograms.items()},
+                }
             if self.error:
                 doc["error"] = self.error
             if self.convergence is not None:
@@ -365,6 +447,33 @@ class CampaignMetrics:
                    [(f'{labels},direction="{_esc(k)}"', float(v))
                     for k, v in sorted(self.transfer.items())]
                    or [(f'{labels},direction="up"', 0.0)])
+            if self.profile:
+                metric("coast_campaign_device_busy_seconds_total",
+                       "counter",
+                       "Measured device-busy seconds "
+                       "(per-dispatch blocking-marker attribution).",
+                       [(labels,
+                         float(self.profile.get("device_busy_s", 0.0)))])
+                metric("coast_campaign_dispatch_gap_seconds_total",
+                       "counter",
+                       "Measured host-side gap seconds the device sat "
+                       "idle between dispatches.",
+                       [(labels,
+                         float(self.profile.get("host_gap_s", 0.0)))])
+            for hname, hist in sorted(self.histograms.items()):
+                # The histogram exposition type (new in PR 15): one
+                # cumulative le-bucket series + _sum/_count per name.
+                full = f"coast_campaign_{hname}"
+                lines.append(f"# HELP {full} Per-dispatch latency "
+                             "histogram (seconds).")
+                lines.append(f"# TYPE {full} histogram")
+                for bound, cum in zip(hist.bounds, hist.bucket_counts):
+                    lines.append(
+                        f'{full}_bucket{{{labels},le="{bound:g}"}} {cum}')
+                lines.append(
+                    f'{full}_bucket{{{labels},le="+Inf"}} {hist.count}')
+                lines.append(f"{full}_sum{{{labels}}} {hist.sum:.17g}")
+                lines.append(f"{full}_count{{{labels}}} {hist.count}")
             if self.memory_watermark is not None:
                 metric("coast_campaign_device_memory_watermark_bytes",
                        "gauge",

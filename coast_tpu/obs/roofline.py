@@ -1,0 +1,297 @@
+"""Roofline / MFU accounting: analytic op counts + measured device time.
+
+docs/perf.md ends on the number that gates the fused-kernel work: TMR
+reaches ~0.44% of bf16 peak at flagship sizes, with the *claimed*
+culprit per-step scalar bookkeeping between matmul dispatches.  This
+module turns that claim into recorded arithmetic:
+
+  * :func:`count_jaxpr_ops` walks a jaxpr and counts arithmetic ops
+    (2mnk per ``dot_general``, one per element for elementwise
+    primitives, operand size for reductions; pure data movement --
+    reshapes, slices, transposes, converts -- counts zero).  Control
+    flow recurses: ``scan`` multiplies by its static length, ``while``
+    by a caller-supplied trip count (the region's ``nominal_steps`` --
+    the fault-free runtime, the honest estimate for the early-exit
+    campaign loop), ``cond`` takes the widest branch.
+  * :func:`region_ops_per_run` is the USEFUL work of one fault-free run
+    (the unprotected step x nominal_steps) -- the MFU numerator;
+    :func:`program_ops_per_run` counts the PROTECTED program (lanes,
+    voters, CFCSS, guards included), so their ratio
+    (:func:`flops_overhead`) generalizes train/'s analytic
+    ``flops_overhead`` column to every registry benchmark.
+  * :func:`mfu_block` combines those counts with the profiler's
+    measured device-busy seconds into the ``summary()["mfu"]`` block:
+    achieved ops/s, achieved MFU against a resolved peak, the
+    roofline-predicted MFU ceiling from the voter-traffic model of
+    docs/perf.md (state x lanes HBM bytes per commit step), the voter
+    bytes share, and the dispatch-gap fraction.
+
+Counts are ARITHMETIC ops, not IEEE FLOPs: the integer benchmarks
+(crc16, sha256...) do integer work on the same VPU lanes, and a
+consistent count is what an A/B needs.  All inputs land in the emitted
+block so a reader can audit the model.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["count_jaxpr_ops", "region_ops_per_run", "program_ops_per_run",
+           "flops_overhead", "phase_split", "resolve_peak", "mfu_block",
+           "region_state_bytes"]
+
+#: One op per output element.
+_ELEMENTWISE = frozenset((
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "neg",
+    "sign", "abs", "max", "min", "and", "or", "xor", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "clamp",
+    "exp", "log", "log1p", "expm1", "tanh", "logistic", "erf",
+    "erfc", "erf_inv", "sin", "cos", "tan", "asin", "acos", "atan",
+    "atan2", "sinh", "cosh", "sqrt", "rsqrt", "cbrt", "square",
+    "floor", "ceil", "round", "nextafter", "is_finite", "population_count",
+    "clz",
+))
+
+#: One op per INPUT element (the reduction tree).
+_REDUCTIONS = frozenset((
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+))
+
+
+def _size(var) -> int:
+    shape = getattr(var.aval, "shape", ())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _sub_jaxprs(value):
+    """Jaxpr-valued params (ClosedJaxpr / Jaxpr / containers thereof),
+    the generic recursion for higher-order primitives this counter does
+    not special-case."""
+    from jax.extend import core as jex_core  # noqa: F401 - jaxpr types
+    import jax.core as jcore
+    out = []
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (tuple, list)):
+            stack.extend(v)
+        elif hasattr(v, "jaxpr") and hasattr(v, "consts"):
+            out.append(v.jaxpr)                 # ClosedJaxpr
+        elif isinstance(v, jcore.Jaxpr):
+            out.append(v)
+    return out
+
+
+def count_jaxpr_ops(jaxpr, while_trip: int = 1) -> float:
+    """Arithmetic ops of one jaxpr evaluation (see module docstring).
+
+    ``while_trip`` is the trip-count estimate applied to every ``while``
+    encountered -- callers pass the region's ``nominal_steps`` (the
+    fault-free runtime the early-exit campaign loop actually executes).
+    """
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)     # ClosedJaxpr -> Jaxpr
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+            lhs_shape = eqn.invars[0].aval.shape
+            k = 1
+            for d in lhs_c:
+                k *= int(lhs_shape[d])
+            total += 2.0 * k * max(_size(eqn.outvars[0]), 1)
+        elif name in _ELEMENTWISE:
+            total += _size(eqn.outvars[0])
+        elif name in _REDUCTIONS:
+            total += _size(eqn.invars[0])
+        elif name == "scan":
+            inner = count_jaxpr_ops(eqn.params["jaxpr"], while_trip)
+            total += inner * int(eqn.params["length"])
+        elif name == "while":
+            body = count_jaxpr_ops(eqn.params["body_jaxpr"], while_trip)
+            cond = count_jaxpr_ops(eqn.params["cond_jaxpr"], while_trip)
+            total += max(1, int(while_trip)) * (body + cond)
+        elif name == "cond":
+            total += max(count_jaxpr_ops(b, while_trip)
+                         for b in eqn.params["branches"])
+        else:
+            # pjit / closed_call / custom_jvp / remat / checkpoint ...:
+            # recurse into any jaxpr-valued param; everything else
+            # (reshape, slice, DUS, broadcast, iota, convert, gather) is
+            # data movement and counts zero.
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    total += count_jaxpr_ops(sub, while_trip)
+    return total
+
+
+def _state_avals(region):
+    import jax
+    return jax.eval_shape(region.init)
+
+
+def region_ops_per_run(region) -> float:
+    """Useful arithmetic ops of one fault-free run: the unprotected
+    step's jaxpr ops x ``nominal_steps``.  The MFU numerator, and the
+    generalization of train/'s per-iteration FLOPs table to regions
+    without an analytic ``meta`` block."""
+    import jax
+    import jax.numpy as jnp
+    closed = jax.make_jaxpr(region.bound_step())(
+        _state_avals(region), jnp.int32(0))
+    return (count_jaxpr_ops(closed, region.nominal_steps)
+            * region.nominal_steps)
+
+
+def program_ops_per_run(prog, steps: Optional[int] = None) -> float:
+    """Arithmetic ops of one PROTECTED run (lanes + voters + signatures
+    + guards): the jaxpr of ``prog.run`` with its early-exit while loop
+    priced at ``steps`` iterations (default the region's
+    ``nominal_steps`` -- what a fault-free run executes)."""
+    import jax
+    import jax.numpy as jnp
+    trip = int(steps) if steps is not None else prog.region.nominal_steps
+    fault = {k: jax.ShapeDtypeStruct((), jnp.int32)
+             for k in ("leaf_id", "lane", "word", "bit", "t")}
+    closed = jax.make_jaxpr(lambda f: prog.run(f))(fault)
+    return count_jaxpr_ops(closed, trip)
+
+
+def flops_overhead(prog) -> float:
+    """Protected / unprotected op ratio, analytically from the jaxprs --
+    the registry-wide generalization of ``coast_tpu.train
+    .flops_overhead`` (which stays authoritative for train regions,
+    whose ``meta`` carries exact per-phase FLOPs)."""
+    useful = region_ops_per_run(prog.region)
+    return program_ops_per_run(prog) / useful if useful else float("nan")
+
+
+def region_state_bytes(region) -> int:
+    """Per-lane persistent state footprint from the region's own init
+    shapes (the ground truth ``meta["state_bytes"]`` must not
+    understate); shared with scripts/flagship_campaign.py's batch
+    sizing."""
+    import jax
+    shapes = jax.eval_shape(region.init)
+    return int(sum(int(math.prod(s.shape)) * s.dtype.itemsize
+                   for s in jax.tree.leaves(shapes)))
+
+
+def phase_split(region) -> List[Tuple[str, float]]:
+    """The protected-region phases and their analytic work shares, for
+    attributing measured device time.  Train regions split fwd/bwd/
+    commit by their ``meta`` FLOPs table (the fwd/bwd/commit micro-steps
+    of coast_tpu.train); every single-phase region gets one ``step``
+    span covering the whole dispatch."""
+    flops = (region.meta.get("train") or {}).get("flops")
+    if flops:
+        total = float(flops["fwd"] + flops["bwd"] + flops["update"]) or 1.0
+        return [("fwd", flops["fwd"] / total),
+                ("bwd", flops["bwd"] / total),
+                ("commit", flops["update"] / total)]
+    return [("step", 1.0)]
+
+
+#: Known per-backend peaks (single chip).  The TPU row is the v5e bf16
+#: peak every perf.md MFU number is quoted against; CPU has no honest
+#: published peak, so MFU stays None there unless the operator pins one
+#: (COAST_PEAK_GFLOPS, or the profile CLI's --peak-gflops for recording
+#: a CPU-measured attribution against the TPU target ceiling).
+_BACKEND_PEAK_GFLOPS = {"tpu": (197_000.0, "v5e-bf16")}
+
+#: v5e single-chip HBM bandwidth (GB/s), the roofline's byte axis.
+DEFAULT_HBM_GBPS = 819.0
+
+
+def resolve_peak(backend: Optional[str] = None,
+                 peak_gflops: Optional[float] = None
+                 ) -> Tuple[Optional[float], str]:
+    """(peak FLOP/s or None, source tag).  Priority: explicit argument >
+    COAST_PEAK_GFLOPS env > the backend table."""
+    if peak_gflops:
+        return float(peak_gflops) * 1e9, "explicit"
+    env = os.environ.get("COAST_PEAK_GFLOPS")
+    if env:
+        return float(env) * 1e9, "env:COAST_PEAK_GFLOPS"
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    row = _BACKEND_PEAK_GFLOPS.get(backend)
+    if row is None:
+        return None, f"unknown backend {backend!r}"
+    return row[0] * 1e9, row[1]
+
+
+def mfu_block(prog, runs: int, device_busy_s: float, wall_s: float,
+              dispatch_gap_fraction: float,
+              peak_gflops: Optional[float] = None,
+              hbm_gbps: float = DEFAULT_HBM_GBPS,
+              ops: Optional[Dict[str, float]] = None) -> Dict[str, object]:
+    """The ``summary()["mfu"]`` block: analytic ops + measured device
+    time -> achieved vs roofline-predicted MFU.
+
+    ``ops`` optionally carries pre-computed ``{"useful", "program"}``
+    per-run op counts (the profiler caches them -- the jaxpr trace costs
+    a compile-trace, paid once per runner).  ``runs`` is the number of
+    physically dispatched injections the measured ``device_busy_s``
+    covers.  Every model input is recorded so the block is auditable.
+    """
+    import jax
+    region = prog.region
+    if ops is None:
+        ops = {"useful": region_ops_per_run(region),
+               "program": program_ops_per_run(prog)}
+    useful = float(ops["useful"])
+    program = float(ops["program"])
+    peak, peak_source = resolve_peak(peak_gflops=peak_gflops)
+    lanes = int(prog.cfg.num_clones)
+    state_bytes = region_state_bytes(region)
+    # The docs/perf.md voter-traffic model: per commit step the voter
+    # moves O(state x lanes) HBM bytes while the matmul does the useful
+    # FLOPs -- one vote per step plus the boundary sync.
+    voter_bytes = float(lanes * state_bytes * (region.nominal_steps + 1))
+    out: Dict[str, object] = {
+        "useful_ops_per_run": round(useful, 1),
+        "program_ops_per_run": round(program, 1),
+        "flops_overhead": round(program / useful, 4) if useful else None,
+        "runs": int(runs),
+        "device_busy_s": round(device_busy_s, 6),
+        "dispatch_gap_fraction": round(dispatch_gap_fraction, 6),
+        "state_bytes": state_bytes,
+        "lanes": lanes,
+        "voter_bytes_per_run": voter_bytes,
+        "hbm_gbps": hbm_gbps,
+        "backend": jax.default_backend(),
+        "peak_source": peak_source,
+    }
+    achieved = (useful * runs / device_busy_s) if device_busy_s > 0 else 0.0
+    wall_rate = (useful * runs / wall_s) if wall_s > 0 else 0.0
+    out["achieved_ops_per_s"] = round(achieved, 1)
+    out["achieved_ops_per_s_wall"] = round(wall_rate, 1)
+    if peak:
+        out["peak_gflops"] = peak / 1e9
+        out["achieved_mfu"] = round(achieved / peak, 8)
+        out["achieved_mfu_wall"] = round(wall_rate / peak, 8)
+        # Roofline ceiling: useful-FLOP time vs voter HBM time.  The
+        # protected program cannot beat this no matter how the
+        # bookkeeping is fused -- the structural table of docs/perf.md.
+        t_flops = useful / peak
+        t_bytes = voter_bytes / (hbm_gbps * 1e9)
+        denom = t_flops + t_bytes
+        out["roofline_mfu"] = round(t_flops / denom, 8) if denom else None
+        out["voter_bytes_share"] = (round(t_bytes / denom, 6)
+                                    if denom else None)
+    else:
+        out["peak_gflops"] = None
+        out["achieved_mfu"] = None
+        out["roofline_mfu"] = None
+        out["voter_bytes_share"] = None
+    return out

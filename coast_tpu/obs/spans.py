@@ -166,10 +166,16 @@ class Telemetry:
         Journal-replayed spans (``span_at(..., replayed=True)``) are
         excluded: they exist for trace continuity, but their seconds
         belong to the crashed run -- counting them would make a resumed
-        campaign's stage totals exceed its own wall clock.
+        campaign's stage totals exceed its own wall clock.  Device-
+        attributed spans (``span_at(..., device=True)``, the campaign
+        profiler's per-phase windows) are excluded for the dual reason:
+        they re-time work already billed to the host-side
+        dispatch/collect stages on another track -- counting them would
+        double-bill the device seconds into the host stage table.
         """
         spans = [e for e in self.events[since:] if e["kind"] == "span"
-                 and not (e.get("args") or {}).get("replayed")]
+                 and not (e.get("args") or {}).get("replayed")
+                 and not (e.get("args") or {}).get("device")]
         if not spans:
             return {}
         top = min(e["depth"] for e in spans)     # type: ignore[type-var]

@@ -23,10 +23,14 @@ from typing import Dict, List, Optional
 
 from coast_tpu.obs.spans import Telemetry
 
-# One synthetic process/thread: the campaign loop is single-threaded and
-# a single track renders the nested stage spans the way they ran.
+# One synthetic process: the campaign loop is single-threaded and one
+# host track renders the nested stage spans the way they ran.  The
+# profiler's device-attributed spans (``span_at(..., device=True)``)
+# land on their own track so Perfetto shows device-busy windows BESIDE
+# the host stages instead of nested inside them.
 _PID = 1
 _TID = 1
+_DEVICE_TID = 2
 
 
 def _origin(telemetry: Telemetry) -> float:
@@ -56,6 +60,12 @@ def to_trace_events(telemetry: Telemetry,
     events: List[Dict[str, object]] = [{
         "name": "process_name", "ph": "M", "pid": _PID, "tid": _TID,
         "args": {"name": process_name},
+    }, {
+        "name": "thread_name", "ph": "M", "pid": _PID, "tid": _TID,
+        "args": {"name": "host"},
+    }, {
+        "name": "thread_name", "ph": "M", "pid": _PID,
+        "tid": _DEVICE_TID, "args": {"name": "device"},
     }]
     for e in telemetry.events:
         kind = e["kind"]
@@ -63,9 +73,11 @@ def to_trace_events(telemetry: Telemetry,
         if kind == "span":
             events.append({
                 "name": e["name"],
-                "cat": ("replay" if args.get("replayed") else "stage"),
+                "cat": ("device" if args.get("device") else
+                        "replay" if args.get("replayed") else "stage"),
                 "ph": "X",
-                "pid": _PID, "tid": _TID,
+                "pid": _PID,
+                "tid": _DEVICE_TID if args.get("device") else _TID,
                 "ts": _us(float(e["t0"])),                  # type: ignore
                 "dur": round((float(e["t1"]) - float(e["t0"]))  # type: ignore
                              * 1e6, 3),

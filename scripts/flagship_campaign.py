@@ -65,11 +65,10 @@ def region_state_bytes(region):
     so train targets are sized by their full persistent state (params +
     moments + golden leaves) automatically: ``train_mlp_adam`` rows cost
     more than ``train_mlp`` rows exactly because the extra ``v_*``
-    moments are real HBM."""
-    import jax
-    shapes = jax.eval_shape(region.init)
-    return int(sum(int(math.prod(s.shape)) * s.dtype.itemsize
-                   for s in jax.tree.leaves(shapes)))
+    moments are real HBM.  Canonical implementation lives with the
+    roofline accounting (one derivation shared with the MFU model)."""
+    from coast_tpu.obs.roofline import region_state_bytes as _rsb
+    return _rsb(region)
 
 
 def analytic_batch(region, lanes, device=None, util=0.5, sites=1):

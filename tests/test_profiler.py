@@ -1,0 +1,484 @@
+"""Campaign profiler, roofline accounting, and fleet trace federation.
+
+Covers: jaxpr arithmetic-op counting (pinned on a known kernel), the
+generalized flops-overhead ratio, phase splitting (train fwd/bwd/commit
+vs single-phase), the per-dispatch attribution identity (device_busy +
+host_gap + host_other == wall, exactly), output byte-identity with the
+profiler on/off (dense and sparse), the disabled-path <2% overhead
+bound (the PR 1 obs bound extended to the profiler hooks), the
+histogram exporter type (Prometheus exposition + /status block), the
+live transfer-rate display fix (Heartbeat/Console), the Perfetto device
+track, the profile CLI artifact, and trace federation's edge cases:
+clock-skewed worker segments re-anchored monotone, a SIGKILL'd+resumed
+worker's batches appearing exactly once, and the queue's
+claim/lease/complete events on the fleet track.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coast_tpu import TMR, obs
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.models import mm
+from coast_tpu.obs import roofline
+from coast_tpu.obs.metrics import CampaignMetrics, Histogram
+from coast_tpu.obs.profiler import CampaignProfiler
+
+
+@pytest.fixture(scope="module")
+def region():
+    return mm.make_region()
+
+
+@pytest.fixture(scope="module")
+def prog(region):
+    return TMR(region)
+
+
+@pytest.fixture(scope="module")
+def profiled_runner(prog):
+    return CampaignRunner(prog, strategy_name="TMR", profile=True)
+
+
+@pytest.fixture(scope="module")
+def profiled_result(profiled_runner):
+    profiled_runner.run(48, seed=1, batch_size=48)     # warm compile
+    return profiled_runner.run(240, seed=17, batch_size=48)
+
+
+# -- roofline op counting -----------------------------------------------------
+
+def test_count_jaxpr_ops_pinned_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.dot(a, b) + 1.0
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((4, 5)), jnp.zeros((5, 6)))
+    # dot: 2*k*prod(out) = 2*5*24 = 240; add: 24 elements.
+    assert roofline.count_jaxpr_ops(closed) == 240 + 24
+
+
+def test_count_jaxpr_ops_scan_multiplies():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        def body(c, _):
+            return c * 2.0, None
+        out, _ = jax.lax.scan(body, x, None, length=7)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((3,)))
+    assert roofline.count_jaxpr_ops(closed) == 7 * 3
+
+
+def test_region_vs_program_ops(region, prog):
+    useful = roofline.region_ops_per_run(region)
+    protected = roofline.program_ops_per_run(prog)
+    assert useful > 0
+    # 3 lanes + voters + flip machinery: strictly more than the lanes
+    # alone, and the ratio is the generalized flops_overhead column.
+    assert protected > 3 * useful * 0.5
+    assert roofline.flops_overhead(prog) == pytest.approx(
+        protected / useful)
+
+
+def test_phase_split_single_and_train(region):
+    assert roofline.phase_split(region) == [("step", 1.0)]
+    from coast_tpu.train.mlp import make_train_region
+    train = make_train_region("sgd")
+    phases = roofline.phase_split(train)
+    assert [name for name, _w in phases] == ["fwd", "bwd", "commit"]
+    assert sum(w for _n, w in phases) == pytest.approx(1.0)
+
+
+def test_resolve_peak_priority(monkeypatch):
+    peak, source = roofline.resolve_peak(backend="tpu")
+    assert peak == pytest.approx(197_000.0 * 1e9) and source == "v5e-bf16"
+    peak, source = roofline.resolve_peak(backend="cpu")
+    assert peak is None
+    monkeypatch.setenv("COAST_PEAK_GFLOPS", "100")
+    peak, source = roofline.resolve_peak(backend="cpu")
+    assert peak == pytest.approx(1e11)
+    assert source == "env:COAST_PEAK_GFLOPS"
+    peak, source = roofline.resolve_peak(peak_gflops=5.0)
+    assert peak == pytest.approx(5e9) and source == "explicit"
+
+
+# -- attribution identity -----------------------------------------------------
+
+def test_profile_attribution_sums_to_wall(profiled_result):
+    prof = profiled_result.profile
+    assert prof is not None
+    total = (prof["device_busy_s"] + prof["host_gap_s"]
+             + prof["host_other_s"])
+    assert total == pytest.approx(prof["wall_s"], abs=2e-3)
+    assert prof["dispatches"] == 5                   # 240 rows / 48
+    assert prof["rows"] == 240
+    hist = prof["device_seconds_histogram"]
+    assert hist["count"] == 5
+    assert hist["counts"][-1] <= hist["count"]
+    # Cumulative le-buckets are monotone.
+    assert all(a <= b for a, b in zip(hist["counts"],
+                                      hist["counts"][1:]))
+    assert 0.0 <= prof["dispatch_gap_fraction"] <= 1.0
+    phases = prof["per_phase_device_s"]
+    assert set(phases) == {"step"}
+    assert phases["step"] == pytest.approx(prof["device_busy_s"],
+                                           abs=1e-6)
+
+
+def test_profile_summary_blocks(profiled_result):
+    summ = profiled_result.summary()
+    assert "profile" in summ and "mfu" in summ
+    assert "mfu" not in summ["profile"]              # split out
+    mfu = summ["mfu"]
+    assert mfu["flops_overhead"] > 1.0
+    assert mfu["achieved_ops_per_s"] > 0
+    # CPU backend: no table peak, MFU null but recorded as such.
+    assert mfu["achieved_mfu"] is None
+    assert mfu["runs"] == 240
+
+
+def test_profile_mfu_with_pinned_peak(prog):
+    profiler = CampaignProfiler(prog, peak_gflops=1.0)  # 1 GFLOP/s
+    runner = CampaignRunner(prog, strategy_name="TMR", profile=profiler)
+    res = runner.run(96, seed=3, batch_size=48)
+    mfu = res.profile["mfu"]
+    assert mfu["peak_gflops"] == 1.0
+    assert mfu["achieved_mfu"] is not None and mfu["achieved_mfu"] > 0
+    assert 0.0 < mfu["roofline_mfu"] <= 1.0
+    assert 0.0 <= mfu["voter_bytes_share"] < 1.0
+    assert mfu["peak_source"] == "explicit"
+
+
+def test_outputs_identical_with_profiler(region, profiled_result):
+    plain = CampaignRunner(TMR(region), strategy_name="TMR")
+    a = plain.run(240, seed=17, batch_size=48)
+    assert a.counts == profiled_result.counts
+    assert np.array_equal(a.codes, profiled_result.codes)
+    assert np.array_equal(a.steps, profiled_result.steps)
+    assert a.profile is None and "profile" not in a.summary()
+
+
+def test_sparse_profile_counts_identical(region, profiled_result):
+    sparse = CampaignRunner(TMR(region), strategy_name="TMR",
+                            collect="sparse", profile=True)
+    b = sparse.run(240, seed=17, batch_size=48)
+    assert b.counts == profiled_result.counts
+    prof = b.profile
+    total = (prof["device_busy_s"] + prof["host_gap_s"]
+             + prof["host_other_s"])
+    assert total == pytest.approx(prof["wall_s"], abs=2e-3)
+
+
+def test_disabled_profiler_overhead_bound(region):
+    """The PR 1 obs bound extended to the profiler hooks: the disabled
+    path (profile=False, the default) is a handful of `is not None`
+    tests per batch -- their cost x a production campaign's batch count
+    must stay far under 2% of even a small campaign's wall clock."""
+    import time
+    r_off = CampaignRunner(TMR(region), strategy_name="TMR",
+                           profile=False)
+    r_off.run(64, seed=1, batch_size=64)
+    secs_off = min(r_off.run(600, seed=5, batch_size=100).seconds
+                   for _ in range(3))
+    # Direct micro-bound on the per-batch disabled-path work.
+    prof = None
+    reps = 20000
+    t0 = time.perf_counter()
+    acc = 0
+    for _ in range(reps):
+        if prof is not None:
+            acc += 1
+        if prof is not None:
+            acc += 1
+        if prof is not None:
+            acc += 1
+    per_batch = (time.perf_counter() - t0) / reps
+    batches_per_campaign = 1_000_000 // 65536 + 1
+    assert per_batch * batches_per_campaign < 0.02 * max(secs_off, 0.05)
+
+
+# -- metrics: the histogram exporter type ------------------------------------
+
+def test_histogram_observe_and_snapshot():
+    h = Histogram(bounds=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["counts"] == [1, 2, 3]               # cumulative
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(55.55)
+
+
+def test_metrics_histogram_prometheus_exposition():
+    hub = CampaignMetrics()
+    hub.campaign_started("mm", "TMR", 100, 100)
+    hub.record_batch(50, 50, {"success": 50}, {}, {},
+                     profile={"device_s": 0.02, "gap_s": 0.001})
+    hub.record_batch(100, 50, {"success": 100}, {}, {},
+                     profile={"device_s": 0.04, "gap_s": 0.0})
+    text = hub.prometheus()
+    assert ("# TYPE coast_campaign_dispatch_device_seconds histogram"
+            in text)
+    assert 'le="+Inf"} 2' in text
+    assert "coast_campaign_dispatch_device_seconds_count" in text
+    assert "coast_campaign_device_busy_seconds_total" in text
+    snap = hub.snapshot()
+    assert snap["profile"]["device_busy_s"] == pytest.approx(0.06)
+    assert snap["profile"]["dispatches"] == 2
+    assert snap["profile"]["histograms"][
+        "dispatch_device_seconds"]["count"] == 2
+
+
+def test_profiled_campaign_feeds_hub(prog):
+    hub = CampaignMetrics()
+    runner = CampaignRunner(prog, strategy_name="TMR", profile=True,
+                            metrics=hub)
+    runner.run(96, seed=3, batch_size=48)
+    snap = hub.snapshot()
+    assert snap["profile"]["dispatches"] == 2
+    assert snap["profile"]["device_busy_s"] > 0
+
+
+# -- live transfer rates (the PR 12 block, now visible mid-campaign) ---------
+
+class _FakeHub:
+    def __init__(self):
+        self.transfer = {"up": 0, "down": 0}
+        self.profile = {}
+        self.stages = {}
+        self.resilience = {}
+        self.memory_watermark = None
+
+
+def test_heartbeat_transfer_rates():
+    from coast_tpu.obs.heartbeat import Heartbeat
+    hub = _FakeHub()
+    lines = []
+    now = {"t": 0.0}
+    hb = Heartbeat(1000, interval_s=0.0, emit=lines.append,
+                   metrics=hub, clock=lambda: now["t"])
+    now["t"] = 1.0
+    hub.transfer = {"up": 2_000_000, "down": 500_000}
+    line = hb.update(100)
+    assert "up=2.0 MB/s" in line and "down=500.0 kB/s" in line
+    now["t"] = 3.0
+    hub.transfer = {"up": 2_000_000, "down": 2_500_000}
+    line = hb.update(200)
+    assert "up=0 B/s" in line and "down=1.0 MB/s" in line
+
+
+def test_console_transfer_and_busy_line():
+    from coast_tpu.obs.console import Console
+    hub = _FakeHub()
+    hub.transfer = {"up": 1_000_000, "down": 0}
+    hub.profile = {"device_busy_s": 0.75, "host_gap_s": 0.1}
+    panels = []
+    now = {"t": 0.0}
+    con = Console(100, interval_s=0.0, emit=panels.append,
+                  metrics=hub, clock=lambda: now["t"])
+    now["t"] = 1.0
+    panel = con.update(50, {"success": 50})
+    assert "link up 1.0 MB/s" in panel
+    # Same definition as device_busy_fraction everywhere else:
+    # busy / elapsed, not busy / (busy + gap).
+    assert "device busy 75%" in panel
+
+
+def test_merged_chunk_campaign_keeps_profile(region):
+    """run_until_errors / replay_chunks (merged multi-chunk campaigns)
+    must not silently drop the attribution --profile promised: the
+    merged profile sums the chunks' buckets and re-derives the mfu
+    block from the summed runs/device seconds."""
+    runner = CampaignRunner(TMR(region), strategy_name="TMR",
+                            profile=True)
+    res = runner.run_until_errors(1, seed=3, batch_size=64, max_n=128)
+    prof = res.profile
+    assert prof is not None and prof["rows"] == res.n
+    total = (prof["device_busy_s"] + prof["host_gap_s"]
+             + prof["host_other_s"])
+    assert total == pytest.approx(prof["wall_s"], abs=5e-3)
+    assert prof["device_seconds_histogram"]["count"] \
+        == prof["dispatches"]
+    assert prof["mfu"]["runs"] == res.n
+    assert "profile" in res.summary() and "mfu" in res.summary()
+
+
+# -- trace export: the device track ------------------------------------------
+
+def test_trace_export_device_track():
+    tel = obs.Telemetry(enabled=True)
+    with tel.span("dispatch"):
+        pass
+    tel.span_at("device:step", tel.origin, tel.origin + 0.5,
+                device=True, lo=0)
+    events = obs.to_trace_events(tel)
+    host = [e for e in events if e.get("cat") == "stage"]
+    device = [e for e in events if e.get("cat") == "device"]
+    assert host and device
+    assert host[0]["tid"] != device[0]["tid"]
+    names = [e["args"]["name"] for e in events if e["ph"] == "M"]
+    assert "host" in names and "device" in names
+
+
+def test_profiled_trace_has_device_spans(profiled_runner,
+                                         profiled_result):
+    events = obs.to_trace_events(profiled_runner.telemetry)
+    device = [e for e in events if e.get("cat") == "device"]
+    assert device, "profiled campaign exported no device spans"
+    assert all(e["name"] == "device:step" for e in device)
+
+
+# -- trace federation ---------------------------------------------------------
+
+def _journal_with_spans(path, batches):
+    """A minimal run-mode journal whose batch records carry the given
+    span triples; ``batches`` is [(lo, [(name, unix_t, dur), ...])]."""
+    from coast_tpu.inject.journal import CampaignJournal
+    j = CampaignJournal.open(path, {"mode": "run", "benchmark": "mm",
+                                    "strategy": "TMR", "n": 96,
+                                    "seed": 0})
+    try:
+        for lo, spans in batches:
+            out = {k: np.zeros(48, np.int32)
+                   for k in ("code", "errors", "corrected", "steps")}
+            j.append_batch(lo, out, {"success": lo + 48}, {},
+                           spans=spans)
+    finally:
+        j.close()
+    return path
+
+
+def test_item_timeline_clock_skew_reanchored(tmp_path):
+    """A resumed worker whose clock is BEHIND writes spans that precede
+    the previous segment's end; the journal record order is ground
+    truth, so the skewed segment is shifted forward to abut it."""
+    from coast_tpu.obs.federate import item_timeline
+    path = str(tmp_path / "skew.journal")
+    _journal_with_spans(path, [
+        (0, [["dispatch", 1000.0, 0.2], ["collect", 1000.2, 0.3]]),
+        # Written by a worker 400s behind: starts "before" batch 0.
+        (48, [["dispatch", 600.0, 0.2], ["collect", 600.2, 0.3]]),
+    ])
+    spans, max_offset = item_timeline(path)
+    assert len(spans) == 4
+    assert max_offset == pytest.approx(1000.5 - 600.0)
+    ends = {}
+    for name, t, dur, lo in spans:
+        ends.setdefault(lo, 0.0)
+        ends[lo] = max(ends[lo], t + dur)
+    starts = {lo: min(t for _n, t, _d, l in spans if l == lo)
+              for lo in (0, 48)}
+    assert starts[48] >= ends[0] - 1e-6             # monotone again
+    # Forward skew (a real wait) is preserved, not compressed.
+    path2 = str(tmp_path / "gap.journal")
+    _journal_with_spans(path2, [
+        (0, [["dispatch", 1000.0, 0.2]]),
+        (48, [["dispatch", 2000.0, 0.2]]),
+    ])
+    spans2, off2 = item_timeline(path2)
+    assert off2 == 0.0
+    assert spans2[1][1] == pytest.approx(2000.0)
+
+
+def test_federated_trace_sigkill_resume_exactly_once(region, tmp_path):
+    """A SIGKILL'd+resumed campaign's merged trace covers every batch
+    exactly once: resume replays the journal prefix without
+    re-appending, and federation builds from the journal."""
+    from coast_tpu.fleet.queue import CampaignQueue, item_spec
+    from coast_tpu.obs.federate import merge_traces
+
+    class _Kill(Exception):
+        pass
+
+    q = CampaignQueue(str(tmp_path / "queue"))
+    item_id = q.enqueue(item_spec("matrixMultiply", 240, seed=17,
+                                  batch_size=48))
+    assert q.claim("w0", lease_s=120.0).id == item_id
+    runner = CampaignRunner(TMR(region), strategy_name="TMR",
+                            telemetry=obs.Telemetry(enabled=True))
+    jpath = q.journal_path(item_id)
+    beats = {"n": 0}
+
+    def killer(done, counts):
+        beats["n"] += 1
+        if beats["n"] == 2:
+            raise _Kill()
+
+    with pytest.raises(_Kill):
+        runner.run(240, seed=17, batch_size=48, journal=jpath,
+                   progress=killer)
+    # The replacement worker resumes the same journal bit-for-bit.
+    res = runner.run(240, seed=17, batch_size=48, journal=jpath)
+    assert res.n == 240
+    q.complete(item_id, "w1", {"benchmark": res.benchmark,
+                               "strategy": res.strategy,
+                               "counts": dict(res.counts),
+                               "worker": "w1"})
+    doc = merge_traces(q)
+    los = sorted(e["args"]["lo"] for e in doc["traceEvents"]
+                 if e.get("cat") == "journal"
+                 and e["name"] == "dispatch")
+    assert los == [0, 48, 96, 144, 192]             # each batch ONCE
+    lease = [e for e in doc["traceEvents"] if e.get("cat") == "lease"]
+    assert lease and lease[0]["args"]["worker"] == "w1"
+    marks = {e["name"].split(" ", 1)[0]
+             for e in doc["traceEvents"] if e.get("cat") == "queue"}
+    assert {"enqueue", "claim", "complete"} <= marks
+    assert doc["otherData"]["items"] == 1
+
+
+def test_merge_traces_multiple_items_separate_pids(tmp_path):
+    from coast_tpu.fleet.queue import CampaignQueue, item_spec
+    from coast_tpu.obs.federate import merge_traces
+    q = CampaignQueue(str(tmp_path / "queue"))
+    for seed in (1, 2):
+        item_id = q.enqueue(item_spec("matrixMultiply", 48, seed=seed,
+                                      batch_size=48))
+        q.claim("w0", lease_s=60.0)
+        _journal_with_spans(q.journal_path(item_id),
+                            [(0, [["dispatch", 100.0 + seed, 0.1]])])
+        q.complete(item_id, "w0", {"benchmark": "matrixMultiply",
+                                   "strategy": "TMR", "counts": {},
+                                   "worker": "w0"})
+    doc = merge_traces(q)
+    pids = {e["pid"] for e in doc["traceEvents"]
+            if e.get("cat") == "journal"}
+    assert len(pids) == 2
+    assert doc["otherData"]["items"] == 2
+
+
+# -- CLI + CI plumbing --------------------------------------------------------
+
+def test_profile_cli_artifact(tmp_path):
+    from coast_tpu.obs.profile_cli import main as profile_main
+    out = str(tmp_path / "profile.json")
+    rc = profile_main(["--target", "matrixMultiply|-TMR", "-t", "96",
+                       "--batch-size", "48", "--out", out,
+                       "--peak-gflops", "197000"])
+    assert rc == 0
+    with open(out) as fh:
+        doc = json.load(fh)
+    blk = doc["targets"]["matrixMultiply|-TMR"]
+    prof = blk["profile"]
+    total = (prof["device_busy_s"] + prof["host_gap_s"]
+             + prof["host_other_s"])
+    assert total == pytest.approx(prof["wall_s"], abs=2e-3)
+    assert blk["mfu"]["achieved_mfu"] is not None
+    assert blk["mfu"]["peak_gflops"] == 197000.0
+
+
+def test_ci_stage_seconds_extraction():
+    from coast_tpu.ci.engine import _stage_seconds
+    result = {"summary": {"stages": {"dispatch": 1.5, "collect": 0.25,
+                                     "overlap": 0.9}}}
+    got = _stage_seconds(result)
+    assert got == {"collect": 0.25, "dispatch": 1.5}
+    assert _stage_seconds({}) == {}
