@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke profile ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke slo_smoke profile ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -137,6 +137,9 @@ propagation_smoke:
 # federated fleet trace end-to-end.
 profile_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.profile_smoke
+
+slo_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.slo_smoke
 
 # The campaign attribution report itself: refresh the recorded
 # artifacts/profile_mm.json baseline (on CPU, MFU pinned against the

@@ -112,9 +112,22 @@ BATCHES = (1024, 2048, 4096, 8192, 16384, 32768, 65536, 131072)
 def _emit(rec):
     sys.stdout.write(json.dumps(rec) + "\n")
     sys.stdout.flush()
+    try:
+        from coast_tpu.obs import flightrec
+        flightrec.record("spawn_stage", stage=rec.get("stage"),
+                         kind=rec.get("kind"))
+    except Exception:  # noqa: BLE001 - progress lines must never die
+        pass
 
 
 def worker(backend: str) -> None:
+    # Blackbox first, backend second: the stage the recorder most needs
+    # to witness is the init wedge, which happens inside the very next
+    # import.  The parent points COAST_FLIGHTREC_DIR at its harvest
+    # directory and SIGUSR1s us for the bundle before it kills us.
+    from coast_tpu.obs import flightrec
+    rec = flightrec.install(source=f"bench-worker:{backend}")
+    rec.install_signal_handler()
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -364,9 +377,48 @@ def _tail_cap(text: str, limit: int) -> str:
     return text if len(text) <= limit else "..." + text[-limit:]
 
 
+def _harvest_blackbox(proc, dump_dir: str, after: float,
+                      wait_s: float = 8.0):
+    """SIGUSR1 the wedged child ("give me your blackbox before I kill
+    you") and poll for the forensic bundle it dumps; returns the bundle
+    path or None.  Best-effort by design: a child wedged inside a C call
+    (backend init holding the device claim) cannot run the Python signal
+    handler, and that absence is itself recorded in the round artifact."""
+    from coast_tpu.obs import flightrec
+    try:
+        proc.send_signal(signal.SIGUSR1)
+    except OSError:
+        return None
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        path = flightrec.newest_bundle(dump_dir)
+        if path is not None:
+            try:
+                if os.path.getmtime(path) >= after:
+                    return path
+            except OSError:
+                pass
+        if proc.poll() is not None:
+            break                    # child died; one last scan below
+        time.sleep(0.2)
+    path = flightrec.newest_bundle(dump_dir)
+    try:
+        if path is not None and os.path.getmtime(path) >= after:
+            return path
+    except OSError:
+        pass
+    return None
+
+
 def _attempt(backend: str, timeout_s: int):
-    """Run one worker; returns (records, error_note)."""
+    """Run one worker; returns (records, error_note, forensics_path)."""
     env = dict(os.environ)
+    # The child's blackbox bundles land where the round artifact can
+    # reference them (operator override via COAST_FLIGHTREC_DIR wins).
+    dump_dir = env.setdefault("COAST_FLIGHTREC_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts", "flightrec"))
+    attempt_t0 = time.time()
     import tempfile
     # Worker stderr goes to a temp file, not a pipe: JAX/XLA on the TPU
     # path can emit more log output than a pipe buffer holds, and an
@@ -378,7 +430,7 @@ def _attempt(backend: str, timeout_s: int):
         cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
     _note(f"[{backend}] stage spawn: worker pid {proc.pid} "
           f"(budget {timeout_s}s)")
-    records, error = [], None
+    records, error, forensics = [], None, None
     deadline = time.monotonic() + timeout_s
     import selectors
     sel = selectors.DefaultSelector()
@@ -391,6 +443,7 @@ def _attempt(backend: str, timeout_s: int):
             if remaining <= 0:
                 error = (f"worker wedged in stage '{stage}' "
                          f"(no progress for {timeout_s}s budget)")
+                forensics = _harvest_blackbox(proc, dump_dir, attempt_t0)
                 proc.kill()
                 break
             if not sel.select(timeout=min(remaining, 5.0)):
@@ -434,7 +487,7 @@ def _attempt(backend: str, timeout_s: int):
                  else f"worker exited rc={proc.returncode}")
     if error and stderr_tail.strip():
         error += " | stderr: " + _tail_line(stderr_tail)
-    return records, error
+    return records, error, forensics
 
 
 def _summarize(records):
@@ -477,12 +530,19 @@ def main() -> int:
              ("cpu", RETRY_TIMEOUT)])
     summary, used = {}, None
     spawn_wedge = None
+    wedge_forensics = None
     for backend, budget in plan:
         claim_tries = 0
         claim_t0 = time.monotonic()
         while True:
             t0 = time.time()
-            records, error = _attempt(backend, budget)
+            records, error, forensics = _attempt(backend, budget)
+            if forensics:
+                # Keep the NEWEST wedge bundle: repeated claim-retries
+                # each harvest one, and the last is the give-up evidence.
+                wedge_forensics = forensics
+                _note(f"[{backend}] harvested worker blackbox: "
+                      f"{forensics}")
             if error:
                 errors.append(
                     f"[{backend} attempt, {time.time()-t0:.0f}s] {error}")
@@ -522,6 +582,7 @@ def main() -> int:
         _note(f"spawn-wedge cleared: a later attempt measured on "
               f"{summary.get('backend')}")
         spawn_wedge = None
+        wedge_forensics = None
 
     artifacts_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "artifacts")
@@ -546,7 +607,11 @@ def main() -> int:
             # a summary, never a log dump.
             full["error"] = _tail_cap("; ".join(errors), 900)
         if spawn_wedge:
-            full["spawn_wedge"] = spawn_wedge
+            # The give-up diagnosis plus the wedged child's blackbox
+            # bundle (obs/flightrec.py): forensics is None when the
+            # child could not answer SIGUSR1 (wedged in a C call).
+            full["spawn_wedge"] = {"note": spawn_wedge,
+                                   "forensics": wedge_forensics}
         # One predicate for "this ran on the host": the worker-REPORTED
         # backend, not the attempt label -- a "default" attempt on a
         # TPU-less box silently resolves to CPU and must carry the same
@@ -614,7 +679,8 @@ def main() -> int:
         if "note" in full:
             line["note"] = full["note"]
         if spawn_wedge:
-            line["spawn_wedge"] = spawn_wedge
+            line["spawn_wedge"] = {"note": spawn_wedge,
+                                   "forensics": wedge_forensics}
         if errors:
             line["error"] = _tail_cap("; ".join(errors), 300)
         line["artifact"] = "artifacts/bench_full.json"
@@ -629,7 +695,8 @@ def main() -> int:
                            or "no measurement produced"),
                  "partial": summary or None})
     if spawn_wedge:
-        line["spawn_wedge"] = spawn_wedge
+        line["spawn_wedge"] = {"note": spawn_wedge,
+                               "forensics": wedge_forensics}
     print(json.dumps(line))
     for e in errors:
         print(f"# {e}", file=sys.stderr)

@@ -5,6 +5,7 @@ repo's own Makefile) do not need to know the module layout:
 
     python -m coast_tpu ci ...        # protection-regression CI
     python -m coast_tpu profile ...   # campaign attribution report
+    python -m coast_tpu slo ...       # reliability SLO check/report
     python -m coast_tpu fleet ...     # campaign fleet (alias)
     python -m coast_tpu analysis ...  # log analysis (alias)
     python -m coast_tpu opt ...       # protect + run one program (alias)
@@ -32,6 +33,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if verb == "profile":
         from coast_tpu.obs.profile_cli import main as profile_main
         return profile_main(rest)
+    if verb == "slo":
+        from coast_tpu.obs.slo_cli import main as slo_main
+        return slo_main(rest)
     if verb == "fleet":
         from coast_tpu.fleet.supervisor import main as fleet_main
         return fleet_main(rest)
@@ -42,7 +46,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from coast_tpu.opt import main as opt_main
         return opt_main(rest)
     print(f"Error, unknown verb {verb!r}; want one of: ci, profile, "
-          "fleet, analysis, opt (see python -m coast_tpu --help)",
+          "slo, fleet, analysis, opt (see python -m coast_tpu --help)",
           file=sys.stderr)
     return 2
 

@@ -191,6 +191,12 @@ class Summary:
     # fractions do not aggregate across campaigns).
     profile: Optional[Dict[str, object]] = None
     mfu: Optional[Dict[str, object]] = None
+    # Reliability-SLO verdicts (obs/slo.summary_block) from the log
+    # summary: per-objective attainment, budget remaining, burn rate,
+    # worst verdict.  None for campaigns run without an SLO set and for
+    # directory aggregates mixing several logs (a budget verdict
+    # describes one campaign's evidence, like the Wilson intervals).
+    slo: Optional[Dict[str, object]] = None
 
     @property
     def due(self) -> int:
@@ -360,6 +366,26 @@ class Summary:
                     f" +-{100.0 * ci.get('half_width', 0.0):6.3f}%"
                     f"  [{100.0 * ci.get('lo', 0.0):.3f}%,"
                     f" {100.0 * ci.get('hi', 0.0):.3f}%]{mark}")
+        if self.slo:
+            slo = self.slo
+            lines.append("  --- slo ---")
+            lines.append(f"  verdict {str(slo.get('verdict', '?')):<6}"
+                         f" (spec {slo.get('spec')})")
+            for oname, row in (slo.get("objectives") or {}).items():
+                attained = row.get("attained")
+                att = ("yes" if attained is True
+                       else "NO" if attained is False else "n/a")
+                budget = row.get("budget_remaining_frac")
+                burn = row.get("burn_rate")
+                lines.append(
+                    f"  {oname:<18} {row.get('op', '')}"
+                    f"{row.get('target')}"
+                    f"  observed {row.get('observed')}"
+                    f"  attained {att}"
+                    + (f"  budget {100.0 * budget:6.1f}%"
+                       if budget is not None else "")
+                    + (f"  burn {burn:.2f}x" if burn is not None else "")
+                    + f"  [{row.get('verdict')}]")
         return "\n".join(lines)
 
 
@@ -454,6 +480,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     convergences: List[Dict[str, object]] = []
     profiles: List[Dict[str, object]] = []
     mfus: List[Dict[str, object]] = []
+    slos: List[Dict[str, object]] = []
     for doc in docs:
         head = doc.get("summary") or {}
         if head.get("collect") == "sparse":
@@ -552,6 +579,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             profiles.append(summary["profile"])
         if summary.get("mfu"):
             mfus.append(summary["mfu"])
+        if summary.get("slo"):
+            slos.append(summary["slo"])
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     # The fault-model axis: absent key == the single-bit legacy model.
@@ -584,7 +613,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                    convergence=(convergences[0]
                                 if len(convergences) == 1 else None),
                    profile=(profiles[0] if len(profiles) == 1 else None),
-                   mfu=(mfus[0] if len(mfus) == 1 else None))
+                   mfu=(mfus[0] if len(mfus) == 1 else None),
+                   slo=(slos[0] if len(slos) == 1 else None))
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -628,7 +658,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             transfer=head["summary"].get("transfer_bytes") or None,
             convergence=head["summary"].get("convergence") or None,
             profile=head["summary"].get("profile") or None,
-            mfu=head["summary"].get("mfu") or None)
+            mfu=head["summary"].get("mfu") or None,
+            slo=head["summary"].get("slo") or None)
     except OSError:
         return None
 

@@ -199,6 +199,8 @@ class CompileCache:
         self.counters[event] += 1
         self.last_event = event
         obs.count(f"compile_cache_{event}", key=key)
+        from coast_tpu.obs import flightrec
+        flightrec.record("compile_cache", outcome=event, key=key)
         return runner, strategy, key, event
 
     def mark_compiled(self, key: str, spec: Dict[str, object]) -> None:

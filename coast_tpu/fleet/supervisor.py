@@ -234,6 +234,11 @@ def parse_command_line(argv: Optional[List[str]] = None):
                    "(clock-skew corrected, SIGKILL'd+resumed workers' "
                    "batches exactly once) plus the queue's "
                    "claim/lease/complete events (obs/federate.py)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="evaluate this reliability SLO spec live against "
+                   "the fleet aggregate (obs/slo.py grammar); verdicts "
+                   "ride /status, /metrics and the spawned workers' "
+                   "own status docs")
 
     p = sub.add_parser("worker", help="run ONE worker process (what "
                        "`run` spawns)")
@@ -247,6 +252,11 @@ def parse_command_line(argv: Optional[List[str]] = None):
                    help="serve this worker's own live campaign metrics "
                    "(port conflicts fall back to an ephemeral port, so "
                    "per-worker servers coexist on one host)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="evaluate this reliability SLO spec live against "
+                   "the worker's campaign metrics (obs/slo.py grammar, "
+                   "e.g. 'sdc_rate<=0.002;min=4096'); the verdict rides "
+                   "the worker status doc and /metrics")
 
     p = sub.add_parser("status", help="print the fleet status document")
     _add_queue(p)
@@ -325,6 +335,8 @@ def _spawn_worker(args, wid: str) -> subprocess.Popen:
            "--lease", str(args.lease)]
     if args.mesh:
         cmd += ["--mesh", str(args.mesh)]
+    if getattr(args, "slo", None):
+        cmd += ["--slo", args.slo]
     # The package may be run from a source checkout rather than an
     # installed dist: make sure the child resolves the same coast_tpu
     # this supervisor is running.
@@ -343,7 +355,12 @@ def cmd_run(args) -> int:
         print("Error, the queue has no live work; enqueue items first",
               file=sys.stderr)
         return 1
-    telemetry = FleetTelemetry(q, stale_s=max(10.0, 2.0 * args.lease))
+    try:
+        telemetry = FleetTelemetry(q, stale_s=max(10.0, 2.0 * args.lease),
+                                   slo=args.slo)
+    except Exception as e:              # noqa: BLE001 - bad --slo spec
+        print(f"Error, bad --slo spec: {e}", file=sys.stderr)
+        return 2
     server = None
     if args.metrics_port is not None:
         from coast_tpu.obs.serve import MetricsServer
@@ -441,8 +458,21 @@ def cmd_run(args) -> int:
 
 def cmd_worker(args) -> int:
     from coast_tpu.fleet.worker import Worker
+    from coast_tpu.obs import flightrec
     from coast_tpu.obs.metrics import CampaignMetrics
-    metrics = CampaignMetrics()
+    from coast_tpu.obs.slo import SLOError
+    # Process-lifetime blackbox: lease/journal/dispatch events land in
+    # one ring, bundles land under the queue root (the supervisor's and
+    # the tests' harvest surface), SIGUSR1 dumps on demand.
+    rec = flightrec.install(dump_dir=os.environ.get(
+        "COAST_FLIGHTREC_DIR") or os.path.join(args.queue, "flightrec"),
+        source=f"fleet-worker:{args.worker_id}")
+    rec.install_signal_handler()
+    try:
+        metrics = CampaignMetrics(slo=args.slo)
+    except SLOError as e:
+        print(f"Error, bad --slo spec: {e}", file=sys.stderr)
+        return 2
     server = None
     if args.metrics_port is not None:
         from coast_tpu.obs.serve import MetricsServer

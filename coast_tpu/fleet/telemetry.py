@@ -42,11 +42,15 @@ class FleetTelemetry:
     """Read-side aggregate over one queue's workers + done records."""
 
     def __init__(self, queue: "CampaignQueue | str",
-                 stale_s: float = 30.0, z: float = 1.96):
+                 stale_s: float = 30.0, z: float = 1.96, slo=None):
         self.q = (queue if isinstance(queue, CampaignQueue)
                   else CampaignQueue(queue))
         self.stale_s = float(stale_s)
         self.z = float(z)
+        if isinstance(slo, str):
+            from coast_tpu.obs.slo import SLOSet
+            slo = SLOSet.parse(slo)
+        self.slo_set = slo
         self._done_cache: Dict[str, Tuple[int, Dict[str, object]]] = {}
 
     # -- readers -------------------------------------------------------------
@@ -151,10 +155,26 @@ class FleetTelemetry:
             "inj_per_sec": inj_per_sec,
         }
 
+    def _slo_report(self, agg: Dict[str, object]):
+        """Evaluate the configured SLO set against the fleet aggregate:
+        the union of done-record counts and live campaigns is exactly
+        the evidence shape obs/slo.py wants (fleet has no histograms or
+        recent-rate ring, so latency objectives stay unevaluated)."""
+        if self.slo_set is None:
+            return None
+        from coast_tpu.obs.slo import evaluate
+        rate = agg["inj_per_sec"] or None
+        if rate is None and agg["seconds"] > 0:
+            rate = agg["injections_done"] / agg["seconds"]
+        return evaluate(self.slo_set, {
+            "counts": {k: int(v) for k, v in agg["counts"].items()},
+            "inj_per_sec": rate,
+        })
+
     # -- hub interface (MetricsServer duck-typing) ---------------------------
     def snapshot(self) -> Dict[str, object]:
         agg = self._aggregate()
-        return {
+        doc = {
             "format": "coast-fleet-status", "version": 1,
             "queue": agg["queue"],
             "workers": agg["workers"],
@@ -168,6 +188,11 @@ class FleetTelemetry:
             "cache": agg["cache"],
             "updated_unix_s": round(agg["now"], 6),
         }
+        report = self._slo_report(agg)
+        if report is not None:
+            from coast_tpu.obs.slo import summary_block
+            doc["slo"] = summary_block(report)
+        return doc
 
     def prometheus(self) -> str:
         """Prometheus 0.0.4 text of the fleet aggregate -- the names
@@ -224,4 +249,29 @@ class FleetTelemetry:
                [(f'kind="{_esc(k)}"', float(v))
                 for k, v in sorted(agg["cache"].items())]
                or [('kind="miss"', 0.0)])
+        report = self._slo_report(agg)
+        if report is not None:
+            rows = report.get("objectives") or []
+            metric("coast_fleet_slo_burn_rate", "gauge",
+                   "Fleet error-budget burn rate per SLO objective "
+                   "(1.0 = consuming budget exactly at the allowed "
+                   "pace).",
+                   [(f'objective="{_esc(r["objective"])}"',
+                     float(r["burn"]["long"]))
+                    for r in rows
+                    if (r.get("burn") or {}).get("long") is not None])
+            metric("coast_fleet_slo_budget_remaining_frac", "gauge",
+                   "Unconsumed fleet error-budget fraction per SLO "
+                   "objective (negative = overspent).",
+                   [(f'objective="{_esc(r["objective"])}"',
+                     float(r["budget"]["remaining_frac"]))
+                    for r in rows
+                    if (r.get("budget") or {}).get("remaining_frac")
+                    is not None])
+            metric("coast_fleet_slo_verdict", "gauge",
+                   "Fleet per-objective verdict (0=ok, 1=warn, 2=page).",
+                   [(f'objective="{_esc(r["objective"])}"',
+                     float(("ok", "warn",
+                            "page").index(r["verdict"])))
+                    for r in rows])
         return "\n".join(lines) + "\n"

@@ -43,6 +43,7 @@ import numpy as np
 from coast_tpu.fleet.compile_cache import CompileCache
 from coast_tpu.fleet.queue import CampaignQueue, LostLeaseError, QueueItem
 from coast_tpu.inject.journal import JournalError, JournalLockedError
+from coast_tpu.obs import flightrec
 from coast_tpu.obs.metrics import CampaignMetrics, atomic_write_json
 
 __all__ = ["Worker", "codes_sha256"]
@@ -86,7 +87,11 @@ class _LeaseKeeper:
         while not self._stop.wait(self.lease_s / 3.0):
             try:
                 self.q.renew(self.item_id, self.worker, self.lease_s)
+                flightrec.record("lease_renew", item=self.item_id,
+                                 phase="compile")
             except LostLeaseError as e:
+                flightrec.record("lease_lost", item=self.item_id,
+                                 phase="compile")
                 self.lost = e
                 return
 
@@ -171,6 +176,8 @@ class Worker:
         if it completed (False: failed terminally or yielded)."""
         spec = item.spec
         self._current_item = item.id
+        flightrec.record("lease_claim", item=item.id,
+                         attempts=int(item.attempts))
         keeper = _LeaseKeeper(self.q, item.id, self.worker_id,
                               self.lease_s)
         try:
@@ -191,6 +198,11 @@ class Worker:
             # Our claim moved while we compiled.  The compile itself is
             # not wasted (the cache keeps it), but the item belongs to
             # another worker now -- stop touching it.
+            flightrec.current().dump(
+                "lease_lost", extra={"item": item.id,
+                                     "worker": self.worker_id,
+                                     "phase": "compile",
+                                     "error": str(keeper.lost)})
             self.items_yielded += 1
             self._current_item = None
             self._write_status("idle")
@@ -210,6 +222,8 @@ class Worker:
             now = time.monotonic()
             if now - state["last_renew"] >= self.lease_s / 3.0:
                 self.q.renew(item.id, self.worker_id, self.lease_s)
+                flightrec.record("lease_renew", item=item.id,
+                                 phase="campaign", done=int(done))
                 state["last_renew"] = now
             self._write_status("running")
             if throttle > 0:
@@ -257,10 +271,18 @@ class Worker:
             self._write_status("idle")
             time.sleep(self.poll_s)
             return False
-        except LostLeaseError:
+        except LostLeaseError as e:
             # Our lease was reaped mid-campaign and someone else owns
             # the item now; the journal we already appended is theirs to
-            # resume.  Stop touching it.
+            # resume.  Stop touching it -- but leave the blackbox behind:
+            # a reaped lease on a worker that believed itself healthy is
+            # exactly the "who stalled, us or the supervisor?" dispute
+            # the forensic bundle adjudicates.
+            flightrec.record("lease_lost", item=item.id, phase="campaign")
+            flightrec.current().dump(
+                "lease_lost", extra={"item": item.id,
+                                     "worker": self.worker_id,
+                                     "error": str(e)})
             self.items_yielded += 1
             self._current_item = None
             self._write_status("idle")

@@ -580,12 +580,31 @@ def _seed_env(st, g_map, baked):
     return e
 
 
+def _force_ro_g_leaves(g_leaves, annotations):
+    """Pin prologue g<j> leaves to read-only unless the user says otherwise.
+
+    No transition ever writes a g leaf (they are prologue values, only read
+    past the loop boundary), but the epilogue/interlude transitions route
+    state through ``lax.cond``/``lax.switch``, whose outputs are fresh jaxpr
+    vars -- provenance identity detection (analyze_step) cannot see the
+    passthrough and would classify them as written registers.  A g leaf
+    misread as ``reg`` gets replicated per-lane and voted, so a single-lane
+    flip is silently outvoted and the leaf stops being injectable -- the
+    opposite of the unwritten-global rule (cloning.cpp:62-288) these leaves
+    exist to mirror.  Explicit user annotations still win."""
+    if not g_leaves:
+        return annotations
+    return {**{leaf: LeafSpec(kind=KIND_RO) for leaf in g_leaves},
+            **(annotations or {})}
+
+
 def _lift_fn_single(name, jaxpr, loop, epi_eqns, env, g_map, baked,
                     annotations, default_xmr, max_steps, step_cap, meta,
                     phase):
     in_vals = [_read(env, v) for v in loop.invars]
     base_leaves = phase.leaves_from_invals(in_vals)
     g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
+    annotations = _force_ro_g_leaves(g_leaves, annotations)
 
     def eval_epilogue(st):
         e = _seed_env(st, g_map, baked)
@@ -700,6 +719,7 @@ def _lift_fn_multi(name, jaxpr, loops, segments, env, g_map, baked,
                 m_producer[v] = p
 
     g_leaves = {leaf: jnp.asarray(env[v]) for v, leaf in g_map.items()}
+    annotations = _force_ro_g_leaves(g_leaves, annotations)
     in_vals0 = [_read(env, v) for v in loops[0].invars]
     # A heavy epilogue executes inside the FINAL transition step (the
     # last inter-phase), writing the flattened output image into an

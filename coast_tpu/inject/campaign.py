@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from coast_tpu import obs
+from coast_tpu.obs import flightrec
 from coast_tpu.inject import classify as cls
 from coast_tpu.inject import resilience as resilience_mod
 from coast_tpu.inject.journal import (CampaignJournal, JournalMismatchError,
@@ -112,6 +113,11 @@ class CampaignResult:
     # None for unprofiled campaigns (the default), so every existing
     # summary stays byte-identical.
     profile: Optional[Dict[str, object]] = None
+    # Reliability-SLO verdicts (obs/slo.summary_block) when the runner
+    # (or its metrics hub) carried an SLO set: per-objective attainment,
+    # error-budget remaining, burn rate, worst verdict.  None otherwise,
+    # so unconfigured summaries stay byte-identical.
+    slo: Optional[Dict[str, object]] = None
 
     @property
     def injections_per_sec(self) -> float:
@@ -199,6 +205,8 @@ class CampaignResult:
                 out["mfu"] = mfu
         if self.convergence is not None:
             out["convergence"] = dict(self.convergence)
+        if self.slo is not None:
+            out["slo"] = dict(self.slo)
         if self.chunks is not None:
             out["chunks"] = self.chunks
         if self.resilience:
@@ -360,7 +368,9 @@ class CampaignRunner:
                  metrics: "Optional[object]" = None,
                  collect: str = "dense",
                  sparse_capacity: "Optional[int]" = None,
-                 profile: "bool | object" = False):
+                 profile: "bool | object" = False,
+                 slo: "Optional[object]" = None,
+                 slo_baseline: "Optional[Dict[str, float]]" = None):
         """``unroll`` forwards to ``ProtectedProgram.run``: how many
         early-exit steps each loop iteration executes.  Classification is
         identical at any value (overshoot sub-steps are masked no-ops);
@@ -467,7 +477,17 @@ class CampaignRunner:
         roofline model into ``summary()["profile"]``/``["mfu"]``.
         Campaign OUTPUTS (codes/counts/logs/journals) are byte-identical
         with the profiler on or off -- it only observes timing; the
-        disabled default adds one attribute test per batch."""
+        disabled default adds one attribute test per batch.
+
+        ``slo`` attaches a reliability SLO set (:mod:`coast_tpu.obs
+        .slo`): a spec string (``"sdc_rate<=0.002;min=4096"``) or an
+        :class:`~coast_tpu.obs.slo.SLOSet`.  The runner's metrics hub
+        (created on demand when ``metrics`` is None) re-evaluates the
+        error budgets every collected batch, and every finished
+        campaign lands the verdicts in ``CampaignResult.slo`` /
+        ``summary()["slo"]``.  ``slo_baseline`` feeds the ``mwtf``
+        objective (``{"sdc_rate", "inj_per_sec"}`` from an unprotected
+        run's recorded evidence)."""
         if mesh is not None:
             raise TypeError(
                 "mesh= reached the base CampaignRunner constructor; pass "
@@ -481,6 +501,17 @@ class CampaignRunner:
                 propagation=preflight in (True, "full", "propagation"))
         self.prog = prog
         self.retry = retry
+        if slo is not None:
+            from coast_tpu.obs.metrics import CampaignMetrics
+            from coast_tpu.obs.slo import SLOSet
+            slo_set = SLOSet.parse(slo) if isinstance(slo, str) else slo
+            if metrics is None:
+                metrics = CampaignMetrics(slo=slo_set,
+                                          slo_baseline=slo_baseline)
+            elif getattr(metrics, "slo_set", None) is None:
+                metrics.slo_set = slo_set
+                metrics.slo_baseline = (dict(slo_baseline)
+                                        if slo_baseline else None)
         self.metrics = metrics
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
@@ -1079,6 +1110,7 @@ class CampaignRunner:
                     progress(done, counts_so_far)
             if done:
                 tel.instant("journal_resume", rows=done)
+                flightrec.record("journal_resume", rows=int(done))
             # An early_stop record is the campaign's terminal state: the
             # replayed prefix IS the whole campaign, so the dispatch
             # loop below must not extend it.  (The live tracker would
@@ -1269,6 +1301,8 @@ class CampaignRunner:
                 tel.count("pad_waste_rows", batch_size - n_part)
             flight = {"pending": None, "n": n_part, "fault": fault,
                       "lo": lo, "attempts": 1, "spans": spans_rec}
+            flightrec.record("dispatch", lo=int(lo), n=int(n_part),
+                             batch_size=int(batch_size))
             _td0 = time.perf_counter() if prof is not None else 0.0
             with tel.span("dispatch", n=n_part):
                 flight["pending"] = _redispatch(flight)
@@ -1283,6 +1317,9 @@ class CampaignRunner:
             resilience[key] += 1
             tel.count(f"resilience_{key}", lo=flight_lo,
                       error=type(exc).__name__)
+            flightrec.record("retry", lo=int(flight_lo),
+                             attempt=int(attempt), kind=kind,
+                             error=type(exc).__name__)
             if journal is not None:
                 journal.append({"kind": "retry", "lo": journal_base
                                 + flight_lo, "attempt": attempt,
@@ -1382,6 +1419,9 @@ class CampaignRunner:
                         raise sig.__cause__    # rounding floor reached
                     resilience["oom_degrade"] += 1
                     tel.count("resilience_oom_degrade", batch_size=new_bs)
+                    flightrec.record("oom_degrade",
+                                     batch_size=int(new_bs),
+                                     lo=int(done))
                     batch_size = new_bs
                     in_flight.clear()
                     next_lo = done
@@ -1399,7 +1439,14 @@ class CampaignRunner:
             # The campaign died (fatal dispatch error, retries
             # exhausted, the caller's progress hook aborting): the live
             # metrics surfaces must say so rather than show "running"
-            # forever.
+            # forever, and the blackbox dumps its forensic bundle while
+            # the failing state still exists.
+            flightrec.record("campaign_crash", lo=int(done),
+                             error=type(e).__name__)
+            flightrec.current().dump(
+                f"campaign_crash:{type(e).__name__}",
+                extra={"error": f"{type(e).__name__}: {e}",
+                       "done_rows": int(done)})
             if metrics is not None:
                 metrics.campaign_finished(
                     error=f"{type(e).__name__}: {e}")
@@ -1492,6 +1539,12 @@ class CampaignRunner:
             res.convergence = tracker.report(
                 stopped, planned_n=planned_effective,
                 done_n=sched.effective_n)
+        if metrics is not None and \
+                getattr(metrics, "slo_set", None) is not None:
+            report = metrics.slo_status()
+            if report is not None:
+                from coast_tpu.obs.slo import summary_block
+                res.slo = summary_block(report)
         if metrics is not None:
             metrics.campaign_finished(res.summary(),
                                       convergence=res.convergence)

@@ -77,6 +77,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from coast_tpu.inject.spec import header_fault_model
+from coast_tpu.obs import flightrec
 
 try:
     import fcntl
@@ -224,9 +225,12 @@ class CampaignJournal:
                     tfh.truncate(valid_bytes)
             j = cls(path, found_header, records, fsync=fsync)
             j._fh = fh
+            flightrec.record("journal_open", path=path, resumed=True,
+                             records=len(records))
             return j
         j = cls(path, header, fsync=fsync)
         j.append({"kind": "header", **header})
+        flightrec.record("journal_open", path=path, resumed=False)
         return j
 
     @staticmethod

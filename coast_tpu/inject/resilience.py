@@ -176,6 +176,13 @@ def watchdog_collect(fn, timeout: Optional[float]):
         # long campaign needs to see the moment it happens.
         from coast_tpu.obs import spans as _spans
         _spans.current().count("watchdog_fired", timeout_s=timeout)
+        # Forensics BEFORE abandoning the wedged thread: the bundle's
+        # all-thread stacks still contain the hung collect, which is
+        # exactly the evidence a one-line diagnosis never carried.
+        from coast_tpu.obs import flightrec as _flightrec
+        _flightrec.record("watchdog_fired", timeout_s=timeout)
+        _flightrec.current().dump("watchdog_wedge",
+                                  extra={"timeout_s": timeout})
         raise CampaignWedgedError(
             f"collect did not return within {timeout}s; batch presumed "
             "wedged (device_get hung) -- re-dispatching")

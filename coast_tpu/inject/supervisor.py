@@ -260,6 +260,14 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "dispatch-latency histograms to --metrics-port, "
                         "and put device spans on their own --trace-out "
                         "track.  Outputs are byte-identical either way")
+    parser.add_argument("--slo", type=str, default=None, metavar="SPEC",
+                        help="declarative reliability SLO set evaluated "
+                        "live over the campaign's own evidence, e.g. "
+                        "'sdc_rate<=0.002,availability>=0.99;min=4096' "
+                        "(docs/observability.md 'Reliability SLOs'): "
+                        "Wilson-backed attainment, error budgets, and "
+                        "burn verdicts ride /status, /metrics, the "
+                        "heartbeat/console line, and summary()['slo']")
     parser.add_argument("--max-retries", type=int, default=0,
                         help="retry transient XLA/device dispatch "
                         "failures up to N times per batch (exponential "
@@ -544,9 +552,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     # cross-chunk progress callback instead (same pattern as
     # scripts/campaign_1m.py).
     chunked = bool(args.errorCount or args.delta_from)
+    slo_set = None
+    if args.slo:
+        from coast_tpu.obs.slo import SLOError, SLOSet
+        try:
+            slo_set = SLOSet.parse(args.slo)
+        except SLOError as e:
+            print(f"Error, bad --slo spec: {e}", file=sys.stderr)
+            return 1
     if args.metrics_port is not None or args.status_json:
         from coast_tpu.obs.metrics import CampaignMetrics
-        metrics = CampaignMetrics(status_path=args.status_json)
+        metrics = CampaignMetrics(status_path=args.status_json,
+                                  slo=slo_set)
     if args.metrics_port is not None:
         from coast_tpu.obs.serve import MetricsServer
         server = MetricsServer(metrics, port=args.metrics_port)
@@ -565,7 +582,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 equiv=args.equiv,
                                 metrics=None if chunked else metrics,
                                 collect=args.collect,
-                                profile=args.profile)
+                                profile=args.profile,
+                                slo=slo_set)
     except ValueError as e:
         if args.equiv:
             print(f"Error, {e}", file=sys.stderr)

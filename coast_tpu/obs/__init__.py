@@ -10,15 +10,19 @@ convergence tracking with Wilson-interval early stop
 (:mod:`coast_tpu.obs.convergence`), a live TTY dashboard
 (:mod:`coast_tpu.obs.console`), per-dispatch device-time attribution
 (:mod:`coast_tpu.obs.profiler`) with roofline/MFU accounting
-(:mod:`coast_tpu.obs.roofline`), and fleet trace federation
-(:mod:`coast_tpu.obs.federate`).  See docs/observability.md for the
-workflow.
+(:mod:`coast_tpu.obs.roofline`), fleet trace federation
+(:mod:`coast_tpu.obs.federate`), declarative reliability SLOs with
+error-budget burn rates (:mod:`coast_tpu.obs.slo`), and a blackbox
+flight recorder with hang forensics (:mod:`coast_tpu.obs.flightrec`).
+See docs/observability.md for the workflow.
 """
 
 from coast_tpu.obs.console import Console
 from coast_tpu.obs.convergence import (ConvergenceTracker, StopWhen,
                                        StopWhenError, wilson_interval)
 from coast_tpu.obs.federate import merge_traces, write_merged_trace
+from coast_tpu.obs.flightrec import FlightRecorder
+from coast_tpu.obs.slo import SLOError, SLOSet, SLOSpec
 from coast_tpu.obs.heartbeat import Heartbeat
 from coast_tpu.obs.metrics import (CampaignMetrics, Histogram, Ring,
                                    atomic_write_json)
@@ -37,4 +41,5 @@ __all__ = [
     "atomic_write_json",
     "CampaignProfiler", "merge_traces", "write_merged_trace",
     "ConvergenceTracker", "StopWhen", "StopWhenError", "wilson_interval",
+    "FlightRecorder", "SLOSpec", "SLOSet", "SLOError",
 ]

@@ -160,12 +160,26 @@ class CampaignMetrics:
                  status_path: Optional[str] = None,
                  status_interval_s: float = 0.0,
                  z: float = 1.96,
+                 slo=None,
+                 slo_baseline: Optional[Mapping[str, float]] = None,
                  clock=time.monotonic):
         self._lock = threading.Lock()
         self._clock = clock
         self.status_path = status_path
         self.status_interval_s = float(status_interval_s)
         self.z = float(z)
+        # Reliability SLOs (obs/slo): a spec string or SLOSet; when set,
+        # every record_batch re-evaluates the error budgets over the
+        # cumulative evidence and snapshot()/prometheus()/the console
+        # expose the live verdicts.  ``slo_baseline`` feeds the mwtf
+        # objective ({"sdc_rate", "inj_per_sec"} of an unprotected run).
+        if isinstance(slo, str):
+            from coast_tpu.obs.slo import SLOSet
+            slo = SLOSet.parse(slo)
+        self.slo_set = slo
+        self.slo_baseline = (dict(slo_baseline) if slo_baseline
+                             else None)
+        self.slo_report: Optional[Dict[str, object]] = None
         self.rings: Dict[str, Ring] = {
             name: Ring(ring_capacity) for name in _SERIES}
         self.state = "idle"
@@ -292,6 +306,7 @@ class CampaignMetrics:
             self.rings["sdc_rate"].append(now, sdc_rate)
             if mem is not None:
                 self.rings["device_memory_bytes"].append(now, mem)
+            self._refresh_slo_locked()
             self._updated_unix = time.time()
         self._maybe_write_status()
 
@@ -308,8 +323,35 @@ class CampaignMetrics:
                 stages = summary.get("stages")
                 if isinstance(stages, dict):
                     self.stages = {k: float(v) for k, v in stages.items()}
+            self._refresh_slo_locked()
             self._updated_unix = time.time()
         self._maybe_write_status(force=True)
+
+    def _refresh_slo_locked(self) -> None:
+        """Re-evaluate the attached SLO set over the cumulative evidence
+        (caller holds the lock; pure arithmetic, one pass per batch)."""
+        if self.slo_set is None:
+            return
+        from coast_tpu.obs.slo import evaluate
+        elapsed = max(self._t_last_batch - self._t_start, 1e-9)
+        evidence = {
+            "counts": dict(self.counts),
+            "inj_per_sec": (self.done_rows / elapsed
+                            if self.done_rows else None),
+            "histograms": {k: h.snapshot()
+                           for k, h in self.histograms.items()},
+            "sdc_rate_recent": [v for _, v in
+                                self.rings["sdc_rate"].points()],
+        }
+        self.slo_report = evaluate(self.slo_set, evidence,
+                                   baseline=self.slo_baseline)
+
+    def slo_status(self) -> Optional[Dict[str, object]]:
+        """The latest live SLO evaluation (None when no SLO set is
+        attached or nothing has been recorded yet) -- the console /
+        heartbeat feed."""
+        with self._lock:
+            return self.slo_report
 
     # -- reader side ---------------------------------------------------------
     def _rates(self) -> Dict[str, Dict[str, float]]:
@@ -361,6 +403,9 @@ class CampaignMetrics:
                 doc["error"] = self.error
             if self.convergence is not None:
                 doc["convergence"] = self.convergence
+            if self.slo_report is not None:
+                from coast_tpu.obs.slo import summary_block
+                doc["slo"] = summary_block(self.slo_report)
             return doc
 
     def prometheus(self) -> str:
@@ -479,6 +524,32 @@ class CampaignMetrics:
                        "gauge",
                        "High-water device bytes_in_use seen.",
                        [(labels, float(self.memory_watermark))])
+            if self.slo_report is not None:
+                rows = self.slo_report.get("objectives") or []
+                metric("coast_campaign_slo_burn_rate", "gauge",
+                       "Error-budget burn rate per SLO objective "
+                       "(1.0 = consuming budget exactly at the allowed "
+                       "pace).",
+                       [(f'{labels},objective="{_esc(r["objective"])}"',
+                         float(r["burn"]["long"]))
+                        for r in rows
+                        if (r.get("burn") or {}).get("long")
+                        is not None])
+                metric("coast_campaign_slo_budget_remaining_frac",
+                       "gauge",
+                       "Unconsumed error-budget fraction per SLO "
+                       "objective (negative = overspent).",
+                       [(f'{labels},objective="{_esc(r["objective"])}"',
+                         float(r["budget"]["remaining_frac"]))
+                        for r in rows
+                        if (r.get("budget") or {}).get("remaining_frac")
+                        is not None])
+                metric("coast_campaign_slo_verdict", "gauge",
+                       "Per-objective verdict (0=ok, 1=warn, 2=page).",
+                       [(f'{labels},objective="{_esc(r["objective"])}"',
+                         float(("ok", "warn",
+                                "page").index(r["verdict"])))
+                        for r in rows])
             return "\n".join(lines) + "\n"
 
     # -- status file ---------------------------------------------------------

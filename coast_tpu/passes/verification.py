@@ -85,6 +85,10 @@ class RegionDataflow:
 _LOAD_PRIMS = ("gather", "dynamic_slice")
 _STORE_UPDATE_PRIM = "dynamic_update_slice"
 
+# Sentinel for "this var has been seen with conflicting alias roots" in
+# _trace_provenance's passthrough tracking (distinct from "never seen").
+_NO_ALIAS = object()
+
 
 def _trace_provenance(jaxpr, names):
     """Propagate leaf provenance through a jaxpr whose first ``len(names)``
@@ -105,6 +109,31 @@ def _trace_provenance(jaxpr, names):
     store_addr: Set[str] = set()
     stored_into: Set[str] = set()
     branch_pred: Set[str] = set()
+
+    # Var-level aliasing: alias[v] is the top-level invar v is provably
+    # identical to on EVERY control path (cond/switch branches that all
+    # return the same operand unchanged, pjit/call passthrough).  Needed
+    # because ``lax.cond``/``lax.switch`` outputs are fresh jaxpr vars even
+    # when every branch is an identity -- without it, a leaf routed through
+    # a phase switch looks written and loses its unwritten-global (ro)
+    # classification.  ``_NO_ALIAS`` marks a var seen with conflicting
+    # roots (alias knowledge only ever narrows, keeping fixpoints sound).
+    alias: Dict[object, object] = {v: v for v in jaxpr.invars}
+
+    def aroot(v):
+        if isinstance(v, Literal):
+            return None
+        r = alias.get(v)
+        return None if r is _NO_ALIAS else r
+
+    def aseed(inner_vars, outer_vars) -> None:
+        for iv, ov in zip(inner_vars, outer_vars):
+            r = None if isinstance(ov, Literal) else aroot(ov)
+            cur = alias.get(iv)
+            if cur is None:
+                alias[iv] = r if r is not None else _NO_ALIAS
+            elif cur is not r:
+                alias[iv] = _NO_ALIAS
 
     def var_deps(v) -> Set[str]:
         if isinstance(v, Literal):
@@ -155,6 +184,7 @@ def _trace_provenance(jaxpr, names):
                 per_branch = []
                 for br in params["branches"]:
                     seed(br.jaxpr.invars, ins[1:])
+                    aseed(br.jaxpr.invars, eqn.invars[1:])
                     per_branch.append(walk(br.jaxpr))
                 # Control dependence: which branch ran (the predicate)
                 # influences every output -- exactly why the reference
@@ -163,6 +193,14 @@ def _trace_provenance(jaxpr, names):
                 branch_pred.update(pred)
                 out_sets = [set().union(pred, *(b[i] for b in per_branch))
                             for i in range(len(eqn.outvars))]
+                # A cond/switch output every branch returns as the SAME
+                # unchanged invar IS that invar, whichever branch ran:
+                # identity passthrough survives the fresh outvars.
+                for i, ov in enumerate(eqn.outvars):
+                    roots = {aroot(br.jaxpr.outvars[i])
+                             for br in params["branches"]}
+                    if len(roots) == 1 and None not in roots:
+                        alias.setdefault(ov, roots.pop())
             elif prim == "while":
                 cn = params["cond_nconsts"]
                 bn = params["body_nconsts"]
@@ -207,12 +245,22 @@ def _trace_provenance(jaxpr, names):
                 sub = params["jaxpr"]
                 sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
                 seed(sub.invars, ins)
+                aseed(sub.invars, eqn.invars)
                 out_sets = walk(sub)
+                for ov, sv in zip(eqn.outvars, sub.outvars):
+                    r = aroot(sv)
+                    if r is not None:
+                        alias.setdefault(ov, r)
             elif "call_jaxpr" in params:
                 sub = params["call_jaxpr"]
                 sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
                 seed(sub.invars, ins)
+                aseed(sub.invars, eqn.invars)
                 out_sets = walk(sub)
+                for ov, sv in zip(eqn.outvars, sub.outvars):
+                    r = aroot(sv)
+                    if r is not None:
+                        alias.setdefault(ov, r)
 
             if len(out_sets) != len(eqn.outvars):
                 acc: Set[str] = set()
@@ -228,7 +276,7 @@ def _trace_provenance(jaxpr, names):
              "store_addr": frozenset(store_addr),
              "stored_into": frozenset(stored_into),
              "branch_pred": frozenset(branch_pred)}
-    return out_sets, in_var_of, facts
+    return out_sets, in_var_of, facts, aroot
 
 
 def analyze_step(step, state) -> RegionDataflow:
@@ -248,7 +296,7 @@ def analyze_step(step, state) -> RegionDataflow:
     # (dicts flatten sorted), then t.
     assert len(jaxpr.invars) == len(names) + 1, (
         len(jaxpr.invars), len(names))
-    out_sets, in_var_of, facts = _trace_provenance(jaxpr, names)
+    out_sets, in_var_of, facts, aroot = _trace_provenance(jaxpr, names)
 
     assert len(jaxpr.outvars) == len(names), (
         f"step() must return exactly the state leaves; got "
@@ -259,7 +307,8 @@ def analyze_step(step, state) -> RegionDataflow:
         if isinstance(var, Literal):
             out_deps[name] = frozenset()
             written.add(name)
-        elif var is in_var_of.get(name):
+        elif (var is in_var_of.get(name)
+              or aroot(var) is in_var_of.get(name)):
             out_deps[name] = frozenset({name})      # identity passthrough
         else:
             out_deps[name] = frozenset(deps)
@@ -282,7 +331,7 @@ def reads_of(fn, state, *extra_args) -> FrozenSet[str]:
     state = jax.eval_shape(lambda: state)
     closed = jax.make_jaxpr(fn)(state, *extra_args)
     names = sorted(state)
-    out_sets, _, _ = _trace_provenance(closed.jaxpr, names)
+    out_sets, _, _, _ = _trace_provenance(closed.jaxpr, names)
     acc: Set[str] = set()
     for s in out_sets:
         acc |= s

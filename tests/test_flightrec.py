@@ -1,0 +1,338 @@
+"""Blackbox flight-recorder tests (ISSUE 16 tentpole b).
+
+The forensics contract: a bounded ring of structured events any layer
+can append to for near-zero cost, atomic parseable bundles on watchdog
+wedge (``CampaignWedgedError``), on lease loss (both the compile-phase
+keeper and the mid-campaign renew), on SIGUSR1 (the bench parent's
+spawn-budget-overrun harvest channel), and on campaign crash -- with
+the disabled path staying inside the PR 1 <2% overhead budget.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from coast_tpu.obs import flightrec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the ring ----------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    rec = flightrec.FlightRecorder(capacity=4, enabled=True)
+    for i in range(10):
+        rec.record("tick", i=i)
+    rows = rec.tail()
+    assert len(rows) == 4                       # capacity bound
+    assert [r["i"] for r in rows] == [6, 7, 8, 9]
+    assert [r["seq"] for r in rows] == [6, 7, 8, 9]
+    assert all(r["event"] == "tick" and "t_unix_s" in r and
+               r["thread"] for r in rows)
+    assert rec.tail(2) == rows[-2:]
+
+
+def test_ring_is_thread_safe_and_tags_threads():
+    rec = flightrec.FlightRecorder(capacity=4096, enabled=True)
+
+    def spin(name):
+        for _ in range(200):
+            rec.record("spin", who=name)
+
+    threads = [threading.Thread(target=spin, args=(f"t{i}",),
+                                name=f"flightrec-test-{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rows = rec.tail()
+    assert len(rows) == 800
+    assert sorted(r["seq"] for r in rows) == list(range(800))
+    assert {r["thread"] for r in rows} == {f"flightrec-test-{i}"
+                                           for i in range(4)}
+
+
+def test_disabled_recorder_and_null_absorb_everything(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=False,
+                                   dump_dir=str(tmp_path))
+    rec.record("never")
+    assert rec.tail() == []
+    assert rec.dump("never") is None and rec.dumps == []
+    assert os.listdir(tmp_path) == []           # dump never touched disk
+    # The ambient default with nothing installed is the NULL recorder.
+    assert flightrec.current() is flightrec.NULL
+    flightrec.record("orphan", x=1)
+    assert not flightrec.NULL.events and not flightrec.NULL.dumps
+
+
+def test_env_knobs(monkeypatch, tmp_path):
+    monkeypatch.setenv("COAST_FLIGHTREC", "0")
+    rec = flightrec.FlightRecorder()
+    assert not rec.enabled
+    monkeypatch.setenv("COAST_FLIGHTREC", "1")
+    monkeypatch.setenv("COAST_FLIGHTREC_CAP", "7")
+    rec = flightrec.FlightRecorder()
+    assert rec.enabled and rec.capacity == 7
+    monkeypatch.setenv("COAST_FLIGHTREC_DIR", str(tmp_path / "d"))
+    rec.record("one")
+    path = rec.dump("env_dir")
+    assert path is not None and path.startswith(str(tmp_path / "d"))
+
+
+def test_activate_scopes_the_ambient_recorder():
+    with flightrec.activate(enabled=True) as outer:
+        assert flightrec.current() is outer
+        with flightrec.activate(enabled=True) as inner:
+            assert flightrec.current() is inner   # newest install wins
+            flightrec.record("inner_event")
+        assert flightrec.current() is outer
+    assert flightrec.current() is flightrec.NULL
+    assert any(r["event"] == "inner_event" for r in inner.tail())
+    assert not any(r["event"] == "inner_event" for r in outer.tail())
+
+
+# -- bundles -----------------------------------------------------------------
+
+def test_bundle_roundtrip(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=True, dump_dir=str(tmp_path),
+                                   source="unit-test")
+    rec.record("dispatch", lo=0, n=64)
+    rec.record("retry", lo=0, attempt=1)
+    path = rec.dump("unit_reason", extra={"answer": 42})
+    assert path is not None and rec.dumps == [path]
+    doc = flightrec.read_bundle(path)
+    assert doc["format"] == flightrec.BUNDLE_FORMAT
+    assert doc["version"] == 1
+    assert doc["reason"] == "unit_reason" and doc["source"] == "unit-test"
+    assert doc["extra"] == {"answer": 42}
+    assert doc["process"]["pid"] == os.getpid()
+    assert [e["event"] for e in doc["events"]] == ["dispatch", "retry"]
+    assert doc["events_recorded_total"] == 2
+    assert "MainThread" in doc["stacks"]        # named all-thread stacks
+    assert flightrec.newest_bundle(str(tmp_path)) == path
+    # No torn temp files left behind (atomic tmp + rename).
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+def test_read_bundle_rejects_non_bundles(tmp_path):
+    p = tmp_path / "flightrec_not_a_bundle.json"
+    p.write_text(json.dumps({"format": "something-else"}))
+    with pytest.raises(ValueError):
+        flightrec.read_bundle(str(p))
+    assert flightrec.newest_bundle(str(tmp_path / "missing")) is None
+
+
+def test_sigusr1_dumps_a_bundle(tmp_path):
+    rec = flightrec.FlightRecorder(enabled=True, dump_dir=str(tmp_path),
+                                   source="sig-test")
+    rec.record("before_signal")
+    try:
+        assert rec.install_signal_handler()
+        os.kill(os.getpid(), signal.SIGUSR1)
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+    assert len(rec.dumps) == 1
+    doc = flightrec.read_bundle(rec.dumps[0])
+    assert doc["reason"] == f"signal:{int(signal.SIGUSR1)}"
+    events = [e["event"] for e in doc["events"]]
+    assert events == ["before_signal", "signal_dump"]
+
+
+# -- watchdog wedge (the acceptance pin) -------------------------------------
+
+def test_watchdog_wedge_dumps_forensics_before_raising(tmp_path):
+    from coast_tpu.inject.resilience import (CampaignWedgedError,
+                                             watchdog_collect)
+    hang = threading.Event()
+    with flightrec.activate(enabled=True, dump_dir=str(tmp_path),
+                            source="wedge-test") as rec:
+        rec.record("dispatch", lo=0, n=64)
+        try:
+            with pytest.raises(CampaignWedgedError):
+                watchdog_collect(lambda: hang.wait(30.0), timeout=0.2)
+        finally:
+            hang.set()
+        assert rec.dumps, "wedge wrote no bundle"
+    doc = flightrec.read_bundle(rec.dumps[-1])
+    assert doc["reason"] == "watchdog_wedge"
+    assert doc["extra"]["timeout_s"] == 0.2
+    events = {e["event"] for e in doc["events"]}
+    assert {"dispatch", "watchdog_fired"} <= events
+    # The hung collect thread is IN the stack dump, by name -- the
+    # evidence a one-line diagnosis never carried.
+    assert "coast-collect-watchdog" in doc["stacks"]
+
+
+# -- campaign events ---------------------------------------------------------
+
+def test_campaign_threads_events_through_the_ring(tmp_path):
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm
+    runner = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR")
+    jpath = str(tmp_path / "run.ndjson")
+    with flightrec.activate(enabled=True,
+                            dump_dir=str(tmp_path)) as rec:
+        runner.run(120, seed=3, batch_size=40, journal=jpath)
+    events = [r["event"] for r in rec.tail()]
+    assert "journal_open" in events
+    assert events.count("dispatch") == 3        # one per batch
+    dispatch = next(r for r in rec.tail() if r["event"] == "dispatch")
+    assert dispatch["n"] == 40
+
+
+# -- lease-loss forensics (fleet worker) -------------------------------------
+
+def _mm_item(q, n=150, seed=3):
+    from coast_tpu.fleet import item_spec
+    return q.enqueue(item_spec("matrixMultiply", n, seed=seed,
+                               batch_size=50))
+
+
+def test_lease_lost_during_compile_dumps_bundle(tmp_path, monkeypatch):
+    """The keeper thread loses the lease while the worker sits in the
+    cold build: run_item yields AND leaves a lease_lost bundle behind
+    (the who-stalled-us-or-the-supervisor adjudication record)."""
+    from coast_tpu.fleet import CampaignQueue, Worker
+    q = CampaignQueue(str(tmp_path / "q"))
+    _mm_item(q)
+    w = Worker(q, "w0", lease_s=0.06, max_retries=0)
+    # Pin the build long enough for the keeper's renew to fire inside
+    # it -- a warm compile cache would otherwise skip the window.
+    orig_runner = w.cache.runner
+
+    def slow_runner(spec, **kwargs):
+        time.sleep(0.5)
+        return orig_runner(spec, **kwargs)
+
+    monkeypatch.setattr(w.cache, "runner", slow_runner)
+    item = q.claim("w0", 0.06)
+    # The supervisor's observed-death fast path reaps the claim; a
+    # replacement worker takes it over while w0 still compiles.
+    assert q.requeue_worker("w0") == [item.id]
+    assert q.claim("thief", 3600).id == item.id
+    with flightrec.activate(enabled=True, dump_dir=str(tmp_path / "fr"),
+                            source="fleet-worker:w0") as rec:
+        assert w.run_item(item) is False
+    assert w.items_yielded == 1 and rec.dumps
+    doc = flightrec.read_bundle(rec.dumps[-1])
+    assert doc["reason"] == "lease_lost"
+    assert doc["extra"]["item"] == item.id
+    assert doc["extra"]["worker"] == "w0"
+    assert doc["extra"]["phase"] == "compile"
+    events = {e["event"] for e in doc["events"]}
+    assert {"lease_claim", "lease_lost"} <= events
+
+
+def test_lease_lost_mid_campaign_dumps_bundle(tmp_path, monkeypatch):
+    """The progress-hook renew discovers the lease was reaped while the
+    campaign ran (the SIGKILL'd-and-replaced worker's surviving twin):
+    the worker stops touching the item and dumps the blackbox."""
+    import coast_tpu.fleet.worker as worker_mod
+    from coast_tpu.fleet import CampaignQueue, Worker
+
+    class _InertKeeper:
+        """Stand-in compile-phase keeper so the loss lands mid-campaign
+        deterministically (the real keeper would race the renew)."""
+
+        def __init__(self, *args, **kwargs):
+            self.lost = None
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return None
+
+    monkeypatch.setattr(worker_mod, "_LeaseKeeper", _InertKeeper)
+    q = CampaignQueue(str(tmp_path / "q"))
+    _mm_item(q)
+    # A tiny lease makes the first progress beat renew immediately; the
+    # item was reaped and reclaimed by then, so the renew raises.
+    w = Worker(q, "w0", lease_s=1e-6, max_retries=0)
+    item = q.claim("w0", 1e-6)
+    assert q.requeue_worker("w0") == [item.id]
+    assert q.claim("thief", 3600).id == item.id
+    with flightrec.activate(enabled=True, dump_dir=str(tmp_path / "fr"),
+                            source="fleet-worker:w0") as rec:
+        assert w.run_item(item) is False
+    assert w.items_yielded == 1 and rec.dumps
+    doc = flightrec.read_bundle(rec.dumps[-1])
+    assert doc["reason"] == "lease_lost"
+    assert doc["extra"]["worker"] == "w0" and "error" in doc["extra"]
+    events = {e["event"] for e in doc["events"]}
+    assert {"lease_claim", "lease_lost", "dispatch"} <= events
+
+
+# -- the bench parent's spawn-budget harvest ---------------------------------
+
+_CHILD_SRC = """
+import os, sys, time
+sys.path.insert(0, {root!r})
+from coast_tpu.obs import flightrec
+rec = flightrec.install(dump_dir=sys.argv[1], source="fake-bench-worker")
+rec.record("spawn_stage", stage="init")
+rec.install_signal_handler()
+print("ready", flush=True)
+time.sleep(120)      # wedge: never reaches the measure stage
+"""
+
+
+def test_bench_harvests_wedged_child_blackbox(tmp_path):
+    """The spawn-budget-overrun path end to end: the parent SIGUSR1s a
+    wedged child and collects its bundle -- exactly what lands in the
+    bench artifact's ``spawn_wedge.forensics``."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+    dump_dir = str(tmp_path / "fr")
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD_SRC.format(root=REPO_ROOT))
+    t0 = time.time()
+    proc = subprocess.Popen([sys.executable, str(child), dump_dir],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        path = bench._harvest_blackbox(proc, dump_dir, after=t0,
+                                       wait_s=20.0)
+        assert path is not None, "no bundle harvested from wedged child"
+        doc = flightrec.read_bundle(path)
+        assert doc["reason"] == f"signal:{int(signal.SIGUSR1)}"
+        assert doc["source"] == "fake-bench-worker"
+        assert doc["process"]["pid"] == proc.pid
+        events = [e["event"] for e in doc["events"]]
+        assert "spawn_stage" in events
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# -- overhead ----------------------------------------------------------------
+
+def test_disabled_recorder_overhead_bound():
+    """The PR 1 obs bound applied to the recorder hooks: with nothing
+    installed, ``record()`` is one call + one attribute test.  Its cost
+    times a production campaign's event count (a handful per batch,
+    never per injection) must stay far under 2% of even a small
+    campaign's wall clock."""
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm
+    runner = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR")
+    runner.run(64, seed=1, batch_size=64)       # warm the jit
+    secs = min(runner.run(600, seed=5, batch_size=100).seconds
+               for _ in range(3))
+    assert flightrec.current() is flightrec.NULL
+    reps = 20000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        flightrec.record("dispatch", lo=0, n=65536)
+    per_record = (time.perf_counter() - t0) / reps
+    events_per_campaign = 5 * (1_000_000 // 65536 + 1)
+    assert per_record * events_per_campaign < 0.02 * max(secs, 0.05)

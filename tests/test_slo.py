@@ -1,0 +1,447 @@
+"""Reliability-SLO engine tests (ISSUE 16 tentpole a).
+
+The SLO contract: declarative objectives (SDC ceiling, availability
+floor, MWTF floor, dispatch-latency percentile) parse/round-trip as
+canonical spec strings, attainment is Wilson-backed (same interval,
+same z as obs/convergence -- a small sample buys no verdict), error
+budgets and multi-window burn rates drive the page/warn/ok verdicts,
+evidence extraction accepts every recorded surface (status docs,
+flattened summaries, fleet done-records, NDJSON logs), and the
+``python -m coast_tpu slo`` gate exits 1 on a burning budget and 0 on
+an attained spec.
+"""
+
+import json
+import math
+
+import pytest
+
+from coast_tpu.inject.classify import DUE_CLASSES, SDC_CLASSES
+from coast_tpu.obs.convergence import wilson_interval
+from coast_tpu.obs.slo import (SLOError, SLOSet, SLOSpec, evaluate,
+                               evidence_from_status, evidence_from_summary,
+                               load_evidence, status_line, summary_block,
+                               worst_verdict)
+
+
+def _evidence(counts, **kw):
+    ev = {"counts": dict(counts), "inj_per_sec": None,
+          "histograms": {}, "sdc_rate_recent": []}
+    ev.update(kw)
+    return ev
+
+
+def _row(report, objective):
+    return next(r for r in report["objectives"]
+                if r["objective"] == objective)
+
+
+# -- spec parsing ------------------------------------------------------------
+
+def test_parse_single_objective():
+    s = SLOSet.parse("sdc_rate<=0.002")
+    assert len(s.objectives) == 1
+    o = s.objectives[0]
+    assert (o.objective, o.op, o.target) == ("sdc_rate", "<=", 0.002)
+    assert (o.z, o.min_n, o.page_burn) == (1.96, 0.0, 2.0)
+
+
+def test_parse_knobs_apply_to_all_objectives():
+    s = SLOSet.parse("sdc_rate<=0.01,availability>=0.99"
+                     ";z=2.576;min=4096;page=14")
+    assert all(o.z == 2.576 and o.min_n == 4096 and o.page_burn == 14
+               for o in s.objectives)
+    assert [o.objective for o in s.objectives] == ["sdc_rate",
+                                                   "availability"]
+
+
+def test_spec_round_trip_is_canonical():
+    for text in ("sdc_rate<=0.002",
+                 "sdc_rate<=0.01,availability>=0.99;z=2.576;min=4096",
+                 "mwtf>=10;min=256",
+                 "p99_dispatch<=0.5,p95_gap<=0.1"):
+        s = SLOSet.parse(text)
+        assert SLOSet.parse(s.spec()).spec() == s.spec()
+
+
+@pytest.mark.parametrize("bad", [
+    "",                                # empty
+    "sdc_rate<0.01",                   # bad op
+    "sdc_rate>=0.01",                  # ceiling with a floor op
+    "availability<=0.9",               # floor with a ceiling op
+    "sdc_rate<=1.5",                   # rate outside (0,1)
+    "sdc_rate<=0",                     # rate outside (0,1)
+    "mwtf>=-1",                        # nonpositive floor
+    "nonsense<=0.5",                   # unknown objective
+    "sdc_rate<=0.01;page=0.5",         # page burn below 1
+    "sdc_rate<=0.01;frob=3",           # unknown knob
+    "sdc_rate<=0.01,sdc_rate<=0.02",   # duplicate objective
+    "p0_dispatch<=1",                  # quantile outside (0,100)
+])
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(SLOError):
+        SLOSet.parse(bad)
+
+
+def test_latency_objective_histogram_aliases():
+    q, hist = SLOSpec("p99_dispatch", "<=", 0.5).latency_parts()
+    assert (q, hist) == (0.99, "dispatch_device_seconds")
+    q, hist = SLOSpec("p95_gap", "<=", 0.1).latency_parts()
+    assert (q, hist) == (0.95, "dispatch_host_gap_seconds")
+
+
+# -- Wilson-backed attainment ------------------------------------------------
+
+def test_sdc_ceiling_attained_and_wilson_consistent():
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"),
+                      _evidence({"success": 980, "sdc": 20}))
+    row = _row(report, "sdc_rate")
+    lo, hi = wilson_interval(20, 1000, 1.96)
+    assert row["wilson"] == {"lo": lo, "hi": hi}
+    assert hi <= 0.05 and row["attained"] is True
+    assert row["observed"] == pytest.approx(0.02)
+    assert report["verdict"] == "ok" and report["burning"] == []
+
+
+def test_sdc_ceiling_violated_pages():
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"),
+                      _evidence({"success": 800, "sdc": 200}))
+    row = _row(report, "sdc_rate")
+    assert row["attained"] is False          # Wilson lo above the ceiling
+    assert row["burn"]["long"] == pytest.approx(4.0)
+    assert row["budget"]["remaining_frac"] < 0  # budget overspent
+    assert row["verdict"] == "page" and report["verdict"] == "page"
+    assert report["burning"] == ["sdc_rate"]
+
+
+def test_small_sample_is_inconclusive():
+    """3/50 at a 0.05 ceiling: the interval straddles the target, so
+    neither side gets a verdict -- small samples cannot buy attainment."""
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"),
+                      _evidence({"success": 47, "sdc": 3}))
+    row = _row(report, "sdc_rate")
+    lo, hi = wilson_interval(3, 50, 1.96)
+    assert lo < 0.05 < hi
+    assert row["attained"] is None
+
+
+def test_min_n_floor_suppresses_verdict():
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05;min=1000"),
+                      _evidence({"success": 40, "sdc": 10}))
+    row = _row(report, "sdc_rate")
+    assert row["attained"] is None and row["verdict"] == "ok"
+
+
+def test_no_evidence_constrains_nothing():
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"), _evidence({}))
+    row = _row(report, "sdc_rate")
+    assert row["effective_n"] == 0 and row["verdict"] == "ok"
+
+
+# -- availability, mwtf, latency ---------------------------------------------
+
+def test_availability_counts_due_classes_as_downtime():
+    counts = {"success": 985, "sdc": 5}
+    for i, cls in enumerate(DUE_CLASSES):
+        counts[cls] = 2 + (i == 0)           # 9 DUE events total
+    report = evaluate(SLOSet.parse("availability>=0.95"),
+                      _evidence(counts))
+    row = _row(report, "availability")
+    due = sum(counts[k] for k in DUE_CLASSES)
+    n = sum(counts.values())
+    assert row["bad"] == due
+    assert row["observed"] == pytest.approx(1.0 - due / n)
+    assert row["attained"] is True and row["verdict"] == "ok"
+
+
+def test_mwtf_against_baseline():
+    """10x fewer SDCs at the same throughput = 10x MWTF; a floor of 5
+    is attained, a floor of 50 burns."""
+    ev = _evidence({"success": 990, "sdc": 10}, inj_per_sec=100.0)
+    baseline = {"sdc_rate": 0.1, "inj_per_sec": 100.0}
+    report = evaluate(SLOSet.parse("mwtf>=5"), ev, baseline=baseline)
+    row = _row(report, "mwtf")
+    assert row["observed"] == pytest.approx(10.0)
+    assert row["attained"] is True and row["verdict"] == "ok"
+    report = evaluate(SLOSet.parse("mwtf>=50"), ev, baseline=baseline)
+    row = _row(report, "mwtf")
+    assert row["attained"] is False and row["verdict"] != "ok"
+
+
+def test_mwtf_runtime_cost_discounts_improvement():
+    """Half the throughput halves the MWTF improvement (the
+    compare_runs definition: error improvement over runtime cost)."""
+    baseline = {"sdc_rate": 0.1, "inj_per_sec": 100.0}
+    ev = _evidence({"success": 990, "sdc": 10}, inj_per_sec=50.0)
+    report = evaluate(SLOSet.parse("mwtf>=5"), ev, baseline=baseline)
+    assert _row(report, "mwtf")["observed"] == pytest.approx(5.0)
+
+
+def test_mwtf_without_baseline_reports_no_data():
+    report = evaluate(SLOSet.parse("mwtf>=5"),
+                      _evidence({"success": 100}))
+    row = _row(report, "mwtf")
+    assert row["observed"] is None and row["attained"] is None
+    assert row["verdict"] == "ok"            # cannot gate without one
+
+
+def test_mwtf_zero_sdc_uses_wilson_upper_bound():
+    """'No SDC seen yet' never claims infinite MWTF: the rate in the
+    denominator is the Wilson upper bound at zero observations."""
+    ev = _evidence({"success": 1000}, inj_per_sec=100.0)
+    report = evaluate(SLOSet.parse("mwtf>=5"), ev,
+                      baseline={"sdc_rate": 0.1, "inj_per_sec": 100.0})
+    row = _row(report, "mwtf")
+    _, hi = wilson_interval(0, 1000, 1.96)
+    assert row["observed"] == pytest.approx(0.1 / hi)
+    assert math.isfinite(row["observed"])
+
+
+def test_latency_percentile_from_histogram():
+    hist = {"le": [0.1, 0.5, 1.0], "counts": [90, 99, 100],
+            "count": 100}
+    ev = _evidence({}, histograms={"dispatch_device_seconds": hist})
+    report = evaluate(SLOSet.parse("p90_dispatch<=0.5"), ev)
+    row = _row(report, "p90_dispatch")
+    assert row["observed"] == pytest.approx(0.1)   # p90 bucket bound
+    assert row["attained"] is True and row["verdict"] == "ok"
+    # A tighter quantile against a bound the tail exceeds burns.
+    report = evaluate(SLOSet.parse("p99_dispatch<=0.1"), ev)
+    row = _row(report, "p99_dispatch")
+    assert row["bad"] == 10 and row["attained"] is False
+
+
+def test_latency_without_histogram_reports_no_data():
+    report = evaluate(SLOSet.parse("p99_dispatch<=0.5"), _evidence({}))
+    row = _row(report, "p99_dispatch")
+    assert row["observed"] is None and row["verdict"] == "ok"
+
+
+# -- burn windows + verdicts -------------------------------------------------
+
+def test_two_window_rule_stale_spike_warns_not_pages():
+    """Gross long-window burn but a quiet recent ring: warn, not page --
+    a page must mean burning NOW."""
+    ev = _evidence({"success": 800, "sdc": 200},
+                   sdc_rate_recent=[0.0] * 16)
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"), ev)
+    row = _row(report, "sdc_rate")
+    assert row["burn"]["long"] == pytest.approx(4.0)
+    assert row["burn"]["short"] == pytest.approx(0.0)
+    assert row["verdict"] == "warn"
+
+
+def test_two_window_rule_both_burning_pages():
+    ev = _evidence({"success": 800, "sdc": 200},
+                   sdc_rate_recent=[0.5] * 16)
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05"), ev)
+    assert _row(report, "sdc_rate")["verdict"] == "page"
+
+
+def test_worst_verdict_order():
+    assert worst_verdict([]) == "ok"
+    assert worst_verdict(["ok", "warn", "ok"]) == "warn"
+    assert worst_verdict(["warn", "page", "ok"]) == "page"
+
+
+# -- evidence extraction -----------------------------------------------------
+
+def test_evidence_from_flattened_summary():
+    """CampaignResult.summary() flattens counts into top-level class
+    keys and stores n under 'injections' -- the evidence extractor must
+    re-derive both (the shape every recorded run artifact has)."""
+    doc = {"benchmark": "matrixMultiply", "strategy": "TMR",
+           "injections": 240, "seconds": 2.0,
+           "success": 210, "sdc": 19, "due_timeout": 11}
+    ev = evidence_from_summary(doc)
+    assert ev["counts"] == {"success": 210.0, "sdc": 19.0,
+                            "due_timeout": 11.0}
+    assert ev["inj_per_sec"] == pytest.approx(120.0)
+    report = evaluate(SLOSet.parse("sdc_rate<=0.5"), ev)
+    row = _row(report, "sdc_rate")
+    assert row["bad"] == 19 and row["effective_n"] == 240
+
+
+def test_evidence_from_nested_counts_summary():
+    """Fleet done-records nest a counts dict instead; same evidence."""
+    doc = {"counts": {"success": 210, "sdc": 19, "due_timeout": 11},
+           "injections": 240, "seconds": 2.0}
+    ev = evidence_from_summary(doc)
+    assert ev["counts"]["sdc"] == 19.0
+    assert ev["inj_per_sec"] == pytest.approx(120.0)
+
+
+def test_evidence_from_summary_lifts_profile_histograms():
+    doc = {"counts": {"success": 10}, "n": 10, "seconds": 1.0,
+           "profile": {"device_seconds_histogram":
+                       {"le": [1.0], "counts": [10], "count": 10}}}
+    ev = evidence_from_summary(doc)
+    assert "dispatch_device_seconds" in ev["histograms"]
+
+
+def test_evidence_from_status_doc():
+    doc = {"format": "coast-status", "counts": {"success": 90, "sdc": 10},
+           "elapsed_s": 2.0, "done_rows": 100,
+           "series": {"sdc_rate": [[0, 0.1], [1, 0.2]]}}
+    ev = evidence_from_status(doc)
+    assert ev["inj_per_sec"] == pytest.approx(50.0)
+    assert ev["sdc_rate_recent"] == [0.1, 0.2]
+
+
+def test_load_evidence_shapes(tmp_path):
+    counts = {"success": 95, "sdc": 5}
+    shapes = {
+        "status.json": {"format": "coast-status", "counts": counts,
+                        "elapsed_s": 1.0, "done_rows": 100},
+        "run.json": {"summary": {"counts": counts, "n": 100,
+                                 "seconds": 1.0}, "runs": []},
+        "summary.json": {"counts": counts, "n": 100, "seconds": 1.0},
+        "flat.json": {"injections": 100, "seconds": 1.0, **counts},
+    }
+    for name, doc in shapes.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        ev = load_evidence(str(p))
+        assert ev["counts"] == {k: float(v) for k, v in counts.items()}, \
+            name
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"hello": "world"}))
+    with pytest.raises(SLOError):
+        load_evidence(str(bad))
+
+
+# -- report forms ------------------------------------------------------------
+
+def test_summary_block_compacts_rows_by_name():
+    report = evaluate(SLOSet.parse("sdc_rate<=0.05,availability>=0.9"),
+                      _evidence({"success": 980, "sdc": 20}))
+    block = summary_block(report)
+    assert block["spec"] == report["spec"]
+    assert set(block["objectives"]) == {"sdc_rate", "availability"}
+    row = block["objectives"]["sdc_rate"]
+    assert row["attained"] is True and row["verdict"] == "ok"
+    assert row["burn_rate"] == pytest.approx(0.4)
+    json.dumps(block)                        # JSON-able end to end
+
+
+def test_status_line_forms():
+    assert status_line(None) is None
+    ok = evaluate(SLOSet.parse("sdc_rate<=0.05"),
+                  _evidence({"success": 980, "sdc": 20}))
+    assert status_line(ok) == "slo ok"
+    burning = evaluate(SLOSet.parse("sdc_rate<=0.05"),
+                       _evidence({"success": 800, "sdc": 200}))
+    frag = status_line(burning)
+    assert frag.startswith("slo PAGE sdc_rate") and "burn" in frag
+
+
+# -- the CLI gate ------------------------------------------------------------
+
+def _write_artifact(tmp_path, counts, n, seconds=2.0):
+    path = tmp_path / "run.json"
+    path.write_text(json.dumps(
+        {"summary": {"injections": n, "seconds": seconds, **counts},
+         "runs": []}))
+    return str(path)
+
+
+def test_cli_check_attained_exits_zero(tmp_path, capsys):
+    from coast_tpu.obs.slo_cli import main
+    artifact = _write_artifact(tmp_path, {"success": 970, "sdc": 10,
+                                          "due_timeout": 20}, 1000)
+    out = tmp_path / "slo.json"
+    rc = main(["check", "--spec", "sdc_rate<=0.05,availability>=0.9",
+               "--input", artifact, "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["format"] == "coast-slo" and doc["verdict"] == "ok"
+    assert "SLO verdict: ok" in capsys.readouterr().out
+
+
+def test_cli_check_burning_budget_exits_one(tmp_path, capsys):
+    from coast_tpu.obs.slo_cli import main
+    artifact = _write_artifact(tmp_path, {"success": 800, "sdc": 200},
+                               1000)
+    rc = main(["check", "--spec", "sdc_rate<=0.05", "--input", artifact])
+    assert rc == 1
+    assert "SLO gate failed" in capsys.readouterr().err
+
+
+def test_cli_report_never_gates(tmp_path):
+    from coast_tpu.obs.slo_cli import main
+    artifact = _write_artifact(tmp_path, {"success": 800, "sdc": 200},
+                               1000)
+    assert main(["report", "--spec", "sdc_rate<=0.05",
+                 "--input", artifact]) == 0
+
+
+def test_cli_bad_inputs_exit_two(tmp_path):
+    from coast_tpu.obs.slo_cli import main
+    artifact = _write_artifact(tmp_path, {"success": 100}, 100)
+    assert main(["check", "--spec", "garbage",
+                 "--input", artifact]) == 2
+    assert main(["check", "--spec", "sdc_rate<=0.05",
+                 "--input", str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_mwtf_gate_with_baseline(tmp_path):
+    from coast_tpu.obs.slo_cli import main
+    protected = _write_artifact(tmp_path, {"success": 990, "sdc": 10},
+                                1000)
+    base_path = tmp_path / "base.json"
+    base_path.write_text(json.dumps(
+        {"summary": {"injections": 1000, "seconds": 2.0,
+                     "success": 900, "sdc": 100}, "runs": []}))
+    assert main(["check", "--spec", "mwtf>=5", "--input", protected,
+                 "--baseline", str(base_path)]) == 0
+    assert main(["check", "--spec", "mwtf>=50", "--input", protected,
+                 "--baseline", str(base_path)]) == 1
+
+
+# -- live integration --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slo_campaign():
+    from coast_tpu import TMR
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.models import mm
+    runner = CampaignRunner(TMR(mm.make_region()), strategy_name="TMR",
+                            slo="sdc_rate<=0.9;min=8")
+    return runner, runner.run(240, seed=17, batch_size=48)
+
+
+def test_campaign_result_carries_slo_block(slo_campaign):
+    runner, res = slo_campaign
+    assert res.slo is not None and res.slo["verdict"] == "ok"
+    assert res.summary()["slo"]["verdict"] == "ok"
+    assert res.slo["objectives"]["sdc_rate"]["attained"] is True
+
+
+def test_live_report_matches_offline_gate(slo_campaign, tmp_path):
+    """The live hub's verdict and the CLI's replay of the recorded
+    artifact agree on bad/effective_n -- one engine, two entries."""
+    from coast_tpu.obs.slo_cli import main
+    runner, res = slo_campaign
+    report = runner.metrics.slo_status()
+    live = next(r for r in report["objectives"]
+                if r["objective"] == "sdc_rate")
+    artifact = tmp_path / "run.json"
+    artifact.write_text(json.dumps({"summary": res.summary(),
+                                    "runs": []}))
+    out = tmp_path / "slo.json"
+    assert main(["check", "--spec", "sdc_rate<=0.9;min=8",
+                 "--input", str(artifact), "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    offline = next(r for r in doc["objectives"]
+                   if r["objective"] == "sdc_rate")
+    assert offline["bad"] == live["bad"]
+    assert offline["effective_n"] == live["effective_n"]
+    bad = sum(res.counts.get(k, 0) for k in SDC_CLASSES)
+    assert offline["bad"] == bad and offline["effective_n"] == res.n
+
+
+def test_snapshot_and_status_line_surfaces(slo_campaign):
+    runner, _ = slo_campaign
+    snap = runner.metrics.snapshot()
+    assert snap["slo"]["verdict"] == "ok"
+    assert status_line(runner.metrics.slo_status()) == "slo ok"
