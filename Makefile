@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke slo_smoke profile ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke slo_smoke serve_smoke serve_loadtest profile ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -140,6 +140,19 @@ profile_smoke:
 
 slo_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.slo_smoke
+
+# Continuous-protection serving smoke (also a fast.yml driver row):
+# prover-gated engine construction, request burst + co-batched
+# injection lanes with zero lane leaks and a live SDC CI, responses
+# byte-identical injection on/off, HTTP front + json_parser rendering.
+serve_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.serve_smoke
+
+# Serving loadtest: closed-loop request waves against a live protected
+# service on CPU (acceptance floor: >=1,000 req/s sustained with the
+# /status SLO block reporting a live Wilson-CI'd SDC rate).
+serve_loadtest:
+	$(CPU_ENV) $(PYTHON) scripts/serve_loadtest.py --out artifacts/serve_loadtest.json
 
 # The campaign attribution report itself: refresh the recorded
 # artifacts/profile_mm.json baseline (on CPU, MFU pinned against the

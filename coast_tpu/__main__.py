@@ -6,6 +6,7 @@ repo's own Makefile) do not need to know the module layout:
     python -m coast_tpu ci ...        # protection-regression CI
     python -m coast_tpu profile ...   # campaign attribution report
     python -m coast_tpu slo ...       # reliability SLO check/report
+    python -m coast_tpu serve ...     # protected inference service
     python -m coast_tpu fleet ...     # campaign fleet (alias)
     python -m coast_tpu analysis ...  # log analysis (alias)
     python -m coast_tpu opt ...       # protect + run one program (alias)
@@ -36,6 +37,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if verb == "slo":
         from coast_tpu.obs.slo_cli import main as slo_main
         return slo_main(rest)
+    if verb == "serve":
+        from coast_tpu.serve.front import main as serve_main
+        return serve_main(rest)
     if verb == "fleet":
         from coast_tpu.fleet.supervisor import main as fleet_main
         return fleet_main(rest)
@@ -46,7 +50,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         from coast_tpu.opt import main as opt_main
         return opt_main(rest)
     print(f"Error, unknown verb {verb!r}; want one of: ci, profile, "
-          "slo, fleet, analysis, opt (see python -m coast_tpu --help)",
+          "slo, serve, fleet, analysis, opt "
+          "(see python -m coast_tpu --help)",
           file=sys.stderr)
     return 2
 

@@ -197,6 +197,12 @@ class Summary:
     # directory aggregates mixing several logs (a budget verdict
     # describes one campaign's evidence, like the Wilson intervals).
     slo: Optional[Dict[str, object]] = None
+    # Serving request-plane block (serve.ServeMetrics.serving_block):
+    # request counts / shed rate / strategy mix / live SDC CI from a
+    # protected-inference-service log.  None for ordinary campaigns and
+    # for directory aggregates mixing several logs (request rates and
+    # the live Wilson CI describe one service's window, like slo).
+    serving: Optional[Dict[str, object]] = None
 
     @property
     def due(self) -> int:
@@ -386,6 +392,44 @@ class Summary:
                        if budget is not None else "")
                     + (f"  burn {burn:.2f}x" if burn is not None else "")
                     + f"  [{row.get('verdict')}]")
+        if self.serving:
+            srv = self.serving
+            reqs = srv.get("requests") or {}
+            rejected = reqs.get("rejected") or {}
+            lines.append("  --- serving ---")
+            lines.append(
+                f"  requests admitted {reqs.get('admitted', 0)}"
+                f"  served {reqs.get('served', 0)}"
+                f"  rejected {sum(rejected.values())}"
+                f"  ({srv.get('req_per_sec', 0.0)} req/s)")
+            mix = srv.get("strategy_mix") or {}
+            if mix:
+                mix_str = "  ".join(f"{k} {v}"
+                                    for k, v in sorted(mix.items()))
+                lines.append(
+                    f"  strategy mix       {mix_str}"
+                    f"  (retries {srv.get('retries', 0)},"
+                    f" escalations {srv.get('escalations', 0)})")
+            shed = srv.get("shed") or {}
+            lines.append(
+                f"  shed               "
+                f"{100.0 * float(shed.get('shed_rate', 0.0)):7.3f}%"
+                f"  ({shed.get('inject_lanes', 0)} inject lanes,"
+                f" {shed.get('saturated_dispatches', 0)} saturated"
+                " dispatches)")
+            leak = srv.get("lane_leak") or {}
+            lines.append(
+                f"  lane leak          {leak.get('violations', 0)}"
+                f" violations / {leak.get('checks', 0)} checks")
+            inj = srv.get("inject") or {}
+            ci = inj.get("sdc_ci") or {}
+            lines.append(
+                f"  live sdc           "
+                f"{100.0 * float(inj.get('sdc_rate', 0.0)):7.4f}%"
+                f" +-{100.0 * float(ci.get('half_width', 0.0)):6.4f}%"
+                f"  [{100.0 * float(ci.get('lo', 0.0)):.4f}%,"
+                f" {100.0 * float(ci.get('hi', 0.0)):.4f}%]"
+                f"  over {inj.get('lanes_done', 0)} injection lanes")
         return "\n".join(lines)
 
 
@@ -481,6 +525,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     profiles: List[Dict[str, object]] = []
     mfus: List[Dict[str, object]] = []
     slos: List[Dict[str, object]] = []
+    servings: List[Dict[str, object]] = []
     for doc in docs:
         head = doc.get("summary") or {}
         if head.get("collect") == "sparse":
@@ -581,6 +626,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             mfus.append(summary["mfu"])
         if summary.get("slo"):
             slos.append(summary["slo"])
+        if summary.get("serving"):
+            servings.append(summary["serving"])
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     # The fault-model axis: absent key == the single-bit legacy model.
@@ -614,7 +661,9 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                                 if len(convergences) == 1 else None),
                    profile=(profiles[0] if len(profiles) == 1 else None),
                    mfu=(mfus[0] if len(mfus) == 1 else None),
-                   slo=(slos[0] if len(slos) == 1 else None))
+                   slo=(slos[0] if len(slos) == 1 else None),
+                   serving=(servings[0]
+                            if len(servings) == 1 else None))
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -659,7 +708,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             convergence=head["summary"].get("convergence") or None,
             profile=head["summary"].get("profile") or None,
             mfu=head["summary"].get("mfu") or None,
-            slo=head["summary"].get("slo") or None)
+            slo=head["summary"].get("slo") or None,
+            serving=head["summary"].get("serving") or None)
     except OSError:
         return None
 

@@ -35,7 +35,37 @@ from coast_tpu.fleet.queue import CampaignQueue
 from coast_tpu.obs.convergence import interval_table
 from coast_tpu.obs.metrics import _esc
 
-__all__ = ["FleetTelemetry"]
+__all__ = ["FleetTelemetry", "merge_histogram"]
+
+#: done-record summary profile key -> the canonical histogram name the
+#: SLO engine's ``p<q>_<alias>`` objectives resolve to (the same
+#: mapping obs/slo.evidence_from_summary applies to one campaign).
+_SUMMARY_HISTS = (("device_seconds_histogram", "dispatch_device_seconds"),
+                  ("host_gap_seconds_histogram",
+                   "dispatch_host_gap_seconds"))
+
+
+def merge_histogram(into: Dict[str, Dict[str, object]], name: str,
+                    snap: Dict[str, object]) -> None:
+    """Sum one histogram snapshot into the fleet accumulator under
+    ``name``.  Snapshots with different bucket bounds are skipped --
+    mixing bounds would corrupt every quantile read off the merge, and
+    all shipped histograms share Histogram.DEFAULT_BOUNDS."""
+    if not snap or not snap.get("count"):
+        return
+    acc = into.get(name)
+    if acc is None:
+        into[name] = {"le": list(snap.get("le") or ()),
+                      "counts": [int(c) for c in snap.get("counts") or ()],
+                      "count": int(snap["count"]),
+                      "sum": float(snap.get("sum", 0.0))}
+        return
+    if list(snap.get("le") or ()) != acc["le"]:
+        return
+    acc["counts"] = [a + int(b) for a, b in
+                     zip(acc["counts"], snap.get("counts") or ())]
+    acc["count"] += int(snap["count"])
+    acc["sum"] += float(snap.get("sum", 0.0))
 
 
 class FleetTelemetry:
@@ -105,6 +135,11 @@ class FleetTelemetry:
         physical = 0
         seconds = 0.0
         cache: Dict[str, int] = {}
+        # Federated dispatch-latency histograms, canonical names: the
+        # evidence the p99_dispatch-style fleet SLOs read.  Done records
+        # carry them under the summary profile keys; live workers'
+        # campaign blocks already use the canonical names.
+        histograms: Dict[str, Dict[str, object]] = {}
         for rec in done:
             result = rec.get("result") or {}
             for k, v in (result.get("counts") or {}).items():
@@ -113,6 +148,11 @@ class FleetTelemetry:
             physical += int(result.get("physical_injections",
                                        result.get("injections", 0)))
             seconds += float(result.get("seconds", 0.0))
+            profile = ((result.get("summary") or {}).get("profile")
+                       or {})
+            for summary_key, canonical in _SUMMARY_HISTS:
+                merge_histogram(histograms, canonical,
+                                profile.get(summary_key) or {})
             event = result.get("cache_event")
             if event:
                 cache[event] = cache.get(event, 0) + 1
@@ -130,6 +170,9 @@ class FleetTelemetry:
                 for k, v in (campaign.get("counts") or {}).items():
                     counts[k] = counts.get(k, 0.0) + float(v)
                 inj_per_sec += float(campaign.get("inj_per_sec", 0.0))
+                for name, snap in ((campaign.get("profile") or {})
+                                   .get("histograms") or {}).items():
+                    merge_histogram(histograms, name, snap)
             for k, v in (doc.get("cache") or {}).items():
                 if k in ("warm_hit", "persistent_hit", "miss"):
                     # Live view of in-flight workers' cache traffic;
@@ -153,13 +196,15 @@ class FleetTelemetry:
             "injections_done": injections, "physical_done": physical,
             "seconds": seconds, "cache": cache,
             "inj_per_sec": inj_per_sec,
+            "histograms": histograms,
         }
 
     def _slo_report(self, agg: Dict[str, object]):
         """Evaluate the configured SLO set against the fleet aggregate:
-        the union of done-record counts and live campaigns is exactly
-        the evidence shape obs/slo.py wants (fleet has no histograms or
-        recent-rate ring, so latency objectives stay unevaluated)."""
+        the union of done-record counts, live campaigns, and the
+        federated dispatch-latency histograms -- so ``p99_dispatch``-
+        style latency objectives get a fleet-scope verdict from the
+        same evidence shape a single campaign's evaluation reads."""
         if self.slo_set is None:
             return None
         from coast_tpu.obs.slo import evaluate
@@ -169,6 +214,7 @@ class FleetTelemetry:
         return evaluate(self.slo_set, {
             "counts": {k: int(v) for k, v in agg["counts"].items()},
             "inj_per_sec": rate,
+            "histograms": agg["histograms"],
         })
 
     # -- hub interface (MetricsServer duck-typing) ---------------------------
@@ -188,6 +234,10 @@ class FleetTelemetry:
             "cache": agg["cache"],
             "updated_unix_s": round(agg["now"], 6),
         }
+        if agg["histograms"]:
+            # Same shape as a campaign snapshot's profile block, so the
+            # evidence readers (and dashboards) share one vocabulary.
+            doc["profile"] = {"histograms": agg["histograms"]}
         report = self._slo_report(agg)
         if report is not None:
             from coast_tpu.obs.slo import summary_block
@@ -249,6 +299,21 @@ class FleetTelemetry:
                [(f'kind="{_esc(k)}"', float(v))
                 for k, v in sorted(agg["cache"].items())]
                or [('kind="miss"', 0.0)])
+        for hname, hist in sorted(agg["histograms"].items()):
+            # Federated dispatch-latency histograms (done records +
+            # live campaigns): the fleet-scope evidence behind the
+            # latency SLO rows below.
+            full = f"coast_fleet_{hname}"
+            lines.append(f"# HELP {full} Federated per-dispatch "
+                         "latency histogram (seconds).")
+            lines.append(f"# TYPE {full} histogram")
+            for bound, cum in zip(hist["le"], hist["counts"]):
+                lines.append(
+                    f'{full}_bucket{{le="{float(bound):g}"}} {cum}')
+            lines.append(
+                f'{full}_bucket{{le="+Inf"}} {hist["count"]}')
+            lines.append(f'{full}_sum {float(hist["sum"]):.17g}')
+            lines.append(f'{full}_count {hist["count"]}')
         report = self._slo_report(agg)
         if report is not None:
             rows = report.get("objectives") or []

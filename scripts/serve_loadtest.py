@@ -1,0 +1,221 @@
+"""Loadtest for the continuous-protection serving engine (PR 18).
+
+Drives closed-loop request waves against a live ``ServeEngine`` on CPU
+while its injection lanes self-measure, and pins the acceptance
+contract:
+
+  * sustained throughput at or above the floor (default 1,000 req/s)
+    with every request answered within its SLA;
+  * the ``/status`` document (scraped over a real ``ServeFront`` HTTP
+    socket) carries the SLO block and a live Wilson-CI'd SDC rate from
+    the injection lanes that ran UNDER the load;
+  * both strategy proofs HOLD, the runtime lane-leak assert saw zero
+    violations, and a sanity subset of requests round-trips over
+    ``POST /v1/infer``;
+  * the differential arm: a short fixed request stream serialises
+    byte-identically with the injection lanes on and off.
+
+Requests are submitted in waves of ``--wave`` concurrent closed loops
+(submit, wait on the completion event, submit again), the shape the
+batched dispatch packs best; ``--threads`` HTTP workers add socket
+traffic on top so the measured service is the real one, not an
+in-process shortcut.
+
+Writes a machine-readable artifact (throughput, serving block, SLO
+verdicts, differential + lane-leak pins) to ``--out``; the committed
+artifact lives at artifacts/serve_loadtest.json.
+
+Usage:  python scripts/serve_loadtest.py [--duration 10] [--wave 256]
+        [--batch-size 128] [--inject-share 0.25] [--floor 1000]
+        [--out artifacts/serve_loadtest.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _closed_loop_wave(engine, wave: int, duration_s: float,
+                      sla_s: float) -> dict:
+    """``wave`` concurrent closed loops for ``duration_s``: each loop
+    submits, parks on the completion event, and submits again.  Returns
+    the wave tally (served / failed / wall seconds)."""
+    stop_at = time.monotonic() + duration_s
+    served = [0] * wave
+    failed = []
+    lock = threading.Lock()
+
+    def loop(slot: int) -> None:
+        i = 0
+        while time.monotonic() < stop_at:
+            req = engine.submit(f"load-{slot}-{i}", sla_s=sla_s)
+            i += 1
+            if not req.done.wait(sla_s + 5.0):
+                with lock:
+                    failed.append((req.rid, "wait_timeout"))
+                return
+            if req.response is None:
+                with lock:
+                    failed.append((req.rid, req.error))
+                continue
+            served[slot] += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=loop, args=(slot,), daemon=True)
+               for slot in range(wave)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 60.0)
+    wall = time.monotonic() - t0
+    return {"served": int(sum(served)), "failed": failed,
+            "wall_s": round(wall, 3)}
+
+
+def _http_sanity(url: str, n: int, sla_s: float) -> int:
+    """Round-trip ``n`` requests over the real socket; returns 200s."""
+    ok = 0
+    for i in range(n):
+        body = json.dumps({"payload": f"http-{i}", "sla_s": sla_s})
+        req = urllib.request.Request(
+            url + "/v1/infer", data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=sla_s + 10.0) as resp:
+            doc = json.loads(resp.read())
+            if resp.status == 200 and doc.get("class") == "success":
+                ok += 1
+    return ok
+
+
+def _differential(bench: str, batch_size: int, n: int) -> bool:
+    """Fixed request stream, injection on vs off: byte-identical?"""
+    from coast_tpu.serve import ServeEngine
+    streams = []
+    for share in (0.5, 0.0):
+        with ServeEngine(bench, batch_size=batch_size,
+                         inject_share=share, seed=7,
+                         inject_n=4 * batch_size) as engine:
+            reqs = [engine.submit(f"diff-{i}", sla_s=60.0)
+                    for i in range(n)]
+            out = []
+            for req in reqs:
+                assert req.done.wait(120.0) and req.response is not None
+                out.append(req.response)
+        streams.append(json.dumps(out, sort_keys=True))
+    return streams[0] == streams[1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--benchmark", default="matrixMultiply")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds of closed-loop load")
+    ap.add_argument("--wave", type=int, default=256,
+                    help="concurrent closed-loop clients")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--inject-share", type=float, default=0.25)
+    ap.add_argument("--sla-s", type=float, default=2.0)
+    ap.add_argument("--floor", type=float, default=1000.0,
+                    help="req/s acceptance floor")
+    ap.add_argument("--slo", default="sdc_rate<=0.9,availability>=0.5;"
+                                     "min=64")
+    ap.add_argument("--http-sanity", type=int, default=16,
+                    help="requests round-tripped over the HTTP socket")
+    ap.add_argument("--out", default="artifacts/serve_loadtest.json")
+    args = ap.parse_args(argv)
+
+    from coast_tpu.serve import ServeEngine, ServeFront, ServeMetrics
+
+    metrics = ServeMetrics(slo=args.slo)
+    engine = ServeEngine(args.benchmark, batch_size=args.batch_size,
+                         inject_share=args.inject_share, seed=7,
+                         inject_n=10_000_000, metrics=metrics)
+    proofs = {s: lane.proof.summary()
+              for s, lane in engine._lanes.items()}
+    for s, p in proofs.items():
+        print(f"# prover {s}: "
+              f"{'HOLDS' if p.get('holds') else 'REFUTED'}")
+    assert all(p.get("holds") for p in proofs.values()), proofs
+
+    with ServeFront(engine, port=0) as front:
+        print(f"# loadtest: {args.wave} closed loops x "
+              f"{args.duration:g}s on {front.url} "
+              f"(batch={args.batch_size}, "
+              f"inject_share={args.inject_share})", flush=True)
+        wave = _closed_loop_wave(engine, args.wave, args.duration,
+                                 args.sla_s)
+        http_ok = _http_sanity(front.url, args.http_sanity, args.sla_s)
+        with urllib.request.urlopen(front.url + "/status",
+                                    timeout=10.0) as resp:
+            status = json.loads(resp.read())
+    doc = engine.summary()
+
+    rps = wave["served"] / wave["wall_s"] if wave["wall_s"] else 0.0
+    srv = status["serving"]
+    inj = srv["inject"]
+    print(f"# {wave['served']} served in {wave['wall_s']:.2f}s = "
+          f"{rps:,.0f} req/s ({len(wave['failed'])} failed, "
+          f"{http_ok}/{args.http_sanity} http ok)")
+    print(f"# live sdc over {inj['lanes_done']} injection lanes: "
+          f"{inj['sdc_rate']:.6g} "
+          f"[{inj['sdc_ci']['lo']:.6g}, {inj['sdc_ci']['hi']:.6g}]")
+    if "slo" in status:
+        print(f"# slo verdict: {status['slo'].get('verdict')}")
+
+    print("# differential arm: inject on/off ...", flush=True)
+    identical = _differential(args.benchmark, args.batch_size, 32)
+
+    checks = {
+        "throughput_floor": rps >= args.floor,
+        "zero_failed": not wave["failed"],
+        "http_sanity": http_ok == args.http_sanity,
+        "status_has_slo": "slo" in status,
+        "status_live_sdc_ci": (inj["lanes_done"] > 0
+                               and inj["sdc_ci"]["hi"] > 0.0),
+        "proofs_hold": all(p.get("holds") for p in proofs.values()),
+        "zero_lane_leak": srv["lane_leak"]["violations"] == 0,
+        "byte_identical_inject_on_off": identical,
+    }
+    artifact = {
+        "format": "coast-serve-loadtest",
+        "benchmark": doc["benchmark"],
+        "config": {"duration_s": args.duration, "wave": args.wave,
+                   "batch_size": args.batch_size,
+                   "inject_share": args.inject_share,
+                   "sla_s": args.sla_s, "floor_rps": args.floor},
+        "throughput": {"served": wave["served"],
+                       "wall_s": wave["wall_s"],
+                       "req_per_sec": round(rps, 1),
+                       "failed": len(wave["failed"]),
+                       "http_ok": http_ok},
+        "proofs": proofs,
+        "status": status,
+        "checks": checks,
+        "summary": {"serving": doc["serving"], "counts": doc["counts"],
+                    **({"slo": doc["slo"]} if "slo" in doc else {})},
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(artifact, fh, indent=1, sort_keys=True)
+        print(f"# artifact -> {args.out}")
+
+    bad = [k for k, v in checks.items() if not v]
+    if bad:
+        print(f"FAILED checks: {bad}")
+        return 1
+    print(f"PASS: {rps:,.0f} req/s >= {args.floor:g} floor, proofs "
+          "HOLD, zero lane leaks, byte-identical on/off")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

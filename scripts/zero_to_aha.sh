@@ -80,3 +80,22 @@ if [ -f artifacts/ci_baseline.json ]; then
     python -m coast_tpu ci check --baseline artifacts/ci_baseline.json \
         || echo "ci check exited $? (1=drift, 2=infra; see docs/ci.md)"
 fi
+
+# 6. continuous protection: serve the protected program for a few
+# seconds while its injection lanes self-measure the SDC rate the
+# campaign above estimated offline (docs/serving.md).
+echo "== 6. protected serving (self-measuring, 5s bounded run) =="
+python -m coast_tpu serve "$SRC" --port 0 --batch-size 32 \
+    --inject-n 256 --duration 5 \
+    --slo 'sdc_rate<=0.9;min=32' \
+    | tail -1 | python -c '
+import json, sys
+doc = json.loads(sys.stdin.read())
+srv = doc["serving"]["inject"]
+print("serve: proofs",
+      {k: v["holds"] for k, v in doc["proofs"].items()},
+      "| live sdc %.4g [%.4g, %.4g] over %d lanes"
+      % (srv["sdc_rate"], srv["sdc_ci"]["lo"], srv["sdc_ci"]["hi"],
+         srv["lanes_done"]),
+      "| slo", doc.get("slo", {}).get("verdict"))
+'

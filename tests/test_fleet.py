@@ -437,3 +437,39 @@ def test_fleet_cli_run_refuses_empty_queue(tmp_path):
     qroot = str(tmp_path / "q")
     CampaignQueue(qroot)
     assert main(["run", "--queue", qroot, "--workers", "1"]) == 1
+
+
+def test_fleet_latency_slo_from_federated_histograms(tmp_path):
+    """Fleet-scope latency SLOs: p99_dispatch evaluates against the
+    dispatch-latency histograms federated out of done records, and the
+    merged histograms export as coast_fleet_* Prometheus series."""
+    from coast_tpu.obs.metrics import Histogram
+    q = CampaignQueue(str(tmp_path / "q"))
+    for k, seconds in ((0, 0.001), (1, 0.002)):
+        item_id = q.enqueue(_mm_spec(n=50, seed=k))
+        item = q.claim("w0", lease_s=60.0)
+        assert item is not None and item.id == item_id
+        hist = Histogram()
+        for _ in range(10):
+            hist.observe(seconds)
+        q.complete(item.id, "w0", {
+            "benchmark": "matrixMultiply", "strategy": "TMR",
+            "injections": 50, "seconds": 0.5,
+            "counts": {"success": 45, "sdc": 5},
+            "codes_sha256": "0" * 64, "worker": "w0",
+            "summary": {"profile": {
+                "device_seconds_histogram": hist.snapshot(),
+                "host_gap_seconds_histogram": hist.snapshot(),
+            }},
+        })
+    tele = FleetTelemetry(q, slo="p99_dispatch<=30;min=8")
+    snap = tele.snapshot()
+    hists = snap["profile"]["histograms"]
+    # Two done records' histograms merged: 20 dispatch observations.
+    assert hists["dispatch_device_seconds"]["count"] == 20
+    row = snap["slo"]["objectives"]["p99_dispatch"]
+    assert row["attained"] is True and row["verdict"] == "ok", row
+    prom = tele.prometheus()
+    assert "coast_fleet_dispatch_device_seconds_bucket" in prom
+    assert ('coast_fleet_slo_verdict{objective="p99_dispatch"} 0'
+            in prom), prom[-800:]

@@ -1,0 +1,241 @@
+"""Continuous-protection serving tests (ISSUE 18).
+
+The admission edge cases the smoke driver's happy path does not pin: a
+deadline-expired request is rejected (never silently served late), a
+saturated batch sheds the injection share to zero but never request
+rows, a DWC detection retries when the rerun fits the SLA and escalates
+to TMR when it does not, and a SIGKILL'd serving process resumes its
+standing injection journal bit-for-bit.  Plus the prover construction
+gate and the fleet-facing pieces (queue-backed injection items,
+serving summary shape).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from coast_tpu.serve import (AdmissionQueue, IsolationRefusedError,
+                             ServeEngine, ServeMetrics, ServeRequest)
+from coast_tpu.serve.admission import REJECT_DEADLINE, REJECT_SLA
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = "matrixMultiply"
+
+
+def _engine(**kw):
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("inject_share", 0.5)
+    kw.setdefault("inject_n", 64)
+    kw.setdefault("seed", 5)
+    return ServeEngine(BENCH, **kw)
+
+
+def _serve_all(engine, reqs, timeout_s=60.0):
+    for req in reqs:
+        assert req.done.wait(timeout_s), f"request {req.rid} hung"
+    return reqs
+
+
+# -- admission edge cases ----------------------------------------------------
+
+def test_deadline_expired_request_is_rejected():
+    """A request whose SLA elapsed before dispatch is rejected with
+    deadline_expired, not served late."""
+    with _engine(inject_share=0.0, inject_n=0) as engine:
+        req = engine.submit("too-late", sla_s=1e-9)
+        assert req.done.wait(30.0)
+        assert req.response is None
+        assert req.error == REJECT_DEADLINE
+        assert engine.metrics.rejected.get(REJECT_DEADLINE, 0) == 1
+        ok = engine.submit("in-time", sla_s=30.0)
+        assert ok.done.wait(60.0) and ok.response is not None
+        assert ok.response["class"] == "success"
+
+
+def test_saturation_sheds_injection_to_zero_never_requests():
+    """Request pressure beyond the batch evicts the injection share
+    entirely (saturated dispatches) while every request is served."""
+    with _engine(batch_size=8, inject_n=1_000_000) as engine:
+        reqs = [engine.submit(f"sat-{i}", sla_s=60.0)
+                for i in range(64)]
+        _serve_all(engine, reqs, timeout_s=120.0)
+        m = engine.metrics
+        assert all(r.response is not None for r in reqs), \
+            [(r.rid, r.error) for r in reqs if r.response is None]
+        assert m.served == 64
+        assert m.shed_inject_lanes > 0, "nothing shed under saturation"
+        assert m.saturated_dispatches > 0, \
+            "injection share never shed to zero"
+        assert m.lane_leak_violations == 0
+
+
+def test_dwc_detection_retries_when_rerun_fits_sla():
+    """detect_hook forces the DWC detect-and-retry path once; the
+    retried request is then served under its original strategy."""
+    seen = set()
+    with _engine() as engine:
+        def hook(req, code):
+            if req.rid in seen:
+                return False
+            seen.add(req.rid)
+            return True
+        engine.detect_hook = hook
+        req = engine.submit("flaky", sla_s=60.0, strategy="DWC")
+        assert req.done.wait(60.0) and req.response is not None
+        assert req.response["strategy"] == "DWC"
+        assert req.retries == 1
+        assert engine.metrics.retries == 1
+        assert engine.metrics.escalations == 0
+
+
+def test_dwc_detection_escalates_to_tmr_when_retry_blows_sla():
+    """With a retry that cannot fit the SLA (huge retry_factor), a DWC
+    detection escalates the request to the TMR lane instead."""
+    with _engine(retry_factor=1e6) as engine:
+        engine.detect_hook = lambda req, code: True
+        req = engine.submit("hot", sla_s=30.0, strategy="DWC")
+        assert req.done.wait(60.0) and req.response is not None, req.error
+        assert req.response["strategy"] == "TMR"
+        assert req.escalated and req.retries == 0
+        assert engine.metrics.escalations == 1
+        # The strategy mix counts the FINAL strategy.
+        assert engine.metrics.strategy_mix.get("TMR", 0) == 1
+
+
+def test_detection_rejects_when_nothing_fits():
+    """No rerun fits, no single attempt fits -> sla_exceeded, and the
+    rejection is an explicit error, not a silent wrong answer."""
+    with _engine(retry_factor=1e6, strategies=("DWC",)) as engine:
+        engine.detect_hook = lambda req, code: True
+        # est_s needs one dispatch to exist; the default pre-dispatch
+        # estimate is 0.05s, so a 1 ms budget fits neither path.
+        req = engine.submit("doomed", sla_s=0.2, strategy="DWC")
+        assert req.done.wait(60.0)
+        assert req.response is None
+        assert req.error in (REJECT_SLA, REJECT_DEADLINE)
+
+
+# -- admission queue unit behavior -------------------------------------------
+
+def test_admission_queue_orders_by_deadline():
+    q = AdmissionQueue(("DWC",))
+    now = time.monotonic()
+    reqs = [ServeRequest(rid=i, payload=str(i), sla_s=s,
+                         deadline=now + s, t_submit=now, strategy="DWC")
+            for i, s in ((1, 30.0), (2, 10.0), (3, 20.0))]
+    for r in reqs:
+        q.submit(r)
+    admitted, expired = q.take("DWC", 8, now)
+    assert not expired
+    assert [r.rid for r in admitted] == [2, 3, 1]
+
+
+def test_admission_queue_requeue_keeps_original_deadline():
+    """A retry re-enters with its ORIGINAL deadline: the SLA is a
+    promise about the submission, not the attempt."""
+    q = AdmissionQueue(("DWC",))
+    now = time.monotonic()
+    req = ServeRequest(rid=1, payload="x", sla_s=5.0, deadline=now + 5.0,
+                       t_submit=now, strategy="DWC")
+    q.submit(req)
+    (got,), _ = q.take("DWC", 1, now)
+    q.requeue(got)
+    # Past the original deadline the requeued request comes back
+    # EXPIRED -- the retry did not buy it a fresh SLA window.
+    admitted, expired = q.take("DWC", 1, now + 10.0)
+    assert admitted == []
+    assert [r.rid for r in expired] == [1]
+
+
+# -- construction gate -------------------------------------------------------
+
+def test_prover_refusal_gates_construction():
+    from coast_tpu.analysis.propagation import seeded_voter_bypass
+    with pytest.raises(IsolationRefusedError, match="REFUTED"):
+        with seeded_voter_bypass():
+            ServeEngine(BENCH, batch_size=16, inject_share=0.0,
+                        inject_n=0, strategies=("TMR",))
+
+
+def test_bad_inject_share_rejected():
+    with pytest.raises(ValueError, match="inject_share"):
+        ServeEngine(BENCH, inject_share=1.5)
+
+
+# -- crash-safe standing journal ---------------------------------------------
+
+@pytest.mark.parametrize("kill", [True])
+def test_sigkilled_server_resumes_journal_bit_for_bit(tmp_path, kill):
+    """SIGKILL a serving process mid-injection; a new engine over the
+    same journal dir resumes and the concatenated injection class codes
+    are bit-for-bit identical to an uninterrupted run."""
+    inject_n, batch, seed = 2048, 16, 5
+    jdir = str(tmp_path / "journals")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "coast_tpu", "serve", BENCH,
+         "--port", "0", "--batch-size", str(batch),
+         "--inject-share", "0.5", "--seed", str(seed),
+         "--inject-n", str(inject_n), "--journal-dir", jdir,
+         "--idle-throttle", "0.01", "--duration", "300"],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    path = os.path.join(jdir, "serve-DWC.journal")
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if os.path.exists(path) and sum(
+                    1 for _ in open(path, "rb")) >= 2:
+                break                   # header + at least one batch
+            if proc.poll() is not None:
+                raise AssertionError("serve process died before "
+                                     "journaling")
+            time.sleep(0.05)
+        else:
+            raise AssertionError("standing journal never appeared")
+    finally:
+        proc.kill() if kill else proc.terminate()
+        proc.wait(30)
+
+    def codes_after_full_run(journal_dir):
+        with ServeEngine(BENCH, batch_size=batch, inject_share=0.5,
+                         seed=seed, inject_n=inject_n,
+                         journal_dir=journal_dir) as engine:
+            assert engine.drain_injection(timeout_s=300.0), engine.error
+            return {s: engine.lane_codes(s)
+                    for s in ("DWC", "TMR")}
+
+    resumed = codes_after_full_run(jdir)
+    fresh = codes_after_full_run(str(tmp_path / "fresh"))
+    for strategy in ("DWC", "TMR"):
+        assert len(resumed[strategy]) == inject_n, \
+            (strategy, len(resumed[strategy]))
+        np.testing.assert_array_equal(resumed[strategy],
+                                      fresh[strategy])
+
+
+# -- artifact shape ----------------------------------------------------------
+
+def test_summary_carries_proofs_counts_and_serving_block():
+    metrics = ServeMetrics(slo="sdc_rate<=0.9;min=8")
+    with _engine(metrics=metrics) as engine:
+        req = engine.submit("one", sla_s=60.0)
+        assert req.done.wait(60.0) and req.response is not None
+        assert engine.drain_injection(timeout_s=120.0), engine.error
+        doc = engine.summary()
+    assert doc["benchmark"] and doc["strategies"] == ["DWC", "TMR"]
+    assert all(p["holds"] for p in doc["proofs"].values())
+    assert sum(doc["counts"].values()) == 2 * 64
+    srv = doc["serving"]
+    assert srv["requests"]["served"] == 1
+    assert srv["inject"]["lanes_done"] == 2 * 64
+    assert 0.0 <= srv["inject"]["sdc_ci"]["lo"] \
+        <= srv["inject"]["sdc_ci"]["hi"] <= 1.0
+    assert doc["slo"]["verdict"] == "ok"
