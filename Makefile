@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke profile_smoke slo_smoke serve_smoke serve_loadtest profile ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke stencil_smoke profile_smoke slo_smoke serve_smoke serve_loadtest profile ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -131,6 +131,13 @@ sparse_smoke:
 # static-budget delta allocator.
 propagation_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.propagation_smoke
+
+# Sharded halo-exchange stencil smoke (also a fast.yml driver row):
+# 2-shard campaign parity under both voter placements, the link fault
+# model's containment duality, and the walker's cross-shard reach
+# closure against measured truth.
+stencil_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.stencil_smoke
 
 # Campaign-profiler smoke (also a fast.yml driver row): attribution
 # sums to wall clock, outputs unchanged by profiling, profile verb +

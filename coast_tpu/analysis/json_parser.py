@@ -203,6 +203,12 @@ class Summary:
     # for directory aggregates mixing several logs (request rates and
     # the live Wilson CI describe one service's window, like slo).
     serving: Optional[Dict[str, object]] = None
+    # Sharded-campaign accounting (ShardedCampaignRunner): the mesh
+    # geometry the campaign ran on and each shard's interesting-row
+    # count.  None for single-device logs and for directory aggregates
+    # mixing several logs (a per-shard ledger describes one campaign's
+    # batch split, like the convergence intervals).
+    mesh: Optional[Dict[str, object]] = None
 
     @property
     def due(self) -> int:
@@ -392,6 +398,20 @@ class Summary:
                        if budget is not None else "")
                     + (f"  burn {burn:.2f}x" if burn is not None else "")
                     + f"  [{row.get('verdict')}]")
+        if self.mesh:
+            mesh = self.mesh
+            axes = mesh.get("axes") or {}
+            axes_str = " x ".join(f"{k}={v}" for k, v in axes.items()) \
+                or "?"
+            lines.append("  --- mesh ---")
+            lines.append(f"  {mesh.get('devices', '?')} devices"
+                         f"  ({axes_str})")
+            ledger = mesh.get("per_shard_interesting")
+            if ledger is not None:
+                total = sum(int(v) for v in ledger) or 1
+                lines.append("  interesting rows per shard: " + "  ".join(
+                    f"[{i}] {int(v)} ({100.0 * int(v) / total:5.1f}%)"
+                    for i, v in enumerate(ledger)))
         if self.serving:
             srv = self.serving
             reqs = srv.get("requests") or {}
@@ -526,6 +546,7 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
     mfus: List[Dict[str, object]] = []
     slos: List[Dict[str, object]] = []
     servings: List[Dict[str, object]] = []
+    meshes: List[Dict[str, object]] = []
     for doc in docs:
         head = doc.get("summary") or {}
         if head.get("collect") == "sparse":
@@ -628,6 +649,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
             slos.append(summary["slo"])
         if summary.get("serving"):
             servings.append(summary["serving"])
+        if summary.get("mesh"):
+            meshes.append(summary["mesh"])
     if overlaps:
         stages["overlap"] = round(sum(overlaps) / len(overlaps), 4)
     # The fault-model axis: absent key == the single-bit legacy model.
@@ -663,7 +686,8 @@ def summarize_runs(name: str, docs: Iterable[Dict[str, object]]) -> Summary:
                    mfu=(mfus[0] if len(mfus) == 1 else None),
                    slo=(slos[0] if len(slos) == 1 else None),
                    serving=(servings[0]
-                            if len(servings) == 1 else None))
+                            if len(servings) == 1 else None),
+                   mesh=(meshes[0] if len(meshes) == 1 else None))
 
 
 def _summarize_ndjson_native(path: str) -> Optional[Summary]:
@@ -709,7 +733,8 @@ def _summarize_ndjson_native(path: str) -> Optional[Summary]:
             profile=head["summary"].get("profile") or None,
             mfu=head["summary"].get("mfu") or None,
             slo=head["summary"].get("slo") or None,
-            serving=head["summary"].get("serving") or None)
+            serving=head["summary"].get("serving") or None,
+            mesh=head["summary"].get("mesh") or None)
     except OSError:
         return None
 

@@ -109,6 +109,17 @@ class VulnerabilityMap:
     live_fraction: float
     rows: Dict[str, List[VulnRow]]       # section -> bit-class rows
     fallback_reason: Optional[str] = None
+    #: Cross-shard influence reach (sharded regions only -- present iff
+    #: the region's ``meta['shard_of']`` names a shard per section):
+    #: leaf -> {reach, shards_reached, cross_shard}.  The transitive
+    #: closure of :attr:`StepFacts.out_taint` over steps: which leaves a
+    #: surviving corruption can eventually change.  Under
+    #: vote-then-exchange a grid leaf's influence dies at the halo's
+    #: pack-commit vote (``cross_shard`` false: blast radius one shard);
+    #: under exchange-then-vote it ships raw through the unvoted commit
+    #: and reaches the neighbor shard (``cross_shard`` true) -- the
+    #: static prediction the stencil campaign pins against measurement.
+    shard_reach: Optional[Dict[str, Dict[str, object]]] = None
 
     def section_verdicts(self) -> Dict[str, str]:
         """Worst verdict per section (the CI budget unit)."""
@@ -150,6 +161,8 @@ class VulnerabilityMap:
                if self.fallback_reason else {}),
             "verdict_counts": self.counts(),
             "ace": self.ace_summary(),
+            **({"shard_reach": self.shard_reach}
+               if self.shard_reach is not None else {}),
             "sections": {
                 name: {"verdict": self.section_verdicts()[name],
                        "kind": rows[0].kind if rows else "?",
@@ -172,6 +185,12 @@ class VulnerabilityMap:
                 if r.verdict == VERDICT_SDC and r.witness:
                     lines.append(f"      {r.bit_class}: "
                                  + " -> ".join(r.witness))
+        if self.shard_reach:
+            crossers = sorted(n for n, d in self.shard_reach.items()
+                              if d.get("cross_shard"))
+            lines.append("  cross-shard reach: "
+                         + (", ".join(crossers) if crossers
+                            else "none (blast radius bounded per shard)"))
         c = self.counts()
         lines.append(f"  verdicts: {c[VERDICT_SDC]} sdc-possible, "
                      f"{c[VERDICT_DETECTED]} detected-bounded, "
@@ -192,6 +211,46 @@ def _bit_classes(dtype) -> Sequence[str]:
     except Exception:       # noqa: BLE001 - unknown dtype: one word class
         pass
     return _WORD_CLASSES
+
+
+def _shard_reach(facts: StepFacts, shard_of: Mapping[str, Optional[int]]
+                 ) -> Dict[str, Dict[str, object]]:
+    """Transitive closure of the per-step influence edges, attributed to
+    shards.  ``reach[leaf]`` is every leaf whose committed value a
+    surviving corruption of ``leaf`` can eventually change (over any
+    number of steps); ``cross_shard`` is True when that set includes a
+    section owned by a DIFFERENT shard than the source's own."""
+    names = set(facts.out_taint)
+    for srcs in facts.out_taint.values():
+        names |= srcs
+    adj: Dict[str, set] = {n: set() for n in names}
+    for dst, srcs in facts.out_taint.items():
+        for src in srcs:
+            adj.setdefault(src, set()).add(dst)
+    reach = {n: set(dsts) for n, dsts in adj.items()}
+    changed = True
+    while changed:
+        changed = False
+        for n in reach:
+            step = set()
+            for m in reach[n]:
+                step |= reach.get(m, set())
+            if not step <= reach[n]:
+                reach[n] |= step
+                changed = True
+    out: Dict[str, Dict[str, object]] = {}
+    for name in sorted(reach):
+        own = shard_of.get(name)
+        shards = sorted({shard_of.get(d) for d in reach[name]
+                         if shard_of.get(d) is not None})
+        doc: Dict[str, object] = {
+            "reach": sorted(reach[name]),
+            "shards_reached": shards,
+        }
+        if own is not None:
+            doc["cross_shard"] = any(s != own for s in shards)
+        out[name] = doc
+    return out
 
 
 def analyze_propagation(prog, closed=None, facts: Optional[StepFacts] = None,
@@ -322,6 +381,7 @@ def analyze_propagation(prog, closed=None, facts: Optional[StepFacts] = None,
                 reason=note, witness=witness, bits=bits, ace_bits=ace))
         rows[name] = section_rows
 
+    shard_of = (getattr(region, "meta", None) or {}).get("shard_of")
     return VulnerabilityMap(
         benchmark=region.name,
         num_clones=facts.num_clones,
@@ -330,7 +390,9 @@ def analyze_propagation(prog, closed=None, facts: Optional[StepFacts] = None,
         live_fraction=live_fraction,
         rows=rows,
         fallback_reason=(TRAIN_FALLBACK if facts.train_fallback
-                         else None))
+                         else None),
+        shard_reach=(_shard_reach(facts, shard_of)
+                     if shard_of is not None else None))
 
 
 def crossvalidate_counts(vmap: VulnerabilityMap,

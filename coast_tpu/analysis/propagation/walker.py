@@ -57,6 +57,11 @@ _STRUCTURAL_PRIMS = frozenset({
     "select_n", "dynamic_update_slice", "dynamic_slice", "slice",
     "reshape", "transpose", "broadcast_in_dim", "squeeze", "concatenate",
     "rev", "copy", "gather", "scatter", "pad", "stop_gradient",
+    # Cross-device permutation collectives move words verbatim between
+    # shards: a flipped word on the wire arrives flipped, never
+    # transformed.  Listing them keeps the walk honest across shard_map
+    # boundaries (the sharded stencil's halo exchange).
+    "ppermute", "pshuffle",
 })
 
 _VALUE_OPERANDS = {
@@ -114,10 +119,18 @@ class _TaintWalk:
     inputs).
     """
 
-    def __init__(self, live: Optional[Set[int]]):
+    def __init__(self, live: Optional[Set[int]],
+                 shared_surviving: Optional[FrozenSet[str]] = None):
         self.env: Dict[object, FrozenSet[str]] = {}
         self.value_fed: Set[str] = set()
         self.live = live
+        # Leaves whose corruption SURVIVES a sanctioned vote: a shared
+        # single-copy leaf (the stencil's link-kind halo) corrupts every
+        # replica identically, so lanes agree on the corrupted value and
+        # a detector tag passes it instead of killing it.  None keeps
+        # the historical kill-at-detector semantics (the equivalence
+        # partition's fingerprints depend on them bit-for-bit).
+        self.shared_surviving = shared_surviving
 
     def val(self, v) -> FrozenSet[str]:
         from jax.extend.core import Literal
@@ -156,6 +169,8 @@ class _TaintWalk:
         if prim == "name":
             tag = str(params.get("name", ""))
             if _detector_tag(tag):
+                if self.shared_surviving is not None and ins:
+                    return [ins[0] & self.shared_surviving]
                 return [frozenset()]
             if tag.startswith(TAG_SPOF):
                 # Single-lane call boundary: the callee sees raw lane-0
@@ -233,6 +248,67 @@ class _TaintWalk:
         # value-fed, outputs carry no verbatim words.
         self._feed(eqn, union)
         return [frozenset() for _ in eqn.outvars]
+
+
+class _InfluenceWalk(_TaintWalk):
+    """Value-influence closure over one protected step: which leaves'
+    OUTPUT values a corrupted leaf can change at all.
+
+    Where the base walk tracks verbatim words (dying at arithmetic),
+    this walk tracks influence: every primitive's outputs inherit the
+    union of their operands' influence -- an added, voted-over, or
+    majority-merged corrupted operand still corrupts the result.
+    Sanctioned detector tags still kill influence (the vote repairs a
+    single-lane divergence) EXCEPT for ``shared_surviving`` leaves,
+    whose corruption is lane-homogeneous and sails through any vote.
+    Single-lane call boundaries (``TAG_SPOF``) pass influence: the
+    callee computes from the raw lane-0 value.
+
+    The per-step leaf->leaf edges this walk yields (``StepFacts.
+    out_taint``) are the raw material of the vulnerability map's
+    cross-shard reach closure: under vote-then-exchange a grid leaf's
+    influence dies at the halo's pack-commit vote (blast radius one
+    shard), under exchange-then-vote it ships raw and reaches the
+    neighbor -- the static prediction the stencil campaigns pin against
+    measured truth."""
+
+    def _eqn_outs(self, eqn, ins):
+        prim = eqn.primitive.name
+        params = eqn.params
+        union = frozenset().union(*ins) if ins else frozenset()
+        if prim == "name" and str(params.get("name", "")).startswith(
+                TAG_SPOF):
+            return [union]
+        if (prim == "name" or prim == "optimization_barrier"
+                or (prim == "cond" and "branches" in params)
+                or prim in ("while", "scan")):
+            # Tags (detector kill / passthrough) and loop joins keep the
+            # base semantics; recursion re-enters this override.
+            return super()._eqn_outs(eqn, ins)
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in params:
+                # Nested calls walk in a FRESH env: jax reuses one traced
+                # jaxpr object across same-shape call sites, and because
+                # the env is keyed by var identity a shared env would
+                # leak the first call site's influence into the second
+                # (observed: golden0 "influencing" golden1 through a
+                # shared broadcast pjit).  Influence propagates through
+                # everything, so the leak is not masked downstream the
+                # way verbatim taint is -- isolate the call instead.
+                sub = params[key]
+                sub = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                fresh = _InfluenceWalk(self.live, self.shared_surviving)
+                fresh.seed(sub.invars, ins)
+                return fresh.walk(sub)
+        # Everything else -- structural moves AND arithmetic -- taints
+        # every output with every operand (steering operands included:
+        # a corrupted predicate or index changes the result too).
+        return [union for _ in eqn.outvars]
+
+    def _feed(self, eqn, taint: FrozenSet[str]) -> None:
+        # Influence is not consumption: value_fed verdicts stay owned by
+        # the base walk.
+        pass
 
 
 #: Witness paths are display artifacts, not proofs: cap their length so
@@ -346,6 +422,13 @@ class StepFacts:
     fn_unsafe: bool
     train_fallback: bool
     num_clones: int
+    #: Per-step influence edges: output leaf -> the leaves whose
+    #: surviving corruption can change its committed value this step
+    #: (:class:`_InfluenceWalk`; votes kill replicated-leaf influence,
+    #: shared single-copy leaves survive them).  The vulnerability map
+    #: closes these transitively into cross-shard reach.
+    out_taint: Dict[str, FrozenSet[str]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def jaxpr(self):
@@ -392,6 +475,18 @@ def analyze_step(prog, closed=None, track_paths: bool = True) -> StepFacts:
     for var, t in zip(jaxpr.invars, taints):
         taint._set(var, t)
     taint.walk(jaxpr)
+
+    # -- per-step influence edges (cross-shard reach raw material) --------
+    shared_names = frozenset(
+        name for name in state_names if not prog.replicated.get(name))
+    infl = _InfluenceWalk(live, shared_surviving=shared_names)
+    for var, t in zip(jaxpr.invars, taints):
+        infl._set(var, t)
+    infl_outs = infl.walk(jaxpr)
+    out_taint = {
+        name: infl_outs[i]
+        for i, name in enumerate(state_names + flag_names)
+        if i < len(infl_outs)}
 
     # -- per-leaf facts ---------------------------------------------------
     out_names = state_names + flag_names
@@ -451,4 +546,5 @@ def analyze_step(prog, closed=None, track_paths: bool = True) -> StepFacts:
         consumed=consumed, written=written, lane_flagged=lane_flagged,
         check_reads=check_reads, check_walker=check_walker,
         check_closed=check_closed, guards=guards, cfcss=cfcss,
-        fn_unsafe=fn_unsafe, train_fallback=train_fallback, num_clones=n)
+        fn_unsafe=fn_unsafe, train_fallback=train_fallback, num_clones=n,
+        out_taint=out_taint)

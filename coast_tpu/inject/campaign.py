@@ -118,6 +118,13 @@ class CampaignResult:
     # error-budget remaining, burn rate, worst verdict.  None otherwise,
     # so unconfigured summaries stay byte-identical.
     slo: Optional[Dict[str, object]] = None
+    # Sharded-backend accounting (ShardedCampaignRunner): the mesh
+    # geometry (device count, axis names/sizes) plus the per-shard
+    # interesting-row counts this process collected -- which physical
+    # shard's runs produced the non-success outcomes.  None on the
+    # single-device runner, so every existing summary stays
+    # byte-identical.
+    mesh: Optional[Dict[str, object]] = None
 
     @property
     def injections_per_sec(self) -> float:
@@ -207,6 +214,12 @@ class CampaignResult:
             out["convergence"] = dict(self.convergence)
         if self.slo is not None:
             out["slo"] = dict(self.slo)
+        if self.mesh is not None:
+            # Sharded campaigns only (absent-means-single-device, so
+            # every single-device summary stays byte-identical): the
+            # mesh geometry and which shard's runs produced the
+            # interesting outcomes.
+            out["mesh"] = dict(self.mesh)
         if self.chunks is not None:
             out["chunks"] = self.chunks
         if self.resilience:
@@ -515,6 +528,23 @@ class CampaignRunner:
         self.metrics = metrics
         self.fault_model = fault_model if fault_model is not None \
             else FaultModel()
+        region_meta = getattr(prog.region, "meta", None) or {}
+        # Voter placement of a sharded region (the stencil's factory
+        # knob): campaign identity, journaled absent-means-compute.
+        self.placement = str(region_meta.get("placement", "compute"))
+        if (self.fault_model.kind == "link"
+                and self.fault_model.t_period == 0
+                and self.fault_model.t_offset == 0
+                and region_meta.get("link_window")):
+            # A bare "link" model against a region that declares its
+            # in-flight window (meta["link_window"] = (offset, period))
+            # upgrades to the windowed model: flips land only at steps
+            # where the halo words are actually on the wire.  Explicit
+            # offsets/periods are respected; regions without the meta
+            # key keep the all-steps bare model.
+            off, per = region_meta["link_window"]
+            self.fault_model = FaultModel.link(offset=int(off),
+                                               period=int(per))
         if collect not in ("dense", "sparse"):
             raise ValueError(
                 f"unknown collect mode {collect!r}; one of 'dense', "
@@ -572,6 +602,26 @@ class CampaignRunner:
 
         self._run_one = run_one
         self._run_batch = jax.jit(jax.vmap(run_one))
+
+    # -- overridable per-shard accounting hooks (no-ops here; the
+    # sharded backend attributes each collected batch's interesting rows
+    # to the physical shard that ran them) ----------------------------------
+    def _ledger_reset(self) -> None:
+        """Start-of-run_schedule reset of the per-shard ledger."""
+
+    def _ledger_rows(self, rows: np.ndarray, per: int) -> None:
+        """Attribute one sparse batch's BATCH-LOCAL interesting rows
+        (shard of row r = r // per under the sharded batch split)."""
+
+    def _ledger_dense(self, out: Dict[str, np.ndarray],
+                      batch_size: int) -> None:
+        """Attribute one dense batch's interesting rows by position."""
+
+    def _mesh_block(self) -> Optional[Dict[str, object]]:
+        """The result's ``mesh`` accounting block; None on the
+        single-device runner (absent-means-single-device keeps every
+        existing summary byte-identical)."""
+        return None
 
     # -- overridable batching hooks (ShardedCampaignRunner replaces these) --
     def _round_batch(self, batch_size: int) -> int:
@@ -788,6 +838,7 @@ class CampaignRunner:
             code = np.asarray(full["code"])
             valid = np.arange(len(code)) < n_part
             rows = np.flatnonzero(valid & (code > cls.CORRECTED))
+            self._ledger_rows(rows.astype(np.int64), per)
             return {"hist": hist, "rows": rows.astype(np.int64),
                     "code": code[rows].astype(np.int32),
                     "errors": np.asarray(full["errors"])[rows],
@@ -823,6 +874,7 @@ class CampaignRunner:
         else:
             out = {"rows": np.zeros(0, np.int64),
                    **{k: np.zeros(0, np.int32) for k in col_parts}}
+        self._ledger_rows(out["rows"], per)
         out["hist"] = hist
         return out
 
@@ -892,6 +944,7 @@ class CampaignRunner:
         # site (advisor, supervisor) where a single smaller compile beats
         # padding waste.
         batch_size = self._round_batch(batch_size)
+        self._ledger_reset()
         if journal is not None:
             # Model = campaign identity, wherever the schedule came from:
             # an externally-generated multi-site schedule journaled under
@@ -948,6 +1001,18 @@ class CampaignRunner:
                     f"{header_mode!r} but this runner collects "
                     f"{self.collect!r}; rerun with the original "
                     "--collect (or a fresh journal)")
+            # Voter placement = campaign identity too (absent-means-
+            # compute): the two placements are different programs, so a
+            # journal written under one must never seed the other.
+            from coast_tpu.inject.journal import PlacementMismatchError
+            from coast_tpu.inject.spec import header_placement
+            header_place = header_placement(journal.header)
+            if header_place != self.placement:
+                raise PlacementMismatchError(
+                    f"journal {journal.path!r} records voter placement "
+                    f"{header_place!r} but this runner's region is built "
+                    f"{self.placement!r}; rerun with the original "
+                    "--placement (or a fresh journal)")
         retry = self.retry
         metrics = self.metrics
         tracker = None
@@ -1177,6 +1242,7 @@ class CampaignRunner:
                                        out)
             else:
                 out = {k: v[:n_part] for k, v in got.items()}
+                self._ledger_dense(out, batch_size)
                 counts_so_far = _account(out, done)
                 done += n_part
                 if journal is not None:
@@ -1534,6 +1600,7 @@ class CampaignRunner:
             transfer={"up": int(transfer["up"]),
                       "down": int(transfer["down"])},
             profile=profile,
+            mesh=self._mesh_block(),
         )
         if tracker is not None:
             res.convergence = tracker.report(
@@ -1569,7 +1636,8 @@ class CampaignRunner:
             equiv=self.equiv_partition is not None,
             stop_when=(stop_when.spec() if stop_when is not None
                        else None),
-            collect=self.collect)
+            collect=self.collect,
+            placement=self.placement)
 
     def _journal_header(self, mode: str, **fields) -> Dict[str, object]:
         """The identity block every journal header shares: resuming under
@@ -1586,6 +1654,12 @@ class CampaignRunner:
             # Absent-means-dense: every journal written before sparse
             # collection existed keeps resuming unchanged.
             header["collect"] = self.collect
+        if self.placement != "compute":
+            # Absent-means-compute (the registry build): pre-placement
+            # journals keep resuming unchanged; an exchange-then-vote
+            # journal refuses a vote-then-exchange resume with the
+            # typed PlacementMismatchError.
+            header["placement"] = self.placement
         if self.equiv_partition is not None:
             # Partition = campaign identity (the reduced rows are only
             # meaningful under it); per-section fingerprints are the

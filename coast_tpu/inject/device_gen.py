@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from coast_tpu.inject.mem import MemoryMap
-from coast_tpu.inject.schedule import FaultModel
+from coast_tpu.inject.schedule import FaultModel, link_steps
 from coast_tpu.native import FAULT_EXPAND_SALT
 
 __all__ = ["DeviceGenError", "DeviceScheduleGen"]
@@ -162,6 +162,50 @@ class DeviceScheduleGen:
         self._leaf = jnp.asarray(sec_leaf.astype(np.int32))
         self._lanes = jnp.asarray(sec_lanes.astype(np.uint32))
         self._words = jnp.asarray(sec_words.astype(np.uint32))
+        if self.model.kind == "link":
+            # Restricted draw tables: site draws map onto the union of the
+            # link-kind sections' bits (the in-flight halo words), exactly
+            # mirroring schedule._generate_link's host mapping.
+            link_idx = [i for i, s in enumerate(mmap.sections)
+                        if s.kind == "link"]
+            if not link_idx:
+                raise DeviceGenError(
+                    "fault model 'link' has no link-kind sections to "
+                    "regenerate draws for on this benchmark")
+            sizes = np.array([mmap.sections[i].bits for i in link_idx],
+                             np.int64)
+            ledges = np.cumsum(sizes)
+            self.link_total = int(ledges[-1])
+            self._link_edges = jnp.asarray(ledges.astype(np.uint32))
+            self._link_local_starts = jnp.asarray(
+                (ledges - sizes).astype(np.uint32))
+            self._link_global_starts = jnp.asarray(
+                starts[link_idx].astype(np.uint32))
+            self._link_k = link_steps(self.model, self.steps)
+        else:
+            # The complement restriction: when the map exposes link-kind
+            # sections they are the link model's EXCLUSIVE surface, so
+            # every other model's base-site draw maps onto the non-link
+            # sections' bits (schedule._nonlink_sites).  draw_total is
+            # None on maps without link sections: the base draw is then
+            # plain `site % total_bits` (the pinned historical stream).
+            nl_idx = [i for i, s in enumerate(mmap.sections)
+                      if s.kind != "link"]
+            self.draw_total = None
+            if len(nl_idx) != len(mmap.sections):
+                if not nl_idx:
+                    raise DeviceGenError(
+                        "every injectable section is link-kind: non-link "
+                        "fault models have no surface to regenerate")
+                sizes = np.array([mmap.sections[i].bits for i in nl_idx],
+                                 np.int64)
+                dedges = np.cumsum(sizes)
+                self.draw_total = int(dedges[-1])
+                self._draw_edges = jnp.asarray(dedges.astype(np.uint32))
+                self._draw_local_starts = jnp.asarray(
+                    (dedges - sizes).astype(np.uint32))
+                self._draw_global_starts = jnp.asarray(
+                    starts[nl_idx].astype(np.uint32))
 
     # -- decode (MemoryMap.decode, on device) --------------------------------
     def _decode(self, flat: jax.Array):
@@ -191,10 +235,36 @@ class DeviceScheduleGen:
         zero = jnp.zeros_like(rows)
         c_site = (zero, rows + _u32(1))
         c_t = _add64(c_site, (jnp.uint32(0), stream_n.astype(jnp.uint32)))
-        flat = _mod64(_splitmix64(seed, c_site), self.total_bits)
+        model = self.model
+        if model.kind == "link":
+            # Same raw stream positions as the generic path, restricted
+            # draw mapping: site modulo the link sections' bit total then
+            # relocated into the global flat space; t modulo the receive
+            # window then mapped to offset + draw*period.
+            local = _mod64(_splitmix64(seed, c_site), self.link_total)
+            lsec = jnp.searchsorted(self._link_edges, local, side="right")
+            flat = (self._link_global_starts[lsec]
+                    + (local - self._link_local_starts[lsec]))
+            leaf, lane, word, bit, _sec = self._decode(flat)
+            draw = _mod64(_splitmix64(seed, c_t), self._link_k)
+            if model.t_period > 0:
+                t = (_u32(model.t_offset)
+                     + draw * _u32(model.t_period)).astype(jnp.int32)
+            else:
+                t = draw.astype(jnp.int32)
+            return {"leaf_id": leaf, "lane": lane, "word": word,
+                    "bit": bit, "t": t}
+        if self.draw_total is not None:
+            # Non-link base draw on a map WITH link sections: modulo the
+            # non-link bit total, relocated into the global flat space.
+            local = _mod64(_splitmix64(seed, c_site), self.draw_total)
+            dsec = jnp.searchsorted(self._draw_edges, local, side="right")
+            flat = (self._draw_global_starts[dsec]
+                    + (local - self._draw_local_starts[dsec]))
+        else:
+            flat = _mod64(_splitmix64(seed, c_site), self.total_bits)
         leaf, lane, word, bit, sec = self._decode(flat)
         t = _mod64(_splitmix64(seed, c_t), self.steps).astype(jnp.int32)
-        model = self.model
         if model.kind == "single" or model.sites == 1:
             return {"leaf_id": leaf, "lane": lane, "word": word,
                     "bit": bit, "t": t}

@@ -76,7 +76,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from coast_tpu.inject.spec import header_fault_model
+from coast_tpu.inject.spec import header_fault_model, header_placement
 from coast_tpu.obs import flightrec
 
 try:
@@ -86,7 +86,8 @@ except ImportError:                     # pragma: no cover - non-POSIX
 
 __all__ = [
     "JournalError", "JournalExistsError", "JournalMismatchError",
-    "FaultModelMismatchError", "JournalLockedError", "CampaignJournal",
+    "FaultModelMismatchError", "PlacementMismatchError",
+    "JournalLockedError", "CampaignJournal",
     "schedule_fingerprint", "config_fingerprint",
 ]
 
@@ -121,6 +122,17 @@ class FaultModelMismatchError(JournalMismatchError):
     model change also changes the schedule fingerprint, and "schedule-sha
     mismatch" would bury the actual cause -- the operator changed what an
     injection *is*, not the seed."""
+
+
+class PlacementMismatchError(JournalMismatchError):
+    """The journal records a different VOTER PLACEMENT than the resuming
+    campaign.  Same burying argument as the fault model: the placement
+    changes the region itself (halo leaf shape, memory map, schedule and
+    config fingerprints), and the generic diff would report those
+    derived symptoms instead of the knob the operator flipped.  Absent
+    header key == ``"compute"`` (the registry build; pre-placement
+    journals resume unchanged -- the rule lives in
+    :func:`coast_tpu.inject.spec.header_placement`)."""
 
 
 def schedule_fingerprint(sched) -> str:
@@ -316,6 +328,16 @@ class CampaignJournal:
                 f"this campaign runs {expect_model!r}; a resumed campaign "
                 "must replay the recorded flip groups exactly.  Rerun with "
                 "the original --fault-model, or start a fresh journal.")
+        found_place = header_placement(found)
+        expect_place = header_placement(expect)
+        if found_place != expect_place:
+            raise PlacementMismatchError(
+                f"journal {path!r} records voter placement "
+                f"{found_place!r} but this campaign runs "
+                f"{expect_place!r}; the two placements are different "
+                "programs (different halo leaf, different blast radius). "
+                "Rerun with the original --placement, or start a fresh "
+                "journal.")
         keys = (set(found) | set(expect)) - _VOLATILE_KEYS
         diffs = [k for k in sorted(keys) if found.get(k) != expect.get(k)]
         if diffs:

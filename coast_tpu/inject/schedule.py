@@ -61,6 +61,26 @@ class FaultModel:
       ``round(window * rate)`` sites (min 1), each at a fresh uniform
       location, fired at ``t0 + U[0, window)`` (clamped to the nominal
       window).
+    * ``link(offset,period)`` -- an interconnect upset: one bit, but the
+      draw is restricted to the program's ``link``-kind sections (the
+      in-flight halo/exchange buffers of a sharded region,
+      ir/region.KIND_LINK) and, when ``period > 0``, the flip step is
+      restricted to the receive window ``offset + i*period`` -- the
+      steps where the buffer's words are "on the wire" between a
+      permute send and its receive (a flip outside the window would
+      land on a buffer the next pack overwrites, i.e. a compute-side
+      upset, not a link upset).  Defaults to the region's own
+      ``meta['link_window']`` when the caller passes none.
+
+    The link-kind sections are the ``link`` model's EXCLUSIVE surface:
+    when a benchmark exposes them, every other model's base-site draw
+    maps onto the complement (the compute/memory sections), so the
+    per-model outcome tables partition the fault surface instead of
+    double-counting in-flight words as memory upsets.  Benchmarks
+    without link sections are bit-identical to the historical stream.
+    (One asymmetry, by construction: ``burst`` EXTRA sites come from
+    ``native.fault_expand``'s full-map uniform draw, whose native/numpy
+    parity is pinned -- only base sites are restricted.)
 
     The classifier taxonomy is deliberately untouched by the model: a
     multi-site injection is still one run with one outcome code.
@@ -80,9 +100,15 @@ class FaultModel:
     span: int = 1         # max word offset of a cluster site
     window: int = 1       # burst time window (steps)
     rate: float = 1.0     # burst flips per step within the window
+    # link only: receive-window arithmetic (t = offset + i*period).
+    # (0, 0) means "no window": uniform over the nominal runtime, or the
+    # region's declared meta['link_window'] when generate() is handed one.
+    t_offset: int = 0
+    t_period: int = 0
 
     def __post_init__(self):
-        if self.kind not in ("single", "multibit", "cluster", "burst"):
+        if self.kind not in ("single", "multibit", "cluster", "burst",
+                             "link"):
             raise ValueError(f"unknown fault-model kind {self.kind!r}")
         if self.kind == "multibit" and not (2 <= self.k <= 32):
             raise ValueError("multibit needs 2 <= k <= 32 (distinct bits "
@@ -91,6 +117,16 @@ class FaultModel:
             raise ValueError("cluster needs k >= 2 sites and span >= 1")
         if self.kind == "burst" and (self.window < 1 or self.rate <= 0):
             raise ValueError("burst needs window >= 1 and rate > 0")
+        if self.kind == "link":
+            if self.t_offset < 0 or self.t_period < 0:
+                raise ValueError("link needs offset >= 0 and period >= 0")
+            if self.t_period == 0 and self.t_offset != 0:
+                raise ValueError(
+                    "link offset without a period is meaningless (the "
+                    "window is offset + i*period); pass period too")
+        elif self.t_offset or self.t_period:
+            raise ValueError(
+                f"offset/period are link-model arguments, not {self.kind!r}")
 
     # -- constructors --------------------------------------------------------
     @classmethod
@@ -108,6 +144,10 @@ class FaultModel:
     @classmethod
     def burst(cls, window: int = 8, rate: float = 0.25) -> "FaultModel":
         return cls(kind="burst", window=int(window), rate=float(rate))
+
+    @classmethod
+    def link(cls, offset: int = 0, period: int = 0) -> "FaultModel":
+        return cls(kind="link", t_offset=int(offset), t_period=int(period))
 
     # -- identity ------------------------------------------------------------
     @property
@@ -127,6 +167,10 @@ class FaultModel:
             return f"cluster(span={self.span},k={self.k})"
         if self.kind == "burst":
             return f"burst(window={self.window},rate={self.rate:g})"
+        if self.kind == "link":
+            if self.t_period:
+                return f"link(offset={self.t_offset},period={self.t_period})"
+            return "link"
         return "single"
 
     @classmethod
@@ -159,6 +203,9 @@ class FaultModel:
             if kind == "burst":
                 return cls.burst(window=int(args.pop("window", 8)),
                                  rate=args.pop("rate", 0.25), **args)
+            if kind == "link":
+                return cls.link(offset=int(args.pop("offset", 0)),
+                                period=int(args.pop("period", 0)), **args)
         except TypeError as e:
             raise ValueError(f"bad fault-model arguments in {text!r}: {e}")
         raise ValueError(f"unknown fault-model kind {kind!r} in {text!r}")
@@ -283,6 +330,37 @@ def _expand(mmap: MemoryMap, sched: FaultSchedule, model: FaultModel,
     return sched
 
 
+def _draw_tables(mmap: MemoryMap, link: bool):
+    """Site-draw remapping tables for the sections with (link=True) or
+    without (link=False) ``kind == 'link'``: per-section bit sizes, local
+    cumulative edges, and each section's global flat-bit start."""
+    idx = [i for i, s in enumerate(mmap.sections)
+           if (s.kind == "link") == link]
+    sizes = np.array([mmap.sections[i].bits for i in idx], np.int64)
+    local_edges = np.cumsum(sizes)
+    all_edges = np.cumsum([s.bits for s in mmap.sections]).astype(np.int64)
+    global_starts = np.array(
+        [all_edges[i] - mmap.sections[i].bits for i in idx], np.int64)
+    return sizes, local_edges, global_starts
+
+
+def _nonlink_sites(mmap: MemoryMap, raws: np.ndarray) -> np.ndarray:
+    """Base-site draws for every non-link fault model: uniform over the
+    non-link sections' bits, relocated into the global flat space.  With
+    no link sections in the map this is exactly ``raws % total_bits``
+    (the pinned historical stream, byte for byte)."""
+    if not any(s.kind == "link" for s in mmap.sections):
+        return (raws % np.uint64(mmap.total_bits)).astype(np.int64)
+    sizes, local_edges, global_starts = _draw_tables(mmap, link=False)
+    if not len(sizes):
+        raise ValueError(
+            "every injectable section is link-kind: non-link fault "
+            "models have no compute/memory surface to draw from")
+    local = (raws % np.uint64(int(local_edges[-1]))).astype(np.int64)
+    li = np.searchsorted(local_edges, local, side="right")
+    return global_starts[li] + (local - (local_edges[li] - sizes[li]))
+
+
 def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
              model: Optional[FaultModel] = None,
              equiv: "Optional[object]" = None) -> FaultSchedule:
@@ -304,7 +382,18 @@ def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
     flip-group outcomes are not site-equivalence-reasoned."""
     with obs.span("schedule", n=n, seed=seed):
         raw = splitmix_fill(seed, 2 * n)      # uint64 stream, native or numpy
-        flat_bits = (raw[:n] % np.uint64(mmap.total_bits)).astype(np.int64)
+        if model is not None and model.kind == "link":
+            if equiv is not None:
+                raise ValueError(
+                    "equiv= reduction is defined for the single-bit "
+                    f"fault model, not {model.spec()!r}: link draws are "
+                    "restricted to the interconnect sections and their "
+                    "receive window, which the site-equivalence partition "
+                    "does not reason about")
+            with obs.span("schedule_link", model=model.spec()):
+                return _generate_link(mmap, raw, n, seed, nominal_steps,
+                                      model)
+        flat_bits = _nonlink_sites(mmap, raw[:n])
         t = (raw[n:] % np.uint64(max(nominal_steps, 1))).astype(np.int32)
         leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
         sched = FaultSchedule(leaf_id, lane, word, bit, t,
@@ -329,6 +418,49 @@ def generate(mmap: MemoryMap, n: int, seed: int, nominal_steps: int,
         return sched
 
 
+def link_steps(model: FaultModel, nominal_steps: int) -> int:
+    """Receive-window size of a link model: how many distinct steps its t
+    column can take inside the nominal runtime.  Shared by the host
+    generator and the device regeneration path so the two cannot drift."""
+    steps = max(nominal_steps, 1)
+    if model.t_period <= 0:
+        return steps
+    k = len(range(model.t_offset, steps, model.t_period))
+    if k < 1:
+        raise ValueError(
+            f"link window offset={model.t_offset} starts past the nominal "
+            f"runtime ({steps} steps): no receive step to flip at")
+    return k
+
+
+def _generate_link(mmap: MemoryMap, raw: np.ndarray, n: int, seed: int,
+                   nominal_steps: int, model: FaultModel) -> FaultSchedule:
+    """Link-model draws: the same raw splitmix stream as ``generate``,
+    but site draws map onto the union of link-kind sections' bits (the
+    in-flight halo words) and the t draw maps into the receive window."""
+    sizes, local_edges, global_starts = _draw_tables(mmap, link=True)
+    if not len(sizes):
+        raise ValueError(
+            "fault model 'link' needs at least one link-kind section "
+            "(ir/region.KIND_LINK leaf) in the injectable map; this "
+            "benchmark exposes none -- it has no interconnect surface")
+    local = (raw[:n] % np.uint64(int(local_edges[-1]))).astype(np.int64)
+    li = np.searchsorted(local_edges, local, side="right")
+    flat_bits = global_starts[li] + (local - (local_edges[li] - sizes[li]))
+
+    k = link_steps(model, nominal_steps)
+    draws = (raw[n:] % np.uint64(k)).astype(np.int64)
+    if model.t_period > 0:
+        t = (model.t_offset + draws * model.t_period).astype(np.int32)
+    else:
+        t = draws.astype(np.int32)
+
+    leaf_id, lane, word, bit, sec_idx = mmap.decode(flat_bits)
+    return FaultSchedule(leaf_id, lane, word, bit, t,
+                         sec_idx.astype(np.int32), seed, model=model,
+                         gen_stream_n=n, gen_steps=max(nominal_steps, 1))
+
+
 def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
                         nominal_steps: int,
                         model: Optional[FaultModel] = None) -> FaultSchedule:
@@ -351,6 +483,11 @@ def generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
     as in ``generate`` (the expansion is keyed by the master seed)."""
     with obs.span("schedule", n_per_section=n_per_section, seed=seed,
                   stratified=True):
+        if model is not None and model.kind == "link":
+            raise ValueError(
+                "stratified allocation contradicts the 'link' fault model: "
+                "link draws target ONLY the link-kind sections (use "
+                "generate() with the link model instead)")
         sched = _generate_stratified(mmap, n_per_section, seed,
                                      nominal_steps)
         if model is None or model.kind == "single":
@@ -366,6 +503,13 @@ def _generate_stratified(mmap: MemoryMap, n_per_section: int, seed: int,
     flat_parts = []
     t_parts = []
     for idx, sec in enumerate(mmap.sections):
+        if sec.kind == "link":
+            # The link-kind sections belong to the 'link' model (which
+            # stratified refuses above); drawing memory-model strata into
+            # them would double-count the interconnect surface.  Keys stay
+            # indexed by global section position so the other strata's
+            # sub-streams are unchanged by the skip.
+            continue
         raw = splitmix_fill(int(keys[idx]), 2 * n_per_section)
         offs = (raw[:n_per_section] % np.uint64(sec.bits)).astype(np.int64)
         t_parts.append((raw[n_per_section:]
@@ -394,15 +538,16 @@ def generate_stratified_total(mmap: MemoryMap, total: int, seed: int,
     campaign than requested -- that deviation is surfaced, not silent:
     >10% drift from ``total`` emits a one-line warning and an obs
     counter (``stratified_budget_drift_rows``)."""
-    n_per = max(1, total // len(mmap.sections))
-    realized = n_per * len(mmap.sections)
+    n_sections = sum(1 for s in mmap.sections if s.kind != "link")
+    n_per = max(1, total // max(n_sections, 1))
+    realized = n_per * n_sections
     if total > 0 and abs(realized - total) > 0.10 * total:
         import sys
         obs.count("stratified_budget_drift_rows", abs(realized - total),
                   requested=int(total), realized=int(realized),
-                  sections=len(mmap.sections))
+                  sections=n_sections)
         print(f"warning: stratified budget {total} realized as {realized} "
-              f"rows ({len(mmap.sections)} sections x {n_per}/section, "
+              f"rows ({n_sections} sections x {n_per}/section, "
               f"{100.0 * abs(realized - total) / total:.0f}% off the "
               "requested budget)", file=sys.stderr)
     return generate_stratified(mmap, n_per, seed, nominal_steps, model=model)

@@ -44,7 +44,8 @@ import dataclasses
 from typing import Dict, Optional
 
 __all__ = ["CampaignSpec", "SpecError", "FAULT_MODEL_DEFAULT",
-           "COLLECT_DEFAULT", "header_collect"]
+           "COLLECT_DEFAULT", "PLACEMENT_DEFAULT", "header_collect",
+           "header_placement"]
 
 #: The journal-evolution default: an absent ``fault_model`` key means
 #: the historical single-bit flip (journals and queue items written
@@ -54,6 +55,13 @@ FAULT_MODEL_DEFAULT = "single"
 #: Same evolution rule for the collection mode: an absent ``collect``
 #: key means the historical dense per-row fetch.
 COLLECT_DEFAULT = "dense"
+
+#: Same evolution rule for the voter placement (sharded benchmarks'
+#: factory knob): an absent ``placement`` key means the registry build
+#: -- vote-then-exchange (``"compute"``).  Journals and queue items
+#: written before the knob existed stay byte-identical and still
+#: open/resume.
+PLACEMENT_DEFAULT = "compute"
 
 
 class SpecError(ValueError):
@@ -109,6 +117,16 @@ class CampaignSpec:
         ``delta_from`` (it shapes HOW the delta spends budget, not what
         the result means); joins the item dict only when set, so every
         pre-existing item stays byte-identical.
+    ``placement``
+        Voter placement of a sharded benchmark (the stencil's
+        ``make_region(placement=...)`` knob): ``"compute"`` (default;
+        vote-then-exchange -- the registry build) or ``"link"``
+        (exchange-then-vote).  Campaign identity: the two placements are
+        DIFFERENT programs (different halo leaf shape, different blast
+        radius), so resuming one under the other must refuse with a
+        typed error naming the knob.  Absent-means-compute everywhere,
+        so every pre-placement journal and queue item stays
+        byte-identical.
     ``collect``
         Result-collection mode: ``"dense"`` (default; every row's
         outcome columns cross the host boundary, the historical
@@ -136,6 +154,7 @@ class CampaignSpec:
     delta_from: Optional[str] = None
     collect: str = COLLECT_DEFAULT
     static_budget: bool = False
+    placement: str = PLACEMENT_DEFAULT
 
     # -- validation ----------------------------------------------------------
     def validate(self) -> "CampaignSpec":
@@ -164,6 +183,11 @@ class CampaignSpec:
             raise SpecError(
                 f"unknown collect mode {self.collect!r}; one of "
                 "'dense', 'sparse'")
+        if self.placement not in ("compute", "link"):
+            raise SpecError(
+                f"unknown voter placement {self.placement!r}; one of "
+                "'compute' (vote-then-exchange), 'link' "
+                "(exchange-then-vote)")
         if self.delta_from and self.collect != COLLECT_DEFAULT:
             raise SpecError(
                 "delta_from campaigns are dense by construction (the "
@@ -221,6 +245,9 @@ class CampaignSpec:
             # the item dict, so every pre-sparse item stays byte-
             # identical.
             doc["collect"] = str(self.collect)
+        if self.placement != PLACEMENT_DEFAULT:
+            # Joins only when non-default (same byte-identity argument).
+            doc["placement"] = str(self.placement)
         return doc
 
     @classmethod
@@ -245,6 +272,7 @@ class CampaignSpec:
             collect=str(spec.get("collect", COLLECT_DEFAULT)
                         or COLLECT_DEFAULT),
             static_budget=bool(spec.get("static_budget", False)),
+            placement=header_placement(spec),
         )
 
     # -- journal-header encoding (inject/journal.py) -------------------------
@@ -283,6 +311,7 @@ class CampaignSpec:
             equiv=bool(header.get("equiv")),
             stop_when=header.get("stop_when") or None,
             collect=header_collect(header),
+            placement=header_placement(header),
         )
 
     # -- delta identity (analysis/equiv/delta.py) ----------------------------
@@ -293,9 +322,17 @@ class CampaignSpec:
         ``strategy`` are header-level facts outside the spec; the
         protection config is deliberately absent -- the config changing
         is the whole point of a delta.)"""
-        return {"benchmark": str(self.benchmark), "seed": int(self.seed),
-                "n": int(self.n), "start_num": int(self.start_num),
-                "fault_model": str(self.fault_model)}
+        doc = {"benchmark": str(self.benchmark), "seed": int(self.seed),
+               "n": int(self.n), "start_num": int(self.start_num),
+               "fault_model": str(self.fault_model)}
+        if self.placement != PLACEMENT_DEFAULT:
+            # A placement change is a different REGION (different leaf
+            # shapes, different blast radius), not just a different
+            # protection config: spliced outcomes would be meaningless.
+            # Only-when-set keeps every pre-placement identity dict --
+            # and its comparisons -- byte-identical.
+            doc["placement"] = str(self.placement)
+        return doc
 
 
 def header_fault_model(header: Dict[str, object]) -> str:
@@ -309,3 +346,12 @@ def header_collect(header: Dict[str, object]) -> str:
     """The collection-mode evolution rule, spelled once: an absent
     ``collect`` header key means the historical dense per-row fetch."""
     return str(header.get("collect", COLLECT_DEFAULT) or COLLECT_DEFAULT)
+
+
+def header_placement(header: Dict[str, object]) -> str:
+    """The voter-placement evolution rule, spelled once: an absent
+    ``placement`` key means the registry build -- vote-then-exchange
+    (``"compute"``).  Pre-placement journals and queue items decode (and
+    resume) unchanged."""
+    return str(header.get("placement", PLACEMENT_DEFAULT)
+               or PLACEMENT_DEFAULT)

@@ -33,12 +33,19 @@ CACHE_SECTIONS = ("cache", "icache", "dcache", "l2cache")
 
 SECTION_CHOICES = ["stack", "text", "rodata", "data", "bss", "heap", "init",
                    "registers", "memory", "params", "opt_state",
-                   *CACHE_SECTIONS]
+                   "interconnect", *CACHE_SECTIONS]
 
 from coast_tpu.inject.hierarchy import DCACHE_KINDS, ICACHE_KINDS
 
 _KIND_SECTIONS = {
-    "memory": DCACHE_KINDS,
+    # "memory" includes the link-kind in-flight buffers so the 'link'
+    # fault model works under the default section choice; non-link
+    # models never draw into them (schedule._nonlink_sites), so the
+    # addition changes nothing on benchmarks without a link surface.
+    "memory": (*DCACHE_KINDS, "link"),
+    # The sharded halo-exchange surface alone (ir/region.KIND_LINK):
+    # the natural section for --fault-model link campaigns.
+    "interconnect": ("link",),
     "data": ("mem",),
     "bss": ("mem",),
     "heap": ("mem",),
@@ -115,11 +122,29 @@ def parse_command_line(argv: Optional[List[str]] = None):
                         "the historical one-bit flip), 'multibit(k=K)' "
                         "(K distinct bits of one word), 'cluster(span=S,"
                         "k=K)' (K flips in adjacent words, lane-crossing), "
-                        "or 'burst(window=W,rate=R)' (round(W*R) upsets "
-                        "inside a W-step window).  Colon form works too "
-                        "(multibit:k=3).  Recorded in the log summary and "
-                        "the journal header; resume under a different "
-                        "model is refused with a typed error")
+                        "'burst(window=W,rate=R)' (round(W*R) upsets "
+                        "inside a W-step window), or 'link' / "
+                        "'link(offset=O,period=P)' (one bit in the "
+                        "in-flight interconnect buffers of a sharded "
+                        "region, fired inside the send->receive window; "
+                        "bare 'link' takes the region's own window).  "
+                        "Colon form works too (multibit:k=3).  Recorded "
+                        "in the log summary and the journal header; "
+                        "resume under a different model is refused with "
+                        "a typed error")
+    parser.add_argument("--placement", type=str, default="compute",
+                        choices=["compute", "link"],
+                        help="voter placement of a sharded halo-exchange "
+                        "benchmark (e.g. stencil): 'compute' votes "
+                        "BEFORE the exchange (a compute flip's blast "
+                        "radius is bounded to its own shard; corruption "
+                        "on the link itself is the blind spot), 'link' "
+                        "votes AFTER it (link corruption is repaired by "
+                        "the receiver's majority; the pre-exchange pack "
+                        "is a single point of failure).  Placement is "
+                        "campaign identity: it joins the journal header "
+                        "and resume under the other placement is "
+                        "refused with a typed error")
     parser.add_argument("--equiv", action="store_true",
                         help="fault-site equivalence reduction "
                         "(analysis/equiv): statically partition the "
@@ -350,6 +375,13 @@ def parse_command_line(argv: Optional[List[str]] = None):
                   "paths (-t/-e/--stratified), not --forceBreak or cache "
                   "sections", file=sys.stderr)
             sys.exit(-1)
+        if args.fault_model_parsed.kind == "link" and args.stratified:
+            # Mirror schedule.generate_stratified's refusal at the CLI
+            # boundary: link draws target ONLY the link-kind sections.
+            print("Error, --stratified contradicts --fault-model link "
+                  "(link draws target only the interconnect sections; "
+                  "use the seeded -t path)", file=sys.stderr)
+            sys.exit(-1)
     else:
         args.fault_model_parsed = None
     if args.stream_logs and (args.no_logging or args.errorCount
@@ -450,7 +482,7 @@ def parse_command_line(argv: Optional[List[str]] = None):
     return args
 
 
-def build_program(bench: str, opt_passes: str):
+def build_program(bench: str, opt_passes: str, placement: str = "compute"):
     """Build the protected program from an opt-CLI flag string, using the
     opt parser itself so flag semantics (and error behavior on typos)
     cannot drift from `python -m coast_tpu.opt`."""
@@ -464,9 +496,20 @@ def build_program(bench: str, opt_passes: str):
     from coast_tpu.frontend import LiftError
     from coast_tpu.models import resolve_region
     try:
-        region = resolve_region(bench)
+        # Only sharded halo-exchange benchmarks take the voter-placement
+        # knob; threading the default through every other factory would
+        # turn "no such knob" into a silent no-op instead of an error.
+        if placement != "compute":
+            region = resolve_region(bench, placement=placement)
+        else:
+            region = resolve_region(bench)
     except (FileNotFoundError, KeyError):
         print(f"Error, file {bench} does not exist!", file=sys.stderr)
+        sys.exit(-1)
+    except TypeError:
+        print(f"Error, benchmark {bench} has no --placement knob (voter "
+              "placement applies to sharded halo-exchange regions, e.g. "
+              "stencil)", file=sys.stderr)
         sys.exit(-1)
     except LiftError as e:
         print(f"ERROR: {e}", file=sys.stderr)
@@ -525,7 +568,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     from coast_tpu.inject.hierarchy import (MemHierarchy,
                                             generate_cache_schedule)
 
-    prog, strategy = build_program(args.filename, args.opt_passes)
+    prog, strategy = build_program(args.filename, args.opt_passes,
+                                   placement=args.placement)
     retry = None
     if args.max_retries > 0 or args.collect_timeout:
         from coast_tpu.inject.resilience import RetryPolicy

@@ -62,9 +62,17 @@ KIND_STACK = "stack"
 # 'opt_state' sync classes the lint re-derives independently.
 KIND_PARAM = "param"
 KIND_OPT_STATE = "opt_state"
+# Sharded regions (coast_tpu.models.stencil): the in-flight halo/exchange
+# buffer of a cross-chip collective -- the words that sit "on the link"
+# between a ppermute send and its receive.  Memory semantics for the
+# engine (a shared single-copy leaf), but its own section kind so the
+# ``link`` fault model (inject/schedule.py) can target exactly the
+# interconnect surface, and campaign attribution separates compute
+# upsets from link upsets.
+KIND_LINK = "link"
 
 _VALID_KINDS = (KIND_MEM, KIND_REG, KIND_CTRL, KIND_RO, KIND_STACK,
-                KIND_PARAM, KIND_OPT_STATE)
+                KIND_PARAM, KIND_OPT_STATE, KIND_LINK)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +96,16 @@ class LeafSpec:
     # ProtectionConfig.protect_stack is set these leaves are voted every
     # step regardless of the per-kind sync flags.
     stack: bool = False
+    # Shared (non-xMR) leaves only: declare that writes to this leaf
+    # deliberately do NOT get the engine's SoR-crossing vote -- the region
+    # carries per-replica data through the shared leaf itself (e.g. a
+    # replicated halo buffer exchanged over the link under the
+    # exchange-then-vote placement, where voting happens on the RECEIVE
+    # side after the collective).  The engine commits ``out[0]`` raw; the
+    # replication linter exempts the leaf from expecting a 'sor_crossing'
+    # vote, and the lane-isolation prover honestly reports the collapse.
+    # Setting this on a replicated leaf is a build error.
+    unvoted_crossing: bool = False
     # KIND_STACK leaves only: the flat word index (within each lane) of the
     # canary/watermark word guarding the stack -- the FreeRTOS
     # tskSTACK_FILL_BYTE pattern at the stack limit that

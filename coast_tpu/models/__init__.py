@@ -9,10 +9,10 @@ from coast_tpu.ir.region import Region
 
 
 def _lazy(modname: str, fn: str = "make_region") -> Callable[[], Region]:
-    def make() -> Region:
+    def make(**kw) -> Region:
         import importlib
         mod = importlib.import_module(f"coast_tpu.models.{modname}")
-        return getattr(mod, fn)()
+        return getattr(mod, fn)(**kw)
     make.modname = modname
     return make
 
@@ -42,14 +42,23 @@ def c_source_paths(arg: str):
     return paths
 
 
-def resolve_region(arg: str) -> Region:
+def resolve_region(arg: str, **kw) -> Region:
     """One program-argument resolver for the CLIs (opt and supervisor take
     the program by registry name or by .c source path -- the reference's
     tools take the program by FILE).  Raises FileNotFoundError for a
     missing .c path, KeyError for an unknown registry name, LiftError for
-    an out-of-subset source."""
+    an out-of-subset source.
+
+    ``**kw`` forwards factory knobs to registry builders that take them
+    (e.g. the stencil's ``placement``); a builder without the knob raises
+    TypeError, which the CLIs surface as "this benchmark has no such
+    knob".  C-source paths accept no factory kwargs."""
     import os
     if arg.endswith(".c"):
+        if kw:
+            raise TypeError(
+                f"factory arguments {sorted(kw)} do not apply to "
+                "C-source programs")
         paths = c_source_paths(arg)
         from coast_tpu.frontend import lift_c
         # Single-TU programs name after the file; multi-TU programs
@@ -62,7 +71,7 @@ def resolve_region(arg: str) -> Region:
                 os.path.abspath(paths[0]))) or "program"
         return lift_c(name, paths)
     if arg in REGISTRY:
-        return REGISTRY[arg]()
+        return REGISTRY[arg](**kw)
     raise KeyError(arg)
 
 
@@ -146,6 +155,14 @@ REGISTRY: Dict[str, Callable[[], Region]] = {
     # train_sdc).  Recorded campaign: artifacts/train_campaign.json.
     "train_mlp": _train_lazy("sgd"),
     "train_mlp_adam": _train_lazy("adam"),
+    # Sharded halo-exchange stencil (ROADMAP item 4): 2D five-point
+    # relaxation in two column shards with an explicit link-kind halo
+    # leaf -- the interconnect as fault surface.  The registry build is
+    # the vote-then-exchange placement; exchange-then-vote is reachable
+    # via resolve_region("stencil", placement="link") / the supervisor's
+    # --placement flag.  Recorded campaign: artifacts/stencil_campaign
+    # .json; distributed shard_map+ppermute differential in the module.
+    "stencil": _lazy("stencil"),
 }
 
 # The CHStone sub-suite (BASELINE config 4: full TMR campaign).  The

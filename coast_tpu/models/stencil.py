@@ -1,0 +1,281 @@
+"""Sharded halo-exchange stencil: the first region whose fault surface
+includes the interconnect.
+
+A 2D Jacobi-style five-point relaxation in mod-2^32 integer arithmetic
+(every flipped bit propagates; nothing is absorbed by rounding), sharded
+into two column blocks the way the TPU CFD framework shards its grids
+(arXiv:2108.11076): each super-step packs the shard-interface edge
+columns into an exchange buffer (the words "on the wire" of a
+``ppermute``), then integrates the received halo and relaxes.  The
+region models the distributed program on one device -- per-shard grid
+leaves plus an explicit ``link``-kind leaf for the in-flight halo -- so
+single-device campaigns, the sharded mesh runner, and the static
+propagation walker all see the same program; ``run_distributed`` is the
+genuinely distributed ``shard_map`` + ``ppermute`` executor, kept
+bit-identical as a FuzzyFlow-style differential pin (arXiv:2306.16178).
+
+Two protection schedules, selected by ``placement``:
+
+* ``compute`` -- **vote-then-exchange.**  The halo buffer is a plain
+  shared leaf: the engine's SoR-crossing vote fires on the PACK commit,
+  before the value travels.  A compute flip in one replica's edge cell
+  is repaired before it can leave the shard (blast radius: one shard,
+  measured zero cross-shard SDC), but a flip on the link itself -- after
+  the vote, before the receive -- is integrated by every replica of the
+  neighbor identically and votes cannot catch it (the honest blind
+  spot; 1x halo bandwidth).
+* ``link`` -- **exchange-then-vote.**  The halo buffer carries ``R=3``
+  copies and is declared ``unvoted_crossing``: the engine commits the
+  buffer raw (lane 0's pack, replicated into all three slots) and the
+  RECEIVER bitwise-majority votes the copies after the collective.  A
+  link flip hits one of three in-flight copies and is repaired (the
+  placement's win), but the unvoted pack is a single point of failure:
+  a lane-0 compute flip in an edge cell at a pack step ships corrupted
+  data in ALL three copies, the receive vote passes it, and the
+  neighbor shard silently integrates it -- measured cross-shard SDC
+  (3x halo bandwidth).  The isolation prover honestly refutes this
+  build; campaigns measure exactly the leak it names.
+
+The ``link`` fault model (inject/schedule.py) targets the halo leaf in
+its receive window (``meta['link_window'] = (1, 2)``: odd steps, after
+the pack committed and before the receive reads), which is what makes
+"interconnect upset" a distinct campaign axis from "compute upset".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.region import (KIND_CTRL, KIND_LINK, KIND_MEM, KIND_RO,
+                                 LeafSpec, Region)
+
+H = 8            # rows per shard (vertical axis is periodic, unsharded)
+W = 6            # interior columns per shard
+SHARDS = 2       # column blocks (grid0 | grid1)
+R_LINK = 3       # in-flight halo copies under exchange-then-vote
+N_ITERS = 6      # relaxation iterations (2 micro-steps each)
+SEED = 1234
+
+PLACEMENTS = ("compute", "link")
+
+
+def _fill(seed: int, n: int) -> np.ndarray:
+    """Deterministic full-width uint32 pseudo-random fill (splitmix-like
+    finalizer): the initial field, dense in every bit position."""
+    x = np.arange(1, n + 1, dtype=np.uint64) + np.uint64(seed)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> np.uint64(31))
+    return (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def _relax_full(u: np.ndarray) -> np.ndarray:
+    """One five-point relaxation of the FULL logical grid (numpy truth):
+    u' = u + N + S + E + W mod 2^32, rows periodic, zero side boundary."""
+    up = np.roll(u, 1, axis=0)
+    down = np.roll(u, -1, axis=0)
+    z = np.zeros((u.shape[0], 1), np.uint32)
+    left = np.concatenate([z, u[:, :-1]], axis=1)
+    right = np.concatenate([u[:, 1:], z], axis=1)
+    return u + up + down + left + right
+
+
+def golden_trajectory(n_iters: int = N_ITERS) -> np.ndarray:
+    """The exhaustive single-device truth: the full (H, SHARDS*W) grid
+    after ``n_iters`` relaxations.  Both the region and the distributed
+    shard_map executor are pinned against this array bit-for-bit."""
+    u = _fill(SEED, H * SHARDS * W).reshape(H, SHARDS * W)
+    for _ in range(n_iters):
+        u = _relax_full(u)
+    return u
+
+
+def _relax_block(u: jnp.ndarray) -> jnp.ndarray:
+    """Relax one (H, W+2) shard block in place: halo columns 0 / W+1 are
+    already loaded; only interior columns 1..W update."""
+    up = jnp.roll(u, 1, axis=0)
+    down = jnp.roll(u, -1, axis=0)
+    left = jnp.concatenate([u[:, :1] * 0, u[:, :-1]], axis=1)
+    right = jnp.concatenate([u[:, 1:], u[:, -1:] * 0], axis=1)
+    relaxed = u + up + down + left + right
+    keep = jnp.concatenate(
+        [u[:, :1], relaxed[:, 1:-1], u[:, -1:]], axis=1)
+    return keep
+
+
+def make_region(placement: str = "compute") -> Region:
+    """Build the stencil region under one of the two voter placements.
+
+    ``compute``: vote-then-exchange (the registry default).
+    ``link``:    exchange-then-vote.
+    """
+    if placement not in PLACEMENTS:
+        raise ValueError(
+            f"unknown stencil placement {placement!r}; one of {PLACEMENTS}")
+    xv = placement == "link"
+
+    full0 = _fill(SEED, H * SHARDS * W).reshape(H, SHARDS * W)
+    golden_full = golden_trajectory(N_ITERS)
+    # Shard s holds logical columns [s*W, (s+1)*W) plus two halo columns.
+    init_blocks = []
+    golden_blocks = []
+    for s in range(SHARDS):
+        blk = np.zeros((H, W + 2), np.uint32)
+        blk[:, 1:-1] = full0[:, s * W:(s + 1) * W]
+        init_blocks.append(jnp.asarray(blk))
+        golden_blocks.append(
+            jnp.asarray(golden_full[:, s * W:(s + 1) * W].copy()))
+
+    halo_shape = (R_LINK, SHARDS, H) if xv else (SHARDS, H)
+
+    def init():
+        return {
+            "grid0": init_blocks[0],
+            "grid1": init_blocks[1],
+            "golden0": golden_blocks[0],
+            "golden1": golden_blocks[1],
+            "halo": jnp.zeros(halo_shape, jnp.uint32),
+            "it": jnp.int32(0),
+        }
+
+    def _pack(g0, g1):
+        """Edge columns onto the wire: row 0 = eastbound (shard0's last
+        interior column -> shard1's left halo), row 1 = westbound."""
+        return jnp.stack([g0[:, W], g1[:, 1]])
+
+    def step(state, t):
+        g0, g1 = state["grid0"], state["grid1"]
+        recv_phase = (t % 2) == 1
+
+        if xv:
+            packed = jnp.broadcast_to(_pack(g0, g1)[None],
+                                      (R_LINK, SHARDS, H))
+            a, b, c = state["halo"][0], state["halo"][1], state["halo"][2]
+            wire = (a & b) | (b & c) | (a & c)
+        else:
+            packed = _pack(g0, g1)
+            wire = state["halo"]
+
+        # Receive: load the interface halos (outer side halos stay the
+        # zero boundary), then relax the interiors.
+        r0 = g0.at[:, W + 1].set(wire[1]).at[:, 0].set(0)
+        r1 = g1.at[:, 0].set(wire[0]).at[:, W + 1].set(0)
+        n0 = _relax_block(r0)
+        n1 = _relax_block(r1)
+
+        return {
+            **state,
+            "grid0": jnp.where(recv_phase, n0, g0),
+            "grid1": jnp.where(recv_phase, n1, g1),
+            "halo": jnp.where(recv_phase, state["halo"], packed),
+            "it": jnp.where(recv_phase, state["it"] + 1, state["it"]),
+        }
+
+    def done(state):
+        return state["it"] >= N_ITERS
+
+    def check(state):
+        return (jnp.sum(state["golden0"] != state["grid0"][:, 1:-1])
+                + jnp.sum(state["golden1"] != state["grid1"][:, 1:-1])
+                ).astype(jnp.int32)
+
+    def output(state):
+        return jnp.concatenate([state["grid0"][:, 1:-1].reshape(-1),
+                                state["grid1"][:, 1:-1].reshape(-1)])
+
+    return Region(
+        name=f"stencil[{placement}]",
+        init=init,
+        step=step,
+        done=done,
+        check=check,
+        output=output,
+        nominal_steps=2 * N_ITERS,
+        max_steps=6 * N_ITERS,
+        spec={
+            "grid0": LeafSpec(KIND_MEM, xmr=True),
+            "grid1": LeafSpec(KIND_MEM, xmr=True),
+            "golden0": LeafSpec(KIND_RO),
+            "golden1": LeafSpec(KIND_RO),
+            "halo": LeafSpec(KIND_LINK, xmr=False,
+                             unvoted_crossing=xv),
+            "it": LeafSpec(KIND_CTRL),
+        },
+        default_xmr=True,
+        meta={
+            "placement": placement,
+            # Receive window of the link fault model: the halo words are
+            # in flight at odd steps (packed at t, read at t+1).
+            "link_window": (1, 2),
+            # Which stencil shard each section's words belong to (None =
+            # the shared interconnect / control surface) -- the walker's
+            # cross-shard reach analysis and blast-radius attribution key.
+            "shard_of": {"grid0": 0, "grid1": 1,
+                         "golden0": 0, "golden1": 1,
+                         "halo": None, "it": None},
+            # Output-vector spans per shard (for blast-radius splits).
+            "shard_slices": {"grid0": (0, H * W),
+                             "grid1": (H * W, 2 * H * W)},
+            "golden_full": golden_full,
+        },
+    )
+
+
+# -- the genuinely distributed executor (shard_map + ppermute) ---------------
+
+def distributed_step(axis: str = "x"):
+    """One relaxation of a (H, W) column block under ``shard_map``: edge
+    columns travel by ``ppermute`` (non-participating edges receive the
+    collective's zero fill -- exactly the zero side boundary)."""
+
+    def step(u):
+        nx = jax.lax.psum(1, axis)
+        fwd = [(i, i + 1) for i in range(nx - 1)]
+        bwd = [(i + 1, i) for i in range(nx - 1)]
+        from_left = jax.lax.ppermute(u[:, -1], axis, fwd)
+        from_right = jax.lax.ppermute(u[:, 0], axis, bwd)
+        up = jnp.roll(u, 1, axis=0)
+        down = jnp.roll(u, -1, axis=0)
+        left = jnp.concatenate([from_left[:, None], u[:, :-1]], axis=1)
+        right = jnp.concatenate([u[:, 1:], from_right[:, None]], axis=1)
+        return u + up + down + left + right
+
+    return step
+
+
+def run_distributed(n_iters: int = N_ITERS, n_devices: int = SHARDS
+                    ) -> np.ndarray:
+    """Run the stencil as an actually-sharded program: the full grid
+    split over ``n_devices`` column blocks on a 1D mesh, halo exchange
+    via ``ppermute`` each iteration.  Returns the final full grid; the
+    differential pin asserts it equals ``golden_trajectory`` (and hence
+    the region model) bit-for-bit."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"run_distributed wants {n_devices} devices, have {len(devs)}")
+    cols = SHARDS * W
+    if cols % n_devices:
+        raise ValueError(f"{cols} columns do not shard over {n_devices}")
+    mesh = Mesh(np.array(devs[:n_devices]), ("x",))
+    step = distributed_step("x")
+
+    @jax.jit
+    def run(u):
+        body = shard_map(step, mesh=mesh, in_specs=P(None, "x"),
+                         out_specs=P(None, "x"))
+
+        def it(carry, _):
+            return body(carry), None
+
+        out, _ = jax.lax.scan(it, u, None, length=n_iters)
+        return out
+
+    u0 = jnp.asarray(_fill(SEED, H * cols).reshape(H, cols))
+    return np.asarray(run(u0))

@@ -62,7 +62,8 @@ from coast_tpu.obs.convergence import wilson_interval
 
 __all__ = ["SLOSpec", "SLOSet", "SLOError", "evaluate", "worst_verdict",
            "evidence_from_status", "evidence_from_summary",
-           "load_evidence", "summary_block", "status_line", "VERDICTS"]
+           "load_evidence", "baseline_from", "summary_block",
+           "status_line", "VERDICTS"]
 
 #: Verdict severity order (worst last).
 VERDICTS = ("ok", "warn", "page")
@@ -318,6 +319,20 @@ def load_evidence(path: str) -> Dict[str, object]:
     raise SLOError(
         f"no SLO evidence in {path}: want a coast-status doc, a run doc "
         "with a summary block, or a summary JSON")
+
+
+def baseline_from(path: str) -> Dict[str, object]:
+    """Reduce recorded evidence (any :func:`load_evidence` surface) to
+    the MWTF objective's baseline dict: the unprotected run's SDC rate
+    and throughput.  Shared by the offline ``slo`` CLI and the serving
+    front end's ``--baseline``, so the two cannot disagree on what an
+    ``mwtf>=N`` denominator is."""
+    ev = load_evidence(path)
+    counts = ev.get("counts") or {}
+    n = float(sum(counts.values()))
+    bad = sum(float(counts.get(k, 0.0)) for k in SDC_CLASSES)
+    return {"sdc_rate": (bad / n) if n > 0 else None,
+            "inj_per_sec": ev.get("inj_per_sec")}
 
 
 # ---------------------------------------------------------------------------

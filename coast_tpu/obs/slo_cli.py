@@ -28,7 +28,6 @@ import os
 import sys
 from typing import List, Optional
 
-from coast_tpu.inject.classify import SDC_CLASSES
 from coast_tpu.obs import slo as slo_mod
 
 __all__ = ["main"]
@@ -62,13 +61,9 @@ def parse_command_line(argv: Optional[List[str]] = None):
     return parser.parse_args(argv)
 
 
-def _baseline_from(path: str) -> dict:
-    ev = slo_mod.load_evidence(path)
-    counts = ev.get("counts") or {}
-    n = float(sum(counts.values()))
-    bad = sum(float(counts.get(k, 0.0)) for k in SDC_CLASSES)
-    return {"sdc_rate": (bad / n) if n > 0 else None,
-            "inj_per_sec": ev.get("inj_per_sec")}
+#: Kept as the CLI's historical private name; the shared definition
+#: lives in obs.slo so the serving front end's --baseline agrees.
+_baseline_from = slo_mod.baseline_from
 
 
 def _fmt(value, digits: int = 4) -> str:

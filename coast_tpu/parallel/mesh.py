@@ -136,6 +136,39 @@ class ShardedCampaignRunner(CampaignRunner):
     def n_devices(self) -> int:
         return int(np.prod(self.mesh.devices.shape))
 
+    # -- per-shard interesting-row ledger ------------------------------------
+    # The batch splits contiguously over the mesh (row r of a batch runs on
+    # device r // per), so attributing each collected batch's interesting
+    # rows back to physical shards is pure host arithmetic -- no extra
+    # device traffic.  Journal-replayed batches are not re-attributed: the
+    # ledger accounts for what *this* process ran.
+    def _ledger_reset(self) -> None:
+        self._shard_ledger = np.zeros(self.n_devices, np.int64)
+
+    def _ledger_rows(self, rows: np.ndarray, per: int) -> None:
+        ledger = getattr(self, "_shard_ledger", None)
+        if ledger is None or not len(rows):
+            return
+        shard = np.minimum(rows // max(int(per), 1), self.n_devices - 1)
+        np.add.at(ledger, shard, 1)
+
+    def _ledger_dense(self, out: Dict[str, np.ndarray],
+                      batch_size: int) -> None:
+        rows = np.flatnonzero(np.asarray(out["code"]) > cls.CORRECTED)
+        self._ledger_rows(rows.astype(np.int64),
+                          max(1, batch_size // self.n_devices))
+
+    def _mesh_block(self) -> Dict[str, object]:
+        ledger = getattr(self, "_shard_ledger", None)
+        if ledger is None:
+            ledger = np.zeros(self.n_devices, np.int64)
+        return {
+            "devices": self.n_devices,
+            "axes": {name: int(n) for name, n
+                     in zip(self.mesh.axis_names, self.mesh.devices.shape)},
+            "per_shard_interesting": [int(v) for v in ledger],
+        }
+
     # -- hooks into the base batching loop ---------------------------------
     def _round_batch(self, batch_size: int) -> int:
         nd = self.n_devices

@@ -210,6 +210,14 @@ class ProtectedProgram:
         self.replicated: Dict[str, bool] = {
             name: cfg.resolve_xmr(region, name) for name in region.spec
         }
+        for name, spec in region.spec.items():
+            if spec.unvoted_crossing and self.replicated[name]:
+                raise ValueError(
+                    f"leaf {name!r} declares unvoted_crossing but resolves "
+                    "to a replicated scope: the declaration is only "
+                    "meaningful on shared (non-xMR) leaves whose SoR-"
+                    "crossing vote the region replaces with its own "
+                    "receive-side voter")
         # Spec-leaf view: CFCSS later registers synthetic replicated
         # runtime leaves, but the lane axis only exists if some PROGRAM
         # leaf is replicated.
@@ -675,6 +683,17 @@ class ProtectedProgram:
                 new_state[name] = out
             else:
                 if self.region.spec[name].kind == KIND_RO:
+                    new_state[name] = out[0]
+                elif self.region.spec[name].unvoted_crossing:
+                    # Declared unvoted SoR crossing (LeafSpec): the region
+                    # carries replica-resolved data through this shared
+                    # leaf itself (e.g. the exchange-then-vote halo buffer
+                    # voted on the RECEIVE side, after the collective);
+                    # inserting the engine's vote here would collapse the
+                    # redundancy the region deliberately ships across the
+                    # link.  Lane 0's value commits raw -- an honest
+                    # single point of failure the provenance lint and the
+                    # isolation prover both surface.
                     new_state[name] = out[0]
                 elif cfg.num_clones > 1 and self._any_replicated:
                     # Store crossing the sphere of replication: vote before

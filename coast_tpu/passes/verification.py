@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.extend.core import Literal
 
-from coast_tpu.ir.region import KIND_CTRL, KIND_RO, Region
+from coast_tpu.ir.region import KIND_CTRL, KIND_LINK, KIND_RO, Region
 
 # Mirror of the reference's colored error prefix (dataflowProtection.h:84-96).
 _ERR = "ERROR (SoR verification): "
@@ -445,10 +445,17 @@ def verify_options(region: Region, cfg) -> FrozenSet[str]:
         # A hole needs *scope choice* exclusion: kind-based exclusion by
         # -noMemReplication is the load-sync design, not a hole (the
         # pervasive noMemReplicationFlag branches sync reads instead).
+        # KIND_LINK leaves are sanctioned crossings, not holes: the
+        # engine forces a SoR-crossing vote on their commit (vote-then-
+        # exchange), or the region declares unvoted_crossing and carries
+        # its own receive-side voter over the in-flight copies
+        # (exchange-then-vote).  Reads from them are the halo-integrate
+        # of a sharded region -- the surface the 'link' fault model
+        # measures, not a scope mistake to refuse.
         mutable_unprot = {
             n for n in region.spec
             if not replicated[n] and n in flow.written
-            and region.spec[n].kind != KIND_RO
+            and region.spec[n].kind not in (KIND_RO, KIND_LINK)
             and _scope_excluded(region, cfg, n)}
         for name in sorted(region.spec):
             if not replicated[name] or getattr(region.spec[name],

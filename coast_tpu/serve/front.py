@@ -223,7 +223,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--slo", default=None,
                    help="SLO spec string, e.g. "
                         "'sdc_rate<=0.002,availability>=0.99,"
-                        "p99_dispatch<=0.05;min=1024'")
+                        "p99_dispatch<=0.05;min=1024'; add 'mwtf>=N' "
+                        "with --baseline to gate on Mean-Work-To-"
+                        "Failure improvement live")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="recorded UNPROTECTED run evidence (status "
+                        "JSON, run doc with summary, summary JSON, or "
+                        "NDJSON log -- the slo CLI's --baseline "
+                        "vocabulary): feeds the mwtf objective's "
+                        "improvement denominator so 'mwtf>=N' gets a "
+                        "live verdict on /status and /metrics instead "
+                        "of no-data")
     p.add_argument("--status-json", default=None,
                    help="atomically-rewritten serving status file")
     p.add_argument("--status-interval", type=float, default=2.0,
@@ -249,7 +259,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.queue:
         from coast_tpu.fleet.queue import CampaignQueue
         queue = CampaignQueue(args.queue)
+    slo_baseline = None
+    if args.baseline:
+        from coast_tpu.obs.slo import SLOError, baseline_from
+        try:
+            slo_baseline = baseline_from(args.baseline)
+        except (OSError, ValueError, SLOError) as e:
+            print(f"Error, cannot load --baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
     metrics = ServeMetrics(slo=args.slo, status_path=args.status_json,
+                           slo_baseline=slo_baseline,
                            status_interval_s=args.status_interval)
     engine = ServeEngine(
         args.benchmark, batch_size=args.batch_size,

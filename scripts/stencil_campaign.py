@@ -1,0 +1,203 @@
+"""Record the sharded-stencil containment study: both voter placements
+x {single, cluster, link} fault models, with per-SDC-row blast radius.
+
+The ISSUE-19 acceptance artifact, ``artifacts/stencil_campaign.json``:
+the measured cross-shard SDC propagation that exchange-then-vote admits
+(its unvoted pack is a single point of failure) and vote-then-exchange
+bounds (blast radius: one shard) -- plus the reverse blind spot on the
+link itself (vote-then-exchange leaks every in-flight flip, exchange-
+then-vote's receiver majority repairs them all).
+
+Per cell the script runs the dense single-device campaign (the
+classification truth), re-runs every SDC row one-at-a-time to measure
+which shard's grid actually diverged from the golden trajectory (the
+blast radius -- ``reference`` rows corrupted only the golden RO copy,
+their grids match bit-for-bit), cross-validates every SDC against the
+statically sdc-possible sections (propagation walker soundness), and
+replays the same schedule through the 2-device ``ShardedCampaignRunner``
+under sparse collect to record bit parity plus the per-shard mesh
+ledger.  Exit 1 if any acceptance check fails.
+
+Usage: python scripts/stencil_campaign.py [--out artifacts/...]
+       [--n 128] [--seed 7] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/stencil_campaign.json")
+    ap.add_argument("--n", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from coast_tpu import ProtectionConfig, protect
+    from coast_tpu.analysis.propagation import (analyze_propagation,
+                                                crossvalidate_counts)
+    from coast_tpu.inject import classify as cls
+    from coast_tpu.inject.campaign import CampaignRunner
+    from coast_tpu.inject.schedule import FaultModel
+    from coast_tpu.models import resolve_region, stencil
+    from coast_tpu.parallel.mesh import ShardedCampaignRunner, make_mesh
+
+    H, W = stencil.H, stencil.W
+    models = [FaultModel.single(), FaultModel.cluster(span=4, k=3),
+              FaultModel.link()]
+    mesh = make_mesh(2)
+    failures = []
+    doc = {
+        "benchmark": "stencil",
+        "strategy": "TMR",
+        "n": args.n,
+        "seed": args.seed,
+        "models": [m.spec() for m in models],
+        "mesh": {"devices": 2},
+        "placements": {},
+    }
+
+    for placement in stencil.PLACEMENTS:
+        region = resolve_region("stencil", placement=placement)
+        prog = protect(region, ProtectionConfig(num_clones=3))
+        vmap = analyze_propagation(prog)
+        shard_of = region.meta["shard_of"]
+        slices = region.meta["shard_slices"]
+        golden = region.meta["golden_full"]
+        golden_out = np.concatenate([golden[:, :W].reshape(-1),
+                                     golden[:, W:].reshape(-1)])
+        # One compiled replay program per placement: fault group -> the
+        # region's output vector (the voted final grids).  jit re-
+        # specializes per fault shape (scalar site vs flip group).
+        replay = jax.jit(jax.vmap(lambda f: prog.run(f)["output"]))
+
+        pl_doc = {"cells": {}}
+        for model in models:
+            runner = CampaignRunner(prog, strategy_name="TMR",
+                                    fault_model=model)
+            res = runner.run(args.n, seed=args.seed,
+                             batch_size=args.batch_size)
+            sec_of_leaf = {s.leaf_id: s.name for s in runner.mmap.sections}
+            arrays = res.schedule.device_arrays()
+            sdc_rows = np.flatnonzero(res.codes == cls.SDC)
+
+            # Blast radius, measured: which shard grids diverged.
+            by_section = {}
+            radius = {"reference": 0, "own_shard": 0, "cross_shard": 0,
+                      "link_origin_escapes": 0}
+            if len(sdc_rows):
+                fault = {k: np.asarray(v)[sdc_rows]
+                         for k, v in arrays.items()}
+                outs = np.asarray(replay(fault))
+                for i, row in enumerate(sdc_rows):
+                    sec = sec_of_leaf[int(res.schedule.leaf_id[row])]
+                    by_section[sec] = by_section.get(sec, 0) + 1
+                    origin = shard_of.get(sec)
+                    bad = [s for s, (lo, hi) in sorted(slices.items())
+                           if np.any(outs[i][lo:hi] != golden_out[lo:hi])]
+                    if not bad:
+                        # Grids bit-clean: the flip corrupted the golden
+                        # RO reference the check compares against.
+                        radius["reference"] += 1
+                    elif origin is None:
+                        # Interconnect origin: any grid corruption means
+                        # the wire's flip escaped into a shard.
+                        radius["link_origin_escapes"] += 1
+                    elif bad == [f"grid{origin}"]:
+                        radius["own_shard"] += 1
+                    else:
+                        radius["cross_shard"] += 1
+
+            # Walker soundness: no SDC outside sdc-possible sections.
+            lids = np.asarray(res.schedule.leaf_id)
+            section_counts = {}
+            for sec in runner.mmap.sections:
+                binc = np.bincount(res.codes[lids == sec.leaf_id],
+                                   minlength=cls.NUM_CLASSES)
+                section_counts[sec.name] = {
+                    k: int(c) for k, c in zip(cls.CLASS_NAMES, binc) if c}
+            violations = crossvalidate_counts(vmap, section_counts)
+            if violations:
+                failures.append(f"{placement}/{model.spec()}: SDC outside "
+                                f"sdc-possible sections: {violations}")
+
+            # Cross-chip replay of the same schedule: bit parity + the
+            # per-shard ledger under sparse collect.
+            sh = ShardedCampaignRunner(prog, mesh, strategy_name="TMR",
+                                       fault_model=model, collect="sparse")
+            sres = sh.run_schedule(res.schedule,
+                                   batch_size=args.batch_size)
+            parity = (np.array_equal(res.codes[res.codes > cls.CORRECTED],
+                                     sres.codes)
+                      and res.counts == sres.counts)
+            if not parity:
+                failures.append(f"{placement}/{model.spec()}: sharded "
+                                f"parity broke: {sres.counts} vs "
+                                f"{res.counts}")
+
+            pl_doc["cells"][model.spec()] = {
+                "counts": res.counts,
+                "sdc": int(len(sdc_rows)),
+                "sdc_by_section": by_section,
+                "blast_radius": radius,
+                "soundness_violations": violations,
+                "sharded_parity": bool(parity),
+                "mesh": sres.summary().get("mesh"),
+            }
+            print(f"# {placement:<8} {model.spec():<22} "
+                  f"sdc={len(sdc_rows):<4} radius={radius}",
+                  file=sys.stderr, flush=True)
+        doc["placements"][placement] = pl_doc
+
+    # The containment difference the two placements trade:
+    cells = {p: doc["placements"][p]["cells"] for p in stencil.PLACEMENTS}
+    link_spec = next(s for s in cells["compute"] if s.startswith("link"))
+    compute_cells = [c for s, c in cells["compute"].items()
+                     if s != link_spec]
+    link_cells = [c for s, c in cells["link"].items() if s != link_spec]
+    doc["containment"] = {
+        # Vote-then-exchange bounds compute faults to their shard...
+        "compute_placement_cross_shard_sdc": sum(
+            c["blast_radius"]["cross_shard"] for c in compute_cells),
+        # ...but is blind to the wire (every in-flight flip escapes).
+        "compute_placement_link_sdc":
+            cells["compute"][link_spec]["sdc"],
+        # Exchange-then-vote repairs every in-flight flip...
+        "link_placement_link_sdc": cells["link"][link_spec]["sdc"],
+        # ...but its unvoted pack ships compute faults across the wire.
+        "link_placement_cross_shard_sdc": sum(
+            c["blast_radius"]["cross_shard"] for c in link_cells),
+    }
+    c = doc["containment"]
+    if not (c["compute_placement_cross_shard_sdc"] == 0
+            and c["compute_placement_link_sdc"] > 0
+            and c["link_placement_link_sdc"] == 0
+            and c["link_placement_cross_shard_sdc"] > 0):
+        failures.append(f"containment duality not measured: {c}")
+
+    doc["failures"] = failures
+    doc["ok"] = not failures
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+    print(json.dumps({"ok": doc["ok"], "containment": c,
+                      "out": args.out}))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

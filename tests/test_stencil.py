@@ -1,0 +1,344 @@
+"""Sharded halo-exchange stencil: region semantics, the ``link`` fault
+model's draw-surface partition, device-side regeneration parity, the
+mesh ledger, cross-shard reach, and placement-as-campaign-identity.
+
+Pins the PR's contracts at unit granularity (the smoke driver covers the
+end-to-end containment duality):
+
+* **Differential pin** -- the region model, the numpy truth, and the
+  genuinely distributed ``shard_map``+``ppermute`` executor agree
+  bit-for-bit on the fault-free trajectory (FuzzyFlow idiom,
+  arXiv:2306.16178).
+* **Fault-surface partition** -- link-kind sections are the ``link``
+  model's EXCLUSIVE surface: memory-model base draws never land there,
+  link draws never leave there (and stay in the receive window), the
+  stratified allocator skips them, and the on-device generator
+  reproduces the partitioned host stream bit-for-bit.
+* **Placement is campaign identity** -- ``placement`` roundtrips
+  through spec/queue items with absent-means-compute, journals record
+  it only when non-default (pre-placement journals keep resuming), and
+  a placement mismatch is refused with the typed error.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from coast_tpu import ProtectionConfig, protect
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.inject.journal import (JournalMismatchError,
+                                      PlacementMismatchError)
+from coast_tpu.inject.mem import MemoryMap
+from coast_tpu.inject.schedule import (FaultModel, generate,
+                                       generate_stratified,
+                                       generate_stratified_total)
+from coast_tpu.inject.spec import (PLACEMENT_DEFAULT, CampaignSpec,
+                                   SpecError, header_placement)
+from coast_tpu.models import resolve_region, stencil
+
+
+@pytest.fixture(scope="module", params=("compute", "link"))
+def placement(request):
+    return request.param
+
+
+@pytest.fixture(scope="module")
+def prog(placement):
+    region = resolve_region("stencil", placement=placement)
+    return protect(region, ProtectionConfig(num_clones=3))
+
+
+@pytest.fixture(scope="module")
+def prog_compute():
+    return protect(resolve_region("stencil"), ProtectionConfig(num_clones=3))
+
+
+@pytest.fixture(scope="module")
+def prog_link():
+    return protect(resolve_region("stencil", placement="link"),
+                   ProtectionConfig(num_clones=3))
+
+
+def _link_leaves(mmap):
+    return {s.leaf_id for s in mmap.sections if s.kind == "link"}
+
+
+# ---------------------------------------------------------------------------
+# Region semantics
+# ---------------------------------------------------------------------------
+
+def test_distributed_executor_matches_golden():
+    """shard_map + ppermute executor == the full-grid numpy truth,
+    bit-for-bit (the differential pin the region model hangs off)."""
+    got = stencil.run_distributed()
+    assert np.array_equal(got, stencil.golden_trajectory())
+
+
+def test_region_fault_free_trajectory(placement):
+    """The single-device region model converges to the same golden grid
+    under BOTH voter placements (the protection schedules differ; the
+    fault-free arithmetic must not)."""
+    region = stencil.make_region(placement)
+    state = region.init()
+    for t in range(region.nominal_steps):
+        state = region.step(state, t)
+    assert int(region.check(state)) == 0
+    golden = region.meta["golden_full"]
+    out = np.asarray(region.output(state))
+    H, W = stencil.H, stencil.W
+    assert np.array_equal(out[:H * W].reshape(H, W), golden[:, :W])
+    assert np.array_equal(out[H * W:].reshape(H, W), golden[:, W:])
+
+
+def test_region_rejects_unknown_placement():
+    with pytest.raises(ValueError, match="placement"):
+        stencil.make_region("bogus")
+    with pytest.raises(TypeError):
+        # resolve_region forwards knobs; mm has no placement knob.
+        resolve_region("matrixMultiply", placement="link")
+
+
+def test_halo_leaf_declares_the_wire(placement):
+    region = stencil.make_region(placement)
+    spec = region.spec["halo"]
+    assert spec.kind == "link"
+    assert spec.unvoted_crossing == (placement == "link")
+    # Exchange-then-vote carries R in-flight copies; vote-then-exchange
+    # ships the single voted value.
+    halo = region.init()["halo"]
+    want = ((stencil.R_LINK, stencil.SHARDS, stencil.H)
+            if placement == "link" else (stencil.SHARDS, stencil.H))
+    assert halo.shape == want
+
+
+# ---------------------------------------------------------------------------
+# FaultModel.link descriptor
+# ---------------------------------------------------------------------------
+
+def test_link_model_parse_spec_roundtrip():
+    assert FaultModel.parse("link") == FaultModel.link()
+    assert FaultModel.link().spec() == "link"
+    windowed = FaultModel.link(offset=1, period=2)
+    assert windowed.spec() == "link(offset=1,period=2)"
+    assert FaultModel.parse(windowed.spec()) == windowed
+    assert windowed.sites == 1
+
+
+def test_link_model_validation():
+    with pytest.raises(ValueError, match="period"):
+        FaultModel.link(offset=3)            # offset without a period
+    with pytest.raises(ValueError, match="link-model arguments"):
+        FaultModel(kind="cluster", k=2, t_offset=1, t_period=2)
+    with pytest.raises(ValueError):
+        FaultModel.link(offset=-1, period=2)
+
+
+def test_runner_upgrades_bare_link_to_region_window(prog_compute):
+    """A bare ``link`` model adopts the region's declared receive window
+    (meta['link_window']) so the CLI spelling targets in-flight words."""
+    runner = CampaignRunner(prog_compute, strategy_name="TMR",
+                            fault_model=FaultModel.link())
+    assert runner.fault_model == FaultModel.link(offset=1, period=2)
+
+
+# ---------------------------------------------------------------------------
+# Fault-surface partition (host schedule)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", [
+    FaultModel.single(),
+    FaultModel.multibit(k=4),
+    FaultModel.cluster(span=4, k=3),
+    FaultModel.burst(window=8, rate=0.5),
+], ids=lambda m: m.spec())
+def test_memory_models_never_draw_link_sections(prog_compute, model):
+    """Base-site draws of every memory-surface model map onto the
+    complement of the link-kind sections (the wire belongs to the link
+    model alone)."""
+    mmap = MemoryMap(prog_compute)
+    region_steps = 2 * stencil.N_ITERS
+    sched = generate(mmap, 256, 5, region_steps, model=model)
+    link = _link_leaves(mmap)
+    assert link, "stencil map lost its link-kind halo section"
+    assert not np.isin(sched.leaf_id, sorted(link)).any()
+    # The draw still covers the rest of the surface.
+    assert len(set(sched.leaf_id.tolist())) > 1
+
+
+def test_link_draws_only_halo_in_window(prog_link):
+    mmap = MemoryMap(prog_link)
+    steps = 2 * stencil.N_ITERS
+    sched = generate(mmap, 256, 5, steps,
+                     model=FaultModel.link(offset=1, period=2))
+    link = _link_leaves(mmap)
+    assert set(sched.leaf_id.tolist()) <= link
+    t = np.asarray(sched.t)
+    assert np.all((t >= 1) & (t < steps))
+    assert np.all(t % 2 == 1), "draws outside the receive window"
+
+
+def test_stratified_skips_link_sections(prog_compute):
+    mmap = MemoryMap(prog_compute)
+    steps = 2 * stencil.N_ITERS
+    sched = generate_stratified(mmap, 4, 0, steps)
+    link = _link_leaves(mmap)
+    assert not np.isin(sched.leaf_id, sorted(link)).any()
+    n_nonlink = sum(1 for s in mmap.sections if s.kind != "link")
+    assert len(sched.leaf_id) == 4 * n_nonlink
+    # The budgeted allocator sizes by the non-link count too.
+    total = generate_stratified_total(mmap, 4 * n_nonlink, 0, steps)
+    assert len(total.leaf_id) == 4 * n_nonlink
+    # And the link model refuses stratification outright.
+    with pytest.raises(ValueError, match="link"):
+        generate_stratified(mmap, 4, 0, steps, model=FaultModel.link())
+
+
+def test_all_link_map_refused(prog_compute):
+    """A map whose every injectable section is link-kind leaves the
+    memory models nothing to draw: typed refusal, not a modulo-0 crash."""
+    from coast_tpu.inject.device_gen import DeviceGenError, DeviceScheduleGen
+    mmap = MemoryMap(prog_compute, sections=("link",))
+    with pytest.raises(ValueError, match="link"):
+        generate(mmap, 8, 0, 12)
+    with pytest.raises(DeviceGenError):
+        DeviceScheduleGen(mmap, 12, FaultModel.single())
+
+
+# ---------------------------------------------------------------------------
+# On-device regeneration parity over the partitioned surface
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", [
+    FaultModel.single(),
+    FaultModel.cluster(span=4, k=3),
+    FaultModel.link(offset=1, period=2),
+], ids=lambda m: m.spec())
+def test_device_gen_parity_on_stencil_map(prog, model):
+    """The compiled generator reproduces the partitioned host stream
+    bit-for-bit on a map WITH link sections (both the complement mapping
+    and the link-only mapping), under both placements."""
+    from coast_tpu.inject.device_gen import DeviceScheduleGen
+    mmap = MemoryMap(prog)
+    steps = 2 * stencil.N_ITERS
+    sched = generate(mmap, 193, 11, steps, model=model)
+    want = sched.device_arrays()
+    gen = DeviceScheduleGen(mmap, steps, model)
+    got = gen.rows_np(11, 193, np.arange(193))
+    for key in ("leaf_id", "lane", "word", "bit", "t"):
+        assert np.array_equal(np.asarray(want[key]), got[key]), key
+    sub = np.array([0, 64, 192, 17])
+    got2 = gen.rows_np(11, 193, sub)
+    for key in ("leaf_id", "lane", "word", "bit", "t"):
+        assert np.array_equal(np.asarray(want[key])[sub], got2[key]), key
+
+
+# ---------------------------------------------------------------------------
+# Sharded mesh ledger
+# ---------------------------------------------------------------------------
+
+def test_sharded_summary_carries_mesh_ledger(prog_compute):
+    from coast_tpu.parallel.mesh import ShardedCampaignRunner, make_mesh
+    mesh = make_mesh(2)
+    for collect in ("sparse", "dense"):
+        res = ShardedCampaignRunner(
+            prog_compute, mesh, strategy_name="TMR",
+            collect=collect).run(64, seed=7, batch_size=32)
+        block = res.summary().get("mesh")
+        assert block and block["devices"] == 2
+        assert sum(block["axes"].values()) >= 2
+        ledger = block["per_shard_interesting"]
+        assert len(ledger) == 2
+        n_interesting = (len(res.interesting_rows)
+                         if res.interesting_rows is not None
+                         else int(np.sum(np.asarray(res.codes) > 1)))
+        assert sum(ledger) == n_interesting, collect
+    # Single-device summaries stay mesh-free (byte-stable ndjson logs).
+    base = CampaignRunner(prog_compute, strategy_name="TMR").run(
+        64, seed=7, batch_size=32)
+    assert "mesh" not in base.summary()
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard reach (propagation walker)
+# ---------------------------------------------------------------------------
+
+def test_walker_shard_reach_pins(prog, placement):
+    from coast_tpu.analysis.propagation import analyze_propagation
+    vmap = analyze_propagation(prog)
+    reach = vmap.shard_reach
+    assert reach is not None
+    want_cross = placement == "link"
+    for name in ("grid0", "grid1"):
+        assert reach[name]["cross_shard"] is want_cross, (placement, name)
+    assert vmap.summary()["shard_reach"] == reach
+
+
+def test_walker_shard_reach_absent_without_shard_meta():
+    from coast_tpu import TMR
+    from coast_tpu.analysis.propagation import analyze_propagation
+    from coast_tpu.models import mm
+    vmap = analyze_propagation(TMR(mm.make_region()))
+    assert vmap.shard_reach is None
+    assert "shard_reach" not in vmap.summary()
+
+
+# ---------------------------------------------------------------------------
+# Placement is campaign identity
+# ---------------------------------------------------------------------------
+
+def test_spec_placement_roundtrip():
+    spec = CampaignSpec(benchmark="stencil", n=64)
+    assert spec.placement == PLACEMENT_DEFAULT == "compute"
+    # Absent-means-compute keeps every pre-placement item byte-identical.
+    assert "placement" not in spec.to_item()
+    assert CampaignSpec.from_item(spec.to_item()).placement == "compute"
+    xv = dataclasses.replace(spec, placement="link").validate()
+    item = xv.to_item()
+    assert item["placement"] == "link"
+    assert CampaignSpec.from_item(item).placement == "link"
+    with pytest.raises(SpecError, match="placement"):
+        dataclasses.replace(spec, placement="wire").validate()
+
+
+def test_header_placement_rule():
+    assert header_placement({}) == "compute"
+    assert header_placement({"placement": None}) == "compute"
+    assert header_placement({"placement": "link"}) == "link"
+
+
+def test_journal_placement_mismatch_typed(prog_compute, prog_link,
+                                          tmp_path):
+    path = str(tmp_path / "j.ndjson")
+    CampaignRunner(prog_link, strategy_name="TMR").run(
+        64, seed=3, batch_size=64, journal=path)
+    with open(path) as fh:
+        header = json.loads(fh.readline())
+    assert header["placement"] == "link"
+    with pytest.raises(PlacementMismatchError) as ei:
+        CampaignRunner(prog_compute, strategy_name="TMR").run(
+            64, seed=3, batch_size=64, journal=path)
+    assert "link" in str(ei.value) and "compute" in str(ei.value)
+    # Typed refusal IS a JournalMismatchError (existing except-clauses).
+    assert issubclass(PlacementMismatchError, JournalMismatchError)
+    # Same placement resumes bit-for-bit.
+    res = CampaignRunner(prog_link, strategy_name="TMR").run(
+        64, seed=3, batch_size=64, journal=path)
+    assert res.n == 64
+
+
+def test_preplacement_journal_resumes_as_compute(prog_compute, tmp_path):
+    """Compute-placement journals never carry the placement key, so
+    journals written before the knob existed resume under the new code
+    (and a link-placement campaign refuses them with the typed error)."""
+    path = str(tmp_path / "j.ndjson")
+    full = CampaignRunner(prog_compute, strategy_name="TMR").run(
+        64, seed=3, batch_size=64, journal=path)
+    with open(path) as fh:
+        header = json.loads(fh.readline())
+    assert "placement" not in header
+    res = CampaignRunner(prog_compute, strategy_name="TMR").run(
+        64, seed=3, batch_size=64, journal=path)
+    assert np.array_equal(res.codes, full.codes)
+    assert res.counts == full.counts
