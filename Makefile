@@ -4,7 +4,7 @@
 PYTHON ?= python3
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke stencil_smoke profile_smoke slo_smoke serve_smoke serve_loadtest profile ci_protection clean
+.PHONY: build test test_all test_fast test_full test_tmr test_csrc regression_test test_rtos rtos bench fidelity mfu_sweep resume_smoke stream_smoke faultmodel_smoke equiv_smoke obs_live_smoke fleet_smoke train_smoke ci_smoke sparse_smoke propagation_smoke stencil_smoke profile_smoke fused_smoke slo_smoke serve_smoke serve_loadtest profile ci_protection clean
 
 build:
 	$(MAKE) -C coast_tpu/native
@@ -145,6 +145,13 @@ stencil_smoke:
 profile_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.profile_smoke
 
+# Fused protected-step smoke (also a fast.yml driver row): dense ndjson
+# byte parity fused-vs-unfused at one seed, measured flops_overhead
+# cut >= 2x (TMR) on the restructured-scan path, journal fuse identity
+# refused typed both directions.
+fused_smoke:
+	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.fused_smoke
+
 slo_smoke:
 	$(CPU_ENV) $(PYTHON) -m coast_tpu.testing.slo_smoke
 
@@ -165,7 +172,8 @@ serve_loadtest:
 # artifacts/profile_mm.json baseline (on CPU, MFU pinned against the
 # v5e target ceiling; on TPU the backend table resolves the peak).
 profile:
-	$(PYTHON) -m coast_tpu profile --out artifacts/profile_mm.json
+	$(PYTHON) -m coast_tpu profile --fuse-step --peak-gflops 197000 \
+	    --out artifacts/profile_mm.json
 
 # The repo gating itself (ROADMAP item 3's end-game): delta-check the
 # current tree against the committed baseline artifact.  Exit 0 = the

@@ -121,7 +121,14 @@ def _emit(rec):
 
 
 def worker(backend: str) -> None:
-    # Blackbox first, backend second: the stage the recorder most needs
+    # First breath before ANY heavy import: the parent's wedge forensics
+    # hinge on whether this line arrives.  Spawn line seen + no init line
+    # == the wedge is inside jax/PJRT backend init (the device claim);
+    # NOT even this line == the wedge is interpreter startup itself (the
+    # site hook importing the axon plugin), which no amount of in-worker
+    # instrumentation can witness.
+    _emit({"stage": "spawn", "pid": os.getpid()})
+    # Blackbox next, backend after: the stage the recorder most needs
     # to witness is the init wedge, which happens inside the very next
     # import.  The parent points COAST_FLIGHTREC_DIR at its harvest
     # directory and SIGUSR1s us for the bundle before it kills us.
@@ -347,6 +354,127 @@ def _kill_stale_workers(max_age_s: float) -> list:
     return killed
 
 
+def _probe_env() -> dict:
+    """Pre-spawn environment probe: everything the wedge diagnosis needs,
+    gathered WITHOUT importing jax in-process (importing it is exactly
+    the operation that wedges).  Cheap filesystem facts only:
+
+    - ``device_nodes``: TPU device files (``/dev/accel*``, ``/dev/vfio``)
+      -- absent means there is no chip behind this container and the
+      PJRT plugin has nothing to claim;
+    - ``libtpu``: the TPU runtime is importable;
+    - ``claim_holders``: (pid, age_s, comm) of OTHER same-uid processes
+      holding a TPU device node open -- the claim contention a fresh
+      worker would wedge against;
+    - ``cause``: the ONE typed pre-spawn verdict: ``tpu_absent`` /
+      ``runtime_missing`` / ``claim_held`` / ``ok``.
+    """
+    import glob
+    import importlib.util
+    nodes = sorted(glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*"))
+    probe = {
+        "device_nodes": nodes,
+        "libtpu": importlib.util.find_spec("libtpu") is not None,
+        "claim_holders": [list(h) for h in _iter_claim_holders(nodes)],
+    }
+    if not nodes:
+        probe["cause"] = "tpu_absent"
+    elif not probe["libtpu"]:
+        probe["cause"] = "runtime_missing"
+    elif probe["claim_holders"]:
+        probe["cause"] = "claim_held"
+    else:
+        probe["cause"] = "ok"
+    return probe
+
+
+def _iter_claim_holders(nodes):
+    """(pid, age_s, comm) of OTHER same-uid processes with a TPU device
+    node open.  /proc/<pid>/fd scan, same no-psutil discipline as
+    _iter_own_workers; unreadable entries are skipped silently."""
+    if not nodes:
+        return
+    me = os.getpid()
+    targets = set(nodes)
+    try:
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        hertz = os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError):
+        return
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            if os.stat(f"/proc/{pid}").st_uid != os.getuid():
+                continue
+            held = False
+            for fd in os.listdir(f"/proc/{pid}/fd"):
+                try:
+                    if os.readlink(f"/proc/{pid}/fd/{fd}") in targets:
+                        held = True
+                        break
+                except OSError:
+                    continue
+            if not held:
+                continue
+            with open(f"/proc/{pid}/comm") as f:
+                comm = f.read().strip()
+            with open(f"/proc/{pid}/stat") as f:
+                stat = f.read()
+            start_ticks = int(stat.rsplit(")", 1)[1].split()[19])
+            yield int(pid), round(uptime - start_ticks / hertz, 1), comm
+        except (OSError, ValueError, IndexError):
+            continue
+
+
+def _kill_claim_holders(probe, max_age_s: float) -> list:
+    """The hard-kill half of the wedge fix: a same-uid process that has
+    held the device claim longer than any supervision budget is a wedge
+    leftover (a previous window's worker, a TPU-initialized pytest), and
+    every fresh attempt behind it silently resolves to the CPU fallback.
+    Kill it so the retry actually reaches the TPU backend.  Younger
+    holders are live neighbours and are left alone (the claim-backoff
+    loop handles them)."""
+    killed = []
+    for pid, age, comm in probe.get("claim_holders", []):
+        if age > max_age_s:
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+                killed.append(int(pid))
+                _note(f"killed stale claim holder pid {pid} ({comm}, age "
+                      f"{age:.0f}s > {max_age_s:.0f}s budget)")
+            except OSError:
+                pass
+    return killed
+
+
+def _classify_wedge(records, probe) -> str:
+    """The ONE typed wedge cause for the bench line, from the pre-spawn
+    probe plus which worker stage lines actually arrived:
+
+    - ``tpu_absent``: no TPU device node in this container -- the axon
+      plugin has nothing to claim and a 'default' attempt can only ever
+      resolve to the CPU host backend (BENCH_r02..: every round since the
+      tunnel went away wedged here);
+    - ``runtime_missing``: device node present but no libtpu runtime;
+    - ``claim_held``: another same-uid process holds the device node;
+    - ``backend_init_wedge``: the worker's first-breath spawn line
+      arrived but init never did -- wedged inside jax/PJRT backend init
+      (the device claim call);
+    - ``interpreter_startup_wedge``: not even the spawn line arrived --
+      wedged before worker() ran, i.e. inside interpreter startup (the
+      site hook importing the PJRT plugin)."""
+    if probe.get("cause") != "ok":
+        return probe.get("cause", "unknown")
+    stages = {r.get("stage") for r in records}
+    if "init" in stages:
+        return "post_init_wedge"
+    if "spawn" in stages:
+        return "backend_init_wedge"
+    return "interpreter_startup_wedge"
+
+
 def _claim_like(error: str) -> bool:
     """Does this attempt failure look like device-claim contention (a
     holder that may release) rather than a hard fault?"""
@@ -523,14 +651,36 @@ def main() -> int:
     errors = []
     # A wedged worker from an earlier window holds the device claim and
     # silently turns every new run into the CPU fallback -- clear it first.
-    _kill_stale_workers(INIT_TIMEOUT + RUN_TIMEOUT + 120)
+    stale_budget = INIT_TIMEOUT + RUN_TIMEOUT + 120
+    _kill_stale_workers(stale_budget)
+    # Pre-spawn environment probe (the spawn-wedge fix): learn BEFORE
+    # burning an INIT_TIMEOUT whether a TPU attempt can possibly succeed,
+    # and hard-kill any stale same-uid claim holder so a retry actually
+    # reaches the backend instead of wedging behind the corpse.
+    probe = _probe_env()
+    if _kill_claim_holders(probe, stale_budget):
+        time.sleep(2.0)
+        probe = _probe_env()
+    _note(f"env probe: cause={probe['cause']} "
+          f"nodes={len(probe['device_nodes'])} libtpu={probe['libtpu']} "
+          f"holders={len(probe['claim_holders'])}")
     force = os.environ.get("COAST_BENCH_BACKEND")  # e.g. "cpu" for dev boxes
-    plan = ([(force, INIT_TIMEOUT)] if force else
-            [("default", INIT_TIMEOUT), ("default", RETRY_TIMEOUT),
-             ("cpu", RETRY_TIMEOUT)])
+    if force:
+        plan = [(force, INIT_TIMEOUT)]
+    elif probe["cause"] == "tpu_absent":
+        # No device node behind this container: a 'default' retry can
+        # never reach hardware, so don't churn the retry budget against
+        # it -- one default attempt (fast host resolve), then the
+        # explicit fallback; the typed cause rides the bench line.
+        plan = [("default", INIT_TIMEOUT), ("cpu", RETRY_TIMEOUT)]
+    else:
+        plan = [("default", INIT_TIMEOUT), ("default", RETRY_TIMEOUT),
+                ("cpu", RETRY_TIMEOUT)]
     summary, used = {}, None
     spawn_wedge = None
     wedge_forensics = None
+    wedge_cause = None
+    last_tpu_records = []
     for backend, budget in plan:
         claim_tries = 0
         claim_t0 = time.monotonic()
@@ -546,6 +696,8 @@ def main() -> int:
             if error:
                 errors.append(
                     f"[{backend} attempt, {time.time()-t0:.0f}s] {error}")
+            if backend != "cpu":
+                last_tpu_records = records
             summary = _summarize(records)
             if "best" in summary:
                 used = backend
@@ -559,9 +711,10 @@ def main() -> int:
             if backend != "cpu" and error and _claim_like(error):
                 elapsed = time.monotonic() - claim_t0
                 if claim_tries >= CLAIM_RETRIES or elapsed > CLAIM_TOTAL_S:
+                    wedge_cause = _classify_wedge(records, probe)
                     spawn_wedge = (
-                        f"{backend} spawn wedged: gave up after "
-                        f"{claim_tries + 1} attempt(s) / {elapsed:.0f}s "
+                        f"{backend} spawn wedged ({wedge_cause}): gave up "
+                        f"after {claim_tries + 1} attempt(s) / {elapsed:.0f}s "
                         f"(budget {CLAIM_RETRIES + 1} x {CLAIM_TOTAL_S:.0f}s)"
                         f"; last: {_tail_cap(error, 160)}")
                     _note(spawn_wedge)
@@ -571,7 +724,10 @@ def main() -> int:
                 _note(f"[{backend}] claim-like failure; backoff {delay:.0f}s "
                       f"then retry {claim_tries}/{CLAIM_RETRIES}")
                 time.sleep(delay)
-                _kill_stale_workers(INIT_TIMEOUT + RUN_TIMEOUT + 120)
+                _kill_stale_workers(stale_budget)
+                # Re-probe between retries: the holder the backoff waited
+                # out may now be stale enough to hard-kill.
+                _kill_claim_holders(_probe_env(), stale_budget)
                 continue
             break
         if "best" in summary:
@@ -583,6 +739,7 @@ def main() -> int:
               f"{summary.get('backend')}")
         spawn_wedge = None
         wedge_forensics = None
+        wedge_cause = None
 
     artifacts_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "artifacts")
@@ -611,6 +768,8 @@ def main() -> int:
             # bundle (obs/flightrec.py): forensics is None when the
             # child could not answer SIGUSR1 (wedged in a C call).
             full["spawn_wedge"] = {"note": spawn_wedge,
+                                   "cause": wedge_cause,
+                                   "probe": probe,
                                    "forensics": wedge_forensics}
         # One predicate for "this ran on the host": the worker-REPORTED
         # backend, not the attempt label -- a "default" attempt on a
@@ -620,6 +779,14 @@ def main() -> int:
         if on_cpu and not force:
             full["note"] = ("TPU backend unreachable; value measured on the "
                             "CPU fallback backend")
+            # The typed WHY behind the fallback (the spawn-wedge fix's
+            # contract: never a silent CPU record): the pre-spawn probe's
+            # verdict, refined by which worker stage lines the last
+            # hardware attempt actually produced.
+            full["tpu_diagnosis"] = {
+                "cause": wedge_cause or _classify_wedge(last_tpu_records,
+                                                        probe),
+                "probe": probe}
         # Per-backend trajectory: this round's value is compared against
         # (and then refreshes) ITS OWN backend's last record, so a
         # CPU-fallback round never reads as a regression from -- or an
@@ -678,8 +845,13 @@ def main() -> int:
             line["vs_backend_baseline"] = full["vs_backend_baseline"]
         if "note" in full:
             line["note"] = full["note"]
+        if "tpu_diagnosis" in full:
+            # Compact on the line (cause only); the probe detail lives in
+            # the artifact.
+            line["tpu_diagnosis"] = full["tpu_diagnosis"]["cause"]
         if spawn_wedge:
             line["spawn_wedge"] = {"note": spawn_wedge,
+                                   "cause": wedge_cause,
                                    "forensics": wedge_forensics}
         if errors:
             line["error"] = _tail_cap("; ".join(errors), 300)
@@ -696,6 +868,8 @@ def main() -> int:
                  "partial": summary or None})
     if spawn_wedge:
         line["spawn_wedge"] = {"note": spawn_wedge,
+                               "cause": wedge_cause,
+                               "probe": probe,
                                "forensics": wedge_forensics}
     print(json.dumps(line))
     for e in errors:

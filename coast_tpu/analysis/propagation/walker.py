@@ -445,6 +445,14 @@ def analyze_step(prog, closed=None, track_paths: bool = True) -> StepFacts:
     that lint, partition, and map in one session trace once);
     ``track_paths=False`` skips witness-path bookkeeping for consumers
     that only need the boolean facts."""
+    if getattr(prog.cfg, "fuse_step", False) and closed is None:
+        # Fused builds (-fuseStep) are differentially pinned bit-identical
+        # to their unfused twin (ops/fused_step.py); the protection
+        # STRUCTURE the static analyses read -- sync coverage, dataflow
+        # cones, merge modes -- is the twin's.  Walking the twin keeps
+        # every equiv partition fingerprint, vulnerability-map verdict,
+        # and isolation proof unchanged by fusion.
+        prog = prog.unfused_twin()
     cfg = prog.cfg
     region = prog.region
     n = cfg.num_clones

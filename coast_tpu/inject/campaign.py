@@ -1013,6 +1013,22 @@ class CampaignRunner:
                     f"{header_place!r} but this runner's region is built "
                     f"{self.placement!r}; rerun with the original "
                     "--placement (or a fresh journal)")
+            # Step engine = campaign identity too (absent-means-unfused):
+            # the fused path is pinned bit-identical, but the rows
+            # measured a different compiled program (op counts, MFU
+            # attribution), so a journal written under one engine must
+            # never blend batches from the other.
+            from coast_tpu.inject.journal import FuseStepMismatchError
+            from coast_tpu.inject.spec import header_fuse
+            header_fused = header_fuse(journal.header)
+            runner_fused = bool(getattr(self.prog.cfg, "fuse_step", False))
+            if header_fused != runner_fused:
+                raise FuseStepMismatchError(
+                    f"journal {journal.path!r} records "
+                    f"fuse={header_fused} but this runner's program is "
+                    f"built fuse={runner_fused}; rerun with the original "
+                    "fuse mode (-fuseStep/-noFuseStep, or a fresh "
+                    "journal)")
         retry = self.retry
         metrics = self.metrics
         tracker = None
@@ -1660,6 +1676,11 @@ class CampaignRunner:
             # journal refuses a vote-then-exchange resume with the
             # typed PlacementMismatchError.
             header["placement"] = self.placement
+        if getattr(self.prog.cfg, "fuse_step", False):
+            # Absent-means-unfused: pre-fusion journals keep resuming
+            # unchanged; a fused journal refuses an unfused resume (and
+            # vice versa) with the typed FuseStepMismatchError.
+            header["fuse"] = True
         if self.equiv_partition is not None:
             # Partition = campaign identity (the reduced rows are only
             # meaningful under it); per-section fingerprints are the

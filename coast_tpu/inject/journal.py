@@ -76,7 +76,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from coast_tpu.inject.spec import header_fault_model, header_placement
+from coast_tpu.inject.spec import (header_fault_model, header_fuse,
+                                   header_placement)
 from coast_tpu.obs import flightrec
 
 try:
@@ -87,7 +88,7 @@ except ImportError:                     # pragma: no cover - non-POSIX
 __all__ = [
     "JournalError", "JournalExistsError", "JournalMismatchError",
     "FaultModelMismatchError", "PlacementMismatchError",
-    "JournalLockedError", "CampaignJournal",
+    "FuseStepMismatchError", "JournalLockedError", "CampaignJournal",
     "schedule_fingerprint", "config_fingerprint",
 ]
 
@@ -135,6 +136,16 @@ class PlacementMismatchError(JournalMismatchError):
     :func:`coast_tpu.inject.spec.header_placement`)."""
 
 
+class FuseStepMismatchError(JournalMismatchError):
+    """The journal records a different STEP ENGINE (fused vs. unfused)
+    than the resuming campaign.  The fused path is pinned bit-identical,
+    but the program the rows measured (op counts, kernel schedule, MFU
+    attribution) is not the same program -- blending rows from both
+    engines into one journal would corrupt any perf claim made from it.
+    Absent header key == unfused (pre-fusion journals resume unchanged
+    -- the rule lives in :func:`coast_tpu.inject.spec.header_fuse`)."""
+
+
 def schedule_fingerprint(sched) -> str:
     """sha256 over a FaultSchedule's columns + seed: the journal's proof
     that a resumed campaign will inject exactly the recorded faults.
@@ -164,7 +175,13 @@ def schedule_fingerprint(sched) -> str:
 def config_fingerprint(cfg) -> str:
     """Stable fingerprint of a ProtectionConfig: resuming under different
     protection flags would measure a different program."""
-    doc = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+    fields = dataclasses.asdict(cfg)
+    # Evolution rule: knobs added after journals existed must vanish
+    # from the fingerprint at their default value, or every pre-knob
+    # journal's config_sha would spuriously change and refuse to resume.
+    if not fields.get("fuse_step", False):
+        fields.pop("fuse_step", None)
+    doc = json.dumps(fields, sort_keys=True, default=str)
     return hashlib.sha256(doc.encode()).hexdigest()[:16]
 
 
@@ -338,6 +355,17 @@ class CampaignJournal:
                 "programs (different halo leaf, different blast radius). "
                 "Rerun with the original --placement, or start a fresh "
                 "journal.")
+        found_fuse = header_fuse(found)
+        expect_fuse = header_fuse(expect)
+        if found_fuse != expect_fuse:
+            raise FuseStepMismatchError(
+                f"journal {path!r} records "
+                f"{'the fused' if found_fuse else 'the unfused'} step "
+                f"engine but this campaign runs "
+                f"{'the fused' if expect_fuse else 'the unfused'} one; "
+                "the rows measured a different compiled program.  Rerun "
+                "with the original fuse mode (-fuseStep/-noFuseStep), or "
+                "start a fresh journal.")
         keys = (set(found) | set(expect)) - _VOLATILE_KEYS
         diffs = [k for k in sorted(keys) if found.get(k) != expect.get(k)]
         if diffs:

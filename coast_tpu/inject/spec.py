@@ -44,8 +44,8 @@ import dataclasses
 from typing import Dict, Optional
 
 __all__ = ["CampaignSpec", "SpecError", "FAULT_MODEL_DEFAULT",
-           "COLLECT_DEFAULT", "PLACEMENT_DEFAULT", "header_collect",
-           "header_placement"]
+           "COLLECT_DEFAULT", "PLACEMENT_DEFAULT", "FUSE_DEFAULT",
+           "header_collect", "header_placement", "header_fuse"]
 
 #: The journal-evolution default: an absent ``fault_model`` key means
 #: the historical single-bit flip (journals and queue items written
@@ -62,6 +62,14 @@ COLLECT_DEFAULT = "dense"
 #: written before the knob existed stay byte-identical and still
 #: open/resume.
 PLACEMENT_DEFAULT = "compute"
+
+#: Same evolution rule for the fused protected-step engine: an absent
+#: ``fuse`` key means the historical unfused interpreter loop.  The
+#: fused path is pinned bit-identical, but the *program* the campaign
+#: measured (op counts, kernel schedule, MFU attribution) differs, so
+#: fuse mode is campaign identity -- resuming a journal under the other
+#: engine is refused typed rather than silently blending measurements.
+FUSE_DEFAULT = False
 
 
 class SpecError(ValueError):
@@ -355,3 +363,10 @@ def header_placement(header: Dict[str, object]) -> str:
     resume) unchanged."""
     return str(header.get("placement", PLACEMENT_DEFAULT)
                or PLACEMENT_DEFAULT)
+
+
+def header_fuse(header: Dict[str, object]) -> bool:
+    """The fused-engine evolution rule, spelled once: an absent ``fuse``
+    key means the historical unfused interpreter loop.  Pre-fusion
+    journals and queue items decode (and resume) unchanged."""
+    return bool(header.get("fuse", FUSE_DEFAULT))

@@ -66,6 +66,12 @@ def parse_command_line(argv: Optional[List[str]] = None):
     parser.add_argument("--hbm-gbps", type=float, default=None,
                         help="roofline HBM bandwidth (default v5e "
                         "819 GB/s)")
+    parser.add_argument("--fuse-step", action="store_true",
+                        help="run every target TWICE at identical seeds "
+                        "-- the unfused baseline and the -fuseStep "
+                        "engine -- and record the A/B (achieved_mfu, "
+                        "flops_overhead, overhead reduction) in the "
+                        "artifact's fused_ab block")
     return parser.parse_args(argv)
 
 
@@ -124,40 +130,73 @@ def main(argv: Optional[List[str]] = None) -> int:
            "backend": jax.default_backend(),
            "n": int(args.t), "batch_size": int(args.batch_size),
            "seed": int(args.seed), "targets": {}}
+    if args.fuse_step:
+        doc["fused_ab"] = {}
     last_runner = None
     rc = 0
     for tid in targets:
         bench, _, opt = tid.partition("|")
-        prog, strategy = build_program(bench, opt or "-TMR")
-        profiler = CampaignProfiler(
-            prog, peak_gflops=args.peak_gflops,
-            hbm_gbps=args.hbm_gbps or DEFAULT_HBM_GBPS)
-        runner = CampaignRunner(prog, strategy_name=strategy or "TMR",
-                                profile=profiler)
-        warm = min(args.batch_size, args.t)
-        runner.run(warm, seed=1, batch_size=args.batch_size)   # compile
-        res = runner.run(args.t, seed=args.seed,
-                         batch_size=args.batch_size)
-        summ = res.summary()
-        prof = summ["profile"]
-        gap = abs(prof["wall_s"] - prof["device_busy_s"]
-                  - prof["host_gap_s"] - prof["host_other_s"])
-        if gap > SUM_TOL_S + 0.01 * prof["wall_s"]:
-            print(f"Error, {tid}: attribution does not sum to wall "
-                  f"clock (off by {gap:.4f}s of {prof['wall_s']:.4f}s)",
-                  file=sys.stderr)
-            rc = 1
-        print("\n".join(_report_lines(tid, summ)))
-        doc["targets"][tid] = {
-            "benchmark": res.benchmark, "strategy": res.strategy,
-            "injections": int(res.n),
-            "injections_per_sec": summ["injections_per_sec"],
-            "counts": {k: int(v) for k, v in res.counts.items()},
-            "profile": summ["profile"],
-            "mfu": summ.get("mfu"),
-            "stages": summ["stages"],
-        }
-        last_runner = runner
+        # --fuse-step: the baseline arm runs as-is, then the identical
+        # campaign (same benchmark, seeds, batch geometry) under the
+        # fused engine; the artifact keeps both target entries plus the
+        # headline A/B block the perf docs quote.
+        arms = ([(tid, opt or "-TMR")] if not args.fuse_step else
+                [(tid, opt or "-TMR"),
+                 (tid + "+fused", (opt or "-TMR") + " -fuseStep")])
+        for arm_tid, arm_opt in arms:
+            prog, strategy = build_program(bench, arm_opt)
+            profiler = CampaignProfiler(
+                prog, peak_gflops=args.peak_gflops,
+                hbm_gbps=args.hbm_gbps or DEFAULT_HBM_GBPS)
+            runner = CampaignRunner(prog, strategy_name=strategy or "TMR",
+                                    profile=profiler)
+            warm = min(args.batch_size, args.t)
+            runner.run(warm, seed=1, batch_size=args.batch_size)  # compile
+            res = runner.run(args.t, seed=args.seed,
+                             batch_size=args.batch_size)
+            summ = res.summary()
+            prof = summ["profile"]
+            gap = abs(prof["wall_s"] - prof["device_busy_s"]
+                      - prof["host_gap_s"] - prof["host_other_s"])
+            if gap > SUM_TOL_S + 0.01 * prof["wall_s"]:
+                print(f"Error, {arm_tid}: attribution does not sum to "
+                      f"wall clock (off by {gap:.4f}s of "
+                      f"{prof['wall_s']:.4f}s)", file=sys.stderr)
+                rc = 1
+            print("\n".join(_report_lines(arm_tid, summ)))
+            doc["targets"][arm_tid] = {
+                "benchmark": res.benchmark, "strategy": res.strategy,
+                "injections": int(res.n),
+                "injections_per_sec": summ["injections_per_sec"],
+                "counts": {k: int(v) for k, v in res.counts.items()},
+                "profile": summ["profile"],
+                "mfu": summ.get("mfu"),
+                "stages": summ["stages"],
+            }
+            last_runner = runner
+        if args.fuse_step:
+            base = doc["targets"][tid]
+            fused = doc["targets"][tid + "+fused"]
+            ab = {"counts_identical": base["counts"] == fused["counts"]}
+            for arm_name, arm in (("unfused", base), ("fused", fused)):
+                m = arm.get("mfu") or {}
+                ab[arm_name] = {
+                    "flops_overhead": m.get("flops_overhead"),
+                    "achieved_mfu": m.get("achieved_mfu"),
+                    "program_ops_per_run": m.get("program_ops_per_run"),
+                    "injections_per_sec": arm["injections_per_sec"]}
+            bo = ab["unfused"]["flops_overhead"]
+            fo = ab["fused"]["flops_overhead"]
+            if bo and fo:
+                ab["overhead_reduction_x"] = round(bo / fo, 3)
+            doc["fused_ab"][tid] = ab
+            print(f"  fused A/B: overhead {bo}x -> {fo}x "
+                  f"({ab.get('overhead_reduction_x', '-')}x reduction), "
+                  f"counts identical: {ab['counts_identical']}")
+            if not ab["counts_identical"]:
+                print(f"Error, {tid}: fused arm changed campaign counts",
+                      file=sys.stderr)
+                rc = 1
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as fh:

@@ -122,6 +122,21 @@ def count_jaxpr_ops(jaxpr, while_trip: int = 1) -> float:
         elif name == "cond":
             total += max(count_jaxpr_ops(b, while_trip)
                          for b in eqn.params["branches"])
+        elif name == "pallas_call":
+            # A Pallas kernel runs its jaxpr once PER GRID STEP; the
+            # generic recursion below would count the kernel body once
+            # and silently undercount a fused program's op budget by the
+            # grid size (overstating its MFU).  Grid layout lives in
+            # params["grid_mapping"] on current JAX; older layouts carry
+            # a bare params["grid"].
+            inner = count_jaxpr_ops(eqn.params["jaxpr"], while_trip)
+            gm = eqn.params.get("grid_mapping")
+            grid = (getattr(gm, "grid", None) if gm is not None
+                    else eqn.params.get("grid")) or ()
+            trips = 1
+            for d in grid:
+                trips *= max(int(d), 1)
+            total += inner * trips
         else:
             # pjit / closed_call / custom_jvp / remat / checkpoint ...:
             # recurse into any jaxpr-valued param; everything else

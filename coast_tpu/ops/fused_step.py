@@ -1,0 +1,436 @@
+"""Fused protected-step path: the in-step overhead collapse (-fuseStep).
+
+PR 15's profiler pinned the attribution: campaigns are device-bound with
+~zero host gap, yet achieved MFU sits far below the voter-traffic
+roofline because the waste lives *inside* the compiled step -- 19.98x /
+9.82x FLOPs overhead for mm x TMR / DWC, dominated by per-step work that
+is provably identity (``artifacts/profile_mm.json``, docs/perf.md
+"Attribution").  This module holds the fused-step machinery the engine
+(passes/dataflow_protection.py) activates under
+``ProtectionConfig.fuse_step``:
+
+  * :class:`FusePlan` / :func:`build_plan` -- the static plan: which
+    per-step ops are provably identity and get pruned, which loop shape
+    applies, how the flip lowers.  Every pruning below is bit-identity-
+    preserving by construction (the differential pin: dense campaign
+    ndjson is sha-equal fused vs unfused, tests/test_fused.py):
+
+      - *done-cone pruning*: ``region.done`` is evaluated on a voted
+        view of EVERY replicated leaf, but its jaxpr consumes only the
+        control cone (mm: the single scalar ``i``).  Voting a leaf the
+        predicate never reads cannot change ``done_now`` (votes are
+        pure); leaves outside the cone pass a sanctioned lane-0 view.
+      - *freeze pruning*: the halt freeze ``where(commit_halt, old,
+        new)`` is identity for leaves whose stepped value provably
+        equals their pre-step value -- not written, not commit-voted,
+        not pre-step repaired.  Those leaves commit ``pstate[name]``
+        directly (bit-equal even mid-flip: the flip lands on ``pstate``
+        before the step, and the lane passthrough preserves it).
+      - *sparse flip*: the per-site XOR costs one select+XOR over every
+        word of every leaf per step under the hoisted masks; the sparse
+        form dynamic-slices the single target word, XORs a scalar, and
+        writes it back -- a handful of scalar ops per leaf.  Off-TPU
+        only: dynamic-index scatter under a vmapped batch serialises on
+        TPU (ops/bitflip.py), so the TPU path keeps the masked XOR and
+        fuses it into the Pallas commit kernel instead.
+      - *packed latches*: the five terminal latches (done / dwc / cfc /
+        stack / assert) carry as bits of one uint32 word, collapsing
+        the per-trip scalar OR-chain (``_halted`` = 4 ORs -> ``latch !=
+        0``; the boundary gate = 4 ANDs -> one compare).
+      - *bounded scan*: when ``region.max_steps == region.nominal_steps``
+        the early-exit ``while_loop`` buys nothing (a batch pays the
+        watchdog bound anyway) and ``lax.scan`` drops the per-trip cond
+        evaluation; the freeze makes post-halt trips value-identical.
+
+    The prunings above are proven identity over the *values the program
+    computes* -- which is only the whole story when the region's
+    dataflow is exact (integer/bool leaves).  Float dataflow is not
+    schedule-independent at the bit level: XLA's fusion clustering and
+    FMA/reduction lowering legitimately re-round differently for
+    different surrounding programs, so ANY restructuring -- even one
+    that touches no float op, like packing the scalar latches -- can
+    shift a float leaf by 1 ulp, and an iterated region (training)
+    amplifies that ulp into a different classification.  Measured, not
+    hypothetical: the same train_mlp fault classifies differently under
+    ``jit(scan(body))`` vs ``jit(while(body))`` of the IDENTICAL
+    unfused body.  ``FusePlan.exact_dataflow`` is therefore the master
+    eligibility gate: regions with any floating/complex leaf keep the
+    legacy schedule bit-for-bit (the engine leaves ``_fuse_plan``
+    unset), while ``fuse_step`` still participates in campaign identity
+    (inject/journal.py) -- the knob records the requested engine, the
+    plan records what the region's numerics allow.
+
+  * :func:`make_sparse_flipper` -- the sparse flip lowering (exact
+    ops/bitflip.py semantics, different cost model).
+
+  * :func:`vote_flip_commit` / the Pallas commit kernel -- the
+    data-plane fusion: per-site XOR flip application, majority/compare
+    reduction, miscompare flag, and the TMR repair broadcast in ONE
+    VMEM pass per eligible leaf (extending ops/pallas_voters.py, which
+    reads the replica set once for the vote and leaves the repair
+    broadcast and the flip as separate XLA passes).  Replica compute
+    between kernel invocations stays XLA-scheduled -- the kernel owns
+    the replica data plane, the packed-latch restructure owns the
+    scalar plane.  ``interpret=True`` runs the same kernel everywhere
+    for the differential tests; the on-chip wiring is gated on the
+    bench spawn-wedge fix landing a reachable TPU backend (bench.py).
+
+The portable restructured-scan fallback (prunings + packed latches +
+sparse flip) is the path that must win on every backend; the measured
+A/B lives in ``artifacts/profile_mm.json`` (``make profile``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from coast_tpu.ir.region import Region, State
+
+try:  # pallas is TPU-only at runtime but importable everywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover - minimal builds
+    _HAVE_PALLAS = False
+
+__all__ = [
+    "FusePlan", "build_plan", "done_cone", "flags_init", "unpack_latch",
+    "latch_or", "latch_get", "make_sparse_flipper", "vote_flip_commit",
+    "LATCH_DONE", "LATCH_DWC", "LATCH_CFC", "LATCH_STACK", "LATCH_ASSERT",
+    "LATCH_DONE_ONLY",
+]
+
+# Latch word bit assignment (stable: the journal/rec extraction and the
+# boundary gate compare against these).
+LATCH_DONE = 0
+LATCH_DWC = 1
+LATCH_CFC = 2
+LATCH_STACK = 3
+LATCH_ASSERT = 4
+
+#: ``latch == LATCH_DONE_ONLY`` <=> completed with zero fault latches --
+#: the region-boundary ``reached_call`` gate as one compare.
+LATCH_DONE_ONLY = 1 << LATCH_DONE
+
+_LATCH_NAMES = (("done", LATCH_DONE), ("dwc_fault", LATCH_DWC),
+                ("cfc_fault", LATCH_CFC), ("stack_fault", LATCH_STACK),
+                ("assert_fault", LATCH_ASSERT))
+
+
+def flags_init() -> Dict[str, jax.Array]:
+    """Fused-mode flags: the five bool latches packed into one uint32
+    word; the counters stay separate int32 accumulators."""
+    return {
+        "latch": jnp.uint32(0),
+        "tmr_cnt": jnp.int32(0),
+        "sync_cnt": jnp.int32(0),
+        "steps": jnp.int32(0),
+    }
+
+
+def latch_or(latch: jax.Array, bit: int, cond: jax.Array) -> jax.Array:
+    """OR ``cond`` into latch bit ``bit`` (the packed analogue of the
+    engine's ``logical_or`` flag updates)."""
+    word = cond.astype(jnp.uint32)
+    if bit:
+        word = word << bit
+    return latch | word
+
+
+def latch_get(latch: jax.Array, bit: int) -> jax.Array:
+    """Read one latch bit back as a bool."""
+    word = latch
+    if bit:
+        word = word >> bit
+    return (word & jnp.uint32(1)) != 0
+
+
+def unpack_latch(flags: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Expand the packed flags word back to the engine's historical flag
+    dict -- the run-record extraction point (one-time cost per run)."""
+    latch = flags["latch"]
+    out = {name: latch_get(latch, bit) for name, bit in _LATCH_NAMES}
+    out["tmr_cnt"] = flags["tmr_cnt"]
+    out["sync_cnt"] = flags["sync_cnt"]
+    out["steps"] = flags["steps"]
+    return out
+
+
+# -- the static plan ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FusePlan:
+    """Static decisions of the fused-step build, derived once at
+    ProtectedProgram construction.  Every field is a pruning/lowering
+    choice proven bit-identity-preserving (module docstring)."""
+
+    #: Region-spec leaves the ``done()`` predicate's jaxpr actually
+    #: consumes: only these are voted in the per-step terminator view.
+    done_leaves: FrozenSet[str]
+    #: Region-spec leaves whose committed value can differ from their
+    #: pre-step image (written, commit-voted, or pre-step repaired):
+    #: only these keep the halt-freeze ``where``.
+    frozen_leaves: FrozenSet[str]
+    #: Lower the per-site XOR via dynamic word slices (non-TPU backends)
+    #: instead of the hoisted full-leaf masks.
+    sparse_flip: bool
+    #: Replace the early-exit while_loop with a fixed-trip lax.scan
+    #: (sound whenever max_steps == nominal_steps: there is no early
+    #: exit to exploit and post-halt trips are frozen no-ops).
+    bounded_scan: bool
+    #: Master eligibility gate: True iff every region leaf is exact
+    #: (integer/bool) dataflow.  Float leaves re-round under ANY
+    #: program restructuring (XLA fusion/FMA lowering is context
+    #: dependent), so the engine activates the fused schedule only when
+    #: this holds -- otherwise the build keeps the legacy program
+    #: bit-for-bit and the knob only marks campaign identity.
+    exact_dataflow: bool = True
+
+
+def done_cone(region: Region) -> FrozenSet[str]:
+    """Leaves consumed by ``region.done``'s jaxpr: a backward liveness
+    walk from the predicate's outputs.  Falls back to every leaf (the
+    unfused behaviour, always sound) if the trace fails."""
+    try:
+        from jax.extend.core import Literal
+        state = jax.eval_shape(region.init)
+        names = sorted(state)
+        closed = jax.make_jaxpr(region.done)(state)
+        jaxpr = closed.jaxpr
+        if len(jaxpr.invars) != len(names):
+            return frozenset(names)
+        needed = set(map(id, jaxpr.outvars))
+        for eqn in reversed(jaxpr.eqns):
+            if any(id(ov) in needed for ov in eqn.outvars):
+                # Conservative: a live equation keeps every operand
+                # (sub-jaxpr params close over eqn.invars, so this also
+                # covers scan/cond/pjit bodies).
+                needed.update(id(iv) for iv in eqn.invars
+                              if not isinstance(iv, Literal))
+        return frozenset(name for name, var in zip(names, jaxpr.invars)
+                         if id(var) in needed)
+    except Exception:            # noqa: BLE001 - pruning must not break builds
+        return frozenset(jax.eval_shape(region.init))
+
+
+def build_plan(prog) -> FusePlan:
+    """Derive the fused-step plan for a built ProtectedProgram."""
+    region = prog.region
+    flow = prog.flow
+    names = list(region.spec)
+
+    cone = done_cone(region)
+
+    if region.wants_fns():
+        # Sub-function wrappers can mutate state outside the provenance
+        # pass's written-set view; keep the full freeze (the prunings
+        # below each degrade independently and stay bit-identical).
+        frozen = frozenset(names)
+    else:
+        frozen = frozenset(
+            name for name in names
+            if (name in flow.written
+                or prog.step_sync.get(name, False)
+                or prog.pre_sync.get(name, False)))
+
+    # Exactness: bit-parity of a restructured schedule is provable only
+    # when no leaf carries rounding state.  eval_shape avoids
+    # materializing the init state just to read dtypes.
+    state = jax.eval_shape(region.init)
+    exact = not any(
+        jnp.issubdtype(leaf.dtype, jnp.floating)
+        or jnp.issubdtype(leaf.dtype, jnp.complexfloating)
+        for leaf in jax.tree.leaves(state))
+
+    return FusePlan(
+        done_leaves=cone,
+        frozen_leaves=frozen,
+        # Dynamic-index scatter under a vmapped batch serialises on TPU
+        # (ops/bitflip.py): the TPU path keeps the masked XOR (fused
+        # into the Pallas commit kernel); everywhere else the sparse
+        # word slice wins by ~2 orders of magnitude in per-step ops.
+        sparse_flip=jax.default_backend() != "tpu",
+        bounded_scan=region.max_steps == region.nominal_steps,
+        exact_dataflow=exact,
+    )
+
+
+# -- sparse flip lowering ----------------------------------------------------
+
+def make_sparse_flipper(leaf_order: List[str]):
+    """Sparse lowering of ops/bitflip.py's maskwise flip: identical
+    semantics (one-hot XOR of word ``lane*words_per_lane + word``, XOR 0
+    for every non-target leaf), but per step it costs a 1-word dynamic
+    slice + scalar XOR + write-back per leaf instead of a select+XOR
+    over every word of every leaf.  Data-movement ops are free in the
+    analytic op model and cheap in XLA; the masked path's per-word
+    selects were ~1/3 of the whole fused-step budget."""
+
+    def build_site(state: State, replicated: Dict[str, bool],
+                   leaf_id: jax.Array, lane: jax.Array, word: jax.Array,
+                   bit: jax.Array):
+        """Per-leaf (flat word index, xor word) pairs, built once
+        outside the loop (step-invariant, like build_masks)."""
+        one = jnp.left_shift(jnp.uint32(1), bit.astype(jnp.uint32))
+        site = {}
+        for i, name in enumerate(leaf_order):
+            arr = state[name]
+            nwords = 1
+            for d in arr.shape:
+                nwords *= int(d)
+            if replicated[name]:
+                words_per_lane = nwords // arr.shape[0]
+                idx = lane * words_per_lane + word
+            else:
+                idx = word
+            # Zero unless this leaf is the target: XOR 0 keeps the
+            # program uniform (no lax.switch over leaves).
+            site[name] = (idx,
+                          jnp.where(leaf_id == i, one, jnp.uint32(0)))
+        return site
+
+    def apply_site(state: State, site, enable: jax.Array) -> State:
+        new: State = {}
+        for name in leaf_order:
+            arr = state[name]
+            idx, mask = site[name]
+            u32 = jax.lax.bitcast_convert_type(arr, jnp.uint32)
+            flat = u32.reshape(-1)
+            cur = jax.lax.dynamic_slice(flat, (idx,), (1,))
+            hit = cur ^ jnp.where(enable, mask, jnp.uint32(0))
+            flat = jax.lax.dynamic_update_slice(flat, hit, (idx,))
+            new[name] = jax.lax.bitcast_convert_type(
+                flat.reshape(u32.shape), arr.dtype)
+        return new
+
+    return build_site, apply_site
+
+
+# -- the Pallas commit kernel ------------------------------------------------
+
+def _commit_kernel(n_lanes: int, in_ref, mask_ref, lanes_ref, voted_ref,
+                   mis_ref):
+    """One VMEM pass over a replica-set tile: XOR the per-site flip mask
+    in, vote/compare, write the repaired lanes, the voted value, and the
+    per-tile miscompare flag block.
+
+    Mirrors ops/pallas_voters.py's ``_vote_kernel`` discipline: per-tile
+    flag BLOCKS (any-reduced by the caller), no cross-step accumulation
+    and no ``program_id`` reads -- both break when a vmapped campaign
+    batch prepends its axis to the grid.
+    """
+    lanes = in_ref[:]
+    bits = jax.lax.bitcast_convert_type(lanes, jnp.uint32) ^ mask_ref[:]
+    flipped = jax.lax.bitcast_convert_type(bits, lanes.dtype)
+    l0, l1 = flipped[0], flipped[1]
+    if n_lanes == 3:
+        l2 = flipped[2]
+        agree01 = l0 == l1
+        voted = jnp.where(agree01, l0, l2)
+        mismatch = jnp.logical_or(jnp.logical_not(jnp.all(agree01)),
+                                  jnp.logical_not(jnp.all(l1 == l2)))
+        repaired = jnp.broadcast_to(voted[None], flipped.shape)
+    else:
+        voted = l0
+        mismatch = jnp.logical_not(jnp.all(l0 == l1))
+        # DWC has no majority: detection only, lanes commit as flipped.
+        repaired = flipped
+    lanes_ref[:] = repaired
+    voted_ref[:] = voted
+    # Per-tile flag block, same discipline as _vote_kernel: no cross-
+    # step accumulation, no pl.program_id (both break under a vmapped
+    # campaign batch, which prepends its axis to the grid).
+    mis_ref[:] = jnp.broadcast_to(mismatch.astype(jnp.int32), (1, 8, 128))
+
+
+def _tile_rows(n: int, m: int, k: int) -> int:
+    """Row-tile height: whole rows, ~2 MiB of VMEM for the n-lane input
+    block, must divide m (pallas_voters._tm with the lane count as a
+    parameter: the fused kernel streams TWO n-lane blocks per step)."""
+    budget_rows = max(8, (2 * 1024 * 1024) // (n * 4 * k) // 8 * 8)
+    tm = min(m, budget_rows)
+    while m % tm:
+        tm -= 8            # m % 8 == 0 (kernel_eligible) -> terminates at 8
+    return tm
+
+
+def kernel_eligible(lanes_shape: Tuple[int, ...]) -> bool:
+    """Same shape contract as ops/pallas_voters.eligible, minus the
+    backend gate (interpret mode runs the kernel anywhere)."""
+    if not _HAVE_PALLAS or len(lanes_shape) != 3:
+        return False
+    n, m, k = lanes_shape
+    return (n in (2, 3) and m % 8 == 0 and k % 128 == 0
+            and m * k >= 16384)
+
+
+@functools.partial(jax.jit, static_argnames=("num_clones", "interpret"))
+def _vote_flip_call(lanes, masks, num_clones: int, interpret: bool):
+    n, m, k = lanes.shape
+    tm = _tile_rows(n, m, k)
+    kernel = functools.partial(_commit_kernel, num_clones)
+    repaired, voted, mis = pl.pallas_call(
+        kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((n, tm, k), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, tm, k), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, tm, k), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, m, k), lanes.dtype),
+            jax.ShapeDtypeStruct((m, k), lanes.dtype),
+            jax.ShapeDtypeStruct((m // tm, 8, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(lanes, masks)
+    return repaired, voted, jnp.any(mis != 0)
+
+
+def vote_flip_commit(lanes: jax.Array, masks: Optional[jax.Array],
+                     num_clones: int, interpret: bool = False
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused commit for one replica set: apply the (already fire-gated)
+    per-site XOR ``masks``, vote/compare, repair.  Returns ``(lanes,
+    voted, miscompare)`` -- the repaired replica set, the collapsed
+    value, and the bool flag.
+
+    Eligible shapes go through the Pallas kernel (one VMEM pass instead
+    of the separate flip / vote / repair-broadcast XLA passes); anything
+    else falls back to the jnp composition, which is also the
+    differential reference the kernel is pinned against
+    (tests/test_fused.py, interpret mode)."""
+    from coast_tpu.ops import voters
+
+    if masks is None:
+        masks = jnp.zeros(lanes.shape, jnp.uint32)
+    use_kernel = kernel_eligible(tuple(lanes.shape)) and (
+        interpret or jax.default_backend() == "tpu")
+    if use_kernel:
+        from jax.ad_checkpoint import checkpoint_name
+        # Same sanction marker the jnp voters carry (voters.TAG_VOTER):
+        # the lane collapse happens inside the opaque Pallas kernel.
+        lanes = checkpoint_name(lanes, voters.TAG_VOTER)
+        return _vote_flip_call(lanes, masks, num_clones, interpret)
+    bits = jax.lax.bitcast_convert_type(lanes, jnp.uint32) ^ masks
+    flipped = jax.lax.bitcast_convert_type(bits, lanes.dtype)
+    voted, mis = voters.vote(flipped, num_clones)
+    if num_clones == 3:
+        repaired = jnp.broadcast_to(voted, flipped.shape)
+    else:
+        repaired = flipped
+    return repaired, voted, mis

@@ -43,6 +43,7 @@ _BOOL_FLAGS = {
     "storeDataSync", "countErrors", "reportErrors", "countSyncs",
     "i", "s", "verbose", "noMain", "noCloneOpsCheck",
     "protectStack", "pallasVoters", "noPallasVoters",
+    "fuseStep", "noFuseStep",
     # Utility passes (SURVEY.md §2.1 #6-#8), stackable with any strategy:
     # -DebugStatements (block trace), -SmallProfile (+ -noPrint), -ExitMarker.
     "DebugStatements", "SmallProfile", "noPrint", "ExitMarker",
@@ -155,6 +156,16 @@ def build_overrides(flags: Dict[str, object]) -> Dict[str, object]:
         overrides["pallas_voters"] = True
     elif flags.get("noPallasVoters"):
         overrides["pallas_voters"] = False
+    # Fused protected step: default off (the unfused interpreter loop is
+    # the reference program); -noFuseStep exists so schedules that set
+    # fuse_step by config can be bisected back to the baseline.
+    if flags.get("fuseStep") and flags.get("noFuseStep"):
+        raise UsageError(
+            "-fuseStep and -noFuseStep are mutually exclusive")
+    if flags.get("fuseStep"):
+        overrides["fuse_step"] = True
+    elif flags.get("noFuseStep"):
+        overrides["fuse_step"] = False
     return overrides
 
 

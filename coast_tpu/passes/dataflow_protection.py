@@ -109,6 +109,16 @@ class ProtectionConfig:
     # the README advertises should be what default campaigns run), OFF
     # elsewhere.  The CLI flag forces it on; pass False to force it off.
     pallas_voters: "bool | None" = None
+    # -fuseStep: the fused protected-step path (ops/fused_step.py).  The
+    # engine derives a static FusePlan and prunes the per-step work that
+    # is provably identity -- done-cone-only terminator votes, freeze
+    # wheres on leaves whose commit equals their pre-step image, a
+    # sparse one-word flip off-TPU, the five bool latches packed into
+    # one uint32 word, and while_loop -> bounded scan where max_steps ==
+    # nominal_steps.  Outputs are bit-identical to the unfused engine
+    # (the dense-ndjson differential pin, tests/test_fused.py); fuse
+    # mode is campaign identity in the journal header (absent = off).
+    fuse_step: bool = False
     # -isrFunctions: interrupt handlers excluded from cloning.  There is no
     # interrupt concept in a stepped TPU region; a non-empty list is a hard
     # configuration error (refused, not silently inert).
@@ -187,7 +197,11 @@ def _flags_init(cfg: ProtectionConfig) -> Dict[str, jax.Array]:
 
 def _halted(flags: Dict[str, jax.Array]) -> jax.Array:
     """A run stops evolving once ANY terminal latch is set: completion,
-    DWC/CFCSS abort, or a tripped kernel guard."""
+    DWC/CFCSS abort, or a tripped kernel guard.  Fused builds carry the
+    five latches packed in one uint32 word (ops/fused_step.py), so the
+    four-OR chain collapses to a single compare."""
+    if "latch" in flags:
+        return flags["latch"] != 0
     return (flags["done"] | flags["dwc_fault"] | flags["cfc_fault"]
             | flags["stack_fault"] | flags["assert_fault"])
 
@@ -227,6 +241,9 @@ class ProtectedProgram:
         # of syncGEP, synchronization.cpp:413-474).
         from coast_tpu.passes.verification import analyze
         flow = analyze(region)
+        # Kept for the fused-step planner (ops/fused_step.build_plan)
+        # and anyone else needing the provenance roles post-build.
+        self.flow = flow
         # Sync-point table: which replicated leaves get voted at the commit
         # boundary each step (post-step), and which get a pre-step vote.
         self.step_sync: Dict[str, bool] = {}
@@ -338,6 +355,38 @@ class ProtectedProgram:
             # the passes.cfcss -> dataflow_protection import cycle.
             from coast_tpu.passes.cfcss import apply_cfcss
             apply_cfcss(self)
+        # Fused-step plan (-fuseStep): derived LAST so the planner sees
+        # the final leaf_order/sync tables (CFCSS leaves included).
+        # The plan's exact_dataflow gate decides whether the fused
+        # schedule ACTIVATES: float regions re-round under any program
+        # restructuring (XLA fusion/FMA lowering is context dependent),
+        # so they keep the legacy program bit-for-bit while cfg.fuse_step
+        # still marks campaign identity (ops/fused_step.py docstring).
+        self._fuse_plan = None
+        self._sparse_flip = None
+        self.fuse_plan_info = None
+        if cfg.fuse_step:
+            from coast_tpu.ops import fused_step
+            plan = fused_step.build_plan(self)
+            self.fuse_plan_info = plan
+            if plan.exact_dataflow:
+                self._fuse_plan = plan
+                if plan.sparse_flip:
+                    self._sparse_flip = fused_step.make_sparse_flipper(
+                        self.leaf_order)
+
+    def unfused_twin(self) -> "ProtectedProgram":
+        """The identical build with ``fuse_step`` off.  The fused step is
+        differentially pinned bit-identical to this twin, so the static
+        analyses (equiv partition, vulnerability map, isolation prover)
+        walk the twin's jaxpr: every partition fingerprint, merge mode,
+        and proof is unchanged by fusion -- which is what keeps fused
+        campaigns journal/equiv-compatible artifacts apart from their
+        own ``fuse`` header key."""
+        if not self.cfg.fuse_step:
+            return self
+        return ProtectedProgram(
+            self.region, dataclasses.replace(self.cfg, fuse_step=False))
 
     # -- CFCSS stacking (passes.cfcss) --------------------------------------
     def install_cfcss(self, init_fn, step_fn, tables) -> None:
@@ -391,6 +440,9 @@ class ProtectedProgram:
         }
         if self._cfcss_init is not None:
             pstate.update(self._cfcss_init())
+        if self._fuse_plan is not None:
+            from coast_tpu.ops import fused_step
+            return pstate, fused_step.flags_init()
         return pstate, _flags_init(self.cfg)
 
     def _sync_class_of(self, name: str) -> str:
@@ -537,8 +589,21 @@ class ProtectedProgram:
         # block body commits.
         if self._cfcss_step is not None:
             merged = {**pstate, **region_state}
-            merged, flags = self._cfcss_step(merged, flags, t, halted)
-            halted = jnp.logical_or(halted, flags["cfc_fault"])
+            if self._fuse_plan is not None:
+                # Packed-latch marshal: the hook's contract is the bool
+                # flag dict; only the cfc bit crosses it, so unpack and
+                # re-OR exactly that bit around the call.
+                from coast_tpu.ops import fused_step as _fs
+                shim = {"cfc_fault": _fs.latch_get(flags["latch"],
+                                                   _fs.LATCH_CFC)}
+                merged, shim = self._cfcss_step(merged, shim, t, halted)
+                flags = {**flags,
+                         "latch": _fs.latch_or(flags["latch"], _fs.LATCH_CFC,
+                                               shim["cfc_fault"])}
+                halted = jnp.logical_or(halted, shim["cfc_fault"])
+            else:
+                merged, flags = self._cfcss_step(merged, flags, t, halted)
+                halted = jnp.logical_or(halted, flags["cfc_fault"])
             # Only the CFCSS runtime leaves (signature tracker, previous
             # block) carry the hook's updates back; the pre-step vote
             # repairs stay local to this step's execution so the frozen
@@ -573,11 +638,18 @@ class ProtectedProgram:
                     jax.vmap(self.region.assert_guard)(gview))
             trip_stack = jnp.logical_and(~halted, trip_stack)
             trip_assert = jnp.logical_and(~halted, trip_assert)
-            flags = {**flags,
-                     "stack_fault": jnp.logical_or(flags["stack_fault"],
-                                                   trip_stack),
-                     "assert_fault": jnp.logical_or(flags["assert_fault"],
-                                                    trip_assert)}
+            if self._fuse_plan is not None:
+                from coast_tpu.ops import fused_step as _fs
+                latch = _fs.latch_or(flags["latch"], _fs.LATCH_STACK,
+                                     trip_stack)
+                latch = _fs.latch_or(latch, _fs.LATCH_ASSERT, trip_assert)
+                flags = {**flags, "latch": latch}
+            else:
+                flags = {**flags,
+                         "stack_fault": jnp.logical_or(flags["stack_fault"],
+                                                       trip_stack),
+                         "assert_fault": jnp.logical_or(flags["assert_fault"],
+                                                        trip_assert)}
         trip_now = jnp.logical_or(trip_stack, trip_assert)
 
         # Call-boundary syncs executed by function-scope wrappers inside the
@@ -715,8 +787,15 @@ class ProtectedProgram:
         if miscompares and cfg.num_clones == 2:
             mis_any = jnp.any(jnp.stack(miscompares))
             fault_now = jnp.logical_and(~halted, mis_any)
-            flags = {**flags,
-                     "dwc_fault": jnp.logical_or(flags["dwc_fault"], fault_now)}
+            if self._fuse_plan is not None:
+                from coast_tpu.ops import fused_step as _fs
+                flags = {**flags,
+                         "latch": _fs.latch_or(flags["latch"], _fs.LATCH_DWC,
+                                               fault_now)}
+            else:
+                flags = {**flags,
+                         "dwc_fault": jnp.logical_or(flags["dwc_fault"],
+                                                     fault_now)}
         elif miscompares and cfg.num_clones == 3 and cfg.count_errors:
             mis_cnt = jnp.sum(jnp.stack(miscompares).astype(jnp.int32))
             flags = {**flags,
@@ -732,35 +811,73 @@ class ProtectedProgram:
 
         # Terminator: evaluate done() on the voted view, *before* committing,
         # so a single corrupted lane cannot steer control flow
-        # (syncTerminator votes branch predicates, :741-1113).
+        # (syncTerminator votes branch predicates, :741-1113).  Fused
+        # builds vote only the predicate's dataflow cone (FusePlan
+        # .done_leaves): a vote on a leaf done() never reads is pure and
+        # cannot change done_now -- the pruning the profiler attributed
+        # ~1/4 of the whole per-step op budget to.
         commit_halt = jnp.logical_or(halted, fault_now)
-        done_now = self.region.done(self._voted_view(new_state))
+        done_only = (self._fuse_plan.done_leaves
+                     if self._fuse_plan is not None else None)
+        done_now = self.region.done(self._voted_view(new_state,
+                                                     only=done_only))
         # A step whose kernel guard tripped still commits (the blown-stack
         # image is the memory a debugger reads at the hook) but cannot
         # reach completion: the hook preempts the guest before any success
         # line, exactly like the reference's overflow/assert hooks.
         done_gate = jnp.logical_and(~commit_halt, ~trip_now)
-        flags = {**flags,
-                 "done": jnp.logical_or(flags["done"],
-                                        jnp.logical_and(done_gate, done_now)),
-                 "steps": flags["steps"] + jnp.where(commit_halt, 0, 1)}
+        if self._fuse_plan is not None:
+            from coast_tpu.ops import fused_step as _fs
+            flags = {**flags,
+                     "latch": _fs.latch_or(flags["latch"], _fs.LATCH_DONE,
+                                           jnp.logical_and(done_gate,
+                                                           done_now)),
+                     "steps": flags["steps"] + jnp.where(commit_halt, 0, 1)}
+        else:
+            flags = {**flags,
+                     "done": jnp.logical_or(flags["done"],
+                                            jnp.logical_and(done_gate,
+                                                            done_now)),
+                     "steps": flags["steps"] + jnp.where(commit_halt, 0, 1)}
 
         # Freeze state once halted (DWC abort semantics in a batch: the run's
         # memory image stops evolving the step the fault latches -- and the
-        # fault step itself never commits, check-before-store).
-        new_state = jax.tree.map(
-            lambda old, new: jnp.where(commit_halt, old, new), pstate, new_state)
+        # fault step itself never commits, check-before-store).  Fused
+        # builds keep the where only on leaves whose stepped value can
+        # actually differ from the pre-step image (FusePlan.frozen_leaves:
+        # written, commit-voted, or pre-step repaired); everything else
+        # commits pstate directly -- bit-equal even mid-flip, since the
+        # flip lands on pstate before the step and the lane passthrough
+        # preserves it.
+        if self._fuse_plan is not None:
+            frozen = self._fuse_plan.frozen_leaves
+            new_state = {
+                name: (jnp.where(commit_halt, pstate[name], val)
+                       if name in frozen else pstate[name])
+                for name, val in new_state.items()}
+        else:
+            new_state = jax.tree.map(
+                lambda old, new: jnp.where(commit_halt, old, new),
+                pstate, new_state)
         return new_state, flags
 
     # -- whole-program runners ---------------------------------------------
-    def _voted_view(self, pstate: State) -> State:
+    def _voted_view(self, pstate: State, only=None) -> State:
         """Collapse lanes for the unprotected consumer of the result -- the
         analogue of checkGolden() being __NO_xMR and reading voted stores
-        (tests/matrixMultiply/matrixMultiply.c checkGolden)."""
+        (tests/matrixMultiply/matrixMultiply.c checkGolden).
+
+        ``only`` (fused builds): vote just the named leaves; the rest read
+        a sanctioned lane-0 view.  Sound exactly when the consumer's
+        dataflow cone is contained in ``only`` (FusePlan.done_leaves for
+        the terminator view) -- a vote is pure, so skipping one on a leaf
+        the consumer never reads cannot change its value."""
         view: State = {}
         for name, arr in pstate.items():
             if not self.replicated[name]:
                 view[name] = arr
+            elif only is not None and name not in only:
+                view[name] = voters.lane_view(arr)
             elif self.cfg.num_clones == 3:
                 view[name] = voters.tmr_vote(arr)[0]
             else:
@@ -816,18 +933,26 @@ class ProtectedProgram:
         # outside the loop (the in-loop iota-compare rebuild measured ~2/3
         # of small-benchmark campaign runtime), leaving one select+XOR per
         # leaf per step -- per SITE for a flip group, each with its own
-        # fire step.
+        # fire step.  Fused builds off-TPU lower the flip sparsely
+        # instead (FusePlan.sparse_flip: one-word dynamic slice + scalar
+        # XOR per leaf, ops/fused_step.make_sparse_flipper -- identical
+        # semantics, ~words-per-leaf fewer ops per step).
+        if self._sparse_flip is not None:
+            build_fn, apply_fn = self._sparse_flip
+        else:
+            build_fn = self._flip.build_masks
+            apply_fn = self._flip.apply_masks
         if fault is None:
             masks = None
         elif n_sites:
-            masks = [self._flip.build_masks(
+            masks = [build_fn(
                          pstate, self.replicated, fault["leaf_id"][g],
                          fault["lane"][g], fault["word"][g], fault["bit"][g])
                      for g in range(n_sites)]
         else:
-            masks = self._flip.build_masks(pstate, self.replicated,
-                                           fault["leaf_id"], fault["lane"],
-                                           fault["word"], fault["bit"])
+            masks = build_fn(pstate, self.replicated,
+                             fault["leaf_id"], fault["lane"],
+                             fault["word"], fault["bit"])
 
         def body(carry, t):
             pstate, flags = carry
@@ -841,12 +966,11 @@ class ProtectedProgram:
                     for g in range(n_sites):
                         fire = jnp.logical_and(t == fault["t"][g],
                                                jnp.logical_not(halted))
-                        pstate = self._flip.apply_masks(pstate, masks[g],
-                                                        fire)
+                        pstate = apply_fn(pstate, masks[g], fire)
                 else:
                     fire = jnp.logical_and(t == fault["t"],
                                            jnp.logical_not(halted))
-                    pstate = self._flip.apply_masks(pstate, masks, fire)
+                    pstate = apply_fn(pstate, masks, fire)
             ys = None
             if trace:
                 if self.region.graph is not None:
@@ -862,6 +986,21 @@ class ProtectedProgram:
             # The per-step trace needs fixed-length stacked outputs.
             (pstate, flags), ys = jax.lax.scan(
                 body, (pstate, flags),
+                jnp.arange(self.region.max_steps, dtype=jnp.int32))
+        elif (self._fuse_plan is not None
+              and self._fuse_plan.bounded_scan):
+            # while_loop -> bounded scan (FusePlan.bounded_scan): when
+            # max_steps == nominal_steps the early exit buys nothing (a
+            # batched while pays the bound anyway) and scan drops the
+            # per-trip cond evaluation.  Post-halt trips are frozen
+            # no-ops, so the record is bit-identical; ``unroll`` does
+            # not apply to the fixed-trip form.
+            def sbody(carry, t):
+                out, _ = body(carry, t)
+                return out, None
+
+            (pstate, flags), _ = jax.lax.scan(
+                sbody, (pstate, flags),
                 jnp.arange(self.region.max_steps, dtype=jnp.int32))
         else:
             # Early exit: stop as soon as the run halts instead of always
@@ -928,24 +1067,46 @@ class ProtectedProgram:
                 mis = jnp.logical_or(mis, m)
                 mis_cnt = mis_cnt + m.astype(jnp.int32)
             # Only a run that completed without ANY detected fault (abort
-            # or kernel-guard trip) reaches the external call.
-            reached_call = jnp.logical_and(
-                flags["done"], jnp.logical_not(flags["dwc_fault"]))
-            reached_call = jnp.logical_and(
-                reached_call, jnp.logical_not(flags["cfc_fault"]))
-            reached_call = jnp.logical_and(
-                reached_call, jnp.logical_not(flags["stack_fault"]))
-            reached_call = jnp.logical_and(
-                reached_call, jnp.logical_not(flags["assert_fault"]))
-            if self.cfg.num_clones == 2:
-                flags = {**flags,
-                         "dwc_fault": jnp.logical_or(
-                             flags["dwc_fault"],
-                             jnp.logical_and(reached_call, mis))}
-            elif self.cfg.count_errors:
-                flags = {**flags,
-                         "tmr_cnt": flags["tmr_cnt"]
-                         + jnp.where(reached_call, mis_cnt, 0)}
+            # or kernel-guard trip) reaches the external call.  Packed
+            # latches make the four-AND gate one equality: done set,
+            # every fault bit clear <=> latch == LATCH_DONE_ONLY.
+            if self._fuse_plan is not None:
+                from coast_tpu.ops import fused_step as _fs
+                reached_call = flags["latch"] == jnp.uint32(
+                    _fs.LATCH_DONE_ONLY)
+                if self.cfg.num_clones == 2:
+                    flags = {**flags,
+                             "latch": _fs.latch_or(
+                                 flags["latch"], _fs.LATCH_DWC,
+                                 jnp.logical_and(reached_call, mis))}
+                elif self.cfg.count_errors:
+                    flags = {**flags,
+                             "tmr_cnt": flags["tmr_cnt"]
+                             + jnp.where(reached_call, mis_cnt, 0)}
+            else:
+                reached_call = jnp.logical_and(
+                    flags["done"], jnp.logical_not(flags["dwc_fault"]))
+                reached_call = jnp.logical_and(
+                    reached_call, jnp.logical_not(flags["cfc_fault"]))
+                reached_call = jnp.logical_and(
+                    reached_call, jnp.logical_not(flags["stack_fault"]))
+                reached_call = jnp.logical_and(
+                    reached_call, jnp.logical_not(flags["assert_fault"]))
+                if self.cfg.num_clones == 2:
+                    flags = {**flags,
+                             "dwc_fault": jnp.logical_or(
+                                 flags["dwc_fault"],
+                                 jnp.logical_and(reached_call, mis))}
+                elif self.cfg.count_errors:
+                    flags = {**flags,
+                             "tmr_cnt": flags["tmr_cnt"]
+                             + jnp.where(reached_call, mis_cnt, 0)}
+
+        if self._fuse_plan is not None:
+            # Expand the packed latch word back to the historical flag
+            # dict once, at record-extraction time.
+            from coast_tpu.ops import fused_step as _fs
+            flags = _fs.unpack_latch(flags)
 
         view = self._voted_view(pstate)
         rec = {

@@ -4,6 +4,12 @@ Mirrors the thin-wrapper passes of the reference: projects/TMR/TMR.cpp:26-36
 (``dataflowProtection::run(M, 3)``), projects/DWC/DWC.cpp:26-36 (``run(M, 2)``)
 and the deprecated projects/EDDI/EDDI.cpp:29-43 which refuses to run and tells
 the user to switch to DWC.
+
+Every ProtectionConfig knob flows through ``**overrides`` unchanged --
+including ``fuse_step=True`` (the fused protected-step engine of
+ops/fused_step.py; ``-fuseStep`` on the opt CLI), which is pinned
+bit-identical to the unfused loop and therefore composes with any
+strategy here.
 """
 
 from __future__ import annotations

@@ -12,7 +12,14 @@ stderr and kept in the artifact under ``benchmarks.<name>.stages`` so
 "which stage dominated" is data, not recollection.
 
 Usage: python scripts/mwtf_report.py [-n 20000] [--benchmarks mm,crc16]
-       [--out artifacts/mwtf_report.json] [--cpu]
+       [--out artifacts/mwtf_report.json] [--cpu] [--fuse-step]
+
+``--fuse-step`` builds the protected programs under the fused engine
+(-fuseStep): every strategy row's ``flops_overhead`` column then reads
+the op count of the program that ACTUALLY ran -- the measured jaxpr
+(obs/roofline) of the fused schedule where the exactness gate activates
+it -- instead of the analytic lanes-x table, and the artifact records
+which source produced the column (``flops_overhead_source``).
 
 Model-sweep mode (``--model-sweep``) is the fault-model degradation
 study: the same protected programs are re-measured under progressively
@@ -202,6 +209,11 @@ def main(argv=None) -> int:
                     "mwtf_report.json; artifacts/faultmodel_study.json "
                     "under --model-sweep)")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--fuse-step", action="store_true",
+                    help="build the protected programs under the fused "
+                    "engine (-fuseStep); the flops_overhead column then "
+                    "reads the fused program's measured op count "
+                    "(flops_overhead_source: measured-jaxpr)")
     ap.add_argument("--model-sweep", action="store_true",
                     help="fault-model degradation study instead of the "
                     "MWTF table: sweep --models over the FIRST benchmark "
@@ -237,8 +249,14 @@ def main(argv=None) -> int:
     for name in args.benchmarks.split(","):
         name = BENCH_ALIASES.get(name.strip(), name.strip())
         region = REGISTRY[name]()
-        progs = {"unprotected": unprotected(region),
-                 "DWC": DWC(region), "TMR": TMR(region)}
+        # Under --fuse-step every arm (the unprotected normalizer too)
+        # runs the fused engine, so the overhead column compares like
+        # schedules -- otherwise the fused DWC program can read BELOW
+        # the unfused single-lane harness.
+        progs = {"unprotected": unprotected(region,
+                                            fuse_step=args.fuse_step),
+                 "DWC": DWC(region, fuse_step=args.fuse_step),
+                 "TMR": TMR(region, fuse_step=args.fuse_step)}
         # Training rows (coast_tpu.train) add the selective-xMR strategy
         # and an analytic per-iteration FLOPs-overhead column next to the
         # measured runtime ratio: overhead is the cost axis the
@@ -248,6 +266,7 @@ def main(argv=None) -> int:
         if train:
             from coast_tpu.train import flops_overhead, selective_xmr
             progs["selective-xMR"] = selective_xmr(region)
+        if train and not args.fuse_step:
             flops_cols = {
                 "unprotected": flops_overhead(region, 1),
                 "DWC": flops_overhead(region, 2),
@@ -313,11 +332,16 @@ def main(argv=None) -> int:
                "stages": stage_blocks,
                "injections_per_sec": {}}
         if not flops_cols:
-            # Non-train rows: the jaxpr-derived generalization (obs/
-            # roofline), normalized by the UNPROTECTED program so the
-            # column reads like train's exact meta table (unprotected
-            # = 1.0) -- the raw vs-region ratio (which includes the
-            # injection-harness ops) stays in the mfu block.
+            # Non-train rows -- and EVERY row under --fuse-step: the
+            # jaxpr-derived generalization (obs/roofline) over the
+            # program that actually ran (the fused schedule where the
+            # exactness gate activates it), normalized by the
+            # UNPROTECTED program so the column reads like train's
+            # exact meta table (unprotected = 1.0) -- the raw vs-region
+            # ratio (which includes the injection-harness ops) stays in
+            # the mfu block.  An analytic lanes-x column would misstate
+            # the fused build's cost by exactly the overhead the fusion
+            # removed.
             base_oh = (mfu_cols.get("unprotected") or {}).get(
                 "flops_overhead")
             flops_cols = {
@@ -325,6 +349,9 @@ def main(argv=None) -> int:
                     if base_oh else mfu_cols[s]["flops_overhead"])
                 for s in mfu_cols
                 if mfu_cols[s].get("flops_overhead")}
+            row["flops_overhead_source"] = "measured-jaxpr"
+        else:
+            row["flops_overhead_source"] = "analytic"
         if flops_cols:
             row["flops_overhead"] = {s: round(v, 4)
                                      for s, v in flops_cols.items()}

@@ -1,0 +1,352 @@
+"""Fused protected-step engine (ops/fused_step.py + fuse_step knob).
+
+The engine's contract is DIFFERENTIAL: fusion is a schedule change,
+never a semantics change.  Every test here compares the fused program
+against the unfused interpreter loop it replaces -- campaign codes AND
+counts across regions, strategies and collection modes; the plan's
+prunings against the region structure that licenses them; the Pallas
+commit kernel against its jnp composition; and the roofline op counter
+against pinned kernel-aware counts.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coast_tpu import DWC, TMR
+from coast_tpu.inject.campaign import CampaignRunner
+from coast_tpu.models import resolve_region
+from coast_tpu.ops import fused_step
+from coast_tpu.passes.strategies import unprotected
+
+REGIONS = ("matrixMultiply", "crc16", "train_mlp")
+STRATEGIES = {"TMR": TMR, "DWC": DWC}
+
+
+def _campaign(region_name, strat, fused, n=48, seed=11, **runner_kw):
+    prog = STRATEGIES[strat](resolve_region(region_name), fuse_step=fused)
+    runner = CampaignRunner(prog, strategy_name=strat, **runner_kw)
+    return runner.run(n, seed=seed, batch_size=n)
+
+
+def _assert_result_parity(a, b):
+    """Codes AND counts (plus the E/F/T columns riding every row)."""
+    assert a.counts == b.counts
+    np.testing.assert_array_equal(a.codes, b.codes)
+    np.testing.assert_array_equal(a.errors, b.errors)
+    np.testing.assert_array_equal(a.corrected, b.corrected)
+    np.testing.assert_array_equal(a.steps, b.steps)
+
+
+# ---------------------------------------------------------------------------
+# campaign bit-parity matrix: regions x strategies x collection modes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strat", sorted(STRATEGIES))
+@pytest.mark.parametrize("region_name", REGIONS)
+def test_dense_campaign_parity(region_name, strat):
+    base = _campaign(region_name, strat, fused=False)
+    fused = _campaign(region_name, strat, fused=True)
+    _assert_result_parity(base, fused)
+
+
+@pytest.mark.parametrize("region_name,strat",
+                         [("matrixMultiply", "TMR"), ("crc16", "DWC")])
+def test_sparse_collect_parity(region_name, strat):
+    base = _campaign(region_name, strat, fused=False, collect="sparse")
+    fused = _campaign(region_name, strat, fused=True, collect="sparse")
+    _assert_result_parity(base, fused)
+
+
+def test_equiv_campaign_parity():
+    """The unfused-twin substitution in the propagation walker makes the
+    partition (and therefore the reduced schedule, weights and section
+    fingerprints) literally identical across engines, so an equiv
+    campaign matches in codes AND effective counts."""
+    region = resolve_region("matrixMultiply")
+    runners = {}
+    for fused in (False, True):
+        runners[fused] = CampaignRunner(
+            TMR(region, fuse_step=fused), strategy_name="TMR", equiv=True)
+    pu, pf = (runners[False].equiv_partition,
+              runners[True].equiv_partition)
+    assert pu.fingerprint == pf.fingerprint
+    assert {n: s.mode for n, s in pu.signatures.items()} == \
+           {n: s.mode for n, s in pf.signatures.items()}
+    a = runners[False].run(256, seed=5, batch_size=256)
+    b = runners[True].run(256, seed=5, batch_size=256)
+    assert a.counts == b.counts
+    np.testing.assert_array_equal(a.codes, b.codes)
+
+
+def test_mesh_campaign_parity():
+    from coast_tpu.parallel.mesh import make_mesh
+    region = resolve_region("matrixMultiply")
+    results = []
+    for fused in (False, True):
+        runner = CampaignRunner(TMR(region, fuse_step=fused),
+                                strategy_name="TMR", mesh=make_mesh(8))
+        results.append(runner.run(64, seed=3, batch_size=64))
+    _assert_result_parity(*results)
+
+
+def test_unprotected_fused_parity():
+    """num_clones=1: no voters at all, but the scan restructuring and
+    freeze pruning still apply and must stay bit-identical."""
+    region = resolve_region("matrixMultiply")
+    results = [
+        CampaignRunner(unprotected(region, fuse_step=f),
+                       strategy_name="unprotected").run(
+            32, seed=7, batch_size=32)
+        for f in (False, True)]
+    _assert_result_parity(*results)
+
+
+# ---------------------------------------------------------------------------
+# journal identity: fuse mode refused typed, absent-means-unfused
+# ---------------------------------------------------------------------------
+
+def test_journal_fuse_mismatch_refused_typed(tmp_path):
+    from coast_tpu.inject.journal import FuseStepMismatchError
+    region = resolve_region("matrixMultiply")
+    for first, second in ((False, True), (True, False)):
+        path = str(tmp_path / f"j{int(first)}.ndjson")
+        CampaignRunner(TMR(region, fuse_step=first),
+                       strategy_name="TMR").run(
+            16, seed=1, batch_size=16, journal=path)
+        with pytest.raises(FuseStepMismatchError):
+            CampaignRunner(TMR(region, fuse_step=second),
+                           strategy_name="TMR").run(
+                16, seed=1, batch_size=16, journal=path)
+
+
+def test_journal_header_absent_means_unfused(tmp_path):
+    """A fused journal carries fuse: true; an unfused one carries NO key
+    at all, so pre-fusion journals keep their exact header byte shape
+    (the absent-means-default evolution rule of fault_model/collect/
+    placement)."""
+    import json
+    from coast_tpu.inject.spec import header_fuse
+    region = resolve_region("matrixMultiply")
+    headers = {}
+    for fused in (False, True):
+        path = str(tmp_path / f"h{int(fused)}.ndjson")
+        CampaignRunner(TMR(region, fuse_step=fused),
+                       strategy_name="TMR").run(
+            16, seed=1, batch_size=16, journal=path)
+        with open(path) as f:
+            headers[fused] = json.loads(f.readline())
+    assert "fuse" not in headers[False]
+    assert headers[True].get("fuse") is True
+    assert header_fuse(headers[False]) is False
+    assert header_fuse(headers[True]) is True
+
+
+def test_config_fingerprint_unchanged_at_default():
+    """Adding the fuse_step field must not perturb the config sha of any
+    existing (unfused) journal: the fingerprint omits the knob at its
+    default and only sees it when fused."""
+    from coast_tpu.inject.journal import config_fingerprint
+    region = resolve_region("matrixMultiply")
+    cfg_u = TMR(region).cfg
+    cfg_f = TMR(region, fuse_step=True).cfg
+    fields = dataclasses.asdict(cfg_u)
+    fields.pop("fuse_step")
+    import hashlib
+    import json
+    legacy = hashlib.sha256(
+        json.dumps(fields, sort_keys=True,
+                   default=str).encode()).hexdigest()[:16]
+    assert config_fingerprint(cfg_u) == legacy
+    assert config_fingerprint(cfg_f) != legacy
+
+
+# ---------------------------------------------------------------------------
+# the FusePlan prunings: pinned against the region structure
+# ---------------------------------------------------------------------------
+
+def test_plan_done_cone_and_frozen_leaves():
+    prog = TMR(resolve_region("matrixMultiply"), fuse_step=True)
+    plan = prog._fuse_plan
+    assert plan is not None
+    # mm's done() reads only the loop counter: the done cone prunes the
+    # vote-for-done to one leaf.
+    assert plan.done_leaves == frozenset({"i"})
+    # Freeze pruning: only leaves the step can write (written + synced)
+    # re-commit; read-only operands commit their stale lanes directly.
+    assert plan.frozen_leaves == frozenset(
+        {"i", "results", "phase", "acc"})
+    # Registry mm runs 18 of 54 bounded steps: the while_loop survives.
+    assert not plan.bounded_scan
+
+
+def test_plan_train_float_gate():
+    """train_mlp has float32 leaves: the planner still derives the
+    prunings (done cone = the iteration counter) but exact_dataflow is
+    False, so the ENGINE keeps the legacy schedule -- float dataflow
+    re-rounds under any program restructuring (XLA fusion/FMA lowering
+    is context dependent), and an iterated region amplifies a 1-ulp
+    difference into a different classification.  cfg.fuse_step still
+    marks campaign identity (the journal header's fuse key)."""
+    prog = TMR(resolve_region("train_mlp"), fuse_step=True)
+    assert prog.fuse_plan_info.done_leaves == frozenset({"it"})
+    assert not prog.fuse_plan_info.exact_dataflow
+    assert prog._fuse_plan is None and prog._sparse_flip is None
+    assert prog.cfg.fuse_step
+
+
+def test_plan_exact_dataflow_integer_regions():
+    """The all-integer regions (mm, crc16) pass the exactness gate: any
+    schedule computes bit-identical values, so the fused engine
+    activates."""
+    for name in ("matrixMultiply", "crc16"):
+        prog = TMR(resolve_region(name), fuse_step=True)
+        assert prog.fuse_plan_info.exact_dataflow, name
+        assert prog._fuse_plan is not None, name
+
+
+def test_bounded_scan_region_parity():
+    """No registry region has max_steps == nominal_steps, so the bounded
+    scan arm is exercised on a synthetic mm variant with the bound
+    tightened to the nominal trip count (sound under TMR: corrected
+    lanes finish on schedule)."""
+    region = resolve_region("matrixMultiply")
+    tight = dataclasses.replace(region, max_steps=region.nominal_steps)
+    progs = {f: TMR(tight, fuse_step=f) for f in (False, True)}
+    assert progs[True]._fuse_plan.bounded_scan
+    results = [
+        CampaignRunner(progs[f], strategy_name="TMR").run(
+            48, seed=13, batch_size=48)
+        for f in (False, True)]
+    _assert_result_parity(*results)
+
+
+def test_fused_flags_packed_latch_words():
+    """The fused engine carries its guard flags as one packed uint32
+    latch word (+ int32 counters), unpacked only at record extraction."""
+    prog = TMR(resolve_region("matrixMultiply"), fuse_step=True)
+    _, flags = prog.init_pstate()
+    assert flags["latch"].dtype == jnp.uint32
+    assert set(flags) == {"latch", "tmr_cnt", "sync_cnt", "steps"}
+
+
+def test_latch_pack_unpack_roundtrip():
+    latch = jnp.uint32(0)
+    latch = fused_step.latch_or(latch, fused_step.LATCH_DONE, jnp.bool_(True))
+    latch = fused_step.latch_or(latch, fused_step.LATCH_CFC, jnp.bool_(True))
+    assert int(latch) == (1 << fused_step.LATCH_DONE) | \
+        (1 << fused_step.LATCH_CFC)
+    assert bool(fused_step.latch_get(latch, fused_step.LATCH_CFC))
+    assert not bool(fused_step.latch_get(latch, fused_step.LATCH_DWC))
+    # DONE alone is the boundary's reached_call predicate.
+    assert fused_step.LATCH_DONE_ONLY == 1 << fused_step.LATCH_DONE
+
+
+def test_unfused_twin_identity():
+    region = resolve_region("matrixMultiply")
+    fused = TMR(region, fuse_step=True)
+    twin = fused.unfused_twin()
+    assert not twin.cfg.fuse_step
+    assert twin.cfg == dataclasses.replace(fused.cfg, fuse_step=False)
+    plain = TMR(region)
+    assert plain.unfused_twin() is plain
+
+
+# ---------------------------------------------------------------------------
+# the Pallas commit kernel: interpret-mode parity with the jnp path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_lanes", [2, 3])
+def test_vote_flip_commit_interpret_parity(n_lanes):
+    key = jax.random.PRNGKey(n_lanes)
+    lane = jax.random.randint(key, (256, 128), 0, 1 << 30,
+                              dtype=jnp.int32)
+    lanes = jnp.broadcast_to(lane, (n_lanes, 256, 128))
+    masks = jnp.zeros((n_lanes, 256, 128), jnp.uint32)
+    masks = masks.at[0, 3, 7].set(jnp.uint32(1 << 5))
+    ref = fused_step.vote_flip_commit(lanes, masks, n_lanes,
+                                      interpret=False)
+    kern = fused_step.vote_flip_commit(lanes, masks, n_lanes,
+                                       interpret=True)
+    for r, k in zip(ref, kern):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(k))
+    # A clean pass reports no miscompare anywhere.
+    clean = fused_step.vote_flip_commit(
+        lanes, jnp.zeros_like(masks), n_lanes, interpret=True)
+    assert not bool(np.asarray(clean[2]).any())
+
+
+# ---------------------------------------------------------------------------
+# roofline: pallas_call-aware op accounting (pinned counts)
+# ---------------------------------------------------------------------------
+
+def test_roofline_counts_pallas_call_kernel_ops():
+    from coast_tpu.obs.roofline import count_jaxpr_ops
+
+    def voted(lanes):
+        masks = jnp.zeros_like(lanes, dtype=jnp.uint32)
+        return fused_step.vote_flip_commit(lanes, masks, 3)
+
+    lanes = jnp.zeros((3, 256, 128), jnp.int32)
+    jaxpr = jax.make_jaxpr(voted)(lanes)
+    ops = count_jaxpr_ops(jaxpr.jaxpr)
+    prims = [str(e.primitive) for e in jaxpr.jaxpr.eqns]
+    if "pallas_call" not in prims:
+        pytest.skip("kernel not eligible on this backend build")
+    # Pinned: the (3,256,128) commit kernel counts its inner jaxpr times
+    # the grid, not as one opaque op (which would overstate MFU).
+    assert ops > 3 * 256 * 128          # at least one op per word voted
+    assert ops == pytest.approx(264195, abs=0)
+
+
+def test_roofline_fused_program_op_counts_pinned():
+    """The A/B the perf narrative quotes, pinned: the fused mm programs'
+    measured op counts and the >= 2x overhead cut for TMR."""
+    from coast_tpu.obs import roofline
+    region = resolve_region("matrixMultiply")
+    expect = {
+        ("TMR", False): 95685, ("TMR", True): 31348,
+        ("DWC", False): 47029, ("DWC", True): 18229,
+    }
+    for (strat, fused), want in expect.items():
+        prog = STRATEGIES[strat](region, fuse_step=fused)
+        got = roofline.program_ops_per_run(prog)
+        assert got == pytest.approx(want, rel=0.02), (strat, fused, got)
+    tmr_cut = (roofline.flops_overhead(TMR(region)) /
+               roofline.flops_overhead(TMR(region, fuse_step=True)))
+    dwc_cut = (roofline.flops_overhead(DWC(region)) /
+               roofline.flops_overhead(DWC(region, fuse_step=True)))
+    assert tmr_cut >= 2.0
+    assert dwc_cut >= 2.0
+
+
+# ---------------------------------------------------------------------------
+# CLI knob
+# ---------------------------------------------------------------------------
+
+def test_opt_cli_fuse_flags():
+    from coast_tpu.opt import UsageError, build_overrides, parse_argv
+    flags, _ = parse_argv(["-TMR", "-fuseStep"])
+    assert build_overrides(flags)["fuse_step"] is True
+    flags, _ = parse_argv(["-TMR", "-noFuseStep"])
+    assert build_overrides(flags)["fuse_step"] is False
+    flags, _ = parse_argv(["-TMR"])
+    assert "fuse_step" not in build_overrides(flags)
+    with pytest.raises(UsageError):
+        build_overrides(parse_argv(["-fuseStep", "-noFuseStep"])[0])
+
+
+def test_supervisor_build_program_fused_parity():
+    from coast_tpu.inject.supervisor import build_program
+    prog, strategy = build_program("matrixMultiply", "-TMR -fuseStep")
+    assert strategy == "TMR"
+    assert prog.cfg.fuse_step
+    base, _ = build_program("matrixMultiply", "-TMR")
+    a = CampaignRunner(base, strategy_name="TMR").run(
+        32, seed=2, batch_size=32)
+    b = CampaignRunner(prog, strategy_name="TMR").run(
+        32, seed=2, batch_size=32)
+    _assert_result_parity(a, b)
